@@ -1,0 +1,80 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Re-designed from scratch for TPU (jax/XLA/pallas/pjit) with the API surface
+and capabilities of the PaddlePaddle Fluid reference (gc1023/Paddle):
+eager (dygraph) + static (Program/Executor) modes, nn layers, optimizers,
+data pipeline, Mesh-based distributed training (dp/tp/pp/sp/ep), AMP,
+checkpointing, inference, and a model zoo.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import (
+    Tensor,
+    Parameter,
+    no_grad,
+    enable_grad,
+    is_grad_enabled,
+)
+from .core.autograd import grad
+from .core.tensor import to_tensor
+from .core import dtype as _dtype_mod
+from .core.dtype import (
+    float16, bfloat16, float32, float64, int8, int16, int32, int64, uint8,
+    bool_, complex64, complex128, set_default_dtype, get_default_dtype,
+)
+from .core.device import (
+    set_device, get_device, device_count, is_compiled_with_tpu,
+    TPUPlace, CPUPlace, CUDAPlace, Place,
+)
+from .core.random import seed
+
+# ops: import attaches Tensor methods, then re-export the functional API
+from . import ops
+from .ops.creation import (
+    zeros, ones, full, empty, zeros_like, ones_like, full_like, empty_like,
+    arange, linspace, logspace, eye, tril, triu, meshgrid, diagflat, assign,
+    clone, rand, randn, randint, randperm, uniform, normal, bernoulli,
+    multinomial, standard_normal,
+)
+from .ops.math import (
+    add, subtract, multiply, divide, floor_divide, remainder, mod, pow,
+    matmul, mm, bmm, dot, outer, inner, scale, clip, add_n, cumsum, cumprod,
+    lerp, einsum, kron, trace, diag, diagonal, nan_to_num, stanh, exp, expm1,
+    log, log2, log10, log1p, sqrt, rsqrt, abs, neg, floor, ceil, round, trunc,
+    sin, cos, tan, asin, acos, atan, sinh, cosh, asinh, acosh, atanh, erf,
+    erfinv, sign, reciprocal, square, digamma, lgamma, isnan, isinf, isfinite,
+    maximum, minimum, atan2, logaddexp, increment, mul,
+)
+from .ops.reduction import (
+    sum, mean, max, min, prod, all, any, logsumexp, argmax, argmin, std, var,
+    median, quantile, kthvalue, mode as mode_op, count_nonzero, nansum,
+    nanmean, amax, amin,
+)
+from .ops.manipulation import (
+    reshape, transpose, t, flatten, squeeze, unsqueeze, concat, stack, split,
+    chunk, unbind, slice, strided_slice, gather, gather_nd, take_along_axis,
+    index_select, index_sample, scatter, scatter_nd, scatter_nd_add,
+    put_along_axis, tile, expand, broadcast_to, expand_as, repeat_interleave,
+    flip, roll, pad, where, topk, sort, argsort, one_hot, cast, nonzero,
+    masked_select, unique, masked_fill, bincount, moveaxis, swapaxes, rot90,
+    shard_index, as_real, as_complex,
+)
+from .ops.compare import (
+    equal, not_equal, less_than, less_equal, greater_than, greater_equal,
+    logical_and, logical_or, logical_xor, logical_not, bitwise_and,
+    bitwise_or, bitwise_xor, bitwise_not, isclose, allclose, equal_all,
+    is_empty, is_tensor,
+)
+from .ops.activation import tanh  # noqa: F401  (others live in nn.functional)
+from .ops.linalg import (
+    norm, dist, cholesky, inverse, matrix_power, pinv, svd, qr, eig, eigh,
+    eigvals, eigvalsh, matrix_rank, det, slogdet, cross, triangular_solve,
+    cholesky_solve, solve, lstsq, histogram, mv, multi_dot, cov, corrcoef,
+)
+from .ops.control_flow import cond, while_loop, case, switch_case, scan
+
+bool = bool_  # paddle.bool
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,10 @@
+from . import dtype, device, random, dispatch
+from .tensor import Tensor, Parameter, to_tensor
+from .dispatch import no_grad, enable_grad, is_grad_enabled
+from .autograd import backward, grad
+
+__all__ = [
+    "dtype", "device", "random", "dispatch",
+    "Tensor", "Parameter", "to_tensor",
+    "no_grad", "enable_grad", "is_grad_enabled", "backward", "grad",
+]

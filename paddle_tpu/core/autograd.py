@@ -1,0 +1,143 @@
+"""Eager autograd engine.
+
+TPU-native analog of the reference's ``paddle/fluid/imperative/basic_engine.cc``
+(+ ``partial_grad_engine.cc`` for ``paddle.grad``): instead of registered
+per-op grad kernels, every taped op carries the ``jax.vjp`` closure captured at
+forward time, so backward is a reverse walk calling XLA-compiled vjps. The
+walk itself is jax-traceable, which lets a whole dygraph train step be wrapped
+in ``jax.jit`` and fuse forward+backward+update into one executable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import dispatch
+from .dispatch import default_tape, fresh_tape
+from .tensor import Tensor
+
+_hooks: dict[int, list] = {}
+
+
+def register_hook(tensor: Tensor, hook):
+    _hooks.setdefault(tensor._id, []).append(hook)
+
+    class _Removable:
+        def remove(self):
+            _hooks.get(tensor._id, []).remove(hook)
+
+    return _Removable()
+
+
+def _walk(tape_nodes, seed_grads, retain_graph, accumulate_into_grad=True,
+          wanted: dict | None = None):
+    """Reverse-walk ``tape_nodes``. ``seed_grads``: {tensor_id: cotangent}.
+
+    Returns dict of {tensor_id: cotangent} for tensors in ``wanted`` (or all
+    leaves if wanted is None and accumulate_into_grad is set).
+    """
+    del accumulate_into_grad
+    pending: dict[int, jax.Array] = dict(seed_grads)
+    results: dict[int, jax.Array] = {}
+
+    def _fire_hooks(t, g):
+        for h in _hooks.get(t._id, ()):  # user hooks may transform the grad
+            out = h(Tensor(g, _internal=True))
+            if out is not None:
+                g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+        return g
+
+    for node in reversed(tape_nodes):
+        if not any(o._id in pending for o in node.outputs):
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to backward through the graph a second time "
+                "(pass retain_graph=True)"
+            )
+        cotangents = tuple(
+            pending.get(o._id, jnp.zeros(o._data.shape, o._data.dtype))
+            for o in node.outputs
+        )
+        # Once the producer is visited no more contributions can arrive
+        # (tape order is topological), so capture wanted intermediates now.
+        for o in node.outputs:
+            if o._id in pending:
+                if wanted is not None and o._id in wanted:
+                    results[o._id] = pending[o._id]
+                del pending[o._id]
+        grads_in = node.vjp_fn(cotangents if len(cotangents) > 1 else cotangents[0])
+        for t, g in zip(node.inputs, grads_in):
+            if t is None or t.stop_gradient:
+                continue
+            if g.dtype == jax.dtypes.float0:
+                continue
+            g = _fire_hooks(t, g)
+            pending[t._id] = pending[t._id] + g if t._id in pending else g
+        if not retain_graph:
+            node.vjp_fn = None
+    for tid, g in pending.items():
+        if wanted is None or tid in wanted:
+            results.setdefault(tid, g)
+    return results
+
+
+def backward(tensor: Tensor, grad_tensor=None, retain_graph=False):
+    """Populate ``.grad`` on all reachable leaves (ref: VarBase::RunBackward)."""
+    tape = default_tape()
+    if grad_tensor is None:
+        seed = jnp.ones(tensor._data.shape, tensor._data.dtype)
+    else:
+        seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    produced = {o._id for n in tape.nodes for o in n.outputs}
+    id2tensor: dict[int, Tensor] = {}
+    for n in tape.nodes:
+        for t in n.inputs:
+            if t is not None and not t.stop_gradient:
+                id2tensor[t._id] = t
+
+    with dispatch.no_grad():
+        results = _walk(tape.nodes, {tensor._id: seed}, retain_graph)
+
+    for tid, g in results.items():
+        t = id2tensor.get(tid)
+        if t is None or tid in produced:
+            continue  # only leaves get .grad (paddle semantics)
+        t.grad = Tensor(g, _internal=True) if t.grad is None else Tensor(t.grad._data + g, _internal=True)
+    if not retain_graph:
+        tape.clear()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """Functional gradients (ref: python/paddle/fluid/dygraph/base.py grad)."""
+    del only_inputs
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    tape = default_tape()
+    seeds = {}
+    for o, go in zip(outputs, grad_outputs):
+        g = jnp.ones(o._data.shape, o._data.dtype) if go is None else (
+            go._data if isinstance(go, Tensor) else jnp.asarray(go))
+        seeds[o._id] = seeds.get(o._id, 0) + g
+
+    wanted = {t._id: t for t in inputs}
+    keep = retain_graph if retain_graph is not None else create_graph
+    with dispatch.no_grad():
+        results = _walk(tape.nodes, seeds, keep, accumulate_into_grad=False, wanted=wanted)
+
+    out = []
+    for t in inputs:
+        if t._id in results:
+            out.append(Tensor(results[t._id], stop_gradient=not create_graph, _internal=True))
+        elif allow_unused:
+            out.append(None)
+        else:
+            raise RuntimeError(f"tensor {t.name} is unused in the graph (pass allow_unused=True)")
+    return out

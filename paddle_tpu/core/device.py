@@ -1,0 +1,95 @@
+"""Device management.
+
+TPU-native analog of the reference's ``paddle/fluid/platform/place.h``
+(CPUPlace/CUDAPlace/CUDAPinnedPlace) and ``device_context.{h,cc}``.
+On TPU there is no per-op stream management — XLA owns scheduling — so a
+"place" reduces to a jax.Device plus helpers for host staging.
+"""
+from __future__ import annotations
+
+import functools
+import jax
+
+
+class Place:
+    """A device placement (ref: platform::Place)."""
+
+    def __init__(self, kind: str, index: int = 0):
+        self.kind = kind
+        self.index = index
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.index})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.kind == other.kind
+            and self.index == other.index
+        )
+
+    def __hash__(self):
+        return hash((self.kind, self.index))
+
+    @property
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _kind_of(d) == self.kind]
+        if not devs:  # fall back to whatever the default backend offers
+            devs = jax.devices()
+        return devs[min(self.index, len(devs) - 1)]
+
+
+def TPUPlace(index: int = 0) -> Place:
+    return Place("tpu", index)
+
+
+def CPUPlace(index: int = 0) -> Place:
+    return Place("cpu", index)
+
+
+# The reference exposes CUDAPlace; accepting the name keeps recipes portable.
+def CUDAPlace(index: int = 0) -> Place:  # pragma: no cover - alias
+    return TPUPlace(index)
+
+
+def _kind_of(dev) -> str:
+    plat = getattr(dev, "platform", "cpu")
+    return "tpu" if plat not in ("cpu",) else "cpu"
+
+
+_CURRENT = [None]
+
+
+def set_device(device) -> Place:
+    """set_device("tpu"), set_device("cpu"), set_device("tpu:0")."""
+    if isinstance(device, Place):
+        _CURRENT[0] = device
+        return device
+    name, _, idx = str(device).partition(":")
+    if name in ("gpu", "cuda", "xpu"):
+        name = "tpu"
+    place = Place(name, int(idx) if idx else 0)
+    _CURRENT[0] = place
+    return place
+
+
+def get_device() -> str:
+    p = current_place()
+    return f"{p.kind}:{p.index}"
+
+
+def current_place() -> Place:
+    if _CURRENT[0] is None:
+        _CURRENT[0] = Place(_kind_of(jax.devices()[0]), 0)
+    return _CURRENT[0]
+
+
+@functools.lru_cache(maxsize=None)
+def device_count(kind: str = None) -> int:
+    if kind is None:
+        return len(jax.devices())
+    return len([d for d in jax.devices() if _kind_of(d) == kind])
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(_kind_of(d) == "tpu" for d in jax.devices())

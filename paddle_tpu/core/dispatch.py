@@ -1,0 +1,192 @@
+"""Central op dispatch.
+
+This replaces the reference's op-dispatch machinery
+(``paddle/fluid/framework/operator.cc`` OperatorWithKernel::Run and
+``paddle/fluid/imperative/tracer.cc``): every framework op is a *pure jax
+function*. In eager (dygraph) mode we execute it immediately, recording a
+vjp closure on the autograd tape when gradients are required. In static mode
+a Program builder intercepts the call and records a symbolic op instead; the
+Executor later re-plays the recorded graph under ``jax.jit`` so the whole
+program compiles to ONE fused XLA executable (the TPU-correct analog of the
+reference's op-by-op kernel launches).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "apply",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "register_tracer",
+    "current_tracer",
+]
+
+_tls = threading.local()
+
+
+def _state():
+    if not hasattr(_tls, "grad_enabled"):
+        _tls.grad_enabled = True
+        _tls.tracer_stack = []  # static-graph program builders
+        _tls.tape_stack = []  # autograd tapes (innermost last)
+    return _tls
+
+
+def is_grad_enabled() -> bool:
+    return _state().grad_enabled
+
+
+@contextlib.contextmanager
+def no_grad():
+    st = _state()
+    prev, st.grad_enabled = st.grad_enabled, False
+    try:
+        yield
+    finally:
+        st.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    st = _state()
+    prev, st.grad_enabled = st.grad_enabled, True
+    try:
+        yield
+    finally:
+        st.grad_enabled = prev
+
+
+# ---------------------------------------------------------------------------
+# Static-graph tracer hook
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def register_tracer(tracer):
+    """Push a static-graph tracer; ops are recorded instead of executed."""
+    st = _state()
+    st.tracer_stack.append(tracer)
+    try:
+        yield tracer
+    finally:
+        st.tracer_stack.pop()
+
+
+def current_tracer():
+    st = _state()
+    return st.tracer_stack[-1] if st.tracer_stack else None
+
+
+# ---------------------------------------------------------------------------
+# Autograd tape
+# ---------------------------------------------------------------------------
+
+
+class TapeNode:
+    __slots__ = ("inputs", "outputs", "vjp_fn", "name")
+
+    def __init__(self, name, inputs, outputs, vjp_fn):
+        self.name = name
+        self.inputs = inputs  # list[Tensor]
+        self.outputs = outputs  # list[Tensor]
+        self.vjp_fn = vjp_fn
+
+
+class Tape:
+    def __init__(self):
+        self.nodes: list[TapeNode] = []
+
+    def record(self, node):
+        self.nodes.append(node)
+
+    def clear(self):
+        self.nodes.clear()
+
+
+def default_tape() -> Tape:
+    st = _state()
+    if not st.tape_stack:
+        st.tape_stack.append(Tape())
+    return st.tape_stack[-1]
+
+
+@contextlib.contextmanager
+def fresh_tape():
+    """Scoped tape, used by paddle_tpu.grad() for double-backward isolation."""
+    st = _state()
+    t = Tape()
+    st.tape_stack.append(t)
+    try:
+        yield t
+    finally:
+        st.tape_stack.pop()
+
+
+# ---------------------------------------------------------------------------
+# apply(): the single entry point every op goes through
+# ---------------------------------------------------------------------------
+
+
+def _is_tensor(x):
+    from .tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+def _unwrap(x):
+    return x._data if _is_tensor(x) else x
+
+
+def _wrap(arr, stop_gradient=True):
+    from .tensor import Tensor
+
+    return Tensor(arr, stop_gradient=stop_gradient, _internal=True)
+
+
+def _all_float(out):
+    outs = out if isinstance(out, tuple) else (out,)
+    return all(jnp.issubdtype(o.dtype, jnp.inexact) for o in outs)
+
+
+def apply(name, fn, *args, **attrs):
+    """Run op ``name`` implemented by pure function ``fn``.
+
+    ``args`` are tensor-like (differentiable) inputs; ``attrs`` are static
+    python attributes baked into the computation (ref: OpDesc attrs).
+    ``fn(*arrays, **attrs)`` must be jax-traceable and return one array or a
+    tuple of arrays.
+    """
+    tracer = current_tracer()
+    if tracer is not None:
+        return tracer.trace_op(name, fn, args, attrs)
+
+    arrays = [_unwrap(a) for a in args]
+    need_grad = is_grad_enabled() and any(
+        _is_tensor(a) and not a.stop_gradient for a in args
+    )
+
+    if need_grad:
+        out, vjp_fn = jax.vjp(lambda *xs: fn(*xs, **attrs), *arrays)
+        if not _all_float(out):
+            # Non-differentiable outputs (argmax, comparisons...): keep the
+            # values, drop the tape record.
+            need_grad = False
+    else:
+        out = fn(*arrays, **attrs)
+
+    multi = isinstance(out, tuple)
+    outs = out if multi else (out,)
+    out_tensors = tuple(_wrap(o, stop_gradient=not need_grad) for o in outs)
+
+    if need_grad:
+        in_tensors = [a if _is_tensor(a) else None for a in args]
+        default_tape().record(
+            TapeNode(name, in_tensors, list(out_tensors), vjp_fn)
+        )
+    return out_tensors if multi else out_tensors[0]

@@ -1,0 +1,94 @@
+"""Dtype registry.
+
+TPU-native analog of the reference's ``paddle/fluid/framework/data_type.h``
+(proto VarType dtypes): we map Paddle-style dtype names onto jnp dtypes and
+default to bfloat16-friendly promotion on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical name -> jnp dtype. TPU-native canonicalization: 64-bit types map
+# to their 32-bit counterparts (int32 is the hardware int; f64 has no TPU
+# unit). The reference defaults python ints to int64 — we accept the names
+# for API parity and store 32-bit.
+_DTYPE_MAP = {
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float32,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int32,
+    "uint8": jnp.uint8,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex64,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float32  # canonicalized (no f64 unit on TPU)
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int32  # canonicalized (TPU int is 32-bit)
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex64
+
+_DEFAULT_DTYPE = ["float32"]
+
+
+def set_default_dtype(d):
+    _DEFAULT_DTYPE[0] = dtype_name(d)
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def convert_dtype(d):
+    """Normalize any dtype spec (str, np/jnp dtype, python type) -> np.dtype."""
+    if d is None:
+        d = _DEFAULT_DTYPE[0]
+    if isinstance(d, str):
+        d = _ALIASES.get(d, d)
+        if d not in _DTYPE_MAP:
+            raise ValueError(f"unknown dtype {d!r}")
+        return jnp.dtype(_DTYPE_MAP[d])
+    if d is float:
+        return jnp.dtype(_DTYPE_MAP[_DEFAULT_DTYPE[0]])
+    if d is int:
+        return jnp.dtype(jnp.int32)
+    if d is bool:
+        return jnp.dtype(jnp.bool_)
+    return jnp.dtype(d)
+
+
+def dtype_name(d) -> str:
+    return convert_dtype(d).name
+
+
+def is_floating(d) -> bool:
+    return jnp.issubdtype(convert_dtype(d), np.floating)
+
+
+def is_integer(d) -> bool:
+    return jnp.issubdtype(convert_dtype(d), np.integer)

@@ -1,0 +1,34 @@
+"""Global RNG state.
+
+TPU-native analog of the reference's ``paddle/fluid/framework/generator.cc``:
+instead of a stateful Philox generator per device, we keep a root jax PRNG key
+and split deterministically. Eager ops draw fresh subkeys; traced code must
+thread keys explicitly (``paddle_tpu.jit`` threads one automatically).
+"""
+from __future__ import annotations
+
+import jax
+
+_STATE = {"seed": 0, "count": 0}
+
+
+def seed(s: int) -> None:
+    """Set the global seed (ref: fluid.default_main_program().random_seed)."""
+    _STATE["seed"] = int(s)
+    _STATE["count"] = 0
+
+
+def get_seed() -> int:
+    return _STATE["seed"]
+
+
+def next_key():
+    """A fresh subkey. Stateful: only for eager use (not inside jit traces)."""
+    k = jax.random.fold_in(jax.random.PRNGKey(_STATE["seed"]), _STATE["count"])
+    _STATE["count"] += 1
+    return k
+
+
+def key_for(*, salt: int = 0):
+    """Deterministic key from the global seed; safe to call at trace time."""
+    return jax.random.fold_in(jax.random.PRNGKey(_STATE["seed"]), salt)

@@ -1,0 +1,210 @@
+"""Tensor: the eager (dygraph) value type.
+
+TPU-native analog of the reference's ``VarBase``/``LoDTensor``
+(``paddle/fluid/imperative/layer.h``, ``framework/lod_tensor.h``): a thin
+wrapper over an immutable ``jax.Array`` plus Paddle's ``stop_gradient``
+autograd contract. Ragged (LoD) data is represented as dense data + explicit
+offset arrays (see ops/sequence.py) — dynamic shapes don't tile onto the MXU,
+so the dense+offsets layout is the TPU-correct encoding.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dispatch
+from .dtype import convert_dtype
+
+_tensor_id = [0]
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "name", "persistable", "_id")
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None, _internal=False):
+        if isinstance(data, Tensor):
+            data = data._data
+        if _internal:
+            self._data = data
+        else:
+            if dtype is not None:
+                dtype = convert_dtype(dtype)
+            elif isinstance(data, (bool, int)):
+                dtype = jnp.int32 if isinstance(data, int) and not isinstance(data, bool) else jnp.bool_
+            elif isinstance(data, float):
+                dtype = jnp.float32
+            elif isinstance(data, np.ndarray) and data.dtype == np.float64:
+                dtype = jnp.float32
+            self._data = jnp.asarray(data, dtype=dtype)
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        _tensor_id[0] += 1
+        self._id = _tensor_id[0]
+        self.name = name or f"tensor_{self._id}"
+        self.persistable = False
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def T(self):
+        return dispatch.apply("transpose", lambda x: jnp.swapaxes(x, -2, -1) if x.ndim >= 2 else x, self)
+
+    @property
+    def is_leaf(self):
+        return True  # overwritten per-instance semantics not needed: leaves tracked by tape
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def numel(self):
+        return self.size
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, _internal=True)
+        return t
+
+    def clone(self):
+        return dispatch.apply("clone", lambda x: x + jnp.zeros((), x.dtype), self)
+
+    def astype(self, dtype):
+        d = convert_dtype(dtype)
+        return dispatch.apply("cast", lambda x: x.astype(d), self)
+
+    cast = astype
+
+    def _replace(self, arr):
+        """In-place value rebind (ref: VarBase::SetValue). Breaks no tape."""
+        self._data = arr
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = jnp.asarray(value, dtype=self._data.dtype).reshape(self._data.shape)
+
+    def copy_(self, other):
+        self.set_value(other)
+        return self
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from . import autograd
+
+        autograd.backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def register_hook(self, hook):
+        from . import autograd
+
+        return autograd.register_hook(self, hook)
+
+    # -- operators (minimal set; rich API monkey-patched by ops package) ----
+    def __len__(self):
+        if not self._data.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"stop_gradient={self.stop_gradient},\n{np.asarray(self._data)})"
+        )
+
+    def __float__(self):
+        return float(self._data.item())
+
+    def __int__(self):
+        return int(self._data.item())
+
+    def __bool__(self):
+        return bool(self._data.item())
+
+    def __format__(self, spec):
+        if self.size == 1:
+            return format(self._data.item(), spec)
+        return repr(self)
+
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return dispatch.apply("slice", lambda x: x[idx], self)
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = self._data.at[idx].set(value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    # arithmetic dunders are attached by paddle_tpu.ops (monkey_patch_tensor)
+
+    # jax interop: allow jnp.asarray(tensor) inside user code
+    def __jax_array__(self):
+        return self._data
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(i._data if isinstance(i, Tensor) else i for i in idx)
+    return idx
+
+
+class Parameter(Tensor):
+    """Trainable tensor (ref: framework::Parameter / ParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, data, name=None, trainable=True):
+        super().__init__(data, stop_gradient=not trainable, name=name, _internal=isinstance(data, jax.Array))
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor equivalent."""
+    del place  # XLA owns placement; sharding APIs control device layout
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)

@@ -1,0 +1,136 @@
+"""Op library: every op is a pure jax kernel in the OP_REGISTRY.
+
+Analog of the reference's ``paddle/fluid/operators`` — but instead of ~500
+hand-written CPU/CUDA kernels, ops are jnp/lax compositions that XLA fuses.
+``monkey_patch_tensor()`` attaches the rich method/dunder API onto Tensor
+(ref: python/paddle/fluid/dygraph/math_op_patch.py monkey_patch_math_varbase).
+"""
+from __future__ import annotations
+
+from ._base import OP_REGISTRY, apply, register
+from . import (  # noqa: F401
+    math,
+    creation,
+    manipulation,
+    reduction,
+    compare,
+    activation,
+    linalg,
+    conv,
+    norm_ops,
+    sequence,
+    control_flow,
+    random_ops,
+)
+from ..core.tensor import Tensor
+
+
+def _rops():
+    from .math import (
+        add, subtract, multiply, divide, floor_divide, remainder, pow as _pow,
+        matmul,
+    )
+    from .compare import (
+        equal, not_equal, less_than, less_equal, greater_than, greater_equal,
+    )
+
+    def _swap(fn):
+        return lambda self, other: fn(other, self)
+
+    Tensor.__add__ = add
+    Tensor.__radd__ = _swap(add)
+    Tensor.__sub__ = subtract
+    Tensor.__rsub__ = _swap(subtract)
+    Tensor.__mul__ = multiply
+    Tensor.__rmul__ = _swap(multiply)
+    Tensor.__truediv__ = divide
+    Tensor.__rtruediv__ = _swap(divide)
+    Tensor.__floordiv__ = floor_divide
+    Tensor.__rfloordiv__ = _swap(floor_divide)
+    Tensor.__mod__ = remainder
+    Tensor.__rmod__ = _swap(remainder)
+    Tensor.__pow__ = _pow
+    Tensor.__rpow__ = _swap(_pow)
+    Tensor.__matmul__ = matmul
+    Tensor.__rmatmul__ = _swap(matmul)
+    Tensor.__neg__ = lambda self: math.neg(self)
+    Tensor.__abs__ = lambda self: math.abs(self)
+    Tensor.__invert__ = lambda self: compare.logical_not(self)
+    Tensor.__eq__ = equal
+    Tensor.__ne__ = not_equal
+    Tensor.__lt__ = less_than
+    Tensor.__le__ = less_equal
+    Tensor.__gt__ = greater_than
+    Tensor.__ge__ = greater_equal
+    Tensor.__and__ = compare.logical_and
+    Tensor.__or__ = compare.logical_or
+    Tensor.__xor__ = compare.logical_xor
+
+
+_METHODS = {}
+
+
+def monkey_patch_tensor():
+    _rops()
+    from . import math as m, reduction as r, manipulation as mp, activation as a
+    from . import linalg as la, compare as cm, creation as cr
+
+    methods = dict(
+        # math
+        add=m.add, subtract=m.subtract, multiply=m.multiply, divide=m.divide,
+        matmul=m.matmul, mm=m.mm, bmm=m.bmm, dot=m.dot, pow=m.pow,
+        exp=m.exp, log=m.log, log2=m.log2, log10=m.log10, log1p=m.log1p,
+        sqrt=m.sqrt, rsqrt=m.rsqrt, abs=m.abs, floor=m.floor, ceil=m.ceil,
+        round=m.round, trunc=m.trunc, sin=m.sin, cos=m.cos, tan=m.tan,
+        sinh=m.sinh, cosh=m.cosh, asin=m.asin, acos=m.acos, atan=m.atan,
+        erf=m.erf, sign=m.sign, reciprocal=m.reciprocal, square=m.square,
+        scale=m.scale, clip=m.clip, cumsum=m.cumsum, cumprod=m.cumprod,
+        maximum=m.maximum, minimum=m.minimum, remainder=m.remainder,
+        mod=m.remainder, floor_divide=m.floor_divide, kron=m.kron,
+        trace=m.trace, diagonal=m.diagonal, lerp=m.lerp,
+        isnan=m.isnan, isinf=m.isinf, isfinite=m.isfinite,
+        nan_to_num=m.nan_to_num, neg=m.neg,
+        # reduction
+        sum=r.sum, mean=r.mean, max=r.max, min=r.min, prod=r.prod,
+        all=r.all, any=r.any, argmax=r.argmax, argmin=r.argmin,
+        std=r.std, var=r.var, median=r.median, logsumexp=r.logsumexp,
+        quantile=r.quantile, kthvalue=r.kthvalue, mode=r.mode,
+        count_nonzero=r.count_nonzero, nansum=r.nansum, nanmean=r.nanmean,
+        # manipulation
+        reshape=mp.reshape, transpose=mp.transpose, flatten=mp.flatten,
+        squeeze=mp.squeeze, unsqueeze=mp.unsqueeze, split=mp.split,
+        chunk=mp.chunk, unbind=mp.unbind, gather=mp.gather,
+        gather_nd=mp.gather_nd, scatter=mp.scatter, tile=mp.tile,
+        expand=mp.expand, expand_as=mp.expand_as, broadcast_to=mp.broadcast_to,
+        flip=mp.flip, roll=mp.roll, topk=mp.topk, sort=mp.sort,
+        argsort=mp.argsort, index_select=mp.index_select,
+        index_sample=mp.index_sample, masked_select=mp.masked_select,
+        masked_fill=mp.masked_fill, where=mp.where, nonzero=mp.nonzero,
+        unique=mp.unique, repeat_interleave=mp.repeat_interleave,
+        moveaxis=mp.moveaxis, swapaxes=mp.swapaxes,
+        take_along_axis=mp.take_along_axis, put_along_axis=mp.put_along_axis,
+        # activation
+        tanh=a.tanh, softmax=a.softmax, sigmoid=a.sigmoid, relu=a.relu,
+        # linalg
+        norm=la.norm, dist=la.dist, cholesky=la.cholesky, inverse=la.inverse,
+        matrix_power=la.matrix_power, det=la.det, slogdet=la.slogdet,
+        cross=la.cross, solve=la.solve, mv=la.mv, pinv=la.pinv,
+        # compare
+        equal=cm.equal, not_equal=cm.not_equal, less_than=cm.less_than,
+        less_equal=cm.less_equal, greater_than=cm.greater_than,
+        greater_equal=cm.greater_equal, logical_and=cm.logical_and,
+        logical_or=cm.logical_or, logical_not=cm.logical_not,
+        logical_xor=cm.logical_xor, isclose=cm.isclose, allclose=cm.allclose,
+        equal_all=cm.equal_all, bitwise_and=cm.bitwise_and,
+        bitwise_or=cm.bitwise_or, bitwise_xor=cm.bitwise_xor,
+        bitwise_not=cm.bitwise_not,
+        # creation-ish
+        zeros_like=cr.zeros_like, ones_like=cr.ones_like, full_like=cr.full_like,
+        tril=cr.tril, triu=cr.triu,
+    )
+    _METHODS.update(methods)
+    for name, fn in methods.items():
+        setattr(Tensor, name, fn)
+
+
+monkey_patch_tensor()

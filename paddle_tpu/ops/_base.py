@@ -1,0 +1,35 @@
+"""Op plumbing shared by all op modules.
+
+Kernels are module-level pure functions registered by name
+(ref: ``paddle/fluid/framework/op_registry.h`` REGISTER_OP_KERNEL). The
+registry lets the static-graph serializer reconstruct an op from
+``(name, attrs)`` alone.
+"""
+from __future__ import annotations
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+OP_REGISTRY: dict[str, callable] = {}
+
+
+def register(name):
+    def deco(fn):
+        OP_REGISTRY[name] = fn
+        fn._op_name = name
+        return fn
+
+    return deco
+
+
+def apply(name, *tensor_args, **attrs):
+    """Dispatch a registered op."""
+    return dispatch.apply(name, OP_REGISTRY[name], *tensor_args, **attrs)
+
+
+def unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def wrap(arr, stop_gradient=True):
+    return Tensor(arr, stop_gradient=stop_gradient, _internal=True)

@@ -1,0 +1,224 @@
+"""Activation ops.
+
+Covers the reference's ``activation_op.cc``/``softmax_op.cc``/``maxout_op.cc``.
+Pure jnp — XLA fuses these into surrounding matmuls on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._base import register, apply
+
+_ACTS = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softplus_": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "silu": jax.nn.silu,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "tanhshrink": lambda x: x - jnp.tanh(x),
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "hardsigmoid": lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0),
+    "hardswish": lambda x: x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0),
+    "log_sigmoid": jax.nn.log_sigmoid,
+}
+for _n, _f in _ACTS.items():
+    register(_n)(_f)
+
+
+def _unary(opname):
+    def op(x, name=None):
+        if not isinstance(x, Tensor):
+            x = Tensor(np.asarray(x))
+        return apply(opname, x)
+
+    op.__name__ = opname
+    return op
+
+
+relu = _unary("relu")
+sigmoid = _unary("sigmoid")
+tanh = _unary("tanh")
+softsign = _unary("softsign")
+silu = _unary("silu")
+swish = silu
+mish = _unary("mish")
+tanhshrink = _unary("tanhshrink")
+relu6 = _unary("relu6")
+hardsigmoid = _unary("hardsigmoid")
+hardswish = _unary("hardswish")
+log_sigmoid = _unary("log_sigmoid")
+logsigmoid = log_sigmoid
+
+
+@register("softplus")
+def _softplus(x, *, beta=1.0, threshold=20.0):
+    return jnp.where(x * beta > threshold, x, jax.nn.softplus(x * beta) / beta)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply("softplus", x, beta=beta, threshold=threshold)
+
+
+@register("gelu")
+def _gelu(x, *, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", x, approximate=approximate)
+
+
+@register("leaky_relu")
+def _leaky_relu(x, *, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", x, negative_slope=negative_slope)
+
+
+@register("elu")
+def _elu(x, *, alpha=1.0):
+    return jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", x, alpha=alpha)
+
+
+@register("celu")
+def _celu(x, *, alpha=1.0):
+    return jnp.maximum(x, 0) + jnp.minimum(0, alpha * jnp.expm1(x / alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", x, alpha=alpha)
+
+
+@register("selu")
+def _selu(x, *, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply("selu", x, scale=scale, alpha=alpha)
+
+
+@register("prelu")
+def _prelu(x, weight):
+    return jnp.where(x >= 0, x, weight * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    w = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    if w.ndim == 1 and w.shape[0] > 1 and x._data.ndim > 1:
+        # per-channel: broadcast along channel dim
+        ch_axis = 1 if data_format == "NCHW" else x._data.ndim - 1
+        shape = [1] * x._data.ndim
+        shape[ch_axis] = w.shape[0]
+        weight = Tensor(w.reshape(shape), _internal=True) if not isinstance(weight, Tensor) else weight.reshape(shape)
+    elif not isinstance(weight, Tensor):
+        weight = Tensor(w, _internal=True)
+    return apply("prelu", x, weight)
+
+
+@register("hardtanh")
+def _hardtanh(x, *, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh", x, min=min, max=max)
+
+
+@register("hardshrink")
+def _hardshrink(x, *, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink", x, threshold=threshold)
+
+
+@register("softshrink")
+def _softshrink(x, *, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply("softshrink", x, threshold=threshold)
+
+
+@register("thresholded_relu")
+def _thresholded_relu(x, *, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply("thresholded_relu", x, threshold=threshold)
+
+
+@register("softmax")
+def _softmax(x, *, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply("softmax", x, axis=axis)
+
+
+@register("log_softmax")
+def _log_softmax(x, *, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply("log_softmax", x, axis=axis)
+
+
+@register("gumbel_softmax_det")
+def _gumbel_softmax_det(x, g, *, temperature=1.0, hard=False, axis=-1):
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:  # straight-through estimator
+        y_hard = (y == jnp.max(y, axis=axis, keepdims=True)).astype(y.dtype)
+        y = y_hard - jax.lax.stop_gradient(y) + y
+    return y
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..core import random as _random
+
+    g = jax.random.gumbel(_random.next_key(), tuple(x.shape), dtype=x._data.dtype)
+    return apply("gumbel_softmax_det", x, Tensor(g, _internal=True),
+                 temperature=temperature, hard=hard, axis=axis)
+
+
+@register("maxout")
+def _maxout(x, *, groups, axis=1):
+    shp = list(x.shape)
+    c = shp[axis]
+    shp[axis:axis + 1] = [c // groups, groups]
+    return jnp.max(jnp.reshape(x, shp), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return apply("maxout", x, groups=groups, axis=axis)
+
+
+@register("glu")
+def _glu(x, *, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def glu(x, axis=-1, name=None):
+    return apply("glu", x, axis=axis)

@@ -1,0 +1,129 @@
+"""Comparison and logical ops.
+
+Covers the reference's ``controlflow/compare_op.cc``, ``logical_op.cc``,
+``isclose/allclose`` and ``is_empty``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._base import register, apply
+
+
+def _coerce(x, other=None):
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, (bool, int, float)) and isinstance(other, Tensor):
+        return Tensor(jnp.asarray(x, dtype=other._data.dtype), _internal=True)
+    return Tensor(np.asarray(x))
+
+
+def _cmp(name, jfn):
+    register(name)(jfn)
+
+    def op(x, y, name_=None):
+        x_t = _coerce(x, y if isinstance(y, Tensor) else None)
+        y_t = _coerce(y, x_t)
+        return apply(name, x_t, y_t)
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+
+
+def _logical(name, jfn):
+    @register(name)
+    def _k(x, y=None):
+        return jfn(x) if y is None else jfn(x, y)
+
+    def op(x, y=None, out=None, name_=None):
+        x_t = _coerce(x)
+        res = apply(name, x_t) if y is None else apply(name, x_t, _coerce(y))
+        if out is not None:
+            out.set_value(res)
+            return out
+        return res
+
+    op.__name__ = name
+    return op
+
+
+logical_and = _logical("logical_and", jnp.logical_and)
+logical_or = _logical("logical_or", jnp.logical_or)
+logical_xor = _logical("logical_xor", jnp.logical_xor)
+logical_not = _logical("logical_not", jnp.logical_not)
+
+
+@register("bitwise_and")
+def _bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@register("bitwise_or")
+def _bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@register("bitwise_xor")
+def _bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@register("bitwise_not")
+def _bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return apply("bitwise_and", _coerce(x), _coerce(y))
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return apply("bitwise_or", _coerce(x), _coerce(y))
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return apply("bitwise_xor", _coerce(x), _coerce(y))
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply("bitwise_not", _coerce(x))
+
+
+@register("isclose")
+def _isclose(x, y, *, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return apply("isclose", _coerce(x), _coerce(y), rtol=float(rtol), atol=float(atol), equal_nan=equal_nan)
+
+
+@register("allclose_op")
+def _allclose(x, y, *, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return apply("allclose_op", _coerce(x), _coerce(y), rtol=float(rtol), atol=float(atol), equal_nan=equal_nan)
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(_coerce(x)._data, _coerce(y)._data), _internal=True)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_coerce(x).size == 0), _internal=True)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,135 @@
+"""Control-flow ops.
+
+Covers the reference's ``layers/control_flow.py`` (cond, while_loop, case,
+switch_case) and the C++ ``conditional_block_op`` / ``while_op``. On TPU these
+map directly onto ``lax.cond`` / ``lax.while_loop`` / ``lax.switch`` so the
+loop body compiles once — no Python-side unrolling of dynamic trip counts.
+In eager mode with concrete predicates we just run Python, matching dygraph.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dispatch
+
+
+def _unwrap_tree(x):
+    return jax.tree_util.tree_map(
+        lambda v: v._data if isinstance(v, Tensor) else v, x,
+        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def _wrap_tree(x):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v, _internal=True) if isinstance(v, jax.Array) else v, x)
+
+
+def _is_concrete(v):
+    if isinstance(v, Tensor):
+        v = v._data
+    return not isinstance(v, jax.core.Tracer)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Ref: layers/control_flow.py cond()."""
+    if _is_concrete(pred) and dispatch.current_tracer() is None:
+        p = bool(pred.item() if isinstance(pred, Tensor) else pred)
+        return true_fn() if p else (false_fn() if false_fn is not None else None)
+    p = pred._data if isinstance(pred, Tensor) else jnp.asarray(pred)
+    out = jax.lax.cond(
+        p,
+        lambda _: _unwrap_tree(true_fn()),
+        lambda _: _unwrap_tree(false_fn()),
+        operand=None,
+    )
+    return _wrap_tree(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Ref: layers/control_flow.py while_loop()."""
+    concrete = _is_concrete(cond_fn(*loop_vars)) and dispatch.current_tracer() is None
+    if concrete:
+        vars_ = list(loop_vars)
+        while bool(_as_bool(cond_fn(*vars_))):
+            out = body_fn(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+
+    def c(state):
+        return _as_bool_arr(cond_fn(*_wrap_tree(state)))
+
+    def b(state):
+        out = body_fn(*_wrap_tree(state))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return _unwrap_tree(out)
+
+    final = jax.lax.while_loop(c, b, _unwrap_tree(list(loop_vars)))
+    return _wrap_tree(final)
+
+
+def _as_bool(v):
+    if isinstance(v, Tensor):
+        return bool(v.item())
+    return bool(v)
+
+
+def _as_bool_arr(v):
+    if isinstance(v, Tensor):
+        return v._data.reshape(())
+    return jnp.asarray(v).reshape(())
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Ref: layers/control_flow.py case()."""
+    for pred, fn in pred_fn_pairs:
+        if _is_concrete(pred):
+            if _as_bool(pred):
+                return fn()
+        else:
+            # build nested lax.cond chain
+            rest = pred_fn_pairs[pred_fn_pairs.index((pred, fn)) + 1:]
+            return cond(pred, fn, lambda: case(rest, default))
+    if default is not None:
+        return default()
+    raise ValueError("no branch taken in case() and no default provided")
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Ref: layers/control_flow.py switch_case()."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        if _is_concrete(branch_index):
+            i = int(branch_index.item() if isinstance(branch_index, Tensor) else branch_index)
+            if i in branch_fns:
+                return branch_fns[i]()
+            return default() if default is not None else fns[-1]()
+        # map arbitrary keys onto dense switch
+        idx = branch_index._data if isinstance(branch_index, Tensor) else jnp.asarray(branch_index)
+        dense = jnp.zeros((), jnp.int32) + len(fns)  # default slot
+        for pos, k in enumerate(keys):
+            dense = jnp.where(idx == k, pos, dense)
+        all_fns = [lambda f=f: _unwrap_tree(f()) for f in fns]
+        all_fns.append(lambda: _unwrap_tree((default or fns[-1])()))
+        return _wrap_tree(jax.lax.switch(dense, all_fns))
+    fns = list(branch_fns)
+    if _is_concrete(branch_index):
+        i = int(branch_index.item() if isinstance(branch_index, Tensor) else branch_index)
+        if 0 <= i < len(fns):
+            return fns[i]()
+        return default() if default is not None else fns[-1]()
+    idx = branch_index._data if isinstance(branch_index, Tensor) else jnp.asarray(branch_index)
+    return _wrap_tree(jax.lax.switch(idx, [lambda f=f: _unwrap_tree(f()) for f in fns]))
+
+
+def scan(f, init, xs, length=None, reverse=False, unroll=1):
+    """TPU-native sequential loop (lax.scan passthrough with Tensor wrapping)."""
+    def body(carry, x):
+        c, y = f(_wrap_tree(carry), _wrap_tree(x))
+        return _unwrap_tree(c), _unwrap_tree(y)
+
+    carry, ys = jax.lax.scan(body, _unwrap_tree(init), _unwrap_tree(xs),
+                             length=length, reverse=reverse, unroll=unroll)
+    return _wrap_tree(carry), _wrap_tree(ys)

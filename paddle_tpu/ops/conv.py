@@ -1,0 +1,416 @@
+"""Convolution / pooling / resize ops.
+
+Covers the reference's ``conv_op.cc``, ``conv_transpose_op.cc``,
+``pool_op.cc``, ``adaptive pooling``, ``interpolate_op.cc``,
+``pixel_shuffle_op.cc``, ``unfold_op.cc``.
+
+All convs lower to ``lax.conv_general_dilated`` which XLA maps onto the MXU;
+NCHW in/out is accepted for API parity but XLA freely relayouts internally.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ._base import register, apply, unwrap
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, nsp, stride=None, ksize=None, dilation=None):
+    """Normalize paddle padding spec -> lax padding."""
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * nsp
+    padding = list(padding)
+    if len(padding) == nsp and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nsp:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nsp)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # [[0,0],[0,0],[t,b],[l,r]] NCHW form: keep trailing spatial entries
+        return [tuple(p) for p in padding[-nsp:]]
+    raise ValueError(f"bad padding {padding}")
+
+
+@register("conv2d")
+def _conv2d(x, w, *, stride, padding, dilation, groups, data_format="NCHW"):
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC"))
+    return lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """Ref: paddle/fluid/operators/conv_op.cc (Conv2D forward).
+
+    weight layout OIHW (paddle convention); NHWC supported via data_format.
+    """
+    stride = _pair(stride, 2)
+    dilation = _pair(dilation, 2)
+    pad = _conv_padding(padding, 2)
+    out = apply("conv2d", x, weight, stride=stride, padding=pad,
+                dilation=dilation, groups=groups, data_format=data_format)
+    if bias is not None:
+        from .math import add
+
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = add(out, bias.reshape(list(shape)))
+    return out
+
+
+@register("conv1d")
+def _conv1d(x, w, *, stride, padding, dilation, groups, data_format="NCL"):
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCH", "OIH", "NCH") if data_format == "NCL" else ("NHC", "HIO", "NHC"))
+    return lax.conv_general_dilated(x, w, window_strides=stride, padding=padding,
+                                    rhs_dilation=dilation, dimension_numbers=dn,
+                                    feature_group_count=groups)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    stride = _pair(stride, 1)
+    dilation = _pair(dilation, 1)
+    pad = _conv_padding(padding, 1)
+    out = apply("conv1d", x, weight, stride=stride, padding=pad,
+                dilation=dilation, groups=groups, data_format=data_format)
+    if bias is not None:
+        from .math import add
+
+        shape = (1, -1, 1) if data_format == "NCL" else (1, 1, -1)
+        out = add(out, bias.reshape(list(shape)))
+    return out
+
+
+@register("conv3d")
+def _conv3d(x, w, *, stride, padding, dilation, groups, data_format="NCDHW"):
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" else ("NDHWC", "DHWIO", "NDHWC"))
+    return lax.conv_general_dilated(x, w, window_strides=stride, padding=padding,
+                                    rhs_dilation=dilation, dimension_numbers=dn,
+                                    feature_group_count=groups)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    pad = _conv_padding(padding, 3)
+    out = apply("conv3d", x, weight, stride=stride, padding=pad,
+                dilation=dilation, groups=groups, data_format=data_format)
+    if bias is not None:
+        from .math import add
+
+        shape = (1, -1, 1, 1, 1) if data_format == "NCDHW" else (1, 1, 1, 1, -1)
+        out = add(out, bias.reshape(list(shape)))
+    return out
+
+
+@register("conv2d_transpose")
+def _conv2d_transpose(x, w, *, stride, padding, dilation, groups, output_padding):
+    # w layout IOHW (paddle transpose-conv convention: [in, out/groups, kh, kw]).
+    # Implemented as a fractionally-strided conv: lhs_dilation=stride with a
+    # flipped kernel; out = (in-1)*s - 2p + d*(k-1) + op + 1 (paddle formula).
+    if groups > 1:
+        i, o = w.shape[0], w.shape[1]
+        w_t = jnp.reshape(w, (groups, i // groups, o, *w.shape[2:]))
+        w_t = jnp.swapaxes(w_t, 1, 2)  # (g, o, i/g, kh, kw)
+        w_t = jnp.reshape(w_t, (groups * o, i // groups, *w.shape[2:]))
+    else:
+        w_t = jnp.swapaxes(w, 0, 1)  # IOHW -> OIHW
+    w_t = jnp.flip(w_t, axis=(-2, -1))
+    dn = lax.conv_dimension_numbers(x.shape, w_t.shape, ("NCHW", "OIHW", "NCHW"))
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        pad = [(d * (k - 1) - p0, d * (k - 1) - p1 + op)
+               for (p0, p1), k, d, op in zip(padding, w.shape[2:], dilation, output_padding)]
+    return lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1), padding=pad, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW", name=None):
+    stride = _pair(stride, 2)
+    dilation = _pair(dilation, 2)
+    output_padding = _pair(output_padding, 2)
+    pad = _conv_padding(padding, 2)
+    out = apply("conv2d_transpose", x, weight, stride=stride, padding=pad,
+                dilation=dilation, groups=groups, output_padding=output_padding)
+    if bias is not None:
+        from .math import add
+
+        out = add(out, bias.reshape([1, -1, 1, 1]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+
+def _pool(x, init, op, ksize, stride, padding, nsp, count_include_pad=True, avg=False):
+    window = (1, 1) + ksize
+    strides = (1, 1) + stride
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        pad = ((0, 0), (0, 0)) + tuple(padding)
+    out = lax.reduce_window(x, init, op, window, strides, pad)
+    if avg:
+        if count_include_pad or (isinstance(pad, str) and pad == "VALID"):
+            out = out / float(np.prod(ksize))
+        else:
+            ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+            counts = lax.reduce_window(jnp.broadcast_to(ones, x.shape), 0.0, lax.add, window, strides, pad)
+            out = out / counts
+    return out
+
+
+@register("max_pool2d")
+def _max_pool2d(x, *, ksize, stride, padding):
+    return _pool(x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+                 lax.max, ksize, stride, padding, 2)
+
+
+@register("avg_pool2d")
+def _avg_pool2d(x, *, ksize, stride, padding, count_include_pad=True):
+    return _pool(x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x, 0.0, lax.add,
+                 ksize, stride, padding, 2, count_include_pad, avg=True).astype(x.dtype)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    ksize = _pair(kernel_size, 2)
+    stride = ksize if stride is None else _pair(stride, 2)
+    pad = _conv_padding(padding, 2)
+    if data_format != "NCHW":
+        from .manipulation import transpose
+
+        x = transpose(x, [0, 3, 1, 2])
+        out = apply("max_pool2d", x, ksize=ksize, stride=stride, padding=pad)
+        return transpose(out, [0, 2, 3, 1])
+    return apply("max_pool2d", x, ksize=ksize, stride=stride, padding=pad)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               count_include_pad=True, divisor_override=None,
+               data_format="NCHW", name=None):
+    ksize = _pair(kernel_size, 2)
+    stride = ksize if stride is None else _pair(stride, 2)
+    pad = _conv_padding(padding, 2)
+    return apply("avg_pool2d", x, ksize=ksize, stride=stride, padding=pad,
+                 count_include_pad=count_include_pad)
+
+
+def pool2d(x, pool_size, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, ceil_mode=False, exclusive=True, data_format="NCHW"):
+    """Ref: layers/nn.py pool2d (fluid API)."""
+    if global_pooling:
+        pool_size = tuple(unwrap(x).shape[2:])
+        pool_padding = 0
+        pool_stride = 1
+    if pool_type == "max":
+        return max_pool2d(x, pool_size, pool_stride, pool_padding)
+    return avg_pool2d(x, pool_size, pool_stride, pool_padding, count_include_pad=not exclusive)
+
+
+@register("max_pool1d")
+def _max_pool1d(x, *, ksize, stride, padding):
+    x4 = x[:, :, None, :]
+    out = _pool(x4, -jnp.inf, lax.max, (1,) + ksize, (1,) + stride,
+                ((0, 0),) + tuple(padding) if not isinstance(padding, str) else padding, 2)
+    return out[:, :, 0, :]
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    ksize = _pair(kernel_size, 1)
+    stride = ksize if stride is None else _pair(stride, 1)
+    pad = _conv_padding(padding, 1)
+    return apply("max_pool1d", x, ksize=ksize, stride=stride, padding=pad)
+
+
+@register("avg_pool1d")
+def _avg_pool1d(x, *, ksize, stride, padding, count_include_pad=True):
+    x4 = x[:, :, None, :]
+    out = _pool(x4, 0.0, lax.add, (1,) + ksize, (1,) + stride,
+                ((0, 0),) + tuple(padding) if not isinstance(padding, str) else padding, 2,
+                count_include_pad, avg=True)
+    return out[:, :, 0, :]
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    ksize = _pair(kernel_size, 1)
+    stride = ksize if stride is None else _pair(stride, 1)
+    pad = _conv_padding(padding, 1)
+    return apply("avg_pool1d", x, ksize=ksize, stride=stride, padding=pad,
+                 count_include_pad=not exclusive)
+
+
+@register("max_pool3d")
+def _max_pool3d(x, *, ksize, stride, padding):
+    window = (1, 1) + ksize
+    strides = (1, 1) + stride
+    pad = padding if isinstance(padding, str) else ((0, 0), (0, 0)) + tuple(padding)
+    return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    ksize = _pair(kernel_size, 3)
+    stride = ksize if stride is None else _pair(stride, 3)
+    pad = _conv_padding(padding, 3)
+    return apply("max_pool3d", x, ksize=ksize, stride=stride, padding=pad)
+
+
+@register("avg_pool3d")
+def _avg_pool3d(x, *, ksize, stride, padding):
+    window = (1, 1) + ksize
+    strides = (1, 1) + stride
+    pad = padding if isinstance(padding, str) else ((0, 0), (0, 0)) + tuple(padding)
+    out = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+    return out / float(np.prod(ksize))
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCDHW", name=None):
+    ksize = _pair(kernel_size, 3)
+    stride = ksize if stride is None else _pair(stride, 3)
+    pad = _conv_padding(padding, 3)
+    return apply("avg_pool3d", x, ksize=ksize, stride=stride, padding=pad)
+
+
+@register("adaptive_avg_pool2d")
+def _adaptive_avg_pool2d(x, *, output_size):
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    # When input divides evenly this is a plain reshape-mean (the common case:
+    # global pooling oh=ow=1); otherwise fall back to per-window mean.
+    if h % oh == 0 and w % ow == 0:
+        return jnp.mean(jnp.reshape(x, (n, c, oh, h // oh, ow, w // ow)), axis=(3, 5))
+    ys = [(int(np.floor(i * h / oh)), int(np.ceil((i + 1) * h / oh))) for i in range(oh)]
+    xs = [(int(np.floor(j * w / ow)), int(np.ceil((j + 1) * w / ow))) for j in range(ow)]
+    rows = [jnp.stack([jnp.mean(x[:, :, y0:y1, x0:x1], axis=(2, 3)) for (x0, x1) in xs], axis=-1)
+            for (y0, y1) in ys]
+    return jnp.stack(rows, axis=-2)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return apply("adaptive_avg_pool2d", x, output_size=_pair(output_size, 2))
+
+
+@register("adaptive_max_pool2d")
+def _adaptive_max_pool2d(x, *, output_size):
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    if h % oh == 0 and w % ow == 0:
+        return jnp.max(jnp.reshape(x, (n, c, oh, h // oh, ow, w // ow)), axis=(3, 5))
+    ys = [(int(np.floor(i * h / oh)), int(np.ceil((i + 1) * h / oh))) for i in range(oh)]
+    xs = [(int(np.floor(j * w / ow)), int(np.ceil((j + 1) * w / ow))) for j in range(ow)]
+    rows = [jnp.stack([jnp.max(x[:, :, y0:y1, x0:x1], axis=(2, 3)) for (x0, x1) in xs], axis=-1)
+            for (y0, y1) in ys]
+    return jnp.stack(rows, axis=-2)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return apply("adaptive_max_pool2d", x, output_size=_pair(output_size, 2))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    out = adaptive_avg_pool2d(x[:, :, None, :] if isinstance(x, jnp.ndarray) else _unsq(x),
+                              (1, int(output_size) if not isinstance(output_size, (list, tuple)) else int(output_size[0])))
+    from .manipulation import squeeze
+
+    return squeeze(out, 2)
+
+
+def _unsq(x):
+    from .manipulation import unsqueeze
+
+    return unsqueeze(x, 2)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = adaptive_max_pool2d(_unsq(x), (1, int(output_size)))
+    from .manipulation import squeeze
+
+    return squeeze(out, 2)
+
+
+# ---------------------------------------------------------------------------
+# resize / shuffle / unfold
+# ---------------------------------------------------------------------------
+
+
+@register("interpolate")
+def _interpolate(x, *, size, mode, align_corners):
+    n, c, h, w = x.shape
+    oh, ow = size
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    xt = jnp.transpose(x, (0, 2, 3, 1))
+    out = jax.image.resize(xt, (n, oh, ow, c), method=method)
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    shp = unwrap(x).shape
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (scale_factor, scale_factor)
+        size = (int(shp[2] * sf[0]), int(shp[3] * sf[1]))
+    else:
+        if isinstance(size, Tensor):
+            size = [int(v) for v in np.asarray(size._data)]
+        size = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in size)
+    return apply("interpolate", x, size=tuple(size), mode=mode, align_corners=align_corners)
+
+
+upsample = interpolate
+resize_bilinear = lambda x, out_shape=None, **kw: interpolate(x, size=out_shape, mode="bilinear")
+resize_nearest = lambda x, out_shape=None, **kw: interpolate(x, size=out_shape, mode="nearest")
+
+
+@register("pixel_shuffle")
+def _pixel_shuffle(x, *, upscale_factor):
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return jnp.reshape(x, (n, c // (r * r), h * r, w * r))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return apply("pixel_shuffle", x, upscale_factor=int(upscale_factor))
+
+
+@register("unfold")
+def _unfold(x, *, ksize, stride, padding, dilation):
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=ksize, window_strides=stride,
+        padding=[(padding[0], padding[0]), (padding[1], padding[1])] if isinstance(padding[0], int) else padding,
+        rhs_dilation=dilation)
+    # patches: (N, C*kh*kw, OH, OW) -> (N, C*kh*kw, OH*OW)
+    return jnp.reshape(patches, (n, patches.shape[1], -1))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    return apply("unfold", x, ksize=_pair(kernel_sizes, 2), stride=_pair(strides, 2),
+                 padding=_pair(paddings, 2), dilation=_pair(dilations, 2))

@@ -1,0 +1,246 @@
+"""Tensor creation ops.
+
+Covers the reference's ``fill_constant_op.cc``, ``range_op.cc``,
+``eye_op.cc``, ``linspace_op.cc``, ``uniform_random_op.cc``,
+``gaussian_random_op.cc``, ``randint_op.cc``, ``randperm_op.cc``,
+``bernoulli``/``multinomial`` samplers and ``assign_value_op.cc``.
+Random ops draw from the global PRNG (core/random.py) in eager mode.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ._base import register, apply, unwrap
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "tril", "triu", "meshgrid", "diagflat", "assign", "clone",
+    "rand", "randn", "randint", "randperm", "uniform", "normal", "bernoulli",
+    "multinomial", "standard_normal", "fill_constant",
+]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    del place
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape._data)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s) for s in shape]
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), convert_dtype(dtype)), _internal=True)
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_list(shape), convert_dtype(dtype)), _internal=True)
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape_list(shape), fill_value, convert_dtype(dtype)), _internal=True)
+
+
+def fill_constant(shape, dtype, value, name=None, out=None):
+    t = full(shape, value, dtype)
+    if out is not None:
+        out.set_value(t)
+        return out
+    return t
+
+
+empty = zeros  # deterministic "empty" — uninitialized memory is a CUDA-ism
+
+
+@register("zeros_like")
+def _zeros_like(x, *, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype)
+
+
+@register("ones_like")
+def _ones_like(x, *, dtype=None):
+    return jnp.full_like(x, 1, dtype=dtype)
+
+
+@register("full_like")
+def _full_like(x, *, value, dtype=None):
+    return jnp.full_like(x, value, dtype=dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply("zeros_like", x, dtype=None if dtype is None else convert_dtype(dtype))
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply("ones_like", x, dtype=None if dtype is None else convert_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply("full_like", x, value=fill_value, dtype=None if dtype is None else convert_dtype(dtype))
+
+
+empty_like = zeros_like
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if all(isinstance(v, (int, np.integer)) for v in (start, end, step)) else "float32"
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)), _internal=True)
+
+
+range_ = arange
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item()) if isinstance(num, Tensor) else int(num)
+    return Tensor(jnp.linspace(start, stop, num, dtype=convert_dtype(dtype or "float32")), _internal=True)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=convert_dtype(dtype or "float32")), _internal=True)
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns),
+                          dtype=convert_dtype(dtype)), _internal=True)
+
+
+@register("tril")
+def _tril(x, *, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@register("triu")
+def _triu(x, *, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply("tril", x, diagonal=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply("triu", x, diagonal=diagonal)
+
+
+def diagflat(x, offset=0, name=None):
+    return Tensor(jnp.diagflat(unwrap(x), k=offset), _internal=True)
+
+
+def meshgrid(*args, name=None):
+    arrays = [unwrap(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(g, _internal=True) for g in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+@register("assign")
+def _assign(x):
+    return x + jnp.zeros((), x.dtype)
+
+
+def assign(x, output=None):
+    if not isinstance(x, Tensor):
+        x = Tensor(np.asarray(x))
+    out = apply("assign", x)
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return apply("assign", x)
+
+
+# ---------------------------------------------------------------------------
+# random creation (eager: stateful global key; traced code threads keys)
+# ---------------------------------------------------------------------------
+
+
+def _key():
+    return _random.next_key()
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = convert_dtype(dtype)
+    if not jnp.issubdtype(d, jnp.floating):
+        d = jnp.float32
+    k = jax.random.PRNGKey(seed) if seed else _key()
+    return Tensor(jax.random.uniform(k, _shape_list(shape), dtype=d, minval=min, maxval=max), _internal=True)
+
+
+uniform_random = uniform
+
+
+def randn(shape, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    if not jnp.issubdtype(d, jnp.floating):
+        d = jnp.float32
+    return Tensor(jax.random.normal(_key(), _shape_list(shape), dtype=d), _internal=True)
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = unwrap(mean) if isinstance(mean, Tensor) else mean
+        s = unwrap(std) if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s)) if shape is None else tuple(_shape_list(shape))
+        return Tensor(m + s * jax.random.normal(_key(), shp, dtype=jnp.float32), _internal=True)
+    shp = _shape_list(shape) if shape is not None else []
+    return Tensor(mean + std * jax.random.normal(_key(), shp, dtype=jnp.float32), _internal=True)
+
+
+gaussian = normal
+gaussian_random = normal
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(), _shape_list(shape), low, high, dtype=convert_dtype(dtype)), _internal=True)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_key(), int(n)).astype(convert_dtype(dtype)), _internal=True)
+
+
+def bernoulli(x, name=None):
+    p = unwrap(x)
+    return Tensor(jax.random.bernoulli(_key(), p).astype(p.dtype), _internal=True)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = unwrap(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(_key(), logits, axis=-1, shape=(*p.shape[:-1], num_samples))
+    else:
+        k = _key()
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(k, p.shape)
+        out = jnp.argsort(-(logits + g), axis=-1)[..., :num_samples]
+    return Tensor(out.astype(jnp.int32), _internal=True)

@@ -1,0 +1,226 @@
+"""Linear-algebra ops.
+
+Covers the reference's ``norm_op.cc`` (p-norm), ``cholesky_op.cc``,
+``matrix_inverse``, ``svd``-family, ``dist_op.cc``, ``cross_op.cc``,
+``triangular ops``, ``histogram_op.cc``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._base import register, apply, unwrap
+
+
+@register("p_norm")
+def _p_norm(x, *, p=2.0, axis=None, keepdim=False):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+@register("frobenius_norm")
+def _fro(x, *, axis=None, keepdim=False):
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    if p == "fro":
+        return apply("frobenius_norm", x, axis=axis, keepdim=keepdim)
+    return apply("p_norm", x, p=float(p), axis=axis, keepdim=keepdim)
+
+
+def dist(x, y, p=2.0, name=None):
+    from .math import subtract
+
+    return norm(subtract(x, y), p=p)
+
+
+@register("cholesky")
+def _cholesky(x, *, upper=False):
+    l = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(l, -1, -2) if upper else l
+
+
+def cholesky(x, upper=False, name=None):
+    return apply("cholesky", x, upper=upper)
+
+
+@register("inverse")
+def _inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def inverse(x, name=None):
+    return apply("inverse", x)
+
+
+@register("matrix_power")
+def _matrix_power(x, *, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", x, n=int(n))
+
+
+@register("pinv")
+def _pinv(x, *, rcond=1e-15):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", x, rcond=rcond)
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(unwrap(x), full_matrices=full_matrices)
+    return (Tensor(u, _internal=True), Tensor(s, _internal=True),
+            Tensor(jnp.swapaxes(vh, -1, -2), _internal=True))
+
+
+def qr(x, mode="reduced", name=None):
+    q, r = jnp.linalg.qr(unwrap(x), mode=mode)
+    return Tensor(q, _internal=True), Tensor(r, _internal=True)
+
+
+def eig(x, name=None):
+    w, v = jnp.linalg.eig(unwrap(x))
+    return Tensor(w, _internal=True), Tensor(v, _internal=True)
+
+
+def eigh(x, UPLO="L", name=None):
+    w, v = jnp.linalg.eigh(unwrap(x), UPLO=UPLO)
+    return Tensor(w, _internal=True), Tensor(v, _internal=True)
+
+
+def eigvals(x, name=None):
+    return Tensor(jnp.linalg.eigvals(unwrap(x)), _internal=True)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return Tensor(jnp.linalg.eigvalsh(unwrap(x), UPLO=UPLO), _internal=True)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(unwrap(x), rtol=tol), _internal=True)
+
+
+def det(x, name=None):
+    return apply("det", x)
+
+
+@register("det")
+def _det(x):
+    return jnp.linalg.det(x)
+
+
+@register("slogdet")
+def _slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return sign, logdet
+
+
+def slogdet(x, name=None):
+    return apply("slogdet", x)
+
+
+@register("cross")
+def _cross(x, y, *, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cross(x, y, axis=-1, name=None):
+    return apply("cross", x, y, axis=axis)
+
+
+@register("triangular_solve")
+def _triangular_solve(x, y, *, upper=True, transpose=False, unitriangular=False):
+    a = jnp.swapaxes(x, -1, -2) if transpose else x
+    return jax.scipy.linalg.solve_triangular(a, y, lower=not upper, unit_diagonal=unitriangular)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return apply("triangular_solve", x, y, upper=upper, transpose=transpose, unitriangular=unitriangular)
+
+
+@register("cholesky_solve")
+def _cholesky_solve(x, y, *, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return apply("cholesky_solve", x, y, upper=upper)
+
+
+@register("solve")
+def _solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def solve(x, y, name=None):
+    return apply("solve", x, y)
+
+
+@register("lstsq_vals")
+def _lstsq(x, y, *, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(unwrap(x), unwrap(y), rcond=rcond)
+    return (Tensor(sol, _internal=True), Tensor(res, _internal=True),
+            Tensor(rank, _internal=True), Tensor(sv, _internal=True))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    arr = np.asarray(unwrap(input))
+    if min == 0 and max == 0:
+        min, max = float(arr.min()), float(arr.max())
+    hist, _ = np.histogram(arr, bins=bins, range=(min, max))
+    return Tensor(jnp.asarray(hist, dtype=jnp.int32), _internal=True)
+
+
+@register("mv")
+def _mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def mv(x, vec, name=None):
+    return apply("mv", x, vec)
+
+
+@register("multi_dot_2")
+def _multi_dot(*xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+def multi_dot(x, name=None):
+    return apply("multi_dot_2", *x)
+
+
+@register("cov")
+def _cov(x, *, rowvar=True, ddof=1):
+    return jnp.cov(x, rowvar=rowvar, ddof=ddof)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply("cov", x, rowvar=rowvar, ddof=1 if ddof else 0)
+
+
+@register("corrcoef")
+def _corrcoef(x, *, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", x, rowvar=rowvar)

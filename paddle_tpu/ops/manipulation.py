@@ -1,0 +1,560 @@
+"""Shape / layout / gather-scatter ops.
+
+Covers the reference's ``reshape_op.cc``, ``transpose_op.cc``,
+``concat_op.cc``, ``split_op.cc``, ``gather(_nd)_op.cc``,
+``scatter(_nd_add)_op.cc``, ``squeeze/unsqueeze``, ``expand/tile``,
+``flip/roll``, ``top_k/argsort``, ``where/one_hot`` etc.
+
+Dynamic-output-shape ops (nonzero, masked_select, unique) exist but return
+host-materialised results in eager mode only — data-dependent shapes do not
+compile on TPU, matching XLA's static-shape model.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dtype import convert_dtype
+from ._base import register, apply, unwrap
+
+
+@register("reshape")
+def _reshape(x, *, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = [int(s) for s in np.asarray(shape._data)]
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    return apply("reshape", x, shape=tuple(shape))
+
+
+reshape_ = reshape
+
+
+@register("transpose")
+def _transpose(x, *, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm=None, name=None):
+    if perm is None:
+        perm = list(range(unwrap(x).ndim))[::-1]
+    return apply("transpose", x, perm=tuple(int(p) for p in perm))
+
+
+def t(x, name=None):
+    if unwrap(x).ndim < 2:
+        return x
+    return apply("transpose", x, perm=(1, 0))
+
+
+@register("flatten")
+def _flatten(x, *, start_axis, stop_axis):
+    shp = x.shape
+    nd = len(shp)
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new = shp[:start] + (int(np.prod(shp[start:stop + 1] or (1,))),) + shp[stop + 1:]
+    return jnp.reshape(x, new)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return apply("flatten", x, start_axis=start_axis, stop_axis=stop_axis)
+
+
+@register("squeeze")
+def _squeeze(x, *, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+def squeeze(x, axis=None, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if unwrap(x).shape[a] == 1) or None
+    elif axis is not None and unwrap(x).shape[axis] != 1:
+        return x
+    return apply("squeeze", x, axis=axis)
+
+
+@register("unsqueeze")
+def _unsqueeze(x, *, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply("unsqueeze", x, axis=axis)
+
+
+@register("concat")
+def _concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply("concat", *x, axis=int(axis))
+
+
+@register("stack")
+def _stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return apply("stack", *x, axis=int(axis))
+
+
+@register("split")
+def _split(x, *, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    idx = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(num_or_sections, (list, tuple)):
+        total = unwrap(x).shape[axis]
+        secs = [int(s) for s in num_or_sections]
+        if any(s == -1 for s in secs):
+            rem = total - sum(s for s in secs if s != -1)
+            secs = [rem if s == -1 else s for s in secs]
+        out = apply("split", x, sections=tuple(secs), axis=int(axis))
+    else:
+        out = apply("split", x, sections=int(num_or_sections), axis=int(axis))
+    return list(out)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = unwrap(x).shape[axis]
+    parts = split(x, n, axis)
+    return [squeeze(p, axis) for p in parts]
+
+
+@register("slice_op")
+def _slice_op(x, *, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = slice(s, e)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+    return apply("slice_op", x, axes=tuple(axes), starts=tuple(starts), ends=tuple(ends))
+
+
+@register("strided_slice")
+def _strided_slice(x, *, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return apply("strided_slice", x, axes=tuple(axes), starts=tuple(starts),
+                 ends=tuple(ends), strides=tuple(strides))
+
+
+@register("gather")
+def _gather(x, index, *, axis=0):
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if not isinstance(index, Tensor):
+        index = Tensor(np.asarray(index))
+    return apply("gather", x, index, axis=int(axis))
+
+
+@register("gather_nd")
+def _gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index, name=None):
+    return apply("gather_nd", x, index)
+
+
+@register("take_along_axis")
+def _take_along_axis(x, index, *, axis):
+    return jnp.take_along_axis(x, index, axis=axis)
+
+
+def take_along_axis(x, indices, axis, name=None):
+    return apply("take_along_axis", x, indices, axis=axis)
+
+
+@register("index_select")
+def _index_select(x, index, *, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select", x, index, axis=axis)
+
+
+@register("index_sample")
+def _index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_sample(x, index, name=None):
+    return apply("index_sample", x, index)
+
+
+@register("scatter")
+def _scatter(x, index, updates, *, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return apply("scatter", x, index, updates, overwrite=overwrite)
+
+
+@register("scatter_nd_add")
+def _scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return apply("scatter_nd_add", x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zeros = Tensor(jnp.zeros(shape, unwrap(updates).dtype), _internal=True)
+    return scatter_nd_add(zeros, index, updates)
+
+
+def _along(index, axis, ndim):
+    idx = []
+    for d in range(ndim):
+        if d == axis:
+            idx.append(index)
+        else:
+            shape = [1] * ndim
+            shape[d] = -1
+            idx.append(jnp.reshape(jnp.arange(index.shape[d]), shape))
+    return tuple(idx)
+
+
+@register("put_along_axis")
+def _put_along_axis(x, index, value, *, axis, reduce="assign"):
+    value = jnp.broadcast_to(value, index.shape)
+    full = _along(index, axis, x.ndim)
+    if reduce == "add":
+        return x.at[full].add(value)
+    if reduce == "multiply":
+        return x.at[full].multiply(value)
+    return x.at[full].set(value)
+
+
+def put_along_axis(x, index, value, axis, reduce="assign", name=None):
+    if not isinstance(value, Tensor):
+        value = Tensor(np.asarray(value))
+    return apply("put_along_axis", x, index, value, axis=axis, reduce=reduce)
+
+
+@register("tile")
+def _tile(x, *, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = [int(v) for v in np.asarray(repeat_times._data)]
+    return apply("tile", x, repeat_times=tuple(int(r) for r in repeat_times))
+
+
+@register("expand")
+def _expand(x, *, shape):
+    lead = len(shape) - x.ndim
+    shape = tuple(
+        x.shape[i - lead] if s in (-1, None) and i >= lead else s
+        for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = [int(v) for v in np.asarray(shape._data)]
+    return apply("expand", x, shape=tuple(int(s) for s in shape))
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return expand(x, unwrap(y).shape)
+
+
+@register("repeat_interleave")
+def _repeat_interleave(x, *, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return apply("repeat_interleave", x, repeats=int(repeats), axis=axis)
+
+
+@register("flip")
+def _flip(x, *, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply("flip", x, axis=axis)
+
+
+reverse = flip
+
+
+@register("roll")
+def _roll(x, *, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    shifts = tuple(shifts) if isinstance(shifts, (list, tuple)) else int(shifts)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return apply("roll", x, shifts=shifts, axis=axis)
+
+
+@register("pad")
+def _pad(x, *, paddings, mode="constant", value=0.0):
+    if mode == "constant":
+        return jnp.pad(x, paddings, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, paddings, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """Paddle pad: flat list [l, r] per-dim from last dims (NCHW aware for len-4)."""
+    nd = unwrap(x).ndim
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in np.asarray(pad._data)]
+    pad = [int(p) for p in pad]
+    if len(pad) == 2 * nd:
+        # paddle "2*ndim" form: [[d0_l, d0_r], ...] flattened
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # spatial form: applies to trailing spatial dims
+        nspatial = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format.upper().endswith("C"):  # NHWC/NLC/NDHWC: spatial before C
+            spatial_dims = list(range(1, 1 + nspatial))
+        else:  # NCHW/NCL/NCDHW
+            spatial_dims = list(range(nd - nspatial, nd))
+        for i, d in enumerate(spatial_dims):
+            widths[d] = (pad[2 * i], pad[2 * i + 1])
+    return apply("pad", x, paddings=tuple(widths), mode=mode, value=value)
+
+
+@register("where")
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    if not isinstance(x, Tensor):
+        x = Tensor(np.asarray(x))
+    if not isinstance(y, Tensor):
+        y = Tensor(np.asarray(y))
+    return apply("where", condition, x, y)
+
+
+@register("topk")
+def _topk(x, *, k, axis=-1, largest=True):
+    if not largest:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(-x, axis, -1), k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(jnp.int32)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    vals, idx = apply("topk", x, k=int(k), axis=axis, largest=largest)
+    return vals, idx
+
+
+top_k = topk
+
+
+@register("sort")
+def _sort(x, *, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return apply("sort", x, axis=axis, descending=descending)
+
+
+@register("argsort")
+def _argsort(x, *, axis=-1, descending=False):
+    idx = jnp.argsort(x, axis=axis)
+    return (jnp.flip(idx, axis=axis) if descending else idx).astype(jnp.int32)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    return apply("argsort", x, axis=axis, descending=descending)
+
+
+@register("one_hot")
+def _one_hot(x, *, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+def one_hot(x, num_classes, name=None):
+    if isinstance(num_classes, Tensor):
+        num_classes = int(num_classes.item())
+    return apply("one_hot", x, num_classes=int(num_classes))
+
+
+@register("cast")
+def _cast(x, *, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    return apply("cast", x, dtype=convert_dtype(dtype))
+
+
+@register("shard_index")
+def _shard_index(x, *, index_num, nshards, shard_id, ignore_value):
+    size = index_num // nshards
+    in_shard = (x // size) == shard_id
+    return jnp.where(in_shard, x % size, ignore_value)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return apply("shard_index", input, index_num=index_num, nshards=nshards,
+                 shard_id=shard_id, ignore_value=ignore_value)
+
+
+# --- dynamic-shape ops: eager only (host materialisation) -------------------
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(unwrap(x))
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i, dtype=jnp.int32), _internal=True) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=jnp.int32), _internal=True)
+
+
+def masked_select(x, mask, name=None):
+    arr = np.asarray(unwrap(x))
+    m = np.asarray(unwrap(mask)).astype(bool)
+    return Tensor(jnp.asarray(arr[np.broadcast_to(m, arr.shape)]), _internal=True)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(unwrap(x))
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res), _internal=True)
+    return tuple(Tensor(jnp.asarray(r), _internal=True) for r in res)
+
+
+@register("masked_fill")
+def _masked_fill(x, mask, *, value):
+    return jnp.where(mask, value, x)
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        value = value.item()
+    return apply("masked_fill", x, mask, value=value)
+
+
+@register("number_count")
+def _number_count(x, *, upper_range):
+    return jnp.bincount(x.reshape(-1), length=upper_range).astype(jnp.int32)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = unwrap(x).reshape(-1)
+    w = unwrap(weights).reshape(-1) if weights is not None else None
+    length = max(int(np.asarray(arr).max(initial=0)) + 1, minlength)
+    return Tensor(jnp.bincount(arr, weights=w, length=length), _internal=True)
+
+
+@register("as_real")
+def _as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@register("as_complex")
+def _as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x, name=None):
+    return apply("as_real", x)
+
+
+def as_complex(x, name=None):
+    return apply("as_complex", x)
+
+
+@register("moveaxis")
+def _moveaxis(x, *, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", x, source=source, destination=destination)
+
+
+@register("swapaxes")
+def _swapaxes(x, *, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply("swapaxes", x, axis1=axis1, axis2=axis2)
+
+
+transpose_ = swapaxes
+
+
+@register("rot90")
+def _rot90(x, *, k, axes):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", x, k=k, axes=tuple(axes))

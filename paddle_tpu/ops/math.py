@@ -1,0 +1,442 @@
+"""Elementwise + matmul math ops.
+
+Covers the reference's ``paddle/fluid/operators/elementwise/*``,
+``activation_op.cc`` (math portion), ``matmul_op.cc``, ``mul_op.cc``,
+``sum_op.cc``, ``scale_op.cc``, ``clip_op.cc``, ``cumsum_op.cc`` etc.
+All kernels are pure jnp — XLA fuses elementwise chains into matmul
+epilogues on TPU, which is why there are no hand-fused variants here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._base import register, apply
+from ..core.dtype import convert_dtype
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+
+@register("add")
+def _add(x, y):
+    return jnp.add(x, y)
+
+
+@register("subtract")
+def _subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@register("multiply")
+def _multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@register("divide")
+def _divide(x, y):
+    return jnp.divide(x, y)
+
+
+@register("floor_divide")
+def _floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@register("remainder")
+def _remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+@register("pow")
+def _pow(x, y):
+    return jnp.power(x, y)
+
+
+@register("maximum")
+def _maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@register("minimum")
+def _minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@register("atan2")
+def _atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@register("matmul")
+def _matmul(x, y, *, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim >= 2 else y
+    return jnp.matmul(x, y)
+
+
+@register("scale")
+def _scale(x, *, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@register("clip")
+def _clip(x, *, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@register("add_n")
+def _add_n(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register("cumsum")
+def _cumsum(x, *, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+@register("cumprod")
+def _cumprod(x, *, axis=None):
+    return jnp.cumprod(x, axis=axis)
+
+
+@register("lerp")
+def _lerp(x, y, w):
+    return x + w * (y - x)
+
+
+@register("outer")
+def _outer(x, y):
+    return jnp.outer(x, y)
+
+
+@register("inner")
+def _inner(x, y):
+    return jnp.inner(x, y)
+
+
+@register("dot")
+def _dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@register("logaddexp")
+def _logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+_UNARY = {
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "abs": jnp.abs,
+    "neg": jnp.negative,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "trunc": jnp.trunc,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh_": jnp.tanh,
+    "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "sign": jnp.sign,
+    "reciprocal": jnp.reciprocal,
+    "square": jnp.square,
+    "digamma": jax.scipy.special.digamma,
+    "lgamma": jax.scipy.special.gammaln,
+    "frac": lambda x: x - jnp.trunc(x),
+    "angle": jnp.angle,
+    "conj": jnp.conj,
+    "deg2rad": jnp.deg2rad,
+    "rad2deg": jnp.rad2deg,
+}
+
+for _name, _fn in _UNARY.items():
+    register(_name)(_fn)
+
+
+@register("isnan")
+def _isnan(x):
+    return jnp.isnan(x)
+
+
+@register("isinf")
+def _isinf(x):
+    return jnp.isinf(x)
+
+
+@register("isfinite")
+def _isfinite(x):
+    return jnp.isfinite(x)
+
+
+@register("nan_to_num")
+def _nan_to_num(x, *, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register("stanh")
+def _stanh(x, *, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@register("einsum")
+def _einsum(*xs, equation):
+    return jnp.einsum(equation, *xs)
+
+
+@register("kron")
+def _kron(x, y):
+    return jnp.kron(x, y)
+
+
+@register("trace_op")
+def _trace(x, *, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("diag")
+def _diag(x, *, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+@register("diagonal")
+def _diagonal(x, *, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def _binop(name):
+    def op(x, y, name_=None, **kw):
+        from ..core.tensor import Tensor
+
+        if not isinstance(x, Tensor):
+            x = Tensor(x)
+        if not isinstance(y, Tensor):
+            if isinstance(y, (bool, int, float)):
+                # python scalars adopt the tensor dtype (paddle semantics)
+                y = Tensor(jnp.asarray(y, dtype=x._data.dtype), _internal=True)
+            else:
+                y = Tensor(y)
+        return apply(name, x, y, **kw)
+
+    op.__name__ = name
+    return op
+
+
+add = _binop("add")
+subtract = _binop("subtract")
+multiply = _binop("multiply")
+divide = _binop("divide")
+floor_divide = _binop("floor_divide")
+remainder = _binop("remainder")
+mod = remainder
+floor_mod = remainder
+maximum = _binop("maximum")
+minimum = _binop("minimum")
+atan2 = _binop("atan2")
+logaddexp = _binop("logaddexp")
+elementwise_add = add
+elementwise_sub = subtract
+elementwise_mul = multiply
+elementwise_div = divide
+
+
+def pow(x, y, name=None):
+    return _binop("pow")(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return apply("matmul", x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+def mm(x, y, name=None):
+    return apply("matmul", x, y)
+
+
+def bmm(x, y, name=None):
+    return apply("matmul", x, y)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """Ref: mul_op.cc — flatten then matmul."""
+    from .manipulation import reshape
+
+    xs, ys = x.shape, y.shape
+    x2 = reshape(x, [int(jnp.prod(jnp.array(xs[:x_num_col_dims]))), -1])
+    y2 = reshape(y, [int(jnp.prod(jnp.array(ys[:y_num_col_dims]))), -1])
+    out = apply("matmul", x2, y2)
+    return reshape(out, list(xs[:x_num_col_dims]) + list(ys[y_num_col_dims:]))
+
+
+def dot(x, y, name=None):
+    return apply("dot", x, y)
+
+
+def outer(x, y, name=None):
+    return apply("outer", x, y)
+
+
+def inner(x, y, name=None):
+    return apply("inner", x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = apply("scale", x, scale=float(scale), bias=float(bias), bias_after_scale=bias_after_scale)
+    if act:
+        from . import activation
+
+        out = getattr(activation, act)(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    from ..core.tensor import Tensor
+
+    if isinstance(min, Tensor):
+        min = float(min.item())
+    if isinstance(max, Tensor):
+        max = float(max.item())
+    return apply("clip", x, min=min, max=max)
+
+
+def add_n(inputs, name=None):
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    return apply("add_n", *inputs)
+
+
+sums = add_n
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    out = apply("cumsum", x, axis=axis)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = apply("cumprod", x, axis=dim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def lerp(x, y, weight, name=None):
+    from ..core.tensor import Tensor
+
+    if not isinstance(weight, Tensor):
+        weight = Tensor(float(weight))
+    return apply("lerp", x, y, weight)
+
+
+def einsum(equation, *operands):
+    return apply("einsum", *operands, equation=equation)
+
+
+def kron(x, y, name=None):
+    return apply("kron", x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace_op", x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diag(x, offset=0, name=None):
+    return apply("diag", x, offset=offset)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal", x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply("nan_to_num", x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", x, scale_a=scale_a, scale_b=scale_b)
+
+
+def _make_unary(name, opname=None):
+    opname = opname or name
+
+    def op(x, name_=None):
+        from ..core.tensor import Tensor
+
+        if not isinstance(x, Tensor):
+            x = Tensor(x)
+        return apply(opname, x)
+
+    op.__name__ = name
+    return op
+
+
+exp = _make_unary("exp")
+expm1 = _make_unary("expm1")
+log = _make_unary("log")
+log2 = _make_unary("log2")
+log10 = _make_unary("log10")
+log1p = _make_unary("log1p")
+sqrt = _make_unary("sqrt")
+rsqrt = _make_unary("rsqrt")
+abs = _make_unary("abs")
+neg = _make_unary("neg")
+floor = _make_unary("floor")
+ceil = _make_unary("ceil")
+round = _make_unary("round")
+trunc = _make_unary("trunc")
+sin = _make_unary("sin")
+cos = _make_unary("cos")
+tan = _make_unary("tan")
+asin = _make_unary("asin")
+acos = _make_unary("acos")
+atan = _make_unary("atan")
+sinh = _make_unary("sinh")
+cosh = _make_unary("cosh")
+asinh = _make_unary("asinh")
+acosh = _make_unary("acosh")
+atanh = _make_unary("atanh")
+erf = _make_unary("erf")
+erfinv = _make_unary("erfinv")
+sign = _make_unary("sign")
+reciprocal = _make_unary("reciprocal")
+square = _make_unary("square")
+digamma = _make_unary("digamma")
+lgamma = _make_unary("lgamma")
+frac = _make_unary("frac")
+angle = _make_unary("angle")
+conj = _make_unary("conj")
+deg2rad = _make_unary("deg2rad")
+rad2deg = _make_unary("rad2deg")
+isnan = _make_unary("isnan")
+isinf = _make_unary("isinf")
+isfinite = _make_unary("isfinite")
+
+
+def increment(x, value=1.0, name=None):
+    return apply("scale", x, scale=1.0, bias=float(value))

@@ -1,0 +1,96 @@
+"""Stateful random ops that consume tensors (dropout etc.).
+
+Covers the reference's ``dropout_op.cc``, ``shuffle_channel``, and
+rrelu-style stochastic ops. Keys come from the global generator in eager
+mode; kernels take the key as an explicit input so they stay pure.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from ..core.tensor import Tensor
+from ._base import register, apply
+
+
+@register("dropout")
+def _dropout(x, key, *, p, mode):
+    if p == 0.0:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, jnp.zeros((), x.dtype))
+    return jnp.where(mask, x, jnp.zeros((), x.dtype))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None, key=None):
+    """Ref: dropout_op.cc. In eval mode: identity (upscale) or scale by 1-p."""
+    if not training:
+        if mode == "upscale_in_train":
+            return x
+        from .math import scale as _scale
+
+        return _scale(x, scale=1.0 - p)
+    if p == 0.0:
+        return x
+    if key is None:
+        key = _random.next_key()
+    key_t = Tensor(key, _internal=True)
+    if axis is not None:
+        # structured dropout along axis: broadcast the mask
+        shape = list(x.shape)
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        for i in range(len(shape)):
+            if i not in axes:
+                shape[i] = 1
+        return apply("dropout_axes", x, key_t, p=float(p), mode=mode, mask_shape=tuple(shape))
+    return apply("dropout", x, key_t, p=float(p), mode=mode)
+
+
+@register("dropout_axes")
+def _dropout_axes(x, key, *, p, mode, mask_shape):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, mask_shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, jnp.zeros((), x.dtype))
+    return jnp.where(mask, x, jnp.zeros((), x.dtype))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p=p, axis=list(axis), training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p=p, axis=list(axis), training=training)
+
+
+@register("alpha_dropout")
+def _alpha_dropout(x, key, *, p):
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    return a * jnp.where(mask, x, jnp.full((), alpha_p, x.dtype)) + b
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    return apply("alpha_dropout", x, Tensor(_random.next_key(), _internal=True), p=float(p))
+
+
+@register("shuffle_channel")
+def _shuffle_channel(x, *, group):
+    n, c, h, w = x.shape
+    return jnp.reshape(jnp.swapaxes(jnp.reshape(x, (n, group, c // group, h, w)), 1, 2), (n, c, h, w))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return apply("shuffle_channel", x, group=int(groups))

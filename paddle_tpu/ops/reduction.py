@@ -1,0 +1,193 @@
+"""Reduction ops.
+
+Covers the reference's ``reduce_ops/*`` (reduce_sum/mean/max/min/prod/all/any),
+``arg_max_op.cc``/``arg_min_op.cc``, ``mean_op.cc``, ``norm`` reductions,
+``logsumexp``, ``kthvalue``/``mode`` and moment ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._base import register, apply, unwrap
+
+
+def _norm_axis(axis):
+    if isinstance(axis, Tensor):
+        axis = [int(v) for v in np.atleast_1d(np.asarray(axis._data))]
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis if axis is None else int(axis)
+
+
+def _reduce(name, jfn):
+    @register(name)
+    def _kernel(x, *, axis=None, keepdim=False):
+        return jfn(x, axis=axis, keepdims=keepdim)
+
+    def op(x, axis=None, keepdim=False, name_=None, dtype=None):
+        if not isinstance(x, Tensor):
+            x = Tensor(np.asarray(x))
+        out = apply(name, x, axis=_norm_axis(axis), keepdim=keepdim)
+        if dtype is not None:
+            out = out.astype(dtype)
+        return out
+
+    op.__name__ = name
+    return op
+
+
+sum = _reduce("reduce_sum", jnp.sum)
+mean = _reduce("reduce_mean", jnp.mean)
+max = _reduce("reduce_max", jnp.max)
+min = _reduce("reduce_min", jnp.min)
+prod = _reduce("reduce_prod", jnp.prod)
+amax = max
+amin = min
+
+
+@register("reduce_all")
+def _all(x, *, axis=None, keepdim=False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+@register("reduce_any")
+def _any(x, *, axis=None, keepdim=False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply("reduce_all", x, axis=_norm_axis(axis), keepdim=keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply("reduce_any", x, axis=_norm_axis(axis), keepdim=keepdim)
+
+
+@register("logsumexp")
+def _logsumexp(x, *, axis=None, keepdim=False):
+    from jax.scipy.special import logsumexp as lse
+
+    return lse(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply("logsumexp", x, axis=_norm_axis(axis), keepdim=keepdim)
+
+
+@register("argmax")
+def _argmax(x, *, axis=None, keepdim=False):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(jnp.int32)
+
+
+@register("argmin")
+def _argmin(x, *, axis=None, keepdim=False):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(jnp.int32)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply("argmax", x, axis=_norm_axis(axis), keepdim=keepdim).astype(dtype)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply("argmin", x, axis=_norm_axis(axis), keepdim=keepdim).astype(dtype)
+
+
+@register("std")
+def _std(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register("var")
+def _var(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("std", x, axis=_norm_axis(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("var", x, axis=_norm_axis(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@register("median")
+def _median(x, *, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply("median", x, axis=_norm_axis(axis), keepdim=keepdim)
+
+
+@register("quantile")
+def _quantile(x, *, q, axis=None, keepdim=False):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply("quantile", x, q=q, axis=_norm_axis(axis), keepdim=keepdim)
+
+
+@register("kthvalue")
+def _kthvalue(x, *, k, axis=-1, keepdim=False):
+    vals = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis)
+    taken_v = jnp.take(vals, k - 1, axis=axis)
+    taken_i = jnp.take(idx, k - 1, axis=axis)
+    if keepdim:
+        taken_v = jnp.expand_dims(taken_v, axis)
+        taken_i = jnp.expand_dims(taken_i, axis)
+    return taken_v, taken_i.astype(jnp.int32)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    return apply("kthvalue", x, k=int(k), axis=axis, keepdim=keepdim)
+
+
+@register("mode")
+def _mode(x, *, axis=-1, keepdim=False):
+    # O(n^2) pairwise count along the axis — fine for the modest n this op
+    # sees; keeps shapes static for XLA.
+    ax = axis % x.ndim
+    eq = jnp.expand_dims(x, ax) == jnp.expand_dims(x, ax + 1)
+    counts = jnp.sum(eq, axis=ax + 1)
+    idx = jnp.argmax(counts, axis=ax)
+    val = jnp.take_along_axis(x, jnp.expand_dims(idx, ax), axis=ax)
+    if not keepdim:
+        return jnp.squeeze(val, ax), idx.astype(jnp.int32)
+    return val, jnp.expand_dims(idx, ax).astype(jnp.int32)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    return apply("mode", x, axis=axis, keepdim=keepdim)
+
+
+@register("count_nonzero")
+def _count_nonzero(x, *, axis=None, keepdim=False):
+    return jnp.sum((x != 0).astype(jnp.int32), axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply("count_nonzero", x, axis=_norm_axis(axis), keepdim=keepdim)
+
+
+@register("nansum")
+def _nansum(x, *, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim)
+
+
+def nansum(x, axis=None, keepdim=False, name=None):
+    return apply("nansum", x, axis=_norm_axis(axis), keepdim=keepdim)
+
+
+@register("nanmean")
+def _nanmean(x, *, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply("nanmean", x, axis=_norm_axis(axis), keepdim=keepdim)

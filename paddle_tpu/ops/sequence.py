@@ -1,0 +1,182 @@
+"""Sequence (LoD) ops on dense + offsets layout.
+
+The reference stores variable-length sequences as LoDTensor
+(``paddle/fluid/framework/lod_tensor.h``) and provides
+``sequence_pool/pad/unpad/expand/mask`` ops. Dynamic shapes don't compile on
+TPU, so our layout is the XLA-native one: dense padded data + an int32
+``length`` (or offsets) array, with masking everywhere. segment_* ops use
+``jax.ops.segment_sum``-style reductions which XLA lowers efficiently.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._base import register, apply, unwrap
+
+
+@register("sequence_mask")
+def _sequence_mask(lengths, *, maxlen, dtype):
+    row = jnp.arange(maxlen)
+    return (row[None, :] < lengths[:, None]).astype(dtype)
+
+
+def sequence_mask(x, maxlen=None, dtype="float32", name=None):
+    from ..core.dtype import convert_dtype
+
+    if maxlen is None:
+        maxlen = int(np.asarray(unwrap(x)).max())
+    elif isinstance(maxlen, Tensor):
+        maxlen = int(maxlen.item())
+    return apply("sequence_mask", x, maxlen=int(maxlen), dtype=convert_dtype(dtype))
+
+
+@register("sequence_pool_op")
+def _sequence_pool(x, lengths, *, pool_type):
+    # x: (B, T, ...) padded; lengths: (B,)
+    t = x.shape[1]
+    mask = (jnp.arange(t)[None, :] < lengths[:, None])
+    mshape = mask.shape + (1,) * (x.ndim - 2)
+    m = mask.reshape(mshape).astype(x.dtype)
+    if pool_type == "sum":
+        return jnp.sum(x * m, axis=1)
+    if pool_type == "average":
+        denom = jnp.maximum(lengths.astype(x.dtype), 1).reshape((-1,) + (1,) * (x.ndim - 2))
+        return jnp.sum(x * m, axis=1) / denom
+    if pool_type == "sqrt":
+        denom = jnp.sqrt(jnp.maximum(lengths.astype(x.dtype), 1)).reshape((-1,) + (1,) * (x.ndim - 2))
+        return jnp.sum(x * m, axis=1) / denom
+    if pool_type == "max":
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    if pool_type == "first":
+        return x[:, 0]
+    if pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        return jnp.take_along_axis(x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1)[:, 0]
+    raise ValueError(pool_type)
+
+
+def sequence_pool(input, pool_type="sum", lengths=None, name=None):
+    """Padded-batch analog of fluid sequence_pool (ref: sequence_pool_op.cc)."""
+    if lengths is None:
+        lengths = Tensor(jnp.full((unwrap(input).shape[0],), unwrap(input).shape[1], jnp.int32), _internal=True)
+    return apply("sequence_pool_op", input, lengths, pool_type=pool_type.lower())
+
+
+@register("sequence_pad_op")
+def _sequence_pad(x, offsets, *, maxlen, pad_value):
+    # x: (total, ...) flat concatenated; offsets: (B+1,)
+    b = offsets.shape[0] - 1
+    starts = offsets[:-1]
+    lengths = offsets[1:] - offsets[:-1]
+    idx = starts[:, None] + jnp.arange(maxlen)[None, :]
+    idx = jnp.clip(idx, 0, x.shape[0] - 1)
+    out = x[idx]  # (B, maxlen, ...)
+    mask = jnp.arange(maxlen)[None, :] < lengths[:, None]
+    mshape = mask.shape + (1,) * (x.ndim - 1)
+    return jnp.where(mask.reshape(mshape), out, pad_value), lengths
+
+
+def sequence_pad(x, pad_value=0.0, maxlen=None, offsets=None, name=None):
+    if offsets is None:
+        raise ValueError("sequence_pad requires offsets (LoD) tensor")
+    if maxlen is None:
+        off = np.asarray(unwrap(offsets))
+        maxlen = int((off[1:] - off[:-1]).max())
+    if isinstance(pad_value, Tensor):
+        pad_value = float(pad_value.item())
+    return apply("sequence_pad_op", x, offsets, maxlen=int(maxlen), pad_value=pad_value)
+
+
+@register("sequence_unpad_op")
+def _sequence_unpad(x, lengths, *, total):
+    # x: (B, T, ...) -> (total, ...): gather valid positions
+    b, t = x.shape[0], x.shape[1]
+    starts = jnp.concatenate([jnp.zeros((1,), lengths.dtype), jnp.cumsum(lengths)[:-1]])
+    flat = jnp.reshape(x, (b * t,) + x.shape[2:])
+    pos = jnp.arange(b * t)
+    row = pos // t
+    col = pos % t
+    dest = jnp.where(col < lengths[row], starts[row] + col, total)
+    out = jnp.zeros((total + 1,) + x.shape[2:], x.dtype).at[dest].set(flat)
+    return out[:total]
+
+
+def sequence_unpad(x, length, name=None):
+    total = int(np.asarray(unwrap(length)).sum())
+    return apply("sequence_unpad_op", x, length, total=total)
+
+
+@register("sequence_expand_op")
+def _sequence_expand(x, repeats, *, total):
+    idx = jnp.repeat(jnp.arange(x.shape[0]), repeats, total_repeat_length=total)
+    return x[idx]
+
+
+def sequence_expand(x, repeats, name=None):
+    r = np.asarray(unwrap(repeats))
+    return apply("sequence_expand_op", x, Tensor(jnp.asarray(r), _internal=True), total=int(r.sum()))
+
+
+@register("sequence_reverse_op")
+def _sequence_reverse(x, lengths):
+    t = x.shape[1]
+    idx = lengths[:, None] - 1 - jnp.arange(t)[None, :]
+    valid = idx >= 0
+    idx = jnp.where(valid, idx, jnp.arange(t)[None, :])
+    return jnp.take_along_axis(x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    if lengths is None:
+        lengths = Tensor(jnp.full((unwrap(x).shape[0],), unwrap(x).shape[1], jnp.int32), _internal=True)
+    return apply("sequence_reverse_op", x, lengths)
+
+
+@register("segment_sum")
+def _segment_sum(x, ids, *, num_segments):
+    return jax.ops.segment_sum(x, ids, num_segments=num_segments)
+
+
+def segment_sum(data, segment_ids, num_segments=None, name=None):
+    if num_segments is None:
+        num_segments = int(np.asarray(unwrap(segment_ids)).max()) + 1
+    return apply("segment_sum", data, segment_ids, num_segments=num_segments)
+
+
+@register("segment_mean")
+def _segment_mean(x, ids, *, num_segments):
+    s = jax.ops.segment_sum(x, ids, num_segments=num_segments)
+    c = jax.ops.segment_sum(jnp.ones_like(x[..., :1] if x.ndim > 1 else x), ids, num_segments=num_segments)
+    return s / jnp.maximum(c, 1)
+
+
+def segment_mean(data, segment_ids, num_segments=None, name=None):
+    if num_segments is None:
+        num_segments = int(np.asarray(unwrap(segment_ids)).max()) + 1
+    return apply("segment_mean", data, segment_ids, num_segments=num_segments)
+
+
+@register("segment_max")
+def _segment_max(x, ids, *, num_segments):
+    return jax.ops.segment_max(x, ids, num_segments=num_segments)
+
+
+def segment_max(data, segment_ids, num_segments=None, name=None):
+    if num_segments is None:
+        num_segments = int(np.asarray(unwrap(segment_ids)).max()) + 1
+    return apply("segment_max", data, segment_ids, num_segments=num_segments)
+
+
+@register("segment_min")
+def _segment_min(x, ids, *, num_segments):
+    return jax.ops.segment_min(x, ids, num_segments=num_segments)
+
+
+def segment_min(data, segment_ids, num_segments=None, name=None):
+    if num_segments is None:
+        num_segments = int(np.asarray(unwrap(segment_ids)).max()) + 1
+    return apply("segment_min", data, segment_ids, num_segments=num_segments)

@@ -1,0 +1,31 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware isn't available in CI; XLA's host platform can fake
+N devices, which exercises the exact same SPMD partitioner + collective
+lowering paths our Mesh code uses on a real pod.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np
+import pytest
+
+import jax
+
+# test-only: exact f32 matmuls so numerical comparisons vs numpy are tight
+# (the production TPU path keeps the fast default so the MXU runs bf16)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,133 @@
+"""Dygraph autograd tests (ref model: tests/unittests/test_imperative_basic.py)."""
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _leaf(data):
+    t = pt.to_tensor(data, stop_gradient=False)
+    return t
+
+
+def test_simple_backward():
+    x = _leaf([2.0, 3.0])
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_and_branching():
+    x = _leaf([1.0, 2.0])
+    a = x * 2
+    b = a + x          # x contributes twice
+    loss = (b * b).sum()
+    loss.backward()
+    # b = 3x, loss = 9x^2, dloss/dx = 18x
+    np.testing.assert_allclose(x.grad.numpy(), [18.0, 36.0])
+
+
+def test_grad_accumulation_until_clear():
+    x = _leaf([1.0])
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_blocks():
+    x = _leaf([1.0, 1.0])
+    y = pt.to_tensor([5.0, 5.0])  # stop_gradient=True
+    loss = (x * y).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = _leaf([2.0])
+    y = (x * x).detach()
+    z = y * 3
+    assert z.stop_gradient
+
+
+def test_no_grad_context():
+    x = _leaf([2.0])
+    with pt.no_grad():
+        y = x * x
+    assert y.stop_gradient
+
+
+def test_matmul_grad():
+    a = _leaf(np.random.randn(3, 4).astype(np.float32))
+    b = _leaf(np.random.randn(4, 5).astype(np.float32))
+    (a @ b).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.ones((3, 5)) @ b.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), a.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_paddle_grad_api():
+    x = _leaf([3.0])
+    y = x * x
+    (gx,) = pt.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+
+
+def test_grad_intermediate():
+    x = _leaf([2.0])
+    h = x * x
+    y = h * 3.0
+    (gh,) = pt.grad(y, h)
+    np.testing.assert_allclose(gh.numpy(), [3.0])
+
+
+def test_grad_allow_unused():
+    x = _leaf([1.0])
+    z = _leaf([1.0])
+    y = x * 2
+    gx, gz = pt.grad(y, [x, z], allow_unused=True)
+    assert gz is None
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+
+
+def test_double_backward_raises_without_retain():
+    x = _leaf([1.0])
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()  # ok: retained once
+    g1 = x.grad.numpy()
+    np.testing.assert_allclose(g1, [4.0])
+
+
+def test_backward_through_slice_and_concat():
+    x = _leaf(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = pt.concat([x[0:1], x[1:2] * 2], axis=0).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 1, 1], [2, 2, 2]])
+
+
+def test_hook():
+    x = _leaf([1.0])
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_softmax_ce_style_grad():
+    logits = _leaf(np.random.randn(4, 10).astype(np.float32))
+    labels = np.random.randint(0, 10, (4,))
+    p = pt.ops.activation.log_softmax(logits)
+    picked = pt.gather_nd(p, pt.to_tensor(np.stack([np.arange(4), labels], axis=1)))
+    loss = -picked.mean()
+    loss.backward()
+    sm = np.exp(p.numpy())
+    onehot = np.eye(10)[labels]
+    expect = (sm - onehot) / 4
+    np.testing.assert_allclose(logits.grad.numpy(), expect, atol=1e-5)

@@ -1,0 +1,126 @@
+"""Tensor + eager-op basics (ref test model: tests/unittests/test_var_base.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_to_tensor_roundtrip():
+    x = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert x.dtype == pt.float32
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_scalar_and_int_dtypes():
+    assert pt.to_tensor(3).dtype == pt.int64
+    assert pt.to_tensor(3.0).dtype == pt.float32
+    assert pt.to_tensor(True).dtype == pt.bool
+    assert pt.to_tensor(np.array([1.0], dtype=np.float64)).dtype == pt.float32
+
+
+def test_arithmetic_broadcast():
+    a = pt.ones([2, 3])
+    b = pt.arange(3, dtype="float32")
+    c = a + b * 2 - 1.0
+    np.testing.assert_allclose(c.numpy(), np.ones((2, 3)) + np.arange(3) * 2 - 1)
+
+
+def test_scalar_keeps_dtype():
+    a = pt.ones([2], dtype="bfloat16")
+    assert (a * 2).dtype == pt.bfloat16
+    assert (a + 1).dtype == pt.bfloat16
+
+
+def test_matmul_and_T():
+    a = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    c = a @ b
+    assert c.shape == [2, 4]
+    np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy())
+    np.testing.assert_allclose(a.T.numpy(), a.numpy().T)
+
+
+def test_getitem_setitem():
+    x = pt.arange(12, dtype="float32").reshape([3, 4])
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    x[0, 0] = 100.0
+    assert x[0, 0].item() == 100.0
+
+
+def test_methods_attached():
+    x = pt.to_tensor([[1.0, -2.0], [3.0, -4.0]])
+    np.testing.assert_allclose(x.abs().sum().item(), 10.0)
+    np.testing.assert_allclose(x.mean(axis=0).numpy(), [2.0, -3.0])
+    assert x.max().item() == 3.0
+    assert x.argmax().item() == 2
+
+
+def test_comparison_ops():
+    a = pt.to_tensor([1.0, 2.0, 3.0])
+    b = pt.to_tensor([3.0, 2.0, 1.0])
+    np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+    assert pt.allclose(a, a).item()
+
+
+def test_cast():
+    x = pt.ones([2], dtype="float32")
+    assert x.astype("int32").dtype == pt.int32
+    assert pt.cast(x, "bfloat16").dtype == pt.bfloat16
+
+
+def test_creation_ops():
+    assert pt.zeros([2, 2]).numpy().sum() == 0
+    assert pt.full([2], 7).numpy().tolist() == [7.0, 7.0]
+    assert pt.eye(3).numpy().trace() == 3
+    assert pt.linspace(0, 1, 5).shape == [5]
+    t = pt.tril(pt.ones([3, 3]))
+    assert t.numpy()[0, 2] == 0
+
+
+def test_manipulation_ops():
+    x = pt.arange(24).reshape([2, 3, 4])
+    assert pt.transpose(x, [2, 0, 1]).shape == [4, 2, 3]
+    assert pt.concat([x, x], axis=1).shape == [2, 6, 4]
+    assert pt.stack([x, x]).shape == [2, 2, 3, 4]
+    parts = pt.split(x, [1, 2], axis=1)
+    assert parts[0].shape == [2, 1, 4] and parts[1].shape == [2, 2, 4]
+    assert pt.flatten(x, 1).shape == [2, 12]
+    assert pt.squeeze(pt.unsqueeze(x, 0), 0).shape == [2, 3, 4]
+
+
+def test_where_topk_sort():
+    x = pt.to_tensor([3.0, 1.0, 2.0])
+    v, i = pt.topk(x, 2)
+    np.testing.assert_allclose(v.numpy(), [3, 2])
+    np.testing.assert_array_equal(i.numpy(), [0, 2])
+    np.testing.assert_allclose(pt.sort(x).numpy(), [1, 2, 3])
+    out = pt.where(x > 1.5, x, pt.zeros_like(x))
+    np.testing.assert_allclose(out.numpy(), [3, 0, 2])
+
+
+def test_gather_scatter():
+    x = pt.arange(10, dtype="float32")
+    idx = pt.to_tensor([1, 3, 5])
+    np.testing.assert_allclose(pt.gather(x, idx).numpy(), [1, 3, 5])
+    upd = pt.scatter(pt.zeros([5]), pt.to_tensor([0, 2]), pt.to_tensor([1.0, 2.0]))
+    np.testing.assert_allclose(upd.numpy(), [1, 0, 2, 0, 0])
+
+
+def test_random_reproducible():
+    pt.seed(7)
+    a = pt.randn([4])
+    pt.seed(7)
+    b = pt.randn([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+def test_linalg():
+    a = np.array([[4.0, 2.0], [2.0, 3.0]], np.float32)
+    x = pt.to_tensor(a)
+    np.testing.assert_allclose(pt.inverse(x).numpy(), np.linalg.inv(a), atol=1e-5)
+    np.testing.assert_allclose(pt.norm(x, p=2).item(), (np.abs(a) ** 2).sum() ** 0.5, rtol=1e-5)
+    l = pt.cholesky(x)
+    np.testing.assert_allclose((l @ l.T).numpy(), a, atol=1e-5)
