@@ -75,6 +75,21 @@ from .ops.linalg import (
 )
 from .ops.control_flow import cond, while_loop, case, switch_case, scan
 
+from . import nn
+from . import optim
+from . import static_ as static
+from .static_ import enable_static, disable_static
+from .static_.program import program_guard, global_scope
+
+
+def in_dynamic_mode():
+    return not static.in_static_mode()
+from .optim import regularizer
+from .nn.param_attr import ParamAttr
+from .utils import unique_name
+
+optimizer = optim  # paddle.optimizer namespace alias
+
 bool = bool_  # paddle.bool
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -203,6 +203,22 @@ class Parameter(Tensor):
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()
 
+    def __deepcopy__(self, memo):
+        # A copied layer must NOT share parameter *names* with the source:
+        # optimizer accumulators / EMA shadows are keyed by name, so a name
+        # collision silently cross-wires their state (e.g. deepcopy'd
+        # Transformer layers). Values are shared (jax arrays are immutable);
+        # identity and name are fresh.
+        from ..utils import unique_name
+
+        p = Parameter(self._data, name=unique_name.generate(self.name),
+                      trainable=self.trainable)
+        p.optimize_attr = dict(self.optimize_attr)
+        p.regularizer = self.regularizer
+        p.need_clip = self.need_clip
+        memo[id(self)] = p
+        return p
+
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     """paddle.to_tensor equivalent."""

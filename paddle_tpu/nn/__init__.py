@@ -1,0 +1,18 @@
+"""paddle_tpu.nn — layers, functional API, initializers.
+
+Mirrors ``paddle.nn`` (ref: python/paddle/nn/__init__.py +
+fluid/dygraph/layers.py). TPU-native: layers hold jax-array Parameters;
+forward passes are pure traced functions.
+"""
+from .layer import Layer, Sequential, LayerList, ParameterList, LayerDict  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from . import initializer  # noqa: F401
+from . import functional  # noqa: F401
+from .layers import *  # noqa: F401,F403
+from .layers import (  # noqa: F401
+    common as _common, conv as _conv, pooling as _pooling, norm as _norm,
+    activation as _activation, loss as _loss, rnn as _rnn,
+    transformer as _transformer,
+)
+
+functional_api = functional

@@ -1,0 +1,69 @@
+"""Attention primitives.
+
+Dense reference implementation of scaled-dot-product attention; the pallas
+flash-attention kernel (ops/pallas/flash_attention.py) is substituted on TPU
+for long sequences. Ref: the reference builds attention from primitive ops in
+its transformer models (book ch8 / ERNIE); there is no fused kernel to port —
+this is the TPU-native design point.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import random as prandom
+from ...core.tensor import Tensor
+from ...ops._base import register, apply
+
+__all__ = ["scaled_dot_product_attention", "sdpa_bhld"]
+
+
+@register("sdpa")
+def _sdpa(q, k, v, mask, key, *, scale, is_causal, dropout_p):
+    # q,k,v: (B, H, L, D). Softmax in f32 for bf16 inputs.
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if is_causal:
+        Lq, Lk = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
+        scores = jnp.where(causal, scores, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -1e30)
+        else:
+            scores = scores + mask.astype(jnp.float32)
+    p = jax.nn.softmax(scores, axis=-1)
+    if dropout_p > 0.0 and key is not None:
+        # dropout on the attention *weights* (reference semantics), before
+        # the V matmul, with upscale-in-train normalization
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def sdpa_bhld(query, key, value, attn_mask=None, scale=None, is_causal=False,
+              dropout_p=0.0, training=True):
+    """(B, H, L, D) layout — internal form used by nn layers."""
+    d = query.shape[-1] if not hasattr(query, "_data") else query._data.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    use_drop = dropout_p > 0.0 and training
+    rng = Tensor(prandom.next_key(), _internal=True) if use_drop else None
+    return apply("sdpa", query, key, value, attn_mask, rng,
+                 scale=float(scale), is_causal=bool(is_causal),
+                 dropout_p=float(dropout_p) if use_drop else 0.0)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """Paddle 2.x layout (B, L, H, D)."""
+    from ...ops.manipulation import transpose
+
+    q = transpose(query, [0, 2, 1, 3])
+    k = transpose(key, [0, 2, 1, 3])
+    v = transpose(value, [0, 2, 1, 3])
+    out = sdpa_bhld(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
+                    dropout_p=dropout_p, training=training)
+    return transpose(out, [0, 2, 1, 3])

@@ -1,0 +1,120 @@
+"""Common functional ops: linear, embedding, similarity, label smoothing.
+
+Refs: python/paddle/fluid/layers/nn.py (fc, embedding, cos_sim,
+label_smooth), paddle/fluid/operators/{mul_op,lookup_table_op,cos_sim_op}.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._base import register, apply, unwrap
+
+__all__ = [
+    "linear", "embedding", "one_hot", "cosine_similarity",
+    "pairwise_distance", "label_smooth", "bilinear", "class_center_sample",
+]
+
+
+@register("linear")
+def _linear(x, w, b):
+    y = jnp.matmul(x, w)
+    return y + b
+
+
+@register("linear_nobias")
+def _linear_nobias(x, w):
+    return jnp.matmul(x, w)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W stored (in_features, out_features) — same layout
+    as the reference's mul_op so state_dicts transfer directly; the matmul
+    maps straight onto the MXU with no transpose."""
+    if bias is None:
+        return apply("linear_nobias", x, weight)
+    return apply("linear", x, weight, bias)
+
+
+@register("embedding")
+def _embedding(w, ids, *, padding_idx):
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    del sparse  # XLA gathers are always "dense"; grad is a scatter-add
+    return apply("embedding", weight, x, padding_idx=padding_idx)
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.manipulation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+@register("cosine_similarity")
+def _cos_sim(x1, x2, *, axis, eps):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    return apply("cosine_similarity", x1, x2, axis=axis, eps=float(eps))
+
+
+@register("pairwise_distance")
+def _pairwise_distance(x, y, *, p, epsilon, keepdim):
+    d = x - y + epsilon
+    return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    return apply("pairwise_distance", x, y, p=float(p), epsilon=float(epsilon),
+                 keepdim=bool(keepdim))
+
+
+@register("label_smooth")
+def _label_smooth(label, *, epsilon):
+    k = label.shape[-1]
+    return label * (1.0 - epsilon) + epsilon / k
+
+
+@register("label_smooth_prior")
+def _label_smooth_prior(label, prior, *, epsilon):
+    return label * (1.0 - epsilon) + epsilon * prior
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is not None:
+        return apply("label_smooth_prior", label, prior_dist, epsilon=float(epsilon))
+    return apply("label_smooth", label, epsilon=float(epsilon))
+
+
+@register("bilinear")
+def _bilinear(x1, x2, w, b):
+    # w: (out, in1, in2)
+    y = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+    return y if b is None else y + b
+
+
+@register("bilinear_nobias")
+def _bilinear_nobias(x1, x2, w):
+    return jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    if bias is None:
+        return apply("bilinear_nobias", x1, x2, weight)
+    return apply("bilinear", x1, x2, weight, bias)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample requires dynamic-size outputs, which cannot be "
+        "compiled for TPU; use ParallelCrossEntropy (dist/tp_layers.py) instead")

@@ -1,0 +1,246 @@
+"""Weight initializers (ref: python/paddle/fluid/initializer.py).
+
+Every initializer is a pure function of (shape, dtype, PRNG key) — the
+TPU-correct analog of the reference's fill ops (``fill_constant``,
+``gaussian_random``, ``uniform_random``, ``truncated_gaussian_random``): init
+happens on-device in one XLA call, seeded via the global generator
+(core/random.py), so multi-host replicas initialize identically.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as prandom
+from ..core.dtype import convert_dtype
+
+__all__ = [
+    "Initializer", "Constant", "Uniform", "Normal", "TruncatedNormal",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Bilinear", "Assign", "Orthogonal", "Dirac", "calculate_gain",
+    "set_global_initializer",
+]
+
+
+def calculate_gain(nonlinearity, param=None):
+    recommended = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3, "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in recommended:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return recommended[nonlinearity]
+
+
+def _fans(shape):
+    """fan_in/fan_out following the reference's convention: for conv weights
+    (OIHW) receptive field multiplies the channel fans."""
+    shape = tuple(int(s) for s in shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:  # Linear stores (in, out)
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32", key=None):
+        dtype = convert_dtype(dtype)
+        if key is None:
+            key = prandom.next_key()
+        return self._generate(tuple(int(s) for s in shape), dtype, key)
+
+    def _generate(self, shape, dtype, key):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _generate(self, shape, dtype, key):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def _generate(self, shape, dtype, key):
+        return jax.random.uniform(key, shape, dtype=jnp.float32,
+                                  minval=self.low, maxval=self.high).astype(dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def _generate(self, shape, dtype, key):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * self.std
+                + self.mean).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    """Samples clipped to ±2σ (ref: truncated_gaussian_random_op)."""
+
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def _generate(self, shape, dtype, key):
+        z = jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype=jnp.float32)
+        return (z * self.std + self.mean).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dtype, key):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, dtype=jnp.float32,
+                                  minval=-limit, maxval=limit).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dtype, key):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * std).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, dtype, key):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope) if \
+            self.nonlinearity == "leaky_relu" else calculate_gain(self.nonlinearity)
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(key, shape, dtype=jnp.float32,
+                                  minval=-limit, maxval=limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, dtype, key):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope) if \
+            self.nonlinearity == "leaky_relu" else calculate_gain(self.nonlinearity)
+        std = gain / math.sqrt(fi)
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * std).astype(dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel for transposed conv (ref: BilinearInitializer)."""
+
+    def _generate(self, shape, dtype, key):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        h, w = shape[2], shape[3]
+        f_h, f_w = math.ceil(h / 2.0), math.ceil(w / 2.0)
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        yy = (1 - np.abs(np.arange(h) / f_h - c_h))
+        xx = (1 - np.abs(np.arange(w) / f_w - c_w))
+        kernel = np.outer(yy, xx).astype(np.float32)
+        weight = np.zeros(shape, dtype=np.float32)
+        for i in range(shape[0]):
+            weight[i, i % shape[1]] = kernel
+        return jnp.asarray(weight, dtype=dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def _generate(self, shape, dtype, key):
+        v = self.value
+        if hasattr(v, "_data"):
+            v = v._data
+        arr = jnp.asarray(np.asarray(v), dtype=dtype)
+        if tuple(arr.shape) != shape:
+            arr = arr.reshape(shape)
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def _generate(self, shape, dtype, key):
+        rows = shape[0]
+        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        flat = (max(rows, cols), min(rows, cols))
+        a = jax.random.normal(key, flat, dtype=jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv kernel (ref: DiracInitializer)."""
+
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def _generate(self, shape, dtype, key):
+        w = np.zeros(shape, dtype=np.float32)
+        out_per_group = shape[0] // self.groups
+        centre = tuple(s // 2 for s in shape[2:])
+        for g in range(self.groups):
+            for i in range(min(out_per_group, shape[1])):
+                w[(g * out_per_group + i, i) + centre] = 1.0
+        return jnp.asarray(w, dtype=dtype)
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """ref: fluid.set_global_initializer."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init, _global_bias_init = weight_init, bias_init
+
+
+def global_initializer(is_bias):
+    return _global_bias_init if is_bias else _global_weight_init
+
+
+# fluid-era aliases
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = XavierUniform
+MSRAInitializer = KaimingNormal
+BilinearInitializer = Bilinear
+NumpyArrayInitializer = Assign

@@ -1,0 +1,119 @@
+"""Convolution layers (ref: python/paddle/nn/layer/conv.py,
+fluid/layers/nn.py conv2d/conv2d_transpose; kernels: conv_op.cc).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import functional as F
+from ..layer import Layer
+from .. import initializer as I
+
+__all__ = [
+    "Conv1D", "Conv2D", "Conv3D",
+    "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose",
+]
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+class _ConvNd(Layer):
+    nsp = 2
+    transposed = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 output_padding=0):
+        super().__init__()
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _ntuple(kernel_size, self.nsp)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._padding_mode = padding_mode
+        self._output_padding = output_padding
+        if self.transposed:
+            shape = (in_channels, out_channels // groups, *self._kernel_size)
+        else:
+            shape = (out_channels, in_channels // groups, *self._kernel_size)
+        fan_in = in_channels * int(np.prod(self._kernel_size)) // groups
+        std = (2.0 / fan_in) ** 0.5
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=I.Normal(0.0, std))
+        self.bias = self.create_parameter((out_channels,), attr=bias_attr,
+                                          is_bias=True)
+
+    def extra_repr(self):
+        return (f"{self._in_channels}, {self._out_channels}, "
+                f"kernel_size={self._kernel_size}, stride={self._stride}")
+
+
+class Conv1D(_ConvNd):
+    nsp = 1
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups)
+
+
+class Conv2D(_ConvNd):
+    nsp = 2
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups)
+
+
+class Conv3D(_ConvNd):
+    nsp = 3
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups)
+
+
+class Conv1DTranspose(_ConvNd):
+    nsp = 1
+    transposed = True
+
+    def forward(self, x, output_size=None):
+        from ...ops.conv import conv1d_transpose
+
+        return conv1d_transpose(x, self.weight, self.bias, stride=self._stride,
+                                padding=self._padding, dilation=self._dilation,
+                                groups=self._groups,
+                                output_padding=self._output_padding)
+
+
+class Conv2DTranspose(_ConvNd):
+    nsp = 2
+    transposed = True
+
+    def forward(self, x, output_size=None):
+        return F.conv2d_transpose(x, self.weight, self.bias,
+                                  stride=self._stride, padding=self._padding,
+                                  dilation=self._dilation, groups=self._groups,
+                                  output_padding=self._output_padding)
+
+
+class Conv3DTranspose(_ConvNd):
+    nsp = 3
+    transposed = True
+
+    def forward(self, x, output_size=None):
+        from ...ops.conv import conv3d_transpose
+
+        return conv3d_transpose(x, self.weight, self.bias, stride=self._stride,
+                                padding=self._padding, dilation=self._dilation,
+                                groups=self._groups,
+                                output_padding=self._output_padding)

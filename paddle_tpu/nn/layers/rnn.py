@@ -1,0 +1,379 @@
+"""Recurrent layers.
+
+Refs: python/paddle/fluid/layers/rnn.py (RNNCell/rnn/birnn),
+python/paddle/nn/layer/rnn.py (SimpleRNN/LSTM/GRU),
+paddle/fluid/operators/{rnn_op,lstm_op,gru_op}.
+
+TPU design: the whole sequence run is ONE framework op whose kernel is a
+``lax.scan`` over time — a single tape node, so forward+backward compile to
+one fused XLA while-loop (the reference instead launches cuDNN RNN kernels or
+per-step ops). Variable-length sequences are handled by masking against
+``sequence_length`` inside the scan — static shapes, MXU-friendly.
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from .. import functional as F
+from ..layer import Layer, LayerList
+from .. import initializer as I
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+]
+
+
+def _wrap(a):
+    return Tensor(a, _internal=True)
+
+
+@contextlib.contextmanager
+def _swap_params(params, arrays):
+    """Temporarily rebind cell Parameters to traced arrays so jax.vjp sees
+    the params as differentiable inputs of the fused sequence op."""
+    old = [p._data for p in params]
+    for p, a in zip(params, arrays):
+        p._data = a
+    try:
+        yield
+    finally:
+        for p, o in zip(params, old):
+            p._data = o
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Run ``cell`` over time with lax.scan (ref: layers/rnn.py rnn())."""
+    x = inputs._data if isinstance(inputs, Tensor) else jnp.asarray(inputs)
+    batch_idx = 1 if time_major else 0
+    if initial_states is None:
+        initial_states = cell.get_initial_states(inputs, batch_dim_idx=batch_idx)
+    states_flat, states_tree = jax.tree_util.tree_flatten(
+        initial_states, is_leaf=lambda v: isinstance(v, Tensor))
+    params = [p for p in cell.parameters() if p is not None]
+    seq = sequence_length
+
+    def kernel(x, seq_len, *flat, time_major, is_reverse, n_state):
+        st = [jnp.asarray(s) for s in flat[:n_state]]
+        p_arrays = flat[n_state:]
+        xs = x if time_major else jnp.swapaxes(x, 0, 1)
+        T = xs.shape[0]
+        t_idx = jnp.arange(T)
+        if is_reverse:
+            xs = jnp.flip(xs, axis=0)
+            t_idx = jnp.flip(t_idx, axis=0)
+
+        def step(carry, xt_t):
+            xt, t = xt_t
+            states = jax.tree_util.tree_unflatten(states_tree, list(carry))
+            with _swap_params(params, p_arrays), dispatch.no_grad():
+                out, new_states = cell(
+                    _wrap(xt),
+                    jax.tree_util.tree_map(
+                        _wrap, states, is_leaf=lambda v: isinstance(v, jax.Array)))
+            new_flat = [s._data for s in jax.tree_util.tree_leaves(
+                new_states, is_leaf=lambda v: isinstance(v, Tensor))]
+            out = out._data
+            if seq_len is not None:
+                keep = (t < seq_len).reshape((-1,) + (1,) * (out.ndim - 1))
+                new_flat = [jnp.where(keep, n, c) for n, c in zip(new_flat, carry)]
+                out = jnp.where(keep, out, jnp.zeros_like(out))
+            return tuple(new_flat), out
+
+        final, ys = jax.lax.scan(step, tuple(st), (xs, t_idx))
+        if is_reverse:
+            ys = jnp.flip(ys, axis=0)
+        if not time_major:
+            ys = jnp.swapaxes(ys, 0, 1)
+        return (ys, *final)
+
+    out = dispatch.apply(
+        "rnn_scan", kernel, inputs, seq, *states_flat, *params,
+        time_major=bool(time_major), is_reverse=bool(is_reverse),
+        n_state=len(states_flat))
+    ys, final = out[0], list(out[1:])
+    final_states = jax.tree_util.tree_unflatten(states_tree, final)
+    return ys, final_states
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None, sequence_length=None,
+          time_major=False, **kwargs):
+    """ref: layers/rnn.py birnn()."""
+    if initial_states is None:
+        fw_init = bw_init = None
+    else:
+        fw_init, bw_init = initial_states
+    out_fw, st_fw = rnn(cell_fw, inputs, fw_init, sequence_length,
+                        time_major=time_major, is_reverse=False)
+    out_bw, st_bw = rnn(cell_bw, inputs, bw_init, sequence_length,
+                        time_major=time_major, is_reverse=True)
+    from ...ops.manipulation import concat
+
+    return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = (batch_ref._data if isinstance(batch_ref, Tensor)
+                 else jnp.asarray(batch_ref)).shape[batch_dim_idx]
+        shape = shape if shape is not None else self.state_shape
+        dtype = dtype or "float32"
+
+        def build(s):
+            return Tensor(jnp.full((batch, *s), init_value,
+                                   dtype=jnp.dtype(dtype) if isinstance(dtype, str) else dtype),
+                          _internal=True)
+
+        if isinstance(shape, tuple) and shape and isinstance(shape[0], (tuple, list)):
+            return tuple(build(tuple(s)) for s in shape)
+        return build(tuple(shape))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((hidden_size, input_size),
+                                               attr=weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               attr=weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter((hidden_size,), attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter((hidden_size,), attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        self.activation = activation
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = F.tanh if self.activation == "tanh" else F.relu
+        gi = F.linear(inputs, self.weight_ih.T, self.bias_ih)
+        gh = F.linear(states, self.weight_hh.T, self.bias_hh)
+        h = act(gi + gh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    """Gate order i, f, g(cell), o (matches the reference's lstm_op)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               attr=weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               attr=weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter((4 * hidden_size,),
+                                             attr=bias_ih_attr, is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter((4 * hidden_size,),
+                                             attr=bias_hh_attr, is_bias=True,
+                                             default_initializer=u)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        gates = F.linear(inputs, self.weight_ih.T, self.bias_ih) + \
+            F.linear(h, self.weight_hh.T, self.bias_hh)
+        from ...ops.manipulation import split
+
+        i, f, g, o = split(gates, 4, axis=-1)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        g = F.tanh(g)
+        new_c = f * c + i * g
+        new_h = o * F.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    """Gate order r, z, c; h' = z*h + (1-z)*c (ref: gru_op)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               attr=weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               attr=weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter((3 * hidden_size,),
+                                             attr=bias_ih_attr, is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter((3 * hidden_size,),
+                                             attr=bias_hh_attr, is_bias=True,
+                                             default_initializer=u)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = states
+        from ...ops.manipulation import split
+
+        gi = F.linear(inputs, self.weight_ih.T, self.bias_ih)
+        gh = F.linear(h, self.weight_hh.T, self.bias_hh)
+        i_r, i_z, i_c = split(gi, 3, axis=-1)
+        h_r, h_z, h_c = split(gh, 3, axis=-1)
+        r = F.sigmoid(i_r + h_r)
+        z = F.sigmoid(i_z + h_z)
+        c = F.tanh(i_c + r * h_c)
+        new_h = z * h + (1.0 - z) * c
+        return new_h, new_h
+
+
+class RNN(Layer):
+    """Generic cell runner (ref: fluid/layers/rnn.py RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        return rnn(self.cell, inputs, initial_states, sequence_length,
+                   time_major=self.time_major, is_reverse=self.is_reverse)
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw, self.cell_bw = cell_fw, cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        return birnn(self.cell_fw, self.cell_bw, inputs, initial_states,
+                     sequence_length, time_major=self.time_major)
+
+
+class _RNNBase(LayerList):
+    """Stacked (and optionally bidirectional) recurrent net."""
+
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        self.direction = direction
+        kw = dict(weight_ih_attr=weight_ih_attr, weight_hh_attr=weight_hh_attr,
+                  bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+        if activation is not None:
+            kw["activation"] = activation
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 else hidden_size * self.num_directions
+            for _ in range(self.num_directions):
+                self.append(type(self).CELL(in_size, hidden_size, **kw))
+
+    def _cell(self, layer, direction):
+        return self[layer * self.num_directions + direction]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        D = self.num_directions
+        state_comps = 2 if type(self).CELL is LSTMCell else 1
+        if initial_states is not None:
+            # paddle layout: (num_layers*D, B, H) per state component
+            init = initial_states if isinstance(initial_states, (tuple, list)) \
+                else (initial_states,)
+        else:
+            init = None
+        out = inputs
+        finals = []  # per (layer, direction) final states
+        for layer in range(self.num_layers):
+            runs = []
+            for d in range(D):
+                cell = self._cell(layer, d)
+                if init is not None:
+                    idx = layer * D + d
+                    st = tuple(s[idx] for s in init)
+                    st = st if state_comps == 2 else st[0]
+                else:
+                    st = None
+                ys, fs = rnn(cell, out, st, sequence_length,
+                             time_major=self.time_major, is_reverse=bool(d))
+                runs.append(ys)
+                finals.append(fs)
+            if D == 2:
+                from ...ops.manipulation import concat
+
+                out = concat(runs, axis=-1)
+            else:
+                out = runs[0]
+            if self.dropout and layer < self.num_layers - 1:
+                out = F.dropout(out, p=self.dropout, training=self.training)
+        from ...ops.manipulation import stack
+
+        if state_comps == 2:
+            h = stack([f[0] for f in finals], axis=0)
+            c = stack([f[1] for f in finals], axis=0)
+            return out, (h, c)
+        h = stack([f if isinstance(f, Tensor) else f[0] for f in finals], axis=0)
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    CELL = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kw)
+
+
+class LSTM(_RNNBase):
+    CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    CELL = GRUCell

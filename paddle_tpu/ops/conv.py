@@ -126,39 +126,69 @@ def _conv2d_transpose(x, w, *, stride, padding, dilation, groups, output_padding
     # w layout IOHW (paddle transpose-conv convention: [in, out/groups, kh, kw]).
     # Implemented as a fractionally-strided conv: lhs_dilation=stride with a
     # flipped kernel; out = (in-1)*s - 2p + d*(k-1) + op + 1 (paddle formula).
-    if groups > 1:
-        i, o = w.shape[0], w.shape[1]
-        w_t = jnp.reshape(w, (groups, i // groups, o, *w.shape[2:]))
-        w_t = jnp.swapaxes(w_t, 1, 2)  # (g, o, i/g, kh, kw)
-        w_t = jnp.reshape(w_t, (groups * o, i // groups, *w.shape[2:]))
+    # Shared math lives in _convnd_transpose (also serves the 1-D/3-D ops).
+    return _convnd_transpose(x, w, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_padding=output_padding, nsp=2)
+
+
+def _output_padding_for(output_size, x_spatial, stride, padding, dilation,
+                        ksize):
+    """Derive output_padding from a requested output_size (paddle lets the
+    user disambiguate the transposed-conv output shape either way)."""
+    ops = []
+    for out, inp, s, (p0, p1), d, k in zip(output_size, x_spatial, stride,
+                                           padding, dilation, ksize):
+        base = (inp - 1) * s - (p0 + p1) + d * (k - 1) + 1
+        op = int(out) - base
+        if op < 0 or op >= s:
+            raise ValueError(
+                f"output_size {output_size} unreachable: needs output_padding"
+                f" {op} for stride {s}")
+        ops.append(op)
+    return tuple(ops)
+
+
+def _conv_transpose_wrapper(opname, nsp, x, weight, bias, stride, padding,
+                            output_padding, dilation, groups, output_size,
+                            data_format):
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    stride = _pair(stride, nsp)
+    dilation = _pair(dilation, nsp)
+    pad = _conv_padding(padding, nsp)
+    from .manipulation import transpose as _tr
+
+    if channel_last:
+        perm_in = [0, nsp + 1] + list(range(1, nsp + 1))
+        x = _tr(x, perm_in)
+    if output_size is not None:
+        if isinstance(pad, str):
+            raise ValueError("output_size with SAME/VALID padding is ambiguous")
+        if isinstance(output_size, int):
+            output_size = (output_size,) * nsp
+        xs = unwrap(x).shape[2:]
+        output_padding = _output_padding_for(output_size, xs, stride, pad,
+                                             dilation, unwrap(weight).shape[2:])
     else:
-        w_t = jnp.swapaxes(w, 0, 1)  # IOHW -> OIHW
-    w_t = jnp.flip(w_t, axis=(-2, -1))
-    dn = lax.conv_dimension_numbers(x.shape, w_t.shape, ("NCHW", "OIHW", "NCHW"))
-    if isinstance(padding, str):
-        pad = padding
-    else:
-        pad = [(d * (k - 1) - p0, d * (k - 1) - p1 + op)
-               for (p0, p1), k, d, op in zip(padding, w.shape[2:], dilation, output_padding)]
-    return lax.conv_general_dilated(
-        x, w_t, window_strides=(1, 1), padding=pad, lhs_dilation=stride,
-        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups)
+        output_padding = _pair(output_padding, nsp)
+    out = apply(opname, x, weight, stride=stride, padding=pad,
+                dilation=dilation, groups=groups, output_padding=output_padding)
+    if bias is not None:
+        from .math import add
+
+        out = add(out, bias.reshape([1, -1] + [1] * nsp))
+    if channel_last:
+        perm_out = [0] + list(range(2, nsp + 2)) + [1]
+        out = _tr(out, perm_out)
+    return out
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      output_size=None, data_format="NCHW", name=None):
-    stride = _pair(stride, 2)
-    dilation = _pair(dilation, 2)
-    output_padding = _pair(output_padding, 2)
-    pad = _conv_padding(padding, 2)
-    out = apply("conv2d_transpose", x, weight, stride=stride, padding=pad,
-                dilation=dilation, groups=groups, output_padding=output_padding)
-    if bias is not None:
-        from .math import add
-
-        out = add(out, bias.reshape([1, -1, 1, 1]))
-    return out
+    return _conv_transpose_wrapper("conv2d_transpose", 2, x, weight, bias,
+                                   stride, padding, output_padding, dilation,
+                                   groups, output_size, data_format)
 
 
 # ---------------------------------------------------------------------------
@@ -414,3 +444,65 @@ def _unfold(x, *, ksize, stride, padding, dilation):
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     return apply("unfold", x, ksize=_pair(kernel_sizes, 2), stride=_pair(strides, 2),
                  padding=_pair(paddings, 2), dilation=_pair(dilations, 2))
+
+
+# ---------------------------------------------------------------------------
+# 1-D / 3-D transposed conv (ref: conv_transpose_op.cc covers 1/2/3-D)
+# ---------------------------------------------------------------------------
+
+
+def _convnd_transpose(x, w, *, stride, padding, dilation, groups,
+                      output_padding, nsp):
+    # Same fractionally-strided formulation as conv2d_transpose, generalized
+    # over nsp spatial dims. w layout: [in, out/groups, *k].
+    spatial = tuple(range(-nsp, 0))
+    if groups > 1:
+        i, o = w.shape[0], w.shape[1]
+        w_t = jnp.reshape(w, (groups, i // groups, o, *w.shape[2:]))
+        w_t = jnp.swapaxes(w_t, 1, 2)
+        w_t = jnp.reshape(w_t, (groups * o, i // groups, *w.shape[2:]))
+    else:
+        w_t = jnp.swapaxes(w, 0, 1)
+    w_t = jnp.flip(w_t, axis=spatial)
+    chars = "DHW"[-nsp:]
+    fmt = ("NC" + chars, "OI" + chars, "NC" + chars)
+    dn = lax.conv_dimension_numbers(x.shape, w_t.shape, fmt)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        pad = [(d * (k - 1) - p0, d * (k - 1) - p1 + op)
+               for (p0, p1), k, d, op in zip(padding, w.shape[2:], dilation,
+                                             output_padding)]
+    return lax.conv_general_dilated(
+        x, w_t, window_strides=(1,) * nsp, padding=pad, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups)
+
+
+@register("conv1d_transpose")
+def _conv1d_transpose(x, w, *, stride, padding, dilation, groups, output_padding):
+    return _convnd_transpose(x, w, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_padding=output_padding, nsp=1)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose_wrapper("conv1d_transpose", 1, x, weight, bias,
+                                   stride, padding, output_padding, dilation,
+                                   groups, output_size, data_format)
+
+
+@register("conv3d_transpose")
+def _conv3d_transpose(x, w, *, stride, padding, dilation, groups, output_padding):
+    return _convnd_transpose(x, w, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_padding=output_padding, nsp=3)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_wrapper("conv3d_transpose", 3, x, weight, bias,
+                                   stride, padding, output_padding, dilation,
+                                   groups, output_size, data_format)

@@ -1,0 +1,14 @@
+"""paddle_tpu.optim — optimizers, LR schedulers, clipping, regularizers.
+
+Mirrors ``paddle.optimizer`` + ``fluid/optimizer.py``/``clip.py``/
+``regularizer.py``.
+"""
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adagrad, Adadelta, RMSProp, Adam, AdamW,
+    Adamax, Lamb, Ftrl, ExponentialMovingAverage, LookAhead,
+)
+from . import lr  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+)
+from .regularizer import L1Decay, L2Decay  # noqa: F401

@@ -1,0 +1,77 @@
+"""Gradient clipping (ref: python/paddle/fluid/clip.py).
+
+Clippers transform a list of (param, grad) pairs. Global-norm clip computes
+the norm in float32 across all grads — one fused XLA reduction per step when
+run under jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._apply(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _apply(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (p is not None and not getattr(p, "need_clip", True)):
+                out.append((p, g))
+            else:
+                out.append((p, jnp.clip(g, self.min, self.max)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _apply(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (p is not None and not getattr(p, "need_clip", True)):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, (g.astype(jnp.float32) * scale).astype(g.dtype)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _apply(self, params_grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for p, g in params_grads
+              if g is not None and (p is None or getattr(p, "need_clip", True))]
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or (p is not None and not getattr(p, "need_clip", True)):
+                out.append((p, g))
+            else:
+                out.append((p, (g.astype(jnp.float32) * scale).astype(g.dtype)))
+        return out
+
+
+# fluid-era aliases
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

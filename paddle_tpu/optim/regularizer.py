@@ -1,0 +1,33 @@
+"""Weight-decay regularizers (ref: python/paddle/fluid/regularizer.py).
+
+Applied as a grad transform g + d(reg)/dp — matching the reference's
+append_regularization_ops semantics (coupled decay; AdamW does decoupled
+decay itself).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer", "L2DecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param, grad):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __call__(self, param, grad):
+        return grad + self.coeff * jnp.sign(param)
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __call__(self, param, grad):
+        return grad + self.coeff * param
+
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

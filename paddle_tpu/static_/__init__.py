@@ -1,0 +1,59 @@
+"""paddle_tpu.static — static (Program/Executor) mode.
+
+Mirrors ``paddle.static`` / fluid's graph mode (ref:
+python/paddle/fluid/{framework,executor,compiler,backward}.py) on top of the
+op-dispatch tracer: while static mode is on, every framework op records into
+the default Program instead of executing; ``Executor.run`` compiles the
+recorded graph to one XLA executable.
+"""
+from .program import (  # noqa: F401
+    Variable, Operator, Block, Program, program_guard, default_main_program,
+    default_startup_program, data, Scope, global_scope, scope_guard,
+    name_scope, ProgramTracer,
+)
+from .backward import append_backward, gradients  # noqa: F401
+from .executor import Executor, build_optimize_ops  # noqa: F401
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
+
+import contextlib as _ctx
+
+from ..core import dispatch as _dispatch
+
+_static_ctx = None
+
+
+def enable_static():
+    """Switch the process into static-graph mode (ref: paddle.enable_static)."""
+    global _static_ctx
+    if _static_ctx is not None:
+        return
+    tracer = ProgramTracer(None)  # program resolved per-op via default
+    # bind tracer to the *current default* program dynamically:
+    tracer.__class__ = _DynamicTracer
+    _static_ctx = _dispatch.register_tracer(tracer)
+    _static_ctx.__enter__()
+
+
+def disable_static():
+    global _static_ctx
+    if _static_ctx is not None:
+        _static_ctx.__exit__(None, None, None)
+        _static_ctx = None
+
+
+def in_static_mode():
+    return _static_ctx is not None
+
+
+class _DynamicTracer(ProgramTracer):
+    """Tracer whose target program is whatever program_guard made current."""
+
+    @property
+    def program(self):
+        from .program import default_main_program
+
+        return default_main_program()
+
+    @program.setter
+    def program(self, v):
+        pass

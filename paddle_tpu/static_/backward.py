@@ -1,0 +1,170 @@
+"""Static-graph autodiff: append_backward / gradients.
+
+TPU-native analog of ``python/paddle/fluid/backward.py``: instead of
+registered per-op grad kernels (ops like ``elementwise_add_grad``), each
+forward Operator's grad op wraps ``jax.vjp`` of the SAME pure kernel — so a
+grad op can never disagree with its forward, and XLA fuses the pair.
+Grad vars follow the reference naming: ``<var>@GRAD``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import unique_name
+from .program import Operator, Variable, default_main_program
+
+__all__ = ["append_backward", "gradients", "grad_name"]
+
+
+def grad_name(name):
+    return name + "@GRAD"
+
+
+def _make_grad_fn(fwd_fn, attrs, n_inputs, multi_out):
+    """Build grad kernel: (inputs..., out_grads...) -> input grads tuple."""
+
+    def gfn(*args):
+        xs = args[:n_inputs]
+        gys = args[n_inputs:]
+        f = functools.partial(fwd_fn, **attrs)
+        _, vjp = jax.vjp(f, *xs)
+        gxs = vjp(tuple(gys) if multi_out else gys[0])
+        return tuple(gxs) if len(gxs) > 1 else gxs[0]
+
+    return gfn
+
+
+def _ensure_grad_var(block, src_var, gname):
+    if block.has_var(gname):
+        return block.var(gname)
+    v = block.create_var(name=gname, shape=src_var.shape,
+                         dtype=src_var._data.dtype)
+    return v
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, program=None):
+    """Append grad ops computing d loss / d params (ref: backward.py).
+
+    Returns list of (param_var, grad_var).
+    """
+    program = program or default_main_program()
+    block = program.global_block
+    no_grad_set = set(no_grad_set or ())
+
+    # seed: d loss/d loss = 1
+    gname = grad_name(loss.name)
+    seed_var = _ensure_grad_var(block, loss, gname)
+    block.append_op(Operator(
+        "fill_ones_like",
+        lambda x: jnp.ones(x.shape, x.dtype), [loss.name], [gname], {}))
+
+    # has-grad tracking: which vars currently have a grad var appended
+    have_grad = {loss.name}
+
+    fwd_ops = [op for op in block.ops if not op.type.endswith("@grad")
+               and op.type not in ("fill_ones_like",)]
+    for op in reversed(fwd_ops):
+        out_with_grad = [n for n in op.output_names if n in have_grad]
+        if not out_with_grad:
+            continue
+        if op.type == "assign_to":
+            # pass-through: grad of target flows to source
+            src = op.input_names[0]
+            tgt = op.output_names[0]
+            if src is not None and tgt in have_grad:
+                g_src = _ensure_grad_var(block, block.var(src), grad_name(src))
+                block.append_op(Operator(
+                    "assign_to@grad", lambda g: g,
+                    [grad_name(tgt)], [g_src.name], {}))
+                have_grad.add(src)
+            continue
+        n_in = len(op.input_names)
+        multi_out = len(op.output_names) > 1
+        gfn = _make_grad_fn(op.fn, op.attrs, n_in, multi_out)
+        # inputs of grad op: fwd inputs + grads of all outputs (zeros if
+        # an output has no grad yet — realized via fill_zeros ops)
+        g_out_names = []
+        for oname in op.output_names:
+            go = grad_name(oname)
+            if oname not in have_grad:
+                ov = block.var(oname)
+                _ensure_grad_var(block, ov, go)
+                block.append_op(Operator(
+                    "fill_zeros_like",
+                    lambda x: jnp.zeros(x.shape, x.dtype), [oname], [go], {}))
+            g_out_names.append(go)
+        grad_outputs = []
+        for iname in op.input_names:
+            if iname is None or iname in no_grad_set:
+                grad_outputs.append(None)
+                continue
+            iv = block.var(iname)
+            if iv.is_data or (iv.stop_gradient and not iv.is_parameter):
+                grad_outputs.append(None)
+                continue
+            grad_outputs.append(iname)
+
+        if not any(g is not None for g in grad_outputs):
+            continue
+
+        # each grad-op invocation produces fresh partials; accumulate into
+        # the canonical @GRAD var with add ops (ref: sum_op insertion)
+        partial_names = []
+        for iname in grad_outputs:
+            if iname is None:
+                partial_names.append(unique_name.generate("_gsink"))
+            elif iname in have_grad:
+                partial_names.append(unique_name.generate(grad_name(iname) + ".p"))
+            else:
+                partial_names.append(grad_name(iname))
+        for iname, pname in zip(grad_outputs, partial_names):
+            ref = block.var(iname) if iname is not None else None
+            if ref is not None:
+                _ensure_grad_var(block, ref, pname)
+            else:
+                # dummy sink var shaped like the op input position; shape
+                # inferred lazily by executor (scalar placeholder)
+                block.create_var(name=pname, shape=(), dtype="float32")
+        block.append_op(Operator(
+            op.type + "@grad", gfn,
+            list(op.input_names) + g_out_names, partial_names, {}))
+        for iname, pname in zip(grad_outputs, partial_names):
+            if iname is None:
+                continue
+            gn = grad_name(iname)
+            if iname in have_grad and pname != gn:
+                block.append_op(Operator(
+                    "grad_accumulate", lambda a, b: a + b,
+                    [gn, pname], [gn], {}))
+            have_grad.add(iname)
+
+    params = parameter_list if parameter_list is not None else [
+        v for v in block.vars.values() if v.is_parameter]
+    out = []
+    for p in params:
+        if isinstance(p, str):
+            p = block.var(p)
+        gn = grad_name(p.name)
+        if block.has_var(gn) and p.name in have_grad:
+            out.append((p, block.var(gn)))
+    program.bump()
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """ref: fluid.gradients — grads of targets wrt arbitrary inputs."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    pg = append_backward(targets[0], parameter_list=list(inputs),
+                         no_grad_set=no_grad_set)
+    got = {p.name: g for p, g in pg}
+    block = default_main_program().global_block
+    out = []
+    for i in inputs:
+        gn = grad_name(i.name)
+        out.append(block.var(gn) if block.has_var(gn) else got.get(i.name))
+    return out

@@ -1,0 +1,56 @@
+"""CompiledProgram / BuildStrategy / ExecutionStrategy.
+
+Ref: python/paddle/fluid/compiler.py + parallel_executor.cc. The reference's
+ParallelExecutor replicates the graph per GPU and all-reduces grads over
+NCCL; on TPU the same thing is a sharding annotation: the Executor runs the
+single fused XLA program, and ``with_data_parallel`` marks the feed batch
+axis to be sharded over the device mesh so XLA partitions the program and
+inserts ICI all-reduces itself (see dist/ for the Mesh machinery).
+"""
+from __future__ import annotations
+
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
+
+
+class BuildStrategy:
+    """Knob container for API parity; XLA owns the actual fusion/memory
+    decisions that these flags tuned in the reference."""
+
+    def __init__(self):
+        self.reduce_strategy = "all_reduce"
+        self.gradient_scale_strategy = "coeff_num_device"
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_optimizer_ops = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+        self.use_thread_pool = False
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = ExecutionStrategy()
+        self._data_parallel = False
+        self._loss_name = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        if exec_strategy is not None:
+            self._exec_strategy = exec_strategy
+        return self

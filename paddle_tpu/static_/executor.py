@@ -1,0 +1,188 @@
+"""Executor: compile + run a Program.
+
+TPU-native analog of ``python/paddle/fluid/executor.py`` +
+``paddle/fluid/framework/executor.cc``. The reference walks the program and
+launches one kernel per op; here the whole program is replayed into a single
+pure jax function and compiled ONCE per (program version, feed shapes) with
+``jax.jit`` — persistable buffers are donated so parameter updates happen
+in-place in HBM.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .program import (Program, Variable, default_main_program, global_scope)
+
+__all__ = ["Executor"]
+
+
+class _Compiled:
+    def __init__(self, fn, feed_names, persist_in, persist_out, fetch_names):
+        self.fn = fn
+        self.feed_names = feed_names
+        self.persist_in = persist_in
+        self.persist_out = persist_out
+        self.fetch_names = fetch_names
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: dict = {}
+
+    def close(self):
+        self._cache.clear()
+
+    # -- program -> pure function ------------------------------------------
+    @staticmethod
+    def _replay_fn(program, feed_names, persist_in, fetch_names, persist_out):
+        ops = list(program.global_block.ops)
+        consts = dict(program._constants)
+
+        def fn(feeds, persists):
+            env = dict(consts)
+            env.update(zip(feed_names, feeds))
+            env.update(zip(persist_in, persists))
+            for op in ops:
+                args = [env[n] if n is not None else None
+                        for n in op.input_names]
+                out = op.fn(*args, **op.attrs)
+                if isinstance(out, tuple):
+                    for name, o in zip(op.output_names, out):
+                        env[name] = o
+                else:
+                    env[op.output_names[0]] = out
+            return ([env[n] for n in fetch_names],
+                    [env[n] for n in persist_out])
+
+        return fn
+
+    def _compile(self, program, feed, fetch_list):
+        feed_names = tuple(sorted(feed))
+        fetch_names = tuple(
+            f.name if isinstance(f, Variable) else str(f) for f in fetch_list)
+        shapes = tuple(
+            (np.asarray(feed[n]).shape, str(np.asarray(feed[n]).dtype))
+            for n in feed_names)
+        key = (id(program), program._version, feed_names, shapes, fetch_names)
+        if key in self._cache:
+            return self._cache[key]
+
+        scope = global_scope()
+        blk = program.global_block
+        persist_in = tuple(
+            v.name for v in blk.vars.values()
+            if v.persistable and scope.find_var(v.name) is not None)
+        written = set()
+        for op in blk.ops:
+            written.update(op.output_names)
+        persist_out = tuple(n for n in persist_in if n in written)
+
+        raw = self._replay_fn(program, feed_names, persist_in, fetch_names,
+                              persist_out)
+        jit_fn = jax.jit(raw, donate_argnums=(1,))
+        compiled = _Compiled(jit_fn, feed_names, persist_in, persist_out,
+                             fetch_names)
+        self._cache[key] = compiled
+        return compiled
+
+    # -- public API ---------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, feed_var_name=None,
+            fetch_var_name=None, scope=None, return_numpy=True,
+            use_program_cache=True):
+        from .compiler import CompiledProgram
+
+        if program is None:
+            program = default_main_program()
+        data_parallel = None
+        if isinstance(program, CompiledProgram):
+            data_parallel = program._data_parallel
+            program = program._program
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+
+        if not program.global_block.ops:  # startup program: params already
+            return []  # materialized eagerly at build time
+
+        # schedulers: refresh host-side lr into the feed each run
+        if program._lr_getter is not None:
+            feed = dict(feed)
+            feed["@lr"] = np.asarray(program._lr_getter(), np.float32)
+
+        compiled = self._compile(program, feed, fetch_list)
+        feeds = [jnp.asarray(np.asarray(feed[n])) for n in compiled.feed_names]
+        persists = [scope.find_var(n) for n in compiled.persist_in]
+        fetches, new_persist = compiled.fn(feeds, persists)
+        for name, arr in zip(compiled.persist_out, new_persist):
+            scope.set(name, arr)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f, _internal=True) for f in fetches]
+
+
+def build_optimize_ops(optimizer, loss, parameter_list=None):
+    """Append backward + optimizer-update ops to the current program
+    (ref: Optimizer.minimize static path in fluid/optimizer.py)."""
+    from .backward import append_backward
+    from .program import default_main_program
+
+    program = default_main_program()
+    blk = program.global_block
+    scope = global_scope()
+    params_grads = append_backward(loss, parameter_list=parameter_list)
+
+    if optimizer._grad_clip is not None:
+        clip = optimizer._grad_clip
+        grads = [g for _, g in params_grads]
+        gnames = [g.name for g in grads]
+
+        def clip_fn(*gs):
+            pairs = clip([(p, g) for (p, _), g in zip(params_grads, gs)])
+            return tuple(g for _, g in pairs)
+
+        out_names = [n + "@CLIPPED" for n in gnames]
+        from .program import Operator
+
+        for (p, g), on in zip(params_grads, out_names):
+            blk.create_var(name=on, shape=g.shape, dtype=g._data.dtype)
+        blk.append_op(Operator("grad_clip", clip_fn, gnames, out_names, {}))
+        params_grads = [(p, blk.var(on)) for (p, _), on in
+                        zip(params_grads, out_names)]
+
+    # lr enters as a fed scalar so schedulers never retrigger compilation
+    if not blk.has_var("@lr"):
+        blk.create_var(name="@lr", shape=(), dtype="float32", is_data=True)
+    program._lr_getter = optimizer.get_lr
+
+    from .program import Operator
+
+    for p, g in params_grads:
+        reg = getattr(p, "regularizer", None) or optimizer._regularization
+        state = optimizer._init_state(
+            jax.ShapeDtypeStruct(tuple(p._data.shape), p._data.dtype))
+        skeys = sorted(state)
+        sname = {k: f"{p.name}@OPT@{k}" for k in skeys}
+        for k in skeys:
+            blk.create_var(name=sname[k], shape=state[k].shape,
+                           dtype=state[k].dtype, persistable=True)
+            scope.set(sname[k], jnp.asarray(state[k]))
+
+        def upd_fn(pa, ga, lr, *svals, _opt=optimizer, _reg=reg, _skeys=skeys):
+            from ..optim.optimizer import AdamW
+
+            if _reg is not None and not isinstance(_opt, AdamW):
+                ga = _reg(pa, ga)
+            s = dict(zip(_skeys, svals))
+            new_p, new_s = _opt._update(pa, ga.astype(pa.dtype), s, lr)
+            return (new_p, *[new_s[k] for k in _skeys])
+
+        blk.append_op(Operator(
+            "optimize_" + type(optimizer).__name__.lower(), upd_fn,
+            [p.name, g.name, "@lr"] + [sname[k] for k in skeys],
+            [p.name] + [sname[k] for k in skeys], {}))
+    program.bump()
+    return None, params_grads

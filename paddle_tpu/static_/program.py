@@ -1,0 +1,356 @@
+"""Static graph: Program / Block / Operator / Variable.
+
+TPU-native analog of the reference's ``python/paddle/fluid/framework.py``
+(Program, Block, Operator, Variable) and C++ ``framework/program_desc.*``.
+
+Key design departure: the reference interprets the program op-by-op through
+per-op CPU/CUDA kernels; here a recorded Program is *replayed symbolically*
+into one jax function which the Executor compiles with ``jax.jit`` into a
+single fused XLA executable — whole-program fusion instead of kernel
+launches, which is the only way to feed the MXU efficiently.
+
+An Operator stores the pure jax kernel (from the op registry) plus static
+attrs, so replay is exact. Shape/dtype inference uses ``jax.eval_shape`` —
+the same tracing machinery XLA uses, so inference can never drift from
+execution.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..core.dtype import convert_dtype
+from ..utils import unique_name
+
+__all__ = [
+    "Variable", "Operator", "Block", "Program", "program_guard",
+    "default_main_program", "default_startup_program", "data",
+    "Scope", "global_scope", "scope_guard", "name_scope",
+]
+
+
+class Variable(Tensor):
+    """Symbolic tensor inside a Program (ref: framework.py Variable).
+
+    ``_data`` holds a ShapeDtypeStruct — shape/dtype inspection works
+    everywhere a concrete Tensor does, but there is no value until the
+    Executor runs the program.
+    """
+
+    __slots__ = ("block", "is_parameter", "initializer", "is_data", "_stale",
+                 "trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, block, name, shape, dtype, persistable=False,
+                 stop_gradient=True, is_data=False):
+        aval = jax.ShapeDtypeStruct(tuple(int(s) if s != -1 else 1 for s in shape),
+                                    convert_dtype(dtype))
+        super().__init__(aval, stop_gradient=stop_gradient, _internal=True)
+        self.name = name
+        self.block = block
+        self.persistable = persistable
+        self.is_parameter = False
+        self.initializer = None
+        self.is_data = is_data
+        self._stale = False
+        self.trainable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._data.dtype)
+
+    @property
+    def ndim(self):
+        return len(self._data.shape)
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' has no value outside Executor.run(); "
+            "fetch it via fetch_list")
+
+    def set_value(self, value):
+        # In-graph assignment (ref: assign op writing to an existing var)
+        tracer = dispatch.current_tracer()
+        if tracer is not None:
+            tracer.record_assign(self, value)
+        else:
+            raise RuntimeError("set_value on a Variable outside program building")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={self._data.dtype}, persistable={self.persistable})")
+
+
+class Operator:
+    """ref: framework.py Operator / OpDesc. Stores the jax kernel + attrs."""
+
+    __slots__ = ("type", "fn", "input_names", "output_names", "attrs", "idx")
+
+    def __init__(self, type, fn, input_names, output_names, attrs):
+        self.type = type
+        self.fn = fn
+        self.input_names = input_names  # list[str|None]
+        self.output_names = output_names  # list[str]
+        self.attrs = attrs
+
+    def __repr__(self):
+        return (f"{{{', '.join(self.output_names)}}} = {self.type}"
+                f"({', '.join(str(n) for n in self.input_names)})")
+
+
+class Block:
+    """ref: framework.py Block. Single-block programs cover the jax design
+    (control flow is expressed with lax ops inside a kernel, not sub-blocks),
+    but the container keeps the reference's shape for API parity."""
+
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: dict[str, Variable] = {}
+        self.ops: list[Operator] = []
+
+    def var(self, name):
+        if name not in self.vars:
+            raise ValueError(f"variable {name} not in block {self.idx}")
+        return self.vars[name]
+
+    def has_var(self, name):
+        return name in self.vars
+
+    def create_var(self, name=None, shape=(), dtype="float32",
+                   persistable=False, stop_gradient=True, is_data=False):
+        name = name or unique_name.generate("tmp_var")
+        v = Variable(self, name, shape, dtype, persistable, stop_gradient,
+                     is_data)
+        self.vars[name] = v
+        return v
+
+    def append_op(self, op):
+        self.ops.append(op)
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if v.is_parameter]
+
+
+class Program:
+    """ref: framework.py Program."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self._constants: dict[str, jax.Array] = {}
+        self.random_seed = None
+        self._version = 0
+        self._lr_getter = None  # set by build_optimize_ops for schedulers
+
+    @property
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def all_parameters(self):
+        return self.global_block.all_parameters()
+
+    def list_vars(self):
+        return list(self.global_block.vars.values())
+
+    def clone(self, for_test=False):
+        import copy
+
+        p = Program()
+        blk = p.global_block
+        for name, v in self.global_block.vars.items():
+            nv = Variable(blk, name, v.shape, v._data.dtype, v.persistable,
+                          v.stop_gradient, v.is_data)
+            nv.is_parameter = v.is_parameter
+            nv.initializer = v.initializer
+            blk.vars[name] = nv
+        for op in self.global_block.ops:
+            attrs = dict(op.attrs)
+            if for_test and op.type in ("dropout", "dropout_axes", "alpha_dropout"):
+                attrs["p"] = 0.0
+            blk.append_op(Operator(op.type, op.fn, list(op.input_names),
+                                   list(op.output_names), attrs))
+        p._constants = dict(self._constants)
+        p._lr_getter = self._lr_getter
+        return p
+
+    def __str__(self):
+        lines = [f"Program(ops={len(self.global_block.ops)})"]
+        for v in self.global_block.vars.values():
+            tag = "param" if v.is_parameter else ("data" if v.is_data else "tmp")
+            lines.append(f"  var {v.name}: {v.shape} {v._data.dtype} [{tag}]")
+        for op in self.global_block.ops:
+            lines.append(f"  {op!r}")
+        return "\n".join(lines)
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        return str(self)
+
+    def bump(self):
+        self._version += 1
+
+
+# -- defaults / guards ------------------------------------------------------
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    old_main, old_startup = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = old_main, old_startup
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    with unique_name.guard(prefix + "/" if prefix else None):
+        yield
+
+
+# -- scope ------------------------------------------------------------------
+
+
+class Scope:
+    """ref: framework/scope.h — name → concrete array storage."""
+
+    def __init__(self):
+        self._vars: dict[str, jax.Array] = {}
+
+    def var(self, name):
+        return self._vars.get(name)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def drop_kids(self):
+        self._vars.clear()
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    old, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = old
+
+
+# -- data placeholder -------------------------------------------------------
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """ref: fluid.data / static.data."""
+    prog = default_main_program()
+    shape = [1 if s in (-1, None) else s for s in shape]
+    v = prog.global_block.create_var(name=name, shape=shape, dtype=dtype,
+                                     is_data=True, stop_gradient=True)
+    return v
+
+
+# -- the tracer -------------------------------------------------------------
+
+
+class ProgramTracer:
+    """Records dispatch.apply calls into a Program (ref: imperative tracer
+    flipped: here recording happens at build time, execution at run time)."""
+
+    def __init__(self, program):
+        self.program = program
+
+    def _var_of(self, x):
+        blk = self.program.current_block()
+        if isinstance(x, Variable):
+            return x.name
+        if isinstance(x, Tensor):
+            # concrete constant captured into the program
+            name = unique_name.generate("const")
+            v = blk.create_var(name=name, shape=x.shape, dtype=x._data.dtype)
+            self.program._constants[name] = x._data
+            return name
+        if x is None:
+            return None
+        # raw python scalar / ndarray
+        arr = jnp.asarray(x)
+        name = unique_name.generate("const")
+        blk.create_var(name=name, shape=arr.shape, dtype=arr.dtype)
+        self.program._constants[name] = arr
+        return name
+
+    def trace_op(self, name, fn, args, attrs):
+        blk = self.program.current_block()
+        in_names = [self._var_of(a) for a in args]
+        specs = []
+        for a, n in zip(args, in_names):
+            if n is None:
+                specs.append(None)
+            elif isinstance(a, Variable):
+                specs.append(jax.ShapeDtypeStruct(tuple(a._data.shape),
+                                                  a._data.dtype))
+            else:
+                c = self.program._constants[n]
+                specs.append(jax.ShapeDtypeStruct(c.shape, c.dtype))
+        out_shape = jax.eval_shape(functools.partial(fn, **attrs), *specs)
+        multi = isinstance(out_shape, tuple)
+        outs = out_shape if multi else (out_shape,)
+        out_vars = []
+        any_grad = any(isinstance(a, Tensor) and not a.stop_gradient
+                       for a in args)
+        for o in outs:
+            v = blk.create_var(name=unique_name.generate(name + ".out"),
+                               shape=o.shape, dtype=o.dtype,
+                               stop_gradient=not any_grad)
+            out_vars.append(v)
+        blk.append_op(Operator(name, fn, in_names,
+                               [v.name for v in out_vars], attrs))
+        self.program.bump()
+        return tuple(out_vars) if multi else out_vars[0]
+
+    def record_assign(self, target, value):
+        blk = self.program.current_block()
+        vname = self._var_of(value)
+        blk.append_op(Operator("assign_to", lambda x: x, [vname],
+                               [target.name], {}))
+        self.program.bump()

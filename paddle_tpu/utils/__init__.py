@@ -1,0 +1,2 @@
+"""Utility subpackage (ref: python/paddle/fluid/unique_name.py, utils/)."""
+from . import unique_name  # noqa: F401

@@ -6,7 +6,7 @@ lowering paths our Mesh code uses on a real pod.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -16,6 +16,11 @@ import numpy as np
 import pytest
 
 import jax
+
+# The axon sitecustomize force-selects the tunneled TPU backend via
+# jax.config; hard-override back to CPU *before* any backend client is
+# created so the suite never depends on (or competes for) the TPU tunnel.
+jax.config.update("jax_platforms", "cpu")
 
 # test-only: exact f32 matmuls so numerical comparisons vs numpy are tight
 # (the production TPU path keeps the fast default so the MXU runs bf16)

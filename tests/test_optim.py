@@ -1,0 +1,223 @@
+"""Optimizer tests (model: reference tests/unittests/test_optimizer.py,
+test_adam_op.py, test_imperative_optimizer.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.optim as optim
+
+
+def make_problem():
+    """Tiny least-squares problem; every optimizer must reduce the loss."""
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(4, 1).astype("float32")
+    X = rng.randn(64, 4).astype("float32")
+    y = X @ w_true
+    model = nn.Linear(4, 1)
+    return model, pt.to_tensor(X), pt.to_tensor(y)
+
+
+def run_steps(model, X, y, opt, n=20):
+    losses = []
+    for _ in range(n):
+        loss = nn.functional.mse_loss(model(X), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("factory", [
+    lambda p: optim.SGD(0.1, parameters=p),
+    lambda p: optim.Momentum(0.05, momentum=0.9, parameters=p),
+    lambda p: optim.Momentum(0.05, momentum=0.9, use_nesterov=True, parameters=p),
+    lambda p: optim.Adagrad(0.5, parameters=p),
+    lambda p: optim.Adadelta(5.0, parameters=p),
+    lambda p: optim.RMSProp(0.05, parameters=p),
+    lambda p: optim.RMSProp(0.05, centered=True, momentum=0.5, parameters=p),
+    lambda p: optim.Adam(0.1, parameters=p),
+    lambda p: optim.AdamW(0.1, weight_decay=0.01, parameters=p),
+    lambda p: optim.Adamax(0.1, parameters=p),
+    lambda p: optim.Lamb(0.1, parameters=p),
+    lambda p: optim.Ftrl(0.5, parameters=p),
+], ids=["sgd", "momentum", "nesterov", "adagrad", "adadelta", "rmsprop",
+        "rmsprop_centered", "adam", "adamw", "adamax", "lamb", "ftrl"])
+def test_optimizer_decreases_loss(factory):
+    model, X, y = make_problem()
+    opt = factory(model.parameters())
+    losses = run_steps(model, X, y, opt)
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_sgd_matches_manual():
+    model, X, y = make_problem()
+    w0 = model.weight.numpy().copy()
+    opt = optim.SGD(0.1, parameters=model.parameters())
+    loss = nn.functional.mse_loss(model(X), y)
+    loss.backward()
+    g = model.weight.grad.numpy()
+    opt.step()
+    np.testing.assert_allclose(model.weight.numpy(), w0 - 0.1 * g, rtol=1e-5)
+
+
+def test_adam_bias_correction_first_step():
+    model, X, y = make_problem()
+    w0 = model.weight.numpy().copy()
+    opt = optim.Adam(0.01, parameters=model.parameters())
+    loss = nn.functional.mse_loss(model(X), y)
+    loss.backward()
+    g = model.weight.grad.numpy()
+    opt.step()
+    # after bias correction the first step is lr * g/(|g| + eps) ~ lr*sign(g)
+    step = w0 - model.weight.numpy()
+    np.testing.assert_allclose(step, 0.01 * g / (np.abs(g) + 1e-8), rtol=1e-3)
+
+
+def test_weight_decay_coupled():
+    m = nn.Linear(3, 3, bias_attr=False)
+    opt = optim.SGD(0.1, parameters=m.parameters(), weight_decay=0.5)
+    x = pt.to_tensor(np.zeros((2, 3), "float32"))
+    loss = pt.mean(m(x))  # zero grad wrt weight
+    loss.backward()
+    w0 = m.weight.numpy().copy()
+    opt.step()
+    np.testing.assert_allclose(m.weight.numpy(), w0 - 0.1 * 0.5 * w0, rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    m = nn.Linear(4, 4)
+    clip = optim.ClipGradByGlobalNorm(0.1)
+    opt = optim.SGD(1.0, parameters=m.parameters(), grad_clip=clip)
+    x = pt.to_tensor(np.random.randn(8, 4).astype("float32") * 100)
+    loss = pt.mean(m(x) ** 2)
+    loss.backward()
+    w0 = m.weight.numpy().copy()
+    opt.step()
+    # total applied step must have norm <= lr * clip_norm (plus bias part)
+    delta = np.linalg.norm(m.weight.numpy() - w0)
+    assert delta <= 0.1 + 1e-5
+
+
+def test_clip_by_value():
+    clip = optim.ClipGradByValue(0.5)
+    import jax.numpy as jnp
+
+    out = clip([(None, jnp.asarray(np.array([-2.0, 0.2, 3.0], "float32")))])
+    np.testing.assert_allclose(np.asarray(out[0][1]), [-0.5, 0.2, 0.5])
+
+
+def test_lr_scheduler_with_optimizer():
+    model, X, y = make_problem()
+    sched = optim.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    opt = optim.SGD(sched, parameters=model.parameters())
+    assert opt.get_lr() == 0.1
+    sched.step()
+    sched.step()
+    assert np.isclose(opt.get_lr(), 0.05)
+
+
+@pytest.mark.parametrize("sched,checks", [
+    (lambda: optim.lr.ExponentialDecay(1.0, 0.5), [(0, 1.0), (1, 0.5), (2, 0.25)]),
+    (lambda: optim.lr.PiecewiseDecay([2, 4], [1.0, 0.5, 0.1]),
+     [(0, 1.0), (2, 0.5), (4, 0.1)]),
+    (lambda: optim.lr.PolynomialDecay(1.0, 10, end_lr=0.0, power=1.0),
+     [(0, 1.0), (5, 0.5), (10, 0.0)]),
+    (lambda: optim.lr.CosineAnnealingDecay(1.0, 10),
+     [(0, 1.0), (10, 0.0)]),
+    (lambda: optim.lr.StepDecay(1.0, 3, 0.1), [(0, 1.0), (3, 0.1), (6, 0.01)]),
+    (lambda: optim.lr.MultiStepDecay(1.0, [2, 5], 0.1),
+     [(0, 1.0), (2, 0.1), (5, 0.01)]),
+    (lambda: optim.lr.LambdaDecay(2.0, lambda e: 1.0 / (e + 1)),
+     [(0, 2.0), (1, 1.0), (3, 0.5)]),
+], ids=["exp", "piecewise", "poly", "cosine", "step", "multistep", "lambda"])
+def test_scheduler_values(sched, checks):
+    s = sched()
+    for epoch, want in checks:
+        s.step(epoch)
+        assert np.isclose(s(), want, atol=1e-7), (epoch, s(), want)
+
+
+def test_linear_warmup():
+    s = optim.lr.LinearWarmup(0.5, warmup_steps=10, start_lr=0.0, end_lr=0.5)
+    s.step(0)
+    assert np.isclose(s(), 0.0)
+    s.step(5)
+    assert np.isclose(s(), 0.25)
+    s.step(15)
+    assert np.isclose(s(), 0.5)
+
+
+def test_noam():
+    s = optim.lr.NoamDecay(d_model=512, warmup_steps=100, learning_rate=1.0)
+    s.step(50)
+    lr_warm = s()
+    s.step(100)
+    lr_peak = s()
+    s.step(10000)
+    assert s() < lr_peak and lr_warm < lr_peak
+
+
+def test_reduce_on_plateau():
+    s = optim.lr.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+    s.step(metrics=1.0)
+    s.step(metrics=1.0)
+    s.step(metrics=1.0)
+    assert s() == 0.5
+
+
+def test_optimizer_state_roundtrip():
+    model, X, y = make_problem()
+    opt = optim.Adam(0.1, parameters=model.parameters())
+    run_steps(model, X, y, opt, n=3)
+    state = opt.state_dict()
+
+    model2, _, _ = make_problem()
+    model2.set_state_dict(model.state_dict())
+    opt2 = optim.Adam(0.1, parameters=model2.parameters())
+    # rename keys to match model2's parameter names
+    names1 = [p.name for p in model.parameters()]
+    names2 = [p.name for p in model2.parameters()]
+    remap = {}
+    for k, v in state.items():
+        if k.startswith("@"):
+            remap[k] = v
+            continue
+        pname, slot = k.rsplit(".", 1)
+        remap[f"{names2[names1.index(pname)]}.{slot}"] = v
+    opt2.set_state_dict(remap)
+    l1 = run_steps(model, X, y, opt, n=2)
+    l2 = run_steps(model2, X, y, opt2, n=2)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_ema():
+    m = nn.Linear(2, 2)
+    ema = optim.ExponentialMovingAverage(m, decay=0.5)
+    w0 = m.weight.numpy().copy()
+    m.weight.set_value(w0 + 1.0)
+    ema.update()
+    ema.apply()
+    assert not np.allclose(m.weight.numpy(), w0 + 1.0)
+    ema.restore()
+    np.testing.assert_allclose(m.weight.numpy(), w0 + 1.0)
+
+
+def test_lookahead():
+    model, X, y = make_problem()
+    inner = optim.SGD(0.1, parameters=model.parameters())
+    opt = optim.LookAhead(inner, alpha=0.5, k=2)
+    losses = run_steps(model, X, y, opt, n=10)
+    assert losses[-1] < losses[0]
+
+
+def test_minimize():
+    model, X, y = make_problem()
+    opt = optim.SGD(0.1, parameters=model.parameters())
+    l0 = float(nn.functional.mse_loss(model(X), y))
+    for _ in range(5):
+        loss = nn.functional.mse_loss(model(X), y)
+        opt.minimize(loss)
+        opt.clear_grad()
+    assert float(nn.functional.mse_loss(model(X), y)) < l0
