@@ -78,6 +78,12 @@ from .ops.control_flow import cond, while_loop, case, switch_case, scan
 from . import nn
 from . import optim
 from . import static_ as static
+from . import framework
+from . import io_ as io
+from . import runtime
+from .framework import jit as _jit_mod
+from .framework.jit import jit, to_static, TrainStep
+from .framework.io import save, load
 from .static_ import enable_static, disable_static
 from .static_.program import program_guard, global_scope
 

@@ -23,10 +23,29 @@ def get_seed() -> int:
 
 
 def next_key():
-    """A fresh subkey. Stateful: only for eager use (not inside jit traces)."""
+    """A fresh subkey. Host-stateful in eager mode; inside a key_context
+    (e.g. a paddle_tpu.jit traced step) it splits from the threaded traced
+    key instead, so stochastic ops vary per step under one compilation."""
+    if _STATE.get("ctx") is not None:
+        _STATE["ctx"], sub = jax.random.split(_STATE["ctx"])
+        return sub
     k = jax.random.fold_in(jax.random.PRNGKey(_STATE["seed"]), _STATE["count"])
     _STATE["count"] += 1
     return k
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def key_context(key):
+    """Thread a (possibly traced) key through stochastic ops."""
+    prev = _STATE.get("ctx")
+    _STATE["ctx"] = key
+    try:
+        yield
+    finally:
+        _STATE["ctx"] = prev
 
 
 def key_for(*, salt: int = 0):

@@ -102,7 +102,13 @@ class Tensor:
     def set_value(self, value):
         if isinstance(value, Tensor):
             value = value._data
-        self._data = jnp.asarray(value, dtype=self._data.dtype).reshape(self._data.shape)
+        arr = jnp.asarray(value, dtype=self._data.dtype).reshape(self._data.shape)
+        if arr is value:
+            # force a copy: aliasing the source buffer would let a later
+            # donated-buffer step (TrainStep/Executor) delete it from under
+            # the source tensor (reference set_value copies too)
+            arr = jnp.array(arr, copy=True)
+        self._data = arr
 
     def copy_(self, other):
         self.set_value(other)

@@ -1,0 +1,7 @@
+"""paddle_tpu.framework — jit train-step fusion, io, trainer utilities."""
+from .jit import jit, to_static, TrainStep, no_jit  # noqa: F401
+from . import io  # noqa: F401
+from .io import (  # noqa: F401
+    save, load, save_inference_model, load_inference_model,
+    save_checkpoint, load_checkpoint,
+)

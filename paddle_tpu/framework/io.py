@@ -1,0 +1,205 @@
+"""Serialization: save/load, inference models, train checkpoints.
+
+Refs: python/paddle/fluid/io.py (save/load_params,
+save/load_inference_model), python/paddle/framework/io.py (paddle.save /
+paddle.load), fluid/dygraph/checkpoint.py.
+
+Formats are TPU-native rather than protobuf: state dicts go to ``.npz``
+(zero-copy into jax arrays), programs to pickle of (op type, var names,
+attrs) — kernels are reconstructed from the op registry by name, so a saved
+inference program replays into the same single fused XLA executable.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "save", "load", "save_inference_model", "load_inference_model",
+    "save_checkpoint", "load_checkpoint",
+]
+
+
+def _to_numpy_tree(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._data)
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy_tree(v) for v in obj)
+    if hasattr(obj, "shape") and hasattr(obj, "dtype"):  # jax array
+        return np.asarray(obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    """ref: paddle.save — state_dicts and nested containers of tensors."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False):
+    """ref: paddle.load."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        return obj
+    return obj  # set_state_dict accepts numpy directly
+
+
+# -- inference model --------------------------------------------------------
+
+
+def _forward_slice(program, feed_names, fetch_names):
+    """Ops needed to compute fetches from feeds, excluding grad/opt ops
+    (ref: prune() in framework.py)."""
+    needed = set(fetch_names)
+    ops = []
+    for op in reversed(program.global_block.ops):
+        if op.type.endswith("@grad") or op.type.startswith("optimize_") or \
+                op.type in ("fill_ones_like", "fill_zeros_like",
+                            "grad_accumulate", "grad_clip"):
+            continue
+        if any(o in needed for o in op.output_names):
+            ops.append(op)
+            needed.update(n for n in op.input_names if n is not None)
+    return list(reversed(ops)), needed
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """ref: fluid.io.save_inference_model. Writes <prefix>.pdmodel (program
+    pickle) + <prefix>.pdiparams (weights npz)."""
+    from ..static_.program import default_main_program, global_scope
+
+    program = program or default_main_program()
+    feed_names = [v if isinstance(v, str) else v.name for v in feed_vars]
+    fetch_names = [v if isinstance(v, str) else v.name for v in fetch_vars]
+    ops, needed = _forward_slice(program, feed_names, fetch_names)
+
+    scope = global_scope()
+    weights, consts = {}, {}
+    for name in needed:
+        blk = program.global_block
+        if name in program._constants:
+            consts[name] = np.asarray(program._constants[name])
+        elif blk.has_var(name) and blk.var(name).persistable:
+            arr = scope.find_var(name)
+            if arr is not None:
+                weights[name] = np.asarray(arr)
+
+    desc = {
+        "feed_names": feed_names,
+        "fetch_names": fetch_names,
+        "ops": [(op.type, list(op.input_names), list(op.output_names),
+                 op.attrs) for op in ops],
+        "vars": {v.name: (list(v.shape), str(np.dtype(v._data.dtype)))
+                 for v in program.global_block.vars.values()},
+    }
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(desc, f, protocol=4)
+    np.savez(path_prefix + ".pdiparams", __consts__=np.array(list(consts)),
+             **{("c!" + k): v for k, v in consts.items()},
+             **{("w!" + k): v for k, v in weights.items()})
+    return feed_names
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """ref: fluid.io.load_inference_model → (program, feed_names,
+    fetch_names); weights land in the global scope."""
+    from ..ops._base import OP_REGISTRY
+    from ..static_.program import Program, Operator, global_scope
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        desc = pickle.load(f)
+    data = np.load(path_prefix + ".pdiparams.npz"
+                   if os.path.exists(path_prefix + ".pdiparams.npz")
+                   else path_prefix + ".pdiparams")
+
+    program = Program()
+    blk = program.global_block
+    for name, (shape, dtype) in desc["vars"].items():
+        v = blk.create_var(name=name, shape=shape, dtype=dtype)
+        if any(k == "w!" + name for k in data.files):
+            v.persistable = True
+    scope = global_scope()
+    for k in data.files:
+        if k.startswith("w!"):
+            scope.set(k[2:], jnp.asarray(data[k]))
+        elif k.startswith("c!"):
+            program._constants[k[2:]] = jnp.asarray(data[k])
+    for type_, in_names, out_names, attrs in desc["ops"]:
+        if type_ not in OP_REGISTRY:
+            raise ValueError(
+                f"op '{type_}' not in kernel registry; model saved by an "
+                "incompatible version")
+        blk.append_op(Operator(type_, OP_REGISTRY[type_], in_names,
+                               out_names, attrs))
+    program.bump()
+    return program, desc["feed_names"], desc["fetch_names"]
+
+
+# -- training checkpoints (ref: fluid incubate checkpoint + SURVEY §2 #45) --
+
+
+def save_checkpoint(directory, step, model=None, optimizer=None,
+                    scheduler=None, keep_last=3, extra=None):
+    """Atomic checkpoint with keep-last-k rotation and resume metadata."""
+    os.makedirs(directory, exist_ok=True)
+    tmp = os.path.join(directory, f".tmp_ckpt_{step}")
+    final = os.path.join(directory, f"ckpt_{step}")
+    os.makedirs(tmp, exist_ok=True)
+    state = {"step": int(step), "extra": extra or {}}
+    if model is not None:
+        save({k: v for k, v in model.state_dict().items()},
+             os.path.join(tmp, "model.pdparams"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(tmp, "opt.pdopt"))
+    if scheduler is not None:
+        state["scheduler"] = scheduler.state_dict()
+    save(state, os.path.join(tmp, "meta.pkl"))
+    if os.path.exists(final):
+        import shutil
+
+        shutil.rmtree(final)
+    os.replace(tmp, final)  # atomic publish: readers never see partial state
+    # rotate
+    ckpts = sorted((d for d in os.listdir(directory) if d.startswith("ckpt_")),
+                   key=lambda d: int(d.split("_")[1]))
+    for old in ckpts[:-keep_last]:
+        import shutil
+
+        shutil.rmtree(os.path.join(directory, old))
+    return final
+
+
+def load_checkpoint(directory, model=None, optimizer=None, scheduler=None,
+                    step=None):
+    """Load latest (or given) checkpoint; returns resume step or None."""
+    if not os.path.isdir(directory):
+        return None
+    ckpts = sorted((d for d in os.listdir(directory) if d.startswith("ckpt_")),
+                   key=lambda d: int(d.split("_")[1]))
+    if not ckpts:
+        return None
+    name = f"ckpt_{step}" if step is not None else ckpts[-1]
+    path = os.path.join(directory, name)
+    meta = load(os.path.join(path, "meta.pkl"))
+    if model is not None:
+        model.set_state_dict(load(os.path.join(path, "model.pdparams")))
+    if optimizer is not None and os.path.exists(os.path.join(path, "opt.pdopt")):
+        optimizer.set_state_dict(load(os.path.join(path, "opt.pdopt")))
+    if scheduler is not None and "scheduler" in meta:
+        scheduler.set_state_dict(meta["scheduler"])
+    return meta["step"]

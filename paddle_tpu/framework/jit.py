@@ -1,0 +1,234 @@
+"""Fused train/eval steps: the dygraph perf path.
+
+TPU-native analog of the reference's CompiledProgram / ParallelExecutor
+speedups for imperative code (and of paddle.jit.to_static,
+python/paddle/fluid/dygraph/jit.py): a Python step function written against
+eager Layers is traced ONCE into a pure jax function over the pytree of
+(params, optimizer state, buffers, rng key, batch) and compiled with
+``jax.jit`` — forward, backward, grad clip, and the optimizer update all
+fuse into a single donated-buffer XLA executable. Per-step Python cost is
+one dictionary of array handles; the reference pays per-op kernel launches.
+
+Mechanism: Parameters/buffers are temporarily rebound to tracers while the
+user's eager code runs under the trace (the same swap trick the fused RNN
+runner uses), so arbitrary Layer code works unmodified, including
+``loss.backward()`` — the eager tape walk is jax-traceable by design
+(core/autograd.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core import random as prandom
+from ..core.tensor import Tensor, Parameter
+
+__all__ = ["jit", "to_static", "TrainStep", "no_jit"]
+
+
+@contextlib.contextmanager
+def _rebind(tensors, arrays):
+    old = [t._data for t in tensors]
+    for t, a in zip(tensors, arrays):
+        t._data = a
+    try:
+        yield
+    finally:
+        for t, o in zip(tensors, old):
+            t._data = o
+
+
+def _collect_state(models):
+    """All Parameters and Buffers reachable from the given layers."""
+    params, buffers = [], []
+    seen = set()
+    for m in models:
+        for _, p in m.named_parameters():
+            if id(p) not in seen:
+                seen.add(id(p))
+                params.append(p)
+        for _, b in m.named_buffers():
+            if id(b) not in seen and b is not None:
+                seen.add(id(b))
+                buffers.append(b)
+    return params, buffers
+
+
+class TrainStep:
+    """One fused (forward + backward + clip + update) step.
+
+    >>> step = TrainStep(model, optimizer, loss_fn)
+    >>> loss = step(x, y)            # compiled on first call per shape
+
+    ``loss_fn(model, *batch)`` must return a scalar loss Tensor. Extra
+    models (e.g. a frozen teacher) can be passed via ``models=[...]``.
+    """
+
+    def __init__(self, model, optimizer, loss_fn, models=None, donate=True):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self._models = list(models) if models is not None else [model]
+        if model not in self._models:
+            self._models.insert(0, model)
+        self._params, self._buffers = _collect_state(self._models)
+        self._trainable = [p for p in self._params
+                           if isinstance(p, Parameter) and p.trainable]
+        self._donate = donate
+        self._compiled = {}
+        # materialize optimizer slots eagerly so they join the carried state
+        for p in self._trainable:
+            optimizer._state_for(p)
+
+    # -- the pure function --------------------------------------------------
+    def _make_pure(self):
+        opt = self.optimizer
+        buffers = self._buffers
+        trainable = self._trainable
+        t_names = [p.name for p in trainable]
+
+        def pure(param_arrs, buf_arrs, opt_state, lr, key, batch):
+            # only TRAINABLE params are threaded as jit arguments; frozen
+            # params stay bound to their concrete arrays and become XLA
+            # constants in the compiled step
+            with _rebind(trainable, list(param_arrs)), \
+                    _rebind(buffers, list(buf_arrs)), \
+                    prandom.key_context(key), \
+                    dispatch.fresh_tape():
+                ts = [Tensor(a, _internal=True) for a in batch]
+                loss = self.loss_fn(self.model, *ts)
+                for p in trainable:
+                    p.grad = None
+                loss.backward()
+                grads = {p.name: (p.grad._data if p.grad is not None else None)
+                         for p in trainable}
+                new_bufs = [b._data for b in buffers]
+                loss_val = loss._data
+
+            pgs = [(p, grads[p.name]) for p in trainable
+                   if grads[p.name] is not None]
+            if opt._grad_clip is not None:
+                pgs = opt._grad_clip(pgs)
+            new_params = dict(zip(t_names, param_arrs))
+            new_state = dict(opt_state)
+            for p, g in pgs:
+                reg = p.regularizer if p.regularizer is not None \
+                    else opt._regularization
+                from ..optim.optimizer import AdamW
+
+                s = opt_state[p.name]
+                master = s.get("master")  # multi_precision fp32 copy
+                pw = master if master is not None else new_params[p.name]
+                if reg is not None and not isinstance(opt, AdamW):
+                    g = reg(pw, g)
+                plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+                opt._current_param = p
+                np_, ns_ = opt._update(pw, g.astype(pw.dtype), s, plr)
+                if master is not None:
+                    ns_ = {**ns_, "master": np_}
+                    np_ = np_.astype(new_params[p.name].dtype)
+                new_params[p.name] = np_
+                new_state[p.name] = ns_
+            return loss_val, [new_params[n] for n in t_names], new_bufs, \
+                {n: new_state[n] for n in t_names}
+
+        return pure
+
+    def __call__(self, *batch):
+        arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(np.asarray(b))
+                  for b in batch]
+        sig = tuple((a.shape, str(a.dtype)) for a in arrays)
+        if sig not in self._compiled:
+            pure = self._make_pure()
+            donate = (0, 1, 2) if self._donate else ()
+            self._compiled[sig] = jax.jit(pure, donate_argnums=donate)
+        fn = self._compiled[sig]
+        opt = self.optimizer
+        opt_state = {p.name: opt._accumulators[p.name] for p in self._trainable}
+        param_arrs = [p._data for p in self._trainable]
+        buf_arrs = [b._data for b in self._buffers]
+        lr = jnp.float32(opt.get_lr())
+        key = prandom.next_key()
+        loss, new_params, new_bufs, new_state = fn(
+            param_arrs, buf_arrs, opt_state, lr, key, arrays)
+        for p, a in zip(self._trainable, new_params):
+            p._data = a
+        for b, a in zip(self._buffers, new_bufs):
+            b._data = a
+        for n, s in new_state.items():
+            opt._accumulators[n] = s
+        opt._global_step += 1
+        return Tensor(loss, _internal=True)
+
+
+class StaticFunction:
+    """jit-compiled forward wrapper (ref: dygraph/jit.py StaticFunction)."""
+
+    def __init__(self, fn, model=None, train=False):
+        self._fn = fn
+        self._model = model
+        self._train = train
+        self._compiled = {}
+        if model is not None:
+            self._params, self._buffers = _collect_state([model])
+        else:
+            self._params, self._buffers = [], []
+
+    def __call__(self, *args):
+        arrays = [a._data if isinstance(a, Tensor)
+                  else jnp.asarray(np.asarray(a)) for a in args]
+        sig = tuple((a.shape, str(a.dtype)) for a in arrays)
+        if sig not in self._compiled:
+            params, buffers = self._params, self._buffers
+
+            def pure(param_arrs, buf_arrs, key, xs):
+                with _rebind(params, list(param_arrs)), \
+                        _rebind(buffers, list(buf_arrs)), \
+                        prandom.key_context(key), \
+                        dispatch.no_grad(), dispatch.fresh_tape():
+                    ts = [Tensor(a, _internal=True) for a in xs]
+                    out = self._fn(*ts) if self._model is None \
+                        else self._fn(self._model, *ts)
+                    return jax.tree_util.tree_map(
+                        lambda t: t._data if isinstance(t, Tensor) else t, out,
+                        is_leaf=lambda t: isinstance(t, Tensor))
+
+            self._compiled[sig] = jax.jit(pure)
+        param_arrs = [p._data for p in self._params]
+        buf_arrs = [b._data for b in self._buffers]
+        out = self._compiled[sig](param_arrs, buf_arrs, prandom.next_key(),
+                                  arrays)
+        return jax.tree_util.tree_map(
+            lambda a: Tensor(a, _internal=True) if isinstance(a, jax.Array) else a,
+            out)
+
+
+def to_static(layer_or_fn=None, input_spec=None, **kwargs):
+    """ref: paddle.jit.to_static. Wraps a Layer (its forward) or a function
+    into a shape-cached jax.jit callable."""
+    from ..nn.layer import Layer
+
+    def wrap(obj):
+        if isinstance(obj, Layer):
+            sf = StaticFunction(lambda m, *xs: m(*xs), model=obj)
+            obj._static_forward = sf
+            return sf
+        return StaticFunction(obj)
+
+    if layer_or_fn is None:
+        return wrap
+    return wrap(layer_or_fn)
+
+
+def jit(fn=None, **kwargs):
+    """Decorator alias: ``@paddle_tpu.jit`` compiles an eager function."""
+    return to_static(fn, **kwargs)
+
+
+_no_jit = contextlib.nullcontext
+no_jit = _no_jit

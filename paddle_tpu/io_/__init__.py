@@ -1,0 +1,16 @@
+"""paddle_tpu.io — data pipeline (datasets, samplers, DataLoader, readers).
+
+Mirrors ``paddle.io`` + fluid's reader stack; prefetch is backed by the
+native C++ ring buffer in paddle_tpu/runtime.
+"""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    ConcatDataset, Subset, random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, default_convert_fn  # noqa: F401
+from . import reader  # noqa: F401
+from .reader import DataFeeder  # noqa: F401

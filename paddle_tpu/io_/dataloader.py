@@ -1,0 +1,192 @@
+"""DataLoader with native-ring prefetch.
+
+Ref: python/paddle/fluid/reader.py (DataLoader, py_reader) +
+paddle/fluid/operators/reader/buffered_reader.cc.
+
+Worker threads fetch+collate batches (numpy work releases the GIL) and push
+pickled batches into the C++ ring buffer (runtime/); the train loop pops
+ready batches — host input prep overlaps device compute, which is the whole
+game for keeping the TPU fed. Threads, not processes: batch assembly is
+numpy-bound, and jax arrays must be created in the consumer process anyway.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn", "default_convert_fn"]
+
+
+def default_convert_fn(batch):
+    return batch
+
+
+def default_collate_fn(batch):
+    """Stack a list of samples into batch arrays (ref: default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if hasattr(sample, "_data"):  # Tensor
+        return np.stack([np.asarray(s._data) for s in batch])
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn(list(f)) for f in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    return np.asarray(batch)
+
+
+class _Prefetcher:
+    """N worker threads -> native ring buffer -> ordered reassembly."""
+
+    def __init__(self, work_iter, fetch, num_workers, capacity):
+        from ..runtime import RingBuffer
+
+        self._ring = RingBuffer(capacity)
+        self._work = list(work_iter)
+        self._fetch = fetch
+        self._next_out = 0
+        self._stash = {}
+        self._cursor = 0
+        self._cursor_lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True)
+            for _ in range(num_workers)]
+        self._active = len(self._threads)
+        self._active_lock = threading.Lock()
+        for t in self._threads:
+            t.start()
+
+    def _worker(self):
+        while True:
+            with self._cursor_lock:
+                i = self._cursor
+                self._cursor += 1
+            if i >= len(self._work):
+                break
+            try:
+                batch = self._fetch(self._work[i])
+                payload = pickle.dumps((i, batch), protocol=5)
+            except Exception as e:  # surface errors in the consumer
+                payload = pickle.dumps((i, e), protocol=5)
+            if not self._ring.push(payload):
+                return
+        with self._active_lock:
+            self._active -= 1
+            if self._active == 0:
+                self._ring.close()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            if self._next_out in self._stash:
+                item = self._stash.pop(self._next_out)
+                self._next_out += 1
+                if isinstance(item, Exception):
+                    raise item
+                return item
+            blob = self._ring.pop()
+            if blob is None:
+                if self._next_out in self._stash:
+                    continue
+                raise StopIteration
+            i, batch = pickle.loads(blob)
+            self._stash[i] = batch  # restore deterministic batch order
+
+    def shutdown(self):
+        self._ring.close()
+
+
+class DataLoader:
+    """ref: paddle.io.DataLoader."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=False, timeout=0, worker_init_fn=None):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 2)
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset=dataset, shuffle=shuffle,
+                batch_size=batch_size, drop_last=drop_last) \
+                if batch_size is not None else None
+            self.batch_size = batch_size
+
+    def _fetch_batch(self, indices):
+        samples = [self.dataset[i] for i in indices]
+        return self.collate_fn(samples)
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if self.batch_size is not None and len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not getattr(self, "drop_last", False):
+            yield self.collate_fn(batch)
+
+    def __iter__(self):
+        from ..core.tensor import Tensor
+
+        def to_tensors(b):
+            if not self.return_list:
+                return b
+            if isinstance(b, (list, tuple)):
+                return [Tensor(np.asarray(x), _internal=False)
+                        if isinstance(x, np.ndarray) else x for x in b]
+            if isinstance(b, np.ndarray):
+                return [Tensor(b, _internal=False)]
+            return b
+
+        if self._iterable_mode:
+            for b in self._iter_iterable():
+                yield to_tensors(b)
+            return
+        if self.batch_sampler is None:  # no batching: raw samples
+            for i in range(len(self.dataset)):
+                yield to_tensors(self.dataset[i])
+            return
+        if self.num_workers <= 0:
+            for indices in self.batch_sampler:
+                yield to_tensors(self._fetch_batch(indices))
+            return
+        pf = _Prefetcher(self.batch_sampler, self._fetch_batch,
+                         self.num_workers,
+                         capacity=self.num_workers * self.prefetch_factor)
+        try:
+            for b in pf:
+                yield to_tensors(b)
+        finally:
+            pf.shutdown()
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)

@@ -1,0 +1,187 @@
+"""Fluid-style reader decorators + DataFeeder.
+
+Ref: python/paddle/reader/decorator.py (batch/shuffle/map_readers/
+xmap_readers/...) and python/paddle/fluid/data_feeder.py.
+"""
+from __future__ import annotations
+
+import itertools
+import random as pyrandom
+import threading
+
+import numpy as np
+
+__all__ = [
+    "batch", "shuffle", "buffered", "map_readers", "xmap_readers", "chain",
+    "compose", "firstn", "cache", "DataFeeder",
+]
+
+
+def batch(reader, batch_size, drop_last=False):
+    def gen():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return gen
+
+
+def shuffle(reader, buf_size):
+    def gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                pyrandom.shuffle(buf)
+                yield from buf
+                buf = []
+        pyrandom.shuffle(buf)
+        yield from buf
+
+    return gen
+
+
+def buffered(reader, size):
+    """Prefetch through the native ring buffer."""
+
+    def gen():
+        from ..runtime import RingBuffer
+        import pickle
+
+        ring = RingBuffer(size)
+
+        def producer():
+            try:
+                for item in reader():
+                    if not ring.push(pickle.dumps(item, protocol=5)):
+                        return
+            finally:
+                ring.close()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            blob = ring.pop()
+            if blob is None:
+                break
+            yield pickle.loads(blob)
+
+    return gen
+
+
+def map_readers(func, *readers):
+    def gen():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            yield func(*items)
+
+    return gen
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker threads (ref: xmap_readers)."""
+
+    def gen():
+        import queue
+
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+        END = object()
+
+        def feed():
+            for i, item in enumerate(reader()):
+                in_q.put((i, item))
+            for _ in range(process_num):
+                in_q.put(END)
+
+        def work():
+            while True:
+                got = in_q.get()
+                if got is END:
+                    out_q.put(END)
+                    return
+                i, item = got
+                out_q.put((i, mapper(item)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+        done = 0
+        stash, nxt = {}, 0
+        while done < process_num:
+            got = out_q.get()
+            if got is END:
+                done += 1
+                continue
+            i, item = got
+            if not order:
+                yield item
+            else:
+                stash[i] = item
+                while nxt in stash:
+                    yield stash.pop(nxt)
+                    nxt += 1
+        if order:
+            for i in sorted(stash):
+                yield stash[i]
+
+    return gen
+
+
+def chain(*readers):
+    def gen():
+        for r in readers:
+            yield from r()
+
+    return gen
+
+
+def compose(*readers, check_alignment=True):
+    def gen():
+        for items in zip(*[r() for r in readers]):
+            out = []
+            for it in items:
+                out.extend(it if isinstance(it, tuple) else (it,))
+            yield tuple(out)
+
+    return gen
+
+
+def firstn(reader, n):
+    def gen():
+        return itertools.islice(reader(), n)
+
+    return gen
+
+
+def cache(reader):
+    data = []
+    filled = [False]
+
+    def gen():
+        if not filled[0]:
+            data.extend(reader())
+            filled[0] = True
+        yield from data
+
+    return gen
+
+
+class DataFeeder:
+    """Convert reader items into an Executor feed dict (ref: data_feeder.py)."""
+
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_names = [v if isinstance(v, str) else v.name
+                           for v in feed_list]
+
+    def feed(self, iterable):
+        cols = list(zip(*iterable))
+        out = {}
+        for name, col in zip(self.feed_names, cols):
+            out[name] = np.stack([np.asarray(c) for c in col])
+        return out

@@ -285,25 +285,22 @@ class Layer:
                    structured_name_prefix="", use_hook=True):
         if destination is None:
             destination = collections.OrderedDict()
-        for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip("."),
-                                             include_sublayers=include_sublayers):
-            destination[name] = p
-        for name, b in self.named_buffers(prefix=structured_name_prefix.rstrip("."),
-                                          include_sublayers=include_sublayers):
-            layer, leaf = self._locate(name)
-            if layer is not None and leaf in layer._non_persistable_buffer_names:
+        prefix = structured_name_prefix
+        if prefix and not prefix.endswith("."):
+            prefix += "."
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            destination[prefix + name] = p
+        # non-persistable buffers are excluded; collect their UNPREFIXED
+        # names first so an external prefix can't defeat the lookup
+        skip = set()
+        for lp, layer in self.named_sublayers(include_self=True):
+            for bname in layer._non_persistable_buffer_names:
+                skip.add(lp + ("." if lp else "") + bname)
+        for name, b in self.named_buffers(include_sublayers=include_sublayers):
+            if name in skip:
                 continue
-            destination[name] = b
+            destination[prefix + name] = b
         return destination
-
-    def _locate(self, dotted):
-        parts = dotted.split(".")
-        layer = self
-        for p in parts[:-1]:
-            layer = layer._sub_layers.get(p)
-            if layer is None:
-                return None, parts[-1]
-        return layer, parts[-1]
 
     def set_state_dict(self, state_dict, use_structured_name=True):
         missing, unexpected = [], []

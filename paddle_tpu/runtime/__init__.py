@@ -1,0 +1,263 @@
+"""Native host runtime bindings (ctypes over libptruntime.so).
+
+See cc/ptruntime.cc for what each piece replaces in the reference. The
+library is compiled on first use with the baked g++ toolchain and cached
+next to the source; a pure-Python fallback keeps the pipeline functional if
+no compiler is available.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(__file__)
+_SO = os.path.join(_HERE, "libptruntime.so")
+_SRC = os.path.join(_HERE, "cc", "ptruntime.cc")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build():
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def get_lib():
+    """Load (building if needed) the native runtime; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or \
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except Exception:
+            return None
+        # signatures
+        lib.pt_arena_new.restype = ctypes.c_void_p
+        lib.pt_arena_new.argtypes = [ctypes.c_size_t]
+        lib.pt_arena_alloc.restype = ctypes.c_void_p
+        lib.pt_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+        lib.pt_arena_reset.argtypes = [ctypes.c_void_p]
+        lib.pt_arena_free.argtypes = [ctypes.c_void_p]
+        lib.pt_arena_stats.argtypes = [ctypes.c_void_p] + \
+            [ctypes.POINTER(ctypes.c_uint64)] * 4
+        lib.pt_ring_new.restype = ctypes.c_void_p
+        lib.pt_ring_new.argtypes = [ctypes.c_size_t]
+        lib.pt_ring_push.restype = ctypes.c_int
+        lib.pt_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_size_t]
+        lib.pt_ring_pop.restype = ctypes.c_int
+        lib.pt_ring_pop.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_void_p),
+                                    ctypes.POINTER(ctypes.c_size_t),
+                                    ctypes.c_long]
+        lib.pt_blob_free.argtypes = [ctypes.c_void_p]
+        lib.pt_ring_close.argtypes = [ctypes.c_void_p]
+        lib.pt_ring_len.restype = ctypes.c_size_t
+        lib.pt_ring_len.argtypes = [ctypes.c_void_p]
+        lib.pt_ring_free.argtypes = [ctypes.c_void_p]
+        lib.pt_rec_writer_open.restype = ctypes.c_void_p
+        lib.pt_rec_writer_open.argtypes = [ctypes.c_char_p]
+        lib.pt_rec_write.restype = ctypes.c_int
+        lib.pt_rec_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint32]
+        lib.pt_rec_writer_close.restype = ctypes.c_uint64
+        lib.pt_rec_writer_close.argtypes = [ctypes.c_void_p]
+        lib.pt_shard_reader_start.restype = ctypes.c_void_p
+        lib.pt_shard_reader_start.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+            ctypes.c_size_t]
+        lib.pt_shard_reader_ring.restype = ctypes.c_void_p
+        lib.pt_shard_reader_ring.argtypes = [ctypes.c_void_p]
+        lib.pt_shard_reader_errors.restype = ctypes.c_int
+        lib.pt_shard_reader_errors.argtypes = [ctypes.c_void_p]
+        lib.pt_shard_reader_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class RingBuffer:
+    """Blocking byte-blob channel; native when possible, queue fallback."""
+
+    def __init__(self, capacity=8):
+        lib = get_lib()
+        self._lib = lib
+        if lib is not None:
+            self._h = lib.pt_ring_new(capacity)
+            self._q = None
+        else:  # pure-python fallback
+            import queue
+
+            self._h = None
+            self._q = queue.Queue(maxsize=capacity)
+        self._closed = False
+
+    def push(self, data: bytes) -> bool:
+        if self._h is not None:
+            return self._lib.pt_ring_push(self._h, data, len(data)) == 0
+        try:
+            while True:
+                if self._closed:
+                    return False
+                try:
+                    self._q.put(data, timeout=0.1)
+                    return True
+                except Exception:
+                    continue
+        except Exception:
+            return False
+
+    def pop(self, timeout_ms=-1):
+        """bytes, or None when closed-and-drained."""
+        if self._h is not None:
+            p = ctypes.c_void_p()
+            n = ctypes.c_size_t()
+            rc = self._lib.pt_ring_pop(self._h, ctypes.byref(p),
+                                       ctypes.byref(n), timeout_ms)
+            if rc == -1:
+                return None
+            if rc == -3:
+                raise TimeoutError("ring pop timed out")
+            data = ctypes.string_at(p.value, n.value)
+            self._lib.pt_blob_free(p)
+            return data
+        import queue
+
+        deadline = None if timeout_ms < 0 else timeout_ms / 1000.0
+        while True:
+            try:
+                return self._q.get(timeout=0.1 if deadline is None else deadline)
+            except queue.Empty:
+                if self._closed and self._q.empty():
+                    return None
+                if deadline is not None:
+                    raise TimeoutError("ring pop timed out")
+
+    def __len__(self):
+        if self._h is not None:
+            return self._lib.pt_ring_len(self._h)
+        return self._q.qsize()
+
+    def close(self):
+        self._closed = True
+        if self._h is not None:
+            self._lib.pt_ring_close(self._h)
+
+    def __del__(self):
+        try:
+            if self._h is not None and self._lib is not None:
+                self._lib.pt_ring_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class Arena:
+    """Host staging allocator with stats (ref: memory/allocation)."""
+
+    def __init__(self, block_size=1 << 20):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.pt_arena_new(block_size)
+
+    def alloc(self, n) -> int:
+        return self._lib.pt_arena_alloc(self._h, n)
+
+    def reset(self):
+        self._lib.pt_arena_reset(self._h)
+
+    def stats(self):
+        vals = [ctypes.c_uint64() for _ in range(4)]
+        self._lib.pt_arena_stats(self._h, *[ctypes.byref(v) for v in vals])
+        return {"total_allocated": vals[0].value, "in_use": vals[1].value,
+                "peak": vals[2].value, "alloc_count": vals[3].value}
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None) is not None:
+                self._lib.pt_arena_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class RecordWriter:
+    """Length-prefixed CRC'd record shard writer."""
+
+    def __init__(self, path):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.pt_rec_writer_open(os.fsencode(path))
+        if not self._h:
+            raise OSError(f"cannot open {path}")
+
+    def write(self, data: bytes):
+        if self._lib.pt_rec_write(self._h, data, len(data)) != 0:
+            raise OSError("record write failed")
+
+    def close(self) -> int:
+        n = self._lib.pt_rec_writer_close(self._h)
+        self._h = None
+        return n
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        if self._h:
+            self.close()
+
+
+class ShardReader:
+    """Threaded readahead over record shards; iterates raw record bytes."""
+
+    def __init__(self, paths, n_threads=2, ring_capacity=64):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        arr = (ctypes.c_char_p * len(paths))(
+            *[os.fsencode(p) for p in paths])
+        self._h = lib.pt_shard_reader_start(arr, len(paths), n_threads,
+                                            ring_capacity)
+        self._ring = lib.pt_shard_reader_ring(self._h)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        p = ctypes.c_void_p()
+        n = ctypes.c_size_t()
+        rc = self._lib.pt_ring_pop(self._ring, ctypes.byref(p),
+                                   ctypes.byref(n), -1)
+        if rc == -1:
+            if self._lib.pt_shard_reader_errors(self._h):
+                raise OSError("shard reader encountered corrupt records")
+            raise StopIteration
+        data = ctypes.string_at(p.value, n.value)
+        self._lib.pt_blob_free(p)
+        return data
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.pt_shard_reader_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

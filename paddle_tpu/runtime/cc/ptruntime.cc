@@ -1,0 +1,338 @@
+// paddle_tpu host runtime: the native layer under the Python data pipeline.
+//
+// TPU-native replacement for the reference's C++ runtime pieces that still
+// matter off-device:
+//   - paddle/fluid/memory/allocation/* (arena/pool host allocator w/ stats)
+//   - paddle/fluid/operators/reader/buffered_reader.cc (double-buffer
+//     prefetch)  -> blocking MPMC ring buffer feeding DataLoader
+//   - paddle/fluid/framework/io (record file shards) -> length-prefixed
+//     record shard writer/reader with CRC and threaded readahead
+//
+// Device memory itself belongs to XLA/PJRT on TPU; this runtime owns the
+// HOST side: staging buffers, pipeline queues, shard IO. Exposed as a C ABI
+// for ctypes (no pybind11 in the image).
+//
+// Build: g++ -O3 -march=native -shared -fPIC -pthread -o libptruntime.so
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <new>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Arena allocator with stats (host staging memory)
+// ---------------------------------------------------------------------------
+
+struct PtArena {
+  std::mutex mu;
+  size_t block_size;
+  std::vector<void*> blocks;     // owned big blocks
+  char* cur = nullptr;           // bump pointer inside current block
+  size_t cur_left = 0;
+  // free-list pooling for large one-off allocations
+  std::deque<std::pair<void*, size_t>> pool;
+  // stats
+  std::atomic<uint64_t> total_allocated{0};
+  std::atomic<uint64_t> in_use{0};
+  std::atomic<uint64_t> peak{0};
+  std::atomic<uint64_t> alloc_count{0};
+};
+
+PtArena* pt_arena_new(size_t block_size) {
+  auto* a = new PtArena();
+  a->block_size = block_size ? block_size : (1u << 20);
+  return a;
+}
+
+static void pt_bump_stats(PtArena* a, size_t n) {
+  a->alloc_count.fetch_add(1, std::memory_order_relaxed);
+  uint64_t now = a->in_use.fetch_add(n, std::memory_order_relaxed) + n;
+  uint64_t peak = a->peak.load(std::memory_order_relaxed);
+  while (now > peak &&
+         !a->peak.compare_exchange_weak(peak, now, std::memory_order_relaxed)) {
+  }
+}
+
+void* pt_arena_alloc(PtArena* a, size_t n) {
+  if (!a || n == 0) return nullptr;
+  n = (n + 63) & ~size_t(63);  // 64B-align: friendly to memcpy/SIMD
+  std::lock_guard<std::mutex> lk(a->mu);
+  if (n >= a->block_size / 2) {
+    // large: serve from pool if a fitting blob exists (first fit)
+    for (auto it = a->pool.begin(); it != a->pool.end(); ++it) {
+      if (it->second >= n && it->second <= n * 2) {
+        void* p = it->first;
+        a->pool.erase(it);
+        pt_bump_stats(a, n);
+        return p;
+      }
+    }
+    void* p = ::operator new(n, std::nothrow);
+    if (!p) return nullptr;
+    a->total_allocated.fetch_add(n, std::memory_order_relaxed);
+    pt_bump_stats(a, n);
+    a->blocks.push_back(p);  // owned; freed at arena destroy
+    return p;
+  }
+  if (a->cur_left < n) {
+    char* blk = static_cast<char*>(::operator new(a->block_size, std::nothrow));
+    if (!blk) return nullptr;
+    a->blocks.push_back(blk);
+    a->total_allocated.fetch_add(a->block_size, std::memory_order_relaxed);
+    a->cur = blk;
+    a->cur_left = a->block_size;
+  }
+  void* p = a->cur;
+  a->cur += n;
+  a->cur_left -= n;
+  pt_bump_stats(a, n);
+  return p;
+}
+
+void pt_arena_reset(PtArena* a) {
+  // bulk free: keep the first block, drop the rest (epoch-style reuse)
+  std::lock_guard<std::mutex> lk(a->mu);
+  for (size_t i = 1; i < a->blocks.size(); ++i) ::operator delete(a->blocks[i]);
+  if (!a->blocks.empty()) {
+    a->blocks.resize(1);
+    a->cur = static_cast<char*>(a->blocks[0]);
+    a->cur_left = a->block_size;
+  }
+  a->pool.clear();
+  a->in_use.store(0, std::memory_order_relaxed);
+}
+
+void pt_arena_stats(PtArena* a, uint64_t* total, uint64_t* in_use,
+                    uint64_t* peak, uint64_t* count) {
+  if (!a) return;
+  if (total) *total = a->total_allocated.load(std::memory_order_relaxed);
+  if (in_use) *in_use = a->in_use.load(std::memory_order_relaxed);
+  if (peak) *peak = a->peak.load(std::memory_order_relaxed);
+  if (count) *count = a->alloc_count.load(std::memory_order_relaxed);
+}
+
+void pt_arena_free(PtArena* a) {
+  if (!a) return;
+  for (void* b : a->blocks) ::operator delete(b);
+  delete a;
+}
+
+// ---------------------------------------------------------------------------
+// Blocking MPMC ring buffer of byte blobs (DataLoader prefetch channel)
+// ---------------------------------------------------------------------------
+
+struct PtBlob {
+  char* data;
+  size_t size;
+};
+
+struct PtRing {
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  std::deque<PtBlob> q;
+  size_t capacity;
+  bool closed = false;
+  std::atomic<uint64_t> pushed{0}, popped{0};
+};
+
+PtRing* pt_ring_new(size_t capacity) {
+  auto* r = new PtRing();
+  r->capacity = capacity ? capacity : 8;
+  return r;
+}
+
+// Copies `data` in; blocks while full. Returns 0 ok, -1 closed.
+int pt_ring_push(PtRing* r, const char* data, size_t size) {
+  char* copy = static_cast<char*>(std::malloc(size ? size : 1));
+  if (!copy) return -2;
+  std::memcpy(copy, data, size);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->not_full.wait(lk, [&] { return r->q.size() < r->capacity || r->closed; });
+  if (r->closed) {
+    std::free(copy);
+    return -1;
+  }
+  r->q.push_back({copy, size});
+  r->pushed.fetch_add(1, std::memory_order_relaxed);
+  r->not_empty.notify_one();
+  return 0;
+}
+
+// Blocks while empty. On success caller owns *data (free with pt_blob_free).
+// Returns 0 ok, -1 closed-and-drained, -3 timeout (timeout_ms >= 0).
+int pt_ring_pop(PtRing* r, char** data, size_t* size, long timeout_ms) {
+  std::unique_lock<std::mutex> lk(r->mu);
+  auto ready = [&] { return !r->q.empty() || r->closed; };
+  if (timeout_ms < 0) {
+    r->not_empty.wait(lk, ready);
+  } else if (!r->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                    ready)) {
+    return -3;
+  }
+  if (r->q.empty()) return -1;  // closed and drained
+  PtBlob b = r->q.front();
+  r->q.pop_front();
+  r->popped.fetch_add(1, std::memory_order_relaxed);
+  r->not_full.notify_one();
+  *data = b.data;
+  *size = b.size;
+  return 0;
+}
+
+void pt_blob_free(char* data) { std::free(data); }
+
+void pt_ring_close(PtRing* r) {
+  std::lock_guard<std::mutex> lk(r->mu);
+  r->closed = true;
+  r->not_empty.notify_all();
+  r->not_full.notify_all();
+}
+
+size_t pt_ring_len(PtRing* r) {
+  std::lock_guard<std::mutex> lk(r->mu);
+  return r->q.size();
+}
+
+void pt_ring_free(PtRing* r) {
+  if (!r) return;
+  for (auto& b : r->q) std::free(b.data);
+  delete r;
+}
+
+// ---------------------------------------------------------------------------
+// Record shard files: [u64 magic][records: u32 crc, u32 len, bytes]
+// with threaded readahead into a ring (the reference's recordio role)
+// ---------------------------------------------------------------------------
+
+static const uint64_t kMagic = 0x70745F7265634631ULL;  // "pt_recF1"
+
+static uint32_t crc32_simple(const char* p, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) {
+    c ^= static_cast<unsigned char>(p[i]);
+    for (int k = 0; k < 8; ++k) c = (c >> 1) ^ (0xEDB88320u & (-(c & 1u)));
+  }
+  return ~c;
+}
+
+struct PtRecWriter {
+  FILE* f;
+  uint64_t n = 0;
+};
+
+PtRecWriter* pt_rec_writer_open(const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return nullptr;
+  if (std::fwrite(&kMagic, 8, 1, f) != 1) {
+    std::fclose(f);
+    return nullptr;
+  }
+  auto* w = new PtRecWriter();
+  w->f = f;
+  return w;
+}
+
+int pt_rec_write(PtRecWriter* w, const char* data, uint32_t len) {
+  uint32_t crc = crc32_simple(data, len);
+  if (std::fwrite(&crc, 4, 1, w->f) != 1) return -1;
+  if (std::fwrite(&len, 4, 1, w->f) != 1) return -1;
+  if (len && std::fwrite(data, 1, len, w->f) != len) return -1;
+  w->n++;
+  return 0;
+}
+
+uint64_t pt_rec_writer_close(PtRecWriter* w) {
+  uint64_t n = w->n;
+  std::fclose(w->f);
+  delete w;
+  return n;
+}
+
+// Threaded shard reader: N reader threads stream records from a list of
+// shard files into a ring buffer; consumers pop via pt_ring_pop.
+struct PtShardReader {
+  PtRing* ring;
+  std::vector<std::string> paths;
+  std::vector<std::thread> threads;
+  std::atomic<int> active{0};
+  std::atomic<int> errors{0};
+  std::atomic<size_t> next_shard{0};
+};
+
+static void shard_worker(PtShardReader* sr) {
+  for (;;) {
+    size_t i = sr->next_shard.fetch_add(1);
+    if (i >= sr->paths.size()) break;
+    FILE* f = std::fopen(sr->paths[i].c_str(), "rb");
+    if (!f) {
+      sr->errors.fetch_add(1);
+      continue;
+    }
+    uint64_t magic = 0;
+    if (std::fread(&magic, 8, 1, f) != 1 || magic != kMagic) {
+      sr->errors.fetch_add(1);
+      std::fclose(f);
+      continue;
+    }
+    std::vector<char> buf;
+    for (;;) {
+      uint32_t crc, len;
+      if (std::fread(&crc, 4, 1, f) != 1) break;  // clean EOF
+      if (std::fread(&len, 4, 1, f) != 1) {
+        sr->errors.fetch_add(1);
+        break;
+      }
+      buf.resize(len);
+      if (len && std::fread(buf.data(), 1, len, f) != len) {
+        sr->errors.fetch_add(1);
+        break;
+      }
+      if (crc32_simple(buf.data(), len) != crc) {
+        sr->errors.fetch_add(1);
+        break;  // corruption: stop this shard
+      }
+      if (pt_ring_push(sr->ring, buf.data(), len) != 0) {
+        std::fclose(f);
+        return;  // ring closed: consumer is done
+      }
+    }
+    std::fclose(f);
+  }
+  if (sr->active.fetch_sub(1) == 1) pt_ring_close(sr->ring);
+}
+
+PtShardReader* pt_shard_reader_start(const char** paths, int n_paths,
+                                     int n_threads, size_t ring_capacity) {
+  auto* sr = new PtShardReader();
+  sr->ring = pt_ring_new(ring_capacity);
+  for (int i = 0; i < n_paths; ++i) sr->paths.emplace_back(paths[i]);
+  if (n_threads < 1) n_threads = 1;
+  sr->active.store(n_threads);
+  for (int i = 0; i < n_threads; ++i)
+    sr->threads.emplace_back(shard_worker, sr);
+  return sr;
+}
+
+PtRing* pt_shard_reader_ring(PtShardReader* sr) { return sr->ring; }
+int pt_shard_reader_errors(PtShardReader* sr) { return sr->errors.load(); }
+
+void pt_shard_reader_free(PtShardReader* sr) {
+  if (!sr) return;
+  pt_ring_close(sr->ring);
+  for (auto& t : sr->threads)
+    if (t.joinable()) t.join();
+  pt_ring_free(sr->ring);
+  delete sr;
+}
+
+}  // extern "C"

@@ -112,15 +112,19 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             continue
 
         # each grad-op invocation produces fresh partials; accumulate into
-        # the canonical @GRAD var with add ops (ref: sum_op insertion)
+        # the canonical @GRAD var with add ops (ref: sum_op insertion).
+        # An input appearing twice in ONE op (e.g. multiply(x, x)) must get
+        # two distinct partial names or the second write clobbers the first.
         partial_names = []
+        seen_this_op: set[str] = set()
         for iname in grad_outputs:
             if iname is None:
                 partial_names.append(unique_name.generate("_gsink"))
-            elif iname in have_grad:
+            elif iname in have_grad or iname in seen_this_op:
                 partial_names.append(unique_name.generate(grad_name(iname) + ".p"))
             else:
                 partial_names.append(grad_name(iname))
+                seen_this_op.add(iname)
         for iname, pname in zip(grad_outputs, partial_names):
             ref = block.var(iname) if iname is not None else None
             if ref is not None:
@@ -159,7 +163,13 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """ref: fluid.gradients — grads of targets wrt arbitrary inputs."""
     targets = targets if isinstance(targets, (list, tuple)) else [targets]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    pg = append_backward(targets[0], parameter_list=list(inputs),
+    loss = targets[0]
+    for t in targets[1:]:
+        # gradient of a list of targets is the gradient of their sum
+        from ..ops.math import add
+
+        loss = add(loss, t)
+    pg = append_backward(loss, parameter_list=list(inputs),
                          no_grad_set=no_grad_set)
     got = {p.name: g for p, g in pg}
     block = default_main_program().global_block

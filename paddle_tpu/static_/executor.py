@@ -38,14 +38,16 @@ class Executor:
 
     # -- program -> pure function ------------------------------------------
     @staticmethod
-    def _replay_fn(program, feed_names, persist_in, fetch_names, persist_out):
+    def _replay_fn(program, feed_names, updated_names, frozen_names,
+                   fetch_names):
         ops = list(program.global_block.ops)
         consts = dict(program._constants)
 
-        def fn(feeds, persists):
+        def fn(feeds, updated, frozen):
             env = dict(consts)
             env.update(zip(feed_names, feeds))
-            env.update(zip(persist_in, persists))
+            env.update(zip(updated_names, updated))
+            env.update(zip(frozen_names, frozen))
             for op in ops:
                 args = [env[n] if n is not None else None
                         for n in op.input_names]
@@ -56,7 +58,7 @@ class Executor:
                 else:
                     env[op.output_names[0]] = out
             return ([env[n] for n in fetch_names],
-                    [env[n] for n in persist_out])
+                    [env[n] for n in updated_names])
 
         return fn
 
@@ -79,13 +81,18 @@ class Executor:
         written = set()
         for op in blk.ops:
             written.update(op.output_names)
-        persist_out = tuple(n for n in persist_in if n in written)
+        # only buffers the program re-emits may be donated; donating a
+        # frozen (read-only) persistable would delete it from the scope
+        updated = tuple(n for n in persist_in if n in written)
+        frozen = tuple(n for n in persist_in if n not in written)
 
-        raw = self._replay_fn(program, feed_names, persist_in, fetch_names,
-                              persist_out)
+        raw = self._replay_fn(program, feed_names, updated, frozen,
+                              fetch_names)
         jit_fn = jax.jit(raw, donate_argnums=(1,))
-        compiled = _Compiled(jit_fn, feed_names, persist_in, persist_out,
+        compiled = _Compiled(jit_fn, feed_names, updated + frozen, updated,
                              fetch_names)
+        compiled.updated = updated
+        compiled.frozen = frozen
         self._cache[key] = compiled
         return compiled
 
@@ -115,8 +122,9 @@ class Executor:
 
         compiled = self._compile(program, feed, fetch_list)
         feeds = [jnp.asarray(np.asarray(feed[n])) for n in compiled.feed_names]
-        persists = [scope.find_var(n) for n in compiled.persist_in]
-        fetches, new_persist = compiled.fn(feeds, persists)
+        updated = [scope.find_var(n) for n in compiled.updated]
+        frozen = [scope.find_var(n) for n in compiled.frozen]
+        fetches, new_persist = compiled.fn(feeds, updated, frozen)
         for name, arr in zip(compiled.persist_out, new_persist):
             scope.set(name, arr)
         if return_numpy:
@@ -171,12 +179,14 @@ def build_optimize_ops(optimizer, loss, parameter_list=None):
                            dtype=state[k].dtype, persistable=True)
             scope.set(sname[k], jnp.asarray(state[k]))
 
-        def upd_fn(pa, ga, lr, *svals, _opt=optimizer, _reg=reg, _skeys=skeys):
+        def upd_fn(pa, ga, lr, *svals, _opt=optimizer, _reg=reg, _skeys=skeys,
+                   _pvar=p):
             from ..optim.optimizer import AdamW
 
             if _reg is not None and not isinstance(_opt, AdamW):
                 ga = _reg(pa, ga)
             s = dict(zip(_skeys, svals))
+            _opt._current_param = _pvar  # AdamW decay exclusion / lr_ratio
             new_p, new_s = _opt._update(pa, ga.astype(pa.dtype), s, lr)
             return (new_p, *[new_s[k] for k in _skeys])
 

@@ -349,8 +349,12 @@ class ProgramTracer:
         return tuple(out_vars) if multi else out_vars[0]
 
     def record_assign(self, target, value):
+        from ..ops._base import OP_REGISTRY, register
+
+        if "assign_to" not in OP_REGISTRY:
+            register("assign_to")(lambda x: x)
         blk = self.program.current_block()
         vname = self._var_of(value)
-        blk.append_op(Operator("assign_to", lambda x: x, [vname],
+        blk.append_op(Operator("assign_to", OP_REGISTRY["assign_to"], [vname],
                                [target.name], {}))
         self.program.bump()

@@ -1,0 +1,223 @@
+"""Data pipeline + native runtime tests (model: reference
+tests/unittests/test_multiprocess_dataloader_*.py, reader decorators)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.io_ import (
+    Dataset, IterableDataset, TensorDataset, ConcatDataset, ComposeDataset,
+    Subset, random_split, BatchSampler, RandomSampler, SequenceSampler,
+    WeightedRandomSampler, DistributedBatchSampler, DataLoader,
+    default_collate_fn,
+)
+from paddle_tpu.io_ import reader as R
+from paddle_tpu.runtime import RingBuffer, Arena, RecordWriter, ShardReader, get_lib
+
+
+class _Sq(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.int64(i * i)
+
+    def __len__(self):
+        return self.n
+
+
+class TestDatasets:
+    def test_tensor_dataset(self):
+        ds = TensorDataset([np.arange(10), np.arange(10) * 2])
+        assert len(ds) == 10
+        a, b = ds[3]
+        assert a == 3 and b == 6
+
+    def test_concat_subset_split(self):
+        d1, d2 = _Sq(5), _Sq(7)
+        cat = ConcatDataset([d1, d2])
+        assert len(cat) == 12
+        assert cat[6][0] == 1.0  # second dataset idx 1
+        sub = Subset(d1, [4, 0])
+        assert sub[0][0] == 4.0
+        a, b = random_split(_Sq(10), [7, 3], generator=0)
+        assert len(a) == 7 and len(b) == 3
+        assert sorted(a.indices + b.indices) == list(range(10))
+
+    def test_compose(self):
+        ds = ComposeDataset([_Sq(4), _Sq(4)])
+        s = ds[2]
+        assert s == (2.0, 4, 2.0, 4)
+
+
+class TestSamplers:
+    def test_sequence_random(self):
+        ds = _Sq(10)
+        assert list(SequenceSampler(ds)) == list(range(10))
+        r = list(RandomSampler(ds, generator=3))
+        assert sorted(r) == list(range(10))
+
+    def test_weighted(self):
+        w = [0.0, 0.0, 1.0]
+        s = list(WeightedRandomSampler(w, 20))
+        assert all(i == 2 for i in s)
+
+    def test_batch_sampler(self):
+        bs = BatchSampler(dataset=_Sq(10), batch_size=3)
+        batches = list(bs)
+        assert len(batches) == 4 and len(batches[-1]) == 1
+        bs = BatchSampler(dataset=_Sq(10), batch_size=3, drop_last=True)
+        assert len(list(bs)) == 3
+
+    def test_distributed_batch_sampler(self):
+        parts = []
+        for rank in range(2):
+            s = DistributedBatchSampler(_Sq(10), batch_size=2,
+                                        num_replicas=2, rank=rank)
+            parts.append([i for b in s for i in b])
+        assert len(parts[0]) == len(parts[1]) == 5
+        assert set(parts[0] + parts[1]) == set(range(10))
+
+
+class TestDataLoader:
+    @pytest.mark.parametrize("workers", [0, 2])
+    def test_loader_batches(self, workers):
+        dl = DataLoader(_Sq(20), batch_size=4, num_workers=workers)
+        out = list(dl)
+        assert len(out) == 5
+        x, y = out[0]
+        assert x.shape == [4] and y.shape == [4]
+        # deterministic order even with workers
+        np.testing.assert_allclose(out[1][0].numpy(), [4, 5, 6, 7])
+
+    def test_loader_shuffle_epoch(self):
+        dl = DataLoader(_Sq(16), batch_size=4, shuffle=True)
+        seen = sorted(float(v) for x, _ in dl for v in x.numpy())
+        assert seen == list(map(float, range(16)))
+
+    def test_iterable_dataset(self):
+        class It(IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.float32(i)
+
+        dl = DataLoader(It(), batch_size=3)
+        shapes = [x[0].shape for x in dl]
+        assert shapes == [[3], [3], [1]]
+
+    def test_collate_nested(self):
+        batch = [{"a": np.ones(2), "b": (1, 2.0)} for _ in range(3)]
+        out = default_collate_fn(batch)
+        assert out["a"].shape == (3, 2)
+        assert out["b"][0].dtype == np.int64
+
+    def test_worker_exception_propagates(self):
+        class Bad(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                if i == 2:
+                    raise ValueError("bad sample")
+                return np.float32(i)
+
+        dl = DataLoader(Bad(), batch_size=1, num_workers=2)
+        with pytest.raises(ValueError, match="bad sample"):
+            list(dl)
+
+
+class TestReaders:
+    def test_batch_shuffle_firstn(self):
+        r = lambda: iter(range(10))
+        assert list(R.batch(r, 3)()) == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+        assert sorted(x for b in R.batch(R.shuffle(r, 5), 2)() for x in b) == \
+            list(range(10))
+        assert list(R.firstn(r, 3)()) == [0, 1, 2]
+
+    def test_map_chain_compose_cache(self):
+        r = lambda: iter(range(5))
+        assert list(R.map_readers(lambda x: x * 2, r)()) == [0, 2, 4, 6, 8]
+        assert list(R.chain(r, r)()) == list(range(5)) * 2
+        c = R.cache(r)
+        assert list(c()) == list(c()) == list(range(5))
+
+    def test_xmap_ordered(self):
+        r = lambda: iter(range(20))
+        got = list(R.xmap_readers(lambda x: x + 100, r, 4, 8, order=True)())
+        assert got == [x + 100 for x in range(20)]
+
+    def test_buffered(self):
+        r = lambda: iter(range(50))
+        assert list(R.buffered(r, 8)()) == list(range(50))
+
+    def test_data_feeder(self):
+        f = R.DataFeeder(feed_list=["x", "y"])
+        feed = f.feed([(np.ones(3), 0), (np.zeros(3), 1)])
+        assert feed["x"].shape == (2, 3)
+        assert feed["y"].tolist() == [0, 1]
+
+
+class TestNativeRuntime:
+    def test_lib_builds(self):
+        assert get_lib() is not None, "native runtime must compile"
+
+    def test_ring_roundtrip_threads(self):
+        import threading
+
+        rb = RingBuffer(4)
+        items = [bytes([i]) * (i + 1) for i in range(50)]
+
+        def produce():
+            for it in items:
+                rb.push(it)
+            rb.close()
+
+        t = threading.Thread(target=produce)
+        t.start()
+        got = []
+        while True:
+            b = rb.pop()
+            if b is None:
+                break
+            got.append(b)
+        t.join()
+        assert got == items
+
+    def test_ring_timeout(self):
+        rb = RingBuffer(2)
+        with pytest.raises(TimeoutError):
+            rb.pop(timeout_ms=50)
+
+    def test_arena_stats(self):
+        a = Arena(1 << 16)
+        a.alloc(100)
+        a.alloc(200)
+        st = a.stats()
+        assert st["alloc_count"] == 2 and st["in_use"] >= 300
+        a.reset()
+        assert a.stats()["in_use"] == 0
+
+    def test_record_shards(self, tmp_path):
+        paths = []
+        for s in range(3):
+            p = str(tmp_path / f"s{s}.rec")
+            with RecordWriter(p) as w:
+                for i in range(40):
+                    w.write(f"{s}:{i}".encode())
+            paths.append(p)
+        rs = ShardReader(paths, n_threads=3)
+        recs = sorted(r.decode() for r in rs)
+        assert len(recs) == 120
+        rs.close()
+
+    def test_corrupt_record_detected(self, tmp_path):
+        p = str(tmp_path / "bad.rec")
+        with RecordWriter(p) as w:
+            w.write(b"payload-abcdef")
+        # flip a payload byte
+        with open(p, "r+b") as f:
+            f.seek(-3, 2)
+            f.write(b"X")
+        rs = ShardReader([p], n_threads=1)
+        with pytest.raises(OSError):
+            list(rs)
+        rs.close()
