@@ -81,6 +81,16 @@ from . import static_ as static
 from . import framework
 from . import io_ as io
 from . import runtime
+# NB: ``paddle_tpu.dist`` is the p-norm distance op (paddle parity);
+# the distributed package binds as ``paddle_tpu.distributed``. A plain
+# ``from . import dist`` would silently resolve to the already-bound
+# function, so import the submodule explicitly.
+import importlib as _importlib
+
+distributed = _importlib.import_module(".dist", __name__)
+# the submodule import rebinds the package attr 'dist' to the module;
+# restore the function for paddle.dist parity
+from .ops.linalg import dist  # noqa: E402,F811
 from .framework import jit as _jit_mod
 from .framework.jit import jit, to_static, TrainStep
 from .framework.io import save, load

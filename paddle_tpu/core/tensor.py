@@ -196,7 +196,8 @@ def _unwrap_index(idx):
 class Parameter(Tensor):
     """Trainable tensor (ref: framework::Parameter / ParamBase)."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "sharding_spec")
 
     def __init__(self, data, name=None, trainable=True):
         super().__init__(data, stop_gradient=not trainable, name=name, _internal=isinstance(data, jax.Array))
@@ -205,6 +206,7 @@ class Parameter(Tensor):
         self.optimize_attr = {"learning_rate": 1.0}
         self.regularizer = None
         self.need_clip = True
+        self.sharding_spec = None  # PartitionSpec set by TP layers / fleet
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()

@@ -1,0 +1,235 @@
+"""Collective communication.
+
+TPU-native analog of the reference's collective op set
+(paddle/fluid/operators/collective/c_allreduce_op.h, c_allgather_op,
+c_reducescatter_op, c_broadcast_op, alltoall) and its NCCL rings
+(platform/nccl_helper.h): each collective is the corresponding XLA
+primitive (psum / all_gather / psum_scatter / ppermute / all_to_all) over a
+named mesh axis. Inside a shard_map/pjit region they compile to ICI
+collectives; called eagerly on a sharded array they run as a tiny jitted
+program over the global mesh.
+
+API mirrors paddle.distributed.* so reference training scripts map 1:1.
+"""
+from __future__ import annotations
+
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .env import get_mesh
+
+__all__ = [
+    "all_reduce", "all_gather", "reduce_scatter", "broadcast", "all_to_all",
+    "ppermute", "reduce", "scatter", "barrier", "ReduceOp", "split_axis",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+
+
+def _is_traced(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _maybe_wrap(arr, like):
+    return Tensor(arr, _internal=True) if isinstance(like, Tensor) else arr
+
+
+def _axis(axis_name):
+    if axis_name is not None:
+        return axis_name
+    mesh = get_mesh()
+    if mesh is None:
+        return None
+    return mesh.axis_names[0]
+
+
+def _eager_shard_map(fn, x, axis_name):
+    """Run a collective eagerly by wrapping it in a one-op shard_map over the
+    global mesh (the eager-mode path of the reference's c_* ops).
+
+    Single-controller semantics: the GLOBAL array is the concatenation of
+    per-rank values along dim 0. A value that cannot shard over the axis
+    (scalar, or dim 0 not divisible) is already a global aggregate — the
+    collective is an identity on it, signalled by returning None.
+    """
+    mesh = get_mesh()
+    if mesh is None or axis_name is None:
+        return None
+    size = mesh.shape[axis_name]
+    if jnp.ndim(x) == 0 or x.shape[0] % size != 0:
+        return None
+    spec = P(axis_name)
+    mapped = jax.shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec)
+    return mapped(x)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, axis_name=None,
+               sync_op=True):
+    """ref: c_allreduce_sum/max/min/prod."""
+    x = _unwrap(tensor)
+    name = _axis(axis_name)
+
+    def _pprod(v, n):
+        # sign-safe product: exp of summed log-magnitudes, sign from the
+        # parity of negative factors, zero if any factor is zero
+        neg = jax.lax.psum((v < 0).astype(jnp.int32), n)
+        mag = jnp.exp(jax.lax.psum(jnp.log(jnp.maximum(jnp.abs(v), 1e-38)), n))
+        any_zero = jax.lax.pmin(jnp.abs(v), n) == 0
+        sign = jnp.where(neg % 2 == 1, -1.0, 1.0).astype(v.dtype)
+        return jnp.where(any_zero, jnp.zeros((), v.dtype), sign * mag)
+
+    red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+           ReduceOp.MIN: jax.lax.pmin, ReduceOp.PROD: _pprod}[op]
+    if _is_traced(x):
+        out = red(x, name)
+    else:
+        if name is None:
+            return tensor  # single-device world: identity
+        out = _eager_shard_map(lambda v: red(v, name), x, name)
+        if out is None:
+            return tensor
+    if isinstance(tensor, Tensor):
+        tensor._replace(out) if not _is_traced(x) else None
+        return _maybe_wrap(out, tensor)
+    return out
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, axis_name=None,
+               axis=0, tiled=True):
+    """ref: c_allgather. Returns the gathered array (paddle's list-output
+    form fills ``tensor_or_list`` when it is a list)."""
+    out_list = None
+    if isinstance(tensor_or_list, list):
+        out_list = tensor_or_list
+        src = tensor
+    else:
+        src = tensor_or_list
+    x = _unwrap(src)
+    name = _axis(axis_name)
+    if _is_traced(x):
+        out = jax.lax.all_gather(x, name, axis=axis, tiled=tiled)
+    else:
+        # single-controller eager view: the global array IS the
+        # concatenation of every rank's shard, so the gather is an identity
+        out = x
+    if out_list is not None:
+        mesh = get_mesh()
+        n = mesh.shape[name] if (mesh is not None and name in mesh.shape) else 1
+        chunk = out.shape[0] // n
+        out_list.extend(
+            _maybe_wrap(out[i * chunk:(i + 1) * chunk], src) for i in range(n))
+        return out_list
+    return _maybe_wrap(out, src)
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, axis_name=None,
+                   scatter_dimension=0):
+    """ref: c_reducescatter."""
+    x = _unwrap(tensor)
+    name = _axis(axis_name)
+    if name is None:
+        return tensor
+    fn = lambda v: jax.lax.psum_scatter(v, name, scatter_dimension=scatter_dimension,
+                                        tiled=True)
+    out = fn(x) if _is_traced(x) else _eager_shard_map(fn, x, name)
+    return _maybe_wrap(out if out is not None else x, tensor)
+
+
+def broadcast(tensor, src=0, group=None, axis_name=None):
+    """ref: c_broadcast — everyone takes rank ``src``'s value."""
+    x = _unwrap(tensor)
+    name = _axis(axis_name)
+    if name is None:
+        return tensor
+
+    def fn(v):
+        idx = jax.lax.axis_index(name)
+        n = jax.lax.axis_size(name)
+        # rotate src's shard to everyone via psum of masked value
+        mask = (idx == src).astype(v.dtype)
+        return jax.lax.psum(v * mask, name)
+
+    out = fn(x) if _is_traced(x) else _eager_shard_map(fn, x, name)
+    if out is None:
+        return tensor
+    if isinstance(tensor, Tensor) and not _is_traced(x):
+        tensor._replace(out)
+    return _maybe_wrap(out, tensor)
+
+
+def all_to_all(tensor, group=None, axis_name=None, split_axis=0,
+               concat_axis=0):
+    """ref: alltoall op. Leading dim is split over the axis; shards are
+    exchanged so rank i holds slice i of every peer."""
+    x = _unwrap(tensor)
+    name = _axis(axis_name)
+    if name is None:
+        return tensor
+    fn = lambda v: jax.lax.all_to_all(v, name, split_axis=split_axis,
+                                      concat_axis=concat_axis, tiled=True)
+    out = fn(x) if _is_traced(x) else _eager_shard_map(fn, x, name)
+    return _maybe_wrap(out if out is not None else x, tensor)
+
+
+def ppermute(tensor, perm, axis_name=None):
+    """Neighbor exchange (ring step); the primitive under pipeline/ring-attn."""
+    x = _unwrap(tensor)
+    name = _axis(axis_name)
+    fn = lambda v: jax.lax.ppermute(v, name, perm)
+    out = fn(x) if _is_traced(x) else _eager_shard_map(fn, x, name)
+    return _maybe_wrap(out if out is not None else x, tensor)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, axis_name=None):
+    """ref: c_reduce — SPMD keeps the value everywhere; matching the
+    reference's semantics only rank dst's copy is meaningful."""
+    return all_reduce(tensor, op=op, group=group, axis_name=axis_name)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, axis_name=None):
+    """ref: c_scatter: rank i receives slice i of src's concatenated input.
+
+    Single-controller semantics: the result's GLOBAL view is the
+    concatenation of the scattered slices, laid out sharded over the axis.
+    """
+    if tensor_list is not None:
+        full = jnp.concatenate([_unwrap(t) for t in tensor_list], axis=0)
+    else:
+        full = _unwrap(tensor)
+    name = _axis(axis_name)
+    mesh = get_mesh()
+    if name is None or mesh is None or _is_traced(full):
+        return _maybe_wrap(full, tensor)
+    out = jax.device_put(full, jax.sharding.NamedSharding(mesh, P(name)))
+    if isinstance(tensor, Tensor):
+        tensor._replace(out)
+        return tensor
+    return out
+
+
+def barrier(group=None):
+    """ref: barrier op — under SPMD-on-XLA every program is naturally
+    bulk-synchronous per executable; block on all outstanding device work."""
+    for d in jax.live_arrays():
+        d.block_until_ready()
+
+
+def split_axis(x, axis_name, axis=0):
+    """Utility: this shard's slice of x along ``axis`` (for manual sharding)."""
+    name = _axis(axis_name)
+    idx = jax.lax.axis_index(name)
+    n = jax.lax.axis_size(name)
+    size = x.shape[axis] // n
+    return jax.lax.dynamic_slice_in_dim(x, idx * size, size, axis)

@@ -1,0 +1,125 @@
+"""Distributed environment + device mesh management.
+
+TPU-native analog of the reference's fleet environment
+(python/paddle/fluid/incubate/fleet/base/role_maker.py, gen_comm_id /
+NCCL bootstrap in platform/collective_helper.cc): there is no comm-id
+handshake to port — jax.distributed + the XLA runtime own process bootstrap,
+and the device Mesh replaces communicator rings. Collectives are compiled
+into the step executable and ride ICI (intra-slice) / DCN (cross-slice)
+according to the mesh axis layout.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+
+__all__ = [
+    "init_parallel_env", "get_world_size", "get_rank", "ParallelEnv",
+    "init_mesh", "get_mesh", "set_mesh", "mesh_axis_size", "MeshGuard",
+]
+
+_MESH = None
+_initialized = False
+
+
+def init_parallel_env():
+    """ref: paddle.distributed.init_parallel_env. Multi-host jax runtime
+    bootstrap when launched under a cluster coordinator; single-host is a
+    no-op (all local devices already visible)."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_TPU_COORDINATOR")  # host:port
+    if coord and jax.process_count() == 1:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", 1)),
+            process_id=int(os.environ.get("PADDLE_TPU_PROCESS_ID", 0)))
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_world_size():
+    return jax.device_count()
+
+
+def get_rank():
+    return jax.process_index()
+
+
+class ParallelEnv:
+    """ref: fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def world_size(self):
+        return jax.device_count()
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def local_rank(self):
+        return jax.process_index()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return jax.device_count()
+
+
+def init_mesh(axes=None, devices=None):
+    """Create and install the global device mesh.
+
+    axes: dict name->size (in order, e.g. {"data": 2, "model": 4}) or None
+    for a 1-D {"data": n_devices} mesh. The product must equal the device
+    count (use -1 once for "whatever is left").
+    """
+    global _MESH
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = devices.size
+    if axes is None:
+        axes = {"data": n}
+    names, sizes = list(axes.keys()), list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} != {n} devices")
+    mesh = jax.sharding.Mesh(devices.reshape(sizes), tuple(names))
+    _MESH = mesh
+    return mesh
+
+
+def set_mesh(mesh):
+    global _MESH
+    _MESH = mesh
+
+
+def get_mesh():
+    return _MESH
+
+
+def mesh_axis_size(name):
+    m = get_mesh()
+    if m is None or name not in m.shape:
+        return 1
+    return m.shape[name]
+
+
+class MeshGuard:
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        self._old = get_mesh()
+        set_mesh(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *a):
+        set_mesh(self._old)

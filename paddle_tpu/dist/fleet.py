@@ -1,0 +1,128 @@
+"""Fleet: the distributed-training facade.
+
+Ref: python/paddle/fluid/incubate/fleet/ (collective mode) and
+DistributedStrategy. The strategy's knobs map onto mesh-axis layout +
+TrainStep features instead of NCCL/program-transpiler passes: dp/mp/pp/sp
+degrees build the Mesh; amp/recompute toggle the corresponding TrainStep
+behaviors; sharding (ZeRO-ish) maps to optimizer-state PartitionSpecs.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from . import env as _env
+from .env import init_mesh, get_mesh, init_parallel_env
+from .parallel import DistributedTrainStep
+
+__all__ = ["DistributedStrategy", "init", "distributed_optimizer",
+           "worker_num", "worker_index", "is_first_worker", "fleet"]
+
+
+class DistributedStrategy:
+    """ref: DistributedStrategy — degrees + feature toggles."""
+
+    def __init__(self):
+        self.dp_degree = -1        # -1: whatever is left
+        self.mp_degree = 1
+        self.pp_degree = 1
+        self.sp_degree = 1
+        self.ep_degree = 1
+        self.sharding = False      # shard optimizer state over dp axis
+        self.sharding_degree = 1
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.lamb = False
+        self.localsgd = False
+        self.hybrid_configs = {}
+
+    def mesh_axes(self):
+        axes = {}
+        if self.pp_degree > 1:
+            axes["pipe"] = self.pp_degree
+        axes["data"] = self.dp_degree
+        if self.mp_degree > 1:
+            axes["model"] = self.mp_degree
+        if self.sp_degree > 1:
+            axes["sp"] = self.sp_degree
+        if self.ep_degree > 1:
+            axes["expert"] = self.ep_degree
+        return axes
+
+
+class _Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._inited = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        init_parallel_env()
+        self._strategy = strategy or DistributedStrategy()
+        hybrid = self._strategy.hybrid_configs or {}
+        if hybrid:
+            self._strategy.dp_degree = hybrid.get("dp_degree",
+                                                  self._strategy.dp_degree)
+            self._strategy.mp_degree = hybrid.get("mp_degree",
+                                                  self._strategy.mp_degree)
+            self._strategy.pp_degree = hybrid.get("pp_degree",
+                                                  self._strategy.pp_degree)
+            self._strategy.sp_degree = hybrid.get("sp_degree",
+                                                  self._strategy.sp_degree)
+            self._strategy.ep_degree = hybrid.get("ep_degree",
+                                                  self._strategy.ep_degree)
+        if get_mesh() is None:
+            init_mesh(self._strategy.mesh_axes())
+        self._inited = True
+        return self
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        optimizer._dist_strategy = self._strategy
+        return optimizer
+
+    def distributed_model(self, model):
+        return model  # SPMD: sharding decisions live on params / TrainStep
+
+    def build_train_step(self, model, optimizer, loss_fn, **kw):
+        if self._strategy is not None and self._strategy.sharding:
+            kw.setdefault("shard_opt_state", True)
+        return DistributedTrainStep(model, optimizer, loss_fn,
+                                    mesh=get_mesh(), **kw)
+
+    # role queries (ref: fleet.worker_num()/worker_index())
+    def worker_num(self):
+        return jax.process_count()
+
+    def worker_index(self):
+        return jax.process_index()
+
+    def is_first_worker(self):
+        return jax.process_index() == 0
+
+    def barrier_worker(self):
+        from .collective import barrier
+
+        barrier()
+
+    def init_worker(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+
+fleet = _Fleet()
+init = fleet.init
+distributed_optimizer = fleet.distributed_optimizer
+worker_num = fleet.worker_num
+worker_index = fleet.worker_index
+is_first_worker = fleet.is_first_worker

@@ -1,0 +1,117 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+TPU-native analog of the reference's section/pipeline training in Fleet
+(pipeline_optimizer): stage parameters live stacked on a leading axis
+sharded over the 'pipe' mesh axis; one shard_map program runs the whole
+schedule, rotating activations ring-wise with ppermute each tick. The
+schedule (M microbatches, S stages → M+S-1 ticks) is a lax.scan, so
+forward AND the autodiff'd backward compile into a single XLA while-loop —
+no per-stage host orchestration like the reference's section executor.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .env import get_mesh
+
+__all__ = ["pipeline_forward", "PipelineStage", "gpipe_inner"]
+
+
+def gpipe_inner(stage_fn, stage_params, x_mb, axis_name):
+    """Per-shard GPipe loop. Call inside shard_map over ``axis_name``.
+
+    stage_fn(params, x) -> y: one stage's computation (same structure for
+    every stage — the usual homogeneous-transformer-block case).
+    stage_params: this shard's stage parameters (pytree; leading stage axis
+    already stripped by shard_map).
+    x_mb: (M, ...) microbatches — only stage 0's copy is consumed.
+    Returns (M, ...) outputs — meaningful on the LAST stage (replicated out
+    by the caller if needed).
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    M = x_mb.shape[0]
+    total = M + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    y0 = jax.eval_shape(lambda p, x: stage_fn(p, x), stage_params,
+                        jax.eval_shape(lambda a: a[0], x_mb))
+    out_buf = jnp.zeros((M,) + y0.shape, y0.dtype)
+    carry_act = jnp.zeros(y0.shape, y0.dtype)  # activation arriving from left
+
+    def tick(state, t):
+        carry, outs = state
+        # stage 0 injects microbatch t; other stages consume the carry
+        mb_idx = jnp.clip(t - idx, 0, M - 1)
+        x_in = jnp.where(idx == 0,
+                         jax.lax.dynamic_index_in_dim(x_mb, mb_idx, 0,
+                                                      keepdims=False),
+                         carry)
+        y = stage_fn(stage_params, x_in)
+        # last stage writes result for microbatch (t - n + 1)
+        out_idx = jnp.clip(t - (n - 1), 0, M - 1)
+        valid = (idx == n - 1) & (t >= n - 1) & (t - (n - 1) < M)
+        outs = jnp.where(
+            valid,
+            jax.lax.dynamic_update_index_in_dim(outs, y, out_idx, 0),
+            outs)
+        carry_next = jax.lax.ppermute(y, axis_name, perm)
+        return (carry_next, outs), None
+
+    (carry, outs), _ = jax.lax.scan(tick, (carry_act, out_buf),
+                                    jnp.arange(total))
+    # replicate the last stage's results to every shard so the caller can
+    # use out_specs=P() (grads of the loss then flow back through the ring)
+    outs = jax.lax.psum(
+        jnp.where(idx == n - 1, outs, jnp.zeros_like(outs)), axis_name)
+    return outs
+
+
+def pipeline_forward(stage_fn, stacked_params, x, num_microbatches,
+                     axis_name="pipe", mesh=None):
+    """Run x (batch-major) through the pipeline; returns last-stage output.
+
+    stacked_params: pytree whose leaves have leading dim = n_stages
+    (sharded over ``axis_name``). x: (B, ...) split into M microbatches.
+    """
+    mesh = mesh or get_mesh()
+    n = mesh.shape[axis_name]
+    B = x.shape[0]
+    M = num_microbatches
+    assert B % M == 0, "batch must divide into microbatches"
+
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    mb = arr.reshape((M, B // M) + arr.shape[1:])
+
+    def shard_fn(params, xs):
+        # shard_map keeps the (now size-1) stage axis on each leaf: strip it
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        return gpipe_inner(stage_fn, params, xs, axis_name)
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    out = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(pspec, P()), out_specs=P(),
+        check_vma=False)(stacked_params, mb)
+    out = out.reshape((B,) + out.shape[2:])
+    return Tensor(out, _internal=True) if isinstance(x, Tensor) else out
+
+
+class PipelineStage:
+    """Helper bundling a stage callable + stacked params for the schedule."""
+
+    def __init__(self, stage_fn, stacked_params, num_microbatches=4,
+                 axis_name="pipe"):
+        self.stage_fn = stage_fn
+        self.stacked_params = stacked_params
+        self.num_microbatches = num_microbatches
+        self.axis_name = axis_name
+
+    def __call__(self, x):
+        return pipeline_forward(self.stage_fn, self.stacked_params, x,
+                                self.num_microbatches, self.axis_name)

@@ -1,0 +1,98 @@
+"""Ring attention: sequence/context parallelism for long sequences.
+
+TPU-native long-context attention (SURVEY §2 #24): q/k/v are sharded along
+the sequence axis over the 'sp' mesh axis; K/V blocks rotate around the
+ring with ppermute while each device accumulates its queries' attention in
+an online-softmax (flash-attention-style) running state. Peak memory per
+device is O(L_local²-ish block) instead of O(L²), and the ppermute overlaps
+with the block matmuls on ICI.
+
+The reference has no sequence-parallel attention (its long-context story is
+capped by single-GPU memory); this is a required capability per the build
+spec, patterned on the public ring-attention formulation.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .env import get_mesh
+
+__all__ = ["ring_attention_inner", "ring_attention"]
+
+
+def ring_attention_inner(q, k, v, axis_name, causal=False, scale=None):
+    """Per-shard kernel: call inside shard_map over ``axis_name``.
+
+    q,k,v: (B, H, L_local, D) — this shard's sequence slice.
+    Returns (B, H, L_local, D).
+    """
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    qf = q.astype(jnp.float32) * scale
+
+    q_pos = idx * Lq + jnp.arange(Lq)
+
+    def step(carry, t):
+        m, l, o, k_cur, v_cur = carry
+        src = (idx - t) % n  # whose K/V block we now hold
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32))
+        if causal:
+            k_pos = src * Lk + jnp.arange(Lk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, o_new, k_next, v_next), None
+
+    # pvary: accumulators must carry the same varying-over-axis type as the
+    # rotating K/V blocks or scan rejects the carry
+    m0 = jax.lax.pcast(jnp.full((B, H, Lq), -jnp.inf, jnp.float32), axis_name, to='varying')
+    l0 = jax.lax.pcast(jnp.zeros((B, H, Lq), jnp.float32), axis_name, to='varying')
+    o0 = jax.lax.pcast(jnp.zeros((B, H, Lq, D), jnp.float32), axis_name, to='varying')
+    (m, l, o, _, _), _ = jax.lax.scan(step, (m0, l0, o0, k, v),
+                                      jnp.arange(n))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, mesh=None):
+    """Layer-level entry: q,k,v (B, H, L, D) Tensors; L sharded over the
+    mesh axis. Usable eagerly or under jit within the mesh."""
+    mesh = mesh or get_mesh()
+    if mesh is None or axis_name not in mesh.shape or \
+            mesh.shape[axis_name] == 1:
+        # single-shard world: plain flash-style dense attention
+        from ..nn.functional.attention import sdpa_bhld
+
+        return sdpa_bhld(q, k, v, is_causal=causal)
+
+    from ..ops._base import register, apply, OP_REGISTRY
+
+    if "ring_attention" not in OP_REGISTRY:
+        @register("ring_attention")
+        def _ring(qa, ka, va, *, axis_name, causal, mesh_id):
+            m = get_mesh()
+            spec = P(None, None, axis_name, None)
+            fn = functools.partial(ring_attention_inner, axis_name=axis_name,
+                                   causal=causal)
+            return jax.shard_map(fn, mesh=m, in_specs=(spec, spec, spec),
+                                 out_specs=spec)(qa, ka, va)
+
+    return apply("ring_attention", q, k, v, axis_name=axis_name,
+                 causal=bool(causal), mesh_id=id(mesh))
