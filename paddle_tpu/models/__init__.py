@@ -1,0 +1,4 @@
+"""Model zoo (ref: the PaddlePaddle book models + ERNIE/BERT-era zoo)."""
+from . import vision  # noqa: F401
+from . import nlp  # noqa: F401
+from . import rec  # noqa: F401

@@ -1,0 +1,281 @@
+"""GPT: decoder-only LM — the 4D-parallel flagship.
+
+Ref (capability target): the reference's ERNIE/GPT-era model-parallel LMs
+built on c_allgather/c_reducescatter collective ops and Fleet hybrid
+parallelism. TPU-native design:
+
+- dp: batch sharded on the 'data' mesh axis (grad psum by GSPMD)
+- tp: Column/RowParallel projections + VocabParallelEmbedding over 'model'
+- sp: activations sharded along sequence on 'sp' between attention blocks
+  (Megatron-SP style via sharding constraints); ring attention
+  (dist/ring_attention.py) is the long-context attention path
+- pp: GPTPipeline stacks per-layer params on a leading stage axis and runs
+  the GPipe schedule over the 'pipe' axis
+- everything compiles into ONE donated XLA executable via
+  DistributedTrainStep; bf16 activations with f32 softmax/normalization.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ... import ops
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn.layer import Layer, LayerList
+from ...nn import initializer as I
+from ...nn.layers.common import Linear, Dropout, Embedding
+from ...nn.layers.norm import LayerNorm
+from ...dist.tp_layers import (ColumnParallelLinear, RowParallelLinear,
+                               VocabParallelEmbedding, mark_sharding,
+                               _constrain)
+from ...dist.env import get_mesh
+
+__all__ = ["GPTConfig", "GPT", "GPTBlock", "gpt_loss", "GPTPipeline",
+           "gpt_tiny", "gpt_small"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden=768, layers=12, heads=12,
+                 max_seq=1024, dropout=0.1, mp_axis="model", sp_axis="sp",
+                 use_ring_attention=False, dtype="float32",
+                 initializer_range=0.02):
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.layers = layers
+        self.heads = heads
+        self.max_seq = max_seq
+        self.dropout = dropout
+        self.mp_axis = mp_axis
+        self.sp_axis = sp_axis
+        self.use_ring_attention = use_ring_attention
+        self.dtype = dtype
+        self.initializer_range = initializer_range
+
+
+def gpt_tiny(**kw):
+    return GPTConfig(vocab_size=1024, hidden=128, layers=2, heads=4,
+                     max_seq=128, **kw)
+
+
+def gpt_small(**kw):
+    return GPTConfig(vocab_size=50304, hidden=768, layers=12, heads=12, **kw)
+
+
+def _sp_constrain(x, cfg):
+    """Shard activations (B, L, D) along sequence on the sp axis."""
+    mesh = get_mesh()
+    if mesh is not None and cfg.sp_axis in mesh.shape and \
+            mesh.shape[cfg.sp_axis] > 1:
+        return _constrain(x, (None, cfg.sp_axis, None))
+    return x
+
+
+class GPTAttention(Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.heads = cfg.heads
+        self.head_dim = cfg.hidden // cfg.heads
+        std = cfg.initializer_range
+        self.qkv = ColumnParallelLinear(
+            cfg.hidden, 3 * cfg.hidden, gather_output=False,
+            weight_attr=I.Normal(0.0, std), mp_axis=cfg.mp_axis)
+        self.proj = RowParallelLinear(
+            cfg.hidden, cfg.hidden, input_is_parallel=True,
+            weight_attr=I.Normal(0.0, std / math.sqrt(2 * cfg.layers)),
+            mp_axis=cfg.mp_axis)
+        self.drop = Dropout(cfg.dropout)
+
+    def forward(self, x, cache=None):
+        B, L = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)
+        q, k, v = ops.split(qkv, 3, axis=-1)
+
+        def heads_of(t, l):
+            t = ops.reshape(t, [B, l, self.heads, self.head_dim])
+            return ops.transpose(t, [0, 2, 1, 3])
+
+        q, k, v = heads_of(q, L), heads_of(k, L), heads_of(v, L)
+        new_cache = None
+        if cache is not None:
+            pk, pv = cache
+            k = ops.concat([pk, k], axis=2)
+            v = ops.concat([pv, v], axis=2)
+            new_cache = (k, v)
+        mesh = get_mesh()
+        if self.cfg.use_ring_attention and cache is None and \
+                mesh is not None and self.cfg.sp_axis in mesh.shape and \
+                mesh.shape[self.cfg.sp_axis] > 1:
+            from ...dist.ring_attention import ring_attention
+
+            att = ring_attention(q, k, v, axis_name=self.cfg.sp_axis,
+                                 causal=True)
+        else:
+            att = F.sdpa_bhld(q, k, v, is_causal=cache is None,
+                              dropout_p=self.cfg.dropout,
+                              training=self.training)
+        att = ops.reshape(ops.transpose(att, [0, 2, 1, 3]),
+                          [B, L, self.cfg.hidden])
+        out = self.drop(self.proj(att))
+        return out if cache is None and new_cache is None else (out, new_cache)
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.ln1 = LayerNorm(cfg.hidden)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = LayerNorm(cfg.hidden)
+        std = cfg.initializer_range
+        self.fc1 = ColumnParallelLinear(cfg.hidden, 4 * cfg.hidden,
+                                        gather_output=False,
+                                        weight_attr=I.Normal(0.0, std),
+                                        mp_axis=cfg.mp_axis)
+        self.fc2 = RowParallelLinear(4 * cfg.hidden, cfg.hidden,
+                                     input_is_parallel=True,
+                                     weight_attr=I.Normal(
+                                         0.0, std / math.sqrt(2 * cfg.layers)),
+                                     mp_axis=cfg.mp_axis)
+        self.drop = Dropout(cfg.dropout)
+
+    def forward(self, x, cache=None):
+        if cache is None:
+            x = x + self.attn(self.ln1(x))
+            x = _sp_constrain(x, self.cfg)
+            x = x + self.drop(self.fc2(F.gelu(self.fc1(self.ln2(x)),
+                                              approximate=True)))
+            return _sp_constrain(x, self.cfg)
+        att, new_cache = self.attn(self.ln1(x), cache=cache)
+        x = x + att
+        x = x + self.drop(self.fc2(F.gelu(self.fc1(self.ln2(x)),
+                                          approximate=True)))
+        return x, new_cache
+
+
+class GPT(Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        std = cfg.initializer_range
+        self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden,
+                                          weight_attr=I.Normal(0.0, std),
+                                          mp_axis=cfg.mp_axis)
+        self.wpe = Embedding(cfg.max_seq, cfg.hidden,
+                             weight_attr=I.Normal(0.0, std))
+        self.drop = Dropout(cfg.dropout)
+        self.blocks = LayerList([GPTBlock(cfg) for _ in range(cfg.layers)])
+        self.ln_f = LayerNorm(cfg.hidden)
+        # LM head tied to wte (ref: weight sharing in GPT); logits computed
+        # against the (vocab-sharded) embedding matrix
+        if cfg.dtype != "float32":
+            self.astype(cfg.dtype)
+
+    def forward(self, ids, cache=None):
+        B, L = ids.shape[0], ids.shape[1]
+        pos0 = 0 if cache is None else cache[0][0].shape[2]
+        pos = ops.arange(pos0, pos0 + L, dtype="int64")
+        x = self.wte(ids) + self.wpe(pos)
+        x = self.drop(x)
+        x = _sp_constrain(x, self.cfg)
+        new_caches = [] if cache is not None else None
+        for i, blk in enumerate(self.blocks):
+            if cache is None:
+                x = blk(x)
+            else:
+                x, c = blk(x, cache=cache[i])
+                new_caches.append(c)
+        x = self.ln_f(x)
+        logits = ops.matmul(x, ops.transpose(self.wte.weight, [1, 0]))
+        logits = _constrain(logits, (None, None, None)) if \
+            get_mesh() is not None else logits
+        return logits if cache is None else (logits, new_caches)
+
+    def init_cache(self, batch_size):
+        import numpy as np
+
+        shape = (batch_size, self.cfg.heads, 0, self.cfg.hidden // self.cfg.heads)
+        z = Tensor(jnp.zeros(shape, self.wte.weight.dtype), _internal=True)
+        return [(z, z) for _ in range(self.cfg.layers)]
+
+    def generate(self, ids, max_new_tokens=32, temperature=1.0, top_k=None):
+        """Greedy/sampled decode with KV cache (eager path)."""
+        import numpy as np
+
+        cache = self.init_cache(ids.shape[0])
+        out = ids
+        cur = ids
+        for _ in range(max_new_tokens):
+            logits, cache = self.forward(cur, cache=cache)
+            last = logits[:, -1]
+            if temperature == 0.0:
+                nxt = ops.argmax(last, axis=-1, keepdim=True)
+            else:
+                last = last / temperature
+                if top_k is not None:
+                    kth = ops.topk(last, top_k, axis=-1)[0][:, -1:]
+                    last = ops.where(last < kth,
+                                     ops.full_like(last, -1e30), last)
+                probs = F.softmax(last, axis=-1)
+                nxt = ops.multinomial(probs, 1)
+            nxt = nxt.astype("int64")
+            out = ops.concat([out, nxt], axis=1)
+            cur = nxt
+        return out
+
+
+def gpt_loss(model, ids, labels):
+    """Next-token CE (labels already shifted)."""
+    logits = model(ids)
+    V = logits.shape[-1]
+    return F.cross_entropy(ops.reshape(logits, [-1, V]),
+                           ops.reshape(labels, [-1]))
+
+
+class GPTPipeline:
+    """Pipeline-parallel GPT forward: per-layer params stacked on a stage
+    axis sharded over 'pipe' (SURVEY §2 #23). Homogeneous blocks make the
+    GPipe schedule a plain lax.scan."""
+
+    def __init__(self, cfg, num_microbatches=4, axis_name="pipe"):
+        assert cfg.layers >= 1
+        self.cfg = cfg
+        self.num_microbatches = num_microbatches
+        self.axis_name = axis_name
+        ref = GPTBlock(cfg)
+        names = [n for n, _ in ref.named_parameters()]
+        stacks = {}
+        self._blocks = [GPTBlock(cfg) for _ in range(cfg.layers)]
+        for n in names:
+            stacks[n] = jnp.stack([dict(b.named_parameters())[n]._data
+                                   for b in self._blocks])
+        self.stacked = stacks
+        self.embed = GPT.__new__(GPT)  # embeddings handled by caller
+
+    def stage_fn(self, params, x):
+        """One block applied with explicit param arrays (pure)."""
+        cfg = self.cfg
+        blk = self._blocks[0]
+        named = dict(blk.named_parameters())
+        from ...framework.jit import _rebind
+
+        tensors = [named[n] for n in params]
+        arrays = [params[n] for n in params]
+        from ...core import dispatch
+
+        with _rebind(tensors, arrays), dispatch.no_grad():
+            out = blk(Tensor(x, _internal=True))
+        return out._data
+
+    def forward(self, x):
+        """x: (B, L, D) activations entering the block stack."""
+        from ...dist.pipeline import pipeline_forward
+
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        out = pipeline_forward(self.stage_fn, self.stacked, arr,
+                               self.num_microbatches, self.axis_name)
+        return Tensor(out, _internal=True) if isinstance(x, Tensor) else out
