@@ -215,11 +215,12 @@ class Parameter(Tensor):
         # A copied layer must NOT share parameter *names* with the source:
         # optimizer accumulators / EMA shadows are keyed by name, so a name
         # collision silently cross-wires their state (e.g. deepcopy'd
-        # Transformer layers). Values are shared (jax arrays are immutable);
-        # identity and name are fresh.
+        # Transformer layers). The buffer must be a fresh copy too — donated
+        # jit arguments reject the same buffer appearing twice.
         from ..utils import unique_name
 
-        p = Parameter(self._data, name=unique_name.generate(self.name),
+        p = Parameter(jnp.array(self._data, copy=True),
+                      name=unique_name.generate(self.name),
                       trainable=self.trainable)
         p.optimize_attr = dict(self.optimize_attr)
         p.regularizer = self.regularizer

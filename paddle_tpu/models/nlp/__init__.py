@@ -1,0 +1,8 @@
+"""NLP model zoo (ref: book ch4/6/8 + BERT/ERNIE/GPT era models)."""
+from . import gpt  # noqa: F401
+from .gpt import GPT, GPTConfig, gpt_loss, gpt_tiny, gpt_small  # noqa: F401
+from .word2vec import NGramLM, SkipGram, skipgram_loss  # noqa: F401
+from .sentiment import ConvSentiment, StackedLSTMSentiment  # noqa: F401
+from .transformer import WMTTransformer, wmt_loss, position_encoding  # noqa: F401
+from .bert import (BertConfig, BertModel, BertForPretraining, bert_base,  # noqa: F401
+                   bert_tiny, bert_pretrain_loss)

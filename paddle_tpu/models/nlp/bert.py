@@ -1,0 +1,134 @@
+"""BERT encoder with MLM + NSP pretraining heads.
+
+Ref (capability target): the reference-era BERT-Base pretrain recipe named
+in BASELINE.json ("BERT-Base pretrain (Fleet CollectiveOptimizer, fp16
+AMP)"). TPU-native: the encoder is jnp matmul/attention graphs that fuse
+into one XLA executable; recommended recipe is bf16 autocast (amp/) +
+data-parallel mesh + the pallas flash-attention path for long sequences.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ops
+from ...core.tensor import Tensor
+from ...nn import Layer
+from ...nn.layers.common import Linear, Embedding, Dropout
+from ...nn.layers.norm import LayerNorm
+from ...nn.layers.transformer import TransformerEncoder, TransformerEncoderLayer
+from ...nn import functional as F
+from ...nn import initializer as I
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining", "bert_base",
+           "bert_tiny", "bert_pretrain_loss"]
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
+                 intermediate=3072, max_position=512, type_vocab=2,
+                 dropout=0.1, initializer_range=0.02):
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.layers = layers
+        self.heads = heads
+        self.intermediate = intermediate
+        self.max_position = max_position
+        self.type_vocab = type_vocab
+        self.dropout = dropout
+        self.initializer_range = initializer_range
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_tiny(**kw):
+    kw.setdefault("vocab_size", 1024)
+    kw.setdefault("hidden", 128)
+    kw.setdefault("layers", 2)
+    kw.setdefault("heads", 2)
+    kw.setdefault("intermediate", 512)
+    kw.setdefault("max_position", 128)
+    return BertConfig(**kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        std = cfg.initializer_range
+        self.word = Embedding(cfg.vocab_size, cfg.hidden,
+                              weight_attr=I.Normal(0.0, std))
+        self.position = Embedding(cfg.max_position, cfg.hidden,
+                                  weight_attr=I.Normal(0.0, std))
+        self.token_type = Embedding(cfg.type_vocab, cfg.hidden,
+                                    weight_attr=I.Normal(0.0, std))
+        self.norm = LayerNorm(cfg.hidden)
+        self.drop = Dropout(cfg.dropout)
+
+    def forward(self, ids, token_type_ids=None):
+        L = ids.shape[1]
+        pos = ops.arange(0, L, dtype="int64")
+        x = self.word(ids) + self.position(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type(token_type_ids)
+        return self.drop(self.norm(x))
+
+
+class BertModel(Layer):
+    """Encoder trunk: embeddings -> N transformer layers -> pooled [CLS]."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = TransformerEncoderLayer(
+            cfg.hidden, cfg.heads, cfg.intermediate, dropout=cfg.dropout,
+            activation="gelu")
+        self.encoder = TransformerEncoder(enc_layer, cfg.layers)
+        self.pooler = Linear(cfg.hidden, cfg.hidden)
+
+    def attn_mask(self, attention_mask):
+        """(B, L) 1/0 -> additive (B, 1, 1, L) mask."""
+        if attention_mask is None:
+            return None
+        m = (1.0 - attention_mask.astype("float32")) * -1e30
+        return ops.unsqueeze(ops.unsqueeze(m, 1), 1)
+
+    def forward(self, ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(ids, token_type_ids)
+        x = self.encoder(x, src_mask=self.attn_mask(attention_mask))
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(Layer):
+    """MLM (tied decoder) + NSP heads over the trunk."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = Linear(cfg.hidden, cfg.hidden)
+        self.transform_norm = LayerNorm(cfg.hidden)
+        self.mlm_bias = self.create_parameter((cfg.vocab_size,), is_bias=True)
+        self.nsp = Linear(cfg.hidden, 2)
+
+    def forward(self, ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(ids, token_type_ids, attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        mlm_logits = ops.matmul(
+            h, ops.transpose(self.bert.embeddings.word.weight, [1, 0]))
+        mlm_logits = mlm_logits + self.mlm_bias
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+def bert_pretrain_loss(model, ids, token_type_ids, attention_mask,
+                       mlm_labels, nsp_labels, ignore_index=-100):
+    """Masked-LM CE (ignore_index for unmasked positions) + NSP CE."""
+    mlm_logits, nsp_logits = model(ids, token_type_ids, attention_mask)
+    V = mlm_logits.shape[-1]
+    mlm = F.cross_entropy(ops.reshape(mlm_logits, [-1, V]),
+                          ops.reshape(mlm_labels, [-1]),
+                          ignore_index=ignore_index)
+    nsp = F.cross_entropy(nsp_logits, nsp_labels)
+    return mlm + nsp

@@ -42,7 +42,7 @@ class GPTConfig:
     def __init__(self, vocab_size=50304, hidden=768, layers=12, heads=12,
                  max_seq=1024, dropout=0.1, mp_axis="model", sp_axis="sp",
                  use_ring_attention=False, dtype="float32",
-                 initializer_range=0.02):
+                 initializer_range=0.02, use_recompute=False):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.layers = layers
@@ -54,6 +54,7 @@ class GPTConfig:
         self.use_ring_attention = use_ring_attention
         self.dtype = dtype
         self.initializer_range = initializer_range
+        self.use_recompute = use_recompute  # jax.checkpoint per block
 
 
 def gpt_tiny(**kw):
@@ -185,7 +186,12 @@ class GPT(Layer):
         new_caches = [] if cache is not None else None
         for i, blk in enumerate(self.blocks):
             if cache is None:
-                x = blk(x)
+                if self.cfg.use_recompute and self.training:
+                    from ...framework.recompute import recompute
+
+                    x = recompute(blk, x)
+                else:
+                    x = blk(x)
             else:
                 x, c = blk(x, cache=cache[i])
                 new_caches.append(c)
@@ -194,6 +200,10 @@ class GPT(Layer):
         logits = _constrain(logits, (None, None, None)) if \
             get_mesh() is not None else logits
         return logits if cache is None else (logits, new_caches)
+
+    def set_recompute(self, value=True):
+        """fleet protocol: DistributedStrategy.recompute toggles this."""
+        self.cfg.use_recompute = bool(value)
 
     def init_cache(self, batch_size):
         import numpy as np

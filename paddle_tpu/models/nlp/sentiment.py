@@ -1,0 +1,62 @@
+"""Sentiment classification: text-CNN and stacked-LSTM nets.
+
+Ref (capability target): book ch.6,
+python/paddle/fluid/tests/book/test_understand_sentiment.py —
+``convolution_net`` (sequence_conv_pool x2 widths) and
+``stacked_lstm_net`` (fc+lstm stack, depth 3). TPU-native: the conv net is
+a batched dense conv over the embedded sequence (MXU); the LSTM stack runs
+as lax.scan cells (nn/layers/rnn.py) compiled into one fused loop.
+"""
+from __future__ import annotations
+
+from ... import ops
+from ...nn import Layer, LayerList
+from ...nn.layers.common import Linear, Embedding, Dropout
+from ...nn.layers.conv import Conv1D
+from ...nn.layers.rnn import LSTM
+from ...nn import functional as F
+
+__all__ = ["ConvSentiment", "StackedLSTMSentiment"]
+
+
+class ConvSentiment(Layer):
+    """Text-CNN: parallel conv widths -> max-pool-over-time -> FC."""
+
+    def __init__(self, vocab_size, embed_dim=128, num_filters=128,
+                 widths=(3, 4), num_classes=2, dropout=0.2):
+        super().__init__()
+        self.embed = Embedding(vocab_size, embed_dim)
+        self.convs = LayerList([
+            Conv1D(embed_dim, num_filters, w, padding=w // 2)
+            for w in widths])
+        self.drop = Dropout(dropout)
+        self.fc = Linear(num_filters * len(widths), num_classes)
+
+    def forward(self, ids):
+        """ids: (B, L) -> (B, num_classes) logits."""
+        e = self.embed(ids)                       # (B, L, E)
+        x = ops.transpose(e, [0, 2, 1])           # (B, E, L) for NCL conv
+        feats = []
+        for conv in self.convs:
+            h = F.tanh(conv(x))                   # (B, F, L')
+            feats.append(ops.max(h, axis=-1))     # pool over time
+        h = ops.concat(feats, axis=-1)
+        return self.fc(self.drop(h))
+
+
+class StackedLSTMSentiment(Layer):
+    """Depth-``num_layers`` LSTM stack, final max-pool over time -> FC."""
+
+    def __init__(self, vocab_size, embed_dim=128, hidden=128, num_layers=3,
+                 num_classes=2, dropout=0.2):
+        super().__init__()
+        self.embed = Embedding(vocab_size, embed_dim)
+        self.lstm = LSTM(embed_dim, hidden, num_layers=num_layers)
+        self.drop = Dropout(dropout)
+        self.fc = Linear(hidden, num_classes)
+
+    def forward(self, ids, seq_len=None):
+        e = self.embed(ids)                       # (B, L, E)
+        out, _ = self.lstm(e, sequence_length=seq_len)  # (B, L, H)
+        h = ops.max(out, axis=1)                  # max-pool over time
+        return self.fc(self.drop(h))

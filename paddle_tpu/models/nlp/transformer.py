@@ -1,0 +1,138 @@
+"""Transformer for WMT en-de machine translation.
+
+Ref (capability target): book ch.8 machine translation
+(python/paddle/fluid/tests/book/test_machine_translation.py) and the
+Fluid-era Transformer WMT recipe: encoder-decoder with sinusoidal position
+encoding, shared target embedding / output projection, label-smoothed CE,
+and beam-search decoding (inference/decoder.py provides the beam engine;
+greedy lives here).
+
+TPU-native: one fused jitted step; decode uses the incremental
+MultiHeadAttention caches so each new token is O(L) not O(L^2).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ops
+from ...core.tensor import Tensor
+from ...nn import Layer
+from ...nn.layers.common import Linear, Embedding, Dropout
+from ...nn.layers.transformer import Transformer
+from ...nn import functional as F
+
+__all__ = ["WMTTransformer", "wmt_loss", "position_encoding"]
+
+
+def position_encoding(max_len, d_model):
+    """Sinusoidal table (max_len, d_model), f32 numpy (baked constant)."""
+    pos = np.arange(max_len)[:, None].astype(np.float64)
+    dim = np.arange(0, d_model, 2).astype(np.float64)
+    inv = 1.0 / np.power(10000.0, dim / d_model)
+    table = np.zeros((max_len, d_model), np.float32)
+    table[:, 0::2] = np.sin(pos * inv)
+    table[:, 1::2] = np.cos(pos * inv)
+    return table
+
+
+class WMTTransformer(Layer):
+    """Encoder-decoder translation model with tied target softmax."""
+
+    def __init__(self, src_vocab, tgt_vocab, d_model=512, nhead=8,
+                 num_layers=6, dim_feedforward=2048, dropout=0.1,
+                 max_len=256, bos_id=0, eos_id=1):
+        super().__init__()
+        self.d_model = d_model
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+        self.max_len = max_len
+        self.src_embed = Embedding(src_vocab, d_model)
+        self.tgt_embed = Embedding(tgt_vocab, d_model)
+        self.pos_table = position_encoding(max_len, d_model)
+        self.drop = Dropout(dropout)
+        self.transformer = Transformer(
+            d_model, nhead, num_layers, num_layers, dim_feedforward, dropout)
+        # output projection tied to tgt embedding (ref WMT recipe)
+        self.tgt_vocab = tgt_vocab
+
+    def _embed(self, table, ids):
+        L = ids.shape[1]
+        x = table(ids) * float(np.sqrt(self.d_model))
+        pos = Tensor(self.pos_table[:L], _internal=True)
+        return self.drop(x + pos)
+
+    def _src_mask(self, src, pad_id=None):
+        if pad_id is None:
+            return None
+        # (B, 1, 1, L) additive mask
+        bad = ops.equal(src, ops.full_like(src, pad_id))
+        m = ops.where(bad, ops.full_like(src, -1e30, dtype="float32"),
+                      ops.full_like(src, 0.0, dtype="float32"))
+        return ops.unsqueeze(ops.unsqueeze(m, 1), 1)
+
+    def forward(self, src, tgt, src_pad_id=None):
+        """Teacher-forced logits: (B, Lt, tgt_vocab)."""
+        src_mask = self._src_mask(src, src_pad_id)
+        tgt_mask = Transformer.generate_square_subsequent_mask(tgt.shape[1])
+        memory = self.transformer.encoder(self._embed(self.src_embed, src),
+                                          src_mask=src_mask)
+        out = self.transformer.decoder(self._embed(self.tgt_embed, tgt),
+                                       memory, tgt_mask=tgt_mask,
+                                       memory_mask=src_mask)
+        return ops.matmul(out, ops.transpose(self.tgt_embed.weight, [1, 0]))
+
+    def encode(self, src, src_pad_id=None):
+        src_mask = self._src_mask(src, src_pad_id)
+        memory = self.transformer.encoder(self._embed(self.src_embed, src),
+                                          src_mask=src_mask)
+        return memory, src_mask
+
+    def decode_step(self, tgt_tok, memory, caches, pos, src_mask=None):
+        """One incremental decode step.
+
+        tgt_tok: (B, 1) current token; pos: int python position. Returns
+        (logits (B, vocab), new caches).
+        """
+        x = self.tgt_embed(tgt_tok) * float(np.sqrt(self.d_model))
+        pos_vec = Tensor(self.pos_table[pos:pos + 1], _internal=True)
+        x = x + pos_vec
+        out, new_caches = self.transformer.decoder(
+            x, memory, memory_mask=src_mask, cache=caches)
+        logits = ops.matmul(out[:, -1],
+                            ops.transpose(self.tgt_embed.weight, [1, 0]))
+        return logits, new_caches
+
+    def greedy_decode(self, src, max_len=None, src_pad_id=None):
+        """Eager greedy decode with KV caches."""
+        max_len = max_len or self.max_len
+        memory, src_mask = self.encode(src, src_pad_id)
+        caches = self.transformer.decoder.gen_cache(memory)
+        B = src.shape[0]
+        cur = ops.full([B, 1], self.bos_id, dtype="int64")
+        outs = [cur]
+        for t in range(max_len - 1):
+            logits, caches = self.decode_step(cur, memory, caches, t,
+                                              src_mask)
+            cur = ops.argmax(logits, axis=-1, keepdim=True).astype("int64")
+            outs.append(cur)
+        return ops.concat(outs, axis=1)
+
+
+def wmt_loss(model, src, tgt_in, tgt_label, smooth_eps=0.1, pad_id=None):
+    """Label-smoothed CE over non-pad target positions."""
+    logits = model(src, tgt_in, src_pad_id=pad_id)
+    V = logits.shape[-1]
+    flat = ops.reshape(logits, [-1, V])
+    lab = ops.reshape(tgt_label, [-1])
+    if smooth_eps and smooth_eps > 0.0:
+        one_hot = F.one_hot(lab, V)
+        soft = one_hot * (1.0 - smooth_eps) + smooth_eps / V
+        logp = F.log_softmax(flat, axis=-1)
+        per_tok = -ops.sum(soft * logp, axis=-1)
+    else:
+        per_tok = F.cross_entropy(flat, lab, reduction="none")
+    if pad_id is not None:
+        keep = ops.not_equal(lab, ops.full_like(lab, pad_id)).astype("float32")
+        return ops.sum(per_tok * keep) / ops.maximum(
+            ops.sum(keep), ops.full_like(ops.sum(keep), 1.0))
+    return ops.mean(per_tok)

@@ -1,0 +1,56 @@
+"""word2vec: N-gram neural LM + skip-gram with negative sampling.
+
+Ref (capability target): book ch.4,
+python/paddle/fluid/tests/book/test_word2vec.py — the N-gram model embeds
+4 context words, concats, hidden layer, softmax over the vocab. The
+skip-gram variant adds the modern negative-sampling objective (the
+reference trains it with hsigmoid/nce ops). TPU-native: both are pure
+embedding-lookup + matmul graphs, ideal MXU shapes when batched.
+"""
+from __future__ import annotations
+
+from ... import ops
+from ...nn import Layer
+from ...nn.layers.common import Linear, Embedding
+from ...nn import functional as F
+
+__all__ = ["NGramLM", "SkipGram", "skipgram_loss"]
+
+
+class NGramLM(Layer):
+    """Embeds ``context_size`` words; predicts the next word."""
+
+    def __init__(self, vocab_size, embed_dim=32, hidden=256, context_size=4):
+        super().__init__()
+        self.context_size = context_size
+        self.embed = Embedding(vocab_size, embed_dim)
+        self.fc1 = Linear(context_size * embed_dim, hidden)
+        self.fc2 = Linear(hidden, vocab_size)
+
+    def forward(self, words):
+        """words: (B, context_size) int ids -> (B, vocab) logits."""
+        e = self.embed(words)                       # (B, C, E)
+        e = ops.reshape(e, [e.shape[0], -1])
+        h = F.relu(self.fc1(e))
+        return self.fc2(h)
+
+
+class SkipGram(Layer):
+    """Center/context embedding towers; score = dot product."""
+
+    def __init__(self, vocab_size, embed_dim=64):
+        super().__init__()
+        self.center = Embedding(vocab_size, embed_dim)
+        self.context = Embedding(vocab_size, embed_dim)
+
+    def forward(self, center, context):
+        """(B,) center ids x (B, K) candidate ids -> (B, K) logits."""
+        c = self.center(center)                     # (B, E)
+        t = self.context(context)                   # (B, K, E)
+        return ops.squeeze(ops.matmul(t, ops.unsqueeze(c, -1)), -1)
+
+
+def skipgram_loss(model, center, context, label):
+    """Negative-sampling BCE: label 1 for true context, 0 for negatives."""
+    logits = model(center, context)
+    return F.binary_cross_entropy_with_logits(logits, label)

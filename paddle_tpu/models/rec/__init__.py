@@ -1,0 +1,2 @@
+"""Recommender model zoo (ref: book ch5 recommender system)."""
+from .recommender import TwoTowerRecommender, DeepFM, rating_loss  # noqa: F401

@@ -1,0 +1,85 @@
+"""Recommender system: dual-tower user/item model + DeepFM-style ranker.
+
+Ref (capability target): book ch.5,
+python/paddle/fluid/tests/book/test_recommender_system.py — user tower
+(id/gender/age/job embeddings -> fc) and movie tower (id/category/title
+-> fc), cosine similarity scaled to a 0-5 rating, squared loss. DeepFM
+adds the factorization-machine + deep ranker used by the Fluid-era
+PaddleRec models.
+"""
+from __future__ import annotations
+
+from ... import ops
+from ...nn import Layer, LayerList
+from ...nn.layers.common import Linear, Embedding
+from ...nn import functional as F
+
+__all__ = ["TwoTowerRecommender", "DeepFM", "rating_loss"]
+
+
+class TwoTowerRecommender(Layer):
+    """Dual-tower matching model; score = 5 * cos_sim(user, item)."""
+
+    def __init__(self, n_users, n_items, n_genders=2, n_ages=7, n_jobs=21,
+                 n_categories=19, embed_dim=32, hidden=200):
+        super().__init__()
+        self.u_id = Embedding(n_users, embed_dim)
+        self.u_gender = Embedding(n_genders, 16)
+        self.u_age = Embedding(n_ages, 16)
+        self.u_job = Embedding(n_jobs, 16)
+        self.u_fc = Linear(embed_dim + 48, hidden)
+        self.i_id = Embedding(n_items, embed_dim)
+        self.i_cat = Embedding(n_categories, embed_dim)
+        self.i_fc = Linear(2 * embed_dim, hidden)
+
+    def user_tower(self, uid, gender, age, job):
+        h = ops.concat([self.u_id(uid), self.u_gender(gender),
+                        self.u_age(age), self.u_job(job)], axis=-1)
+        return F.tanh(self.u_fc(h))
+
+    def item_tower(self, iid, cat):
+        h = ops.concat([self.i_id(iid), self.i_cat(cat)], axis=-1)
+        return F.tanh(self.i_fc(h))
+
+    def forward(self, uid, gender, age, job, iid, cat):
+        u = self.user_tower(uid, gender, age, job)
+        i = self.item_tower(iid, cat)
+        sim = F.cosine_similarity(u, i, axis=-1)
+        return 5.0 * sim
+
+
+class DeepFM(Layer):
+    """FM second-order interactions + deep MLP over shared embeddings.
+
+    fields: list of vocabulary sizes, one sparse feature per field.
+    """
+
+    def __init__(self, fields, embed_dim=16, hidden=(400, 400, 400)):
+        super().__init__()
+        self.embeds = LayerList([Embedding(v, embed_dim) for v in fields])
+        self.linears = LayerList([Embedding(v, 1) for v in fields])
+        dims = [len(fields) * embed_dim] + list(hidden)
+        self.mlp = LayerList([Linear(dims[i], dims[i + 1])
+                              for i in range(len(hidden))])
+        self.out = Linear(dims[-1], 1)
+
+    def forward(self, *field_ids):
+        """field_ids: one (B,) int tensor per field -> (B,) logit."""
+        vs = [emb(ids) for emb, ids in zip(self.embeds, field_ids)]  # (B,E)
+        first = ops.concat([lin(ids) for lin, ids in
+                            zip(self.linears, field_ids)], axis=-1)
+        first = ops.sum(first, axis=-1)                      # (B,)
+        V = ops.stack(vs, axis=1)                            # (B, F, E)
+        sum_sq = ops.sum(V, axis=1) ** 2                     # (B, E)
+        sq_sum = ops.sum(V * V, axis=1)
+        fm = 0.5 * ops.sum(sum_sq - sq_sum, axis=-1)         # (B,)
+        h = ops.reshape(V, [V.shape[0], -1])
+        for fc in self.mlp:
+            h = F.relu(fc(h))
+        deep = ops.squeeze(self.out(h), -1)
+        return first + fm + deep
+
+
+def rating_loss(model, uid, gender, age, job, iid, cat, rating):
+    pred = model(uid, gender, age, job, iid, cat)
+    return F.mse_loss(pred, rating)

@@ -1,0 +1,6 @@
+"""Vision model zoo (ref: book ch2/3 — LeNet/MNIST, ResNet/VGG/MobileNet)."""
+from .lenet import LeNet  # noqa: F401
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F401
+                     resnet152)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .mobilenet import MobileNetV1, MobileNetV2  # noqa: F401

@@ -1,0 +1,39 @@
+"""LeNet-5 for MNIST.
+
+Ref (capability target): the reference's book ch.2 recognize-digits CNN,
+python/paddle/fluid/tests/book/test_recognize_digits.py (conv_net: two
+conv+pool blocks then FC softmax). TPU-native: plain NCHW convs — XLA's
+layout assignment picks the TPU-friendly layout, so no manual transposes.
+"""
+from __future__ import annotations
+
+from ... import ops
+from ...nn import Layer, Sequential
+from ...nn.layers.common import Linear
+from ...nn.layers.conv import Conv2D
+from ...nn.layers.pooling import MaxPool2D
+from ...nn.layers.activation import ReLU
+from ...nn import functional as F
+
+__all__ = ["LeNet"]
+
+
+class LeNet(Layer):
+    """Classic LeNet-5 (num_classes logits; feed (B, 1, 28, 28))."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1), ReLU(),
+            MaxPool2D(2, 2),
+            Conv2D(6, 16, 5, stride=1, padding=0), ReLU(),
+            MaxPool2D(2, 2))
+        self.fc = Sequential(
+            Linear(400, 120), ReLU(),
+            Linear(120, 84), ReLU(),
+            Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = ops.flatten(x, 1)
+        return self.fc(x)

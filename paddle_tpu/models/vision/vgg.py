@@ -1,0 +1,71 @@
+"""VGG family (11/13/16/19, optional BN).
+
+Ref (capability target): book ch.3 vgg16_bn_drop in
+python/paddle/fluid/tests/book/test_image_classification.py (conv blocks +
+dropout + BN'd FC head).
+"""
+from __future__ import annotations
+
+from ... import ops
+from ...nn import Layer, Sequential
+from ...nn.layers.common import Linear, Dropout
+from ...nn.layers.conv import Conv2D
+from ...nn.layers.norm import BatchNorm2D, BatchNorm1D
+from ...nn.layers.pooling import MaxPool2D, AdaptiveAvgPool2D
+from ...nn.layers.activation import ReLU
+from ...nn import functional as F
+
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
+
+_CFGS = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(Layer):
+    def __init__(self, depth=16, num_classes=1000, batch_norm=True,
+                 in_channels=3, dropout=0.5):
+        super().__init__()
+        layers = []
+        cin = in_channels
+        for v in _CFGS[depth]:
+            if v == "M":
+                layers.append(MaxPool2D(2, 2))
+            else:
+                layers.append(Conv2D(cin, v, 3, padding=1))
+                if batch_norm:
+                    layers.append(BatchNorm2D(v))
+                layers.append(ReLU())
+                cin = v
+        self.features = Sequential(*layers)
+        self.avgpool = AdaptiveAvgPool2D(7)
+        self.classifier = Sequential(
+            Linear(512 * 7 * 7, 4096), ReLU(), Dropout(dropout),
+            Linear(4096, 4096), ReLU(), Dropout(dropout),
+            Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(ops.flatten(x, 1))
+
+
+def vgg11(**kw):
+    return VGG(11, **kw)
+
+
+def vgg13(**kw):
+    return VGG(13, **kw)
+
+
+def vgg16(**kw):
+    return VGG(16, **kw)
+
+
+def vgg19(**kw):
+    return VGG(19, **kw)

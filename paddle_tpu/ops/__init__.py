@@ -67,6 +67,28 @@ def _rops():
     Tensor.__xor__ = compare.logical_xor
 
 
+# Flat namespace: every public op is reachable as ``ops.<name>`` (analog of
+# the reference's single fluid.layers namespace). Submodule attributes and
+# registry infrastructure keep precedence.
+def _flatten_namespace():
+    import types
+
+    g = globals()
+    skip = {"apply", "register", "Tensor", "unwrap", "convert_dtype",
+            "OP_REGISTRY"}
+    for mod in (math, creation, manipulation, reduction, compare, activation,
+                linalg, conv, norm_ops, sequence, control_flow, random_ops):
+        public = getattr(mod, "__all__", None) or [
+            n for n in dir(mod) if not n.startswith("_")]
+        for n in public:
+            v = getattr(mod, n)
+            if n in skip or isinstance(v, types.ModuleType) or n in g:
+                continue
+            g[n] = v
+
+
+_flatten_namespace()
+
 _METHODS = {}
 
 
