@@ -36,9 +36,31 @@ def _reduce(loss, reduction):
 # -- cross entropy ----------------------------------------------------------
 
 
+def _fused_ce_ok(logits, label, weight, axis, use_softmax, label_smoothing):
+    """Route to the pallas fused softmax-CE kernel for the common LM-head
+    case: 2D (N, V) logits, hard int labels, no class weights."""
+    from ...ops import pallas as pk
+
+    return (pk.enabled() and weight is None and use_softmax and
+            label_smoothing == 0.0 and axis in (-1, logits.ndim - 1) and
+            logits.ndim == 2 and label.ndim in (1, 2) and
+            logits.shape[0] % 8 == 0 and logits.shape[1] % 128 == 0)
+
+
 @register("cross_entropy_hard")
 def _ce_hard(logits, label, weight, *, axis, ignore_index, reduction,
              use_softmax, label_smoothing):
+    if _fused_ce_ok(logits, label, weight, axis, use_softmax,
+                    label_smoothing):
+        from ...ops import pallas as pk
+
+        lab = label if label.ndim == 1 else jnp.squeeze(label, axis=-1)
+        loss = pk.softmax_cross_entropy(logits, lab, int(ignore_index),
+                                        pk.auto_interpret())
+        if reduction == "mean":
+            valid = (lab != ignore_index).astype(jnp.float32)
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1e-12)
+        return _reduce(loss, reduction)
     lf = logits.astype(jnp.float32)
     n_cls = lf.shape[axis]
     logp = jax.nn.log_softmax(lf, axis=axis) if use_softmax else jnp.log(

@@ -62,6 +62,20 @@ def batch_norm(x, running_mean, running_var, weight, bias, training=False,
 
 @register("layer_norm")
 def _layer_norm(x, weight, bias, *, epsilon, begin_norm_axis):
+    # Pallas fused path for the common last-axis case with 1D scale/shift
+    # (ref: the hand-fused layer_norm_op.cu) — one VMEM pass + fused bwd.
+    if begin_norm_axis == x.ndim - 1 and weight.ndim == 1 and \
+            bias.ndim == 1:
+        from . import pallas as pk
+
+        D = x.shape[-1]
+        N = 1
+        for s in x.shape[:-1]:
+            N *= s
+        if pk.enabled() and D % 128 == 0 and N % 8 == 0:
+            out = pk.fused_layer_norm(x.reshape(N, D), weight, bias,
+                                      float(epsilon), pk.auto_interpret())
+            return out.reshape(x.shape)
     axes = tuple(range(begin_norm_axis, x.ndim))
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axes, keepdims=True)

@@ -1,0 +1,56 @@
+"""Pallas TPU kernels (SURVEY §2.39).
+
+The reference ships ~500 hand-written CUDA kernels under
+paddle/fluid/operators; on TPU, XLA fusion covers most of them, and these
+pallas kernels cover the rest — the memory-bound fusions XLA can't do:
+
+- flash_attention: O(L)-memory blocked attention (fwd + custom_vjp bwd)
+- fused_layer_norm: one-pass moments+normalize (+ fused bwd)
+- softmax_cross_entropy: LM-head CE without materializing softmax
+
+``enabled()`` gates use: on by default on TPU backends, off elsewhere
+(the dense jnp paths remain the reference implementations and the CPU
+test oracle; interpret=True runs these same kernels on CPU for parity
+tests).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from .flash_attention import flash_attention
+from .layernorm import fused_layer_norm
+from .softmax_ce import softmax_cross_entropy
+
+__all__ = ["flash_attention", "fused_layer_norm", "softmax_cross_entropy",
+           "enabled", "set_enabled"]
+
+_FORCED = None  # None: auto (TPU only); True/False: explicit override
+
+
+def set_enabled(value):
+    """Force pallas kernels on/off (None restores platform auto-detect)."""
+    global _FORCED
+    _FORCED = value
+
+
+def enabled():
+    if _FORCED is not None:
+        return _FORCED
+    env = os.environ.get("PADDLE_TPU_PALLAS")
+    if env is not None:
+        return env not in ("0", "false", "off")
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def auto_interpret():
+    """Interpret-mode fallback so force-enabled kernels still run off-TPU
+    (the CPU test oracle for the wired call sites)."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True

@@ -1,0 +1,129 @@
+"""Fused LayerNorm as a pallas TPU kernel (fwd + custom_vjp bwd).
+
+TPU-native analog of the reference's hand-fused CUDA layer_norm kernel
+(paddle/fluid/operators/layer_norm_op.cu): one VMEM pass computes the
+moments, normalizes, and applies scale/shift; the backward kernel fuses
+the three-term gradient in a single pass. Stats are f32 even for bf16
+activations.
+
+Layout: (N, D) rows; callers flatten leading dims.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    y = xhat * g_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+    mean_ref[:] = mean[:, 0]
+    rstd_ref[:] = rstd[:, 0]
+
+
+def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref, dx_ref, dg_ref,
+                db_ref):
+    x = x_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    mean = mean_ref[:][:, None]
+    rstd = rstd_ref[:][:, None]
+    xhat = (x - mean) * rstd
+    wdy = dy * g
+    c1 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    c2 = jnp.mean(wdy, axis=-1, keepdims=True)
+    dx = (wdy - xhat * c1 - c2) * rstd
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    # per-block partial reductions; caller sums the grid axis
+    dg_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_ref[:] = jnp.sum(dy, axis=0, keepdims=True)
+
+
+def _pick_rows(N, want=256):
+    b = min(want, N)
+    while N % b:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_layer_norm(x, gamma, beta, eps=1e-5, interpret=False):
+    """x: (N, D); gamma/beta: (D,) -> (N, D)."""
+    y, _, _ = _ln_call(x, gamma, beta, eps, interpret)
+    return y
+
+
+def _ln_call(x, gamma, beta, eps, interpret):
+    N, D = x.shape
+    bn = _pick_rows(N)
+    kern = functools.partial(_fwd_kernel, eps=float(eps))
+    y, mean, rstd = pl.pallas_call(
+        kern,
+        grid=(N // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, D), lambda i: (i, 0)),
+            pl.BlockSpec((D,), lambda i: (0,)),
+            pl.BlockSpec((D,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, D), lambda i: (i, 0)),
+            pl.BlockSpec((bn,), lambda i: (i,)),
+            pl.BlockSpec((bn,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, D), x.dtype),
+            jax.ShapeDtypeStruct((N,), jnp.float32),
+            jax.ShapeDtypeStruct((N,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, gamma, beta)
+    return y, mean, rstd
+
+
+def _ln_fwd(x, gamma, beta, eps, interpret):
+    y, mean, rstd = _ln_call(x, gamma, beta, eps, interpret)
+    return y, (x, gamma, mean, rstd)
+
+
+def _ln_bwd(eps, interpret, res, dy):
+    x, gamma, mean, rstd = res
+    N, D = x.shape
+    bn = _pick_rows(N)
+    nblocks = N // bn
+    dx, dg_part, db_part = pl.pallas_call(
+        _bwd_kernel,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((bn, D), lambda i: (i, 0)),
+            pl.BlockSpec((D,), lambda i: (0,)),
+            pl.BlockSpec((bn,), lambda i: (i,)),
+            pl.BlockSpec((bn,), lambda i: (i,)),
+            pl.BlockSpec((bn, D), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, D), lambda i: (i, 0)),
+            pl.BlockSpec((1, D), lambda i: (i, 0)),
+            pl.BlockSpec((1, D), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, D), x.dtype),
+            jax.ShapeDtypeStruct((nblocks, D), jnp.float32),
+            jax.ShapeDtypeStruct((nblocks, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, gamma, mean, rstd, dy)
+    dgamma = jnp.sum(dg_part, axis=0).astype(gamma.dtype)
+    dbeta = jnp.sum(db_part, axis=0).astype(gamma.dtype)
+    return dx, dgamma, dbeta
+
+
+fused_layer_norm.defvjp(_ln_fwd, _ln_bwd)
