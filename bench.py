@@ -1,0 +1,214 @@
+"""Benchmark: flagship training throughput on one real TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+Workloads (BASELINE.json configs):
+  - BERT-Base pretrain step, seq 128 (headline: tokens/sec/chip)
+  - ResNet-50 train step (imgs/sec/chip)
+  - GPT-2-small train step, seq 1024 (tokens/sec/chip + MFU)
+
+All run the fused donated TrainStep (fwd+bwd+clip+update in one XLA
+executable), bf16 params with f32 master weights — the standard TPU
+recipe. vs_baseline compares against the reference's published-era GPU
+headline numbers recorded below (BASELINE.json `published` is empty, so
+these V100-fp16 figures stand in as the reference baseline).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+SMOKE = os.environ.get("PADDLE_TPU_BENCH_SMOKE") == "1"  # tiny-shape CPU run
+
+# Reference-era baselines (V100 fp16, PaddlePaddle ~1.7 headline figures):
+# BERT-Base pretrain seq128 ~200 seq/s = 25.6k tok/s; ResNet-50 ~980 img/s.
+BASELINE_BERT_TOKENS_S = 25600.0
+BASELINE_RESNET_IMGS_S = 980.0
+BASELINE_GPT_TOKENS_S = 25000.0  # GPT-2-small-class LM, V100 fp16
+
+PEAK_FLOPS = {  # per-chip peak bf16 FLOP/s
+    "TPU v5e": 197e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _peak_flops():
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_FLOPS.items():
+        if k.lower() in kind.lower():
+            return v
+    return 197e12
+
+
+def _time_step(step, batch, warmup=3, iters=10):
+    import jax
+
+    if SMOKE:
+        warmup, iters = 1, 2
+
+    for _ in range(warmup):
+        loss = step(*batch)
+    jax.block_until_ready(loss._data)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(*batch)
+    jax.block_until_ready(loss._data)
+    return (time.perf_counter() - t0) / iters, float(np.asarray(loss._data))
+
+
+def bench_bert(B=32, L=128):
+    import paddle_tpu as pt
+    from paddle_tpu import optim
+    from paddle_tpu.models.nlp.bert import (BertForPretraining, bert_base,
+                                            bert_pretrain_loss)
+
+    pt.seed(0)
+    cfg = bert_base()
+    model = BertForPretraining(cfg)
+    model.bfloat16()
+    opt = optim.AdamW(parameters=model.parameters(), learning_rate=1e-4,
+                      multi_precision=True,
+                      grad_clip=optim.ClipGradByGlobalNorm(1.0))
+    step = pt.TrainStep(model, opt, bert_pretrain_loss)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (B, L)).astype("int32")
+    tt = np.zeros((B, L), "int32")
+    am = np.ones((B, L), "int32")
+    mlm = np.where(rng.rand(B, L) < 0.15, ids, -100).astype("int32")
+    nsp = rng.randint(0, 2, (B,)).astype("int32")
+    dt, loss = _time_step(step, (ids, tt, am, mlm, nsp))
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    tokens_s = B * L / dt
+    mfu = 6.0 * n_params * B * L / dt / _peak_flops()
+    return {"tokens_per_sec": tokens_s, "step_ms": dt * 1e3, "mfu": mfu,
+            "loss": loss, "params": n_params}
+
+
+def bench_resnet50(B=64, size=224):
+    import paddle_tpu as pt
+    from paddle_tpu import optim
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models.vision import resnet50
+
+    pt.seed(0)
+    model = resnet50()
+    model.bfloat16()
+    opt = optim.Momentum(learning_rate=0.1, momentum=0.9,
+                         parameters=model.parameters(),
+                         multi_precision=True)
+    step = pt.TrainStep(
+        model, opt,
+        lambda m, x, y: F.cross_entropy(
+            m(x.astype("bfloat16")).astype("float32"), y))
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, 3, size, size).astype(np.float32)
+    y = rng.randint(0, 1000, (B,)).astype("int32")
+    dt, loss = _time_step(step, (x, y))
+    return {"imgs_per_sec": B / dt, "step_ms": dt * 1e3, "loss": loss}
+
+
+def bench_gpt(B=8, L=1024):
+    import paddle_tpu as pt
+    from paddle_tpu import optim
+    from paddle_tpu.models.nlp.gpt import GPT, GPTConfig, gpt_loss
+
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=50304, hidden=768, layers=12, heads=12,
+                    max_seq=L, dropout=0.0)
+    model = GPT(cfg)
+    model.bfloat16()
+    opt = optim.AdamW(parameters=model.parameters(), learning_rate=1e-4,
+                      multi_precision=True,
+                      grad_clip=optim.ClipGradByGlobalNorm(1.0))
+    step = pt.TrainStep(model, opt, gpt_loss)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (B, L)).astype("int32")
+    labels = np.roll(ids, -1, axis=1).astype("int32")
+    dt, loss = _time_step(step, (ids, labels))
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    tokens_s = B * L / dt
+    mfu = 6.0 * n_params * B * L / dt / _peak_flops()
+    return {"tokens_per_sec": tokens_s, "step_ms": dt * 1e3, "mfu": mfu,
+            "loss": loss, "params": n_params}
+
+
+def main():
+    import jax
+
+    if SMOKE:
+        jax.config.update("jax_platforms", "cpu")
+    _log(f"devices: {jax.devices()}")
+    global bench_bert, bench_resnet50, bench_gpt
+    if SMOKE:
+        import functools
+
+        bench_bert = functools.partial(bench_bert, B=2, L=128)
+        bench_resnet50 = functools.partial(bench_resnet50, B=2, size=64)
+        bench_gpt = functools.partial(bench_gpt, B=1, L=128)
+    extras = {}
+    results = {}
+    for name, fn in (("bert", bench_bert), ("resnet50", bench_resnet50),
+                     ("gpt", bench_gpt)):
+        try:
+            t0 = time.perf_counter()
+            results[name] = fn()
+            _log(f"{name}: {results[name]} "
+                 f"({time.perf_counter() - t0:.0f}s incl. compile)")
+        except Exception as e:  # keep the bench scoreable even if one fails
+            _log(f"{name} FAILED: {type(e).__name__}: {e}")
+
+    if "bert" in results:
+        headline = {
+            "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+            "value": round(results["bert"]["tokens_per_sec"], 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(
+                results["bert"]["tokens_per_sec"] / BASELINE_BERT_TOKENS_S, 3),
+        }
+        extras["bert_mfu"] = round(results["bert"]["mfu"], 4)
+    elif "gpt" in results:
+        headline = {
+            "metric": "gpt2_small_train_tokens_per_sec_per_chip",
+            "value": round(results["gpt"]["tokens_per_sec"], 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(
+                results["gpt"]["tokens_per_sec"] / BASELINE_GPT_TOKENS_S, 3),
+        }
+    elif "resnet50" in results:
+        headline = {
+            "metric": "resnet50_train_imgs_per_sec_per_chip",
+            "value": round(results["resnet50"]["imgs_per_sec"], 1),
+            "unit": "imgs/s",
+            "vs_baseline": round(
+                results["resnet50"]["imgs_per_sec"] / BASELINE_RESNET_IMGS_S,
+                3),
+        }
+    else:
+        headline = {"metric": "bench_failed", "value": 0.0, "unit": "none",
+                    "vs_baseline": 0.0}
+    if "resnet50" in results:
+        extras["resnet50_imgs_per_sec"] = round(
+            results["resnet50"]["imgs_per_sec"], 1)
+        extras["resnet50_vs_baseline"] = round(
+            results["resnet50"]["imgs_per_sec"] / BASELINE_RESNET_IMGS_S, 3)
+    if "gpt" in results:
+        extras["gpt_tokens_per_sec"] = round(
+            results["gpt"]["tokens_per_sec"], 1)
+        extras["gpt_mfu"] = round(results["gpt"]["mfu"], 4)
+    print(json.dumps({**headline, **extras}))
+
+
+if __name__ == "__main__":
+    main()

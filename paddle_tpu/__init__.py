@@ -77,6 +77,7 @@ from .ops.control_flow import cond, while_loop, case, switch_case, scan
 
 from . import nn
 from . import optim
+from . import amp
 from . import static_ as static
 from . import framework
 from . import io_ as io
@@ -93,6 +94,7 @@ distributed = _importlib.import_module(".dist", __name__)
 from .ops.linalg import dist  # noqa: E402,F811
 from .framework import jit as _jit_mod
 from .framework.jit import jit, to_static, TrainStep
+from .framework.recompute import recompute, Recompute
 from .framework.io import save, load
 from .static_ import enable_static, disable_static
 from .static_.program import program_guard, global_scope

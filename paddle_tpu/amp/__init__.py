@@ -1,0 +1,54 @@
+"""paddle_tpu.amp — automatic mixed precision.
+
+Ref: python/paddle/fluid/contrib/mixed_precision (decorator.py:218
+``decorate``, fp16_lists.py:20 ``AutoMixedPrecisionLists``, amp_nn.py
+dynamic loss scaling) and the paddle.amp 2.0 API. See autocast.py for the
+TPU-native design (dispatch-level casts instead of program rewriting).
+"""
+from .autocast import auto_cast, amp_guard, amp_state, cast_op_inputs  # noqa: F401
+from .lists import AutoMixedPrecisionLists, WHITE_LIST, BLACK_LIST  # noqa: F401
+from .grad_scaler import (  # noqa: F401
+    StaticLossScaler, DynamicLossScaler, GradScaler,
+)
+
+__all__ = [
+    "auto_cast", "amp_guard", "decorate", "AutoMixedPrecisionLists",
+    "StaticLossScaler", "DynamicLossScaler", "GradScaler",
+]
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """ref: decorator.py:218 / paddle.amp.decorate.
+
+    O2: cast model params to half precision; optimizers keep f32 master
+    weights (multi_precision). O1: no param cast (auto_cast does the work).
+    Returns (models, optimizers) with the same nesting the caller passed.
+    """
+    from ..nn.layer import Layer
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models or [])
+    from ..optim.optimizer import Optimizer
+
+    single_opt = isinstance(optimizers, Optimizer)
+    opt_list = [optimizers] if single_opt else list(optimizers or [])
+
+    if level == "O2":
+        for m in model_list:
+            m.astype(dtype)
+        for o in opt_list:
+            o._multi_precision = True
+            if master_weight is not False:
+                # refresh existing slots so masters materialize
+                for p in o._param_groups:
+                    if p.name in o._accumulators:
+                        del o._accumulators[p.name]
+    elif level != "O1":
+        raise ValueError(f"level must be O1 or O2, got {level}")
+
+    models_out = model_list[0] if single_model else model_list
+    opts_out = opt_list[0] if single_opt else opt_list
+    if optimizers is None:
+        return models_out
+    return models_out, opts_out

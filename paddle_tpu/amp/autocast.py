@@ -1,0 +1,89 @@
+"""auto_cast: per-op mixed-precision dispatch.
+
+Ref: python/paddle/fluid/contrib/mixed_precision/decorator.py:218 and
+paddle.amp.auto_cast (dygraph amp_guard). TPU-native: instead of
+rewriting a Program with cast ops, the eager dispatcher consults the
+active amp state and casts op inputs *inside* the traced computation —
+so the casts live in the vjp too, gradients arrive in the original param
+dtype, and under jit XLA fuses every cast into the adjacent kernel (zero
+copies on TPU; bf16 feeds the MXU directly).
+
+O1: params stay f32, white-listed ops compute in half precision.
+O2: `decorate` casts the whole model to half precision with f32 master
+weights in the optimizer; black-listed ops still run f32.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from .lists import AutoMixedPrecisionLists
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "amp_stack"):
+        _tls.amp_stack = []
+    return _tls.amp_stack
+
+
+class _AmpState:
+    __slots__ = ("dtype", "lists")
+
+    def __init__(self, dtype, lists):
+        self.dtype = dtype
+        self.lists = lists
+
+
+def amp_state():
+    """Innermost active auto_cast state, or None (consulted by dispatch)."""
+    st = _stack()
+    return st[-1] if st else None
+
+
+def _cast_tree(x, dtype):
+    if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) and \
+            x.dtype != dtype:
+        return x.astype(dtype)
+    return x
+
+
+def cast_op_inputs(name, arrays):
+    """Apply the active amp policy to one op's input arrays (called from
+    core.dispatch inside the differentiated function)."""
+    state = amp_state()
+    if state is None:
+        return arrays
+    if name in state.lists.white_list:
+        return [_cast_tree(a, state.dtype) for a in arrays]
+    if name in state.lists.black_list:
+        return [_cast_tree(a, jnp.float32) for a in arrays]
+    return arrays
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """ref: paddle.amp.auto_cast / fluid amp_guard.
+
+    dtype: 'bfloat16' (TPU-native) or 'float16'.
+    """
+    if not enable:
+        yield
+        return
+    if level not in ("O1", "O2"):
+        raise ValueError(f"level must be O1 or O2, got {level}")
+    jdtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
+    lists = AutoMixedPrecisionLists(custom_white_list, custom_black_list)
+    state = _AmpState(jdtype, lists)
+    _stack().append(state)
+    try:
+        yield
+    finally:
+        _stack().pop()
+
+
+amp_guard = auto_cast  # fluid-era alias

@@ -1,0 +1,164 @@
+"""Loss scaling (ref: python/paddle/fluid/contrib/mixed_precision/
+decorator.py OptimizerWithMixedPrecision + amp_nn.py
+update_loss_scaling, and paddle.amp.GradScaler).
+
+fp16 needs dynamic loss scaling to keep small gradients from flushing to
+zero; bf16 on TPU usually doesn't, but the machinery is here for parity
+and for fp16 workloads. The scaler state is a pytree of scalars so the
+whole update — scale, unscale, finite-check, conditional apply, scale
+adjustment — compiles INTO the fused train step (no host sync per step;
+the reference runs a separate update_loss_scaling op).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["StaticLossScaler", "DynamicLossScaler", "GradScaler"]
+
+
+class StaticLossScaler:
+    """Constant loss scale (ref: static loss_scaling in decorator.py)."""
+
+    use_dynamic = False
+
+    def __init__(self, init_loss_scaling=2.0 ** 15):
+        self.loss_scaling = float(init_loss_scaling)
+
+    def state(self):
+        return {"scale": jnp.float32(self.loss_scaling),
+                "good": jnp.int32(0)}
+
+    def update_state(self, state, found_inf):
+        return state
+
+
+class DynamicLossScaler:
+    """Grow scale after N clean steps; shrink on inf/nan
+    (ref: update_loss_scaling in amp_nn.py)."""
+
+    use_dynamic = True
+
+    def __init__(self, init_loss_scaling=2.0 ** 15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1):
+        self.loss_scaling = float(init_loss_scaling)
+        self.incr_ratio = float(incr_ratio)
+        self.decr_ratio = float(decr_ratio)
+        self.incr_every_n_steps = int(incr_every_n_steps)
+        self.decr_every_n_nan_or_inf = int(decr_every_n_nan_or_inf)
+
+    def state(self):
+        return {"scale": jnp.float32(self.loss_scaling),
+                "good": jnp.int32(0), "bad": jnp.int32(0)}
+
+    def update_state(self, state, found_inf):
+        """Pure: new scaler state from the finite-check flag."""
+        scale, good = state["scale"], state["good"]
+        bad = state.get("bad", jnp.int32(0))
+        good_new = jnp.where(found_inf, 0, good + 1)
+        bad_new = jnp.where(found_inf, bad + 1, 0)
+        grow = good_new >= self.incr_every_n_steps
+        shrink = bad_new >= self.decr_every_n_nan_or_inf
+        scale_new = jnp.where(
+            shrink, jnp.maximum(scale * self.decr_ratio, 1.0),
+            jnp.where(grow, scale * self.incr_ratio, scale))
+        good_new = jnp.where(grow, 0, good_new)
+        bad_new = jnp.where(shrink, 0, bad_new)
+        return {"scale": scale_new.astype(jnp.float32),
+                "good": good_new.astype(jnp.int32),
+                "bad": bad_new.astype(jnp.int32)}
+
+
+class GradScaler(DynamicLossScaler):
+    """paddle.amp.GradScaler API over the dynamic scaler (eager path).
+
+    For the fused path just pass the scaler to ``TrainStep(scaler=...)``;
+    this class additionally supports the explicit eager protocol:
+        scaled = scaler.scale(loss); scaled.backward()
+        scaler.step(opt); scaler.update()
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        super().__init__(init_loss_scaling, incr_ratio, decr_ratio,
+                         incr_every_n_steps, decr_every_n_nan_or_inf)
+        self._enable = bool(enable)
+        self.use_dynamic = bool(use_dynamic_loss_scaling)
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    is_use_dynamic_loss_scaling = lambda self: self.use_dynamic  # noqa: E731
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * float(self.loss_scaling)
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / float(self.loss_scaling)
+        found = False
+        for p in optimizer._param_groups:
+            if p.grad is not None:
+                g = p.grad._data * inv
+                found = found or bool(jnp.any(~jnp.isfinite(g)))
+                p.grad = Tensor(g, _internal=True)
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def minimize(self, optimizer, scaled_loss):
+        del scaled_loss  # backward already ran on it
+        self.step(optimizer)
+        self.update()
+
+    def update(self):
+        if not self._enable:
+            return
+        if self.use_dynamic:
+            if self._found_inf:
+                self._good = 0
+                self._bad = self._bad_py() + 1
+                if self._bad >= self.decr_every_n_nan_or_inf:
+                    self.loss_scaling = max(
+                        self.loss_scaling * self.decr_ratio, 1.0)
+                    self._bad = 0
+            else:
+                self._bad = 0
+                self._good = self._good_py() + 1
+                if self._good >= self.incr_every_n_steps:
+                    self.loss_scaling *= self.incr_ratio
+                    self._good = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def _good_py(self):
+        return getattr(self, "_good", 0)
+
+    def _bad_py(self):
+        return getattr(self, "_bad", 0)
+
+    def state_dict(self):
+        return {"scale": self.loss_scaling, "incr_ratio": self.incr_ratio,
+                "decr_ratio": self.decr_ratio,
+                "incr_every_n_steps": self.incr_every_n_steps,
+                "good_steps": self._good_py()}
+
+    def load_state_dict(self, state):
+        self.loss_scaling = float(state["scale"])
+        self._good = int(state.get("good_steps", 0))

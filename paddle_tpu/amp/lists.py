@@ -1,0 +1,48 @@
+"""AMP op lists (ref: python/paddle/fluid/contrib/mixed_precision/
+fp16_lists.py:20 AutoMixedPrecisionLists).
+
+White: MXU-bound ops that are fast and safe in half precision.
+Black: numerically sensitive ops kept in float32 (softmax/log/reductions/
+norm statistics).
+Everything else runs in whatever dtype its inputs arrive in (the
+reference's gray list — type promotion decides).
+"""
+from __future__ import annotations
+
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "dot", "linear",
+    "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+    "sdpa",
+}
+
+BLACK_LIST = {
+    "softmax", "log_softmax", "logsumexp",
+    "cross_entropy_hard", "cross_entropy_soft", "nll_loss", "kl_div",
+    "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "exp", "log", "log2", "log10", "log1p", "pow", "rsqrt",
+    "mean", "sum", "prod", "std", "var",
+    "layer_norm", "layer_norm_noaffine", "batch_norm", "group_norm",
+    "instance_norm", "norm", "cosine_similarity", "erf", "softplus",
+    "sigmoid_focal_loss", "ctc_loss",
+}
+
+
+class AutoMixedPrecisionLists:
+    """ref: fp16_lists.py AutoMixedPrecisionLists — resolved white/black
+    sets after applying user customization."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(WHITE_LIST)
+        self.black_list = set(BLACK_LIST)
+        both = set(custom_white_list or ()) & set(custom_black_list or ())
+        if both:
+            raise ValueError(f"ops {sorted(both)} in both custom lists")
+        if custom_white_list:
+            for op in custom_white_list:
+                self.black_list.discard(op)
+                self.white_list.add(op)
+        if custom_black_list:
+            for op in custom_black_list:
+                self.white_list.discard(op)
+                self.black_list.add(op)

@@ -154,6 +154,21 @@ def _all_float(out):
     return all(jnp.issubdtype(o.dtype, jnp.inexact) for o in outs)
 
 
+_amp_state = _cast_op_inputs = _nan_guard = None
+
+
+def _lazy_hooks():
+    """Bind the AMP / nan-guard hooks once (module-level import would be a
+    cycle: amp.grad_scaler -> core.tensor -> core.dispatch)."""
+    global _amp_state, _cast_op_inputs, _nan_guard
+    if _amp_state is None:
+        from ..amp.autocast import amp_state, cast_op_inputs
+        from ..utils import nan_guard
+
+        _amp_state, _cast_op_inputs, _nan_guard = \
+            amp_state, cast_op_inputs, nan_guard
+
+
 def apply(name, fn, *args, **attrs):
     """Run op ``name`` implemented by pure function ``fn``.
 
@@ -171,17 +186,34 @@ def apply(name, fn, *args, **attrs):
         _is_tensor(a) and not a.stop_gradient for a in args
     )
 
-    if need_grad:
+    # AMP: cast inputs per the active auto_cast policy INSIDE the
+    # differentiated function, so grads flow back in the original dtype and
+    # XLA fuses the casts into the op (paddle_tpu.amp.auto_cast). The
+    # helpers are imported once (cycle-safe) and the no-AMP hot path avoids
+    # any extra closure.
+    _lazy_hooks()
+    if _amp_state() is not None:
+        op_fn = lambda *xs: fn(*_cast_op_inputs(name, xs), **attrs)  # noqa: E731
+        if need_grad:
+            out, vjp_fn = jax.vjp(op_fn, *arrays)
+        else:
+            out = op_fn(*arrays)
+    elif need_grad:
         out, vjp_fn = jax.vjp(lambda *xs: fn(*xs, **attrs), *arrays)
-        if not _all_float(out):
-            # Non-differentiable outputs (argmax, comparisons...): keep the
-            # values, drop the tape record.
-            need_grad = False
     else:
         out = fn(*arrays, **attrs)
+    if need_grad and not _all_float(out):
+        # Non-differentiable outputs (argmax, comparisons...): keep the
+        # values, drop the tape record.
+        need_grad = False
 
     multi = isinstance(out, tuple)
     outs = out if multi else (out,)
+
+    if _nan_guard.check_nan_enabled() and not isinstance(
+            outs[0], jax.core.Tracer):
+        _nan_guard.check_op_outputs(name, outs)
+
     out_tensors = tuple(_wrap(o, stop_gradient=not need_grad) for o in outs)
 
     if need_grad:

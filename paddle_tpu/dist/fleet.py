@@ -95,6 +95,45 @@ class _Fleet:
     def build_train_step(self, model, optimizer, loss_fn, **kw):
         if self._strategy is not None and self._strategy.sharding:
             kw.setdefault("shard_opt_state", True)
+        if self._strategy is not None and self._strategy.recompute and \
+                hasattr(model, "set_recompute"):
+            model.set_recompute(True)
+        if self._strategy is not None and self._strategy.amp:
+            from .. import amp as amp_mod
+
+            cfgs = self._strategy.amp_configs or {}
+            dtype = cfgs.get("dtype", "bfloat16")
+            level = cfgs.get("level", "O1")
+            if level == "O2":
+                amp_mod.decorate(model, optimizer, level="O2", dtype=dtype)
+            # wrap the loss so white-listed ops compute in half precision —
+            # a scaler alone is NOT mixed precision
+            white = cfgs.get("custom_white_list")
+            black = cfgs.get("custom_black_list")
+            inner_loss = loss_fn
+
+            def loss_fn(m, *batch, _inner=inner_loss):  # noqa: F811
+                with amp_mod.auto_cast(custom_white_list=white,
+                                       custom_black_list=black,
+                                       dtype=dtype):
+                    return _inner(m, *batch)
+
+            if "scaler" not in kw:
+                use_dyn = cfgs.get("use_dynamic_loss_scaling",
+                                   dtype == "float16")
+                if use_dyn:
+                    kw["scaler"] = amp_mod.DynamicLossScaler(
+                        init_loss_scaling=cfgs.get("init_loss_scaling",
+                                                   2.0 ** 15),
+                        incr_ratio=cfgs.get("incr_ratio", 2.0),
+                        decr_ratio=cfgs.get("decr_ratio", 0.5),
+                        incr_every_n_steps=cfgs.get("incr_every_n_steps",
+                                                    1000),
+                        decr_every_n_nan_or_inf=cfgs.get(
+                            "decr_every_n_nan_or_inf", 1))
+                elif cfgs.get("init_loss_scaling") is not None:
+                    kw["scaler"] = amp_mod.StaticLossScaler(
+                        cfgs["init_loss_scaling"])
         return DistributedTrainStep(model, optimizer, loss_fn,
                                     mesh=get_mesh(), **kw)
 

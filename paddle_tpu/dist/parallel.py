@@ -44,9 +44,10 @@ class DistributedTrainStep(TrainStep):
 
     def __init__(self, model, optimizer, loss_fn, mesh=None,
                  batch_axis="data", batch_specs=None, models=None,
-                 donate=True, shard_opt_state=False):
+                 donate=True, shard_opt_state=False, scaler=None,
+                 check_nan=False):
         super().__init__(model, optimizer, loss_fn, models=models,
-                         donate=donate)
+                         donate=donate, scaler=scaler, check_nan=check_nan)
         self.mesh = mesh or get_mesh()
         if self.mesh is None:
             raise ValueError("no mesh: call dist.init_mesh(...) first")

@@ -69,10 +69,13 @@ class TrainStep:
     models (e.g. a frozen teacher) can be passed via ``models=[...]``.
     """
 
-    def __init__(self, model, optimizer, loss_fn, models=None, donate=True):
+    def __init__(self, model, optimizer, loss_fn, models=None, donate=True,
+                 scaler=None, check_nan=False):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
+        self.scaler = scaler  # amp.StaticLossScaler / DynamicLossScaler
+        self.check_nan = check_nan  # on-device finite check, host raise
         self._models = list(models) if models is not None else [model]
         if model not in self._models:
             self._models.insert(0, model)
@@ -81,6 +84,7 @@ class TrainStep:
                            if isinstance(p, Parameter) and p.trainable]
         self._donate = donate
         self._compiled = {}
+        self._scaler_state = scaler.state() if scaler is not None else {}
         # materialize optimizer slots eagerly so they join the carried state
         for p in self._trainable:
             optimizer._state_for(p)
@@ -91,8 +95,10 @@ class TrainStep:
         buffers = self._buffers
         trainable = self._trainable
         t_names = [p.name for p in trainable]
+        scaler = self.scaler
 
-        def pure(param_arrs, buf_arrs, opt_state, lr, key, batch):
+        def pure(param_arrs, buf_arrs, opt_state, lr, key, batch,
+                 scaler_state):
             # only TRAINABLE params are threaded as jit arguments; frozen
             # params stay bound to their concrete arrays and become XLA
             # constants in the compiled step
@@ -104,11 +110,34 @@ class TrainStep:
                 loss = self.loss_fn(self.model, *ts)
                 for p in trainable:
                     p.grad = None
-                loss.backward()
+                if scaler is not None:
+                    scale = scaler_state["scale"]
+                    (loss * Tensor(scale, _internal=True)).backward()
+                else:
+                    loss.backward()
                 grads = {p.name: (p.grad._data if p.grad is not None else None)
                          for p in trainable}
                 new_bufs = [b._data for b in buffers]
                 loss_val = loss._data
+
+            found_inf = jnp.bool_(False)
+            if scaler is not None:
+                # unscale + single fused finite-check over every grad
+                inv = 1.0 / scaler_state["scale"]
+                flags = []
+                for n in t_names:
+                    if grads[n] is not None:
+                        g = grads[n].astype(jnp.float32) * inv
+                        grads[n] = g
+                        flags.append(jnp.any(~jnp.isfinite(g)))
+                if flags:
+                    found_inf = jnp.stack(flags).any()
+            elif self.check_nan:
+                flags = [jnp.any(~jnp.isfinite(loss_val))]
+                for n in t_names:
+                    if grads[n] is not None:
+                        flags.append(jnp.any(~jnp.isfinite(grads[n])))
+                found_inf = jnp.stack(flags).any()
 
             pgs = [(p, grads[p.name]) for p in trainable
                    if grads[p.name] is not None]
@@ -132,10 +161,24 @@ class TrainStep:
                 if master is not None:
                     ns_ = {**ns_, "master": np_}
                     np_ = np_.astype(new_params[p.name].dtype)
+                if scaler is not None:
+                    # inf/nan step: keep params and optimizer state frozen
+                    old_p, old_s = new_params[p.name], s
+                    np_ = jnp.where(found_inf, old_p, np_)
+                    ns_ = {k: jnp.where(found_inf, old_s[k], v)
+                           if k in old_s else v for k, v in ns_.items()}
                 new_params[p.name] = np_
                 new_state[p.name] = ns_
+            if scaler is not None:
+                # skipped step: buffer updates (e.g. BN running stats) from
+                # the overflowed forward must not be committed either
+                new_bufs = [jnp.where(found_inf, old, new)
+                            for old, new in zip(buf_arrs, new_bufs)]
+            new_scaler_state = scaler.update_state(scaler_state, found_inf) \
+                if scaler is not None else scaler_state
             return loss_val, [new_params[n] for n in t_names], new_bufs, \
-                {n: new_state[n] for n in t_names}
+                {n: new_state[n] for n in t_names}, new_scaler_state, \
+                found_inf
 
         return pure
 
@@ -154,15 +197,23 @@ class TrainStep:
         buf_arrs = [b._data for b in self._buffers]
         lr = jnp.float32(opt.get_lr())
         key = prandom.next_key()
-        loss, new_params, new_bufs, new_state = fn(
-            param_arrs, buf_arrs, opt_state, lr, key, arrays)
+        loss, new_params, new_bufs, new_state, new_scaler, found_bad = fn(
+            param_arrs, buf_arrs, opt_state, lr, key, arrays,
+            self._scaler_state)
         for p, a in zip(self._trainable, new_params):
             p._data = a
         for b, a in zip(self._buffers, new_bufs):
             b._data = a
         for n, s in new_state.items():
             opt._accumulators[n] = s
+        self._scaler_state = new_scaler
         opt._global_step += 1
+        if self.check_nan and self.scaler is None and bool(found_bad):
+            from ..utils.nan_guard import NanInfError
+
+            raise NanInfError(
+                f"NaN/Inf in loss or gradients at step {opt._global_step} "
+                f"(loss={float(np.asarray(loss))})")
         return Tensor(loss, _internal=True)
 
 

@@ -1,0 +1,85 @@
+"""Recompute (gradient checkpointing) — SURVEY §2.12.
+
+Ref: the reference's forward-recomputation machinery
+(python/paddle/fluid/incubate/fleet RecomputeOptimizer / recompute
+segments). TPU-native: ``jax.checkpoint`` on the sub-graph — the forward
+runs normally, residuals inside the segment are dropped, and the backward
+pass rematerializes them from the segment inputs. Trades FLOPs for HBM,
+the standard lever for deep transformer stacks on TPU.
+
+Works in eager mode and (the real use) inside the fused TrainStep trace:
+the whole recompute region becomes one tape node whose vjp is the
+jax.checkpoint'd vjp.
+
+Limitation: the segment must be functionally pure w.r.t. its parameters —
+buffer mutations inside (e.g. BatchNorm running stats) do not propagate
+out of the recompute region. Transformer blocks (LayerNorm) are fine.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core import dispatch
+from ..core.tensor import Tensor, Parameter
+
+__all__ = ["recompute", "Recompute"]
+
+
+def _segment_params(function, models):
+    from ..nn.layer import Layer
+
+    layers = []
+    if isinstance(function, Layer):
+        layers.append(function)
+    for m in models or ():
+        layers.append(m)
+    params, seen = [], set()
+    for layer in layers:
+        for _, p in layer.named_parameters():
+            if id(p) not in seen:
+                seen.add(id(p))
+                params.append(p)
+        for _, b in layer.named_buffers():
+            if b is not None and id(b) not in seen:
+                seen.add(id(b))
+                params.append(b)
+    return params
+
+
+def recompute(function, *args, models=None, **kwargs):
+    """Run ``function(*args)`` under gradient checkpointing.
+
+    function: a Layer (its parameters are discovered automatically) or any
+    callable over Tensors (pass the Layers it closes over via ``models``).
+    """
+    from .jit import _rebind
+
+    params = _segment_params(function, models)
+    n = len(params)
+
+    def pure(*arrays):
+        p_arr, x_arr = list(arrays[:n]), arrays[n:]
+        with _rebind(params, p_arr), dispatch.fresh_tape():
+            ts = [Tensor(a, _internal=True) for a in x_arr]
+            out = function(*ts, **kwargs)
+            if isinstance(out, (tuple, list)):
+                return tuple(o._data if isinstance(o, Tensor) else o
+                             for o in out)
+            return out._data if isinstance(out, Tensor) else out
+
+    wrapped = jax.checkpoint(pure)
+    return dispatch.apply("recompute", wrapped, *params, *args)
+
+
+class Recompute:
+    """Layer wrapper: ``Recompute(block)(x)`` == block(x) with segment
+    checkpointing (ref: RecomputeOptimizer's segment list)."""
+
+    def __init__(self, layer):
+        self._layer = layer
+
+    def __call__(self, *args, **kwargs):
+        return recompute(self._layer, *args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layer"], name)

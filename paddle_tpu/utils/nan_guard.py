@@ -1,0 +1,84 @@
+"""NaN/Inf detection (ref: FLAGS_check_nan_inf consumed in
+paddle/fluid/framework/operator.cc:41 — after every op kernel the runtime
+scans outputs and aborts naming the op).
+
+Two modes, matching how TPU programs actually run:
+- eager debug mode (``enable_check_nan()``): the dispatcher host-checks
+  every op's outputs right after execution and raises with the op name —
+  the direct analog of the reference flag. Forces a device sync per op, so
+  debug-only.
+- fused-step mode (``TrainStep(check_nan=True)``): the compiled step
+  returns a found-nonfinite flag computed on-device (loss + grads); the
+  host raises after the step. No per-op sync, usable in real training.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["enable_check_nan", "disable_check_nan", "check_nan_enabled",
+           "check_numerics", "NanInfError"]
+
+_ENABLED = False
+
+
+class NanInfError(FloatingPointError):
+    pass
+
+
+def enable_check_nan():
+    """Turn on per-op NaN/Inf checking in eager mode."""
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable_check_nan():
+    global _ENABLED
+    _ENABLED = False
+
+
+def check_nan_enabled():
+    return _ENABLED
+
+
+def _bad(arr):
+    return jnp.issubdtype(arr.dtype, jnp.inexact) and \
+        bool(jnp.any(~jnp.isfinite(arr)))
+
+
+def check_numerics(value, name="tensor"):
+    """Raise NanInfError if any leaf of ``value`` holds NaN/Inf.
+
+    Accepts arrays, Tensors, or nested lists/tuples/dicts of them.
+    """
+    from ..core.tensor import Tensor
+
+    def walk(v, path):
+        if isinstance(v, Tensor):
+            v = v._data
+        if isinstance(v, dict):
+            for k, x in v.items():
+                walk(x, f"{path}.{k}")
+        elif isinstance(v, (list, tuple)):
+            for i, x in enumerate(v):
+                walk(x, f"{path}[{i}]")
+        elif hasattr(v, "dtype"):
+            if _bad(v):
+                n_nan = int(jnp.sum(jnp.isnan(v)))
+                n_inf = int(jnp.sum(jnp.isinf(v)))
+                raise NanInfError(
+                    f"NaN/Inf found in {path}: shape={tuple(v.shape)} "
+                    f"nan={n_nan} inf={n_inf}")
+
+    walk(value, name)
+    return value
+
+
+def check_op_outputs(name, outs):
+    """Dispatcher hook: eager per-op check (debug flag on)."""
+    for i, o in enumerate(outs):
+        if hasattr(o, "dtype") and _bad(o):
+            raise NanInfError(
+                f"op '{name}' produced NaN/Inf in output {i} "
+                f"(shape={tuple(o.shape)}) — reference analog: "
+                f"FLAGS_check_nan_inf")

@@ -1,0 +1,230 @@
+"""AMP tests (model: reference contrib/tests/test_image_classification_fp16
+and mixed_precision unit tests — auto_cast lists, loss scaling, decorate)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optim as optim
+from paddle_tpu import amp
+
+
+class TestAutoCast:
+    def test_white_op_computes_half(self):
+        a = pt.to_tensor(np.random.randn(16, 16).astype("float32"))
+        b = pt.to_tensor(np.random.randn(16, 16).astype("float32"))
+        with amp.auto_cast(dtype="bfloat16"):
+            out = pt.matmul(a, b)
+        assert out.dtype == "bfloat16"
+        out2 = pt.matmul(a, b)
+        assert out2.dtype == "float32"
+
+    def test_black_op_stays_f32(self):
+        x = pt.to_tensor(np.random.randn(8, 8).astype("float32"))
+        with amp.auto_cast(dtype="bfloat16"):
+            h = pt.matmul(x, x)            # bf16
+            s = F.softmax(h)               # black: cast back to f32
+        assert s.dtype == "float32"
+
+    def test_custom_lists(self):
+        x = pt.to_tensor(np.random.randn(4, 4).astype("float32"))
+        with amp.auto_cast(custom_black_list=["matmul"]):
+            out = pt.matmul(x, x)
+        assert out.dtype == "float32"
+        with amp.auto_cast(custom_white_list=["softmax"]):
+            out = F.softmax(pt.matmul(x, x))
+        assert out.dtype == "bfloat16"
+
+    def test_disabled_passthrough(self):
+        x = pt.to_tensor(np.random.randn(4, 4).astype("float32"))
+        with amp.auto_cast(enable=False):
+            out = pt.matmul(x, x)
+        assert out.dtype == "float32"
+
+    def test_grads_arrive_in_param_dtype(self):
+        m = nn.Linear(8, 4)
+        x = pt.to_tensor(np.random.randn(2, 8).astype("float32"))
+        with amp.auto_cast(dtype="bfloat16"):
+            loss = m(x).astype("float32").sum()
+        loss.backward()
+        assert m.weight.grad is not None
+        assert m.weight.grad.dtype == "float32"  # same dtype as the param
+
+    def test_train_step_with_autocast_loss(self):
+        """auto_cast inside loss_fn is traced into the fused step."""
+        pt.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        opt = optim.Adam(1e-2, parameters=m.parameters())
+
+        def loss_fn(model, x, y):
+            with amp.auto_cast(dtype="bfloat16"):
+                out = model(x)
+            return F.mse_loss(out.astype("float32"), y)
+
+        step = pt.TrainStep(m, opt, loss_fn)
+        X = np.random.RandomState(0).randn(32, 8).astype("float32")
+        Y = np.random.RandomState(1).randn(32, 1).astype("float32")
+        losses = [float(step(X, Y)) for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+
+class TestLossScalers:
+    def test_dynamic_scaler_state_machine(self):
+        sc = amp.DynamicLossScaler(init_loss_scaling=1024.0, incr_ratio=2.0,
+                                   decr_ratio=0.5, incr_every_n_steps=2)
+        st = sc.state()
+        st = sc.update_state(st, jnp.bool_(False))
+        assert float(st["scale"]) == 1024.0 and int(st["good"]) == 1
+        st = sc.update_state(st, jnp.bool_(False))   # hits incr_every_n=2
+        assert float(st["scale"]) == 2048.0 and int(st["good"]) == 0
+        st = sc.update_state(st, jnp.bool_(True))    # overflow halves
+        assert float(st["scale"]) == 1024.0 and int(st["good"]) == 0
+
+    def test_static_scaler_fixed(self):
+        sc = amp.StaticLossScaler(128.0)
+        st = sc.state()
+        st2 = sc.update_state(st, jnp.bool_(True))
+        assert float(st2["scale"]) == 128.0
+
+    def test_fused_step_skips_update_on_inf(self):
+        """A loss that goes inf must leave params untouched and halve the
+        scale; a clean loss must update params."""
+        pt.seed(0)
+        m = nn.Linear(4, 1)
+        opt = optim.SGD(0.1, parameters=m.parameters())
+        scaler = amp.DynamicLossScaler(init_loss_scaling=8.0,
+                                       incr_every_n_steps=1000)
+
+        def loss_fn(model, x, y, bad):
+            # bad=1 blows the loss (and so the grads) up to inf
+            return F.mse_loss(model(x), y) * (1.0 + bad * np.float32(1e38))
+
+        step = pt.TrainStep(m, opt, loss_fn, scaler=scaler)
+        X = np.random.RandomState(0).randn(8, 4).astype("float32")
+        Y = np.random.RandomState(1).randn(8, 1).astype("float32")
+        w0 = m.weight.numpy().copy()
+        step(X, Y, np.float32(1.0))  # overflow step
+        np.testing.assert_array_equal(m.weight.numpy(), w0)
+        assert float(step._scaler_state["scale"]) == 4.0
+
+        step(X, Y, np.float32(0.0))  # clean step
+        assert not np.allclose(m.weight.numpy(), w0)
+        assert float(step._scaler_state["scale"]) == 4.0
+
+    def test_grad_scaler_eager_protocol(self):
+        pt.seed(1)
+        m = nn.Linear(4, 1)
+        opt = optim.SGD(0.1, parameters=m.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=16.0)
+        X = pt.to_tensor(np.random.randn(8, 4).astype("float32"))
+        Y = pt.to_tensor(np.random.randn(8, 1).astype("float32"))
+        w0 = m.weight.numpy().copy()
+        loss = F.mse_loss(m(X), Y)
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        assert not np.allclose(m.weight.numpy(), w0)
+        assert scaler.loss_scaling == 16.0  # no overflow, no growth yet
+
+
+class TestDecorate:
+    def test_o2_casts_model_and_enables_master(self):
+        m = nn.Linear(8, 8)
+        opt = optim.Adam(1e-3, parameters=m.parameters())
+        m2, opt2 = amp.decorate(m, opt, level="O2", dtype="bfloat16")
+        assert m2.weight.dtype == "bfloat16"
+        assert opt2._multi_precision
+        # master weights materialize on first state access
+        opt2._state_for(m2.weight)
+        assert opt2._accumulators[m2.weight.name]["master"].dtype == \
+            jnp.float32
+
+    def test_o2_trains(self):
+        pt.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        opt = optim.Adam(1e-2, parameters=m.parameters())
+        m, opt = amp.decorate(m, opt, level="O2", dtype="bfloat16")
+
+        def loss_fn(model, x, y):
+            return F.mse_loss(model(x.astype("bfloat16")).astype("float32"),
+                              y)
+
+        step = pt.TrainStep(m, opt, loss_fn)
+        X = np.random.RandomState(0).randn(32, 8).astype("float32")
+        Y = np.random.RandomState(1).randn(32, 1).astype("float32")
+        losses = [float(step(X, Y)) for _ in range(10)]
+        assert losses[-1] < losses[0]
+
+
+class TestReviewFixes:
+    def test_decr_every_n_nan_or_inf(self):
+        sc = amp.DynamicLossScaler(init_loss_scaling=1024.0,
+                                   decr_every_n_nan_or_inf=2)
+        st = sc.state()
+        st = sc.update_state(st, jnp.bool_(True))
+        assert float(st["scale"]) == 1024.0      # 1st bad: no shrink yet
+        st = sc.update_state(st, jnp.bool_(True))
+        assert float(st["scale"]) == 512.0       # 2nd consecutive: shrink
+        st = sc.update_state(st, jnp.bool_(True))
+        assert float(st["scale"]) == 512.0       # counter reset
+        st = sc.update_state(st, jnp.bool_(False))
+        st = sc.update_state(st, jnp.bool_(True))
+        assert float(st["scale"]) == 512.0       # non-consecutive: no shrink
+
+    def test_skipped_step_freezes_buffers(self):
+        """BN running stats must not absorb an overflowed forward."""
+        pt.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 1))
+        opt = optim.SGD(0.1, parameters=m.parameters())
+        scaler = amp.DynamicLossScaler(init_loss_scaling=8.0,
+                                       decr_every_n_nan_or_inf=1)
+
+        def loss_fn(model, x, y, bad):
+            # overflow the LOSS (grads go inf); the BN stats in this
+            # forward still receive a normal EMA update we must discard
+            return F.mse_loss(model(x), y) * \
+                (1.0 + bad * np.float32(1e38)) ** 2
+
+        step = pt.TrainStep(m, opt, loss_fn, scaler=scaler)
+        X = np.random.RandomState(0).randn(8, 4).astype("float32")
+        Y = np.random.RandomState(1).randn(8, 1).astype("float32")
+        step(X, Y, np.float32(0.0))  # clean step primes the stats
+        bn = m[1]
+        mean0 = bn._mean.numpy().copy()
+        step(X, Y, np.float32(1.0))  # overflowed step must be a no-op
+        np.testing.assert_array_equal(bn._mean.numpy(), mean0)
+        # sanity: a clean step DOES move the stats
+        step(X, Y, np.float32(0.0))
+        assert not np.array_equal(bn._mean.numpy(), mean0)
+
+    def test_fleet_amp_enables_half_compute(self):
+        from paddle_tpu.dist.fleet import DistributedStrategy, fleet
+        from paddle_tpu.dist import env as denv
+
+        strat = DistributedStrategy()
+        strat.dp_degree = -1
+        strat.amp = True
+        strat.amp_configs = {"dtype": "bfloat16"}
+        denv.set_mesh(None)
+        fleet.init(strategy=strat)
+        try:
+            pt.seed(0)
+            m = nn.Linear(8, 8)
+            opt = optim.SGD(0.1, parameters=m.parameters())
+            seen = {}
+
+            def loss_fn(model, x):
+                out = model(x)           # matmul under auto_cast -> bf16
+                seen["dtype"] = out.dtype
+                return (out.astype("float32") ** 2).mean()
+
+            step = fleet.build_train_step(m, opt, loss_fn)
+            step(np.random.RandomState(0).randn(8, 8).astype("float32"))
+            assert str(seen["dtype"]) == "bfloat16"
+        finally:
+            denv.set_mesh(None)

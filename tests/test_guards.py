@@ -1,0 +1,114 @@
+"""Recompute + NaN-guard tests (SURVEY §2.12 / §5; ref FLAGS_check_nan_inf
+in framework/operator.cc:41 and fleet RecomputeOptimizer)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optim as optim
+from paddle_tpu.utils import nan_guard
+
+
+class TestRecompute:
+    def _block(self):
+        pt.seed(0)
+        return nn.Sequential(nn.Linear(16, 64), nn.GELU(), nn.Linear(64, 16))
+
+    def test_same_output_and_grads(self):
+        blk = self._block()
+        x = pt.to_tensor(np.random.RandomState(0).randn(4, 16)
+                         .astype("float32"), stop_gradient=False)
+
+        out_plain = blk(x)
+        loss_plain = (out_plain * out_plain).mean()
+        loss_plain.backward()
+        g_plain = {n: p.grad.numpy().copy()
+                   for n, p in blk.named_parameters()}
+        for _, p in blk.named_parameters():
+            p.clear_grad()
+
+        out_rc = pt.recompute(blk, x)
+        loss_rc = (out_rc * out_rc).mean()
+        loss_rc.backward()
+        np.testing.assert_allclose(out_rc.numpy(), out_plain.numpy(),
+                                   rtol=1e-6)
+        for n, p in blk.named_parameters():
+            np.testing.assert_allclose(p.grad.numpy(), g_plain[n],
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_recompute_inside_train_step(self):
+        """jax.checkpoint region compiles into the fused step and trains."""
+        pt.seed(1)
+        blk = self._block()
+        head = nn.Linear(16, 1)
+        opt = optim.Adam(1e-2, parameters=list(blk.parameters()) +
+                         list(head.parameters()))
+
+        def loss_fn(model, x, y):
+            h = pt.recompute(model, x)
+            return F.mse_loss(head(h), y)
+
+        step = pt.TrainStep(blk, opt, loss_fn, models=[blk, head])
+        X = np.random.RandomState(0).randn(16, 16).astype("float32")
+        Y = np.random.RandomState(1).randn(16, 1).astype("float32")
+        losses = [float(step(X, Y)) for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+    def test_gpt_recompute_parity(self):
+        from paddle_tpu.models.nlp import GPT, gpt_tiny, gpt_loss
+
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 1024, (2, 16)).astype("int64")
+        labels = np.roll(ids, -1, 1)
+
+        def loss_with(flag):
+            pt.seed(7)
+            cfg = gpt_tiny(dropout=0.0, use_recompute=flag)
+            m = GPT(cfg)
+            loss = gpt_loss(m, pt.to_tensor(ids), pt.to_tensor(labels))
+            loss.backward()
+            g = [p.grad.numpy().copy() for _, p in
+                 sorted(m.named_parameters()) if p.grad is not None]
+            return float(loss.numpy()), g
+
+        l0, g0 = loss_with(False)
+        l1, g1 = loss_with(True)
+        assert np.isclose(l0, l1, rtol=1e-5)
+        for a, b in zip(g0, g1):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+class TestNanGuard:
+    def test_eager_op_check_names_op(self):
+        nan_guard.enable_check_nan()
+        try:
+            x = pt.to_tensor(np.array([1.0, -1.0], "float32"))
+            with pytest.raises(nan_guard.NanInfError, match="op 'log'"):
+                pt.log(x)  # log(-1) = nan
+        finally:
+            nan_guard.disable_check_nan()
+
+    def test_check_numerics_nested(self):
+        good = {"a": pt.to_tensor(np.ones(3, "float32"))}
+        nan_guard.check_numerics(good, "state")
+        bad = {"a": [pt.to_tensor(np.array([np.inf], "float32"))]}
+        with pytest.raises(nan_guard.NanInfError, match=r"state\.a\[0\]"):
+            nan_guard.check_numerics(bad, "state")
+
+    def test_train_step_check_nan_raises(self):
+        pt.seed(0)
+        m = nn.Linear(4, 1)
+        opt = optim.SGD(0.1, parameters=m.parameters())
+
+        def loss_fn(model, x, y, bad):
+            # bad=1 -> factor overflows to inf -> loss and grads go inf
+            return F.mse_loss(model(x), y) * \
+                (1.0 + bad * np.float32(1e38)) ** 2
+
+        step = pt.TrainStep(m, opt, loss_fn, check_nan=True)
+        X = np.random.RandomState(0).randn(8, 4).astype("float32")
+        Y = np.random.RandomState(1).randn(8, 1).astype("float32")
+        step(X, Y, np.float32(0.0))  # clean: no raise
+        with pytest.raises(nan_guard.NanInfError, match="step"):
+            step(X, Y, np.float32(1.0))
