@@ -78,6 +78,9 @@ from .ops.control_flow import cond, while_loop, case, switch_case, scan
 from . import nn
 from . import optim
 from . import amp
+from . import metrics
+from . import metrics as metric  # paddle.metric alias
+from . import distribution
 from . import static_ as static
 from . import framework
 from . import io_ as io

@@ -60,16 +60,24 @@ def _eager_shard_map(fn, x, axis_name):
     global mesh (the eager-mode path of the reference's c_* ops).
 
     Single-controller semantics: the GLOBAL array is the concatenation of
-    per-rank values along dim 0. A value that cannot shard over the axis
-    (scalar, or dim 0 not divisible) is already a global aggregate — the
-    collective is an identity on it, signalled by returning None.
+    per-rank values along dim 0. A scalar has no per-rank axis — it is
+    already the global aggregate, so the collective is an identity on it
+    (signalled by returning None). A non-scalar whose dim 0 does not
+    divide the axis size is an ERROR: silently skipping the reduction
+    would hand back unreduced per-rank data.
     """
     mesh = get_mesh()
     if mesh is None or axis_name is None:
         return None
     size = mesh.shape[axis_name]
-    if jnp.ndim(x) == 0 or x.shape[0] % size != 0:
+    if jnp.ndim(x) == 0:
         return None
+    if x.shape[0] % size != 0:
+        raise ValueError(
+            f"eager collective over axis '{axis_name}' (size {size}): "
+            f"leading dim {x.shape[0]} is not divisible — the global view "
+            f"must concatenate equal per-rank shards along dim 0. Reshape "
+            f"or pad the input, or run the collective inside shard_map.")
     spec = P(axis_name)
     mapped = jax.shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec)
     return mapped(x)

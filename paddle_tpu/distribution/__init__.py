@@ -1,0 +1,169 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Ref: python/paddle/fluid/layers/distributions.py (Uniform/Normal/
+Categorical sample, log_prob, kl_divergence, entropy) and the
+paddle.distribution 2.0 API. TPU-native: sampling uses the framework's
+threaded PRNG keys (core/random.py) so draws inside a jitted step are
+reproducible and trace-safe.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import random as prandom
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical", "Bernoulli",
+           "kl_divergence"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+def _wrap(a):
+    return Tensor(a, _internal=True)
+
+
+class Distribution:
+    """ref: distributions.py Distribution base."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..ops.math import exp
+
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low).astype(jnp.float32)
+        self.high = _arr(high).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(prandom.next_key(), shape, jnp.float32)
+        return _wrap(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.float32)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.log(self.high - self.low))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        z = jax.random.normal(prandom.next_key(), shape, jnp.float32)
+        return _wrap(self.loc + z * self.scale)
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.float32)
+        var = self.scale ** 2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var) -
+                     jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _wrap(0.5 + 0.5 * math.log(2 * math.pi) +
+                     jnp.log(self.scale))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits).astype(jnp.float32)
+
+    @property
+    def _logp(self):
+        return jax.nn.log_softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        return _wrap(jax.random.categorical(prandom.next_key(), self.logits,
+                                            shape=tuple(shape) +
+                                            self.logits.shape[:-1]))
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        return _wrap(jnp.take_along_axis(self._logp, v[..., None],
+                                         axis=-1)[..., 0])
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        p = jax.nn.softmax(self.logits, axis=-1)
+        return _wrap(-jnp.sum(p * self._logp, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs_ = jnp.clip(_arr(probs).astype(jnp.float32),
+                                   1e-7, 1 - 1e-7)
+            self.logits_ = jnp.log(self.probs_) - jnp.log1p(-self.probs_)
+        else:
+            self.logits_ = _arr(logits).astype(jnp.float32)
+            self.probs_ = jax.nn.sigmoid(self.logits_)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(prandom.next_key(),
+                               tuple(shape) + self.probs_.shape)
+        return _wrap((u < self.probs_).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.float32)
+        return _wrap(v * jnp.log(self.probs_) +
+                     (1 - v) * jnp.log1p(-self.probs_))
+
+    def entropy(self):
+        p = self.probs_
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+def kl_divergence(p, q):
+    """ref: distributions.py kl_divergence (closed forms per pair)."""
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        out = jnp.log((q.high - q.low) / (p.high - p.low))
+        ok = (q.low <= p.low) & (p.high <= q.high)
+        return _wrap(jnp.where(ok, out, jnp.inf))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        pp = jax.nn.softmax(p.logits, axis=-1)
+        return _wrap(jnp.sum(pp * (p._logp - q._logp), axis=-1))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        a, b = p.probs_, q.probs_
+        return _wrap(a * (jnp.log(a) - jnp.log(b)) +
+                     (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

@@ -1,0 +1,259 @@
+"""paddle_tpu.metrics — streaming evaluation metrics.
+
+Ref: python/paddle/fluid/metrics.py (MetricBase at :58, Accuracy at :435,
+Precision/Recall/Auc) and the paddle.metric 2.0 API. TPU-native notes:
+``update`` accepts device arrays or Tensors and does its accumulation with
+tiny host scalars — metrics never force a device sync inside a jitted
+step; call them on fetched outputs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Metric", "MetricBase", "Accuracy", "Precision", "Recall", "F1",
+           "Auc", "MAE", "MSE", "RMSE", "CompositeMetric", "accuracy"]
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        x = x._data
+    return np.asarray(x)
+
+
+class Metric:
+    """ref: metrics.py:58 MetricBase / paddle.metric.Metric."""
+
+    def __init__(self, name=None):
+        self._name = name or type(self).__name__
+
+    def name(self):
+        return self._name
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    # fluid-era aliases
+    def eval(self):
+        return self.accumulate()
+
+    def compute(self, pred, label, *args):
+        """hapi hook: map raw model outputs to update() inputs."""
+        return pred, label
+
+
+MetricBase = Metric
+
+
+def accuracy(input, label, k=1):
+    """Functional top-k accuracy (ref: fluid.layers.accuracy)."""
+    pred = _np(input)
+    lab = _np(label).reshape(-1)
+    if pred.ndim == 1:
+        top = pred.reshape(-1, 1)
+    else:
+        top = np.argsort(-pred, axis=-1)[:, :k]
+    hit = (top == lab[:, None]).any(axis=1)
+    return float(hit.mean())
+
+
+class Accuracy(Metric):
+    """ref: metrics.py:435 Accuracy (streaming top-k)."""
+
+    def __init__(self, topk=1, name=None):
+        super().__init__(name or "acc")
+        self.topk = topk if isinstance(topk, (tuple, list)) else (topk,)
+        self.reset()
+
+    def reset(self):
+        self.correct = np.zeros(len(self.topk), np.int64)
+        self.total = 0
+
+    def update(self, pred, label):
+        pred = _np(pred)
+        lab = _np(label).reshape(-1)
+        order = np.argsort(-pred, axis=-1)
+        for i, k in enumerate(self.topk):
+            hit = (order[:, :k] == lab[:, None]).any(axis=1)
+            self.correct[i] += int(hit.sum())
+        self.total += lab.shape[0]
+        return self.accumulate()
+
+    def accumulate(self):
+        if self.total == 0:
+            return 0.0 if len(self.topk) == 1 else [0.0] * len(self.topk)
+        accs = (self.correct / self.total).tolist()
+        return accs[0] if len(self.topk) == 1 else accs
+
+
+class Precision(Metric):
+    """Binary precision over thresholded scores (ref: metrics.py Precision)."""
+
+    def __init__(self, name=None, threshold=0.5):
+        super().__init__(name or "precision")
+        self.threshold = threshold
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, pred, label):
+        p = (_np(pred).reshape(-1) > self.threshold)
+        l = _np(label).reshape(-1).astype(bool)
+        self.tp += int((p & l).sum())
+        self.fp += int((p & ~l).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fp
+        return float(self.tp) / d if d else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name=None, threshold=0.5):
+        super().__init__(name or "recall")
+        self.threshold = threshold
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, pred, label):
+        p = (_np(pred).reshape(-1) > self.threshold)
+        l = _np(label).reshape(-1).astype(bool)
+        self.tp += int((p & l).sum())
+        self.fn += int((~p & l).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fn
+        return float(self.tp) / d if d else 0.0
+
+
+class F1(Metric):
+    def __init__(self, name=None, threshold=0.5):
+        super().__init__(name or "f1")
+        self._p = Precision(threshold=threshold)
+        self._r = Recall(threshold=threshold)
+
+    def reset(self):
+        self._p.reset()
+        self._r.reset()
+
+    def update(self, pred, label):
+        self._p.update(pred, label)
+        self._r.update(pred, label)
+
+    def accumulate(self):
+        p, r = self._p.accumulate(), self._r.accumulate()
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+
+class Auc(Metric):
+    """ROC AUC via the reference's histogram-bucket method
+    (ref: metrics.py Auc: num_thresholds stat buckets, trapezoid area)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        super().__init__(name or "auc")
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def update(self, pred, label):
+        p = _np(pred)
+        if p.ndim == 2:  # (N, 2) softmax output: positive-class prob
+            p = p[:, 1]
+        p = p.reshape(-1)
+        l = _np(label).reshape(-1).astype(bool)
+        idx = np.clip((p * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        np.add.at(self._pos, idx[l], 1)
+        np.add.at(self._neg, idx[~l], 1)
+
+    def accumulate(self):
+        # sweep thresholds high->low accumulating TP/FP counts
+        tp = np.cumsum(self._pos[::-1])
+        fp = np.cumsum(self._neg[::-1])
+        P = tp[-1]
+        N = fp[-1]
+        if P == 0 or N == 0:
+            return 0.0
+        tpr = np.concatenate([[0.0], tp / P])
+        fpr = np.concatenate([[0.0], fp / N])
+        return float(np.trapezoid(tpr, fpr))
+
+
+class MAE(Metric):
+    def __init__(self, name=None):
+        super().__init__(name or "mae")
+        self.reset()
+
+    def reset(self):
+        self.abs_sum = 0.0
+        self.total = 0
+
+    def update(self, pred, label):
+        e = np.abs(_np(pred).reshape(-1) - _np(label).reshape(-1))
+        self.abs_sum += float(e.sum())
+        self.total += e.shape[0]
+
+    def accumulate(self):
+        return self.abs_sum / self.total if self.total else 0.0
+
+
+class MSE(Metric):
+    def __init__(self, name=None):
+        super().__init__(name or "mse")
+        self.reset()
+
+    def reset(self):
+        self.sq_sum = 0.0
+        self.total = 0
+
+    def update(self, pred, label):
+        e = _np(pred).reshape(-1) - _np(label).reshape(-1)
+        self.sq_sum += float((e * e).sum())
+        self.total += e.shape[0]
+
+    def accumulate(self):
+        return self.sq_sum / self.total if self.total else 0.0
+
+
+class RMSE(MSE):
+    def __init__(self, name=None):
+        super().__init__(name or "rmse")
+
+    def accumulate(self):
+        return float(np.sqrt(super().accumulate()))
+
+
+class CompositeMetric(Metric):
+    """ref: metrics.py CompositeMetric — fan one update to many metrics."""
+
+    def __init__(self, *metrics, name=None):
+        super().__init__(name or "composite")
+        self._metrics = list(metrics)
+
+    def add_metric(self, m):
+        self._metrics.append(m)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, pred, label):
+        for m in self._metrics:
+            m.update(pred, label)
+
+    def accumulate(self):
+        return [m.accumulate() for m in self._metrics]

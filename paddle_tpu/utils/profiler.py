@@ -1,0 +1,106 @@
+"""Profiler (ref: python/paddle/fluid/profiler.py — profiler context,
+start/stop, per-op timing report).
+
+TPU-native: two layers.
+- ``profiler()`` / start_profiler / stop_profiler wrap ``jax.profiler``
+  traces (view in TensorBoard / xprof — this is where XLA fusion and MXU
+  utilization actually show up; the reference's per-CUDA-kernel timers
+  have no TPU analog because the whole step is one executable).
+- ``StepTimer`` / ``add_profiler_step`` give the host-side per-step
+  wall-clock stats the reference prints (min/max/mean, imgs-per-sec).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+import numpy as np
+
+__all__ = ["profiler", "start_profiler", "stop_profiler",
+           "add_profiler_step", "StepTimer", "cuda_profiler"]
+
+_trace_dir = None
+
+
+def start_profiler(state=None, tracer_option=None, log_dir="/tmp/pt_profile"):
+    """ref: profiler.start_profiler. Starts a jax.profiler trace."""
+    global _trace_dir
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    _trace_dir = log_dir
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    """ref: profiler.stop_profiler. Ends the trace; returns the dir."""
+    global _trace_dir
+    import jax
+
+    jax.profiler.stop_trace()
+    d, _trace_dir = _trace_dir, None
+    return d
+
+
+@contextlib.contextmanager
+def profiler(state=None, sorted_key=None, profile_path=None,
+             log_dir="/tmp/pt_profile"):
+    """ref: profiler.profiler context manager."""
+    start_profiler(state, log_dir=log_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **k):
+    """API-parity shim: there is no CUDA on TPU; this is a no-op trace."""
+    yield
+
+
+class StepTimer:
+    """Host-side per-step timing (the reference's profiler report numbers).
+
+    >>> t = StepTimer()
+    >>> for batch in loader:
+    ...     with t.step():
+    ...         loss = train_step(*batch)
+    >>> t.summary()   # {'steps': N, 'mean_ms': ..., 'p50_ms': ...}
+    """
+
+    def __init__(self, skip_first=1):
+        self.skip_first = skip_first
+        self.times = []
+        self._seen = 0
+
+    @contextlib.contextmanager
+    def step(self):
+        t0 = time.perf_counter()
+        yield
+        dt = time.perf_counter() - t0
+        self._seen += 1
+        if self._seen > self.skip_first:
+            self.times.append(dt)
+
+    def summary(self):
+        if not self.times:
+            return {"steps": 0}
+        a = np.asarray(self.times) * 1e3
+        return {"steps": len(a), "mean_ms": float(a.mean()),
+                "p50_ms": float(np.percentile(a, 50)),
+                "p90_ms": float(np.percentile(a, 90)),
+                "max_ms": float(a.max())}
+
+    def reset(self):
+        self.times.clear()
+        self._seen = 0
+
+
+_step_timer = StepTimer()
+
+
+def add_profiler_step(*a, **k):
+    """ref: profiler.add_profiler_step hook for Executor loops."""
+    return _step_timer

@@ -353,3 +353,44 @@ class TestCollectiveReviewRegressions:
         for _ in range(3):
             l1 = float(s(x, y))
         assert l1 < l0
+
+
+class TestEagerCollectiveShapes:
+    """VERDICT r1 item 9: non-divisible eager collectives must raise, not
+    silently return the input unreduced."""
+
+    def test_odd_leading_dim_raises(self):
+        from paddle_tpu.dist import env as denv
+        from paddle_tpu.dist import collective as C
+
+        mesh = denv.init_mesh({"data": 8})
+        try:
+            x = pt.to_tensor(np.arange(9, dtype="float32"))
+            with pytest.raises(ValueError, match="not divisible"):
+                C.all_reduce(x)
+        finally:
+            denv.set_mesh(None)
+
+    def test_scalar_is_identity(self):
+        from paddle_tpu.dist import env as denv
+        from paddle_tpu.dist import collective as C
+
+        mesh = denv.init_mesh({"data": 8})
+        try:
+            x = pt.to_tensor(np.float32(3.5))
+            out = C.all_reduce(x)
+            assert float(out.numpy()) == 3.5
+        finally:
+            denv.set_mesh(None)
+
+    def test_divisible_reduces(self):
+        from paddle_tpu.dist import env as denv
+        from paddle_tpu.dist import collective as C
+
+        mesh = denv.init_mesh({"data": 8})
+        try:
+            x = pt.to_tensor(np.arange(8, dtype="float32"))
+            out = C.all_reduce(x)
+            np.testing.assert_allclose(out.numpy(), np.full(8, 28.0))
+        finally:
+            denv.set_mesh(None)

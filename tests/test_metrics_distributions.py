@@ -1,0 +1,138 @@
+"""Metrics + distributions + profiler tests (ref: fluid/tests test_metrics.py,
+test_distributions.py, test_profiler.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import metrics, distribution
+from paddle_tpu.utils.profiler import StepTimer
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = metrics.Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1],
+                         [0.3, 0.3, 0.4], [0.2, 0.5, 0.3]], "float32")
+        lab = np.array([1, 0, 1, 2])
+        m.update(pred, lab)
+        top1, top2 = m.accumulate()
+        assert top1 == pytest.approx(0.5)
+        assert top2 == pytest.approx(1.0)
+
+    def test_accuracy_streaming(self):
+        m = metrics.Accuracy()
+        m.update(np.array([[0.9, 0.1]]), np.array([0]))
+        m.update(np.array([[0.9, 0.1]]), np.array([1]))
+        assert m.accumulate() == pytest.approx(0.5)
+        m.reset()
+        assert m.accumulate() == 0.0
+
+    def test_precision_recall_f1(self):
+        pred = np.array([0.9, 0.8, 0.3, 0.6], "float32")
+        lab = np.array([1, 0, 1, 1])
+        p = metrics.Precision(); p.update(pred, lab)
+        r = metrics.Recall(); r.update(pred, lab)
+        f = metrics.F1(); f.update(pred, lab)
+        assert p.accumulate() == pytest.approx(2 / 3)
+        assert r.accumulate() == pytest.approx(2 / 3)
+        assert f.accumulate() == pytest.approx(2 / 3)
+
+    def test_auc_perfect_and_random(self):
+        rng = np.random.RandomState(0)
+        lab = rng.randint(0, 2, 2000)
+        perfect = metrics.Auc()
+        perfect.update(lab * 0.9 + 0.05, lab)
+        assert perfect.accumulate() > 0.99
+        rand = metrics.Auc()
+        rand.update(rng.rand(2000), lab)
+        assert abs(rand.accumulate() - 0.5) < 0.05
+
+    def test_regression_metrics(self):
+        pred = np.array([1.0, 2.0, 3.0])
+        lab = np.array([2.0, 2.0, 1.0])
+        mae = metrics.MAE(); mae.update(pred, lab)
+        mse = metrics.MSE(); mse.update(pred, lab)
+        rmse = metrics.RMSE(); rmse.update(pred, lab)
+        assert mae.accumulate() == pytest.approx(1.0)
+        assert mse.accumulate() == pytest.approx(5 / 3)
+        assert rmse.accumulate() == pytest.approx(np.sqrt(5 / 3))
+
+    def test_functional_accuracy_and_tensors(self):
+        logits = pt.to_tensor(np.array([[0.2, 0.8], [0.7, 0.3]], "float32"))
+        lab = pt.to_tensor(np.array([1, 1]))
+        assert metrics.accuracy(logits, lab) == pytest.approx(0.5)
+
+
+class TestDistributions:
+    def test_normal_sample_logprob_kl(self):
+        pt.seed(0)
+        d = distribution.Normal(0.0, 1.0)
+        s = d.sample((20000,))
+        assert abs(float(s.numpy().mean())) < 0.05
+        assert abs(float(s.numpy().std()) - 1.0) < 0.05
+        lp = d.log_prob(pt.to_tensor(np.float32(0.0)))
+        assert float(lp.numpy()) == pytest.approx(-0.9189385, rel=1e-5)
+        q = distribution.Normal(1.0, 2.0)
+        kl = distribution.kl_divergence(d, q)
+        expect = 0.5 * ((1 / 4) + (1 / 4) - 1 - np.log(1 / 4))
+        assert float(kl.numpy()) == pytest.approx(expect, rel=1e-5)
+
+    def test_uniform(self):
+        pt.seed(1)
+        d = distribution.Uniform(2.0, 4.0)
+        s = d.sample((5000,))
+        v = s.numpy()
+        assert v.min() >= 2.0 and v.max() < 4.0
+        assert float(d.log_prob(pt.to_tensor(np.float32(3.0))).numpy()) == \
+            pytest.approx(-np.log(2.0))
+        assert float(d.log_prob(pt.to_tensor(np.float32(5.0))).numpy()) == \
+            -np.inf
+        assert float(d.entropy().numpy()) == pytest.approx(np.log(2.0))
+
+    def test_categorical(self):
+        pt.seed(2)
+        logits = np.log(np.array([0.2, 0.3, 0.5], "float32"))
+        d = distribution.Categorical(logits)
+        s = d.sample((20000,)).numpy()
+        freq = np.bincount(s, minlength=3) / 20000
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+        lp = d.log_prob(pt.to_tensor(np.array(2)))
+        assert float(lp.numpy()) == pytest.approx(np.log(0.5), rel=1e-4)
+        ent = d.entropy()
+        expect = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+        assert float(ent.numpy()) == pytest.approx(expect, rel=1e-4)
+
+    def test_bernoulli_and_kl(self):
+        pt.seed(3)
+        d = distribution.Bernoulli(probs=0.7)
+        s = d.sample((20000,)).numpy()
+        assert abs(s.mean() - 0.7) < 0.02
+        q = distribution.Bernoulli(probs=0.5)
+        kl = distribution.kl_divergence(d, q)
+        expect = 0.7 * np.log(0.7 / 0.5) + 0.3 * np.log(0.3 / 0.5)
+        assert float(kl.numpy()) == pytest.approx(expect, rel=1e-4)
+
+    def test_sampling_inside_jit(self):
+        """Draws use the framework PRNG chain: trace-safe + reproducible."""
+        import jax
+
+        def draw():
+            pt.seed(42)
+            d = distribution.Normal(0.0, 1.0)
+            return d.sample((4,)).numpy()
+
+        a, b = draw(), draw()
+        np.testing.assert_array_equal(a, b)
+
+
+class TestProfiler:
+    def test_step_timer(self):
+        t = StepTimer(skip_first=1)
+        for _ in range(4):
+            with t.step():
+                pass
+        s = t.summary()
+        assert s["steps"] == 3
+        assert s["mean_ms"] >= 0.0
+        t.reset()
+        assert t.summary() == {"steps": 0}
