@@ -52,6 +52,13 @@ def _peak_flops():
     return 197e12
 
 
+def _mfu(n_params, n_layers, hidden, B, L, dt):
+    """Model FLOPs utilization; denominator includes attention FLOPs
+    (PaLM appendix B formula: 6N + 12*n_layer*d_model*L per token)."""
+    flops_per_token = 6.0 * n_params + 12.0 * n_layers * hidden * L
+    return flops_per_token * B * L / dt / _peak_flops()
+
+
 def _time_step(step, batch, warmup=3, iters=10):
     import jax
 
@@ -91,7 +98,7 @@ def bench_bert(B=32, L=128):
     dt, loss = _time_step(step, (ids, tt, am, mlm, nsp))
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     tokens_s = B * L / dt
-    mfu = 6.0 * n_params * B * L / dt / _peak_flops()
+    mfu = _mfu(n_params, cfg.layers, cfg.hidden, B, L, dt)
     return {"tokens_per_sec": tokens_s, "step_ms": dt * 1e3, "mfu": mfu,
             "loss": loss, "params": n_params}
 
@@ -139,17 +146,47 @@ def bench_gpt(B=8, L=1024):
     dt, loss = _time_step(step, (ids, labels))
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     tokens_s = B * L / dt
-    mfu = 6.0 * n_params * B * L / dt / _peak_flops()
+    mfu = _mfu(n_params, cfg.layers, cfg.hidden, B, L, dt)
     return {"tokens_per_sec": tokens_s, "step_ms": dt * 1e3, "mfu": mfu,
             "loss": loss, "params": n_params}
 
 
-def main():
+def _init_backend():
+    """Initialize the jax backend, retrying transient tunnel failures.
+
+    Two rounds of BENCH gates died here (rc=1, no JSON): the axon TPU
+    tunnel can fail its first init. Retry with backoff; after exhausting
+    retries report the failure (never bench full shapes on host CPU)."""
     import jax
 
     if SMOKE:
         jax.config.update("jax_platforms", "cpu")
-    _log(f"devices: {jax.devices()}")
+        return jax.devices()
+    last = None
+    for attempt in range(5):
+        try:
+            devs = jax.devices()
+            _log(f"backend ok on attempt {attempt + 1}: {devs}")
+            return devs
+        except Exception as e:
+            last = e
+            _log(f"backend init attempt {attempt + 1} failed: "
+                 f"{type(e).__name__}: {e}")
+            try:
+                import jax.extend.backend as jeb
+
+                jeb.clear_backends()
+            except Exception:
+                pass
+            time.sleep(min(15.0, 2.0 ** attempt))
+    # Do NOT fall back to benching full-size workloads on host CPU: that
+    # trades a fast failure for an hours-long stall reported under the
+    # per-chip TPU metric. Report the failure instead.
+    _log(f"backend init exhausted retries; giving up: {last}")
+    return None
+
+
+def _run_benches():
     global bench_bert, bench_resnet50, bench_gpt
     if SMOKE:
         import functools
@@ -157,7 +194,6 @@ def main():
         bench_bert = functools.partial(bench_bert, B=2, L=128)
         bench_resnet50 = functools.partial(bench_resnet50, B=2, size=64)
         bench_gpt = functools.partial(bench_gpt, B=1, L=128)
-    extras = {}
     results = {}
     for name, fn in (("bert", bench_bert), ("resnet50", bench_resnet50),
                      ("gpt", bench_gpt)):
@@ -167,8 +203,36 @@ def main():
             _log(f"{name}: {results[name]} "
                  f"({time.perf_counter() - t0:.0f}s incl. compile)")
         except Exception as e:  # keep the bench scoreable even if one fails
-            _log(f"{name} FAILED: {type(e).__name__}: {e}")
+            import traceback
 
+            _log(f"{name} FAILED: {type(e).__name__}: {e}")
+            _log(traceback.format_exc())
+    return results
+
+
+def main():
+    # The one-line JSON must print on EVERY exit path (driver contract).
+    headline = {"metric": "bench_failed", "value": 0.0, "unit": "none",
+                "vs_baseline": 0.0}
+    extras = {}
+    results = {}
+    try:
+        if _init_backend() is not None:
+            results = _run_benches()
+    except Exception as e:
+        import traceback
+
+        _log(f"bench harness error: {type(e).__name__}: {e}")
+        _log(traceback.format_exc())
+    finally:
+        try:
+            line = json.dumps(_score(results, headline, extras))
+        except Exception:
+            line = json.dumps(headline)
+        print(line, flush=True)
+
+
+def _score(results, headline, extras):
     if "bert" in results:
         headline = {
             "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
@@ -195,9 +259,6 @@ def main():
                 results["resnet50"]["imgs_per_sec"] / BASELINE_RESNET_IMGS_S,
                 3),
         }
-    else:
-        headline = {"metric": "bench_failed", "value": 0.0, "unit": "none",
-                    "vs_baseline": 0.0}
     if "resnet50" in results:
         extras["resnet50_imgs_per_sec"] = round(
             results["resnet50"]["imgs_per_sec"], 1)
@@ -207,7 +268,7 @@ def main():
         extras["gpt_tokens_per_sec"] = round(
             results["gpt"]["tokens_per_sec"], 1)
         extras["gpt_mfu"] = round(results["gpt"]["mfu"], 4)
-    print(json.dumps({**headline, **extras}))
+    return {**headline, **extras}
 
 
 if __name__ == "__main__":

@@ -52,15 +52,29 @@ class Metric:
 MetricBase = Metric
 
 
+def _topk_hits(pred, lab, k):
+    """Tie-inclusive top-k hit mask: the label counts as in the top k when
+    fewer than k classes score strictly higher (ref: fluid.layers.accuracy
+    via the top_k op, which admits ties at the k-th value).
+
+    Out-of-range labels (e.g. -100 ignore-index) and non-finite label
+    scores are misses, matching the old argsort behavior."""
+    C = pred.shape[-1]
+    valid = (lab >= 0) & (lab < C)
+    safe = np.where(valid, lab, 0)
+    lab_score = np.take_along_axis(pred, safe[:, None], axis=-1)
+    hits = (pred > lab_score).sum(axis=-1) < k
+    return hits & valid & np.isfinite(lab_score[:, 0])
+
+
 def accuracy(input, label, k=1):
     """Functional top-k accuracy (ref: fluid.layers.accuracy)."""
     pred = _np(input)
     lab = _np(label).reshape(-1)
     if pred.ndim == 1:
-        top = pred.reshape(-1, 1)
+        hit = pred.reshape(-1).astype(np.int64) == lab
     else:
-        top = np.argsort(-pred, axis=-1)[:, :k]
-    hit = (top == lab[:, None]).any(axis=1)
+        hit = _topk_hits(pred, lab, k)
     return float(hit.mean())
 
 
@@ -79,10 +93,8 @@ class Accuracy(Metric):
     def update(self, pred, label):
         pred = _np(pred)
         lab = _np(label).reshape(-1)
-        order = np.argsort(-pred, axis=-1)
         for i, k in enumerate(self.topk):
-            hit = (order[:, :k] == lab[:, None]).any(axis=1)
-            self.correct[i] += int(hit.sum())
+            self.correct[i] += int(_topk_hits(pred, lab, k).sum())
         self.total += lab.shape[0]
         return self.accumulate()
 

@@ -24,6 +24,12 @@ def _pair(v, n):
     return (int(v),) * n
 
 
+# Low-precision convs run in the input dtype end to end: the TPU MXU
+# accumulates bf16 convs in float32 internally, so no explicit
+# preferred_element_type is needed — and requesting one breaks the vjp
+# (an f32 cotangent meets bf16 operands in the transpose conv).
+
+
 def _conv_padding(padding, nsp, stride=None, ksize=None, dilation=None):
     """Normalize paddle padding spec -> lax padding."""
     if isinstance(padding, str):
@@ -48,8 +54,7 @@ def _conv2d(x, w, *, stride, padding, dilation, groups, data_format="NCHW"):
         ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC"))
     return lax.conv_general_dilated(
         x, w, window_strides=stride, padding=padding,
-        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups)
 
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
@@ -76,9 +81,10 @@ def _conv1d(x, w, *, stride, padding, dilation, groups, data_format="NCL"):
     dn = lax.conv_dimension_numbers(
         x.shape, w.shape,
         ("NCH", "OIH", "NCH") if data_format == "NCL" else ("NHC", "HIO", "NHC"))
-    return lax.conv_general_dilated(x, w, window_strides=stride, padding=padding,
-                                    rhs_dilation=dilation, dimension_numbers=dn,
-                                    feature_group_count=groups)
+    return lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
@@ -101,9 +107,10 @@ def _conv3d(x, w, *, stride, padding, dilation, groups, data_format="NCDHW"):
     dn = lax.conv_dimension_numbers(
         x.shape, w.shape,
         ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" else ("NDHWC", "DHWIO", "NDHWC"))
-    return lax.conv_general_dilated(x, w, window_strides=stride, padding=padding,
-                                    rhs_dilation=dilation, dimension_numbers=dn,
-                                    feature_group_count=groups)
+    return lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
 
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
