@@ -178,7 +178,8 @@ def _init_backend():
                 jeb.clear_backends()
             except Exception:
                 pass
-            time.sleep(min(15.0, 2.0 ** attempt))
+            if attempt < 4:  # no pointless sleep after the final attempt
+                time.sleep(min(15.0, 2.0 ** attempt))
     # Do NOT fall back to benching full-size workloads on host CPU: that
     # trades a fast failure for an hours-long stall reported under the
     # per-chip TPU metric. Report the failure instead.

@@ -85,6 +85,9 @@ from . import static_ as static
 from . import framework
 from . import io_ as io
 from . import runtime
+from . import inference
+from . import hapi
+from .hapi import Model
 # NB: ``paddle_tpu.dist`` is the p-norm distance op (paddle parity);
 # the distributed package binds as ``paddle_tpu.distributed``. A plain
 # ``from . import dist`` would silently resolve to the already-bound

@@ -1,0 +1,263 @@
+"""High-level training API: ``Model.fit / evaluate / predict``.
+
+Ref (capability target): the reference's high-level-api book suite
+(python/paddle/fluid/tests/book/high-level-api/ — Trainer/Inferencer
+abstractions) and the 2.0-era ``paddle.Model`` hapi surface.
+
+TPU-native: ``fit`` drives the fused ``TrainStep`` (fwd+bwd+update in one
+donated XLA executable), eval/predict run through a shape-cached jitted
+forward, and data comes from ``io_.DataLoader`` so host batching overlaps
+device compute.
+"""
+from __future__ import annotations
+
+import inspect
+import os
+import time
+
+import numpy as np
+
+from .core.tensor import Tensor
+from .framework.jit import TrainStep, StaticFunction
+from .io_.dataloader import DataLoader
+from .io_.dataset import Dataset
+
+__all__ = ["Model", "Callback", "EarlyStopping"]
+
+
+class Callback:
+    """Hook points for fit (ref: hapi callbacks)."""
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+
+class EarlyStopping(Callback):
+    """Stop fit when a monitored metric stops improving."""
+
+    def __init__(self, monitor="loss", patience=3, mode="min", min_delta=0.0):
+        self.monitor = monitor
+        self.patience = patience
+        self.sign = -1.0 if mode == "min" else 1.0
+        self.min_delta = min_delta
+        self.best = -np.inf
+        self.wait = 0
+        self.stop_training = False
+
+    def on_eval_end(self, logs=None):
+        cur = self.sign * float((logs or {}).get(self.monitor, np.nan))
+        if cur > self.best + self.min_delta:
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stop_training = True
+
+
+def _as_loader(data, batch_size, shuffle):
+    if data is None or isinstance(data, DataLoader):
+        return data
+    if isinstance(data, Dataset):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+    raise TypeError(f"expected Dataset or DataLoader, got {type(data)}")
+
+
+def _num_forward_inputs(network):
+    sig = inspect.signature(network.forward)
+    n = 0
+    for p in sig.parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD) \
+                and p.default is p.empty:
+            n += 1
+    return max(n, 1)
+
+
+class Model:
+    """``Model(network).prepare(opt, loss, metrics)`` then ``fit``.
+
+    >>> m = Model(LeNet())
+    >>> m.prepare(optim.Adam(1e-3, parameters=m.parameters()),
+    ...           F.cross_entropy, metrics.Accuracy())
+    >>> m.fit(train_ds, epochs=2, batch_size=64)
+    >>> m.evaluate(test_ds)["acc"]
+    """
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._n_in = len(inputs) if inputs is not None \
+            else _num_forward_inputs(network)
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+        self._train_step = None
+        self._fwd = StaticFunction(lambda net, *xs: net(*xs), model=network)
+        self.stop_training = False
+
+    def parameters(self):
+        return self.network.parameters()
+
+    # -- setup --------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+        if optimizer is not None and loss is not None:
+            self._train_step = TrainStep(self.network, optimizer,
+                                         self._loss_fn())
+        return self
+
+    def _loss_fn(self):
+        n_in, loss = self._n_in, self._loss
+
+        def fn(net, *batch):
+            xs, ys = batch[:n_in], batch[n_in:]
+            out = net(*xs)
+            if isinstance(out, (list, tuple)):
+                return loss(*out, *ys)
+            return loss(out, *ys)
+
+        return fn
+
+    # -- single-batch ops (ref: hapi Model.train_batch etc.) ---------------
+    def train_batch(self, inputs, labels=None):
+        if self._train_step is None:
+            raise RuntimeError("call prepare(optimizer, loss) before fit")
+        batch = list(inputs) + list(labels or [])
+        self.network.train()
+        loss = self._train_step(*batch)
+        return float(np.asarray(loss._data))
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        out = self._fwd(*inputs)
+        logs = {}
+        if self._loss is not None and labels:
+            pred = out if not isinstance(out, (list, tuple)) else out[0]
+            logs["loss"] = float(np.asarray(
+                self._loss(pred, *labels)._data))
+        for m in self._metrics:
+            pred = out if not isinstance(out, (list, tuple)) else out[0]
+            m.update(*m.compute(pred, *labels)) if labels else None
+        return out, logs
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        out = self._fwd(*inputs)
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o._data) for o in out]
+        return np.asarray(out._data)
+
+    # -- loops --------------------------------------------------------------
+    def fit(self, train_data, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            shuffle=True, verbose=1, callbacks=None):
+        loader = _as_loader(train_data, batch_size, shuffle)
+        eval_loader = _as_loader(eval_data, batch_size, False)
+        callbacks = list(callbacks or [])
+        history = {"loss": []}
+        self.stop_training = False
+        for epoch in range(epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            t0 = time.time()
+            losses = []
+            for step, batch in enumerate(loader):
+                loss = self.train_batch(batch[:self._n_in],
+                                        batch[self._n_in:])
+                losses.append(loss)
+                logs = {"loss": loss, "epoch": epoch, "step": step}
+                for cb in callbacks:
+                    cb.on_batch_end(step, logs)
+                if verbose and log_freq and step % log_freq == 0:
+                    print(f"epoch {epoch} step {step}: loss {loss:.4f}")
+            epoch_logs = {"loss": float(np.mean(losses)) if losses else None,
+                          "time": time.time() - t0}
+            history["loss"].append(epoch_logs["loss"])
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                epoch_logs.update({f"eval_{k}": v
+                                   for k, v in eval_logs.items()})
+                for cb in callbacks:
+                    cb.on_eval_end(eval_logs)
+            for cb in callbacks:
+                cb.on_epoch_end(epoch, epoch_logs)
+            if verbose:
+                print(f"epoch {epoch}: " + ", ".join(
+                    f"{k} {v:.4f}" if isinstance(v, float) else f"{k} {v}"
+                    for k, v in epoch_logs.items()))
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, f"epoch_{epoch}"))
+            if self.stop_training or any(
+                    getattr(cb, "stop_training", False) for cb in callbacks):
+                break
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, verbose=1):
+        loader = _as_loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            xs, ys = batch[:self._n_in], batch[self._n_in:]
+            _, logs = self.eval_batch(xs, ys)
+            if "loss" in logs:
+                losses.append(logs["loss"])
+        out = {}
+        if losses:
+            out["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            name = m.name() if callable(m.name) else m.name
+            out[name] = m.accumulate()
+        if verbose:
+            print("eval: " + ", ".join(f"{k} {v}" for k, v in out.items()))
+        return out
+
+    def predict(self, test_data, batch_size=1):
+        loader = _as_loader(test_data, batch_size, False)
+        outs = []
+        for batch in loader:
+            outs.append(self.predict_batch(batch[:self._n_in]))
+        return outs
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path):
+        from .framework import io
+
+        io.save(self.network.state_dict(), path + ".pdparams")
+        if self._optimizer is not None:
+            io.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path):
+        from .framework import io
+
+        self.network.set_state_dict(io.load(path + ".pdparams"))
+        if self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(io.load(path + ".pdopt"))
+
+    def summary(self):
+        """Param-count summary (ref: hapi Model.summary)."""
+        rows, total = [], 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if len(p.shape) else 1
+            total += n
+            rows.append((name, tuple(p.shape), n))
+        lines = [f"{n:<48} {str(s):<20} {c:>12,}" for n, s, c in rows]
+        lines.append(f"Total params: {total:,}")
+        text = "\n".join(lines)
+        print(text)
+        return {"total_params": total, "layers": rows}

@@ -1,0 +1,23 @@
+"""Inference engine.
+
+TPU-native analog of the reference deployment stack
+(paddle/fluid/inference/api/analysis_predictor.h:82 AnalysisPredictor,
+paddle_infer::Config/Predictor): a saved inference program is replayed
+into one pure jax function and compiled per input-shape bucket; the
+reference's analysis/IR passes (fusion, constant fold, layout) are XLA's
+job here.
+
+Also hosts the generic decode library (dynamic_decode, BeamSearchDecoder,
+beam_search/greedy_search) — the reusable analog of
+python/paddle/fluid/layers/rnn.py:1052 dynamic_decode, :2699 beam_search.
+"""
+from .predictor import Config, Predictor, create_predictor
+from .decoder import (Decoder, BeamSearchDecoder, dynamic_decode,
+                      beam_search, greedy_search, tile_beam,
+                      gather_beams)
+
+__all__ = [
+    "Config", "Predictor", "create_predictor",
+    "Decoder", "BeamSearchDecoder", "dynamic_decode",
+    "beam_search", "greedy_search", "tile_beam", "gather_beams",
+]

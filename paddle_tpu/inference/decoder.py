@@ -1,0 +1,310 @@
+"""Generic decode library: beam search, greedy search, dynamic_decode.
+
+Ref (capability target): python/paddle/fluid/layers/rnn.py:1052
+``dynamic_decode``, :2699 ``beam_search``, :2849 ``beam_search_decode``,
+and the Decoder/BeamSearchDecoder classes of the 2.0 ``paddle.nn`` API.
+
+TPU-native design: everything is expressed over fixed-shape dense
+tensors — the token history is a preallocated (batch, beam, max_len)
+buffer updated per step, beams/batches stay merged on the leading axis so
+each step is one batched matmul-heavy call, and finished beams keep
+"running" with EOS forced at zero cost (no dynamic shapes, no host sync
+inside the loop). The eager loop is jax-traceable, so the whole decode
+can be wrapped in ``paddle_tpu.jit`` for a single compiled program.
+
+The model plugs in as ``step_fn(tokens, state, t) -> (logits, state)``
+with ``tokens: (batch*beam, 1)`` and any pytree state (e.g. KV caches)
+whose leaves carry the merged batch*beam leading dim.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from ..core.tensor import Tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode",
+           "beam_search", "greedy_search", "tile_beam", "gather_beams"]
+
+_NEG_INF = -1e9
+
+
+def _map_state(fn, state):
+    """tree-map over nested tuples/lists/dicts/namedtuples of Tensors."""
+    if isinstance(state, Tensor):
+        return fn(state)
+    if isinstance(state, dict):
+        return {k: _map_state(fn, v) for k, v in state.items()}
+    if isinstance(state, tuple) and hasattr(state, "_fields"):  # namedtuple
+        return type(state)(*(_map_state(fn, v) for v in state))
+    if isinstance(state, (list, tuple)):
+        return type(state)(_map_state(fn, v) for v in state)
+    return state
+
+
+def tile_beam(state, beam_size):
+    """Tile every leaf (B, ...) -> (B*beam, ...), beams contiguous per
+    batch item (ref: BeamSearchDecoder.tile_beam_merge_with_batch)."""
+
+    def tile(t):
+        expanded = ops.unsqueeze(t, 1)
+        reps = [1, beam_size] + [1] * (len(t.shape) - 1)
+        return ops.reshape(ops.tile(expanded, reps),
+                           [-1] + list(t.shape[1:]))
+
+    return _map_state(tile, state)
+
+
+def gather_beams(state, beam_idx, batch_size, beam_size):
+    """Reorder every leaf's merged (B*K, ...) leading dim by the chosen
+    parent beam ``beam_idx (B, K)`` (the backtrace step the reference does
+    with beam_search_decode's gather tree)."""
+    flat = ops.reshape(
+        beam_idx + ops.unsqueeze(
+            ops.arange(0, batch_size, dtype="int64") * beam_size, 1),
+        [-1])
+
+    def gather(t):
+        return ops.index_select(t, flat, axis=0)
+
+    return _map_state(gather, state)
+
+
+def _length_penalty(lengths, alpha):
+    """GNMT length normalization ((5+len)/6)^alpha."""
+    if not alpha:
+        return ops.ones_like(lengths.astype("float32"))
+    return ops.pow((lengths.astype("float32") + 5.0) / 6.0,
+                   ops.full_like(lengths.astype("float32"), alpha))
+
+
+def beam_search(step_fn, init_state, batch_size, bos_id, eos_id, beam_size,
+                max_len, length_penalty=0.6, return_all=False,
+                state_is_tiled=False):
+    """Batched beam search over a stepwise model.
+
+    Returns ``(tokens, scores)``: best sequence per batch item
+    ``(B, max_len)`` and its length-normalized score ``(B,)``; with
+    ``return_all=True`` all beams, sorted best-first: ``(B, K, max_len)``
+    and ``(B, K)``. Pass ``state_is_tiled=True`` when init_state leaves
+    already carry the merged batch*beam leading dim.
+    """
+    B, K, = batch_size, beam_size
+    state = init_state if (init_state is None or state_is_tiled) \
+        else tile_beam(init_state, K)
+
+    cur = ops.full([B * K, 1], bos_id, dtype="int64")
+    tokens = ops.full([B, K, max_len], eos_id, dtype="int64")
+    tokens[:, :, 0] = ops.full([B, K], bos_id, dtype="int64")
+    # beam 0 live, the rest dead-on-arrival so identical initial beams
+    # don't crowd the first topk
+    log_probs = ops.tile(ops.reshape(ops.to_tensor(
+        np.array([0.0] + [_NEG_INF] * (K - 1), np.float32)), [1, K]), [B, 1])
+    finished = ops.zeros([B, K], dtype="bool")
+    lengths = ops.ones([B, K], dtype="int64")
+
+    for t in range(max_len - 1):
+        logits, state = step_fn(cur, state, t)
+        V = logits.shape[-1]
+        lp = ops.reshape(F_log_softmax(logits.astype("float32")), [B, K, V])
+        # finished beams may only emit EOS, at no cost
+        eos_row = ops.to_tensor(
+            np.full((V,), _NEG_INF, np.float32))
+        eos_row[eos_id] = ops.to_tensor(np.float32(0.0))
+        lp = ops.where(ops.unsqueeze(finished, 2),
+                       ops.reshape(eos_row, [1, 1, V]), lp)
+        total = ops.unsqueeze(log_probs, 2) + lp
+        top_v, top_i = ops.topk(ops.reshape(total, [B, K * V]), K, axis=-1)
+        beam_idx = (top_i // V).astype("int64")
+        tok = (top_i % V).astype("int64")
+
+        log_probs = top_v
+        tokens = gather_beams(tokens.reshape([B * K, max_len]), beam_idx,
+                              B, K).reshape([B, K, max_len])
+        tokens[:, :, t + 1] = tok
+        finished = gather_beams(finished.reshape([B * K]), beam_idx, B, K) \
+            .reshape([B, K])
+        lengths = gather_beams(lengths.reshape([B * K]), beam_idx, B, K) \
+            .reshape([B, K])
+        lengths = lengths + (~finished).astype("int64")
+        finished = ops.logical_or(finished, ops.equal(
+            tok, ops.full_like(tok, eos_id)))
+        if state is not None:
+            state = gather_beams(state, beam_idx, B, K)
+        cur = ops.reshape(tok, [B * K, 1])
+        if bool(ops.all(finished)):
+            break
+
+    scores = log_probs / _length_penalty(lengths, length_penalty)
+    order = ops.argsort(-scores, axis=-1)
+    scores = ops.take_along_axis(scores, order, axis=1)
+    tokens = gather_beams(tokens.reshape([B * K, max_len]),
+                          order.astype("int64"), B, K) \
+        .reshape([B, K, max_len])
+    if return_all:
+        return tokens, scores
+    return tokens[:, 0], scores[:, 0]
+
+
+def greedy_search(step_fn, init_state, batch_size, bos_id, eos_id, max_len):
+    """Argmax decode through the same step_fn contract; returns
+    ``(tokens (B, max_len), finished-lengths (B,))``."""
+    state = init_state
+    cur = ops.full([batch_size, 1], bos_id, dtype="int64")
+    toks = [cur]
+    finished = ops.zeros([batch_size], dtype="bool")
+    lengths = ops.ones([batch_size], dtype="int64")
+    for t in range(max_len - 1):
+        logits, state = step_fn(cur, state, t)
+        nxt = ops.argmax(logits, axis=-1).astype("int64")
+        nxt = ops.where(finished, ops.full_like(nxt, eos_id), nxt)
+        lengths = lengths + (~finished).astype("int64")
+        finished = ops.logical_or(finished, ops.equal(
+            nxt, ops.full_like(nxt, eos_id)))
+        cur = ops.reshape(nxt, [batch_size, 1])
+        toks.append(cur)
+        if bool(ops.all(finished)):
+            break
+    out = ops.concat(toks, axis=1)
+    if out.shape[1] < max_len:
+        pad = ops.full([batch_size, max_len - out.shape[1]], eos_id,
+                       dtype="int64")
+        out = ops.concat([out, pad], axis=1)
+    return out, lengths
+
+
+def F_log_softmax(x):
+    from ..nn import functional as F
+
+    return F.log_softmax(x, axis=-1)
+
+
+# -- fluid-style Decoder objects -------------------------------------------
+
+
+class Decoder:
+    """Abstract stepwise decoder (ref: fluid layers/rnn.py Decoder)."""
+
+    def initialize(self, inits):
+        """-> (initial_inputs, initial_states, initial_finished)"""
+        raise NotImplementedError
+
+    def step(self, time, inputs, states):
+        """-> (outputs, next_states, next_inputs, finished)"""
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search decoding as a Decoder (ref: rnn.py BeamSearchDecoder /
+    paddle.nn.BeamSearchDecoder), for use with ``dynamic_decode``.
+
+    ``step_fn(tokens (B*K, 1), states, t) -> (logits, next_states)``.
+    """
+
+    def __init__(self, step_fn, start_token, end_token, beam_size,
+                 length_penalty=0.6):
+        self._step_fn = step_fn
+        self.bos = int(start_token)
+        self.eos = int(end_token)
+        self.beam_size = int(beam_size)
+        self.length_penalty = length_penalty
+        self._B = None
+
+    def initialize(self, inits):
+        """``inits``: (batch_size, model state pytree)."""
+        B, state = inits
+        self._B = int(B)
+        K = self.beam_size
+        state = tile_beam(state, K) if state is not None else None
+        inputs = ops.full([self._B * K, 1], self.bos, dtype="int64")
+        lp0 = ops.tile(ops.reshape(ops.to_tensor(
+            np.array([0.0] + [_NEG_INF] * (K - 1), np.float32)), [1, K]),
+            [self._B, 1])
+        states = {"cell": state, "log_probs": lp0,
+                  "finished": ops.zeros([self._B, K], dtype="bool"),
+                  "lengths": ops.ones([self._B, K], dtype="int64")}
+        return inputs, states, ops.zeros([self._B, K], dtype="bool")
+
+    def step(self, time, inputs, states):
+        B, K = self._B, self.beam_size
+        logits, cell = self._step_fn(inputs, states["cell"], time)
+        V = logits.shape[-1]
+        lp = ops.reshape(F_log_softmax(logits.astype("float32")), [B, K, V])
+        eos_row = ops.to_tensor(np.full((V,), _NEG_INF, np.float32))
+        eos_row[self.eos] = ops.to_tensor(np.float32(0.0))
+        lp = ops.where(ops.unsqueeze(states["finished"], 2),
+                       ops.reshape(eos_row, [1, 1, V]), lp)
+        total = ops.unsqueeze(states["log_probs"], 2) + lp
+        top_v, top_i = ops.topk(ops.reshape(total, [B, K * V]), K, axis=-1)
+        beam_idx = (top_i // V).astype("int64")
+        tok = (top_i % V).astype("int64")
+        fin = gather_beams(states["finished"].reshape([B * K]), beam_idx,
+                           B, K).reshape([B, K])
+        lens = gather_beams(states["lengths"].reshape([B * K]), beam_idx,
+                            B, K).reshape([B, K])
+        lens = lens + (~fin).astype("int64")
+        fin = ops.logical_or(fin, ops.equal(tok, ops.full_like(tok, self.eos)))
+        cell = gather_beams(cell, beam_idx, B, K) if cell is not None else None
+        next_states = {"cell": cell, "log_probs": top_v, "finished": fin,
+                       "lengths": lens}
+        outputs = {"token": tok, "parent": beam_idx}
+        return outputs, next_states, ops.reshape(tok, [B * K, 1]), fin
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrace parent pointers into full sequences
+        (ref: beam_search_decode op, rnn.py:2849)."""
+        B, K = self._B, self.beam_size
+        toks = [np.asarray(o["token"].numpy()) for o in outputs]
+        parents = [np.asarray(o["parent"].numpy()) for o in outputs]
+        T = len(toks)
+        seq = np.full((B, K, T + 1), self.eos, np.int64)
+        seq[:, :, 0] = self.bos
+        beam = np.tile(np.arange(K)[None], (B, 1))
+        cols = np.empty((B, K, T), np.int64)
+        for t in range(T - 1, -1, -1):
+            cols[:, :, t] = np.take_along_axis(toks[t], beam, axis=1)
+            beam = np.take_along_axis(parents[t], beam, axis=1)
+        seq[:, :, 1:] = cols
+        scores = states_scores = final_states["log_probs"] / _length_penalty(
+            final_states["lengths"], self.length_penalty)
+        order = ops.argsort(-states_scores, axis=-1)
+        scores = ops.take_along_axis(states_scores, order, axis=1)
+        onp = np.asarray(order.numpy())
+        seq = np.take_along_axis(seq, onp[:, :, None], axis=1)
+        return (ops.to_tensor(seq), scores), final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=64, output_time_major=
+                   False, impute_finished=False, is_test=False,
+                   return_length=False, **kwargs):
+    """Drive a Decoder until every sequence finishes or ``max_step_num``
+    (ref: fluid layers/rnn.py:1052 dynamic_decode)."""
+    inputs, states, finished = decoder.initialize(inits)
+    outputs = []
+    for t in range(max_step_num):
+        step_out, next_states, next_inputs, next_finished = \
+            decoder.step(t, inputs, states)
+        if not decoder.tracks_own_finished:
+            next_finished = ops.logical_or(next_finished, finished)
+        outputs.append(step_out)
+        inputs, states, finished = next_inputs, next_states, next_finished
+        if bool(ops.all(finished)):
+            break
+    final, final_states = decoder.finalize(
+        outputs, states, states.get("lengths")
+        if isinstance(states, dict) else None)
+    if return_length:
+        lens = states["lengths"] if isinstance(states, dict) else None
+        return final, final_states, lens
+    return final, final_states

@@ -1,0 +1,150 @@
+"""Predictor: compiled inference over a saved program.
+
+Ref (capability target): paddle/fluid/inference/api/analysis_predictor.h:82
+(AnalysisPredictor::Run), paddle_inference_api.h (Config / PaddlePredictor).
+
+TPU-native design: the loaded program is replayed into a single pure
+function ``feeds -> fetches`` and compiled with ``jax.jit`` once per input
+shape signature. Weights stay resident on device between calls (passed as
+jit arguments, never donated, so many Predictors and repeated calls share
+one device copy). Optional batch bucketing pads the leading dim to a small
+set of sizes so a serving workload with ragged batch sizes compiles a
+handful of executables instead of one per batch size — the analog of the
+reference's shape-optimized subgraphs.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """ref: paddle_infer.Config (model path + tuning knobs)."""
+
+    def __init__(self, model_prefix=None):
+        self.model_prefix = model_prefix
+        self.batch_bucketing = True
+        self.buckets = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+    def disable_batch_bucketing(self):
+        self.batch_bucketing = False
+
+    def set_buckets(self, buckets):
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+
+
+class Predictor:
+    """Run a saved inference model (ref: AnalysisPredictor).
+
+    >>> pred = Predictor("/tmp/model")            # prefix from
+    ...                                           # save_inference_model
+    >>> out, = pred.run({"x": np.zeros((4, 784), "float32")})
+    """
+
+    def __init__(self, config_or_prefix):
+        cfg = config_or_prefix if isinstance(config_or_prefix, Config) \
+            else Config(str(config_or_prefix))
+        if cfg.model_prefix is None:
+            raise ValueError("Config.model_prefix not set")
+        self._config = cfg
+        from ..framework.io import load_inference_model
+        from ..static_.program import global_scope
+
+        program, feed_names, fetch_names = load_inference_model(
+            cfg.model_prefix)
+        self._program = program
+        self._feed_names = list(feed_names)
+        self._fetch_names = list(fetch_names)
+        # snapshot weights now: later loads into the global scope must not
+        # be able to corrupt this predictor
+        scope = global_scope()
+        blk = program.global_block
+        self._weight_names = tuple(
+            v.name for v in blk.vars.values()
+            if v.persistable and scope.find_var(v.name) is not None)
+        self._weights = [jnp.asarray(scope.find_var(n))
+                         for n in self._weight_names]
+        self._compiled = {}
+
+    # -- introspection (ref: PaddlePredictor::GetInputNames) ----------------
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    # -- compile ------------------------------------------------------------
+    def _replay(self):
+        ops = list(self._program.global_block.ops)
+        consts = dict(self._program._constants)
+        feed_names = tuple(self._feed_names)
+        weight_names = self._weight_names
+        fetch_names = tuple(self._fetch_names)
+
+        def fn(feeds, weights):
+            env = dict(consts)
+            env.update(zip(feed_names, feeds))
+            env.update(zip(weight_names, weights))
+            for op in ops:
+                args = [env[n] if n is not None else None
+                        for n in op.input_names]
+                out = op.fn(*args, **op.attrs)
+                if isinstance(out, tuple):
+                    env.update(zip(op.output_names, out))
+                else:
+                    env[op.output_names[0]] = out
+            return [env[n] for n in fetch_names]
+
+        return fn
+
+    def _bucket(self, b):
+        for cap in self._config.buckets:
+            if b <= cap:
+                return cap
+        return b
+
+    def run(self, feed, return_numpy=True):
+        """``feed``: dict name->array, or list in get_input_names() order."""
+        if not isinstance(feed, dict):
+            feed = dict(zip(self._feed_names, feed))
+        arrays = [np.asarray(feed[n]._data if isinstance(feed[n], Tensor)
+                             else feed[n]) for n in self._feed_names]
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:
+            raise KeyError(f"missing feeds {missing}")
+
+        B = arrays[0].shape[0] if arrays and arrays[0].ndim else None
+        pad_to = None
+        if (self._config.batch_bucketing and B is not None
+                and all(a.ndim and a.shape[0] == B for a in arrays)):
+            cap = self._bucket(B)
+            if cap != B:
+                pad_to = cap
+                arrays = [np.concatenate(
+                    [a, np.zeros((cap - B,) + a.shape[1:], a.dtype)])
+                    for a in arrays]
+
+        sig = tuple((a.shape, str(a.dtype)) for a in arrays)
+        if sig not in self._compiled:
+            self._compiled[sig] = jax.jit(self._replay())
+        outs = self._compiled[sig]([jnp.asarray(a) for a in arrays],
+                                   self._weights)
+        if pad_to is not None:
+            # slice padding back off any fetch that kept the batch dim
+            outs = [o[:B] if hasattr(o, "ndim") and o.ndim
+                    and o.shape[0] == pad_to else o for o in outs]
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o, _internal=True) for o in outs]
+
+    __call__ = run
+
+
+def create_predictor(config):
+    """ref: paddle_infer.create_predictor."""
+    return Predictor(config)

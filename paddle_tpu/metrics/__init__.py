@@ -53,18 +53,22 @@ MetricBase = Metric
 
 
 def _topk_hits(pred, lab, k):
-    """Tie-inclusive top-k hit mask: the label counts as in the top k when
-    fewer than k classes score strictly higher (ref: fluid.layers.accuracy
-    via the top_k op, which admits ties at the k-th value).
+    """Top-k hit mask with fluid's top_k tie-breaking: ties at the k-th
+    value resolve by smallest class index first (ref: fluid.layers.accuracy
+    over the top_k op's stable CPU ordering). The label hits when its rank
+    — classes scoring strictly higher, plus equal-scoring classes with a
+    smaller index — is below k.
 
     Out-of-range labels (e.g. -100 ignore-index) and non-finite label
-    scores are misses, matching the old argsort behavior."""
+    scores are misses."""
     C = pred.shape[-1]
     valid = (lab >= 0) & (lab < C)
     safe = np.where(valid, lab, 0)
     lab_score = np.take_along_axis(pred, safe[:, None], axis=-1)
-    hits = (pred > lab_score).sum(axis=-1) < k
-    return hits & valid & np.isfinite(lab_score[:, 0])
+    ties_before = ((pred == lab_score)
+                   & (np.arange(C)[None] < safe[:, None])).sum(axis=-1)
+    rank = (pred > lab_score).sum(axis=-1) + ties_before
+    return (rank < k) & valid & np.isfinite(lab_score[:, 0])
 
 
 def accuracy(input, label, k=1):

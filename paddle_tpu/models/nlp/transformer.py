@@ -117,6 +117,36 @@ class WMTTransformer(Layer):
             outs.append(cur)
         return ops.concat(outs, axis=1)
 
+    def beam_search_decode(self, src, beam_size=4, max_len=None,
+                           src_pad_id=None, length_penalty=0.6,
+                           return_all=False):
+        """Beam search through the generic decode library
+        (inference/decoder.py — ref rnn.py:2699 beam_search)."""
+        from ...inference import beam_search
+
+        max_len = max_len or self.max_len
+        memory, src_mask = self.encode(src, src_pad_id)
+        B = src.shape[0]
+
+        # memory/mask are identical across beams of one batch item, so they
+        # stay OUT of the gathered beam state (closure instead) — only the
+        # KV caches, which diverge per beam, pay the per-step reorder
+        from ...inference.decoder import tile_beam
+
+        mem_k = tile_beam(memory, beam_size)
+        mask_k = tile_beam(src_mask, beam_size) if src_mask is not None \
+            else None
+        caches_k = self.transformer.decoder.gen_cache(mem_k)
+
+        def step_fn(tok, caches, t):
+            logits, caches = self.decode_step(tok, mem_k, caches, t, mask_k)
+            return logits, caches
+
+        return beam_search(
+            step_fn, caches_k, B, self.bos_id, self.eos_id,
+            beam_size, max_len, length_penalty=length_penalty,
+            return_all=return_all, state_is_tiled=True)
+
 
 def wmt_loss(model, src, tgt_in, tgt_label, smooth_eps=0.1, pad_id=None):
     """Label-smoothed CE over non-pad target positions."""

@@ -1,0 +1,80 @@
+"""High-level Model API tests (ref: the reference's high-level-api book
+suite — train whole models through a trainer abstraction in a few lines).
+"""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu import optim, metrics
+from paddle_tpu.hapi import Model, EarlyStopping
+from paddle_tpu.io_.dataset import TensorDataset
+from paddle_tpu.models.vision import LeNet
+
+
+_MEANS = np.random.RandomState(1234).randn(10, 1, 28, 28) \
+    .astype("float32") * 2.0
+
+
+def _mnist_like(n=64, classes=10, seed=0):
+    """Shared class means + per-split noise: train/test are the same task."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, n)
+    x = _MEANS[y] + rng.randn(n, 1, 28, 28).astype("float32") * 0.5
+    return TensorDataset([x, y.astype("int64")])
+
+
+def test_mnist_fit_evaluate_predict():
+    """The 10-line MNIST recipe: Model(LeNet()).prepare(...).fit(...)."""
+    pt.seed(0)
+    train_ds = _mnist_like(64)
+    test_ds = _mnist_like(32, seed=1)
+    m = Model(LeNet())
+    m.prepare(optim.Adam(2e-3, parameters=m.parameters()),
+              F.cross_entropy, metrics.Accuracy())
+    hist = m.fit(train_ds, epochs=8, batch_size=32, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0], hist
+    res = m.evaluate(test_ds, batch_size=32, verbose=0)
+    assert res["acc"] > 0.5, res
+    preds = m.predict(test_ds, batch_size=32)
+    assert preds[0].shape == (32, 10)
+
+
+def test_train_eval_batch_and_save_load(tmp_path):
+    pt.seed(0)
+    ds = _mnist_like(32)
+    m = Model(LeNet())
+    m.prepare(optim.Adam(1e-3, parameters=m.parameters()),
+              F.cross_entropy, metrics.Accuracy())
+    x, y = ds[0]
+    xb = np.stack([np.asarray(ds[i][0]) for i in range(8)])
+    yb = np.asarray([ds[i][1] for i in range(8)])
+    l0 = m.train_batch([xb], [yb])
+    assert np.isfinite(l0)
+    path = str(tmp_path / "ck")
+    m.save(path)
+    m2 = Model(LeNet())
+    m2.prepare(optim.Adam(1e-3, parameters=m2.parameters()),
+               F.cross_entropy)
+    m2.load(path)
+    p1 = m.predict_batch([xb])
+    p2 = m2.predict_batch([xb])
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_early_stopping_stops():
+    pt.seed(0)
+    ds = _mnist_like(32)
+    m = Model(LeNet())
+    m.prepare(optim.Adam(0.0, parameters=m.parameters()),  # lr 0: no change
+              F.cross_entropy, metrics.Accuracy())
+    es = EarlyStopping(monitor="loss", patience=1)
+    hist = m.fit(ds, eval_data=ds, epochs=10, batch_size=32, verbose=0,
+                 callbacks=[es])
+    assert len(hist["loss"]) < 10  # stopped long before 10 epochs
+
+
+def test_summary_counts_params():
+    m = Model(LeNet())
+    info = m.summary()
+    n = sum(int(np.prod(p.shape)) for p in m.parameters())
+    assert info["total_params"] == n

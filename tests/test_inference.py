@@ -1,0 +1,240 @@
+"""Inference engine tests: Predictor over a saved program, and the
+generic decode library (beam/greedy/dynamic_decode).
+
+Model: reference inference/api/analysis_predictor.h (Predictor contract),
+python/paddle/fluid/layers/rnn.py dynamic_decode/beam_search semantics.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optim as optim
+from paddle_tpu import ops
+from paddle_tpu.inference import (Predictor, Config, beam_search,
+                                  greedy_search, BeamSearchDecoder,
+                                  dynamic_decode, tile_beam, gather_beams)
+from paddle_tpu.models.vision import LeNet
+
+
+def _save_lenet(tmp_path):
+    pt.seed(0)
+    pt.enable_static()
+    try:
+        main, startup = pt.static.Program(), pt.static.Program()
+        with pt.program_guard(main, startup):
+            x = pt.static.data("x", [8, 1, 28, 28], "float32")
+            model = LeNet()
+            logits = model(x)
+            prob = F.softmax(logits, axis=-1)
+    finally:
+        pt.disable_static()
+    exe = pt.static.Executor()
+    exe.run(startup)
+    xs = np.random.RandomState(0).randn(8, 1, 28, 28).astype("float32")
+    ref, = exe.run(main, feed={"x": xs}, fetch_list=[prob])
+    prefix = str(tmp_path / "lenet")
+    pt.framework.io.save_inference_model(prefix, ["x"], [prob],
+                                         program=main)
+    return prefix, xs, ref
+
+
+class TestPredictor:
+    def test_save_load_parity(self, tmp_path):
+        prefix, xs, ref = _save_lenet(tmp_path)
+        pred = Predictor(prefix)
+        assert pred.get_input_names() == ["x"]
+        out, = pred.run({"x": xs})
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_list_feed_and_call(self, tmp_path):
+        prefix, xs, ref = _save_lenet(tmp_path)
+        pred = Predictor(prefix)
+        out, = pred([xs])
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_batch_bucketing(self, tmp_path):
+        """Odd batch sizes reuse one bucket-sized executable; results are
+        unpadded and correct."""
+        prefix, xs, ref = _save_lenet(tmp_path)
+        cfg = Config(prefix)
+        pred = Predictor(cfg)
+        out5, = pred.run({"x": xs[:5]})
+        assert out5.shape[0] == 5
+        np.testing.assert_allclose(out5, ref[:5], rtol=1e-5, atol=1e-6)
+        out7, = pred.run({"x": xs[:7]})
+        assert out7.shape[0] == 7
+        np.testing.assert_allclose(out7, ref[:7], rtol=1e-5, atol=1e-6)
+        # 5 and 7 both pad to the 8-bucket -> one compiled executable
+        assert len(pred._compiled) == 1
+
+    def test_bucketing_disabled_compiles_per_shape(self, tmp_path):
+        prefix, xs, _ = _save_lenet(tmp_path)
+        cfg = Config(prefix)
+        cfg.disable_batch_bucketing()
+        pred = Predictor(cfg)
+        pred.run({"x": xs[:3]})
+        pred.run({"x": xs[:5]})
+        assert len(pred._compiled) == 2
+
+    def test_missing_feed_raises(self, tmp_path):
+        prefix, xs, _ = _save_lenet(tmp_path)
+        pred = Predictor(prefix)
+        with pytest.raises(KeyError):
+            pred.run({})
+
+    def test_weights_isolated_from_scope(self, tmp_path):
+        """Predictor must not be corrupted by later global-scope writes."""
+        prefix, xs, ref = _save_lenet(tmp_path)
+        pred = Predictor(prefix)
+        from paddle_tpu.static_.program import global_scope
+
+        for n in pred._weight_names:
+            global_scope().set(n, pt.zeros([1])._data)
+        out, = pred.run({"x": xs})
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+# -- decode library ---------------------------------------------------------
+
+
+def _toy_step(transitions):
+    """Deterministic stepwise model over a tiny Markov chain: logits
+    depend only on the previous token. transitions: (V, V) numpy."""
+    T = np.asarray(transitions, np.float32)
+
+    def step_fn(tok, state, t):
+        logits = ops.to_tensor(T)[ops.reshape(tok, [-1])]
+        return logits, state
+
+    return step_fn
+
+
+class TestBeamSearch:
+    def test_beam_equals_greedy_when_beam1(self):
+        rng = np.random.RandomState(0)
+        T = rng.randn(6, 6).astype("float32")
+        step = _toy_step(T)
+        g_toks, _ = greedy_search(step, None, 2, bos_id=0, eos_id=5,
+                                  max_len=6)
+        b_toks, _ = beam_search(step, None, 2, bos_id=0, eos_id=5,
+                                beam_size=1, max_len=6, length_penalty=0.0)
+        np.testing.assert_array_equal(np.asarray(g_toks.numpy()),
+                                      np.asarray(b_toks.numpy()))
+
+    def test_beam_beats_greedy(self):
+        """Classic trap: the greedy first step leads into a low-probability
+        continuation; beam search must recover the higher-scoring path."""
+        # vocab: 0=bos 1 2 3=eos
+        # from bos: token1 slightly better than token2 (greedy takes 1)
+        # from 1: forced low-prob spread; from 2: near-certain eos
+        T = np.array([
+            [-9., 0.0, -0.1, -9.],     # bos -> prefers 1
+            [-9., -2., -2., -2.],      # after 1: everything bad (log 1/3ish)
+            [-9., -9., -9., 0.0],      # after 2: eos certain
+            [-9., -9., -9., 0.0],      # eos absorbing
+        ], "float32")
+        step = _toy_step(T)
+        g_toks, _ = greedy_search(step, None, 1, bos_id=0, eos_id=3,
+                                  max_len=4)
+        b_toks, b_scores = beam_search(step, None, 1, bos_id=0, eos_id=3,
+                                       beam_size=3, max_len=4,
+                                       length_penalty=0.0)
+        g = np.asarray(g_toks.numpy())[0]
+        b = np.asarray(b_toks.numpy())[0]
+        assert g[1] == 1, g          # greedy falls into the trap
+        assert b[1] == 2 and b[2] == 3, b  # beam takes 2 -> eos
+
+    def test_beam_scores_sorted_and_finite(self):
+        rng = np.random.RandomState(1)
+        T = rng.randn(8, 8).astype("float32")
+        toks, scores = beam_search(_toy_step(T), None, 3, bos_id=0,
+                                   eos_id=7, beam_size=4, max_len=7,
+                                   return_all=True)
+        s = np.asarray(scores.numpy())
+        assert s.shape == (3, 4)
+        assert np.isfinite(s[:, 0]).all()
+        assert (np.diff(s, axis=1) <= 1e-5).all()  # sorted best-first
+
+    def test_state_gather(self):
+        """Beam reordering must permute state leaves on the merged dim."""
+        state = {"a": pt.to_tensor(np.arange(8, dtype=np.float32)
+                                   .reshape(4, 2))}
+        # B=2, K=2; swap beams of batch 0, keep batch 1
+        idx = pt.to_tensor(np.array([[1, 0], [0, 1]], np.int64))
+        out = gather_beams(state, idx, 2, 2)
+        np.testing.assert_array_equal(
+            np.asarray(out["a"].numpy()),
+            np.array([[2, 3], [0, 1], [4, 5], [6, 7]], np.float32))
+
+    def test_tile_beam(self):
+        x = pt.to_tensor(np.array([[1., 2.], [3., 4.]], np.float32))
+        t = tile_beam(x, 3)
+        assert list(t.shape) == [6, 2]
+        np.testing.assert_array_equal(np.asarray(t.numpy())[:3],
+                                      np.tile([[1., 2.]], (3, 1)))
+
+
+class TestDynamicDecode:
+    def test_matches_functional_beam(self):
+        rng = np.random.RandomState(2)
+        T = rng.randn(6, 6).astype("float32")
+        step = _toy_step(T)
+        dec = BeamSearchDecoder(step, start_token=0, end_token=5,
+                                beam_size=3, length_penalty=0.0)
+        (seqs, scores), _ = dynamic_decode(dec, inits=(2, None),
+                                           max_step_num=5)
+        f_toks, f_scores = beam_search(step, None, 2, bos_id=0, eos_id=5,
+                                       beam_size=3, max_len=6,
+                                       length_penalty=0.0, return_all=True)
+        np.testing.assert_allclose(np.asarray(scores.numpy()),
+                                   np.asarray(f_scores.numpy()), rtol=1e-5)
+        s = np.asarray(seqs.numpy())
+        f = np.asarray(f_toks.numpy())
+        if s.shape[-1] < f.shape[-1]:  # dynamic_decode stopped early;
+            pad = np.full(s.shape[:-1] + (f.shape[-1] - s.shape[-1],), 5,
+                          s.dtype)  # post-finish positions are all eos
+            s = np.concatenate([s, pad], axis=-1)
+        np.testing.assert_array_equal(s, f)
+
+
+class TestWMTBeam:
+    def test_wmt_beam_decode_runs(self):
+        from paddle_tpu.models.nlp.transformer import WMTTransformer
+
+        pt.seed(0)
+        model = WMTTransformer(src_vocab=32, tgt_vocab=32, d_model=16,
+                               nhead=2, num_layers=1, dim_feedforward=32,
+                               max_len=10, dropout=0.0)
+        model.eval()
+        src = np.random.RandomState(0).randint(2, 32, (2, 5)).astype("int64")
+        toks, scores = model.beam_search_decode(pt.to_tensor(src),
+                                                beam_size=3, max_len=8)
+        t = np.asarray(toks.numpy())
+        assert t.shape == (2, 8)
+        assert (t[:, 0] == model.bos_id).all()
+        assert np.isfinite(np.asarray(scores.numpy())).all()
+
+    def test_wmt_beam1_matches_greedy(self):
+        from paddle_tpu.models.nlp.transformer import WMTTransformer
+
+        pt.seed(0)
+        model = WMTTransformer(src_vocab=32, tgt_vocab=32, d_model=16,
+                               nhead=2, num_layers=1, dim_feedforward=32,
+                               max_len=10, dropout=0.0)
+        model.eval()
+        src = np.random.RandomState(1).randint(2, 32, (2, 5)).astype("int64")
+        g = np.asarray(model.greedy_decode(pt.to_tensor(src),
+                                           max_len=8).numpy())
+        b, _ = model.beam_search_decode(pt.to_tensor(src), beam_size=1,
+                                       max_len=8, length_penalty=0.0)
+        b = np.asarray(b.numpy())
+        # greedy pads nothing after eos; compare up to first eos per row
+        for gi, bi in zip(g, b):
+            L = min(len(gi), len(bi))
+            stop = L
+            for j in range(L):
+                if gi[j] == model.eos_id:
+                    stop = j + 1
+                    break
+            np.testing.assert_array_equal(gi[:stop], bi[:stop])

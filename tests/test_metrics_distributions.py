@@ -17,7 +17,23 @@ class TestMetrics:
         m.update(pred, lab)
         top1, top2 = m.accumulate()
         assert top1 == pytest.approx(0.5)
-        assert top2 == pytest.approx(1.0)
+        # row [.3,.3,.4] lab=1: fluid top_k tie-breaks by smallest index,
+        # so top-2 = {2, 0} and the label misses -> 3/4
+        assert top2 == pytest.approx(0.75)
+
+    def test_accuracy_tie_and_degenerate(self):
+        """Stable-index tie-break (fluid top_k CPU order): constant logits
+        must NOT score perfect accuracy, and ignore-index labels miss."""
+        const = np.zeros((4, 10), "float32")
+        assert metrics.accuracy(const, np.array([0, 1, 5, 9]), k=1) \
+            == pytest.approx(0.25)  # only label 0 is in top-1
+        assert metrics.accuracy(const, np.array([0, 1, 5, 9]), k=2) \
+            == pytest.approx(0.5)
+        pred = np.array([[0.1, 0.9], [0.9, 0.1]], "float32")
+        assert metrics.accuracy(pred, np.array([-100, 0]), k=1) \
+            == pytest.approx(0.5)  # ignore-index is a miss, not a crash
+        nan = np.full((2, 3), np.nan, "float32")
+        assert metrics.accuracy(nan, np.array([0, 1]), k=3) == 0.0
 
     def test_accuracy_streaming(self):
         m = metrics.Accuracy()
