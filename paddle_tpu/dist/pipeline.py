@@ -73,30 +73,48 @@ def gpipe_inner(stage_fn, stage_params, x_mb, axis_name):
 
 
 def pipeline_forward(stage_fn, stacked_params, x, num_microbatches,
-                     axis_name="pipe", mesh=None):
+                     axis_name="pipe", mesh=None, batch_axis=None):
     """Run x (batch-major) through the pipeline; returns last-stage output.
 
-    stacked_params: pytree whose leaves have leading dim = n_stages
-    (sharded over ``axis_name``). x: (B, ...) split into M microbatches.
+    stacked_params: pytree whose leaves have leading dim = n_layers, a
+    multiple of the ``axis_name`` mesh size (each stage applies its
+    n_layers/n_stages resident layers in order — the usual
+    layers-per-stage grouping). x: (B, ...) split into M microbatches.
+    ``batch_axis``: optional dp mesh axis; microbatches are then sharded
+    over it so dp x pp runs in one shard_map.
     """
     mesh = mesh or get_mesh()
     n = mesh.shape[axis_name]
     B = x.shape[0]
     M = num_microbatches
     assert B % M == 0, "batch must divide into microbatches"
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    assert n_layers % n == 0, \
+        f"{n_layers} stacked layers not divisible by {n} pipeline stages"
+    if batch_axis:
+        dp = mesh.shape[batch_axis]
+        assert (B // M) % dp == 0, \
+            f"microbatch size {B // M} not divisible by " \
+            f"{batch_axis} mesh size {dp}"
 
     arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
     mb = arr.reshape((M, B // M) + arr.shape[1:])
 
+    def local_stage(params, x):
+        # apply this shard's resident layers (leading dim n_layers/n)
+        for i in range(n_layers // n):
+            p_i = jax.tree_util.tree_map(lambda a: a[i], params)
+            x = stage_fn(p_i, x)
+        return x
+
     def shard_fn(params, xs):
-        # shard_map keeps the (now size-1) stage axis on each leaf: strip it
-        params = jax.tree_util.tree_map(lambda a: a[0], params)
-        return gpipe_inner(stage_fn, params, xs, axis_name)
+        return gpipe_inner(local_stage, params, xs, axis_name)
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    xspec = P(None, batch_axis) if batch_axis else P()
     out = jax.shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(pspec, P()), out_specs=P(),
+        in_specs=(pspec, xspec), out_specs=xspec,
         check_vma=False)(stacked_params, mb)
     out = out.reshape((B,) + out.shape[2:])
     return Tensor(out, _internal=True) if isinstance(x, Tensor) else out

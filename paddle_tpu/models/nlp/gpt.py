@@ -247,45 +247,94 @@ def gpt_loss(model, ids, labels):
 
 
 class GPTPipeline:
-    """Pipeline-parallel GPT forward: per-layer params stacked on a stage
-    axis sharded over 'pipe' (SURVEY §2 #23). Homogeneous blocks make the
-    GPipe schedule a plain lax.scan."""
+    """Pipeline-parallel GPT (SURVEY §2 #23): per-layer block params
+    stacked on a leading stage axis sharded over 'pipe'; embeddings and
+    the final LN/LM-head run replicated around the GPipe schedule.
 
-    def __init__(self, cfg, num_microbatches=4, axis_name="pipe"):
-        assert cfg.layers >= 1
-        self.cfg = cfg
+    Built FROM a ``GPT`` model — the stacked arrays are snapshots of the
+    model's block weights, so single-device parity is directly testable
+    and the full forward (ids -> logits) matches ``GPT.forward``.
+    Homogeneous blocks make the schedule a plain lax.scan; with a
+    ``batch_axis`` the same shard_map runs dp x pp.
+    """
+
+    def __init__(self, model, num_microbatches=4, axis_name="pipe",
+                 batch_axis=None):
+        assert isinstance(model, GPT), "build GPTPipeline from a GPT model"
+        # active dropout would draw its keys once at trace time and replay
+        # the same masks every step (and break GPT.forward parity)
+        assert not model.training or model.cfg.dropout == 0.0, \
+            "GPTPipeline needs model.eval() or cfg.dropout == 0.0"
+        self.model = model
+        self.cfg = model.cfg
         self.num_microbatches = num_microbatches
         self.axis_name = axis_name
-        ref = GPTBlock(cfg)
-        names = [n for n, _ in ref.named_parameters()]
-        stacks = {}
-        self._blocks = [GPTBlock(cfg) for _ in range(cfg.layers)]
-        for n in names:
-            stacks[n] = jnp.stack([dict(b.named_parameters())[n]._data
-                                   for b in self._blocks])
-        self.stacked = stacks
-        self.embed = GPT.__new__(GPT)  # embeddings handled by caller
+        self.batch_axis = batch_axis
+        self.param_names = [n for n, _ in model.blocks[0].named_parameters()]
+        self.stacked = self.snapshot_blocks()
 
-    def stage_fn(self, params, x):
-        """One block applied with explicit param arrays (pure)."""
-        cfg = self.cfg
-        blk = self._blocks[0]
+    def snapshot_blocks(self):
+        """Re-stack block weights from the model (call after updates)."""
+        dicts = [dict(b.named_parameters()) for b in self.model.blocks]
+        return {n: jnp.stack([d[n]._data for d in dicts])
+                for n in self.param_names}
+
+    def _block_apply(self, params, x):
+        """One block applied with explicit param arrays (pure, traceable)."""
+        blk = self.model.blocks[0]
         named = dict(blk.named_parameters())
         from ...framework.jit import _rebind
 
-        tensors = [named[n] for n in params]
-        arrays = [params[n] for n in params]
-        from ...core import dispatch
-
-        with _rebind(tensors, arrays), dispatch.no_grad():
+        tensors = [named[n] for n in self.param_names]
+        arrays = [params[n] for n in self.param_names]
+        with _rebind(tensors, arrays):
             out = blk(Tensor(x, _internal=True))
         return out._data
 
-    def forward(self, x):
-        """x: (B, L, D) activations entering the block stack."""
+    def blocks_forward(self, x, stacked=None):
+        """(B, L, D) activations through the pipelined block stack."""
         from ...dist.pipeline import pipeline_forward
 
         arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-        out = pipeline_forward(self.stage_fn, self.stacked, arr,
-                               self.num_microbatches, self.axis_name)
+        out = pipeline_forward(self._block_apply,
+                               stacked if stacked is not None
+                               else self.stacked, arr,
+                               self.num_microbatches, self.axis_name,
+                               batch_axis=self.batch_axis)
         return Tensor(out, _internal=True) if isinstance(x, Tensor) else out
+
+    def forward(self, ids, stacked=None):
+        """Full ids -> logits, matching GPT.forward with dropout off."""
+        m = self.model
+        L = ids.shape[1]
+        pos = ops.arange(0, L, dtype="int64")
+        x = m.wte(ids) + m.wpe(pos)
+        x = self.blocks_forward(x, stacked=stacked)
+        x = m.ln_f(x)
+        return ops.matmul(x, ops.transpose(m.wte.weight, [1, 0]))
+
+    __call__ = forward
+
+    def loss(self, ids, labels, stacked=None):
+        logits = self.forward(ids, stacked=stacked)
+        V = logits.shape[-1]
+        return F.cross_entropy(ops.reshape(logits, [-1, V]),
+                               ops.reshape(labels, [-1]))
+
+    def train_step_fn(self, lr=1e-3):
+        """Pure jittable SGD step over the stacked block params: proves
+        grads flow back through the ppermute ring (embeddings/head stay
+        frozen constants here; DistributedTrainStep owns the full-model
+        path)."""
+
+        def step(stacked, ids, labels):
+            def loss_of(st):
+                l = self.loss(Tensor(ids, _internal=True),
+                              Tensor(labels, _internal=True), stacked=st)
+                return l._data
+
+            loss, grads = jax.value_and_grad(loss_of)(stacked)
+            new = {k: v - lr * grads[k] for k, v in stacked.items()}
+            return loss, new
+
+        return step
