@@ -13,7 +13,8 @@ import numpy as np
 from ..core.tensor import Tensor
 
 __all__ = ["Metric", "MetricBase", "Accuracy", "Precision", "Recall", "F1",
-           "Auc", "MAE", "MSE", "RMSE", "CompositeMetric", "accuracy"]
+           "Auc", "MAE", "MSE", "RMSE", "CompositeMetric", "accuracy",
+           "ChunkEvaluator"]
 
 
 def _np(x):
@@ -273,3 +274,41 @@ class CompositeMetric(Metric):
 
     def accumulate(self):
         return [m.accumulate() for m in self._metrics]
+
+
+class ChunkEvaluator(Metric):
+    """Streaming chunk-level precision/recall/F1 over IOB/IOE/IOBES tag
+    sequences (ref: fluid.metrics.ChunkEvaluator + the chunk_eval op).
+    ``update(pred, label, seq_length=None)`` accumulates chunk counts;
+    ``accumulate()`` -> (precision, recall, f1)."""
+
+    def __init__(self, chunk_scheme="IOB", num_chunk_types=1,
+                 excluded_chunk_types=None, name=None):
+        super().__init__(name or "chunk")
+        self.chunk_scheme = chunk_scheme
+        self.num_chunk_types = num_chunk_types
+        self.excluded_chunk_types = excluded_chunk_types
+        self.reset()
+
+    def reset(self):
+        self.n_infer = 0
+        self.n_label = 0
+        self.n_correct = 0
+
+    def update(self, pred, label, seq_length=None):
+        from ..ops.labeling import chunk_eval
+
+        _, _, _, ni, nl, nc = chunk_eval(
+            _np(pred), _np(label), self.chunk_scheme,
+            self.num_chunk_types, seq_length=seq_length,
+            excluded_chunk_types=self.excluded_chunk_types)
+        self.n_infer += ni
+        self.n_label += nl
+        self.n_correct += nc
+        return self.accumulate()
+
+    def accumulate(self):
+        p = self.n_correct / self.n_infer if self.n_infer else 0.0
+        r = self.n_correct / self.n_label if self.n_label else 0.0
+        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        return p, r, f1

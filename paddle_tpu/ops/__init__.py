@@ -21,6 +21,8 @@ from . import (  # noqa: F401
     sequence,
     control_flow,
     random_ops,
+    detection,
+    labeling,
 )
 from ..core.tensor import Tensor
 
@@ -77,7 +79,8 @@ def _flatten_namespace():
     skip = {"apply", "register", "Tensor", "unwrap", "convert_dtype",
             "OP_REGISTRY"}
     for mod in (math, creation, manipulation, reduction, compare, activation,
-                linalg, conv, norm_ops, sequence, control_flow, random_ops):
+                linalg, conv, norm_ops, sequence, control_flow, random_ops,
+                detection, labeling):
         public = getattr(mod, "__all__", None) or [
             n for n in dir(mod) if not n.startswith("_")]
         for n in public:

@@ -1,0 +1,438 @@
+"""Sequence-labeling op family: CRF, chunk eval, edit distance, and the
+large-vocab sampled losses (NCE / hsigmoid / sampled softmax).
+
+Ref (capability target): python/paddle/fluid/layers/nn.py —
+linear_chain_crf (:695), crf_decoding (:772), chunk_eval (:820 area),
+nce (:5213 area), hsigmoid; layers/loss.py sampled_softmax_with_
+cross_entropy, edit_distance; exercised by the reference book chapter
+tests/book/test_label_semantic_roles.py.
+
+TPU-native design: everything is dense (B, L) padded + lengths — no LoD.
+The CRF forward/viterbi recursions are lax.scan over time (one compiled
+loop, grads by autodiff through the scan); edit distance is a scan over
+DP rows; sampled losses take an explicit PRNG key input so the kernels
+stay pure under jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import random as _random
+from ..core.tensor import Tensor
+from ._base import register, apply, unwrap
+
+__all__ = [
+    "linear_chain_crf", "crf_decoding", "chunk_eval", "edit_distance",
+    "nce", "hsigmoid", "sampled_softmax_with_cross_entropy",
+]
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF
+# ---------------------------------------------------------------------------
+
+
+def _split_transition(transition):
+    """fluid layout: (T+2, T) — row 0 start, row 1 stop, rows 2.. pairwise."""
+    return transition[0], transition[1], transition[2:]
+
+
+@register("linear_chain_crf")
+def _linear_chain_crf(emission, label, length, transition):
+    B, L, T = emission.shape
+    start, stop, trans = _split_transition(transition)
+    t_idx = jnp.arange(L)
+    mask = (t_idx[None, :] < length[:, None]).astype(emission.dtype)
+
+    # -- partition function: alpha recursion in log space
+    def alpha_step(alpha, inp):
+        emit_t, m_t = inp  # (B, T), (B,)
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) \
+            + emit_t
+        return jnp.where(m_t[:, None] > 0, nxt, alpha), None
+
+    alpha0 = start[None] + emission[:, 0]
+    alphaL, _ = lax.scan(
+        alpha_step, alpha0,
+        (emission.transpose(1, 0, 2)[1:], mask.T[1:]))
+    log_z = jax.nn.logsumexp(alphaL + stop[None], axis=-1)
+
+    # -- gold path score
+    lab = label.astype(jnp.int32)
+    emit_score = jnp.take_along_axis(emission, lab[:, :, None],
+                                     axis=-1)[..., 0]  # (B, L)
+    emit_score = (emit_score * mask).sum(-1)
+    pair = trans[lab[:, :-1], lab[:, 1:]]  # (B, L-1)
+    pair = (pair * mask[:, 1:]).sum(-1)
+    first = start[lab[:, 0]]
+    last_idx = jnp.clip(length - 1, 0, L - 1)
+    last_lab = jnp.take_along_axis(lab, last_idx[:, None], axis=1)[:, 0]
+    gold = first + emit_score + pair + stop[last_lab]
+    return log_z - gold  # negative log-likelihood per sequence
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None,
+                     transition=None, name=None):
+    """CRF negative log-likelihood (ref: layers/nn.py:695).
+
+    input: (B, L, T) emissions; label (B, L) int; transition (T+2, T)
+    (row 0 start, row 1 stop); length (B,) valid lengths (defaults to
+    full L). Returns nll (B,) — minimize its mean.
+    """
+    if transition is None:
+        raise ValueError("pass the transition parameter "
+                         "(Tensor of shape (num_tags + 2, num_tags))")
+    if length is None:
+        B, L = unwrap(input).shape[:2]
+        length = Tensor(jnp.full((B,), L, jnp.int32), _internal=True)
+    return apply("linear_chain_crf", input, label, length, transition)
+
+
+@register("crf_decoding")
+def _crf_decoding(emission, length, transition):
+    B, L, T = emission.shape
+    start, stop, trans = _split_transition(transition)
+    mask = (jnp.arange(L)[None, :] < length[:, None])
+
+    def vit_step(state, inp):
+        score = state  # (B, T)
+        emit_t, m_t = inp
+        cand = score[:, :, None] + trans[None]  # (B, T, T)
+        best_prev = jnp.argmax(cand, axis=1)  # (B, T)
+        nxt = jnp.max(cand, axis=1) + emit_t
+        nxt = jnp.where(m_t[:, None], nxt, score)
+        bp = jnp.where(m_t[:, None], best_prev,
+                       jnp.arange(T)[None].astype(best_prev.dtype))
+        return nxt, bp
+
+    score0 = start[None] + emission[:, 0]
+    scoreL, bps = lax.scan(vit_step, score0,
+                           (emission.transpose(1, 0, 2)[1:],
+                            mask.T[1:]))  # bps: (L-1, B, T)
+    final = scoreL + stop[None]
+    last = jnp.argmax(final, axis=-1)  # (B,)
+    best_score = jnp.max(final, axis=-1)
+
+    def back_step(tag, bp_t):
+        # bp_t[b, tag_{t+1}] = best tag at time t; emit it at position t
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, path_rev = lax.scan(back_step, last, bps, reverse=True)
+    path = jnp.concatenate([path_rev, last[None]], axis=0).T  # (B, L)
+    path = jnp.where(mask, path, 0)
+    return path.astype(jnp.int64), best_score
+
+
+def crf_decoding(input, param_attr=None, length=None, transition=None,
+                 name=None):
+    """Viterbi decode (ref: layers/nn.py:772). Returns (path (B, L) int64
+    zero-padded, best score (B,))."""
+    if transition is None:
+        raise ValueError("pass the transition parameter")
+    if length is None:
+        B, L = unwrap(input).shape[:2]
+        length = Tensor(jnp.full((B,), L, jnp.int32), _internal=True)
+    return apply("crf_decoding", input, length, transition)
+
+
+# ---------------------------------------------------------------------------
+# chunk eval (host-side metric, IOB/IOE/IOBES)
+# ---------------------------------------------------------------------------
+
+
+def _extract_chunks(tags, length, scheme, num_types):
+    """-> set of (type, start, end) chunks from a dense tag row."""
+    n_states = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+    chunks = set()
+    start = None
+    ctype = None
+    for i in range(length):
+        t = int(tags[i])
+        if t == n_states * num_types:  # the "O" tag
+            if start is not None:
+                chunks.add((ctype, start, i))
+                start, ctype = None, None
+            continue
+        ty, st = divmod(t, n_states)
+        if scheme == "plain":
+            begin = ctype != ty or start is None
+        elif scheme == "IOB":
+            begin = st == 0 or ctype != ty
+        elif scheme == "IOE":
+            begin = start is None or ctype != ty
+        else:  # IOBES: B=0, I=1, E=2, S=3
+            begin = st in (0, 3) or start is None or ctype != ty
+        if begin:
+            if start is not None:
+                chunks.add((ctype, start, i))
+            start, ctype = i, ty
+        if scheme == "IOE" and st == 1:  # E tag closes
+            chunks.add((ctype, start, i + 1))
+            start, ctype = None, None
+        if scheme == "IOBES" and st in (2, 3):
+            chunks.add((ctype, start, i + 1))
+            start, ctype = None, None
+    if start is not None:
+        chunks.add((ctype, start, length))
+    return chunks
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types, seq_length=None,
+               excluded_chunk_types=None, name=None):
+    """Chunk-level P/R/F1 (ref: chunk_eval op; CoNLL NER convention).
+
+    input/label: (B, L) int tag ids; tag = type * n_states + state,
+    with the single "O" tag = num_chunk_types * n_states.
+    Returns (precision, recall, f1, n_infer, n_label, n_correct) floats —
+    host-side metric (not jit-traceable), like the reference's C++ op
+    output fetched to host.
+    """
+    pred = np.asarray(unwrap(input))
+    lab = np.asarray(unwrap(label))
+    B, L = pred.shape
+    lens = np.full((B,), L, np.int64) if seq_length is None \
+        else np.asarray(unwrap(seq_length))
+    excl = set(excluded_chunk_types or [])
+    n_inf = n_lab = n_cor = 0
+    for b in range(B):
+        pc = {c for c in _extract_chunks(pred[b], lens[b], chunk_scheme,
+                                         num_chunk_types)
+              if c[0] not in excl}
+        lc = {c for c in _extract_chunks(lab[b], lens[b], chunk_scheme,
+                                         num_chunk_types)
+              if c[0] not in excl}
+        n_inf += len(pc)
+        n_lab += len(lc)
+        n_cor += len(pc & lc)
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    return p, r, f1, n_inf, n_lab, n_cor
+
+
+# ---------------------------------------------------------------------------
+# edit distance
+# ---------------------------------------------------------------------------
+
+
+@register("edit_distance")
+def _edit_distance(hyp, ref, hyp_len, ref_len, *, normalized):
+    B, Lh = hyp.shape
+    Lr = ref.shape[1]
+
+    def one(h, r, hl, rl):
+        # DP over ref positions; rows scanned over hyp tokens
+        row0 = jnp.arange(Lr + 1, dtype=jnp.float32)
+
+        def step(prev_row, inp):
+            i, tok = inp  # 1-based hyp position
+            in_h = i <= hl
+
+            def row_fn(carry, inp2):
+                j, up, diag = inp2  # prev_row[j], prev_row[j-1]
+                left = carry
+                sub = diag + jnp.where(
+                    (tok == r[j - 1]) | (j > rl), 0.0, 1.0)
+                # positions beyond ref length replicate the j=rl column
+                val = jnp.minimum(jnp.minimum(up + 1.0, left + 1.0), sub)
+                val = jnp.where(j <= rl, val, carry)
+                return val, val
+
+            first = prev_row[0] + 1.0
+            _, rest = lax.scan(
+                row_fn, first,
+                (jnp.arange(1, Lr + 1), prev_row[1:], prev_row[:-1]))
+            new_row = jnp.concatenate([first[None], rest])
+            return jnp.where(in_h, new_row, prev_row), None
+
+        rowL, _ = lax.scan(step, row0,
+                           (jnp.arange(1, Lh + 1), h))
+        d = rowL[jnp.clip(rl, 0, Lr)]
+        return jnp.where(normalized, d / jnp.maximum(rl, 1), d)
+
+    return jax.vmap(one)(hyp, ref, hyp_len.astype(jnp.int32),
+                         ref_len.astype(jnp.int32))
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance per pair (ref: layers/loss.py edit_distance).
+
+    input (B, Lh), label (B, Lr) int token ids, with lengths; returns
+    (distances (B,), sequence_num scalar). ``ignored_tokens`` are removed
+    host-side first (mirrors the reference's preprocessing).
+    """
+    hyp = np.asarray(unwrap(input))
+    ref = np.asarray(unwrap(label))
+    B = hyp.shape[0]
+    hl = np.full((B,), hyp.shape[1], np.int32) if input_length is None \
+        else np.asarray(unwrap(input_length)).astype(np.int32)
+    rl = np.full((B,), ref.shape[1], np.int32) if label_length is None \
+        else np.asarray(unwrap(label_length)).astype(np.int32)
+    if ignored_tokens:
+        def strip(arr, lens):
+            out = np.zeros_like(arr)
+            new_lens = np.zeros_like(lens)
+            for b in range(B):
+                row = [t for t in arr[b, :lens[b]]
+                       if t not in ignored_tokens]
+                out[b, :len(row)] = row
+                new_lens[b] = len(row)
+            return out, new_lens
+
+        hyp, hl = strip(hyp, hl)
+        ref, rl = strip(ref, rl)
+    d = apply("edit_distance", Tensor(jnp.asarray(hyp), _internal=True),
+              Tensor(jnp.asarray(ref), _internal=True),
+              Tensor(jnp.asarray(hl), _internal=True),
+              Tensor(jnp.asarray(rl), _internal=True),
+              normalized=bool(normalized))
+    return d, Tensor(jnp.asarray(B, jnp.int64), _internal=True)
+
+
+# ---------------------------------------------------------------------------
+# sampled large-vocab losses
+# ---------------------------------------------------------------------------
+
+
+@register("nce")
+def _nce(x, label, weight, bias, key, *, num_neg, vocab):
+    B = x.shape[0]
+    neg = jax.random.randint(key, (B, num_neg), 0, vocab)  # uniform sampler
+    pos_w = weight[label]  # (B, D)
+    pos_b = bias[label]
+    pos_logit = (x * pos_w).sum(-1) + pos_b
+    neg_w = weight[neg]  # (B, K, D)
+    neg_b = bias[neg]
+    neg_logit = jnp.einsum("bd,bkd->bk", x, neg_w) + neg_b
+    # NCE with uniform noise: P_n = 1/vocab; logit correction log(k*Pn)
+    corr = jnp.log(num_neg / vocab)
+    pos_loss = -jax.nn.log_sigmoid(pos_logit - corr)
+    neg_loss = -jax.nn.log_sigmoid(-(neg_logit - corr)).sum(-1)
+    return pos_loss + neg_loss
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10,
+        name=None, sampler="uniform", custom_dist=None, seed=0,
+        is_sparse=False, weight=None, bias=None, key=None):
+    """NCE loss (ref: layers/nn.py nce): per-example loss (B,).
+
+    Functional form: pass ``weight (V, D)`` and ``bias (V,)`` explicitly.
+    """
+    if sampler != "uniform" or custom_dist is not None \
+            or sample_weight is not None:
+        raise NotImplementedError(
+            "only sampler='uniform' is implemented; log_uniform/custom "
+            "samplers would bias the NCE correction term silently")
+    if weight is None:
+        raise ValueError("pass weight=(V, D) (and optionally bias=(V,))")
+    if bias is None:
+        V = unwrap(weight).shape[0]
+        bias = Tensor(jnp.zeros((V,), unwrap(weight).dtype), _internal=True)
+    if key is None:
+        key = _random.next_key()
+    lab = label.reshape([-1]) if hasattr(label, "reshape") else label
+    return apply("nce", input, lab, weight, bias,
+                 Tensor(key, _internal=True),
+                 num_neg=int(num_neg_samples),
+                 vocab=int(num_total_classes))
+
+
+@register("hsigmoid")
+def _hsigmoid(x, label, weight, bias, *, num_classes):
+    # default complete binary tree over num_classes leaves; internal nodes
+    # are num_classes-1 rows of weight. Path of leaf l: bits of (l + C)
+    # from the root (MSB after the implicit 1) down.
+    C = num_classes
+    depth = max(int(np.ceil(np.log2(C))), 1)
+    lab = label.astype(jnp.int32)
+    node = lab + C  # heap index of the leaf
+
+    # walk root->leaf: bit i of the heap index selects left/right
+    losses = jnp.zeros(x.shape[0], x.dtype)
+    codes = []
+    nodes = []
+    cur = node
+    for _ in range(depth):
+        codes.append((cur & 1).astype(x.dtype))  # this level's branch bit
+        cur = cur >> 1
+        nodes.append(jnp.clip(cur - 1, 0, C - 2))  # parent internal node
+    # nodes[i] is the parent at height i+1; valid while parent index >= 1
+    for code, nidx, lvl in zip(codes, nodes, range(depth)):
+        valid = ((node >> (lvl + 1)) >= 1).astype(x.dtype)
+        logit = (x * weight[nidx]).sum(-1) + bias[nidx]
+        # code 1 -> right child: target sigmoid(logit) = 1
+        ce = jnp.maximum(logit, 0) - logit * code \
+            + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        losses = losses + ce * valid
+    return losses
+
+
+def hsigmoid(input, label, num_classes, weight=None, bias=None,
+             param_attr=None, bias_attr=None, name=None,
+             path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Hierarchical sigmoid loss over a complete binary tree
+    (ref: layers/nn.py hsigmoid). weight: (num_classes - 1, D) internal
+    node vectors; returns per-example loss (B,)."""
+    if weight is None:
+        raise ValueError("pass weight=(num_classes - 1, D)")
+    if bias is None:
+        C = int(num_classes)
+        bias = Tensor(jnp.zeros((C - 1,), unwrap(weight).dtype),
+                      _internal=True)
+    lab = label.reshape([-1]) if hasattr(label, "reshape") else label
+    return apply("hsigmoid", input, lab, weight, bias,
+                 num_classes=int(num_classes))
+
+
+@register("sampled_softmax")
+def _sampled_softmax(x, label, weight, bias, key, *, num_samples, vocab):
+    B = x.shape[0]
+    neg = jax.random.randint(key, (B, num_samples), 0, vocab)
+    # candidate set = [true, negatives]; logQ correction for uniform
+    # sampling, true class gets -inf correction removal (it is always in)
+    cand = jnp.concatenate([label[:, None], neg], axis=1)  # (B, 1+K)
+    w = weight[cand]  # (B, 1+K, D)
+    b = bias[cand]
+    logits = jnp.einsum("bd,bkd->bk", x, w) + b
+    # importance-weight the sampled denominator: each negative stands in
+    # for expected-count num_samples*q of the full vocab (q uniform), so
+    # subtract log(k*q) from negatives only — sum_j exp(s_j - log(k q))
+    # is then an unbiased estimate of the full softmax denominator
+    log_kq = jnp.log(num_samples / vocab)
+    # mask accidental hits (a negative equal to the true class)
+    hit = cand[:, 1:] == label[:, None]
+    logits = logits.at[:, 1:].set(
+        jnp.where(hit, -1e30, logits[:, 1:] - log_kq))
+    return -jax.nn.log_softmax(logits, axis=-1)[:, 0]
+
+
+def sampled_softmax_with_cross_entropy(logits=None, label=None,
+                                       num_samples=100, *, input=None,
+                                       weight=None, bias=None,
+                                       num_classes=None, key=None,
+                                       name=None, **kwargs):
+    """Sampled-softmax CE (ref: layers/loss.py sampled_softmax_with_
+    cross_entropy): softmax over [true class + sampled negatives] only.
+    Functional form: input (B, D) hidden, weight (V, D), bias (V,),
+    label (B,). Returns per-example loss (B,)."""
+    x = input if input is not None else logits
+    if weight is None:
+        raise ValueError("pass weight=(V, D)")
+    if num_classes is None:
+        num_classes = unwrap(weight).shape[0]
+    if bias is None:
+        bias = Tensor(jnp.zeros((int(num_classes),),
+                                unwrap(weight).dtype), _internal=True)
+    if key is None:
+        key = _random.next_key()
+    lab = label.reshape([-1]) if hasattr(label, "reshape") else label
+    return apply("sampled_softmax", x, lab, weight, bias,
+                 Tensor(key, _internal=True),
+                 num_samples=int(num_samples), vocab=int(num_classes))

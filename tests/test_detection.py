@@ -1,0 +1,375 @@
+"""Detection op tests: numpy parity for every op (SURVEY §2 #3 breadth;
+ref: python/paddle/fluid/layers/detection.py, tests/unittests/test_*_op.py
+style — compare against slow reference implementations)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import ops
+
+
+def _rand_boxes(rng, n, scale=1.0):
+    """n random valid [x1, y1, x2, y2] boxes."""
+    xy1 = rng.rand(n, 2) * 0.6 * scale
+    wh = (rng.rand(n, 2) * 0.4 + 0.05) * scale
+    return np.concatenate([xy1, xy1 + wh], axis=1).astype("float32")
+
+
+def _iou_np(a, b):
+    n, m = len(a), len(b)
+    out = np.zeros((n, m), np.float32)
+    for i in range(n):
+        for j in range(m):
+            xx1 = max(a[i, 0], b[j, 0]); yy1 = max(a[i, 1], b[j, 1])
+            xx2 = min(a[i, 2], b[j, 2]); yy2 = min(a[i, 3], b[j, 3])
+            inter = max(xx2 - xx1, 0) * max(yy2 - yy1, 0)
+            ua = ((a[i, 2] - a[i, 0]) * (a[i, 3] - a[i, 1])
+                  + (b[j, 2] - b[j, 0]) * (b[j, 3] - b[j, 1]) - inter)
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+class TestIoU:
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        a = _rand_boxes(rng, 5)
+        b = _rand_boxes(rng, 7)
+        got = np.asarray(ops.iou_similarity(
+            pt.to_tensor(a), pt.to_tensor(b)).numpy())
+        np.testing.assert_allclose(got, _iou_np(a, b), atol=1e-5)
+
+    def test_known_value(self):
+        x = np.array([[0., 0., 2., 2.]], "float32")
+        y = np.array([[1., 1., 3., 3.]], "float32")
+        got = float(np.asarray(ops.iou_similarity(
+            pt.to_tensor(x), pt.to_tensor(y)).numpy()).reshape(()))
+        assert got == pytest.approx(1.0 / 7.0, abs=1e-6)
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(1)
+        priors = _rand_boxes(rng, 6)
+        targets = _rand_boxes(rng, 4)
+        var = [0.1, 0.1, 0.2, 0.2]
+        enc = ops.box_coder(pt.to_tensor(priors), var,
+                            pt.to_tensor(targets),
+                            code_type="encode_center_size")
+        assert list(enc.shape) == [4, 6, 4]
+        dec = ops.box_coder(pt.to_tensor(priors), var, enc,
+                            code_type="decode_center_size", axis=0)
+        got = np.asarray(dec.numpy())
+        # decoding the encoding of target t against prior p returns t
+        for t in range(4):
+            for p in range(6):
+                np.testing.assert_allclose(got[t, p], targets[t],
+                                           atol=1e-5)
+
+    def test_decode_without_var(self):
+        priors = np.array([[0.1, 0.1, 0.5, 0.5]], "float32")
+        deltas = np.zeros((1, 1, 4), "float32")
+        dec = ops.box_coder(pt.to_tensor(priors), None,
+                            pt.to_tensor(deltas),
+                            code_type="decode_center_size")
+        np.testing.assert_allclose(np.asarray(dec.numpy())[0, 0],
+                                   priors[0], atol=1e-6)
+
+
+class TestPriorBox:
+    def test_shapes_and_range(self):
+        feat = pt.zeros([1, 8, 4, 4])
+        img = pt.zeros([1, 3, 64, 64])
+        boxes, vars_ = ops.prior_box(feat, img, min_sizes=[16.0],
+                                     max_sizes=[32.0],
+                                     aspect_ratios=[2.0], flip=True,
+                                     clip=True)
+        # priors per cell: 1 (min) + 1 (max) + 2 (ar 2, 1/2) = 4
+        assert list(boxes.shape) == [4, 4, 4, 4]
+        b = np.asarray(boxes.numpy())
+        assert (b >= 0).all() and (b <= 1).all()
+        assert (b[..., 2] >= b[..., 0]).all()
+        v = np.asarray(vars_.numpy())
+        np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+    def test_center_offset(self):
+        feat = pt.zeros([1, 8, 2, 2])
+        img = pt.zeros([1, 3, 32, 32])
+        boxes, _ = ops.prior_box(feat, img, min_sizes=[8.0])
+        b = np.asarray(boxes.numpy())
+        # cell (0,0) center at (0.5*16)/32 = 0.25
+        cx = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2
+        assert cx == pytest.approx(0.25, abs=1e-6)
+
+
+class TestAnchorGenerator:
+    def test_pixel_anchors(self):
+        feat = pt.zeros([1, 8, 2, 3])
+        anchors, vars_ = ops.anchor_generator(
+            feat, anchor_sizes=[32.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0])
+        a = np.asarray(anchors.numpy())
+        assert a.shape == (2, 3, 1, 4)
+        # first cell center (8, 8), size 32 -> [-8, -8, 24, 24]
+        np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24], atol=1e-4)
+
+
+class TestBoxClip:
+    def test_clip(self):
+        boxes = pt.to_tensor(np.array(
+            [[[-5.0, -5.0, 30.0, 40.0]]], "float32"))
+        im_info = pt.to_tensor(np.array([[20.0, 25.0, 1.0]], "float32"))
+        out = np.asarray(ops.box_clip(boxes, im_info).numpy())
+        np.testing.assert_allclose(out[0, 0], [0, 0, 24, 19])
+
+
+def _nms_np(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(boxes), bool)
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        ious = _iou_np(boxes[i:i + 1], boxes)[0]
+        sup |= ious > thresh
+        sup[i] = True
+    return keep
+
+
+class TestNMS:
+    def test_single_class_matches_numpy(self):
+        rng = np.random.RandomState(3)
+        boxes = _rand_boxes(rng, 16, scale=10.0)
+        scores = rng.rand(16).astype("float32")
+        keep = np.asarray(ops.nms(pt.to_tensor(boxes),
+                                  pt.to_tensor(scores), 0.4).numpy())
+        want = np.zeros(16, bool)
+        want[_nms_np(boxes, scores, 0.4)] = True
+        np.testing.assert_array_equal(keep, want)
+
+    def test_multiclass_padded_output(self):
+        rng = np.random.RandomState(4)
+        B, M, C = 2, 12, 3
+        boxes = _rand_boxes(rng, B * M, scale=10.0).reshape(B, M, 4)
+        scores = rng.rand(B, C, M).astype("float32")
+        out, counts = ops.multiclass_nms(
+            pt.to_tensor(boxes), pt.to_tensor(scores),
+            score_threshold=0.5, nms_top_k=8, keep_top_k=10,
+            nms_threshold=0.4, background_label=0)
+        o = np.asarray(out.numpy())
+        c = np.asarray(counts.numpy())
+        assert o.shape == (B, 10, 6)
+        for b in range(B):
+            n = c[b]
+            # valid rows: class != -1, scores sorted descending
+            assert (o[b, :n, 0] >= 0).all()
+            assert (o[b, n:, 0] == -1).all()
+            assert (np.diff(o[b, :n, 1]) <= 1e-6).all()
+            assert (o[b, :n, 0] != 0).all()  # background dropped
+            assert (o[b, :n, 1] >= 0.5).all()
+
+    def test_multiclass_agrees_with_per_class_numpy(self):
+        rng = np.random.RandomState(5)
+        M = 10
+        boxes = _rand_boxes(rng, M, scale=8.0).reshape(1, M, 4)
+        scores = rng.rand(1, 2, M).astype("float32")
+        out, counts = ops.multiclass_nms(
+            pt.to_tensor(boxes), pt.to_tensor(scores),
+            score_threshold=0.3, nms_top_k=M, keep_top_k=M * 2,
+            nms_threshold=0.5, background_label=-1)
+        got = np.asarray(out.numpy())[0]
+        n = int(np.asarray(counts.numpy())[0])
+        want = []
+        for c in range(2):
+            s = scores[0, c].copy()
+            ok = s >= 0.3
+            keep = _nms_np(boxes[0][ok], s[ok], 0.5)
+            idx = np.where(ok)[0][keep]
+            want += [(c, s[i], *boxes[0][i]) for i in idx]
+        want.sort(key=lambda r: -r[1])
+        assert n == len(want)
+        for row, w in zip(got[:n], want):
+            assert int(row[0]) == w[0]
+            np.testing.assert_allclose(row[1:], w[1:], atol=1e-5)
+
+
+class TestYolo:
+    def test_yolo_box_decode(self):
+        B, A, C, H, W = 1, 2, 3, 2, 2
+        anchors = [10, 14, 23, 27]
+        x = np.zeros((B, A * (5 + C), H, W), "float32")
+        img = np.array([[64, 64]], "int32")
+        boxes, scores = ops.yolo_box(pt.to_tensor(x), pt.to_tensor(img),
+                                     anchors, C, 0.01, 32)
+        b = np.asarray(boxes.numpy())
+        s = np.asarray(scores.numpy())
+        assert b.shape == (1, A * H * W, 4)
+        assert s.shape == (1, A * H * W, C)
+        # zero logits -> sigmoid 0.5: cell(0,0) anchor0 center = 0.5/2
+        cx = (b[0, 0, 0] + b[0, 0, 2]) / 2
+        assert cx == pytest.approx(0.5 / W * 64, rel=1e-5)
+        # width = exp(0)*10 / (2*32) * 64 = 10
+        assert b[0, 0, 2] - b[0, 0, 0] == pytest.approx(10.0, rel=1e-5)
+        # scores = cls_sig * obj_sig = 0.25
+        assert s[0, 0, 0] == pytest.approx(0.25, rel=1e-5)
+
+    def test_yolo_box_conf_threshold(self):
+        x = np.zeros((1, 16, 2, 2), "float32")
+        img = np.array([[64, 64]], "int32")
+        _, scores = ops.yolo_box(pt.to_tensor(x), pt.to_tensor(img),
+                                 [10, 14, 23, 27], 3, 0.6, 32)
+        assert (np.asarray(scores.numpy()) == 0).all()  # 0.5 < 0.6
+
+    def test_yolov3_loss_trains(self):
+        rng = np.random.RandomState(6)
+        B, C, H, W = 2, 4, 4, 4
+        A = 3
+        anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119,
+                   116, 90, 156, 198, 373, 326]
+        mask = [0, 1, 2]
+        x = pt.to_tensor(rng.randn(B, A * (5 + C), H, W)
+                         .astype("float32") * 0.1)
+        x.stop_gradient = False
+        gt_box = np.zeros((B, 3, 4), "float32")
+        gt_box[:, 0] = [0.5, 0.5, 0.1, 0.12]  # one real gt, rest padding
+        gt_label = np.zeros((B, 3), "int64")
+        loss = ops.yolov3_loss(x, pt.to_tensor(gt_box),
+                               pt.to_tensor(gt_label), anchors, mask, C,
+                               ignore_thresh=0.7, downsample_ratio=32)
+        assert list(loss.shape) == [B]
+        total = loss.sum()
+        total.backward()
+        g = np.asarray(x.grad.numpy())
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_yolov3_loss_padding_does_not_clobber_real_gt(self):
+        """A padding row landing on anchor 0 / cell (0,0) must not
+        overwrite a real gt's targets (regression: scatter .set clobber)."""
+        rng = np.random.RandomState(11)
+        B, C, H, W = 1, 2, 4, 4
+        anchors = [10, 14, 23, 27]
+        mask = [0, 1]
+        x = pt.to_tensor(rng.randn(B, 2 * (5 + C), H, W)
+                         .astype("float32") * 0.1)
+        gt1 = np.zeros((B, 1, 4), "float32")
+        gt1[0, 0] = [0.1, 0.1, 0.15, 0.2]  # cell (0,0)
+        lab1 = np.ones((B, 1), "int64")
+        gt2 = np.zeros((B, 2, 4), "float32")
+        gt2[0, 0] = gt1[0, 0]  # same gt + one all-zero padding row
+        lab2 = np.concatenate([lab1, np.zeros((B, 1), "int64")], axis=1)
+        l1 = float(ops.yolov3_loss(x, pt.to_tensor(gt1),
+                                   pt.to_tensor(lab1), anchors, mask, C,
+                                   0.7, 32).sum())
+        l2 = float(ops.yolov3_loss(x, pt.to_tensor(gt2),
+                                   pt.to_tensor(lab2), anchors, mask, C,
+                                   0.7, 32).sum())
+        assert l1 == pytest.approx(l2, rel=1e-6), (l1, l2)
+
+    def test_yolov3_loss_ignores_padding_rows(self):
+        B, C, H, W = 1, 2, 2, 2
+        anchors = [10, 14, 23, 27]
+        mask = [0, 1]
+        x = pt.to_tensor(np.zeros((B, 2 * (5 + C), H, W), "float32"))
+        empty = ops.yolov3_loss(
+            x, pt.to_tensor(np.zeros((B, 2, 4), "float32")),
+            pt.to_tensor(np.zeros((B, 2), "int64")), anchors, mask, C,
+            ignore_thresh=0.7, downsample_ratio=32)
+        one = ops.yolov3_loss(
+            x, pt.to_tensor(np.array([[[0.5, 0.5, 0.2, 0.2],
+                                       [0, 0, 0, 0]]], "float32")),
+            pt.to_tensor(np.zeros((B, 2), "int64")), anchors, mask, C,
+            ignore_thresh=0.7, downsample_ratio=32)
+        assert float(one.sum()) > float(empty.sum())
+
+
+def _roi_align_np(feat, roi, ph, pw, scale, sr):
+    C, H, W = feat.shape
+    x1, y1, x2, y2 = roi * scale
+    rw = max(x2 - x1, 1.0)
+    rh = max(y2 - y1, 1.0)
+    out = np.zeros((C, ph, pw), np.float32)
+    for j in range(ph):
+        for i in range(pw):
+            acc = np.zeros(C, np.float32)
+            for sj in range(sr):
+                for si in range(sr):
+                    yy = y1 + (j * sr + sj + 0.5) * rh / ph / sr
+                    xx = x1 + (i * sr + si + 0.5) * rw / pw / sr
+                    yy = min(max(yy, 0.0), H - 1.0)
+                    xx = min(max(xx, 0.0), W - 1.0)
+                    y0, x0 = int(np.floor(yy)), int(np.floor(xx))
+                    y1_, x1_ = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+                    wy, wx = yy - y0, xx - x0
+                    acc += (feat[:, y0, x0] * (1 - wy) * (1 - wx)
+                            + feat[:, y0, x1_] * (1 - wy) * wx
+                            + feat[:, y1_, x0] * wy * (1 - wx)
+                            + feat[:, y1_, x1_] * wy * wx)
+            out[:, j, i] = acc / (sr * sr)
+    return out
+
+
+class TestRoiOps:
+    def test_roi_align_matches_numpy(self):
+        rng = np.random.RandomState(7)
+        feat = rng.randn(1, 3, 8, 8).astype("float32")
+        rois = np.array([[2.0, 2.0, 12.0, 12.0],
+                         [0.0, 0.0, 6.0, 4.0]], "float32")
+        got = np.asarray(ops.roi_align(
+            pt.to_tensor(feat), pt.to_tensor(rois), pooled_height=2,
+            pooled_width=2, spatial_scale=0.5, sampling_ratio=2).numpy())
+        for r in range(2):
+            want = _roi_align_np(feat[0], rois[r], 2, 2, 0.5, 2)
+            np.testing.assert_allclose(got[r], want, atol=1e-4)
+
+    def test_roi_align_grads(self):
+        feat = pt.to_tensor(np.random.RandomState(8)
+                            .randn(1, 2, 6, 6).astype("float32"))
+        feat.stop_gradient = False
+        rois = pt.to_tensor(np.array([[1.0, 1.0, 5.0, 5.0]], "float32"))
+        out = ops.roi_align(feat, rois, 2, 2, 1.0, sampling_ratio=2)
+        out.sum().backward()
+        g = np.asarray(feat.grad.numpy())
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_roi_pool_max_semantics(self):
+        feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0.0, 0.0, 3.0, 3.0]], "float32")
+        got = np.asarray(ops.roi_pool(
+            pt.to_tensor(feat), pt.to_tensor(rois), pooled_height=2,
+            pooled_width=2, spatial_scale=1.0).numpy())
+        np.testing.assert_allclose(got[0, 0], [[5, 7], [13, 15]])
+
+    def test_rois_num_counts_semantics(self):
+        """rois_num is the fluid per-image COUNT vector, not batch ids."""
+        feat = np.stack([np.zeros((1, 4, 4), "float32"),
+                         np.ones((1, 4, 4), "float32")])
+        rois = np.array([[0.0, 0.0, 3.0, 3.0]] * 3, "float32")
+        counts = np.array([2, 1], "int32")  # 2 rois img0, 1 roi img1
+        got = np.asarray(ops.roi_pool(
+            pt.to_tensor(feat), pt.to_tensor(rois), 1, 1, 1.0,
+            rois_num=pt.to_tensor(counts)).numpy())
+        assert got[0, 0, 0, 0] == 0.0 and got[1, 0, 0, 0] == 0.0
+        assert got[2, 0, 0, 0] == 1.0
+        with pytest.raises(ValueError):
+            ops.roi_pool(pt.to_tensor(feat), pt.to_tensor(rois), 1, 1,
+                         1.0, rois_num=pt.to_tensor(
+                             np.array([1, 1], "int32")))
+
+
+class TestFocalLoss:
+    def test_matches_formula(self):
+        rng = np.random.RandomState(9)
+        x = rng.randn(6, 3).astype("float32")
+        label = np.array([0, 1, 2, 3, 1, 0], "int64")
+        fg = np.float32(4.0)
+        got = np.asarray(ops.sigmoid_focal_loss(
+            pt.to_tensor(x), pt.to_tensor(label), pt.to_tensor(fg),
+            gamma=2.0, alpha=0.25).numpy())
+        p = 1 / (1 + np.exp(-x))
+        t = np.zeros_like(x)
+        for i, l in enumerate(label):
+            if l > 0:
+                t[i, l - 1] = 1.0
+        ce = -(t * np.log(p) + (1 - t) * np.log(1 - p))
+        w = (0.25 * t + 0.75 * (1 - t)) * np.abs(t - p) ** 2.0
+        np.testing.assert_allclose(got, w * ce / 4.0, atol=1e-5)

@@ -1,0 +1,266 @@
+"""Sequence-labeling family tests: CRF (brute-force parity), chunk_eval,
+edit_distance (numpy DP parity), NCE/hsigmoid/sampled-softmax, and a
+label-semantic-roles-style BiLSTM-CRF book training test
+(ref: tests/book/test_label_semantic_roles.py)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import ops
+
+
+def _crf_brute(emission, labels_all, trans, length):
+    """Brute-force log Z and gold scores for tiny (L, T)."""
+    start, stop, pair = trans[0], trans[1], trans[2:]
+    T = emission.shape[1]
+
+    def score(path):
+        s = start[path[0]] + emission[0, path[0]]
+        for t in range(1, length):
+            s += pair[path[t - 1], path[t]] + emission[t, path[t]]
+        return s + stop[path[length - 1]]
+
+    scores = [score(p) for p in
+              itertools.product(range(T), repeat=length)]
+    return np.logaddexp.reduce(scores), score
+
+
+class TestCRF:
+    def _setup(self, B=2, L=4, T=3, seed=0):
+        rng = np.random.RandomState(seed)
+        em = rng.randn(B, L, T).astype("float32")
+        trans = rng.randn(T + 2, T).astype("float32") * 0.5
+        lab = rng.randint(0, T, (B, L)).astype("int64")
+        return em, trans, lab
+
+    def test_nll_matches_bruteforce(self):
+        em, trans, lab = self._setup()
+        B, L, T = em.shape
+        nll = np.asarray(ops.linear_chain_crf(
+            pt.to_tensor(em), pt.to_tensor(lab),
+            transition=pt.to_tensor(trans)).numpy())
+        for b in range(B):
+            logz, score = _crf_brute(em[b], None, trans, L)
+            want = logz - score(lab[b])
+            assert nll[b] == pytest.approx(want, rel=1e-4)
+
+    def test_nll_respects_length(self):
+        em, trans, lab = self._setup()
+        B, L, T = em.shape
+        lens = np.array([2, 3], "int32")
+        nll = np.asarray(ops.linear_chain_crf(
+            pt.to_tensor(em), pt.to_tensor(lab),
+            length=pt.to_tensor(lens),
+            transition=pt.to_tensor(trans)).numpy())
+        for b in range(B):
+            logz, score = _crf_brute(em[b], None, trans, lens[b])
+            want = logz - score(lab[b])
+            assert nll[b] == pytest.approx(want, rel=1e-4)
+
+    def test_decoding_matches_bruteforce(self):
+        em, trans, _ = self._setup(seed=3)
+        B, L, T = em.shape
+        path, best = ops.crf_decoding(pt.to_tensor(em),
+                                      transition=pt.to_tensor(trans))
+        path = np.asarray(path.numpy())
+        best = np.asarray(best.numpy())
+        for b in range(B):
+            _, score = _crf_brute(em[b], None, trans, L)
+            want_path = max(itertools.product(range(T), repeat=L),
+                            key=score)
+            np.testing.assert_array_equal(path[b], want_path)
+            assert best[b] == pytest.approx(score(want_path), rel=1e-4)
+
+    def test_crf_grads_flow(self):
+        em, trans, lab = self._setup()
+        emt = pt.to_tensor(em); emt.stop_gradient = False
+        trt = pt.to_tensor(trans); trt.stop_gradient = False
+        nll = ops.linear_chain_crf(emt, pt.to_tensor(lab), transition=trt)
+        nll.mean().backward()
+        assert np.isfinite(np.asarray(emt.grad.numpy())).all()
+        assert np.abs(np.asarray(trt.grad.numpy())).sum() > 0
+
+
+class TestChunkEval:
+    def test_iob_perfect(self):
+        # 2 types, IOB: B0=0 I0=1 B1=2 I1=3 O=4
+        label = np.array([[0, 1, 4, 2, 3, 4]], "int64")
+        p, r, f1, ni, nl, nc = ops.chunk_eval(label, label, "IOB", 2)
+        assert (p, r, f1) == (1.0, 1.0, 1.0)
+        assert ni == nl == nc == 2
+
+    def test_iob_partial(self):
+        label = np.array([[0, 1, 4, 2, 3, 4]], "int64")
+        pred = np.array([[0, 1, 4, 4, 2, 4]], "int64")  # 2nd chunk moved
+        p, r, f1, ni, nl, nc = ops.chunk_eval(pred, label, "IOB", 2)
+        assert nc == 1 and nl == 2 and ni == 2
+        assert p == pytest.approx(0.5) and r == pytest.approx(0.5)
+
+    def test_seq_length_mask(self):
+        label = np.array([[0, 1, 0, 0]], "int64")
+        pred = np.array([[0, 1, 4, 4]], "int64")
+        p, r, f1, ni, nl, nc = ops.chunk_eval(
+            pred, label, "IOB", 2,
+            seq_length=np.array([2], "int64"))
+        assert nc == 1 and nl == 1 and ni == 1 and f1 == 1.0
+
+
+def _edit_np(h, r):
+    dp = np.zeros((len(h) + 1, len(r) + 1), np.int64)
+    dp[:, 0] = np.arange(len(h) + 1)
+    dp[0, :] = np.arange(len(r) + 1)
+    for i in range(1, len(h) + 1):
+        for j in range(1, len(r) + 1):
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                           dp[i - 1, j - 1] + (h[i - 1] != r[j - 1]))
+    return dp[-1, -1]
+
+
+class TestEditDistance:
+    def test_matches_numpy_dp(self):
+        rng = np.random.RandomState(1)
+        hyp = rng.randint(0, 5, (4, 7)).astype("int64")
+        ref = rng.randint(0, 5, (4, 9)).astype("int64")
+        hl = np.array([7, 5, 3, 7], "int64")
+        rl = np.array([9, 4, 9, 1], "int64")
+        d, n = ops.edit_distance(hyp, ref, normalized=False,
+                                 input_length=hl, label_length=rl)
+        d = np.asarray(d.numpy())
+        assert int(np.asarray(n.numpy())) == 4
+        for b in range(4):
+            assert d[b] == _edit_np(hyp[b, :hl[b]], ref[b, :rl[b]])
+
+    def test_normalized_and_ignored(self):
+        hyp = np.array([[1, 0, 2, 0]], "int64")
+        ref = np.array([[1, 2, 3]], "int64")
+        d, _ = ops.edit_distance(hyp, ref, normalized=True,
+                                 ignored_tokens=[0])
+        # hyp -> [1,2]; ref [1,2,3]: distance 1, normalized by 3
+        assert float(np.asarray(d.numpy())[0]) == pytest.approx(1 / 3)
+
+
+class TestSampledLosses:
+    def test_nce_trains_classifier(self):
+        rng = np.random.RandomState(0)
+        V, D, B = 32, 8, 16
+        pt.seed(0)
+        W = pt.to_tensor(rng.randn(V, D).astype("float32") * 0.1)
+        W.stop_gradient = False
+        x = rng.randn(B, D).astype("float32")
+        y = rng.randint(0, V, (B,)).astype("int64")
+        loss0 = None
+        for i in range(60):
+            loss = ops.nce(pt.to_tensor(x), pt.to_tensor(y), V,
+                           num_neg_samples=8, weight=W).mean()
+            if loss0 is None:
+                loss0 = float(loss)
+            loss.backward()
+            W._replace(W._data - 0.5 * W.grad._data)
+            W.grad = None
+        assert float(loss) < loss0
+        # full softmax accuracy should now favor the true class
+        logits = x @ np.asarray(W.numpy()).T
+        assert (logits.argmax(-1) == y).mean() > 0.5
+
+    def test_hsigmoid_loss_decreases_and_classifies(self):
+        rng = np.random.RandomState(1)
+        C, D, B = 8, 16, 32
+        pt.seed(1)
+        W = pt.to_tensor(rng.randn(C - 1, D).astype("float32") * 0.1)
+        W.stop_gradient = False
+        x = rng.randn(B, D).astype("float32")
+        y = rng.randint(0, C, (B,)).astype("int64")
+        losses = []
+        for i in range(80):
+            loss = ops.hsigmoid(pt.to_tensor(x), pt.to_tensor(y), C,
+                                weight=W).mean()
+            losses.append(float(loss))
+            loss.backward()
+            W._replace(W._data - 0.5 * W.grad._data)
+            W.grad = None
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    def test_sampled_softmax_close_to_full(self):
+        """With num_samples ~ vocab the sampled loss tracks full CE."""
+        rng = np.random.RandomState(2)
+        V, D, B = 16, 8, 64
+        pt.seed(2)
+        x = rng.randn(B, D).astype("float32")
+        W = rng.randn(V, D).astype("float32") * 0.5
+        y = rng.randint(0, V, (B,)).astype("int64")
+        loss = ops.sampled_softmax_with_cross_entropy(
+            input=pt.to_tensor(x), label=pt.to_tensor(y),
+            weight=pt.to_tensor(W), num_samples=200)
+        full = x @ W.T
+        full = full - full.max(-1, keepdims=True)
+        logp = full - np.log(np.exp(full).sum(-1, keepdims=True))
+        want = -logp[np.arange(B), y]
+        got = float(np.asarray(loss.numpy()).mean())
+        # sampled-with-replacement underestimates slightly; just require
+        # the same ballpark
+        assert abs(got - want.mean()) / want.mean() < 0.35
+
+
+class TestSemanticRolesBook:
+    def test_bilstm_crf_trains(self):
+        """Compact label-semantic-roles recipe: embedding -> BiLSTM ->
+        linear emissions -> CRF loss; viterbi F1 improves
+        (ref: tests/book/test_label_semantic_roles.py)."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu import optim
+
+        rng = np.random.RandomState(0)
+        V, T, B, L, D = 40, 5, 16, 8, 16
+        pt.seed(0)
+
+        # synthetic task: tag depends on word id bucket
+        words = rng.randint(0, V, (B, L)).astype("int64")
+        tags = (words % T).astype("int64")
+
+        class Tagger(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(V, D)
+                self.lstm = nn.LSTM(D, D, direction="bidirect")
+                self.fc = nn.Linear(2 * D, T)
+                self.trans = self.create_parameter(
+                    [T + 2, T], default_initializer=pt.nn.initializer
+                    .Normal(0.0, 0.1))
+
+            def forward(self, w):
+                h, _ = self.lstm(self.emb(w))
+                return self.fc(h)
+
+        model = Tagger()
+        opt = optim.Adam(5e-3, parameters=model.parameters())
+
+        def loss_fn(m, w, t):
+            em = m(w)
+            return ops.linear_chain_crf(em, t, transition=m.trans).mean()
+
+        step = pt.TrainStep(model, opt, loss_fn)
+        losses = [float(step(words, tags)) for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+        em = model(pt.to_tensor(words))
+        path, _ = ops.crf_decoding(em, transition=model.trans)
+        acc = (np.asarray(path.numpy()) == tags).mean()
+        assert acc > 0.8, acc
+
+
+class TestChunkEvaluator:
+    def test_streaming_counts(self):
+        from paddle_tpu.metrics import ChunkEvaluator
+
+        m = ChunkEvaluator(chunk_scheme="IOB", num_chunk_types=2)
+        label = np.array([[0, 1, 4, 2, 3, 4]], "int64")
+        pred = np.array([[0, 1, 4, 4, 2, 4]], "int64")
+        m.update(pred, label)
+        m.update(label, label)
+        p, r, f1 = m.accumulate()
+        assert p == pytest.approx(3 / 4)
+        assert r == pytest.approx(3 / 4)
+        m.reset()
+        assert m.accumulate() == (0.0, 0.0, 0.0)
