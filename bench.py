@@ -208,6 +208,22 @@ def _run_benches():
 
             _log(f"{name} FAILED: {type(e).__name__}: {e}")
             _log(traceback.format_exc())
+    if "gpt" in results and not SMOKE:
+        # pallas-attributable delta: rerun GPT with the kernels disabled
+        old = os.environ.get("PADDLE_TPU_PALLAS")
+        os.environ["PADDLE_TPU_PALLAS"] = "0"
+        try:
+            t0 = time.perf_counter()
+            results["gpt_no_pallas"] = bench_gpt()
+            _log(f"gpt (pallas off): {results['gpt_no_pallas']} "
+                 f"({time.perf_counter() - t0:.0f}s incl. compile)")
+        except Exception as e:
+            _log(f"gpt pallas-off leg FAILED: {type(e).__name__}: {e}")
+        finally:
+            if old is None:
+                os.environ.pop("PADDLE_TPU_PALLAS", None)
+            else:
+                os.environ["PADDLE_TPU_PALLAS"] = old
     return results
 
 
@@ -269,6 +285,11 @@ def _score(results, headline, extras):
         extras["gpt_tokens_per_sec"] = round(
             results["gpt"]["tokens_per_sec"], 1)
         extras["gpt_mfu"] = round(results["gpt"]["mfu"], 4)
+    if "gpt_no_pallas" in results and "gpt" in results:
+        off = results["gpt_no_pallas"]["tokens_per_sec"]
+        extras["gpt_tokens_per_sec_no_pallas"] = round(off, 1)
+        extras["pallas_speedup"] = round(
+            results["gpt"]["tokens_per_sec"] / off, 3) if off else 0.0
     return {**headline, **extras}
 
 

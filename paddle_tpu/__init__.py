@@ -86,6 +86,7 @@ from . import framework
 from . import io_ as io
 from . import runtime
 from . import inference
+from . import quant
 from . import hapi
 from .hapi import Model
 # NB: ``paddle_tpu.dist`` is the p-norm distance op (paddle parity);

@@ -31,5 +31,14 @@ def unwrap(x):
     return x._data if isinstance(x, Tensor) else x
 
 
+def bce_with_logits(logit, target):
+    """Numerically stable sigmoid cross-entropy on raw logits
+    (shared by the yolo/focal/hsigmoid kernels)."""
+    import jax.numpy as jnp
+
+    return (jnp.maximum(logit, 0) - logit * target
+            + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+
 def wrap(arr, stop_gradient=True):
     return Tensor(arr, stop_gradient=stop_gradient, _internal=True)

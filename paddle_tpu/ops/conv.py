@@ -437,6 +437,149 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
     return apply("pixel_shuffle", x, upscale_factor=int(upscale_factor))
 
 
+@register("pixel_unshuffle")
+def _pixel_unshuffle(x, *, downscale_factor):
+    n, c, h, w = x.shape
+    r = downscale_factor
+    x = jnp.reshape(x, (n, c, h // r, r, w // r, r))
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return jnp.reshape(x, (n, c * r * r, h // r, w // r))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    """Inverse of pixel_shuffle (ref: pixel_shuffle_op.cc reverse)."""
+    return apply("pixel_unshuffle", x,
+                 downscale_factor=int(downscale_factor))
+
+
+@register("space_to_depth")
+def _space_to_depth(x, *, blocksize):
+    # Reference layout: block offset is the HIGH-order part of the output
+    # channel ((by*bs + bx)*C + c) — NOT pixel_unshuffle's channel-major
+    # (c*bs*bs + offset); they only coincide for C == 1.
+    n, c, h, w = x.shape
+    r = blocksize
+    x = jnp.reshape(x, (n, c, h // r, r, w // r, r))
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))  # (n, by, bx, c, h', w')
+    return jnp.reshape(x, (n, r * r * c, h // r, w // r))
+
+
+def space_to_depth(x, blocksize, name=None):
+    """ref: space_to_depth_op.cc — rearrange (B, C, H, W) spatial blocks
+    into channels: (B, bs*bs*C, H/bs, W/bs), block-offset-major."""
+    return apply("space_to_depth", x, blocksize=int(blocksize))
+
+
+@register("affine_grid")
+def _affine_grid(theta, *, out_h, out_w, align_corners):
+    n = theta.shape[0]
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, out_h)
+        xs = jnp.linspace(-1.0, 1.0, out_w)
+    else:
+        ys = (jnp.arange(out_h) * 2.0 + 1.0) / out_h - 1.0
+        xs = (jnp.arange(out_w) * 2.0 + 1.0) / out_w - 1.0
+    xg, yg = jnp.meshgrid(xs, ys)  # (H, W)
+    ones = jnp.ones_like(xg)
+    base = jnp.stack([xg, yg, ones], axis=-1)  # (H, W, 3)
+    # grid = base @ theta^T per batch: (N, H, W, 2)
+    return jnp.einsum("hwk,nik->nhwi", base, theta)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D affine sampling grid (ref: layers/nn.py affine_grid).
+
+    theta: (N, 2, 3); out_shape [N, C, H, W] -> grid (N, H, W, 2) in
+    normalized [-1, 1] xy coords (grid_sample convention)."""
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in np.asarray(unwrap(out_shape))]
+    return apply("affine_grid", theta, out_h=int(out_shape[2]),
+                 out_w=int(out_shape[3]), align_corners=bool(align_corners))
+
+
+@register("grid_sample")
+def _grid_sample(x, grid, *, mode, padding_mode, align_corners):
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]  # (N, H', W') in [-1, 1]
+    if align_corners:
+        fx = (gx + 1.0) * 0.5 * (w - 1)
+        fy = (gy + 1.0) * 0.5 * (h - 1)
+    else:
+        fx = ((gx + 1.0) * w - 1.0) * 0.5
+        fy = ((gy + 1.0) * h - 1.0) * 0.5
+
+    def reflect(v, lo, hi):
+        # triangular-wave reflection into [lo, hi] around pixel centers
+        rng = hi - lo
+        return jnp.abs(jnp.mod(v - lo, 2 * rng + 1e-12) - rng) + lo
+
+    if padding_mode == "border":
+        fx = jnp.clip(fx, 0.0, w - 1.0)
+        fy = jnp.clip(fy, 0.0, h - 1.0)
+    elif padding_mode == "reflection":
+        fx = jnp.clip(reflect(fx, 0.0, w - 1.0), 0.0, w - 1.0)
+        fy = jnp.clip(reflect(fy, 0.0, h - 1.0), 0.0, h - 1.0)
+
+    def tap(ix, iy):
+        """x[n, :, iy, ix] with zero padding OOB -> (N, H', W', C)."""
+        inb = ((ix >= 0) & (ix <= w - 1) & (iy >= 0)
+               & (iy <= h - 1))
+        ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+        v = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, iyc, ixc)
+        # v: (N, C, H', W')
+        return v * inb[:, None].astype(x.dtype)
+
+    if mode == "nearest":
+        return tap(jnp.round(fx), jnp.round(fy))
+    x0 = jnp.floor(fx)
+    y0 = jnp.floor(fy)
+    wx = (fx - x0).astype(x.dtype)[:, None]
+    wy = (fy - y0).astype(x.dtype)[:, None]
+    v00 = tap(x0, y0)
+    v01 = tap(x0 + 1, y0)
+    v10 = tap(x0, y0 + 1)
+    v11 = tap(x0 + 1, y0 + 1)
+    return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+            + v10 * (1 - wx) * wy + v11 * wx * wy)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x at normalized grid locations (ref: layers/nn.py:12182
+    grid_sampler). x (N, C, H, W); grid (N, H', W', 2) xy in [-1, 1].
+    Returns (N, C, H', W')."""
+    return apply("grid_sample", x, grid, mode=mode,
+                 padding_mode=padding_mode,
+                 align_corners=bool(align_corners))
+
+
+grid_sampler = grid_sample
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None,
+                 align_corners=True, align_mode=1, data_format="NCHW"):
+    """ref: layers/nn.py image_resize — thin front over interpolate.
+
+    Sampling follows the half-pixel-center convention (the reference's
+    align_mode=1 behavior); align_mode=0 is not implemented."""
+    modes = {"BILINEAR": "bilinear", "NEAREST": "nearest",
+             "BICUBIC": "bicubic"}
+    key = str(resample).upper()
+    if key not in modes:
+        raise ValueError(
+            f"resample={resample!r} not supported (have "
+            f"{sorted(modes)}; TRILINEAR needs 5-D resize, not "
+            "implemented)")
+    if align_mode == 0:
+        raise NotImplementedError(
+            "align_mode=0 (src_idx = scale*dst_idx) not implemented; "
+            "only the half-pixel align_mode=1 convention is")
+    return interpolate(input, size=out_shape, scale_factor=scale,
+                       mode=modes[key], align_corners=align_corners)
+
+
 @register("unfold")
 def _unfold(x, *, ksize, stride, padding, dilation):
     n, c, h, w = x.shape

@@ -257,23 +257,28 @@ def box_clip(input, im_info, name=None):
 # ---------------------------------------------------------------------------
 
 
-def _greedy_nms_mask(boxes, scores, iou_threshold, normalized):
+def _greedy_nms_mask(boxes, scores, iou_threshold, normalized,
+                     nms_eta=1.0):
     """Keep-mask (K,) bool of greedy NMS over score-sorted candidates.
     Static shapes: a lax.scan walks candidates best-first, suppressing by
-    the IoU matrix."""
+    the IoU matrix. ``nms_eta < 1`` decays the threshold after each kept
+    candidate while it exceeds 0.5 (the reference's adaptive NMS)."""
     K = boxes.shape[0]
     order = jnp.argsort(-scores)
     b_sorted = boxes[order]
     iou = _pairwise_iou(b_sorted, b_sorted, normalized)
 
-    def body(alive, i):
+    def body(carry, i):
+        alive, thr = carry
         keep_i = alive[i]
-        sup = (iou[i] > iou_threshold) & keep_i
+        sup = (iou[i] > thr) & keep_i
         alive = alive & (~sup | (jnp.arange(K) <= i))
-        return alive, keep_i
+        thr = jnp.where(keep_i & (nms_eta < 1.0) & (thr > 0.5),
+                        thr * nms_eta, thr)
+        return (alive, thr), keep_i
 
-    alive0 = jnp.ones((K,), bool)
-    _, kept_sorted = lax.scan(body, alive0, jnp.arange(K))
+    carry0 = (jnp.ones((K,), bool), jnp.float32(iou_threshold))
+    _, kept_sorted = lax.scan(body, carry0, jnp.arange(K))
     # map back to original candidate order
     keep = jnp.zeros((K,), bool).at[order].set(kept_sorted)
     return keep
@@ -293,7 +298,7 @@ def nms(boxes, scores, iou_threshold=0.3, normalized=True, name=None):
 @register("multiclass_nms")
 def _multiclass_nms(bboxes, scores, *, score_threshold, nms_top_k,
                     keep_top_k, nms_threshold, normalized,
-                    background_label):
+                    background_label, nms_eta=1.0):
     B, M = bboxes.shape[0], bboxes.shape[1]
     C = scores.shape[1]
     nms_top_k = min(nms_top_k, M) if nms_top_k > 0 else M
@@ -307,7 +312,8 @@ def _multiclass_nms(bboxes, scores, *, score_threshold, nms_top_k,
             s = jnp.where(s >= score_threshold, s, -jnp.inf)
             top_s, top_i = lax.top_k(s, nms_top_k)
             cand = boxes_i[top_i]
-            keep = _greedy_nms_mask(cand, top_s, nms_threshold, normalized)
+            keep = _greedy_nms_mask(cand, top_s, nms_threshold, normalized,
+                                    nms_eta)
             keep = keep & jnp.isfinite(top_s)
             if background_label >= 0:
                 keep = keep & (c != background_label)
@@ -340,7 +346,8 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
         "multiclass_nms", bboxes, scores,
         score_threshold=float(score_threshold), nms_top_k=int(nms_top_k),
         keep_top_k=int(keep_top_k), nms_threshold=float(nms_threshold),
-        normalized=normalized, background_label=int(background_label))
+        normalized=normalized, background_label=int(background_label),
+        nms_eta=float(nms_eta))
     return out, counts
 
 
@@ -403,8 +410,9 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
 
 
 @register("yolov3_loss")
-def _yolov3_loss(x, gt_box, gt_label, *, anchors, anchor_mask, class_num,
-                 ignore_thresh, downsample_ratio, use_label_smooth):
+def _yolov3_loss(x, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
+                 class_num, ignore_thresh, downsample_ratio,
+                 use_label_smooth):
     B, _, H, W = x.shape
     A = len(anchor_mask)
     an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
@@ -456,6 +464,8 @@ def _yolov3_loss(x, gt_box, gt_label, *, anchors, anchor_mask, class_num,
         gh / jnp.maximum(an[:, 1][local_a], 1e-10), 1e-10)))
     # box-size weighting (small boxes matter more): 2 - w*h
     t_scale = dense(2.0 - gt_box[..., 2] * gt_box[..., 3])
+    # mixup weighting: gt_score scales every positive term (ref: gt_score)
+    t_score = dense(gt_score)
 
     # class one-hot targets
     smooth_lo = 1.0 / class_num if use_label_smooth else 0.0
@@ -486,17 +496,18 @@ def _yolov3_loss(x, gt_box, gt_label, *, anchors, anchor_mask, class_num,
     ious = jnp.where(valid[:, None, :], ious, 0.0)
     ignore = (ious.max(-1) > ignore_thresh).reshape(B, A, H, W)
 
-    bce = lambda logit, t: jnp.maximum(logit, 0) - logit * t \
-        + jnp.log1p(jnp.exp(-jnp.abs(logit)))  # noqa: E731
+    from ._base import bce_with_logits as bce
+
     obj = obj_set
-    loss_xy = (t_scale * obj * (bce(px, t_x) + bce(py, t_y))) \
+    w_pos = t_scale * t_score * obj
+    loss_xy = (w_pos * (bce(px, t_x) + bce(py, t_y))).sum(axis=(1, 2, 3))
+    loss_wh = (w_pos * ((pw - t_w) ** 2 + (ph - t_h) ** 2) * 0.5) \
         .sum(axis=(1, 2, 3))
-    loss_wh = (t_scale * obj * ((pw - t_w) ** 2 + (ph - t_h) ** 2) * 0.5) \
-        .sum(axis=(1, 2, 3))
-    loss_obj = (obj * bce(pobj, 1.0)
+    loss_obj = (t_score * obj * bce(pobj, 1.0)
                 + (1.0 - obj) * (~ignore) * bce(pobj, 0.0)) \
         .sum(axis=(1, 2, 3))
-    loss_cls = (obj[:, :, None] * bce(pcls, t_cls)).sum(axis=(1, 2, 3, 4))
+    loss_cls = ((t_score * obj)[:, :, None] * bce(pcls, t_cls)) \
+        .sum(axis=(1, 2, 3, 4))
     return loss_xy + loss_wh + loss_obj + loss_cls
 
 
@@ -506,10 +517,15 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     """YOLOv3 training loss for one head (ref: detection.py:895).
 
     x: (B, A*(5+C), H, W) raw head; gt_box (B, G, 4) normalized
-    [cx, cy, w, h]; gt_label (B, G) int. Returns per-image loss (B,).
-    Dense target assignment — zero-area gt rows are padding.
+    [cx, cy, w, h]; gt_label (B, G) int; ``gt_score`` (B, G) mixup
+    weights (default 1.0) scaling every positive-sample term.
+    Returns per-image loss (B,). Dense target assignment — zero-area gt
+    rows are padding.
     """
-    return apply("yolov3_loss", x, gt_box, gt_label,
+    if gt_score is None:
+        shp = unwrap(gt_label).shape
+        gt_score = Tensor(jnp.ones(shp, jnp.float32), _internal=True)
+    return apply("yolov3_loss", x, gt_box, gt_label, gt_score,
                  anchors=tuple(anchors), anchor_mask=tuple(anchor_mask),
                  class_num=int(class_num),
                  ignore_thresh=float(ignore_thresh),
@@ -651,10 +667,12 @@ def roi_pool(input, rois, pooled_height=1, pooled_width=1,
 @register("sigmoid_focal_loss_fluid")
 def _sigmoid_focal_loss(x, label, fg_num, *, gamma, alpha):
     # label (N,) int in [0, C]: 0 = background (ref one-based fg classes)
+    from ._base import bce_with_logits
+
     C = x.shape[1]
     t = jax.nn.one_hot(label - 1, C, dtype=x.dtype)  # bg rows all-zero
     p = jax.nn.sigmoid(x)
-    ce = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ce = bce_with_logits(x, t)
     w = (alpha * t + (1 - alpha) * (1 - t)) \
         * jnp.power(jnp.abs(t - p), gamma)
     return w * ce / jnp.maximum(fg_num.astype(x.dtype), 1.0)

@@ -363,13 +363,13 @@ def _hsigmoid(x, label, weight, bias, *, num_classes):
         cur = cur >> 1
         nodes.append(jnp.clip(cur - 1, 0, C - 2))  # parent internal node
     # nodes[i] is the parent at height i+1; valid while parent index >= 1
+    from ._base import bce_with_logits
+
     for code, nidx, lvl in zip(codes, nodes, range(depth)):
         valid = ((node >> (lvl + 1)) >= 1).astype(x.dtype)
         logit = (x * weight[nidx]).sum(-1) + bias[nidx]
         # code 1 -> right child: target sigmoid(logit) = 1
-        ce = jnp.maximum(logit, 0) - logit * code \
-            + jnp.log1p(jnp.exp(-jnp.abs(logit)))
-        losses = losses + ce * valid
+        losses = losses + bce_with_logits(logit, code) * valid
     return losses
 
 

@@ -180,3 +180,194 @@ def segment_min(data, segment_ids, num_segments=None, name=None):
     if num_segments is None:
         num_segments = int(np.asarray(unwrap(segment_ids)).max()) + 1
     return apply("segment_min", data, segment_ids, num_segments=num_segments)
+
+
+# ---------------------------------------------------------------------------
+# sequence_* breadth (ref: python/paddle/fluid/layers/sequence_lod.py)
+# ---------------------------------------------------------------------------
+
+
+@register("sequence_first_step")
+def _sequence_first_step(x, lengths):
+    return x[:, 0]
+
+
+def sequence_first_step(input, lengths=None, name=None):
+    """First timestep of each sequence (ref: sequence_lod.py
+    sequence_first_step). input (B, L, ...) -> (B, ...)."""
+    if lengths is None:
+        B = unwrap(input).shape[0]
+        lengths = Tensor(jnp.full((B,), unwrap(input).shape[1],
+                                  jnp.int32), _internal=True)
+    return apply("sequence_first_step", input, lengths)
+
+
+@register("sequence_last_step")
+def _sequence_last_step(x, lengths):
+    idx = jnp.clip(lengths.astype(jnp.int32) - 1, 0, x.shape[1] - 1)
+    return jnp.take_along_axis(
+        x, idx.reshape((-1,) + (1,) * (x.ndim - 1)), axis=1)[:, 0]
+
+
+def sequence_last_step(input, lengths=None, name=None):
+    """Last VALID timestep per sequence (ref: sequence_last_step)."""
+    if lengths is None:
+        B = unwrap(input).shape[0]
+        lengths = Tensor(jnp.full((B,), unwrap(input).shape[1],
+                                  jnp.int32), _internal=True)
+    return apply("sequence_last_step", input, lengths)
+
+
+@register("sequence_softmax")
+def _sequence_softmax(x, lengths):
+    mask = jnp.arange(x.shape[1])[None, :] < lengths[:, None]
+    z = jnp.where(mask, x, -jnp.inf)
+    out = jax.nn.softmax(z.astype(jnp.float32), axis=-1).astype(x.dtype)
+    return jnp.where(mask, out, jnp.zeros((), x.dtype))
+
+
+def sequence_softmax(input, lengths=None, use_cudnn=False, name=None):
+    """Per-sequence masked softmax over the time axis
+    (ref: sequence_lod.py sequence_softmax). input (B, L)."""
+    if lengths is None:
+        B = unwrap(input).shape[0]
+        lengths = Tensor(jnp.full((B,), unwrap(input).shape[1],
+                                  jnp.int32), _internal=True)
+    return apply("sequence_softmax", input, lengths)
+
+
+@register("sequence_conv")
+def _sequence_conv(x, w, lengths, *, context_start, context_length):
+    B, L, D = x.shape
+    # gather context frames per position; OOB / beyond-length -> zeros
+    offs = jnp.arange(context_length) + context_start  # (ctx,)
+    pos = jnp.arange(L)[:, None] + offs[None, :]  # (L, ctx)
+    inb = (pos >= 0) & (pos < L)
+    in_len = pos < lengths[:, None, None]  # (B, L, ctx)
+    posc = jnp.clip(pos, 0, L - 1)
+    ctx = x[:, posc]  # (B, L, ctx, D)
+    ctx = ctx * (inb[None, :, :, None] & in_len[..., None]).astype(x.dtype)
+    flat = ctx.reshape(B, L, context_length * D)
+    return jnp.einsum("bld,do->blo", flat, w)
+
+
+def sequence_conv(input, num_filters=None, filter_size=3, stride=1,
+                  padding=True, padding_start=None, weight=None,
+                  lengths=None, bias=None, name=None, **kw):
+    """Context-window sequence convolution (ref: sequence_lod.py
+    sequence_conv): each position sees [t + padding_start,
+    t + padding_start + filter_size) frames, flattened and projected.
+
+    Functional form: pass ``weight`` (filter_size * D, num_filters).
+    input (B, L, D) dense + lengths.
+    """
+    if weight is None:
+        raise ValueError("pass weight=(filter_size * D, num_filters)")
+    if padding_start is None:
+        padding_start = -(filter_size // 2)
+    if lengths is None:
+        B = unwrap(input).shape[0]
+        lengths = Tensor(jnp.full((B,), unwrap(input).shape[1],
+                                  jnp.int32), _internal=True)
+    out = apply("sequence_conv", input, weight, lengths,
+                context_start=int(padding_start),
+                context_length=int(filter_size))
+    if bias is not None:
+        from .math import add
+
+        out = add(out, bias)
+    return out
+
+
+@register("sequence_reshape")
+def _sequence_reshape(x, *, new_dim):
+    B, L, D = x.shape
+    return x.reshape(B, L * D // new_dim, new_dim)
+
+
+def sequence_reshape(input, new_dim, name=None):
+    """Re-chunk each sequence's flattened payload into new_dim columns
+    (ref: sequence_lod.py sequence_reshape). (B, L, D) ->
+    (B, L*D/new_dim, new_dim); lengths scale by D/new_dim."""
+    D = unwrap(input).shape[-1]
+    L = unwrap(input).shape[1]
+    if (L * D) % new_dim != 0:
+        raise ValueError(f"L*D = {L * D} not divisible by {new_dim}")
+    return apply("sequence_reshape", input, new_dim=int(new_dim))
+
+
+@register("sequence_scatter")
+def _sequence_scatter(x, index, updates, lengths, *, overwrite):
+    # x (B, N, ...); index (B, K) positions; updates (B, K, ...)
+    valid = index < lengths[:, None]
+    safe = jnp.where(valid, index, x.shape[1]).astype(jnp.int32)
+
+    def one(row, idx, upd):
+        if overwrite:
+            return row.at[idx].set(upd, mode="drop")
+        return row.at[idx].add(upd, mode="drop")
+
+    vshape = (valid.shape + (1,) * (updates.ndim - 2))
+    upd = updates * valid.reshape(vshape).astype(updates.dtype) \
+        if not overwrite else updates
+    return jax.vmap(one)(x, safe, upd)
+
+
+def sequence_scatter(input, index, updates, lengths=None, overwrite=False,
+                     name=None):
+    """Scatter updates into per-sequence positions (ref: sequence_lod.py
+    sequence_scatter; add-semantics by default like the reference).
+    input (B, N, ...), index (B, K) int, updates (B, K, ...)."""
+    if lengths is None:
+        B = unwrap(input).shape[0]
+        lengths = Tensor(jnp.full((B,), unwrap(input).shape[1],
+                                  jnp.int32), _internal=True)
+    return apply("sequence_scatter", input, index, updates, lengths,
+                 overwrite=bool(overwrite))
+
+
+@register("sequence_enumerate")
+def _sequence_enumerate(x, lengths, *, win_size, pad_value):
+    B, L = x.shape
+    pos = jnp.arange(L)[:, None] + jnp.arange(win_size)[None, :]
+    inb = (pos[None] < lengths[:, None, None])  # within this row's length
+    posc = jnp.clip(pos, 0, L - 1)
+    win = x[:, posc]  # (B, L, win)
+    return jnp.where(inb, win, jnp.full((), pad_value, x.dtype))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, lengths=None,
+                       name=None):
+    """All length-win_size subsequences per position, padded past the
+    sequence end (ref: sequence_lod.py sequence_enumerate).
+    input (B, L) int -> (B, L, win_size)."""
+    if lengths is None:
+        B = unwrap(input).shape[0]
+        lengths = Tensor(jnp.full((B,), unwrap(input).shape[1],
+                                  jnp.int32), _internal=True)
+    return apply("sequence_enumerate", input, lengths,
+                 win_size=int(win_size), pad_value=int(pad_value))
+
+
+@register("sequence_slice")
+def _sequence_slice(x, offset, length, *, maxlen):
+    B, L = x.shape[0], x.shape[1]
+    pos = offset[:, None].astype(jnp.int32) + jnp.arange(maxlen)[None, :]
+    valid = jnp.arange(maxlen)[None, :] < length[:, None]
+    posc = jnp.clip(pos, 0, L - 1)
+    out = jnp.take_along_axis(
+        x, posc.reshape(pos.shape + (1,) * (x.ndim - 2)), axis=1)
+    return jnp.where(valid.reshape(valid.shape + (1,) * (x.ndim - 2)),
+                     out, jnp.zeros((), x.dtype))
+
+
+def sequence_slice(input, offset, length, maxlen=None, name=None):
+    """Per-sequence slice [offset, offset+length) (ref: sequence_lod.py
+    sequence_slice). Dense output padded to ``maxlen`` (defaults to the
+    host max of ``length``); returns (sliced (B, maxlen, ...), length)."""
+    ln = unwrap(length)
+    if maxlen is None:
+        maxlen = int(np.asarray(ln).max())
+    out = apply("sequence_slice", input, offset, length,
+                maxlen=int(maxlen))
+    return out, length

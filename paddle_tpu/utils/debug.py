@@ -1,0 +1,153 @@
+"""Debug dumps: program graphs and compiler IR.
+
+Ref (capability target): python/paddle/fluid/graphviz.py (GraphPreviewGenerator),
+debugger.py (draw_block_graphviz), and the reference's habit of printing
+ProgramDesc text. TPU-native additions: jaxpr and XLA-HLO dumps of any
+traceable callable — the IRs that actually matter on this backend.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["program_to_dot", "draw_program", "dump_jaxpr", "dump_hlo"]
+
+
+def _esc(s):
+    return str(s).replace('"', '\\"')
+
+
+def program_to_dot(program, graph_name="program", max_label=40):
+    """Render a static Program's op/var graph as graphviz dot text
+    (ref: debugger.py draw_block_graphviz).
+
+    Vars are ellipses (persistables shaded), ops are boxes; edges follow
+    input/output names through the single global block.
+    """
+    lines = [f'digraph "{_esc(graph_name)}" {{',
+             "  rankdir=TB;",
+             '  node [fontsize=10, fontname="Helvetica"];']
+    blk = program.global_block
+    seen_vars = set()
+
+    def var_node(name):
+        if name in seen_vars or name is None:
+            return
+        seen_vars.add(name)
+        style = ""
+        if blk.has_var(name):
+            v = blk.var(name)
+            shape = getattr(v, "shape", None)
+            label = f"{name}\\n{list(shape) if shape is not None else ''}"
+            if getattr(v, "persistable", False):
+                style = ', style=filled, fillcolor="lightsteelblue"'
+        else:
+            label = name
+        lines.append(
+            f'  "v_{_esc(name)}" [label="{_esc(label[:max_label])}", '
+            f"shape=ellipse{style}];")
+
+    for i, op in enumerate(blk.ops):
+        label = op.type[:max_label]
+        lines.append(
+            f'  "op_{i}" [label="{_esc(label)}", shape=box, '
+            'style=filled, fillcolor="honeydew"];')
+        for n in op.input_names:
+            if n is not None:
+                var_node(n)
+                lines.append(f'  "v_{_esc(n)}" -> "op_{i}";')
+        for n in op.output_names:
+            if n is not None:
+                var_node(n)
+                lines.append(f'  "op_{i}" -> "v_{_esc(n)}";')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def draw_program(program, path, graph_name="program"):
+    """Write <path> (.dot text); if graphviz's ``dot`` binary exists and
+    path ends in .png/.pdf/.svg, also render it. Returns the dot path."""
+    dot = program_to_dot(program, graph_name=graph_name)
+    base, ext = os.path.splitext(path)
+    dot_path = path if ext == ".dot" else base + ".dot"
+    d = os.path.dirname(dot_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(dot_path, "w") as f:
+        f.write(dot)
+    if ext in (".png", ".pdf", ".svg"):
+        import shutil
+        import subprocess
+
+        if shutil.which("dot"):
+            subprocess.run(["dot", f"-T{ext[1:]}", dot_path, "-o", path],
+                           check=False)
+    return dot_path
+
+
+def _purify(fn_or_layer):
+    """A jax-traceable callable from a Layer (its forward with concrete
+    params baked) or a plain function over Tensors/arrays."""
+    from ..core import dispatch
+    from ..core.tensor import Tensor
+    from ..nn.layer import Layer
+
+    if isinstance(fn_or_layer, Layer):
+        layer = fn_or_layer
+
+        def pure(*arrays):
+            with dispatch.no_grad(), dispatch.fresh_tape():
+                ts = [Tensor(a, _internal=True) for a in arrays]
+                out = layer(*ts)
+            return out._data if isinstance(out, Tensor) else out
+
+        return pure
+
+    def pure_fn(*arrays):
+        with dispatch.no_grad(), dispatch.fresh_tape():
+            ts = [Tensor(a, _internal=True) for a in arrays]
+            out = fn_or_layer(*ts)
+        return out._data if isinstance(out, Tensor) else out
+
+    return pure_fn
+
+
+def dump_jaxpr(fn_or_layer, *example_args, path=None):
+    """The jaxpr of a Layer/function on example inputs — this backend's
+    'program text' (analog of the reference's ProgramDesc dump)."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    arrays = [a._data if hasattr(a, "_data") else jnp.asarray(np.asarray(a))
+              for a in example_args]
+    text = str(jax.make_jaxpr(_purify(fn_or_layer))(*arrays))
+    if path:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+def dump_hlo(fn_or_layer, *example_args, path=None, optimized=False):
+    """XLA HLO for a Layer/function: what actually runs on the chip.
+    ``optimized=True`` returns the post-fusion compiled module."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    arrays = [a._data if hasattr(a, "_data") else jnp.asarray(np.asarray(a))
+              for a in example_args]
+    lowered = jax.jit(_purify(fn_or_layer)).lower(*arrays)
+    if optimized:
+        text = lowered.compile().as_text()
+    else:
+        text = lowered.as_text()
+    if path:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
+    return text

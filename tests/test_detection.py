@@ -265,6 +265,43 @@ class TestYolo:
                                    0.7, 32).sum())
         assert l1 == pytest.approx(l2, rel=1e-6), (l1, l2)
 
+    def test_yolov3_loss_gt_score_weights(self):
+        """Mixup gt_score scales positive terms: score 0.5 must sit
+        between score 0 (background-only) and score 1."""
+        rng = np.random.RandomState(12)
+        B, C, H, W = 1, 2, 2, 2
+        anchors = [10, 14, 23, 27]
+        x = pt.to_tensor(rng.randn(B, 2 * (5 + C), H, W)
+                         .astype("float32") * 0.1)
+        gt = np.array([[[0.5, 0.5, 0.2, 0.2]]], "float32")
+        lab = np.ones((B, 1), "int64")
+
+        def loss_at(s):
+            return float(ops.yolov3_loss(
+                x, pt.to_tensor(gt), pt.to_tensor(lab), anchors, [0, 1],
+                C, 0.7, 32,
+                gt_score=pt.to_tensor(np.full((B, 1), s, "float32"))
+            ).sum())
+
+        l0, l5, l1 = loss_at(0.0), loss_at(0.5), loss_at(1.0)
+        assert l0 < l5 < l1, (l0, l5, l1)
+
+    def test_nms_eta_adaptive_keeps_more(self):
+        """nms_eta < 1 decays the threshold, so it can only suppress
+        MORE than fixed-threshold NMS (fewer or equal boxes kept)."""
+        rng = np.random.RandomState(13)
+        M = 12
+        boxes = _rand_boxes(rng, M, scale=10.0).reshape(1, M, 4)
+        scores = rng.rand(1, 1, M).astype("float32")
+        _, c_fixed = ops.multiclass_nms(
+            pt.to_tensor(boxes), pt.to_tensor(scores), 0.1, M, M,
+            nms_threshold=0.9, background_label=-1)
+        _, c_adapt = ops.multiclass_nms(
+            pt.to_tensor(boxes), pt.to_tensor(scores), 0.1, M, M,
+            nms_threshold=0.9, nms_eta=0.5, background_label=-1)
+        assert int(np.asarray(c_adapt.numpy())[0]) <= \
+            int(np.asarray(c_fixed.numpy())[0])
+
     def test_yolov3_loss_ignores_padding_rows(self):
         B, C, H, W = 1, 2, 2, 2
         anchors = [10, 14, 23, 27]

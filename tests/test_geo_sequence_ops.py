@@ -1,0 +1,194 @@
+"""Image geometric ops (grid_sample/affine_grid/pixel (un)shuffle/
+space_to_depth) and sequence_* breadth — numpy parity tests
+(ref: layers/nn.py:12182 grid_sampler, affine_grid; sequence_lod.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import ops
+
+
+class TestAffineGridSample:
+    def test_identity_affine_roundtrip(self):
+        """Identity theta + grid_sample reproduces the input."""
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 5, 7).astype("float32")
+        theta = np.tile(np.array([[[1., 0., 0.], [0., 1., 0.]]],
+                                 "float32"), (2, 1, 1))
+        grid = ops.affine_grid(pt.to_tensor(theta), [2, 3, 5, 7])
+        out = ops.grid_sample(pt.to_tensor(x), grid)
+        np.testing.assert_allclose(np.asarray(out.numpy()), x, atol=1e-5)
+
+    def test_horizontal_flip(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 1, 4, 6).astype("float32")
+        theta = np.array([[[-1., 0., 0.], [0., 1., 0.]]], "float32")
+        grid = ops.affine_grid(pt.to_tensor(theta), [1, 1, 4, 6])
+        out = np.asarray(ops.grid_sample(pt.to_tensor(x), grid).numpy())
+        np.testing.assert_allclose(out, x[:, :, :, ::-1], atol=1e-5)
+
+    def test_translation_zero_padding(self):
+        x = np.ones((1, 1, 4, 4), "float32")
+        # shift right by a full half-extent: left half samples OOB
+        theta = np.array([[[1., 0., -1.], [0., 1., 0.]]], "float32")
+        grid = ops.affine_grid(pt.to_tensor(theta), [1, 1, 4, 4])
+        out = np.asarray(ops.grid_sample(pt.to_tensor(x), grid,
+                                         padding_mode="zeros").numpy())
+        assert out[0, 0, 0, 0] == 0.0  # pulled from beyond the left edge
+        assert out[0, 0, 0, -1] == 1.0
+
+    def test_border_padding_and_nearest(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        theta = np.array([[[2., 0., 0.], [0., 2., 0.]]], "float32")
+        grid = ops.affine_grid(pt.to_tensor(theta), [1, 1, 4, 4])
+        out = np.asarray(ops.grid_sample(
+            pt.to_tensor(x), grid, mode="nearest",
+            padding_mode="border").numpy())
+        assert out[0, 0, 0, 0] == 0.0  # clamped to corner
+        assert out[0, 0, -1, -1] == 15.0
+
+    def test_grid_sample_grads(self):
+        rng = np.random.RandomState(2)
+        x = pt.to_tensor(rng.randn(1, 2, 6, 6).astype("float32"))
+        x.stop_gradient = False
+        theta = pt.to_tensor(np.array(
+            [[[0.8, 0.1, 0.05], [-0.1, 0.9, -0.05]]], "float32"))
+        theta.stop_gradient = False
+        grid = ops.affine_grid(theta, [1, 2, 6, 6])
+        out = ops.grid_sample(x, grid)
+        out.sum().backward()
+        assert np.isfinite(np.asarray(x.grad.numpy())).all()
+        assert np.abs(np.asarray(theta.grad.numpy())).sum() > 0
+
+
+class TestShuffleOps:
+    def test_pixel_shuffle_unshuffle_roundtrip(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 8, 3, 5).astype("float32")
+        up = ops.pixel_shuffle(pt.to_tensor(x), 2)
+        assert list(up.shape) == [2, 2, 6, 10]
+        back = ops.pixel_unshuffle(up, 2)
+        np.testing.assert_allclose(np.asarray(back.numpy()), x, atol=1e-6)
+
+    def test_space_to_depth_blocks(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        out = np.asarray(ops.space_to_depth(pt.to_tensor(x), 2).numpy())
+        assert out.shape == (1, 4, 2, 2)
+        # channel 0 holds the top-left element of each 2x2 block
+        np.testing.assert_allclose(out[0, 0], [[0, 2], [8, 10]])
+
+    def test_space_to_depth_multichannel_layout(self):
+        """Reference layout is block-offset-major: out channel
+        (by*bs + bx)*C + c — distinct from pixel_unshuffle when C > 1."""
+        x = np.arange(8, dtype="float32").reshape(1, 2, 2, 2)
+        out = np.asarray(ops.space_to_depth(pt.to_tensor(x), 2).numpy())
+        assert out.shape == (1, 8, 1, 1)
+        # offset (0,0): channels [x[0,0,0], x[1,0,0]] = [0, 4], then
+        # offset (0,1): [1, 5], (1,0): [2, 6], (1,1): [3, 7]
+        np.testing.assert_allclose(out[0, :, 0, 0],
+                                   [0, 4, 1, 5, 2, 6, 3, 7])
+
+
+class TestSequenceSteps:
+    def test_first_and_last_step(self):
+        x = np.arange(24, dtype="float32").reshape(2, 4, 3)
+        lens = np.array([2, 4], "int32")
+        first = np.asarray(ops.sequence_first_step(
+            pt.to_tensor(x), pt.to_tensor(lens)).numpy())
+        last = np.asarray(ops.sequence_last_step(
+            pt.to_tensor(x), pt.to_tensor(lens)).numpy())
+        np.testing.assert_allclose(first, x[:, 0])
+        np.testing.assert_allclose(last[0], x[0, 1])
+        np.testing.assert_allclose(last[1], x[1, 3])
+
+    def test_sequence_softmax_masked(self):
+        x = np.array([[1.0, 2.0, 3.0, 50.0]], "float32")
+        lens = np.array([3], "int32")
+        out = np.asarray(ops.sequence_softmax(
+            pt.to_tensor(x), pt.to_tensor(lens)).numpy())
+        assert out[0, 3] == 0.0
+        np.testing.assert_allclose(out[0, :3].sum(), 1.0, atol=1e-6)
+        want = np.exp(x[0, :3]) / np.exp(x[0, :3]).sum()
+        np.testing.assert_allclose(out[0, :3], want, atol=1e-6)
+
+
+class TestSequenceConv:
+    def test_matches_numpy_window(self):
+        rng = np.random.RandomState(4)
+        B, L, D, F = 2, 5, 3, 4
+        x = rng.randn(B, L, D).astype("float32")
+        w = rng.randn(3 * D, F).astype("float32")
+        lens = np.array([5, 3], "int32")
+        out = np.asarray(ops.sequence_conv(
+            pt.to_tensor(x), filter_size=3, weight=pt.to_tensor(w),
+            lengths=pt.to_tensor(lens)).numpy())
+        for b in range(B):
+            for t in range(L):
+                ctx = []
+                for o in (-1, 0, 1):
+                    p = t + o
+                    if 0 <= p < lens[b]:
+                        ctx.append(x[b, p])
+                    else:
+                        ctx.append(np.zeros(D, "float32"))
+                want = np.concatenate(ctx) @ w
+                np.testing.assert_allclose(out[b, t], want, atol=1e-5)
+
+
+class TestSequenceReshape:
+    def test_rechunk(self):
+        x = np.arange(24, dtype="float32").reshape(2, 2, 6)
+        out = np.asarray(ops.sequence_reshape(pt.to_tensor(x), 3).numpy())
+        assert out.shape == (2, 4, 3)
+        np.testing.assert_allclose(out.reshape(2, -1), x.reshape(2, -1))
+        with pytest.raises(ValueError):
+            ops.sequence_reshape(pt.to_tensor(x), 5)
+
+
+class TestSequenceScatter:
+    def test_add_and_overwrite(self):
+        x = np.zeros((2, 5, 2), "float32")
+        idx = np.array([[0, 2], [1, 9]], "int64")  # 9 out of range
+        upd = np.ones((2, 2, 2), "float32")
+        lens = np.array([5, 5], "int32")
+        out = np.asarray(ops.sequence_scatter(
+            pt.to_tensor(x), pt.to_tensor(idx), pt.to_tensor(upd),
+            lengths=pt.to_tensor(lens)).numpy())
+        assert out[0, 0, 0] == 1.0 and out[0, 2, 0] == 1.0
+        assert out[1, 1, 0] == 1.0
+        assert out.sum() == 6.0  # OOB row dropped
+        # add semantics accumulate
+        out2 = np.asarray(ops.sequence_scatter(
+            pt.to_tensor(out), pt.to_tensor(idx), pt.to_tensor(upd),
+            lengths=pt.to_tensor(lens)).numpy())
+        assert out2[0, 0, 0] == 2.0
+
+
+class TestSequenceEnumerate:
+    def test_windows(self):
+        x = np.array([[1, 2, 3, 4]], "int64")
+        out = np.asarray(ops.sequence_enumerate(
+            pt.to_tensor(x), 2, pad_value=0).numpy())
+        np.testing.assert_array_equal(
+            out[0], [[1, 2], [2, 3], [3, 4], [4, 0]])
+
+    def test_respects_lengths(self):
+        x = np.array([[1, 2, 3, 4]], "int64")
+        out = np.asarray(ops.sequence_enumerate(
+            pt.to_tensor(x), 2, pad_value=-1,
+            lengths=pt.to_tensor(np.array([3], "int32"))).numpy())
+        np.testing.assert_array_equal(
+            out[0], [[1, 2], [2, 3], [3, -1], [-1, -1]])
+
+
+class TestSequenceSlice:
+    def test_slice_per_row(self):
+        x = np.arange(20, dtype="float32").reshape(2, 10)
+        off = np.array([2, 5], "int64")
+        ln = np.array([3, 2], "int64")
+        out, lens = ops.sequence_slice(pt.to_tensor(x), pt.to_tensor(off),
+                                       pt.to_tensor(ln))
+        o = np.asarray(out.numpy())
+        assert o.shape == (2, 3)
+        np.testing.assert_allclose(o[0], [2, 3, 4])
+        np.testing.assert_allclose(o[1], [15, 16, 0])  # padded past len

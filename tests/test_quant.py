@@ -1,0 +1,121 @@
+"""Quantization tests: fake-quant STE, weight-only PTQ accuracy, QAT
+training loop, int8 storage (ref: contrib/slim/quantization)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import optim
+from paddle_tpu.quant import (fake_quantize_abs_max, quantize_abs_max,
+                              dequantize, quantize_model, QuantizedLinear,
+                              PostTrainingQuantization, QAT)
+
+
+def _classifier(seed=0):
+    pt.seed(seed)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def _data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    means = rng.randn(4, 16).astype("float32") * 2.0
+    y = rng.randint(0, 4, n)
+    x = (means[y] + rng.randn(n, 16) * 0.4).astype("float32")
+    return x, y.astype("int64")
+
+
+def _train(model, x, y, steps=40, lr=5e-3):
+    opt = optim.Adam(lr, parameters=model.parameters())
+    step = pt.TrainStep(model, opt,
+                        lambda m, a, b: F.cross_entropy(m(a), b))
+    return [float(step(x, y)) for _ in range(steps)]
+
+
+def _acc(model, x, y):
+    model.eval()
+    logits = np.asarray(model(pt.to_tensor(x)).numpy())
+    return (logits.argmax(-1) == y).mean()
+
+
+class TestFakeQuant:
+    def test_quant_error_bounded(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 32).astype("float32")
+        q = np.asarray(fake_quantize_abs_max(pt.to_tensor(x),
+                                             bits=8).numpy())
+        step = np.abs(x).max() / 127
+        assert np.abs(q - x).max() <= step / 2 + 1e-6
+
+    def test_straight_through_gradient(self):
+        x = pt.to_tensor(np.linspace(-1, 1, 11).astype("float32"))
+        x.stop_gradient = False
+        fake_quantize_abs_max(x, bits=8).sum().backward()
+        g = np.asarray(x.grad.numpy())
+        np.testing.assert_allclose(g, np.ones_like(g))  # STE: all pass
+
+    def test_per_channel_scales(self):
+        w = np.stack([np.ones(4, "float32"), 100 * np.ones(4, "float32")],
+                     axis=1)  # (4, 2): channels differ 100x
+        q, s = quantize_abs_max(w, bits=8, channel_axis=1)
+        assert q.dtype == np.int8
+        deq = np.asarray(dequantize(q, s))
+        np.testing.assert_allclose(deq, w, rtol=1e-2)
+
+
+class TestPTQ:
+    def test_weight_only_accuracy_close(self):
+        x, y = _data()
+        model = _classifier()
+        _train(model, x, y)
+        fp_acc = _acc(model, x, y)
+        quantize_model(model)
+        assert isinstance(model[0], QuantizedLinear)
+        q_acc = _acc(model, x, y)
+        assert fp_acc > 0.9
+        assert q_acc >= fp_acc - 0.05, (fp_acc, q_acc)
+        # weights really stored int8
+        assert str(model[0].qweight.dtype) == "int8"
+
+    def test_calibration_records_act_scales(self):
+        from paddle_tpu.io_.dataset import TensorDataset
+        from paddle_tpu.io_.dataloader import DataLoader
+
+        x, y = _data(64)
+        model = _classifier()
+        _train(model, x, y, steps=10)
+        loader = DataLoader(TensorDataset([x, y]), batch_size=16)
+        ptq = PostTrainingQuantization(model, loader, batch_nums=2)
+        qmodel = ptq.quantize()
+        qlayers = [l for _, l in qmodel.named_sublayers()
+                   if isinstance(l, QuantizedLinear)]
+        assert len(qlayers) == 2
+        assert all(getattr(l, "act_scale", 0) > 0 for l in qlayers)
+
+    def test_state_dict_roundtrip_after_quant(self):
+        x, y = _data(32)
+        model = _classifier()
+        _train(model, x, y, steps=5)
+        quantize_model(model)
+        sd = model.state_dict()
+        model2 = quantize_model(_classifier(seed=1))
+        model2.set_state_dict(sd)
+        o1 = np.asarray(model(pt.to_tensor(x[:4])).numpy())
+        o2 = np.asarray(model2(pt.to_tensor(x[:4])).numpy())
+        np.testing.assert_allclose(o1, o2, atol=1e-6)
+
+
+class TestQAT:
+    def test_qat_trains_and_converts(self):
+        x, y = _data()
+        model = _classifier()
+        qat = QAT(bits=8)
+        qat.quantize(model)
+        losses = _train(model, x, y, steps=50)
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        qat_acc = _acc(model, x, y)
+        qat.convert(model)
+        int8_acc = _acc(model, x, y)
+        assert qat_acc > 0.9
+        # QAT-trained weights should survive real int8 conversion
+        assert int8_acc >= qat_acc - 0.05, (qat_acc, int8_acc)
