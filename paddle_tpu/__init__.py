@@ -88,6 +88,7 @@ from . import runtime
 from . import inference
 from . import quant
 from . import hapi
+from . import dataset
 from .hapi import Model
 # NB: ``paddle_tpu.dist`` is the p-norm distance op (paddle parity);
 # the distributed package binds as ``paddle_tpu.distributed``. A plain

@@ -1,0 +1,25 @@
+"""paddle_tpu.dataset — reader-creator API parity with paddle.dataset.
+
+Ref (capability target): python/paddle/dataset/{mnist,cifar,uci_housing,
+imdb,imikolov,movielens,wmt16,conll05}.py — each module exposes
+``train()`` / ``test()`` reader creators yielding per-sample tuples.
+
+This environment has zero network egress, so the readers are backed by
+DETERMINISTIC SYNTHETIC data with the same sample shapes, dtypes, vocab
+structure, and separability properties as the originals (class-mean
+images, n-gram text with Zipfian vocab, etc.) — enough to train every
+book-chapter model end to end and exercise identical input pipelines.
+Swap in the real files by pointing the loaders at a data directory if
+one exists.
+"""
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import movielens  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import conll05  # noqa: F401
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov",
+           "movielens", "wmt16", "conll05"]

@@ -1,0 +1,47 @@
+"""Shared synthetic-data helpers for the dataset readers."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def class_mean_images(n, shape, classes, seed, noise=0.35, flat=True):
+    """Separable image-classification data: per-class mean + noise,
+    scaled to the reference's [-1, 1] convention."""
+    rng = np.random.RandomState(seed)
+    means = rng.randn(classes, *shape).astype("float32")
+    y = rng.randint(0, classes, n)
+    x = means[y] + rng.randn(n, *shape).astype("float32") * noise
+    x = np.tanh(x)  # into [-1, 1]
+    if flat:
+        x = x.reshape(n, -1)
+    return x.astype("float32"), y.astype("int64")
+
+
+def zipf_sentences(n, vocab, min_len, max_len, seed, order=2):
+    """Markov text with a Zipfian unigram marginal: learnable n-gram
+    structure for language-model chapters."""
+    rng = np.random.RandomState(seed)
+    probs = 1.0 / np.arange(1, vocab + 1) ** 1.1
+    probs /= probs.sum()
+    # deterministic bigram kernel: next word drawn near f(prev)
+    shift = rng.randint(1, vocab, size=vocab)
+    sents = []
+    for _ in range(n):
+        L = rng.randint(min_len, max_len + 1)
+        w = [int(rng.choice(vocab, p=probs))]
+        for _ in range(L - 1):
+            if rng.rand() < 0.6:  # predictable transition
+                w.append(int((w[-1] + shift[w[-1]]) % vocab))
+            else:
+                w.append(int(rng.choice(vocab, p=probs)))
+        sents.append(w)
+    return sents
+
+
+def reader_creator(samples):
+    """paddle.dataset convention: a creator returning a fresh generator."""
+    def reader():
+        for s in samples:
+            yield s
+
+    return reader

@@ -1,0 +1,26 @@
+"""CIFAR readers (ref: python/paddle/dataset/cifar.py: train10/test10,
+train100/test100 yield ((3072,) float32, int)). Synthetic."""
+from ._synth import class_mean_images, reader_creator
+
+_N_TRAIN, _N_TEST = 2048, 512
+
+
+def _make(n, classes, seed):
+    x, y = class_mean_images(n, (3, 32, 32), classes, seed)
+    return reader_creator(list(zip(x, y)))
+
+
+def train10():
+    return _make(_N_TRAIN, 10, 10)
+
+
+def test10():
+    return _make(_N_TEST, 10, 11)
+
+
+def train100():
+    return _make(_N_TRAIN, 100, 12)
+
+
+def test100():
+    return _make(_N_TEST, 100, 13)

@@ -1,0 +1,49 @@
+"""CoNLL-05 SRL readers (ref: python/paddle/dataset/conll05.py:
+get_dict(), test() yields (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1,
+ctx_p2, verb_ids, mark, label_ids)). Synthetic: labels follow word
+identity + predicate distance, which a BiLSTM-CRF tagger can learn."""
+import numpy as np
+
+from ._synth import reader_creator
+
+_WORDS, _VERBS, _LABELS = 4459, 3162, 59
+
+
+def get_dict():
+    word_dict = {("w%d" % i): i for i in range(_WORDS)}
+    verb_dict = {("v%d" % i): i for i in range(_VERBS)}
+    label_dict = {("l%d" % i): i for i in range(_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    return np.random.RandomState(17).randn(_WORDS, 32).astype("float32")
+
+
+def _make(n, seed):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        L = rng.randint(5, 20)
+        words = rng.randint(0, _WORDS, L)
+        pred_pos = int(rng.randint(0, L))
+        verb = int(rng.randint(0, _VERBS))
+        mark = [1 if i == pred_pos else 0 for i in range(L)]
+        labels = [(int(w) + abs(i - pred_pos)) % _LABELS
+                  for i, w in enumerate(words)]
+        ctx = words.tolist()
+
+        def shift(k):
+            return [ctx[min(max(i + k, 0), L - 1)] for i in range(L)]
+
+        out.append((words.tolist(), shift(-2), shift(-1), shift(0),
+                    shift(1), shift(2), [verb] * L, mark, labels))
+    return reader_creator(out)
+
+
+def train():
+    return _make(512, 18)
+
+
+def test():
+    return _make(128, 19)

@@ -1,0 +1,35 @@
+"""IMDB sentiment readers (ref: python/paddle/dataset/imdb.py:
+word_dict(), train(word_idx)/test(word_idx) yield ([ids], 0/1)).
+Synthetic: positive/negative classes draw from shifted vocab regions,
+so conv/LSTM sentiment models separate them."""
+import numpy as np
+
+from ._synth import reader_creator
+
+_VOCAB = 5148  # mirrors the real dict size order
+
+
+def word_dict():
+    return {("w%d" % i).encode(): i for i in range(_VOCAB)}
+
+
+def _make(n, seed, word_idx):
+    rng = np.random.RandomState(seed)
+    v = len(word_idx)
+    half = v // 2
+    out = []
+    for _ in range(n):
+        lab = int(rng.randint(0, 2))
+        L = rng.randint(16, 64)
+        base = rng.randint(0, half, L)
+        ids = base + (half if lab else 0)
+        out.append((ids.astype(np.int64).tolist(), lab))
+    return reader_creator(out)
+
+
+def train(word_idx):
+    return _make(1024, 4, word_idx)
+
+
+def test(word_idx):
+    return _make(256, 5, word_idx)

@@ -1,0 +1,27 @@
+"""PTB-style n-gram readers (ref: python/paddle/dataset/imikolov.py:
+build_dict(), train(word_idx, n)/test(word_idx, n) yield n-gram tuples).
+Synthetic Markov text — word2vec learns its transition structure."""
+from ._synth import zipf_sentences, reader_creator
+
+_VOCAB = 2074
+
+
+def build_dict(min_word_freq=50):
+    return {("w%d" % i).encode(): i for i in range(_VOCAB)}
+
+
+def _make(n_sent, seed, word_idx, n):
+    sents = zipf_sentences(n_sent, len(word_idx), n + 2, 24, seed)
+    grams = []
+    for s in sents:
+        for i in range(len(s) - n + 1):
+            grams.append(tuple(s[i:i + n]))
+    return reader_creator(grams)
+
+
+def train(word_idx, n):
+    return _make(256, 6, word_idx, n)
+
+
+def test(word_idx, n):
+    return _make(64, 7, word_idx, n)

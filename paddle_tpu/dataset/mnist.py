@@ -1,0 +1,18 @@
+"""MNIST readers (ref: python/paddle/dataset/mnist.py API: train()/test()
+yield ((784,) float32 in [-1,1], int label)). Synthetic — see package doc."""
+from ._synth import class_mean_images, reader_creator
+
+_N_TRAIN, _N_TEST = 2048, 512
+
+
+def _make(n, seed):
+    x, y = class_mean_images(n, (1, 28, 28), 10, seed)
+    return reader_creator(list(zip(x, y)))
+
+
+def train():
+    return _make(_N_TRAIN, 0)
+
+
+def test():
+    return _make(_N_TEST, 1)

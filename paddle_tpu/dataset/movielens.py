@@ -1,0 +1,38 @@
+"""MovieLens readers (ref: python/paddle/dataset/movielens.py:
+train()/test() yield (user_id, gender_id, age_id, job_id, movie_id,
+category_ids, title_ids, rating)). Synthetic with a low-rank
+user x movie preference structure the recommender can learn."""
+import numpy as np
+
+from ._synth import reader_creator
+
+_USERS, _MOVIES, _CATS, _TITLE_VOCAB = 944, 1683, 19, 512
+max_user_id = _USERS
+max_movie_id = _MOVIES
+max_job_id = 20
+
+
+def _make(n, seed):
+    rng = np.random.RandomState(seed)
+    uf = rng.randn(_USERS, 4)
+    mf = rng.randn(_MOVIES, 4)
+    out = []
+    for _ in range(n):
+        u = int(rng.randint(1, _USERS))
+        m = int(rng.randint(1, _MOVIES))
+        score = uf[u] @ mf[m]
+        rating = float(np.clip(np.round(3.0 + score), 1, 5))
+        cats = rng.randint(0, _CATS, rng.randint(1, 4)).tolist()
+        title = rng.randint(0, _TITLE_VOCAB, rng.randint(2, 6)).tolist()
+        out.append((u, int(rng.randint(0, 2)), int(rng.randint(0, 7)),
+                    int(rng.randint(0, max_job_id)), m, cats, title,
+                    rating))
+    return reader_creator(out)
+
+
+def train():
+    return _make(4096, 8)
+
+
+def test():
+    return _make(512, 9)

@@ -1,0 +1,26 @@
+"""UCI housing readers (ref: python/paddle/dataset/uci_housing.py:
+train()/test() yield ((13,) float32, (1,) float32)). Synthetic linear
+task with noise — fit_a_line trains to low loss on it."""
+import numpy as np
+
+from ._synth import reader_creator
+
+feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
+                 "DIS", "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+_W = np.random.RandomState(99).randn(13).astype("float32")
+
+
+def _make(n, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 13).astype("float32")
+    y = (x @ _W + 1.5 + rng.randn(n).astype("float32") * 0.1)
+    return reader_creator([(xi, yi.reshape(1)) for xi, yi in
+                           zip(x, y.astype("float32"))])
+
+
+def train():
+    return _make(404, 2)
+
+
+def test():
+    return _make(102, 3)

@@ -1,0 +1,30 @@
+"""WMT16-style translation readers (ref: python/paddle/dataset/wmt16.py:
+train(src_dict_size, trg_dict_size) yields (src_ids, trg_in, trg_next)).
+Synthetic copy+shift task: the target is a deterministic function of the
+source, so the transformer chapter trains to low loss. ids 0/1/2 =
+<s>/<e>/<unk> like the reference."""
+import numpy as np
+
+from ._synth import reader_creator
+
+
+def _make(n, seed, src_v, trg_v):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        L = rng.randint(4, 12)
+        src = rng.randint(3, src_v, L)
+        trg = (src % (trg_v - 3)) + 3  # learnable mapping
+        src_ids = [0] + src.tolist() + [1]
+        trg_ids = [0] + trg.tolist()
+        trg_next = trg.tolist() + [1]
+        out.append((src_ids, trg_ids, trg_next))
+    return reader_creator(out)
+
+
+def train(src_dict_size=1000, trg_dict_size=1000, tar_fname=None):
+    return _make(1024, 14, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size=1000, trg_dict_size=1000, tar_fname=None):
+    return _make(128, 15, src_dict_size, trg_dict_size)

@@ -8,6 +8,7 @@ from .layer import Layer, Sequential, LayerList, ParameterList, LayerDict  # noq
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from . import initializer  # noqa: F401
 from . import functional  # noqa: F401
+from . import nets  # noqa: F401
 from .layers import *  # noqa: F401,F403
 from .layers import (  # noqa: F401
     common as _common, conv as _conv, pooling as _pooling, norm as _norm,

@@ -1,0 +1,126 @@
+"""Composite network building blocks.
+
+Ref (capability target): python/paddle/fluid/nets.py —
+simple_img_conv_pool (:28), img_conv_group (:138), sequence_conv_pool
+(:251), glu (:319), scaled_dot_product_attention (:360).
+
+Like the reference, each call CREATES fresh parameters (the fluid
+LayerHelper pattern); call once while building a model/program, not per
+step. Everything lowers to the same conv/pool/attention ops as the rest
+of the framework, so XLA fuses the composites.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from . import functional as F
+from .layers.common import Linear, Dropout
+from .layers.conv import Conv2D
+from .layers.norm import BatchNorm2D
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+           "glu", "scaled_dot_product_attention"]
+
+
+def _act(x, act):
+    if act is None:
+        return x
+    return getattr(F, act)(x)
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    """Conv2D + activation + pool2d (ref: nets.py:28)."""
+    in_ch = int(input.shape[1])
+    conv = Conv2D(in_ch, num_filters, filter_size, stride=conv_stride,
+                  padding=conv_padding, dilation=conv_dilation,
+                  groups=conv_groups, weight_attr=param_attr,
+                  bias_attr=bias_attr)
+    out = _act(conv(input), act)
+    if global_pooling:
+        pool_fn = (F.adaptive_max_pool2d if pool_type == "max"
+                   else F.adaptive_avg_pool2d)
+        return pool_fn(out, 1)
+    pool_fn = F.max_pool2d if pool_type == "max" else F.avg_pool2d
+    return pool_fn(out, pool_size, stride=pool_stride,
+                   padding=pool_padding)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """VGG-style conv block: N convs (+BN +dropout) then one pool
+    (ref: nets.py:138)."""
+    if isinstance(conv_num_filter, int):
+        conv_num_filter = [conv_num_filter]
+    n = len(conv_num_filter)
+
+    def per_conv(v, i):
+        return v[i] if isinstance(v, (list, tuple)) else v
+
+    out = input
+    for i in range(n):
+        in_ch = int(out.shape[1])
+        conv = Conv2D(in_ch, conv_num_filter[i],
+                      per_conv(conv_filter_size, i),
+                      padding=per_conv(conv_padding, i),
+                      weight_attr=per_conv(param_attr, i)
+                      if param_attr else None)
+        out = conv(out)
+        if conv_with_batchnorm:
+            out = BatchNorm2D(conv_num_filter[i])(out)
+            drop = per_conv(conv_batchnorm_drop_rate, i)
+            if drop:
+                out = Dropout(drop)(out)
+        out = _act(out, conv_act)
+    pool_fn = F.max_pool2d if pool_type == "max" else F.avg_pool2d
+    return pool_fn(out, pool_size, stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None,
+                       lengths=None):
+    """sequence_conv + activation + sequence_pool over the time axis
+    (ref: nets.py:251). input (B, L, D) dense + lengths."""
+    D = int(input.shape[-1])
+    w = Linear(filter_size * D, num_filters,
+               weight_attr=param_attr, bias_attr=bias_attr)
+    out = ops.sequence_conv(input, filter_size=filter_size,
+                            weight=w.weight, bias=w.bias, lengths=lengths)
+    out = _act(out, act)
+    return ops.sequence_pool(out, pool_type=pool_type, lengths=lengths)
+
+
+def glu(input, dim=-1):
+    """Gated Linear Unit: split in two along ``dim``, a * sigmoid(b)
+    (ref: nets.py:319)."""
+    a, b = ops.split(input, 2, axis=dim)
+    return a * F.sigmoid(b)
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0, training=True):
+    """Multi-head SDPA over (B, L, D) projections-free inputs
+    (ref: nets.py:360 — the reference also just reshapes to heads and
+    calls the primitive attention)."""
+    B, Lq, D = queries.shape[0], queries.shape[1], queries.shape[2]
+    Lk = keys.shape[1]
+    if D % num_heads:
+        raise ValueError(f"hidden {D} not divisible by heads {num_heads}")
+    hd = D // num_heads
+
+    def heads_of(t, L):
+        t = ops.reshape(t, [B, L, num_heads, hd])
+        return ops.transpose(t, [0, 2, 1, 3])
+
+    q, k, v = (heads_of(queries, Lq), heads_of(keys, Lk),
+               heads_of(values, Lk))
+    att = F.sdpa_bhld(q, k, v, dropout_p=dropout_rate, training=training)
+    att = ops.transpose(att, [0, 2, 1, 3])
+    return ops.reshape(att, [B, Lq, D])

@@ -656,3 +656,37 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
     return _conv_transpose_wrapper("conv3d_transpose", 3, x, weight, bias,
                                    stride, padding, output_padding, dilation,
                                    groups, output_size, data_format)
+
+
+@register("shuffle_channel")
+def _shuffle_channel(x, *, group):
+    n, c, h, w = x.shape
+    x = jnp.reshape(x, (n, group, c // group, h, w))
+    x = jnp.transpose(x, (0, 2, 1, 3, 4))
+    return jnp.reshape(x, (n, c, h, w))
+
+
+def shuffle_channel(x, group, name=None):
+    """ShuffleNet channel shuffle (ref: shuffle_channel_op.cc)."""
+    if unwrap(x).shape[1] % group:
+        raise ValueError(
+            f"channels {unwrap(x).shape[1]} not divisible by {group}")
+    return apply("shuffle_channel", x, group=int(group))
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0,
+                input_image_size=None, out_stride=1, name=None):
+    """Unfold image patches into a sequence (ref: im2sequence_op.cc):
+    (N, C, H, W) -> (N, OH*OW, C*kh*kw), row-major patch order."""
+    if input_image_size is not None or out_stride != 1:
+        raise NotImplementedError(
+            "per-sample real-size patch grids (input_image_size/"
+            "out_stride) are not implemented; patches come from the "
+            "padded static H/W")
+    ks = _pair(filter_size, 2)
+    st = _pair(stride, 2)
+    out = unfold(input, ks, strides=st, paddings=padding)
+    # unfold gives (N, C*kh*kw, OH*OW); sequence layout wants time first
+    from .manipulation import transpose as _tr
+
+    return _tr(out, [0, 2, 1])

@@ -27,6 +27,7 @@ __all__ = [
     "iou_similarity", "box_coder", "prior_box", "anchor_generator",
     "box_clip", "multiclass_nms", "yolo_box", "yolov3_loss",
     "roi_align", "roi_pool", "sigmoid_focal_loss", "nms",
+    "bipartite_match", "target_assign", "ssd_loss", "detection_output",
 ]
 
 
@@ -683,3 +684,221 @@ def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25, name=None):
     with 0 = background, 1..C = foreground classes; fg_num scalar."""
     return apply("sigmoid_focal_loss_fluid", x, label, fg_num,
                  gamma=float(gamma), alpha=float(alpha))
+
+
+# ---------------------------------------------------------------------------
+# SSD stack: matching, target assignment, loss, inference output
+# ---------------------------------------------------------------------------
+
+
+@register("bipartite_match")
+def _bipartite_match(dist, *, match_type, overlap_threshold):
+    B, G, P = dist.shape
+
+    def one(d):
+        # greedy bipartite: G rounds, each takes the global max over the
+        # still-unmatched (gt, prior) pairs
+        def body(carry, _):
+            midx, mdist, avail = carry
+            masked = jnp.where(avail, d, -1.0)
+            flat = jnp.argmax(masked)
+            g, p = flat // P, flat % P
+            val = masked.reshape(-1)[flat]
+            ok = val > 0
+            midx = jnp.where(ok, midx.at[p].set(g.astype(jnp.int32)),
+                             midx)
+            mdist = mdist.at[p].set(jnp.where(ok, val, mdist[p]))
+            kill = ((jnp.arange(G)[:, None] == g)
+                    | (jnp.arange(P)[None, :] == p))
+            avail = jnp.where(ok, avail & ~kill, avail)
+            return (midx, mdist, avail), None
+
+        init = (jnp.full((P,), -1, jnp.int32), jnp.zeros((P,), d.dtype),
+                jnp.ones((G, P), bool))
+        (midx, mdist, _), _ = lax.scan(body, init, jnp.arange(G))
+        if match_type == "per_prediction":
+            # unmatched priors also match their argmax gt above threshold
+            best = jnp.argmax(d, axis=0).astype(jnp.int32)
+            bestv = jnp.max(d, axis=0)
+            extra = (midx < 0) & (bestv >= overlap_threshold)
+            midx = jnp.where(extra, best, midx)
+            mdist = jnp.where(extra, bestv, mdist)
+        return midx, mdist
+
+    return jax.vmap(one)(dist)
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    """Greedy bipartite (optionally + per-prediction) matching
+    (ref: detection.py:1198). dist_matrix (B, G, P) similarity ->
+    (match_indices (B, P) int32 with -1 = unmatched, match_dist (B, P)).
+    """
+    return apply("bipartite_match", dist_matrix, match_type=match_type,
+                 overlap_threshold=float(dist_threshold))
+
+
+@register("target_assign")
+def _target_assign(x, match, *, mismatch_value):
+    # x (B, G, K) per-gt attributes; match (B, P) -> out (B, P, K)
+    safe = jnp.maximum(match, 0)
+    out = jnp.take_along_axis(
+        x, safe[:, :, None].astype(jnp.int32), axis=1)
+    neg = (match < 0)[:, :, None]
+    out = jnp.where(neg, jnp.full((), mismatch_value, x.dtype), out)
+    weight = (~neg).astype(jnp.float32)
+    return out, weight
+
+
+@register("target_assign_neg")
+def _target_assign_neg(x, match, neg_idx, *, mismatch_value):
+    out, weight = _target_assign(x, match, mismatch_value=mismatch_value)
+    # listed negatives are REAL training targets: mismatch_value with
+    # weight 1 (how SSD marks background conf rows trainable)
+    B, P = match.shape
+    neg_mask = jnp.zeros((B, P), bool)
+    neg_mask = jax.vmap(
+        lambda m, idx: m.at[jnp.clip(idx, 0, P - 1)].set(
+            True, mode="drop"))(neg_mask, neg_idx.astype(jnp.int32))
+    out = jnp.where(neg_mask[:, :, None],
+                    jnp.full((), mismatch_value, x.dtype), out)
+    weight = jnp.where(neg_mask[:, :, None], 1.0, weight)
+    return out, weight
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """Gather per-gt rows onto priors by match index
+    (ref: detection.py:1287). Unmatched priors get ``mismatch_value``
+    with weight 0; priors listed in ``negative_indices`` (B, K) get
+    ``mismatch_value`` with weight 1 (trainable background targets)."""
+    if negative_indices is None:
+        return apply("target_assign", input, matched_indices,
+                     mismatch_value=mismatch_value)
+    return apply("target_assign_neg", input, matched_indices,
+                 negative_indices, mismatch_value=mismatch_value)
+
+
+@register("ssd_loss")
+def _ssd_loss(loc, conf, gt_box, gt_label, prior, pvar, *,
+              background_label, overlap_threshold, neg_pos_ratio,
+              neg_overlap, loc_loss_weight, conf_loss_weight,
+              match_type="per_prediction"):
+    B, P = loc.shape[0], loc.shape[1]
+    G = gt_box.shape[1]
+    C = conf.shape[-1]
+    valid_gt = (gt_box[..., 2] > gt_box[..., 0]) \
+        & (gt_box[..., 3] > gt_box[..., 1])
+
+    iou = _pairwise_iou(gt_box, prior[None])  # (B, G, P)
+    iou = jnp.where(valid_gt[:, :, None], iou, 0.0)
+    midx, mdist = _bipartite_match(iou, match_type=match_type,
+                                   overlap_threshold=overlap_threshold)
+    pos = midx >= 0  # (B, P)
+    npos = pos.sum(-1)
+
+    # -- localization target: encode matched gt against its prior
+    safe = jnp.maximum(midx, 0)
+    gt_m = jnp.take_along_axis(gt_box, safe[:, :, None], axis=1)  # B,P,4
+
+    def encode(gt_b):
+        # per-prior single encode (diagonal of the pairwise box_coder)
+        pcx, pcy, pw, ph = _to_center(prior, True)
+        tcx, tcy, tw, th = _to_center(gt_b, True)
+        ox = (tcx - pcx) / pw / pvar[:, 0]
+        oy = (tcy - pcy) / ph / pvar[:, 1]
+        ow = jnp.log(jnp.maximum(tw / pw, 1e-10)) / pvar[:, 2]
+        oh = jnp.log(jnp.maximum(th / ph, 1e-10)) / pvar[:, 3]
+        return jnp.stack([ox, oy, ow, oh], axis=-1)
+
+    loc_t = jax.vmap(encode)(gt_m)  # (B, P, 4)
+    diff = loc - loc_t
+    ad = jnp.abs(diff)
+    smooth_l1 = jnp.where(ad < 1.0, 0.5 * ad * ad, ad - 0.5).sum(-1)
+    loc_loss = (smooth_l1 * pos).sum(-1)
+
+    # -- confidence target + hard negative mining
+    lab_m = jnp.take_along_axis(gt_label, safe, axis=1)  # (B, P)
+    conf_t = jnp.where(pos, lab_m, background_label)
+    logp = jax.nn.log_softmax(conf.astype(jnp.float32), axis=-1)
+    ce = -jnp.take_along_axis(logp, conf_t[:, :, None].astype(jnp.int32),
+                              axis=-1)[..., 0]  # (B, P)
+    is_neg = (~pos) & (mdist < neg_overlap)
+    neg_ce = jnp.where(is_neg, ce, -jnp.inf)
+    order = jnp.argsort(-neg_ce, axis=-1)
+    rank = jnp.zeros((B, P), jnp.int32)
+    rank = jax.vmap(lambda r, o: r.at[o].set(jnp.arange(P,
+                                                        dtype=jnp.int32))
+                    )(rank, order)
+    k = jnp.clip(neg_pos_ratio * npos, 0, P).astype(jnp.int32)
+    sel_neg = is_neg & (rank < k[:, None])
+    conf_loss = (ce * (pos | sel_neg)).sum(-1)
+
+    denom = jnp.maximum(npos.astype(jnp.float32), 1.0)
+    return (loc_loss_weight * loc_loss
+            + conf_loss_weight * conf_loss) / denom
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0, neg_overlap=0.5,
+             loc_loss_weight=1.0, conf_loss_weight=1.0, match_type=
+             "per_prediction", mining_type="max_negative",
+             sample_size=None, name=None):
+    """SSD multibox loss (ref: detection.py:1390): per-prediction
+    matching, smooth-L1 localization, softmax confidence with
+    max-negative hard mining at ``neg_pos_ratio``.
+
+    location (B, P, 4), confidence (B, P, C), gt_box (B, G, 4)
+    normalized corners (degenerate rows = padding), gt_label (B, G) int,
+    prior_box (P, 4) (+ optional (P, 4) variances). Returns per-image
+    loss (B,).
+    """
+    if mining_type != "max_negative":
+        raise NotImplementedError("only max_negative mining (the SSD "
+                                  "paper recipe) is implemented")
+    if match_type not in ("per_prediction", "bipartite"):
+        raise ValueError(f"match_type {match_type!r} not recognized")
+    if prior_box_var is None:
+        pv = Tensor(jnp.ones((unwrap(prior_box).shape[0], 4),
+                             jnp.float32), _internal=True)
+    elif isinstance(prior_box_var, (list, tuple)):
+        pv = Tensor(jnp.broadcast_to(
+            jnp.asarray(prior_box_var, jnp.float32),
+            (unwrap(prior_box).shape[0], 4)), _internal=True)
+    else:
+        pv = prior_box_var
+    return apply("ssd_loss", location, confidence, gt_box, gt_label,
+                 prior_box, pv, background_label=int(background_label),
+                 overlap_threshold=float(overlap_threshold),
+                 neg_pos_ratio=float(neg_pos_ratio),
+                 neg_overlap=float(neg_overlap),
+                 loc_loss_weight=float(loc_loss_weight),
+                 conf_loss_weight=float(conf_loss_weight),
+                 match_type=match_type)
+
+
+def detection_output(loc, scores, prior_box, prior_box_var=None,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     name=None):
+    """SSD inference head (ref: detection.py:518): decode loc deltas
+    against priors, then multiclass NMS.
+
+    loc (B, P, 4), scores (B, P, C) post-softmax, prior_box (P, 4).
+    Returns (out (B, keep_top_k, 6), valid counts (B,)) like
+    multiclass_nms.
+    """
+    if prior_box_var is None:
+        prior_box_var = [1.0, 1.0, 1.0, 1.0]
+    # loc (B, P, 4) deltas; priors align with axis 1, i.e. decoded[b, p]
+    # decodes loc[b, p] against prior[p]
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size", axis=0)
+    from .manipulation import transpose as _tr
+
+    return multiclass_nms(decoded, _tr(scores, [0, 2, 1]),
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold, nms_eta=nms_eta,
+                          background_label=background_label)

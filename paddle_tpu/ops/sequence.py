@@ -236,17 +236,25 @@ def sequence_softmax(input, lengths=None, use_cudnn=False, name=None):
     return apply("sequence_softmax", input, lengths)
 
 
+def _context_gather(x, offsets, lengths=None):
+    """Gather per-position context frames: x (B, L, D) + relative
+    ``offsets`` (ctx,) -> (B, L, ctx, D), with out-of-bounds (and, when
+    ``lengths`` is given, beyond-length) frames zeroed. Shared by
+    sequence_conv and row_conv."""
+    B, L, D = x.shape
+    pos = jnp.arange(L)[:, None] + offsets[None, :]  # (L, ctx)
+    mask = ((pos >= 0) & (pos < L))[None, :, :]  # (1, L, ctx)
+    if lengths is not None:
+        mask = mask & (pos[None] < lengths[:, None, None])
+    posc = jnp.clip(pos, 0, L - 1)
+    return x[:, posc] * mask[..., None].astype(x.dtype)
+
+
 @register("sequence_conv")
 def _sequence_conv(x, w, lengths, *, context_start, context_length):
     B, L, D = x.shape
-    # gather context frames per position; OOB / beyond-length -> zeros
-    offs = jnp.arange(context_length) + context_start  # (ctx,)
-    pos = jnp.arange(L)[:, None] + offs[None, :]  # (L, ctx)
-    inb = (pos >= 0) & (pos < L)
-    in_len = pos < lengths[:, None, None]  # (B, L, ctx)
-    posc = jnp.clip(pos, 0, L - 1)
-    ctx = x[:, posc]  # (B, L, ctx, D)
-    ctx = ctx * (inb[None, :, :, None] & in_len[..., None]).astype(x.dtype)
+    offs = jnp.arange(context_length) + context_start
+    ctx = _context_gather(x, offs, lengths)  # (B, L, ctx, D)
     flat = ctx.reshape(B, L, context_length * D)
     return jnp.einsum("bld,do->blo", flat, w)
 
@@ -371,3 +379,26 @@ def sequence_slice(input, offset, length, maxlen=None, name=None):
     out = apply("sequence_slice", input, offset, length,
                 maxlen=int(maxlen))
     return out, length
+
+
+@register("row_conv")
+def _row_conv(x, w):
+    # x (B, L, D); w (ctx, D): look-ahead conv (DeepSpeech2)
+    gathered = _context_gather(x, jnp.arange(w.shape[0]))
+    return jnp.einsum("blcd,cd->bld", gathered, w)
+
+
+def row_conv(input, future_context_size=None, weight=None, param_attr=None,
+             act=None, name=None):
+    """Look-ahead row convolution (ref: row_conv_op.cc, DeepSpeech2):
+    out[t] = sum_i w[i] * x[t+i] over the next ``ctx`` frames.
+
+    Functional form: pass weight (future_context_size + 1, D)."""
+    if weight is None:
+        raise ValueError("pass weight=(future_context_size + 1, D)")
+    out = apply("row_conv", input, weight)
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out

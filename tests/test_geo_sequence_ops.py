@@ -192,3 +192,30 @@ class TestSequenceSlice:
         assert o.shape == (2, 3)
         np.testing.assert_allclose(o[0], [2, 3, 4])
         np.testing.assert_allclose(o[1], [15, 16, 0])  # padded past len
+
+
+class TestMiscOps:
+    def test_shuffle_channel(self):
+        x = np.arange(8, dtype="float32").reshape(1, 4, 1, 2)
+        out = np.asarray(ops.shuffle_channel(pt.to_tensor(x), 2).numpy())
+        # groups [0,1][2,3] -> interleave: [0,2,1,3]
+        np.testing.assert_allclose(out[0, :, 0, 0], [0, 4, 2, 6])
+        with pytest.raises(ValueError):
+            ops.shuffle_channel(pt.to_tensor(x), 3)
+
+    def test_im2sequence(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        out = np.asarray(ops.im2sequence(pt.to_tensor(x), filter_size=2,
+                                         stride=2).numpy())
+        assert out.shape == (1, 4, 4)
+        np.testing.assert_allclose(out[0, 0], [0, 1, 4, 5])
+        np.testing.assert_allclose(out[0, 3], [10, 11, 14, 15])
+
+    def test_row_conv_lookahead(self):
+        x = np.arange(12, dtype="float32").reshape(1, 4, 3)
+        w = np.zeros((2, 3), "float32")
+        w[1] = 1.0  # pure one-step lookahead
+        out = np.asarray(ops.row_conv(pt.to_tensor(x),
+                                      weight=pt.to_tensor(w)).numpy())
+        np.testing.assert_allclose(out[0, :3], x[0, 1:])
+        np.testing.assert_allclose(out[0, 3], np.zeros(3))

@@ -1,0 +1,286 @@
+"""nets.py composites, the SSD stack (bipartite_match/target_assign/
+ssd_loss/detection_output), and the dataset readers
+(ref: fluid/nets.py, layers/detection.py:518,1198,1287,1390,
+python/paddle/dataset/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu import ops
+from paddle_tpu.nn import nets
+
+
+class TestNets:
+    def test_simple_img_conv_pool(self):
+        x = pt.to_tensor(np.random.RandomState(0)
+                         .randn(2, 3, 16, 16).astype("float32"))
+        out = nets.simple_img_conv_pool(x, num_filters=8, filter_size=3,
+                                        pool_size=2, pool_stride=2,
+                                        conv_padding=1, act="relu")
+        assert list(out.shape) == [2, 8, 8, 8]
+        assert (np.asarray(out.numpy()) >= 0).all()
+
+    def test_img_conv_group(self):
+        x = pt.to_tensor(np.random.RandomState(1)
+                         .randn(2, 3, 16, 16).astype("float32"))
+        out = nets.img_conv_group(x, conv_num_filter=[8, 8], pool_size=2,
+                                  conv_act="relu",
+                                  conv_with_batchnorm=True,
+                                  pool_stride=2)
+        assert list(out.shape) == [2, 8, 8, 8]
+
+    def test_sequence_conv_pool(self):
+        x = pt.to_tensor(np.random.RandomState(2)
+                         .randn(2, 6, 4).astype("float32"))
+        lens = pt.to_tensor(np.array([6, 3], "int32"))
+        out = nets.sequence_conv_pool(x, num_filters=5, filter_size=3,
+                                      act="tanh", pool_type="max",
+                                      lengths=lens)
+        assert list(out.shape) == [2, 5]
+
+    def test_glu_halves_width(self):
+        x = pt.to_tensor(np.random.RandomState(3)
+                         .randn(4, 10).astype("float32"))
+        out = nets.glu(x)
+        assert list(out.shape) == [4, 5]
+        a, b = np.split(np.asarray(x.numpy()), 2, axis=-1)
+        want = a * (1 / (1 + np.exp(-b)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   atol=1e-5)
+
+    def test_scaled_dot_product_attention(self):
+        rng = np.random.RandomState(4)
+        q = pt.to_tensor(rng.randn(2, 5, 8).astype("float32"))
+        kv = pt.to_tensor(rng.randn(2, 7, 8).astype("float32"))
+        out = nets.scaled_dot_product_attention(q, kv, kv, num_heads=2)
+        assert list(out.shape) == [2, 5, 8]
+        with pytest.raises(ValueError):
+            nets.scaled_dot_product_attention(q, kv, kv, num_heads=3)
+
+
+class TestSSDStack:
+    def test_bipartite_match_greedy(self):
+        # gt0 best matches prior1 (0.9); gt1 takes prior0 (0.8)
+        d = np.array([[[0.7, 0.9, 0.1], [0.8, 0.85, 0.0]]], "float32")
+        idx, dist = ops.bipartite_match(pt.to_tensor(d))
+        idx = np.asarray(idx.numpy())[0]
+        assert idx[1] == 0 and idx[0] == 1  # greedy global-max order
+        assert idx[2] == -1
+
+    def test_bipartite_per_prediction_extension(self):
+        d = np.array([[[0.9, 0.6, 0.2]]], "float32")
+        idx, _ = ops.bipartite_match(pt.to_tensor(d),
+                                     match_type="per_prediction",
+                                     dist_threshold=0.5)
+        idx = np.asarray(idx.numpy())[0]
+        assert idx[0] == 0          # bipartite winner
+        assert idx[1] == 0          # above threshold -> also matched
+        assert idx[2] == -1         # below threshold
+
+    def test_target_assign(self):
+        x = np.arange(12, dtype="float32").reshape(1, 3, 4)
+        match = np.array([[1, -1, 2, 0]], "int32")
+        out, w = ops.target_assign(pt.to_tensor(x), pt.to_tensor(match),
+                                   mismatch_value=-7)
+        out = np.asarray(out.numpy())[0]
+        w = np.asarray(w.numpy())[0]
+        np.testing.assert_allclose(out[0], x[0, 1])
+        assert (out[1] == -7).all() and w[1, 0] == 0.0
+        np.testing.assert_allclose(out[3], x[0, 0])
+
+    def _ssd_inputs(self, seed=0):
+        rng = np.random.RandomState(seed)
+        P, G, C = 8, 2, 4
+        prior = np.stack([
+            np.linspace(0.0, 0.7, P), np.linspace(0.0, 0.7, P),
+            np.linspace(0.2, 0.9, P), np.linspace(0.2, 0.9, P)],
+            axis=1).astype("float32")
+        gt = np.array([[[0.05, 0.05, 0.25, 0.25],
+                        [0.55, 0.55, 0.85, 0.85]]], "float32")
+        lab = np.array([[1, 3]], "int64")
+        loc = rng.randn(1, P, 4).astype("float32") * 0.1
+        conf = rng.randn(1, P, C).astype("float32") * 0.1
+        return loc, conf, gt, lab, prior
+
+    def test_ssd_loss_finite_and_grads(self):
+        loc, conf, gt, lab, prior = self._ssd_inputs()
+        loct = pt.to_tensor(loc); loct.stop_gradient = False
+        conft = pt.to_tensor(conf); conft.stop_gradient = False
+        loss = ops.ssd_loss(loct, conft, pt.to_tensor(gt),
+                            pt.to_tensor(lab), pt.to_tensor(prior),
+                            [0.1, 0.1, 0.2, 0.2])
+        assert list(loss.shape) == [1]
+        loss.sum().backward()
+        assert np.isfinite(np.asarray(loct.grad.numpy())).all()
+        assert np.abs(np.asarray(conft.grad.numpy())).sum() > 0
+
+    def test_ssd_loss_trains(self):
+        loc, conf, gt, lab, prior = self._ssd_inputs()
+        loct = pt.to_tensor(loc); loct.stop_gradient = False
+        conft = pt.to_tensor(conf); conft.stop_gradient = False
+        losses = []
+        for _ in range(30):
+            loss = ops.ssd_loss(loct, conft, pt.to_tensor(gt),
+                                pt.to_tensor(lab), pt.to_tensor(prior),
+                                [0.1, 0.1, 0.2, 0.2]).sum()
+            losses.append(float(loss))
+            loss.backward()
+            loct._replace(loct._data - 0.5 * loct.grad._data)
+            conft._replace(conft._data - 0.5 * conft.grad._data)
+            loct.grad = None
+            conft.grad = None
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    def test_detection_output_roundtrip(self):
+        """Perfect loc deltas (zeros) + confident scores recover priors."""
+        P, C = 4, 3
+        prior = np.array([[0.1, 0.1, 0.3, 0.3], [0.2, 0.6, 0.4, 0.9],
+                          [0.6, 0.1, 0.9, 0.4], [0.55, 0.5, 0.95, 0.95]],
+                         "float32")
+        loc = np.zeros((1, P, 4), "float32")
+        scores = np.full((1, P, C), 0.01, "float32")
+        scores[0, 0, 1] = 0.95
+        scores[0, 2, 2] = 0.9
+        out, counts = ops.detection_output(
+            pt.to_tensor(loc), pt.to_tensor(scores),
+            pt.to_tensor(prior), score_threshold=0.5, nms_threshold=0.4,
+            nms_top_k=P, keep_top_k=P)
+        n = int(np.asarray(counts.numpy())[0])
+        o = np.asarray(out.numpy())[0]
+        assert n == 2
+        assert int(o[0, 0]) == 1 and int(o[1, 0]) == 2
+        np.testing.assert_allclose(o[0, 2:], prior[0], atol=1e-5)
+        np.testing.assert_allclose(o[1, 2:], prior[2], atol=1e-5)
+
+
+class TestDatasets:
+    def test_mnist_shapes_and_determinism(self):
+        from paddle_tpu import dataset
+
+        a = list(dataset.mnist.test()())
+        b = list(dataset.mnist.test()())
+        assert len(a) == 512
+        assert a[0][0].shape == (784,)
+        np.testing.assert_array_equal(a[0][0], b[0][0])
+
+    def test_uci_housing_learnable(self):
+        """fit_a_line on the synthetic housing data reaches low loss."""
+        from paddle_tpu import dataset
+
+        xs, ys = zip(*list(dataset.uci_housing.train()()))
+        X = np.stack(xs); Y = np.stack(ys)[:, 0]
+        # closed-form ridge fit must explain the data
+        w = np.linalg.lstsq(
+            np.concatenate([X, np.ones((len(X), 1), "float32")], 1),
+            Y, rcond=None)[0]
+        pred = np.concatenate([X, np.ones((len(X), 1), "float32")],
+                              1) @ w
+        assert np.mean((pred - Y) ** 2) < 0.05
+
+    def test_imdb_classes_separable(self):
+        from paddle_tpu import dataset
+
+        wd = dataset.imdb.word_dict()
+        samples = list(dataset.imdb.train(wd)())[:50]
+        half = len(wd) // 2
+        for ids, lab in samples:
+            frac_hi = np.mean(np.asarray(ids) >= half)
+            assert (frac_hi > 0.5) == bool(lab)
+
+    def test_wmt16_mapping_deterministic(self):
+        from paddle_tpu import dataset
+
+        src, trg_in, trg_next = next(dataset.wmt16.train(100, 100)())
+        assert src[0] == 0 and src[-1] == 1
+        assert trg_in[0] == 0 and trg_next[-1] == 1
+        body = src[1:-1]
+        np.testing.assert_array_equal(
+            trg_next[:-1], [(w % 97) + 3 for w in body])
+
+    def test_conll05_structure(self):
+        from paddle_tpu import dataset
+
+        s = next(dataset.conll05.test()())
+        assert len(s) == 9
+        L = len(s[0])
+        assert all(len(f) == L for f in s)
+        assert sum(s[7]) == 1  # exactly one predicate mark
+
+
+class TestTargetAssignNegatives:
+    def test_negative_indices_trainable(self):
+        x = np.arange(8, dtype="float32").reshape(1, 2, 4)
+        match = np.array([[0, -1, -1, 1]], "int32")
+        negs = np.array([[1]], "int32")  # prior 1 is a mined negative
+        out, w = ops.target_assign(pt.to_tensor(x), pt.to_tensor(match),
+                                   negative_indices=pt.to_tensor(negs),
+                                   mismatch_value=0)
+        w = np.asarray(w.numpy())[0]
+        assert w[0, 0] == 1.0   # matched
+        assert w[1, 0] == 1.0   # mined negative: trainable
+        assert w[2, 0] == 0.0   # unmatched, unmined: ignored
+        assert w[3, 0] == 1.0
+
+
+class TestSSDMatchType:
+    def test_bipartite_only_matches_fewer(self):
+        loc = np.zeros((1, 6, 4), "float32")
+        conf = np.zeros((1, 6, 3), "float32")
+        prior = np.stack([np.linspace(0, 0.6, 6)] * 2
+                         + [np.linspace(0.3, 0.9, 6)] * 2,
+                         axis=1).astype("float32")
+        gt = np.array([[[0.0, 0.0, 0.35, 0.35]]], "float32")
+        lab = np.array([[1]], "int64")
+        l_bi = float(ops.ssd_loss(
+            pt.to_tensor(loc), pt.to_tensor(conf), pt.to_tensor(gt),
+            pt.to_tensor(lab), pt.to_tensor(prior),
+            match_type="bipartite").sum())
+        l_pp = float(ops.ssd_loss(
+            pt.to_tensor(loc), pt.to_tensor(conf), pt.to_tensor(gt),
+            pt.to_tensor(lab), pt.to_tensor(prior),
+            match_type="per_prediction").sum())
+        assert np.isfinite([l_bi, l_pp]).all()
+        with pytest.raises(ValueError):
+            ops.ssd_loss(pt.to_tensor(loc), pt.to_tensor(conf),
+                         pt.to_tensor(gt), pt.to_tensor(lab),
+                         pt.to_tensor(prior), match_type="nope")
+
+
+class TestFitALineBook:
+    def test_uci_housing_reader_pipeline_static(self):
+        """Book ch.1 fit_a_line, reference-shaped: dataset reader ->
+        paddle.batch(shuffle(...)) -> DataFeeder -> static Executor
+        (ref: tests/book/test_fit_a_line.py)."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu import dataset, optim
+        from paddle_tpu.io_ import reader as rd
+
+        pt.seed(0)
+        train_reader = rd.batch(
+            rd.shuffle(dataset.uci_housing.train(), buf_size=256),
+            batch_size=101, drop_last=True)
+
+        pt.enable_static()
+        try:
+            main, startup = pt.static.Program(), pt.static.Program()
+            with pt.program_guard(main, startup):
+                x = pt.static.data("x", [101, 13], "float32")
+                y = pt.static.data("y", [101, 1], "float32")
+                pred = nn.Linear(13, 1)(x)
+                loss = F.mse_loss(pred, y)
+                opt = optim.SGD(learning_rate=0.05)
+                opt.minimize(loss)
+        finally:
+            pt.disable_static()
+        exe = pt.static.Executor()
+        exe.run(startup)
+        feeder = pt.io.DataFeeder(feed_list=[x, y])
+        losses = []
+        for epoch in range(12):
+            for batch in train_reader():
+                lv, = exe.run(main, feed=feeder.feed(batch),
+                              fetch_list=[loss])
+                losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
