@@ -89,6 +89,8 @@ from . import inference
 from . import quant
 from . import hapi
 from . import dataset
+from . import vision
+from . import fluid
 from .hapi import Model
 # NB: ``paddle_tpu.dist`` is the p-norm distance op (paddle parity);
 # the distributed package binds as ``paddle_tpu.distributed``. A plain

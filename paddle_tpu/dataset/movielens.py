@@ -7,9 +7,24 @@ import numpy as np
 from ._synth import reader_creator
 
 _USERS, _MOVIES, _CATS, _TITLE_VOCAB = 944, 1683, 19, 512
-max_user_id = _USERS
-max_movie_id = _MOVIES
-max_job_id = 20
+_MAX_JOB = 20
+
+
+def max_user_id():
+    """ref API: paddle.dataset.movielens.max_user_id() -> int."""
+    return _USERS
+
+
+def max_movie_id():
+    return _MOVIES
+
+
+def max_job_id():
+    return _MAX_JOB
+
+
+def movie_categories():
+    return {("c%d" % i): i for i in range(_CATS)}
 
 
 def _make(n, seed):
@@ -25,7 +40,7 @@ def _make(n, seed):
         cats = rng.randint(0, _CATS, rng.randint(1, 4)).tolist()
         title = rng.randint(0, _TITLE_VOCAB, rng.randint(2, 6)).tolist()
         out.append((u, int(rng.randint(0, 2)), int(rng.randint(0, 7)),
-                    int(rng.randint(0, max_job_id)), m, cats, title,
+                    int(rng.randint(0, _MAX_JOB)), m, cats, title,
                     rating))
     return reader_creator(out)
 

@@ -1,0 +1,82 @@
+"""paddle_tpu.fluid — compatibility namespace for fluid-era code.
+
+Ref: the ``import paddle.fluid as fluid`` surface of the reference
+(python/paddle/fluid/__init__.py). Code written against the reference —
+``fluid.data``, ``fluid.layers.fc``, ``fluid.Executor``,
+``exe.run(program, feed, fetch_list)``, ``fluid.optimizer.SGD`` — runs
+here unchanged; every symbol maps onto the TPU-native implementation
+(one jitted executable per program, XLA collectives, dense sequence
+layouts).
+"""
+import contextlib as _contextlib
+
+from .. import static_ as _static
+from ..static_.program import (Program,  # noqa: F401
+                               default_main_program,
+                               default_startup_program, global_scope)
+from ..static_.program import program_guard as _program_guard
+
+
+@_contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """fluid-era code treats static graph as the default mode and never
+    calls enable_static(); this guard switches it on for the block."""
+    import paddle_tpu as _pt
+
+    was_static = _static.in_static_mode()
+    if not was_static:
+        _pt.enable_static()
+    try:
+        with _program_guard(main_program, startup_program):
+            yield
+    finally:
+        if not was_static:
+            _pt.disable_static()
+from ..static_.executor import Executor  # noqa: F401
+from ..framework.jit import to_static  # noqa: F401
+from ..framework import io  # noqa: F401
+from ..framework.io import (save_inference_model,  # noqa: F401
+                            load_inference_model)
+from ..core.device import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
+
+CUDAPinnedPlace = CPUPlace  # host-staging place: plain host memory here
+
+
+def is_compiled_with_cuda():
+    return False  # TPU build — recipes branch to the collective path
+from .. import optim as optimizer  # noqa: F401
+from ..nn.param_attr import ParamAttr  # noqa: F401
+from ..nn import initializer  # noqa: F401
+from ..optim import clip  # noqa: F401
+from ..optim import regularizer  # noqa: F401
+from ..io_ import reader as io_reader
+from ..io_.reader import DataFeeder  # noqa: F401
+from ..nn.layer import Layer  # noqa: F401
+from .. import metrics  # noqa: F401
+from .. import nn as _nn
+from ..nn import nets  # noqa: F401
+from . import layers  # noqa: F401
+from . import dygraph  # noqa: F401
+
+# top-level conveniences the reference exposes on fluid itself
+data = _static.data
+enable_dygraph = lambda place=None: None  # dygraph (eager) is the default
+disable_dygraph = lambda: None
+in_dygraph_mode = lambda: not _static.in_static_mode() \
+    if hasattr(_static, "in_static_mode") else True
+
+__all__ = [
+    "Program", "program_guard", "default_main_program",
+    "default_startup_program", "global_scope", "Executor", "DataFeeder",
+    "CPUPlace", "CUDAPlace", "TPUPlace", "CUDAPinnedPlace", "ParamAttr",
+    "optimizer", "initializer", "clip", "regularizer", "layers",
+    "dygraph", "nets", "metrics", "io", "data", "save_inference_model",
+    "load_inference_model", "to_static", "Layer",
+]
+
+
+class CompiledProgram:  # re-export with the fluid name
+    def __new__(cls, *args, **kwargs):
+        from ..static_.compiler import CompiledProgram as CP
+
+        return CP(*args, **kwargs)

@@ -1,0 +1,49 @@
+"""fluid.dygraph compatibility surface (ref: fluid/dygraph/__init__.py).
+
+Eager execution is this framework's default mode, so ``guard`` is a
+no-op context; Layer/to_variable map straight onto the native types.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer, Sequential, LayerList, ParameterList  # noqa: F401
+from ..nn.layers.common import (Linear, Embedding, Dropout)  # noqa: F401
+from ..nn.layers.conv import Conv2D  # noqa: F401
+from ..nn.layers.norm import BatchNorm2D as BatchNorm  # noqa: F401
+from ..framework.io import save_checkpoint, load_checkpoint  # noqa: F401
+from ..framework.jit import to_static as jit  # noqa: F401
+from ..dist.parallel import DataParallel  # noqa: F401
+
+__all__ = ["guard", "to_variable", "Layer", "Sequential", "LayerList",
+           "ParameterList", "Linear", "Embedding", "Dropout", "Conv2D",
+           "BatchNorm", "DataParallel", "no_grad", "jit"]
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Eager mode is the default; kept for source compatibility."""
+    yield
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    arr = np.asarray(value)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    return Tensor(arr)
+
+
+def no_grad(fn=None):
+    from ..core import dispatch
+
+    if fn is None:
+        return dispatch.no_grad()
+
+    def wrapped(*a, **k):
+        with dispatch.no_grad():
+            return fn(*a, **k)
+
+    return wrapped

@@ -1,0 +1,256 @@
+"""fluid.layers compatibility surface.
+
+Ref: python/paddle/fluid/layers/* __all__ — the symbol set fluid-era
+user code imports. Every name here resolves to the TPU-native
+implementation; renamed ops get thin aliases (reduce_sum -> ops.sum,
+fc -> Linear-on-the-fly, While/Switch -> lax-backed control flow).
+Parameter-creating functions follow the fluid convention of creating
+fresh parameters per call — call them while building a model/program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops as _ops
+from ..core.tensor import Tensor
+from ..nn import functional as _F
+from ..nn.layers.common import Linear, Embedding
+from ..nn.param_attr import ParamAttr
+from ..static_ import data  # noqa: F401  (fluid.layers.data legacy)
+from ..optim import lr as _lr
+
+# -- wholesale re-exports: everything the functional namespaces already
+# provide under the fluid name ----------------------------------------------
+_g = globals()
+for _src in (_ops, _F):
+    for _n in dir(_src):
+        if not _n.startswith("_") and _n not in _g:
+            _g[_n] = getattr(_src, _n)
+
+# decode / beam API lives in inference
+from ..inference.decoder import (dynamic_decode, BeamSearchDecoder,  # noqa: F401,E402
+                                 Decoder, beam_search, greedy_search)
+from ..metrics import accuracy, Auc  # noqa: F401,E402
+from ..ops.control_flow import (cond, while_loop, case,  # noqa: F401,E402
+                                switch_case)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Streaming-free AUC of one batch (ref: metric_op.py auc)."""
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(input, label)
+    return m.accumulate()
+
+
+# -- renamed reductions / elementwise ---------------------------------------
+reduce_sum = _ops.sum
+reduce_mean = _ops.mean
+reduce_max = _ops.max
+reduce_min = _ops.min
+reduce_prod = _ops.prod
+reduce_all = _ops.all
+reduce_any = _ops.any
+elementwise_add = _ops.add
+elementwise_sub = _ops.subtract
+elementwise_mul = _ops.multiply
+elementwise_div = _ops.divide
+elementwise_max = _ops.maximum
+elementwise_min = _ops.minimum
+elementwise_mod = _ops.remainder
+elementwise_floordiv = _ops.floor_divide
+elementwise_pow = _ops.pow
+hard_sigmoid = _F.hardsigmoid
+hard_swish = _F.hardswish
+image_resize_short = None  # defined below
+smooth_l1 = _F.smooth_l1_loss
+kldiv_loss = _F.kl_div
+sigmoid_cross_entropy_with_logits = _F.binary_cross_entropy_with_logits
+warpctc = _F.ctc_loss
+resize_bilinear = _ops.resize_bilinear
+resize_nearest = _ops.resize_nearest
+grid_sampler = _ops.grid_sample
+uniform_random = _ops.uniform
+gaussian_random = _ops.randn
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the short side equals out_short_len (ref: nn.py
+    image_resize_short)."""
+    h, w = input.shape[2], input.shape[3]
+    short = min(h, w)
+    oh = int(round(h * out_short_len / short))
+    ow = int(round(w * out_short_len / short))
+    return _ops.image_resize(input, out_shape=[oh, ow], resample=resample)
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Fully-connected with fresh parameters (ref: nn.py fc). Flattens
+    trailing dims past ``num_flatten_dims`` like the reference."""
+    shp = input.shape
+    in_dim = int(np.prod(shp[num_flatten_dims:]))
+    x = _ops.reshape(input, list(shp[:num_flatten_dims]) + [in_dim])
+    lin = Linear(in_dim, size, weight_attr=param_attr,
+                 bias_attr=bias_attr)
+    out = lin(x)
+    if act is not None:
+        out = getattr(_F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """Embedding lookup with fresh table (ref: input.py embedding)."""
+    emb = Embedding(size[0], size[1], padding_idx=padding_idx,
+                    weight_attr=param_attr)
+    return emb(input)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone parameter (ref: tensor.py create_parameter)."""
+    from ..nn.layer import Layer
+
+    holder = Layer()
+    return holder.create_parameter(shape, attr=attr, dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    return _ops.full(shape, value, dtype=dtype)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    return _ops.zeros([1], dtype=dtype)
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return _ops.full(shape, value, dtype=dtype)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32", min=-1.0,
+                                   max=1.0, input_dim_idx=0,
+                                   output_dim_idx=0, seed=0):
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return _ops.uniform(shape, dtype=dtype, min=min, max=max)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return _ops.randn(shape, dtype=dtype) * std + mean
+
+
+def pad_constant_like(x, y, pad_value=0.0):
+    """Pad y up to x's shape (ref: nn.py pad_constant_like)."""
+    pads = []
+    for xi, yi in zip(x.shape, y.shape):
+        pads += [0, int(xi) - int(yi)]
+    return _ops.pad(y, pads, value=pad_value)
+
+
+def shape(input):
+    return _ops.to_tensor(np.asarray(list(input.shape), np.int32))
+
+
+def rank(input):
+    return _ops.to_tensor(np.asarray(len(input.shape), np.int32))
+
+
+def size(input):
+    return _ops.to_tensor(np.asarray(int(np.prod(input.shape)), np.int64))
+
+
+def range(start, end, step, dtype):  # noqa: A001 (fluid name)
+    return _ops.arange(start, end, step, dtype=dtype)
+
+
+def has_nan(x):
+    return _ops.any(_ops.isnan(x))
+
+
+def has_inf(x):
+    return _ops.any(_ops.isinf(x))
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Host-side step counter (the reference keeps it in the scope)."""
+    import itertools
+
+    key = counter_name or "@STEP_COUNTER@"
+    c = _counters.setdefault(key, itertools.count(begin, step))
+    return _ops.to_tensor(np.asarray(next(c), np.int64))
+
+
+_counters: dict = {}
+
+
+# -- LR schedules under their fluid names (callable objects) ----------------
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    return _lr.NoamDecay(d_model, warmup_steps, learning_rate)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    # fluid: lr * rate^(t / decay_steps)  ==  lr * (rate^(1/steps))^t
+    return _lr.ExponentialDecay(learning_rate,
+                                decay_rate ** (1.0 / decay_steps))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    # fluid: lr * exp(-rate * t / decay_steps)
+    return _lr.NaturalExpDecay(learning_rate, decay_rate / decay_steps)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    # fluid: lr / (1 + rate * t / decay_steps)
+    return _lr.InverseTimeDecay(learning_rate, decay_rate / decay_steps)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    return _lr.PolynomialDecay(learning_rate, decay_steps,
+                               end_learning_rate, power, cycle)
+
+
+def piecewise_decay(boundaries, values):
+    return _lr.PiecewiseDecay(boundaries, values)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    return _lr.CosineAnnealingDecay(learning_rate,
+                                    step_each_epoch * epochs)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    return _lr.LinearWarmup(learning_rate, warmup_steps, start_lr, end_lr)
+
+
+# -- control flow under fluid names -----------------------------------------
+While = while_loop
+Switch = switch_case
+IfElse = cond
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """Debug print passthrough (ref: control_flow.py Print)."""
+    import jax
+
+    label = message or "Print"
+    jax.debug.print(label + ": {x}", x=input._data
+                    if hasattr(input, "_data") else input)
+    return input

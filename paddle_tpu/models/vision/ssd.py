@@ -1,0 +1,125 @@
+"""SSD single-shot detector.
+
+Ref (capability target): the reference's SSD recipe —
+layers/detection.py multi_box_head (:1971) + ssd_loss (:1390) +
+detection_output (:518) over a MobileNet-style backbone (the
+PaddleCV MobileNet-SSD configuration).
+
+TPU-native: priors are baked host-side constants per feature level
+(static shapes), the heads are plain convs whose outputs reshape to
+(B, P, 4)/(B, P, C), and train/infer both run as one fused XLA program
+through ops.ssd_loss / ops.detection_output.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ops
+from ...core.tensor import Tensor
+from ...nn.layer import Layer, LayerList, Sequential
+from ...nn.layers.conv import Conv2D
+from ...nn.layers.norm import BatchNorm2D
+from ...nn import functional as F
+
+__all__ = ["SSD", "ssd_tiny"]
+
+
+class _ConvBN(Layer):
+    def __init__(self, cin, cout, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=padding,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class SSD(Layer):
+    """Small multi-level SSD. ``image_size`` fixes the prior grid.
+
+    feature_channels: channels of each detection level; the backbone
+    downsamples by 2 per level starting at stride 4.
+    """
+
+    def __init__(self, num_classes=21, image_size=64,
+                 feature_channels=(32, 64), min_sizes=(0.2, 0.5),
+                 max_sizes=(0.5, 0.8), aspect_ratios=(2.0,),
+                 in_channels=3):
+        super().__init__()
+        self.num_classes = num_classes
+        self.image_size = image_size
+        levels = len(feature_channels)
+        self.stem = _ConvBN(in_channels, feature_channels[0], 3, stride=4,
+                            padding=1)
+        downs, locs, confs = [], [], []
+        # priors per cell: len(ars)*2-1(flip)+1 min +1 max = with one ar:
+        # [1, ar, 1/ar] + max -> 4
+        self._ppc = 2 + 2 * len(aspect_ratios)
+        cin = feature_channels[0]
+        for i, ch in enumerate(feature_channels):
+            if i > 0:
+                downs.append(_ConvBN(cin, ch, 3, stride=2, padding=1))
+            locs.append(Conv2D(ch, self._ppc * 4, 3, padding=1))
+            confs.append(Conv2D(ch, self._ppc * num_classes, 3,
+                                padding=1))
+            cin = ch
+        self.downs = LayerList(downs)
+        self.locs = LayerList(locs)
+        self.confs = LayerList(confs)
+
+        # bake priors (normalized) for each level host-side
+        priors = []
+        s = image_size // 4
+        img = np.zeros((1, 3, image_size, image_size), np.float32)
+        for i in range(levels):
+            feat = np.zeros((1, 1, s, s), np.float32)
+            b, _ = ops.prior_box(
+                Tensor(feat, _internal=True), Tensor(img, _internal=True),
+                min_sizes=[min_sizes[i] * image_size],
+                max_sizes=[max_sizes[i] * image_size],
+                aspect_ratios=list(aspect_ratios), flip=True, clip=True)
+            priors.append(np.asarray(b.numpy()).reshape(-1, 4))
+            s //= 2
+        self.prior_box = Tensor(np.concatenate(priors, 0), _internal=True)
+        self.prior_var = [0.1, 0.1, 0.2, 0.2]
+
+    def _heads(self, x):
+        feats = [self.stem(x)]
+        for d in self.downs:
+            feats.append(d(feats[-1]))
+        locs, confs = [], []
+        B = x.shape[0]
+        for f, lh, ch in zip(feats, self.locs, self.confs):
+            l = lh(f)  # (B, ppc*4, H, W)
+            c = ch(f)
+            locs.append(ops.reshape(
+                ops.transpose(l, [0, 2, 3, 1]), [B, -1, 4]))
+            confs.append(ops.reshape(
+                ops.transpose(c, [0, 2, 3, 1]),
+                [B, -1, self.num_classes]))
+        return ops.concat(locs, axis=1), ops.concat(confs, axis=1)
+
+    def forward(self, x):
+        return self._heads(x)
+
+    def loss(self, x, gt_box, gt_label):
+        loc, conf = self._heads(x)
+        return ops.ssd_loss(loc, conf, gt_box, gt_label, self.prior_box,
+                            self.prior_var).mean()
+
+    def infer(self, x, score_threshold=0.3, nms_threshold=0.45,
+              keep_top_k=100):
+        loc, conf = self._heads(x)
+        scores = F.softmax(conf, axis=-1)
+        return ops.detection_output(
+            loc, scores, self.prior_box, self.prior_var,
+            score_threshold=score_threshold, nms_threshold=nms_threshold,
+            nms_top_k=min(keep_top_k * 4, loc.shape[1]),
+            keep_top_k=keep_top_k)
+
+
+def ssd_tiny(num_classes=4, image_size=64):
+    return SSD(num_classes=num_classes, image_size=image_size,
+               feature_channels=(16, 32), min_sizes=(0.2, 0.5),
+               max_sizes=(0.5, 0.8))

@@ -1,0 +1,122 @@
+"""YOLOv3 detector (compact).
+
+Ref (capability target): the reference's YOLOv3 recipe —
+layers/detection.py yolov3_loss (:895) + yolo_box (:1022) over a
+Darknet-style backbone (PaddleCV yolov3 configuration).
+
+TPU-native: fixed-size heads, dense target assignment inside
+ops.yolov3_loss, and inference via ops.yolo_box + multiclass_nms — all
+static shapes, one fused program each for train and infer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ops
+from ...nn.layer import Layer, LayerList, Sequential
+from ...nn.layers.conv import Conv2D
+from ...nn.layers.norm import BatchNorm2D
+from ...nn import functional as F
+
+__all__ = ["YOLOv3", "yolov3_tiny"]
+
+_COCO_ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119,
+                 116, 90, 156, 198, 373, 326]
+
+
+class _ConvBN(Layer):
+    def __init__(self, cin, cout, k, stride=1):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=k // 2,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+
+    def forward(self, x):
+        return F.leaky_relu(self.bn(self.conv(x)), negative_slope=0.1)
+
+
+class YOLOv3(Layer):
+    """Multi-scale YOLOv3; ``anchor_masks`` selects anchors per head
+    (finest first like the reference)."""
+
+    def __init__(self, num_classes=80, anchors=None,
+                 anchor_masks=((0, 1, 2), (3, 4, 5)),
+                 channels=(32, 64), in_channels=3, ignore_thresh=0.7):
+        super().__init__()
+        self.num_classes = num_classes
+        self.anchors = list(anchors or _COCO_ANCHORS)
+        self.anchor_masks = [list(m) for m in anchor_masks]
+        self.ignore_thresh = ignore_thresh
+        # stride-8 entry, then one extra /2 per additional head
+        self.stem = Sequential(_ConvBN(in_channels, channels[0], 3, 2),
+                               _ConvBN(channels[0], channels[0], 3, 2),
+                               _ConvBN(channels[0], channels[0], 3, 2))
+        downs, heads = [], []
+        cin = channels[0]
+        for i, ch in enumerate(channels):
+            if i > 0:
+                downs.append(_ConvBN(cin, ch, 3, stride=2))
+            a = len(self.anchor_masks[i])
+            heads.append(Conv2D(ch, a * (5 + num_classes), 1))
+            cin = ch
+        self.downs = LayerList(downs)
+        self.heads = LayerList(heads)
+        # downsample ratio of each head relative to the input
+        self.downsamples = [8 * (2 ** i) for i in range(len(channels))]
+
+    def _feats(self, x):
+        feats = [self.stem(x)]
+        for d in self.downs:
+            feats.append(d(feats[-1]))
+        return [h(f) for h, f in zip(self.heads, feats)]
+
+    def forward(self, x):
+        return self._feats(x)
+
+    def loss(self, x, gt_box, gt_label, gt_score=None):
+        """Sum of per-head yolov3 losses, meaned over the batch."""
+        outs = self._feats(x)
+        total = None
+        for out, mask, ds in zip(outs, self.anchor_masks,
+                                 self.downsamples):
+            l = ops.yolov3_loss(out, gt_box, gt_label, self.anchors,
+                                mask, self.num_classes,
+                                self.ignore_thresh, ds,
+                                gt_score=gt_score)
+            total = l if total is None else total + l
+        return total.mean()
+
+    def infer(self, x, img_size=None, conf_thresh=0.05,
+              score_threshold=0.3, nms_threshold=0.45, keep_top_k=100):
+        """Decode every head and NMS across all of them."""
+        B, H = x.shape[0], x.shape[2]
+        if img_size is None:
+            img_size = ops.tile(
+                ops.reshape(ops.to_tensor(
+                    np.asarray([H, x.shape[3]], np.int32)), [1, 2]),
+                [B, 1])
+        outs = self._feats(x)
+        boxes, scores = [], []
+        for out, mask, ds in zip(outs, self.anchor_masks,
+                                 self.downsamples):
+            sub = [self.anchors[2 * i + j] for i in mask for j in (0, 1)]
+            b, s = ops.yolo_box(out, img_size, sub, self.num_classes,
+                                conf_thresh, ds)
+            boxes.append(b)
+            scores.append(s)
+        boxes = ops.concat(boxes, axis=1)
+        scores = ops.concat(scores, axis=1)
+        return ops.multiclass_nms(
+            boxes, ops.transpose(scores, [0, 2, 1]),
+            score_threshold=score_threshold,
+            nms_top_k=min(keep_top_k * 4, boxes.shape[1]),
+            keep_top_k=keep_top_k, nms_threshold=nms_threshold,
+            background_label=-1)
+
+
+def yolov3_tiny(num_classes=4):
+    return YOLOv3(num_classes=num_classes,
+                  anchors=[10, 14, 23, 27, 37, 58, 81, 82, 135, 169,
+                           344, 319],
+                  anchor_masks=((0, 1, 2), (3, 4, 5)),
+                  channels=(16, 32))

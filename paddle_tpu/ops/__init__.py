@@ -23,6 +23,7 @@ from . import (  # noqa: F401
     random_ops,
     detection,
     labeling,
+    misc,
 )
 from ..core.tensor import Tensor
 
@@ -80,7 +81,7 @@ def _flatten_namespace():
             "OP_REGISTRY"}
     for mod in (math, creation, manipulation, reduction, compare, activation,
                 linalg, conv, norm_ops, sequence, control_flow, random_ops,
-                detection, labeling):
+                detection, labeling, misc):
         public = getattr(mod, "__all__", None) or [
             n for n in dir(mod) if not n.startswith("_")]
         for n in public:

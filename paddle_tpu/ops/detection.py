@@ -417,7 +417,8 @@ def _yolov3_loss(x, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
     B, _, H, W = x.shape
     A = len(anchor_mask)
     an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
-    an = jnp.asarray(an_all[list(anchor_mask)])  # (A, 2) masked anchors
+    an_np = an_all[list(anchor_mask)]  # (A, 2) masked anchors, HOST-side
+    an = jnp.asarray(an_np)
     in_w, in_h = W * downsample_ratio, H * downsample_ratio
     x = x.reshape(B, A, 5 + class_num, H, W)
     px, py = x[:, :, 0], x[:, :, 1]
@@ -484,8 +485,7 @@ def _yolov3_loss(x, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
         x.reshape(B, A * (5 + class_num), H, W),
         jnp.broadcast_to(jnp.asarray([[in_h, in_w]], jnp.float32),
                          (B, 2)).astype(jnp.int32),
-        anchors=tuple(np.asarray(an, np.float32).reshape(-1)
-                      .astype(np.float32).tolist()),
+        anchors=tuple(an_np.reshape(-1).tolist()),
         class_num=class_num, conf_thresh=-1.0,
         downsample_ratio=downsample_ratio, clip_bbox=False)
     gt_xyxy = jnp.stack([
@@ -756,10 +756,13 @@ def _target_assign_neg(x, match, neg_idx, *, mismatch_value):
     # listed negatives are REAL training targets: mismatch_value with
     # weight 1 (how SSD marks background conf rows trainable)
     B, P = match.shape
+    # padding entries (negative indices) must DROP, not wrap: route them
+    # to the explicit out-of-bounds index P
+    neg_i = neg_idx.astype(jnp.int32)
+    safe_i = jnp.where(neg_i < 0, P, neg_i)
     neg_mask = jnp.zeros((B, P), bool)
     neg_mask = jax.vmap(
-        lambda m, idx: m.at[jnp.clip(idx, 0, P - 1)].set(
-            True, mode="drop"))(neg_mask, neg_idx.astype(jnp.int32))
+        lambda m, idx: m.at[idx].set(True, mode="drop"))(neg_mask, safe_i)
     out = jnp.where(neg_mask[:, :, None],
                     jnp.full((), mismatch_value, x.dtype), out)
     weight = jnp.where(neg_mask[:, :, None], 1.0, weight)

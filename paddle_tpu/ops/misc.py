@@ -1,0 +1,414 @@
+"""Long-tail fluid ops: similarity/ranking/distillation losses, tensor
+utilities, decode helpers.
+
+Ref (capability target): python/paddle/fluid/layers/nn.py and loss.py —
+cos_sim, dice_loss, huber_loss, rank_loss, margin_rank_loss, bpr_loss,
+center_loss, teacher_student_sigmoid_loss, mean_iou, multiplex,
+crop_tensor, unstack, bilinear_tensor_product, add_position_encoding,
+temporal_shift, affine_channel, gather_tree, sampling_id,
+ctc_greedy_decoder, fsp_matrix, clip_by_norm, brelu, soft_relu.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import random as _random
+from ..core.tensor import Tensor
+from ._base import register, apply, unwrap, bce_with_logits
+
+__all__ = [
+    "cos_sim", "dice_loss", "huber_loss", "rank_loss",
+    "margin_rank_loss", "bpr_loss", "center_loss",
+    "teacher_student_sigmoid_loss", "mean_iou", "multiplex",
+    "crop_tensor", "unstack", "bilinear_tensor_product",
+    "add_position_encoding", "temporal_shift", "affine_channel",
+    "gather_tree", "sampling_id", "ctc_greedy_decoder", "fsp_matrix",
+    "clip_by_norm", "brelu", "soft_relu",
+]
+
+
+# -- similarity / ranking / distillation losses -----------------------------
+
+
+@register("cos_sim")
+def _cos_sim(x, y):
+    xn = jnp.sqrt(jnp.sum(x * x, -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, -1, keepdims=True))
+    dot = jnp.sum(x * y, -1, keepdims=True)
+    return dot / jnp.maximum(xn * yn, 1e-12)
+
+
+def cos_sim(X, Y, name=None):
+    """Row-wise cosine similarity -> (N, 1) (ref: nn.py cos_sim)."""
+    return apply("cos_sim", X, Y)
+
+
+@register("dice_loss")
+def _dice_loss(x, label, *, epsilon):
+    # x (N, ..., C) probabilities; label (N, ..., 1) int
+    lab = jax.nn.one_hot(label[..., 0], x.shape[-1], dtype=x.dtype)
+    red = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * lab, red)
+    union = jnp.sum(x, red) + jnp.sum(lab, red)
+    return 1.0 - (2.0 * inter + epsilon) / (union + epsilon)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss for segmentation (ref: loss.py dice_loss)."""
+    return apply("dice_loss", input, label, epsilon=float(epsilon))
+
+
+@register("huber_loss")
+def _huber_loss(x, y, *, delta):
+    r = jnp.abs(x - y)
+    return jnp.where(r <= delta, 0.5 * r * r,
+                     delta * (r - 0.5 * delta))
+
+
+def huber_loss(input, label, delta=1.0, name=None):
+    """Huber loss (ref: loss.py huber_loss)."""
+    return apply("huber_loss", input, label, delta=float(delta))
+
+
+@register("rank_loss")
+def _rank_loss(label, left, right):
+    # pairwise logistic ranking (RankNet): P(left > right)
+    return bce_with_logits(left - right, label)
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (ref: loss.py rank_loss)."""
+    return apply("rank_loss", label, left, right)
+
+
+@register("margin_rank_loss")
+def _margin_rank_loss(label, left, right, *, margin):
+    return jnp.maximum(0.0, -label * (left - right) + margin)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """Margin ranking loss; label in {1, -1} (ref: loss.py
+    margin_rank_loss)."""
+    return apply("margin_rank_loss", label, left, right,
+                 margin=float(margin))
+
+
+@register("bpr_loss")
+def _bpr_loss(x, label):
+    # Bayesian personalized ranking over softmax-free logits:
+    # -mean_j log(sigmoid(x[label] - x[j])), j != label
+    N, C = x.shape
+    pos = jnp.take_along_axis(x, label.astype(jnp.int32), axis=1)
+    diff = pos - x  # (N, C)
+    lsm = jax.nn.log_sigmoid(diff)
+    mask = jax.nn.one_hot(label[:, 0], C, dtype=x.dtype)
+    return -(lsm * (1 - mask)).sum(-1, keepdims=True) / (C - 1)
+
+
+def bpr_loss(input, label, name=None):
+    """BPR pairwise loss (ref: loss.py bpr_loss). input (N, C) logits,
+    label (N, 1)."""
+    return apply("bpr_loss", input, label)
+
+
+@register("center_loss")
+def _center_loss(x, label, centers, *, alpha, update_center):
+    lab = label.reshape(-1).astype(jnp.int32)
+    c = centers[lab]  # (N, D)
+    loss = 0.5 * jnp.sum((x - c) ** 2, -1, keepdims=True)
+    if not update_center:
+        return loss, centers
+    # class-wise center EMA toward the batch mean (ref center update)
+    diff = c - x
+    counts = jnp.zeros((centers.shape[0],), x.dtype) \
+        .at[lab].add(1.0)
+    delta = jnp.zeros_like(centers).at[lab].add(diff)
+    new_centers = centers - alpha * delta / (counts[:, None] + 1.0)
+    return loss, new_centers
+
+
+def center_loss(input, label, num_classes=None, alpha=0.5, centers=None,
+                update_center=True, param_attr=None, name=None):
+    """Center loss (ref: loss.py center_loss). Functional: pass
+    ``centers`` (num_classes, D); returns (loss (N, 1), new_centers)."""
+    if centers is None:
+        raise ValueError("pass centers=(num_classes, D)")
+    return apply("center_loss", input, label, centers,
+                 alpha=float(alpha), update_center=bool(update_center))
+
+
+@register("ts_sigmoid_loss")
+def _ts_sigmoid_loss(x, label, *, soft_max_up_bound, soft_max_lower_bound):
+    # teacher (soft) vs student (hard) combined sigmoid loss
+    z = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+    hard = (label > 0.5).astype(x.dtype)
+    return bce_with_logits(z, hard) + bce_with_logits(z, label)
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0, name=None):
+    """ref: loss.py teacher_student_sigmoid_loss."""
+    return apply("ts_sigmoid_loss", input, label,
+                 soft_max_up_bound=float(soft_max_up_bound),
+                 soft_max_lower_bound=float(soft_max_lower_bound))
+
+
+# -- metrics-ish ------------------------------------------------------------
+
+
+@register("mean_iou")
+def _mean_iou(pred, label, *, num_classes):
+    p = pred.reshape(-1).astype(jnp.int32)
+    l = label.reshape(-1).astype(jnp.int32)
+    conf = jnp.zeros((num_classes, num_classes)) \
+        .at[l, p].add(1.0, mode="drop")
+    inter = jnp.diagonal(conf)
+    union = conf.sum(0) + conf.sum(1) - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = iou.sum() / jnp.maximum(present.sum(), 1)
+    correct = inter.astype(jnp.int64)
+    wrong = (conf.sum(1) - inter).astype(jnp.int64)
+    return miou, wrong, correct
+
+
+def mean_iou(input, label, num_classes, name=None):
+    """Mean IoU over predicted segmentation ids (ref: nn.py mean_iou).
+    Returns (mean_iou scalar, out_wrong (C,), out_correct (C,))."""
+    return apply("mean_iou", input, label, num_classes=int(num_classes))
+
+
+# -- tensor utilities -------------------------------------------------------
+
+
+@register("multiplex")
+def _multiplex(index, *xs):
+    stacked = jnp.stack(xs, 0)  # (K, N, ...)
+    idx = index.reshape(-1).astype(jnp.int32)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among K same-shape inputs by index (N, 1)
+    (ref: nn.py multiplex)."""
+    return apply("multiplex", index, *inputs)
+
+
+@register("crop_tensor")
+def _crop_tensor(x, *, offsets, shape):
+    return lax.dynamic_slice(x, offsets, shape)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """Static crop at offsets (ref: nn.py crop_tensor). shape entries of
+    -1/None mean "to the end" (dim - offset), like the reference."""
+    xs = unwrap(x).shape
+    if offsets is None:
+        offsets = [0] * len(xs)
+    shape = [xs[i] - int(offsets[i]) if s in (-1, None) else int(s)
+             for i, s in enumerate(shape)]
+    return apply("crop_tensor", x, offsets=tuple(int(o) for o in offsets),
+                 shape=tuple(shape))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """Split along axis into unit slices (ref: nn.py unstack)."""
+    from .manipulation import squeeze, split
+
+    n = unwrap(x).shape[axis]
+    if num is not None and num != n:
+        raise ValueError(f"num={num} != dim size {n}")
+    return [squeeze(p, axis=axis) for p in split(x, n, axis=axis)]
+
+
+@register("bilinear_tensor_product")
+def _bilinear_tensor_product(x, y, w, b):
+    # w (size, dx, dy): out[n, k] = x[n] @ w[k] @ y[n]
+    out = jnp.einsum("nd,kde,ne->nk", x, w, y)
+    return out if b is None else out + b
+
+
+def bilinear_tensor_product(x, y, size=None, weight=None, bias=None,
+                            act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    """x^T W y bilinear form (ref: nn.py bilinear_tensor_product).
+    Functional: pass weight (size, dx, dy) (+ optional bias (size,))."""
+    if weight is None:
+        raise ValueError("pass weight=(size, x_dim, y_dim)")
+    out = apply("bilinear_tensor_product", x, y, weight, bias)
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+@register("add_position_encoding")
+def _add_position_encoding(x, *, alpha, beta):
+    B, L, D = x.shape
+    half = D // 2
+    pos = jnp.arange(L, dtype=jnp.float32)[:, None]
+    inv = jnp.power(10000.0, -jnp.arange(half, dtype=jnp.float32)
+                    / max(half, 1))
+    angles = pos * inv[None, :]
+    enc = jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=1)
+    if enc.shape[1] < D:  # odd D: pad
+        enc = jnp.pad(enc, ((0, 0), (0, D - enc.shape[1])))
+    return alpha * x + beta * enc[None].astype(x.dtype)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """Sinusoidal position encoding mixed in (ref: nn.py
+    add_position_encoding): alpha*x + beta*PE."""
+    return apply("add_position_encoding", input, alpha=float(alpha),
+                 beta=float(beta))
+
+
+@register("temporal_shift")
+def _temporal_shift(x, *, seg_num, shift_ratio):
+    NT, C, H, W = x.shape
+    N = NT // seg_num
+    v = x.reshape(N, seg_num, C, H, W)
+    fold = int(C * shift_ratio)
+    back = jnp.roll(v[:, :, :fold], 1, axis=1) \
+        .at[:, 0, :].set(0.0)
+    fwd = jnp.roll(v[:, :, fold:2 * fold], -1, axis=1) \
+        .at[:, -1, :].set(0.0)
+    rest = v[:, :, 2 * fold:]
+    return jnp.concatenate([back, fwd, rest], axis=2) \
+        .reshape(NT, C, H, W)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    """TSM temporal channel shift (ref: nn.py temporal_shift)."""
+    return apply("temporal_shift", x, seg_num=int(seg_num),
+                 shift_ratio=float(shift_ratio))
+
+
+@register("affine_channel")
+def _affine_channel(x, scale, bias):
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW",
+                   name=None, act=None):
+    """Per-channel affine (frozen-BN form; ref: nn.py affine_channel)."""
+    out = apply("affine_channel", x, scale, bias)
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+# -- decode helpers ---------------------------------------------------------
+
+
+@register("gather_tree")
+def _gather_tree(ids, parents):
+    # ids, parents: (T, B, K) — backtrace beams into full sequences
+    T = ids.shape[0]
+    K = ids.shape[2]
+
+    def step(beam, t):
+        tok = jnp.take_along_axis(ids[t], beam, axis=1)
+        beam = jnp.take_along_axis(parents[t], beam, axis=1)
+        return beam, tok
+
+    beam0 = jnp.broadcast_to(jnp.arange(K, dtype=ids.dtype)[None],
+                             ids.shape[1:])
+    _, toks = lax.scan(step, beam0, jnp.arange(T - 1, -1, -1))
+    return toks[::-1]
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (ref: rnn.py gather_tree): follow parent
+    pointers from the last step so every (b, k) column holds a complete
+    sequence."""
+    return apply("gather_tree", ids, parents)
+
+
+@register("sampling_id")
+def _sampling_id(probs, key):
+    return jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-20)),
+                                  axis=-1)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64", name=None):
+    """Sample one id per row from probabilities (ref: nn.py
+    sampling_id)."""
+    key = _random.next_key() if seed == 0 else jax.random.PRNGKey(seed)
+    return apply("sampling_id", x, Tensor(key, _internal=True))
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decode: argmax -> merge repeats -> drop blanks
+    (ref: nn.py ctc_greedy_decoder). input (B, T, C) probs/logits.
+    Returns (decoded (B, T) padded with ``padding_value``, lengths (B,)).
+    Host-side (decode output feeds metrics, not the graph)."""
+    arr = np.asarray(unwrap(input))
+    B, T = arr.shape[0], arr.shape[1]
+    lens = np.full((B,), T) if input_length is None \
+        else np.asarray(unwrap(input_length)).reshape(-1)
+    out = np.full((B, T), padding_value, np.int64)
+    out_lens = np.zeros((B,), np.int64)
+    for b in range(B):
+        path = arr[b, :lens[b]].argmax(-1)
+        prev = -1
+        k = 0
+        for t in path:
+            if t != prev and t != blank:
+                out[b, k] = t
+                k += 1
+            prev = t
+        out_lens[b] = k
+    return (Tensor(jnp.asarray(out), _internal=True),
+            Tensor(jnp.asarray(out_lens), _internal=True))
+
+
+@register("fsp_matrix")
+def _fsp_matrix(x, y):
+    # flow-of-solution-procedure: (B, Cx, H, W) x (B, Cy, H, W)
+    B, Cx, H, W = x.shape
+    return jnp.einsum("bchw,bdhw->bcd", x, y) / (H * W)
+
+
+def fsp_matrix(x, y, name=None):
+    """FSP distillation matrix (ref: loss.py fsp_matrix)."""
+    return apply("fsp_matrix", x, y)
+
+
+@register("clip_by_norm")
+def _clip_by_norm(x, *, max_norm):
+    norm = jnp.sqrt(jnp.sum(x * x))
+    return jnp.where(norm > max_norm, x * (max_norm / norm), x)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Scale down to L2 norm <= max_norm (ref: nn.py clip_by_norm)."""
+    return apply("clip_by_norm", x, max_norm=float(max_norm))
+
+
+@register("brelu")
+def _brelu(x, *, t_min, t_max):
+    return jnp.clip(x, t_min, t_max)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    """Bounded relu (ref: nn.py brelu)."""
+    return apply("brelu", x, t_min=float(t_min), t_max=float(t_max))
+
+
+@register("soft_relu")
+def _soft_relu(x, *, threshold):
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    """log(1 + exp(clip(x))) (ref: nn.py soft_relu)."""
+    return apply("soft_relu", x, threshold=float(threshold))

@@ -1,0 +1,21 @@
+"""paddle_tpu.vision — host-side image preprocessing.
+
+Ref (capability target): python/paddle/dataset/image.py (resize_short,
+center_crop, random_crop, left_right_flip, to_chw, simple_transform) and
+the 2.0 paddle.vision.transforms composition style.
+
+Host-side numpy on purpose: augmentation runs in the DataLoader workers
+while the TPU computes the previous step, so none of this sits on the
+device critical path.
+"""
+from .transforms import (Compose, Resize, CenterCrop, RandomCrop,
+                         RandomHorizontalFlip, Normalize, ToCHW,
+                         resize_short, center_crop, random_crop,
+                         left_right_flip, to_chw, simple_transform)
+
+__all__ = [
+    "Compose", "Resize", "CenterCrop", "RandomCrop",
+    "RandomHorizontalFlip", "Normalize", "ToCHW",
+    "resize_short", "center_crop", "random_crop", "left_right_flip",
+    "to_chw", "simple_transform",
+]

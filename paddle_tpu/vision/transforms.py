@@ -1,0 +1,150 @@
+"""Image transforms over HWC numpy arrays (see package docstring).
+
+Ref: python/paddle/dataset/image.py — resize_short (:33 area),
+center_crop, random_crop, left_right_flip, to_chw, simple_transform.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _bilinear_resize(img, oh, ow):
+    """HWC float bilinear resize (half-pixel centers), pure numpy."""
+    h, w = img.shape[:2]
+    ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+    xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    im = img if img.ndim == 3 else img[:, :, None]
+    top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
+    bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out if img.ndim == 3 else out[:, :, 0]
+
+
+def resize_short(im, size):
+    """Scale so the SHORT side equals ``size`` (ref: image.py
+    resize_short)."""
+    h, w = im.shape[:2]
+    if h < w:
+        oh, ow = size, int(round(w * size / h))
+    else:
+        oh, ow = int(round(h * size / w)), size
+    return _bilinear_resize(np.asarray(im, np.float32), oh, ow)
+
+
+def center_crop(im, size, is_color=True):
+    """Crop the center size x size patch (ref: image.py center_crop)."""
+    h, w = im.shape[:2]
+    hs = max((h - size) // 2, 0)
+    ws = max((w - size) // 2, 0)
+    return im[hs:hs + size, ws:ws + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    hs = rng.randint(0, max(h - size, 0) + 1)
+    ws = rng.randint(0, max(w - size, 0) + 1)
+    return im[hs:hs + size, ws:ws + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    if im.ndim == 2:
+        im = im[:, :, None]
+    return np.transpose(im, order)
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """The reference's standard pipeline: resize_short -> (random|center)
+    crop -> maybe flip -> CHW -> mean subtract (ref: image.py
+    simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if (rng or np.random).randint(2):
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        im -= np.asarray(mean, np.float32).reshape(-1, 1, 1)
+    return im
+
+
+# -- composable transform objects (2.0-style) -------------------------------
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, im):
+        for t in self.transforms:
+            im = t(im)
+        return im
+
+
+class Resize:
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, im):
+        if isinstance(self.size, int):
+            return resize_short(im, self.size)
+        return _bilinear_resize(np.asarray(im, np.float32),
+                                self.size[0], self.size[1])
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, im):
+        return center_crop(im, self.size)
+
+
+class RandomCrop:
+    def __init__(self, size, seed=None):
+        self.size = size
+        self.rng = np.random.RandomState(seed) if seed is not None \
+            else np.random
+
+    def __call__(self, im):
+        return random_crop(im, self.size, rng=self.rng)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, seed=None):
+        self.prob = prob
+        self.rng = np.random.RandomState(seed) if seed is not None \
+            else np.random
+
+    def __call__(self, im):
+        return left_right_flip(im) if self.rng.rand() < self.prob else im
+
+
+class Normalize:
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, im):
+        shape = (-1, 1, 1) if im.ndim == 3 and im.shape[0] in (1, 3) \
+            else (-1,)
+        return ((np.asarray(im, np.float32)
+                 - self.mean.reshape(shape)) / self.std.reshape(shape))
+
+
+class ToCHW:
+    def __call__(self, im):
+        return to_chw(im)

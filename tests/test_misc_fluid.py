@@ -1,0 +1,273 @@
+"""Long-tail misc ops + the fluid compatibility namespace
+(ref: layers/nn.py, layers/loss.py long tail; fluid/__init__.py surface).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import ops
+
+
+class TestMiscLosses:
+    def test_cos_sim(self):
+        x = np.array([[1.0, 0.0], [1.0, 1.0]], "float32")
+        y = np.array([[0.0, 1.0], [1.0, 1.0]], "float32")
+        out = np.asarray(ops.cos_sim(pt.to_tensor(x),
+                                     pt.to_tensor(y)).numpy())
+        np.testing.assert_allclose(out[:, 0], [0.0, 1.0], atol=1e-6)
+
+    def test_dice_loss_perfect_prediction(self):
+        probs = np.zeros((2, 3, 4), "float32")
+        lab = np.random.RandomState(0).randint(0, 4, (2, 3, 1))
+        for b in range(2):
+            for i in range(3):
+                probs[b, i, lab[b, i, 0]] = 1.0
+        out = np.asarray(ops.dice_loss(pt.to_tensor(probs),
+                                       pt.to_tensor(lab)).numpy())
+        np.testing.assert_allclose(out, 0.0, atol=1e-4)
+
+    def test_huber_loss_quadratic_linear(self):
+        x = pt.to_tensor(np.array([0.5, 3.0], "float32"))
+        y = pt.to_tensor(np.zeros(2, "float32"))
+        out = np.asarray(ops.huber_loss(x, y, delta=1.0).numpy())
+        assert out[0] == pytest.approx(0.125)
+        assert out[1] == pytest.approx(2.5)  # 1*(3 - 0.5)
+
+    def test_rank_and_margin_rank_loss(self):
+        lab = pt.to_tensor(np.array([1.0], "float32"))
+        l = pt.to_tensor(np.array([2.0], "float32"))
+        r = pt.to_tensor(np.array([1.0], "float32"))
+        rl = float(np.asarray(ops.rank_loss(lab, l, r).numpy()))
+        assert rl == pytest.approx(np.log1p(np.exp(-1.0)), rel=1e-5)
+        ml = float(np.asarray(ops.margin_rank_loss(
+            lab, l, r, margin=0.5).numpy()))
+        assert ml == 0.0
+        ml2 = float(np.asarray(ops.margin_rank_loss(
+            lab, r, l, margin=0.5).numpy()))
+        assert ml2 == pytest.approx(1.5)
+
+    def test_bpr_loss_prefers_true_class(self):
+        good = np.array([[5.0, 0.0, 0.0]], "float32")
+        bad = np.array([[0.0, 5.0, 5.0]], "float32")
+        lab = np.array([[0]], "int64")
+        lg = float(np.asarray(ops.bpr_loss(pt.to_tensor(good),
+                                           pt.to_tensor(lab)).numpy()))
+        lb = float(np.asarray(ops.bpr_loss(pt.to_tensor(bad),
+                                           pt.to_tensor(lab)).numpy()))
+        assert lg < lb
+
+    def test_center_loss_updates_centers(self):
+        x = np.array([[1.0, 1.0], [3.0, 3.0]], "float32")
+        lab = np.array([[0], [0]], "int64")
+        centers = np.zeros((2, 2), "float32")
+        loss, new_c = ops.center_loss(pt.to_tensor(x), pt.to_tensor(lab),
+                                      centers=pt.to_tensor(centers),
+                                      alpha=0.5)
+        nc = np.asarray(new_c.numpy())
+        assert nc[0, 0] > 0  # moved toward the class mean
+        assert nc[1, 0] == 0  # untouched class
+        l = np.asarray(loss.numpy())
+        assert l[0, 0] == pytest.approx(1.0)  # 0.5*(1+1)
+
+    def test_mean_iou(self):
+        pred = np.array([[0, 1, 1, 2]], "int64")
+        lab = np.array([[0, 1, 2, 2]], "int64")
+        miou, wrong, correct = ops.mean_iou(pt.to_tensor(pred),
+                                            pt.to_tensor(lab), 3)
+        # class ious: 1.0, 0.5, 0.5 -> mean 2/3
+        assert float(np.asarray(miou.numpy())) == pytest.approx(2 / 3)
+        np.testing.assert_array_equal(np.asarray(correct.numpy()),
+                                      [1, 1, 1])
+
+
+class TestMiscTensorOps:
+    def test_multiplex(self):
+        a = np.zeros((3, 2), "float32")
+        b = np.ones((3, 2), "float32")
+        idx = np.array([[0], [1], [0]], "int32")
+        out = np.asarray(ops.multiplex(
+            [pt.to_tensor(a), pt.to_tensor(b)],
+            pt.to_tensor(idx)).numpy())
+        np.testing.assert_allclose(out[:, 0], [0, 1, 0])
+
+    def test_crop_tensor_and_unstack(self):
+        x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+        out = np.asarray(ops.crop_tensor(
+            pt.to_tensor(x), shape=[1, 2, 2], offsets=[1, 1, 2]).numpy())
+        np.testing.assert_allclose(out[0], x[1, 1:3, 2:4])
+        parts = ops.unstack(pt.to_tensor(x), axis=1)
+        assert len(parts) == 3
+        np.testing.assert_allclose(np.asarray(parts[1].numpy()), x[:, 1])
+
+    def test_bilinear_tensor_product(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 3).astype("float32")
+        y = rng.randn(4, 5).astype("float32")
+        w = rng.randn(2, 3, 5).astype("float32")
+        out = np.asarray(ops.bilinear_tensor_product(
+            pt.to_tensor(x), pt.to_tensor(y),
+            weight=pt.to_tensor(w)).numpy())
+        want = np.einsum("nd,kde,ne->nk", x, w, y)
+        np.testing.assert_allclose(out, want, atol=1e-4)
+
+    def test_add_position_encoding(self):
+        x = np.zeros((1, 4, 6), "float32")
+        out = np.asarray(ops.add_position_encoding(
+            pt.to_tensor(x), alpha=0.0, beta=1.0).numpy())
+        assert out[0, 0, 0] == pytest.approx(0.0)       # sin(0)
+        assert out[0, 0, 3] == pytest.approx(1.0)       # cos(0)
+        assert abs(out[0, 1, 0] - np.sin(1.0)) < 1e-5
+
+    def test_temporal_shift_moves_channels(self):
+        x = np.arange(2 * 4, dtype="float32") \
+            .reshape(2, 4, 1, 1)  # NT=2 (N=1, T=2), C=4
+        out = np.asarray(ops.temporal_shift(
+            pt.to_tensor(x.copy()), seg_num=2,
+            shift_ratio=0.25).numpy())
+        # channel 0 shifts backward: frame0 gets 0, frame1 gets frame0's
+        assert out[0, 0, 0, 0] == 0.0
+        assert out[1, 0, 0, 0] == x[0, 0, 0, 0]
+        # untouched channels stay
+        np.testing.assert_allclose(out[:, 2:], x[:, 2:])
+
+    def test_affine_channel(self):
+        x = np.ones((1, 2, 2, 2), "float32")
+        s = np.array([2.0, 3.0], "float32")
+        b = np.array([1.0, -1.0], "float32")
+        out = np.asarray(ops.affine_channel(
+            pt.to_tensor(x), pt.to_tensor(s), pt.to_tensor(b)).numpy())
+        assert out[0, 0, 0, 0] == 3.0 and out[0, 1, 0, 0] == 2.0
+
+    def test_gather_tree(self):
+        ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], "int64")
+        parents = np.array([[[0, 0]], [[1, 0]], [[1, 0]]], "int64")
+        out = np.asarray(ops.gather_tree(
+            pt.to_tensor(ids), pt.to_tensor(parents)).numpy())
+        # beam 0 backtrace: t2 tok 5 (parent 1), t1 tok 4 (parent 0),
+        # t0 tok 1 -> [1, 4, 5]
+        np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+
+    def test_clip_by_norm(self):
+        x = np.array([3.0, 4.0], "float32")
+        out = np.asarray(ops.clip_by_norm(pt.to_tensor(x), 1.0).numpy())
+        np.testing.assert_allclose(np.linalg.norm(out), 1.0, rtol=1e-5)
+        keep = np.asarray(ops.clip_by_norm(pt.to_tensor(x), 10.0).numpy())
+        np.testing.assert_allclose(keep, x)
+
+    def test_fsp_matrix(self):
+        rng = np.random.RandomState(1)
+        a = rng.randn(2, 3, 4, 4).astype("float32")
+        b = rng.randn(2, 5, 4, 4).astype("float32")
+        out = np.asarray(ops.fsp_matrix(pt.to_tensor(a),
+                                        pt.to_tensor(b)).numpy())
+        want = np.einsum("bchw,bdhw->bcd", a, b) / 16
+        np.testing.assert_allclose(out, want, atol=1e-4)
+
+    def test_ctc_greedy_decoder(self):
+        # argmax path: [1, 1, blank, 2, 2, blank] -> [1, 2]
+        T, C = 6, 4
+        probs = np.zeros((1, T, C), "float32")
+        path = [1, 1, 3, 2, 2, 3]
+        for t, c in enumerate(path):
+            probs[0, t, c] = 1.0
+        dec, lens = ops.ctc_greedy_decoder(probs, blank=3)
+        assert int(np.asarray(lens.numpy())[0]) == 2
+        np.testing.assert_array_equal(np.asarray(dec.numpy())[0, :2],
+                                      [1, 2])
+
+
+class TestFluidCompat:
+    def test_static_fc_pipeline(self):
+        import paddle_tpu.fluid as fluid
+
+        pt.seed(0)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[16, 8], dtype="float32")
+            y = fluid.data(name="y", shape=[16, 1], dtype="float32")
+            pred = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square(pred - y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        X = rng.randn(16, 8).astype("float32")
+        Y = (X @ rng.randn(8).astype("float32")).reshape(16, 1)
+        losses = [float(exe.run(main, feed={"x": X, "y": Y},
+                                fetch_list=[loss])[0])
+                  for _ in range(40)]
+        assert losses[-1] < losses[0] * 0.1
+
+    def test_alias_surface(self):
+        import paddle_tpu.fluid as fluid
+
+        x = pt.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+        assert float(fluid.layers.reduce_sum(x)) == 10.0
+        out = fluid.layers.elementwise_max(x, x * 0 + 2.5)
+        assert float(np.asarray(out.numpy()).min()) == 2.5
+        assert list(np.asarray(fluid.layers.shape(x).numpy())) == [2, 2]
+        assert int(fluid.layers.rank(x)) == 2
+        sched = fluid.layers.piecewise_decay([10], [0.1, 0.01])
+        assert sched.get_lr() == 0.1
+
+    def test_dygraph_guard_and_variable(self):
+        import paddle_tpu.fluid as fluid
+
+        with fluid.dygraph.guard():
+            v = fluid.dygraph.to_variable(np.ones((2, 2), "float32"))
+            lin = fluid.dygraph.Linear(2, 3)
+            out = lin(v)
+            assert list(out.shape) == [2, 3]
+
+    def test_compat_program_guard_restores_mode(self):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu import static_
+
+        assert not static_.in_static_mode()
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            assert static_.in_static_mode()
+        assert not static_.in_static_mode()
+
+
+class TestReviewRegressions:
+    def test_crop_tensor_minus_one_respects_offset(self):
+        x = np.arange(20, dtype="float32").reshape(5, 4)
+        out = np.asarray(ops.crop_tensor(
+            pt.to_tensor(x), shape=[-1, 2], offsets=[2, 0]).numpy())
+        assert out.shape == (3, 2)
+        np.testing.assert_allclose(out, x[2:, :2])
+
+    def test_target_assign_negative_padding_dropped(self):
+        x = np.arange(8, dtype="float32").reshape(1, 2, 4)
+        match = np.array([[0, -1, -1]], "int32")
+        negs = np.array([[2, -1]], "int32")  # -1 is padding, NOT prior 0
+        out, w = ops.target_assign(pt.to_tensor(x), pt.to_tensor(match),
+                                   negative_indices=pt.to_tensor(negs),
+                                   mismatch_value=0)
+        w = np.asarray(w.numpy())[0]
+        assert w[0, 0] == 1.0  # matched positive untouched
+        assert w[1, 0] == 0.0  # unmined stays ignored
+        assert w[2, 0] == 1.0  # the listed negative
+
+    def test_fluid_decay_steps_semantics(self):
+        import paddle_tpu.fluid as fluid
+
+        s = fluid.layers.exponential_decay(0.1, decay_steps=100,
+                                           decay_rate=0.5)
+        for _ in range(100):
+            s.step()
+        assert s.get_lr() == pytest.approx(0.05, rel=1e-6)
+        s2 = fluid.layers.inverse_time_decay(0.1, decay_steps=10,
+                                             decay_rate=1.0)
+        for _ in range(10):
+            s2.step()
+        assert s2.get_lr() == pytest.approx(0.05, rel=1e-6)
+
+    def test_movielens_api_callables(self):
+        from paddle_tpu import dataset
+
+        assert dataset.movielens.max_user_id() > 0
+        assert dataset.movielens.max_job_id() == 20
+        s = next(dataset.movielens.train()())
+        assert len(s) == 8 and 1 <= s[-1] <= 5

@@ -1,0 +1,45 @@
+"""vision.transforms tests (ref: python/paddle/dataset/image.py)."""
+import numpy as np
+
+import paddle_tpu
+from paddle_tpu.vision import (Compose, Resize, CenterCrop, RandomCrop,
+                               RandomHorizontalFlip, Normalize, ToCHW,
+                               resize_short, center_crop, simple_transform)
+
+
+def test_resize_short_scales_short_side():
+    im = np.random.RandomState(0).rand(40, 80, 3).astype("float32")
+    out = resize_short(im, 20)
+    assert out.shape == (20, 40, 3)
+    tall = resize_short(im.transpose(1, 0, 2), 20)
+    assert tall.shape == (40, 20, 3)
+
+
+def test_resize_preserves_constant_image():
+    im = np.full((30, 50, 3), 0.7, "float32")
+    out = resize_short(im, 16)
+    np.testing.assert_allclose(out, 0.7, atol=1e-6)
+
+
+def test_center_crop():
+    im = np.arange(36, dtype="float32").reshape(6, 6)
+    out = center_crop(im, 2)
+    np.testing.assert_allclose(out, [[14, 15], [20, 21]])
+
+
+def test_simple_transform_eval_deterministic():
+    im = np.random.RandomState(1).rand(40, 40, 3).astype("float32")
+    a = simple_transform(im, 32, 24, is_train=False, mean=[0.5, 0.5, 0.5])
+    b = simple_transform(im, 32, 24, is_train=False, mean=[0.5, 0.5, 0.5])
+    assert a.shape == (3, 24, 24)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_compose_pipeline():
+    rng_seeded = Compose([Resize(32), RandomCrop(24, seed=0),
+                          RandomHorizontalFlip(seed=0), ToCHW(),
+                          Normalize([0.5] * 3, [0.25] * 3)])
+    im = np.random.RandomState(2).rand(48, 64, 3).astype("float32")
+    out = rng_seeded(im)
+    assert out.shape == (3, 24, 24)
+    assert abs(float(out.mean())) < 2.0
