@@ -23,6 +23,12 @@ import numpy as np
 
 SMOKE = os.environ.get("PADDLE_TPU_BENCH_SMOKE") == "1"  # tiny-shape CPU run
 
+
+class _Deadline(BaseException):
+    """Raised by the SIGALRM watchdog; BaseException so per-leg `except
+    Exception` blocks can't swallow it (the alarm is one-shot — once
+    swallowed, a later hang would die JSON-less under the driver's kill)."""
+
 # Reference-era baselines (V100 fp16, PaddlePaddle ~1.7 headline figures):
 # BERT-Base pretrain seq128 ~200 seq/s = 25.6k tok/s; ResNet-50 ~980 img/s.
 BASELINE_BERT_TOKENS_S = 25600.0
@@ -151,12 +157,40 @@ def bench_gpt(B=8, L=1024):
             "loss": loss, "params": n_params}
 
 
+def _devices_blocking_guard(timeout_s):
+    """jax.devices() through a worker thread: the axon tunnel client can
+    BLOCK FOREVER inside PJRT init (observed live: relay down -> no
+    exception, no return), and a blocked main thread means the driver's
+    kill leaves no JSON. Returns (devices, error) with devices=None on
+    timeout/failure."""
+    import threading
+
+    box = {}
+
+    def work():
+        try:
+            import jax
+
+            box["devs"] = jax.devices()
+        except Exception as e:  # report, don't raise in the thread
+            box["err"] = e
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return None, TimeoutError(f"jax.devices() blocked > {timeout_s}s "
+                                  "(axon tunnel down?)")
+    return box.get("devs"), box.get("err")
+
+
 def _init_backend():
     """Initialize the jax backend, retrying transient tunnel failures.
 
-    Two rounds of BENCH gates died here (rc=1, no JSON): the axon TPU
-    tunnel can fail its first init. Retry with backoff; after exhausting
-    retries report the failure (never bench full shapes on host CPU)."""
+    Two rounds of BENCH gates died here (rc=1/hang, no JSON): the axon
+    TPU tunnel can fail its first init OR block indefinitely. Retry with
+    backoff under a per-attempt timeout; after exhausting retries report
+    the failure (never bench full shapes on host CPU)."""
     import jax
 
     if SMOKE:
@@ -164,22 +198,23 @@ def _init_backend():
         return jax.devices()
     last = None
     for attempt in range(5):
-        try:
-            devs = jax.devices()
+        devs, err = _devices_blocking_guard(120.0)
+        if devs is not None:
             _log(f"backend ok on attempt {attempt + 1}: {devs}")
             return devs
-        except Exception as e:
-            last = e
-            _log(f"backend init attempt {attempt + 1} failed: "
-                 f"{type(e).__name__}: {e}")
-            try:
-                import jax.extend.backend as jeb
+        last = err
+        _log(f"backend init attempt {attempt + 1} failed: "
+             f"{type(err).__name__}: {err}")
+        if isinstance(err, TimeoutError):
+            break  # the stuck client thread won't recover; fail fast
+        try:
+            import jax.extend.backend as jeb
 
-                jeb.clear_backends()
-            except Exception:
-                pass
-            if attempt < 4:  # no pointless sleep after the final attempt
-                time.sleep(min(15.0, 2.0 ** attempt))
+            jeb.clear_backends()
+        except Exception:
+            pass
+        if attempt < 4:  # no pointless sleep after the final attempt
+            time.sleep(min(15.0, 2.0 ** attempt))
     # Do NOT fall back to benching full-size workloads on host CPU: that
     # trades a fast failure for an hours-long stall reported under the
     # per-chip TPU metric. Report the failure instead.
@@ -187,7 +222,9 @@ def _init_backend():
     return None
 
 
-def _run_benches():
+def _run_benches(results):
+    """Mutates `results` in place so legs finished before a watchdog
+    deadline still reach the JSON line."""
     global bench_bert, bench_resnet50, bench_gpt
     if SMOKE:
         import functools
@@ -195,19 +232,25 @@ def _run_benches():
         bench_bert = functools.partial(bench_bert, B=2, L=128)
         bench_resnet50 = functools.partial(bench_resnet50, B=2, size=64)
         bench_gpt = functools.partial(bench_gpt, B=1, L=128)
-    results = {}
     for name, fn in (("bert", bench_bert), ("resnet50", bench_resnet50),
                      ("gpt", bench_gpt)):
-        try:
-            t0 = time.perf_counter()
-            results[name] = fn()
-            _log(f"{name}: {results[name]} "
-                 f"({time.perf_counter() - t0:.0f}s incl. compile)")
-        except Exception as e:  # keep the bench scoreable even if one fails
-            import traceback
+        for attempt in (1, 2):  # one retry: the tunnel drops transiently
+            try:
+                t0 = time.perf_counter()
+                results[name] = fn()
+                _log(f"{name}: {results[name]} "
+                     f"({time.perf_counter() - t0:.0f}s incl. compile)")
+                break
+            except Exception as e:  # keep the bench scoreable regardless
+                import traceback
 
-            _log(f"{name} FAILED: {type(e).__name__}: {e}")
-            _log(traceback.format_exc())
+                _log(f"{name} FAILED (attempt {attempt}): "
+                     f"{type(e).__name__}: {e}")
+                _log(traceback.format_exc())
+                transient = "UNAVAILABLE" in str(e) or "Connection" in str(e)
+                if not (transient and attempt == 1):
+                    break
+                time.sleep(10.0)
     if "gpt" in results and not SMOKE:
         # pallas-attributable delta: rerun GPT with the kernels disabled
         old = os.environ.get("PADDLE_TPU_PALLAS")
@@ -233,9 +276,24 @@ def main():
                 "vs_baseline": 0.0}
     extras = {}
     results = {}
+    # Global watchdog: SIGALRM raises so a mid-leg compile/tunnel hang
+    # still reaches the JSON print before the driver's kill.
+    import signal
+
+    def _deadline(signum, frame):
+        raise _Deadline("bench deadline reached")
+
+    deadline_s = int(os.environ.get("PADDLE_TPU_BENCH_DEADLINE", "3000"))
+    try:
+        signal.signal(signal.SIGALRM, _deadline)
+        signal.alarm(deadline_s)
+    except Exception:
+        pass  # non-main-thread / platform without SIGALRM
     try:
         if _init_backend() is not None:
-            results = _run_benches()
+            _run_benches(results)
+    except _Deadline as e:
+        _log(f"bench watchdog fired: {e}; reporting partial results")
     except Exception as e:
         import traceback
 
@@ -243,10 +301,18 @@ def main():
         _log(traceback.format_exc())
     finally:
         try:
+            signal.alarm(0)
+        except Exception:
+            pass
+        try:
             line = json.dumps(_score(results, headline, extras))
         except Exception:
             line = json.dumps(headline)
         print(line, flush=True)
+        # A wedged tunnel client thread must not stall interpreter
+        # shutdown after the JSON is out.
+        sys.stdout.flush()
+        os._exit(0)
 
 
 def _score(results, headline, extras):

@@ -74,7 +74,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    lse_ref[0] = m + jnp.log(l)   # (bq, 1) — trailing unit dim keeps the
+    # block 2-D-tileable on TPU ((1, bq) row blocks violate the min tile)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -82,8 +83,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0]        # (bq, 1)
+    delta = delta_ref[0]    # (bq, 1)
     block_q, D = q.shape
     nk = Lk // block_k
     dq = jnp.zeros((block_q, D), jnp.float32)
@@ -123,8 +124,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) \
             * scale
         do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q)][:, None]
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q)]      # (bq, 1)
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q)]  # (bq, 1)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
             s = s + _causal_mask(qi, ki, block_q, block_k, offset)
@@ -182,15 +183,15 @@ def _flash_call(q, k, v, causal, scale, block_q, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Lq), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Lq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return o.reshape(B, H, Lq, D), lse.reshape(B, H, Lq)
+    return o.reshape(B, H, Lq, D), lse   # lse stays (BH, Lq, 1) for bwd
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, interpret):
@@ -209,10 +210,10 @@ def _flash_bwd(causal, scale, block_q, interpret, res, do):
     kr = k.reshape(B * H, Lk, D)
     vr = v.reshape(B * H, Lk, D)
     dor = do.reshape(B * H, Lq, D)
-    lser = lse.reshape(B * H, Lq)
+    lser = lse                                   # (BH, Lq, 1)
     # delta_i = rowsum(dO * O) — the softmax-jacobian diagonal term
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1).reshape(B * H, Lq)
+                    axis=-1).reshape(B * H, Lq, 1)
 
     dq_kern = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                                 block_k=bk, Lk=Lk, offset=Lk - Lq)
@@ -224,8 +225,8 @@ def _flash_bwd(causal, scale, block_q, interpret, res, do):
             pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
@@ -242,8 +243,8 @@ def _flash_bwd(causal, scale, block_q, interpret, res, do):
             pl.BlockSpec((1, bk, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, Lq, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Lq), lambda b, i: (b, 0)),
-            pl.BlockSpec((1, Lq), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, Lq, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Lq, 1), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, i: (b, i, 0)),

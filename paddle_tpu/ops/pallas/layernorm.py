@@ -26,8 +26,8 @@ def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
     xhat = xc * rstd
     y = xhat * g_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
     y_ref[:] = y.astype(y_ref.dtype)
-    mean_ref[:] = mean[:, 0]
-    rstd_ref[:] = rstd[:, 0]
+    mean_ref[:] = mean      # (bn, 1): 2-D so the block is TPU-tileable
+    rstd_ref[:] = rstd
 
 
 def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref, dx_ref, dg_ref,
@@ -35,17 +35,24 @@ def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref, dx_ref, dg_ref,
     x = x_ref[:].astype(jnp.float32)
     dy = dy_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
-    mean = mean_ref[:][:, None]
-    rstd = rstd_ref[:][:, None]
+    mean = mean_ref[:]
+    rstd = rstd_ref[:]
     xhat = (x - mean) * rstd
     wdy = dy * g
     c1 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
     c2 = jnp.mean(wdy, axis=-1, keepdims=True)
     dx = (wdy - xhat * c1 - c2) * rstd
     dx_ref[:] = dx.astype(dx_ref.dtype)
-    # per-block partial reductions; caller sums the grid axis
-    dg_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
-    db_ref[:] = jnp.sum(dy, axis=0, keepdims=True)
+    # dgamma/dbeta: accumulate into one (D,) block revisited across the
+    # sequential TPU grid (a (1, D) partial-per-block output would violate
+    # the (8, 128) min-tile rule)
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dg_ref[:] = jnp.zeros_like(dg_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+
+    dg_ref[:] += jnp.sum(dy * xhat, axis=0)
+    db_ref[:] += jnp.sum(dy, axis=0)
 
 
 def _pick_rows(N, want=256):
@@ -76,13 +83,13 @@ def _ln_call(x, gamma, beta, eps, interpret):
         ],
         out_specs=[
             pl.BlockSpec((bn, D), lambda i: (i, 0)),
-            pl.BlockSpec((bn,), lambda i: (i,)),
-            pl.BlockSpec((bn,), lambda i: (i,)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((N, D), x.dtype),
-            jax.ShapeDtypeStruct((N,), jnp.float32),
-            jax.ShapeDtypeStruct((N,), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
         ],
         interpret=interpret,
     )(x, gamma, beta)
@@ -99,31 +106,29 @@ def _ln_bwd(eps, interpret, res, dy):
     N, D = x.shape
     bn = _pick_rows(N)
     nblocks = N // bn
-    dx, dg_part, db_part = pl.pallas_call(
+    dx, dg, db = pl.pallas_call(
         _bwd_kernel,
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((bn, D), lambda i: (i, 0)),
             pl.BlockSpec((D,), lambda i: (0,)),
-            pl.BlockSpec((bn,), lambda i: (i,)),
-            pl.BlockSpec((bn,), lambda i: (i,)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
             pl.BlockSpec((bn, D), lambda i: (i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((bn, D), lambda i: (i, 0)),
-            pl.BlockSpec((1, D), lambda i: (i, 0)),
-            pl.BlockSpec((1, D), lambda i: (i, 0)),
+            pl.BlockSpec((D,), lambda i: (0,)),
+            pl.BlockSpec((D,), lambda i: (0,)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((N, D), x.dtype),
-            jax.ShapeDtypeStruct((nblocks, D), jnp.float32),
-            jax.ShapeDtypeStruct((nblocks, D), jnp.float32),
+            jax.ShapeDtypeStruct((D,), jnp.float32),
+            jax.ShapeDtypeStruct((D,), jnp.float32),
         ],
         interpret=interpret,
     )(x, gamma, mean, rstd, dy)
-    dgamma = jnp.sum(dg_part, axis=0).astype(gamma.dtype)
-    dbeta = jnp.sum(db_part, axis=0).astype(gamma.dtype)
-    return dx, dgamma, dbeta
+    return dx, dg.astype(gamma.dtype), db.astype(gamma.dtype)
 
 
 fused_layer_norm.defvjp(_ln_fwd, _ln_bwd)
