@@ -289,6 +289,23 @@ def main():
         signal.alarm(deadline_s)
     except Exception:
         pass  # non-main-thread / platform without SIGALRM
+    # Hard backstop: SIGALRM only interrupts Python bytecode — a leg
+    # blocked inside a C-level PJRT call (compile/block_until_ready on a
+    # dead tunnel) never runs the handler. A watchdog thread always can.
+    import threading
+
+    def _hard_exit():
+        _log("hard watchdog fired; dumping partial results")
+        try:
+            line = json.dumps(_score(results, headline, extras))
+        except Exception:
+            line = json.dumps(headline)
+        print(line, flush=True)
+        os._exit(0)
+
+    hard = threading.Timer(deadline_s + 90.0, _hard_exit)
+    hard.daemon = True
+    hard.start()
     try:
         if _init_backend() is not None:
             _run_benches(results)
@@ -302,6 +319,7 @@ def main():
     finally:
         try:
             signal.alarm(0)
+            hard.cancel()
         except Exception:
             pass
         try:

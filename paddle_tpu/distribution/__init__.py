@@ -18,7 +18,7 @@ from ..core import random as prandom
 from ..core.tensor import Tensor
 
 __all__ = ["Distribution", "Uniform", "Normal", "Categorical", "Bernoulli",
-           "kl_divergence"]
+           "MultivariateNormalDiag", "kl_divergence"]
 
 
 def _arr(x):
@@ -148,8 +148,47 @@ class Bernoulli(Distribution):
         return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
 
 
+class MultivariateNormalDiag(Distribution):
+    """Diagonal-covariance multivariate normal (ref: distributions.py
+    MultivariateNormalDiag): ``scale`` is the diagonal matrix; only its
+    diagonal participates."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        sc = _arr(scale).astype(jnp.float32)
+        self.diag = jnp.diagonal(sc, axis1=-2, axis2=-1) if sc.ndim >= 2 \
+            else sc
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.diag.shape)
+        z = jax.random.normal(prandom.next_key(), shape, jnp.float32)
+        return _wrap(self.loc + z * self.diag)
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.float32)
+        k = self.loc.shape[-1]
+        quad = jnp.sum(((v - self.loc) / self.diag) ** 2, axis=-1)
+        logdet = jnp.sum(jnp.log(self.diag ** 2), axis=-1)
+        return _wrap(-0.5 * (quad + logdet + k * math.log(2 * math.pi)))
+
+    def entropy(self):
+        k = self.loc.shape[-1]
+        logdet = jnp.sum(jnp.log(self.diag ** 2), axis=-1)
+        return _wrap(0.5 * (k * (1 + math.log(2 * math.pi)) + logdet))
+
+
 def kl_divergence(p, q):
     """ref: distributions.py kl_divergence (closed forms per pair)."""
+    if isinstance(p, MultivariateNormalDiag) and \
+            isinstance(q, MultivariateNormalDiag):
+        var_p, var_q = p.diag ** 2, q.diag ** 2
+        k = p.loc.shape[-1]
+        return _wrap(0.5 * (
+            jnp.sum(var_p / var_q, axis=-1) +
+            jnp.sum((q.loc - p.loc) ** 2 / var_q, axis=-1) - k +
+            jnp.sum(jnp.log(var_q), axis=-1) -
+            jnp.sum(jnp.log(var_p), axis=-1)))
     if isinstance(p, Normal) and isinstance(q, Normal):
         var_ratio = (p.scale / q.scale) ** 2
         t1 = ((p.loc - q.loc) / q.scale) ** 2

@@ -77,10 +77,13 @@ gaussian_random = _ops.randn
 def image_resize_short(input, out_short_len, resample="BILINEAR"):
     """Resize so the short side equals out_short_len (ref: nn.py
     image_resize_short)."""
+    import builtins
+
+    # NB: builtins.* — the module namespace re-exports ops.min/ops.round
     h, w = input.shape[2], input.shape[3]
-    short = min(h, w)
-    oh = int(round(h * out_short_len / short))
-    ow = int(round(w * out_short_len / short))
+    short = builtins.min(h, w)
+    oh = int(builtins.round(h * out_short_len / short))
+    ow = int(builtins.round(w * out_short_len / short))
     return _ops.image_resize(input, out_shape=[oh, ow], resample=resample)
 
 
@@ -254,3 +257,176 @@ def Print(input, first_n=-1, message=None, summarize=20,
     jax.debug.print(label + ": {x}", x=input._data
                     if hasattr(input, "_data") else input)
     return input
+
+
+# -- fluid-era RNN / decode compat (rnn.py) ---------------------------------
+from .rnn import (RNNCell, StaticRNN, DynamicRNN, dynamic_lstm,  # noqa: F401,E402
+                  dynamic_lstmp, dynamic_gru, gru_unit, lstm_unit, lstm,
+                  DecodeHelper, TrainingHelper, GreedyEmbeddingHelper,
+                  SampleEmbeddingHelper, BasicDecoder, beam_search_decode)
+from ..nn.layers.rnn import (LSTMCell, GRUCell, SimpleRNNCell,  # noqa: F401,E402
+                             rnn, birnn, RNN, BiRNN)
+
+# -- distributions under the fluid.layers namespace -------------------------
+from ..distribution import (Uniform, Normal, Categorical,  # noqa: F401,E402
+                            MultivariateNormalDiag)
+
+
+# -- LoDTensorArray compat ---------------------------------------------------
+# The reference's TensorArray ops power while-loop bodies; eager python
+# lists are the direct equivalent (inside ``lax.scan`` the stacked-array
+# convention replaces them — SURVEY §3).
+
+
+def create_array(dtype="float32", initialized_list=None):
+    return list(initialized_list or [])
+
+
+def array_write(x, i, array=None):
+    array = [] if array is None else array
+    idx = int(i.item() if hasattr(i, "item") else i)
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    return array[int(i.item() if hasattr(i, "item") else i)]
+
+
+def array_length(array):
+    return _ops.to_tensor(np.asarray(len(array), np.int64))
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    out = _ops.stack(input, axis=axis) if use_stack else \
+        _ops.concat(input, axis=axis)
+    sizes = _ops.to_tensor(np.asarray(
+        [t.shape[axis] if not use_stack else 1 for t in input], np.int32))
+    return out, sizes
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Re-associate sequence boundaries (ref: sequence_lod.py lod_reset).
+    LoD is explicit in this framework (dense + lengths everywhere), so
+    the data passes through and the new per-row lengths are returned
+    alongside: (x, lengths)."""
+    if target_lod is not None:
+        off = np.asarray(target_lod)
+        lengths = np.diff(off) if off.ndim == 1 else off
+        return x, _ops.to_tensor(lengths.astype(np.int64))
+    return x, y
+
+
+def lod_append(x, level):
+    """Single-level LoD only (SURVEY §4b descope): appending deeper
+    levels is unsupported; boundaries stay explicit at call sites."""
+    raise NotImplementedError(
+        "multi-level LoD is descoped; track lengths explicitly")
+
+
+# -- pooling / padding / crop compat ----------------------------------------
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    if pool_type == "max":
+        return _ops.adaptive_max_pool2d(input, pool_size,
+                                        return_mask=require_index)
+    return _ops.adaptive_avg_pool2d(input, pool_size)
+
+
+adaptive_pool3d = _ops.adaptive_pool3d
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCHW"):
+    if global_pooling:
+        pool_size = input.shape[2:]
+        pool_stride, pool_padding = pool_size, 0
+    fn = _ops.max_pool2d if pool_type == "max" else _ops.avg_pool2d
+    return fn(input, pool_size, stride=pool_stride, padding=pool_padding,
+              ceil_mode=ceil_mode)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCDHW"):
+    if global_pooling:
+        pool_size = input.shape[2:]
+        pool_stride, pool_padding = pool_size, 0
+    fn = _ops.max_pool3d if pool_type == "max" else _ops.avg_pool3d
+    return fn(input, pool_size, stride=pool_stride, padding=pool_padding,
+              ceil_mode=ceil_mode)
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    """Spatial padding of NCHW maps (ref: nn.py pad2d)."""
+    t, b, l, r = [int(p) for p in paddings]
+    import jax.numpy as _jnp
+
+    x = input._data if hasattr(input, "_data") else input
+    cfg = ((0, 0), (0, 0), (t, b), (l, r))
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "edge": "edge"}[mode]
+    if jmode == "constant":
+        out = _jnp.pad(x, cfg, constant_values=pad_value)
+    else:
+        out = _jnp.pad(x, cfg, mode=jmode)
+    return Tensor(out, _internal=True)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    return _ops.crop_tensor(x, shape=shape, offsets=offsets)
+
+
+def random_crop(x, shape, seed=None):
+    """Random spatial crop (ref: nn.py random_crop): same random offset
+    per call, host-drawn from the framework RNG."""
+    from ..core import random as _prandom
+    import jax as _jax
+
+    full = x.shape
+    ndim = len(full)
+    sh = list(shape)
+    lead = ndim - len(sh)
+    key = _prandom.next_key()
+    offs = []
+    for i, s in enumerate(sh):
+        # NB: builtins.max — the module namespace re-exports ops.max
+        limit = int(full[lead + i]) - int(s)
+        if limit < 0:
+            limit = 0
+        key, sub = _jax.random.split(key)
+        off = int(_jax.random.randint(sub, (), 0, limit + 1))
+        offs.append(off)
+    import builtins
+
+    sl = builtins.slice  # ops.slice shadows the builtin at module level
+    idx = tuple([sl(None)] * lead +
+                [sl(o, o + int(s)) for o, s in zip(offs, sh)])
+    return x[idx]
+
+
+def inplace_abn(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+                param_attr=None, bias_attr=None, data_layout="NCHW",
+                name=None, moving_mean_name=None, moving_variance_name=None,
+                do_model_average_for_mean_and_var=False, use_global_stats=
+                False, act_alpha=1.0):
+    """Activated batch norm (ref: nn.py inplace_abn). XLA has no in-place
+    buffers — this is batch_norm + activation, which XLA fuses anyway."""
+    from ..nn.layers.norm import BatchNorm2D
+
+    bn = BatchNorm2D(input.shape[1], momentum=momentum, epsilon=epsilon)
+    if is_test:
+        bn.eval()
+    out = bn(input)
+    if act == "leaky_relu":
+        return _F.leaky_relu(out, act_alpha)
+    if act is not None:
+        return getattr(_F, act)(out)
+    return out

@@ -1,0 +1,550 @@
+"""fluid-era recurrent API compat.
+
+Refs: python/paddle/fluid/layers/rnn.py — dynamic_lstm (:1861), lstm
+(:2018), dynamic_lstmp (:2193), dynamic_gru (:2396), gru_unit (:2549),
+lstm_unit (:2922), DecodeHelper family (:1272-1725), BasicDecoder
+(:1726), beam_search_decode (:2849); layers/control_flow.py StaticRNN,
+layers/rnn.py DynamicRNN.
+
+TPU design notes:
+- All sequence ops run dense (batch, time, feature) with optional
+  ``sequence_length`` masking — the dense+offsets LoD stand-in used
+  across ``ops/sequence.py`` (multi-level LoD is descoped, SURVEY §4b).
+- Recurrences compile to ONE ``lax.scan`` per call via
+  ``nn.layers.rnn.rnn`` — not per-step op launches.
+- ``StaticRNN``/``DynamicRNN`` accept the step as a callable: the
+  fluid with-block sugar builds a sub-block program, which an eager
+  tape can't re-execute per step; the callable form is the same
+  contract with the block made explicit.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import ops as _ops
+from ..core.tensor import Tensor
+from ..inference.decoder import Decoder, dynamic_decode  # noqa: F401
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..nn.layers.rnn import (RNNCellBase, LSTM as _LSTM, rnn as _rnn_run)
+
+__all__ = [
+    "RNNCell", "StaticRNN", "DynamicRNN", "dynamic_lstm", "dynamic_lstmp",
+    "dynamic_gru", "gru_unit", "lstm_unit", "lstm", "DecodeHelper",
+    "TrainingHelper", "GreedyEmbeddingHelper", "SampleEmbeddingHelper",
+    "BasicDecoder", "beam_search_decode", "gather_tree",
+]
+
+RNNCell = RNNCellBase  # fluid name for the cell protocol
+
+
+def _act(name):
+    return {"sigmoid": F.sigmoid, "tanh": F.tanh, "relu": F.relu,
+            "identity": (lambda x: x)}[name]
+
+
+# -- fluid LSTM/GRU sequence ops --------------------------------------------
+
+
+class _FluidLSTMCell(RNNCellBase):
+    """Recurrent-only LSTM cell over pre-projected inputs: x already
+    carries W_x·x (ref dynamic_lstm contract). Gate order c,i,f,o;
+    optional peephole weights appended to the bias."""
+
+    def __init__(self, hidden, param_attr, bias_attr, use_peepholes,
+                 gate_act, cell_act, cand_act):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden)
+        u = I.Uniform(-std, std)
+        self.weight = self.create_parameter((hidden, 4 * hidden),
+                                            attr=param_attr,
+                                            default_initializer=u)
+        nb = 7 * hidden if use_peepholes else 4 * hidden
+        self.bias = self.create_parameter((nb,), attr=bias_attr,
+                                          is_bias=True)
+        self.hidden = hidden
+        self.use_peepholes = use_peepholes
+        self.gate_act, self.cell_act, self.cand_act = gate_act, cell_act, \
+            cand_act
+
+    @property
+    def state_shape(self):
+        return ((self.hidden,), (self.hidden,))
+
+    def forward(self, x, states):
+        h, c = states
+        H = self.hidden
+        g = x + _ops.matmul(h, self.weight) + self.bias[:4 * H]
+        gc, gi, gf, go = (g[:, :H], g[:, H:2 * H], g[:, 2 * H:3 * H],
+                          g[:, 3 * H:])
+        act_g, act_c, act_d = (_act(self.gate_act), _act(self.cell_act),
+                               _act(self.cand_act))
+        if self.use_peepholes:
+            w_ic = self.bias[4 * H:5 * H]
+            w_fc = self.bias[5 * H:6 * H]
+            w_oc = self.bias[6 * H:]
+            i = act_g(gi + w_ic * c)
+            f = act_g(gf + w_fc * c)
+            new_c = f * c + i * act_d(gc)
+            o = act_g(go + w_oc * new_c)
+        else:
+            i, f, o = act_g(gi), act_g(gf), act_g(go)
+            new_c = f * c + i * act_d(gc)
+        new_h = o * act_c(new_c)
+        return new_h, (new_h, new_c)
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None,
+                 sequence_length=None):
+    """LSTM over a pre-projected sequence (ref: rnn.py:1861). ``input``
+    is (B, T, 4*hidden); returns (hidden_seq, cell_seq)."""
+    hidden = size // 4
+    cell = _FluidLSTMCell(hidden, param_attr, bias_attr, use_peepholes,
+                          gate_activation, cell_activation,
+                          candidate_activation)
+    init = None
+    if h_0 is not None:
+        init = (h_0, c_0 if c_0 is not None else _ops.zeros_like(h_0))
+    hs_and_cs = _rnn_with_cell_states(cell, input, init, sequence_length,
+                                      is_reverse)
+    return hs_and_cs
+
+
+def _rnn_with_cell_states(cell, input, init, sequence_length, is_reverse):
+    """Run a (h, c)-state cell returning both per-step h and c."""
+
+    class _Both(Layer):
+        def __init__(self, c):
+            super().__init__()
+            self.c = c
+
+        def get_initial_states(self, *a, **k):
+            return self.c.get_initial_states(*a, **k)
+
+        @property
+        def state_shape(self):
+            return self.c.state_shape
+
+        def forward(self, x, states):
+            h, st = self.c(x, states)
+            return _ops.concat([h, st[1]], axis=-1), st
+
+    both = _Both(cell)
+    ys, _ = _rnn_run(both, input, init, sequence_length,
+                     is_reverse=is_reverse)
+    H = cell.hidden
+    ys = Tensor(ys, _internal=True) if not isinstance(ys, Tensor) else ys
+    return ys[:, :, :H], ys[:, :, H:]
+
+
+class _FluidLSTMPCell(RNNCellBase):
+    """LSTM with a projection of the hidden state (ref dynamic_lstmp,
+    rnn.py:2193): recurrence runs over r_t = act_p(h_t · W_proj)."""
+
+    def __init__(self, hidden, proj, param_attr, bias_attr, use_peepholes,
+                 gate_act, cell_act, cand_act, proj_act):
+        super().__init__()
+        self.weight = self.create_parameter((proj, 4 * hidden),
+                                            attr=param_attr)
+        self.w_proj = self.create_parameter((hidden, proj), attr=param_attr)
+        nb = 7 * hidden if use_peepholes else 4 * hidden
+        self.bias = self.create_parameter((nb,), attr=bias_attr,
+                                          is_bias=True)
+        self.hidden, self.proj = hidden, proj
+        self.use_peepholes = use_peepholes
+        self.gate_act, self.cell_act = gate_act, cell_act
+        self.cand_act, self.proj_act = cand_act, proj_act
+
+    @property
+    def state_shape(self):
+        return ((self.proj,), (self.hidden,))
+
+    def forward(self, x, states):
+        r, c = states
+        H = self.hidden
+        g = x + _ops.matmul(r, self.weight) + self.bias[:4 * H]
+        gc, gi, gf, go = (g[:, :H], g[:, H:2 * H], g[:, 2 * H:3 * H],
+                          g[:, 3 * H:])
+        act_g, act_c = _act(self.gate_act), _act(self.cell_act)
+        act_d, act_p = _act(self.cand_act), _act(self.proj_act)
+        if self.use_peepholes:
+            i = act_g(gi + self.bias[4 * H:5 * H] * c)
+            f = act_g(gf + self.bias[5 * H:6 * H] * c)
+            new_c = f * c + i * act_d(gc)
+            o = act_g(go + self.bias[6 * H:] * new_c)
+        else:
+            i, f, o = act_g(gi), act_g(gf), act_g(go)
+            new_c = f * c + i * act_d(gc)
+        new_h = o * act_c(new_c)
+        new_r = act_p(_ops.matmul(new_h, self.w_proj))
+        return new_r, (new_r, new_c)
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, h_0=None, c_0=None,
+                  cell_clip=None, proj_clip=None, sequence_length=None):
+    """Projected LSTM (ref: rnn.py:2193). input: (B, T, 4*hidden);
+    returns (projection_seq, cell_seq)."""
+    hidden = size // 4
+    cell = _FluidLSTMPCell(hidden, proj_size, param_attr, bias_attr,
+                           use_peepholes, gate_activation, cell_activation,
+                           candidate_activation, proj_activation)
+    init = None
+    if h_0 is not None:
+        init = (h_0, c_0)
+
+    class _Both(Layer):
+        def __init__(self, c):
+            super().__init__()
+            self.c = c
+
+        def get_initial_states(self, *a, **k):
+            return self.c.get_initial_states(*a, **k)
+
+        @property
+        def state_shape(self):
+            return self.c.state_shape
+
+        def forward(self, x, states):
+            r, st = self.c(x, states)
+            return _ops.concat([r, st[1]], axis=-1), st
+
+    ys, _ = _rnn_run(_Both(cell), input, init, sequence_length,
+                     is_reverse=is_reverse)
+    ys = Tensor(ys, _internal=True) if not isinstance(ys, Tensor) else ys
+    return ys[:, :, :proj_size], ys[:, :, proj_size:]
+
+
+class _FluidGRUCell(RNNCellBase):
+    """GRU over pre-projected inputs (ref dynamic_gru, rnn.py:2396).
+    Weight (D, 3D): [W_uh | W_rh | W_ch]; gates u, r then candidate."""
+
+    def __init__(self, hidden, param_attr, bias_attr, gate_act, cand_act,
+                 origin_mode):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden)
+        u = I.Uniform(-std, std)
+        self.weight = self.create_parameter((hidden, 3 * hidden),
+                                            attr=param_attr,
+                                            default_initializer=u)
+        self.bias = self.create_parameter((3 * hidden,), attr=bias_attr,
+                                          is_bias=True)
+        self.hidden = hidden
+        self.gate_act, self.cand_act = gate_act, cand_act
+        self.origin_mode = origin_mode
+
+    @property
+    def state_shape(self):
+        return (self.hidden,)
+
+    def forward(self, x, states):
+        h = states
+        H = self.hidden
+        xb = x + self.bias
+        gates = xb[:, :2 * H] + _ops.matmul(h, self.weight[:, :2 * H])
+        act_g, act_c = _act(self.gate_act), _act(self.cand_act)
+        u = act_g(gates[:, :H])
+        r = act_g(gates[:, H:])
+        c = act_c(xb[:, 2 * H:] + _ops.matmul(r * h, self.weight[:, 2 * H:]))
+        if self.origin_mode:
+            new_h = u * h + (1.0 - u) * c
+        else:
+            new_h = (1.0 - u) * h + u * c
+        return new_h, new_h
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False,
+                sequence_length=None):
+    """GRU over a pre-projected (B, T, 3*size) sequence (ref:
+    rnn.py:2396); returns the hidden sequence (B, T, size)."""
+    cell = _FluidGRUCell(size, param_attr, bias_attr, gate_activation,
+                         candidate_activation, origin_mode)
+    ys, _ = _rnn_run(cell, input, h_0, sequence_length,
+                     is_reverse=is_reverse)
+    return Tensor(ys, _internal=True) if not isinstance(ys, Tensor) else ys
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """One GRU step (ref: rnn.py:2549). ``size`` is 3*D as in fluid.
+    Returns (new_hidden, reset_hidden_prev, gate)."""
+    D = size // 3
+    cell = _FluidGRUCell(D, param_attr, bias_attr, gate_activation,
+                         activation, origin_mode)
+    xb = input + cell.bias
+    gates = xb[:, :2 * D] + _ops.matmul(hidden, cell.weight[:, :2 * D])
+    act_g, act_c = _act(gate_activation), _act(activation)
+    u = act_g(gates[:, :D])
+    r = act_g(gates[:, D:])
+    r_h = r * hidden
+    c = act_c(xb[:, 2 * D:] + _ops.matmul(r_h, cell.weight[:, 2 * D:]))
+    if origin_mode:
+        new_h = u * hidden + (1.0 - u) * c
+    else:
+        new_h = (1.0 - u) * hidden + u * c
+    gate = _ops.concat([u, r, c], axis=-1)
+    return new_h, r_h, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One fused LSTM step over concat([x, h]) (ref: rnn.py:2922).
+    Returns (hidden, cell)."""
+    H = hidden_t_prev.shape[-1]
+    concat = _ops.concat([x_t, hidden_t_prev], axis=-1)
+    from .layers import fc
+
+    g = fc(concat, 4 * H, param_attr=param_attr, bias_attr=bias_attr)
+    i, f, c_cand, o = (g[:, :H], g[:, H:2 * H], g[:, 2 * H:3 * H],
+                       g[:, 3 * H:])
+    new_c = F.sigmoid(f + forget_bias) * cell_t_prev + \
+        F.sigmoid(i) * F.tanh(c_cand)
+    new_h = F.sigmoid(o) * F.tanh(new_c)
+    return new_h, new_c
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """cuDNN-style stacked LSTM (ref: rnn.py:2018) on the framework's
+    fused-scan LSTM. input: (B, T, D); init_h/init_c: (L*dirs, B, H).
+    Returns (out_seq, last_h, last_c)."""
+    net = _LSTM(input.shape[-1], hidden_size, num_layers=num_layers,
+                direction="bidirect" if is_bidirec else "forward",
+                dropout=0.0 if is_test else dropout_prob)
+    out, (h, c) = net(input, (init_h, init_c))
+    return out, h, c
+
+
+# -- StaticRNN / DynamicRNN --------------------------------------------------
+
+
+class StaticRNN:
+    """Unrolled recurrence over fixed-length sequences (ref:
+    control_flow.py StaticRNN). The per-step block is a callable::
+
+        srnn = StaticRNN()
+        srnn.step_input(x)                 # (B, T, D) sequence
+        srnn.memory(init=h0)               # recurrent state
+        srnn.step(lambda xt, h: (out, h')) # block
+        outs = srnn()                      # (B, T, ...) stacked outputs
+
+    The step callable receives one tensor per registered step_input then
+    one per memory, and returns (output, *new_memories).
+    """
+
+    def __init__(self, name=None):
+        self._inputs = []
+        self._mems = []
+        self._fn = None
+
+    def step_input(self, x):
+        self._inputs.append(x)
+        return x
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        if init is None:
+            ref = batch_ref if batch_ref is not None else self._inputs[0]
+            B = ref.shape[0]
+            init = _ops.full([B] + list(shape), init_value)
+        self._mems.append(init)
+        return init
+
+    def step(self, fn):
+        self._fn = fn
+        return fn
+
+    def __call__(self):
+        assert self._fn is not None and self._inputs, \
+            "register step_input() and a step() callable first"
+        T = self._inputs[0].shape[1]
+        mems = list(self._mems)
+        outs = []
+        for t in range(T):
+            xs = [x[:, t] for x in self._inputs]
+            res = self._fn(*xs, *mems)
+            if not isinstance(res, tuple):
+                res = (res,)
+            out, new_mems = res[0], list(res[1:])
+            mems = new_mems if new_mems else mems
+            outs.append(out)
+        return _ops.stack(outs, axis=1)
+
+
+class DynamicRNN(StaticRNN):
+    """Variable-length recurrence (ref: rnn.py DynamicRNN): same step
+    contract as StaticRNN plus per-row ``sequence_length`` masking —
+    finished rows keep their last state and emit zeros."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._lengths = None
+
+    def step_input(self, x, lengths=None):
+        if lengths is not None:
+            self._lengths = lengths
+        return super().step_input(x)
+
+    def __call__(self):
+        assert self._fn is not None and self._inputs
+        T = self._inputs[0].shape[1]
+        mems = list(self._mems)
+        outs = []
+        for t in range(T):
+            xs = [x[:, t] for x in self._inputs]
+            res = self._fn(*xs, *mems)
+            if not isinstance(res, tuple):
+                res = (res,)
+            out, new_mems = res[0], list(res[1:])
+            if self._lengths is not None:
+                alive = (self._lengths > t)
+                keep = _ops.reshape(alive, [-1] + [1] * (len(out.shape) - 1))
+                out = _ops.where(keep, out, _ops.zeros_like(out))
+                if new_mems:
+                    new_mems = [
+                        _ops.where(_ops.reshape(
+                            alive, [-1] + [1] * (len(n.shape) - 1)), n, m)
+                        for n, m in zip(new_mems, mems)]
+            mems = new_mems if new_mems else mems
+            outs.append(out)
+        return _ops.stack(outs, axis=1)
+
+
+# -- decode helpers ----------------------------------------------------------
+
+
+class DecodeHelper:
+    """Sampling + next-input protocol for BasicDecoder (ref:
+    rnn.py:1272)."""
+
+    def initialize(self):
+        """-> (initial_inputs, initial_finished)"""
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        """-> (finished, next_inputs, next_states)"""
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing from a ground-truth sequence (ref: rnn.py:1341)."""
+
+    def __init__(self, inputs, sequence_length, time_major=False):
+        self.inputs = inputs if not time_major else _ops.transpose(
+            inputs, [1, 0] + list(range(2, len(inputs.shape))))
+        self.sequence_length = sequence_length
+
+    def initialize(self):
+        finished = (self.sequence_length <= 0)
+        return self.inputs[:, 0], finished
+
+    def sample(self, time, outputs, states):
+        return _ops.argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        T = self.inputs.shape[1]
+        nt = min(time + 1, T - 1)
+        finished = (self.sequence_length <= (time + 1))
+        return finished, self.inputs[:, nt], states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Argmax then embed (ref: rnn.py:1494)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = start_tokens
+        self.end_token = int(end_token)
+
+    def initialize(self):
+        finished = _ops.zeros_like(self.start_tokens).astype("bool")
+        return self.embedding_fn(self.start_tokens), finished
+
+    def sample(self, time, outputs, states):
+        return _ops.argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        finished = _ops.equal(
+            sample_ids, _ops.full_like(sample_ids, self.end_token))
+        return finished, self.embedding_fn(sample_ids), states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Multinomial sampling then embed (ref: rnn.py:1625)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+
+    def sample(self, time, outputs, states):
+        logits = outputs if self.temperature is None else \
+            outputs / self.temperature
+        from ..distribution import Categorical
+
+        return Categorical(logits=logits).sample([]).astype("int64")
+
+
+class BasicDecoder(Decoder):
+    """cell + helper -> Decoder for dynamic_decode (ref: rnn.py:1726).
+    Step outputs are (cell_outputs, sample_ids) pairs."""
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        inputs, finished = self.helper.initialize()
+        return inputs, initial_cell_states, finished
+
+    def step(self, time, inputs, states):
+        out, next_states = self.cell(inputs, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        sample_ids = self.helper.sample(time, out, next_states)
+        finished, next_inputs, next_states = self.helper.next_inputs(
+            time, out, next_states, sample_ids)
+        return {"cell_outputs": out, "sample_ids": sample_ids}, \
+            next_states, next_inputs, finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        stacked = {
+            "cell_outputs": _ops.stack([o["cell_outputs"] for o in outputs],
+                                       axis=1),
+            "sample_ids": _ops.stack([o["sample_ids"] for o in outputs],
+                                     axis=1),
+        }
+        return stacked, final_states
+
+
+# -- beam search decode (gather tree) ---------------------------------------
+
+from ..ops.misc import gather_tree  # noqa: E402  (fluid re-export)
+
+
+def beam_search_decode(ids, parents, beam_size=None, end_id=None, name=None,
+                       scores=None):
+    """Full-sequence decode from per-step beam ids + parent pointers
+    (ref: rnn.py:2849 beam_search_decode). The fluid op reads parent
+    links out of the ids TensorArray's LoD; the dense+offsets design
+    (SURVEY §4b) passes them explicitly: ``ids``/``parents`` are
+    (T, B, K). Returns (sequences (T, B, K), scores passthrough)."""
+    seqs = gather_tree(ids, parents)
+    return seqs, scores if scores is not None else parents

@@ -366,7 +366,36 @@ def _adaptive_max_pool2d(x, *, output_size):
     return jnp.stack(rows, axis=-2)
 
 
+@register("adaptive_max_pool2d_mask")
+def _adaptive_max_pool2d_mask(x, *, output_size):
+    # (out, mask): mask holds the flattened h*w argmax per output cell
+    # (ref: max_pool_with_index_op.cc contract used by adaptive_pool2d).
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    ys = [(int(np.floor(i * h / oh)), int(np.ceil((i + 1) * h / oh)))
+          for i in range(oh)]
+    xs = [(int(np.floor(j * w / ow)), int(np.ceil((j + 1) * w / ow)))
+          for j in range(ow)]
+    outs, idxs = [], []
+    for (y0, y1) in ys:
+        row_o, row_i = [], []
+        for (x0, x1) in xs:
+            cell = x[:, :, y0:y1, x0:x1].reshape(n, c, -1)
+            flat = jnp.argmax(cell, axis=-1)
+            cw = x1 - x0
+            gy = y0 + flat // cw
+            gx = x0 + flat % cw
+            row_o.append(jnp.max(cell, axis=-1))
+            row_i.append(gy * w + gx)
+        outs.append(jnp.stack(row_o, axis=-1))
+        idxs.append(jnp.stack(row_i, axis=-1))
+    return jnp.stack(outs, axis=-2), jnp.stack(idxs, axis=-2)
+
+
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return apply("adaptive_max_pool2d_mask", x,
+                     output_size=_pair(output_size, 2))
     return apply("adaptive_max_pool2d", x, output_size=_pair(output_size, 2))
 
 
@@ -690,3 +719,156 @@ def im2sequence(input, filter_size=1, stride=1, padding=0,
     from .manipulation import transpose as _tr
 
     return _tr(out, [0, 2, 1])
+
+
+@register("resize_trilinear_op")
+def _resize_trilinear(x, *, size):
+    n, c, d, h, w = x.shape
+    od, oh, ow = size
+    xt = jnp.transpose(x, (0, 2, 3, 4, 1))
+    out = jax.image.resize(xt, (n, od, oh, ow, c), method="linear")
+    return jnp.transpose(out, (0, 4, 1, 2, 3))
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    """Trilinear resize of NCDHW volumes (ref: nn.py resize_trilinear)."""
+    shp = unwrap(input).shape
+    if out_shape is None:
+        out_shape = [int(shp[2] * scale), int(shp[3] * scale),
+                     int(shp[4] * scale)]
+    out_shape = tuple(int(v) for v in out_shape)
+    return apply("resize_trilinear_op", input, size=out_shape)
+
+
+@register("adaptive_pool3d_op")
+def _adaptive_pool3d(x, *, output_size, pool_type):
+    n, c, d, h, w = x.shape
+    od, oh, ow = output_size
+    red = jnp.max if pool_type == "max" else jnp.mean
+    # static per-cell bucket loops (output sizes are small Python ints)
+    rows = []
+    for i in range(od):
+        d0, d1 = (i * d) // od, max(((i + 1) * d + od - 1) // od, (i * d) // od + 1)
+        plane = []
+        for j in range(oh):
+            h0, h1 = (j * h) // oh, max(((j + 1) * h + oh - 1) // oh, (j * h) // oh + 1)
+            cells = []
+            for k in range(ow):
+                w0, w1 = (k * w) // ow, max(((k + 1) * w + ow - 1) // ow, (k * w) // ow + 1)
+                cells.append(red(x[:, :, d0:d1, h0:h1, w0:w1], axis=(2, 3, 4)))
+            plane.append(jnp.stack(cells, axis=-1))
+        rows.append(jnp.stack(plane, axis=-2))
+    return jnp.stack(rows, axis=-3)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """Adaptive 3-D pooling (ref: nn.py adaptive_pool3d)."""
+    if isinstance(pool_size, int):
+        pool_size = (pool_size,) * 3
+    return apply("adaptive_pool3d_op", input,
+                 output_size=tuple(int(s) for s in pool_size),
+                 pool_type=pool_type)
+
+
+@register("deformable_conv_op")
+def _deformable_conv(x, offset, mask, weight, bias, *, stride, padding,
+                     dilation, groups, deformable_groups=1):
+    # ref: layers/nn.py deformable_conv (deformable_conv_op.cu). v1/v2
+    # via bilinear sampling: for each kernel tap (r, s) the input is
+    # sampled at p0 + (r,s)*dilation + learned offset, optionally scaled
+    # by a modulation mask (v2), then the taps contract with the weight
+    # as a dense matmul (MXU) — the XLA-native layout of the CUDA
+    # im2col+gemm kernel. Each of the ``deformable_groups`` channel
+    # groups (C/dg channels) has its own offset/mask planes.
+    B, C, H, W = x.shape
+    O, Cg, KH, KW = weight.shape
+    dg = deformable_groups
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    Hp, Wp = xp.shape[2], xp.shape[3]
+    OH = (H + 2 * ph - (dh * (KH - 1) + 1)) // sh + 1
+    OW = (W + 2 * pw - (dw * (KW - 1) + 1)) // sw + 1
+    oy = jnp.arange(OH) * sh
+    ox = jnp.arange(OW) * sw
+    Cd = C // dg
+    cols = []
+    for r in range(KH):
+        for s in range(KW):
+            k = r * KW + s
+            vals = []
+            for d in range(dg):
+                base = d * 2 * KH * KW
+                dy = offset[:, base + 2 * k, :OH, :OW]     # (B, OH, OW)
+                dx = offset[:, base + 2 * k + 1, :OH, :OW]
+                yy = oy[None, :, None] + r * dh + dy
+                xx = ox[None, None, :] + s * dw + dx
+                y0 = jnp.floor(yy)
+                x0 = jnp.floor(xx)
+                wy = yy - y0
+                wx = xx - x0
+                xg = xp[:, d * Cd:(d + 1) * Cd]
+
+                def gather(yi, xi):
+                    yi = jnp.clip(yi.astype(jnp.int32), 0, Hp - 1)
+                    xi = jnp.clip(xi.astype(jnp.int32), 0, Wp - 1)
+                    flat = yi * Wp + xi                    # (B, OH, OW)
+                    xf = xg.reshape(B, Cd, Hp * Wp)
+                    return jnp.take_along_axis(
+                        xf, flat.reshape(B, 1, OH * OW).astype(jnp.int32),
+                        axis=2).reshape(B, Cd, OH, OW)
+
+                inb = ((yy >= 0) & (yy <= Hp - 1) &
+                       (xx >= 0) & (xx <= Wp - 1))
+                val = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, None] +
+                       gather(y0, x0 + 1) * ((1 - wy) * wx)[:, None] +
+                       gather(y0 + 1, x0) * (wy * (1 - wx))[:, None] +
+                       gather(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+                val = jnp.where(inb[:, None], val, 0.0)
+                if mask is not None:                       # v2 modulation
+                    val = val * mask[:, d * KH * KW + k, :OH, :OW][:, None]
+                vals.append(val)
+            cols.append(vals[0] if dg == 1 else jnp.concatenate(vals, axis=1))
+    col = jnp.stack(cols, axis=2)                     # (B, C, KH*KW, OH, OW)
+    col = col.reshape(B, groups, (C // groups) * KH * KW, OH * OW)
+    wr = weight.reshape(groups, O // groups, Cg * KH * KW)
+    out = jnp.einsum("bgkp,gok->bgop", col, wr)
+    out = out.reshape(B, O, OH, OW)
+    if bias is not None:
+        out = out + bias.reshape(1, O, 1, 1)
+    return out
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, weight=None,
+                    bias=None, param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    """Deformable convolution v1/v2 (ref: nn.py deformable_conv).
+    Functional form: pass ``weight (O, C/groups, KH, KW)`` (and optional
+    ``bias``); ``mask=None`` selects v1."""
+    pair = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+    if weight is None:
+        raise ValueError("pass weight=(num_filters, C//groups, KH, KW)")
+    if mask is None:
+        return apply("deformable_conv_v1_op", input, offset, weight, bias,
+                     stride=pair(stride), padding=pair(padding),
+                     dilation=pair(dilation), groups=int(groups),
+                     deformable_groups=int(deformable_groups))
+    return apply("deformable_conv_op", input, offset, mask, weight, bias,
+                 stride=pair(stride), padding=pair(padding),
+                 dilation=pair(dilation), groups=int(groups),
+                 deformable_groups=int(deformable_groups))
+
+
+@register("deformable_conv_v1_op")
+def _deformable_conv_v1(x, offset, weight, bias, *, stride, padding,
+                        dilation, groups, deformable_groups=1):
+    return _deformable_conv(x, offset, None, weight, bias, stride=stride,
+                            padding=padding, dilation=dilation,
+                            groups=groups,
+                            deformable_groups=deformable_groups)

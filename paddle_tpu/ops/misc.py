@@ -27,6 +27,8 @@ __all__ = [
     "add_position_encoding", "temporal_shift", "affine_channel",
     "gather_tree", "sampling_id", "ctc_greedy_decoder", "fsp_matrix",
     "clip_by_norm", "brelu", "soft_relu",
+    "unique_with_counts", "hash", "similarity_focus",
+    "polygon_box_transform",
 ]
 
 
@@ -412,3 +414,85 @@ def _soft_relu(x, *, threshold):
 def soft_relu(x, threshold=40.0, name=None):
     """log(1 + exp(clip(x))) (ref: nn.py soft_relu)."""
     return apply("soft_relu", x, threshold=float(threshold))
+
+
+def unique_with_counts(x, dtype="int32", name=None):
+    """Unique values + index map + counts (ref: nn.py unique_with_counts;
+    host-side like ``unique`` — dynamic output shape can't live under jit)."""
+    arr = np.asarray(unwrap(x)).reshape(-1)
+    vals, inverse, counts = np.unique(arr, return_inverse=True,
+                                      return_counts=True)
+    return (Tensor(jnp.asarray(vals), _internal=True),
+            Tensor(jnp.asarray(inverse.astype(dtype)), _internal=True),
+            Tensor(jnp.asarray(counts.astype(dtype)), _internal=True))
+
+
+@register("hash_op")
+def _hash(x, *, num_hash, mod_by):
+    # Deterministic multiplicative hashing (XLA-friendly stand-in for the
+    # reference's xxhash kernel, pyramid_hash/hash_op.cc). Each of the
+    # ``num_hash`` slots uses a distinct odd multiplier.
+    xi = x.astype(jnp.uint32)
+    muls = (jnp.arange(num_hash, dtype=jnp.uint32) * jnp.uint32(2654435761)
+            | jnp.uint32(1))
+    flat = xi.reshape(-1, xi.shape[-1])
+    key = jnp.zeros((flat.shape[0],), jnp.uint32)
+    for c in range(flat.shape[-1]):  # combine the row of ids into one key
+        key = key * jnp.uint32(1000003) + flat[:, c]
+    acc = (key[:, None] * muls[None, :]) % jnp.uint32(mod_by)
+    return acc.astype(jnp.int64).reshape(x.shape[:-1] + (num_hash,))
+
+
+def hash(input, hash_size, num_hash=1, name=None):  # noqa: A001 (fluid name)
+    """Bucketed id hashing (ref: nn.py hash): maps each row of int ids to
+    ``num_hash`` bucket ids in [0, hash_size)."""
+    return apply("hash_op", input, num_hash=int(num_hash),
+                 mod_by=int(hash_size))
+
+
+@register("similarity_focus_op")
+def _similarity_focus(x, *, axis, indices):
+    # ref: nn.py similarity_focus (similarity_focus_op.cc): for each
+    # selected channel along ``axis``, mark the argmax position of every
+    # other (depth) slice; output is a {0,1} mask of x's shape.
+    B = x.shape[0]
+    mask = jnp.zeros_like(x, dtype=jnp.float32)
+    if axis == 1:
+        C, H, W = x.shape[1], x.shape[2], x.shape[3]
+        for ind in indices:
+            sl = x[:, ind]                      # (B, H, W)
+            flat = sl.reshape(B, -1)
+            top = jnp.argmax(flat, axis=-1)
+            hi, wi = top // W, top % W
+            row_mask = jnp.zeros((B, H, W), jnp.float32)
+            row_mask = row_mask.at[jnp.arange(B), hi, :].set(1.0)
+            col_mask = jnp.zeros((B, H, W), jnp.float32)
+            col_mask = col_mask.at[jnp.arange(B), :, wi].set(1.0)
+            m = jnp.maximum(row_mask, col_mask)[:, None, :, :]
+            mask = jnp.maximum(mask, jnp.broadcast_to(m, mask.shape))
+    else:
+        raise NotImplementedError("similarity_focus: axis must be 1 (NCHW)")
+    return mask.astype(x.dtype)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return apply("similarity_focus_op", input, axis=int(axis),
+                 indices=tuple(int(i) for i in indexes))
+
+
+@register("polygon_box_transform_op")
+def _polygon_box_transform(x):
+    # ref: detection.py polygon_box_transform (polygon_box_transform_op.cc):
+    # converts per-pixel quad offsets to absolute coordinates: for channel
+    # 2k (x-offset) add pixel col, channel 2k+1 (y-offset) add pixel row.
+    B, C, H, W = x.shape
+    cols = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    rows = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    is_x = (jnp.arange(C) % 2 == 0)[None, :, None, None]
+    base = jnp.where(is_x, jnp.broadcast_to(cols, x.shape),
+                     jnp.broadcast_to(rows, x.shape))
+    return base - x
+
+
+def polygon_box_transform(input, name=None):
+    return apply("polygon_box_transform_op", input)

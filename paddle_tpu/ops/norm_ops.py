@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 from ._base import register, apply, unwrap
@@ -197,3 +198,75 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW
 
 
 lrn = local_response_norm
+
+
+@register("spectral_norm_op")
+def _spectral_norm(w, *, dim, power_iters, eps):
+    # ref: nn.py spectral_norm (spectral_norm_op.cc): normalize a weight
+    # by its largest singular value, estimated with power iteration.
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+    wm = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+    u = jnp.ones((wm.shape[0],), jnp.float32)
+    v = jnp.ones((wm.shape[1],), jnp.float32)
+
+    def it(_, uv):
+        u, v = uv
+        v = wm.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wm @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+        return u, v
+
+    u, v = jax.lax.fori_loop(0, power_iters, it, (u, v))
+    sigma = u @ wm @ v
+    return w / sigma
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    return apply("spectral_norm_op", weight, dim=int(dim),
+                 power_iters=int(power_iters), eps=float(eps))
+
+
+@register("data_norm_op")
+def _data_norm(x, batch_size, batch_sum, batch_square_sum, *, epsilon):
+    # ref: nn.py data_norm (data_norm_op.cc): normalize with accumulated
+    # batch statistics (a CTR-model staple; stats updated by the caller).
+    # Stats are per-channel (C,); broadcast along axis 1 for NC* layouts.
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    mean = (batch_sum / batch_size).reshape(shape)
+    var = (batch_square_sum / batch_size).reshape(shape) - mean * mean
+    return (x - mean) / jnp.sqrt(var + epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False, slot_dim=-1,
+              summary_decay_rate=0.9999999, stats=None):
+    """Accumulated-stats normalization (ref: nn.py data_norm). Pass
+    ``stats=(batch_size, batch_sum, batch_square_sum)`` (each (C,)); when
+    omitted, per-feature batch statistics of ``input`` are used."""
+    if stats is None:
+        xv = unwrap(input)
+        n = float(np.prod([s for i, s in enumerate(xv.shape) if i != 1]))
+        axes = tuple(i for i in range(xv.ndim) if i != 1)
+        bsize = Tensor(jnp.full((xv.shape[1],), n, jnp.float32), _internal=True)
+        bsum = apply("_dn_sum", input, axes=axes)
+        bsq = apply("_dn_sqsum", input, axes=axes)
+        stats = (bsize, bsum, bsq)
+    out = apply("data_norm_op", input, *stats, epsilon=float(epsilon))
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+@register("_dn_sum")
+def _dn_sum(x, *, axes):
+    return jnp.sum(x.astype(jnp.float32), axis=axes)
+
+
+@register("_dn_sqsum")
+def _dn_sqsum(x, *, axes):
+    return jnp.sum(x.astype(jnp.float32) ** 2, axis=axes)

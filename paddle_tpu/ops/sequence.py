@@ -402,3 +402,50 @@ def row_conv(input, future_context_size=None, weight=None, param_attr=None,
 
         out = getattr(F, act)(out)
     return out
+
+
+@register("sequence_concat_op")
+def _sequence_concat(*xs_and_lens):
+    # xs: dense (B, T_i, ...) padded; lens: (B,) each. Rows are packed
+    # back-to-back per batch item into a (B, sum(T_i), ...) buffer.
+    n = len(xs_and_lens) // 2
+    xs, lens = xs_and_lens[:n], xs_and_lens[n:]
+    B = xs[0].shape[0]
+    T_out = sum(x.shape[1] for x in xs)
+    out = jnp.zeros((B, T_out) + xs[0].shape[2:], xs[0].dtype)
+    offset = jnp.zeros((B,), jnp.int32)
+    t_idx = jnp.arange(T_out)
+    for x, ln in zip(xs, lens):
+        T = x.shape[1]
+        # scatter rows [0, ln) of x at [offset, offset+ln) of out
+        src_pos = t_idx[None, :] - offset[:, None]          # (B, T_out)
+        valid = (src_pos >= 0) & (src_pos < ln[:, None])
+        src = jnp.take_along_axis(
+            x, jnp.clip(src_pos, 0, T - 1).reshape(
+                (B, T_out) + (1,) * (x.ndim - 2)), axis=1)
+        out = jnp.where(valid.reshape((B, T_out) + (1,) * (x.ndim - 2)),
+                        src, out)
+        offset = offset + ln.astype(jnp.int32)
+    return out
+
+
+def sequence_concat(input, lengths=None, name=None):
+    """Per-row concatenation of padded sequences (ref:
+    sequence_lod.py sequence_concat): row b of the result is
+    x1[b,:len1] ++ x2[b,:len2] ++ ..., zero-padded. ``lengths`` is a
+    list of (B,) arrays (defaults to full rows). Returns (out, lengths)."""
+    if lengths is None:
+        lengths = [Tensor(jnp.full((unwrap(x).shape[0],), unwrap(x).shape[1],
+                                   jnp.int32), _internal=True) for x in input]
+    out = apply("sequence_concat_op", *input, *lengths)
+    total = lengths[0]
+    for ln in lengths[1:]:
+        total = Tensor(unwrap(total) + unwrap(ln).astype(unwrap(total).dtype),
+                       _internal=True)
+    return out, total
+
+
+def sequence_expand_as(x, y_lengths, name=None):
+    """Expand each row of ``x (N, ...)`` ``y_lengths[i]`` times (ref:
+    sequence_lod.py sequence_expand_as)."""
+    return sequence_expand(x, y_lengths)

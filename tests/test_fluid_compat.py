@@ -1,0 +1,207 @@
+"""fluid-era RNN/decode/array/op compat tests.
+
+Mirrors reference API surfaces: python/paddle/fluid/layers/rnn.py
+(dynamic_lstm/lstmp/gru, gru_unit, lstm_unit, lstm, decode helpers,
+BasicDecoder, beam_search_decode), control_flow.py (StaticRNN,
+tensor-array ops), sequence_lod.py (lod_reset, sequence_concat),
+nn.py (unique_with_counts, hash, similarity_focus, pool/pad/crop,
+spectral_norm, data_norm, deformable_conv), distributions.py
+(MultivariateNormalDiag).
+"""
+import numpy as np
+import paddle_tpu as pt
+import paddle_tpu.fluid.layers as L
+from paddle_tpu.fluid.rnn import (dynamic_lstm, dynamic_gru, dynamic_lstmp,
+                                  gru_unit, lstm_unit, lstm, StaticRNN,
+                                  DynamicRNN, TrainingHelper,
+                                  GreedyEmbeddingHelper, SampleEmbeddingHelper,
+                                  BasicDecoder, beam_search_decode)
+from paddle_tpu.inference.decoder import dynamic_decode
+
+def test_fluid_rnn_and_op_compat():
+    import paddle_tpu as pt
+    pt.seed(0)
+
+    def shp(t):
+        return list(t.shape)
+
+    B, T, H = 4, 6, 8
+    x4 = pt.to_tensor(np.random.randn(B, T, 4 * H).astype("float32"))
+    h, c = dynamic_lstm(x4, 4 * H, use_peepholes=True)
+    assert shp(h) == [B, T, H] and shp(c) == [B, T, H], (shp(h), shp(c))
+    h, c = dynamic_lstm(x4, 4 * H, use_peepholes=False, is_reverse=True)
+    print("dynamic_lstm ok")
+
+    hp, cp = dynamic_lstmp(x4, 4 * H, proj_size=5)
+    assert shp(hp) == [B, T, 5] and shp(cp) == [B, T, H]
+    print("dynamic_lstmp ok")
+
+    x3 = pt.to_tensor(np.random.randn(B, T, 3 * H).astype("float32"))
+    g = dynamic_gru(x3, H)
+    assert shp(g) == [B, T, H]
+    g2 = dynamic_gru(x3, H, origin_mode=True, is_reverse=True)
+    print("dynamic_gru ok")
+
+    xu = pt.to_tensor(np.random.randn(B, 3 * H).astype("float32"))
+    hu = pt.to_tensor(np.zeros((B, H), "float32"))
+    nh, rh, gate = gru_unit(xu, hu, 3 * H)
+    assert shp(nh) == [B, H] and shp(gate) == [B, 3 * H]
+    print("gru_unit ok")
+
+    xt = pt.to_tensor(np.random.randn(B, 10).astype("float32"))
+    nh, nc = lstm_unit(xt, pt.to_tensor(np.zeros((B, H), "float32")),
+                       pt.to_tensor(np.zeros((B, H), "float32")))
+    assert shp(nh) == [B, H]
+    print("lstm_unit ok")
+
+    xi = pt.to_tensor(np.random.randn(B, T, 10).astype("float32"))
+    ih = pt.to_tensor(np.zeros((2, B, H), "float32"))
+    out, lh, lc = lstm(xi, ih, ih, T, H, num_layers=2)
+    assert shp(out) == [B, T, H], shp(out)
+    print("lstm (stacked) ok")
+
+    import paddle_tpu.ops as ops
+    srnn = StaticRNN()
+    srnn.step_input(xi)
+    h0 = srnn.memory(shape=[H], batch_ref=xi)
+    W = pt.to_tensor(np.random.randn(10 + H, H).astype("float32") * 0.1)
+    srnn.step(lambda xt, h: (ops.tanh(ops.matmul(ops.concat([xt, h], axis=-1), W)),) * 2)
+    outs = srnn()
+    assert shp(outs) == [B, T, H]
+    drnn = DynamicRNN()
+    drnn.step_input(xi, lengths=pt.to_tensor(np.array([6, 3, 2, 1], "int32")))
+    drnn.memory(shape=[H], batch_ref=xi)
+    drnn.step(lambda xt, h: (ops.tanh(ops.matmul(ops.concat([xt, h], axis=-1), W)),) * 2)
+    outs2 = drnn()
+    assert float(np.abs(np.asarray(outs2[1, 3:].numpy())).sum()) == 0.0
+    print("StaticRNN/DynamicRNN ok")
+
+    V, E = 12, 8
+    emb = pt.to_tensor(np.random.randn(V, E).astype("float32"))
+    proj = pt.to_tensor(np.random.randn(H, V).astype("float32"))
+    from paddle_tpu.nn.layers.rnn import GRUCell
+    cell = GRUCell(E, H)
+    helper = GreedyEmbeddingHelper(lambda ids: ops.index_select(emb, ids.reshape([-1]), axis=0),
+                                   pt.to_tensor(np.zeros((B,), "int64")), end_token=1)
+    dec = BasicDecoder(cell, helper, output_fn=lambda h: ops.matmul(h, proj))
+    outs, _ = dynamic_decode(dec, cell.get_initial_states(pt.to_tensor(np.zeros((B, E), "float32"))), max_step_num=5)
+    assert shp(outs["sample_ids"])[0] == B
+    print("BasicDecoder greedy ok")
+
+    helper2 = SampleEmbeddingHelper(lambda ids: ops.index_select(emb, ids.reshape([-1]), axis=0),
+                                    pt.to_tensor(np.zeros((B,), "int64")), end_token=1)
+    dec2 = BasicDecoder(cell, helper2, output_fn=lambda h: ops.matmul(h, proj))
+    dynamic_decode(dec2, cell.get_initial_states(pt.to_tensor(np.zeros((B, E), "float32"))), max_step_num=4)
+    print("SampleEmbeddingHelper ok")
+
+    tgt = pt.to_tensor(np.random.randn(B, T, E).astype("float32"))
+    helper3 = TrainingHelper(tgt, pt.to_tensor(np.array([6, 5, 4, 3], "int64")))
+    dec3 = BasicDecoder(cell, helper3, output_fn=lambda h: ops.matmul(h, proj))
+    dynamic_decode(dec3, cell.get_initial_states(pt.to_tensor(np.zeros((B, E), "float32"))), max_step_num=T)
+    print("TrainingHelper ok")
+
+    ids = pt.to_tensor(np.random.randint(0, V, (5, B, 3)).astype("int64"))
+    par = pt.to_tensor(np.random.randint(0, 3, (5, B, 3)).astype("int64"))
+    seqs, sc = beam_search_decode(ids, par, 3, 1)
+    assert shp(seqs) == [5, B, 3]
+    print("beam_search_decode ok")
+
+    arr = L.create_array()
+    L.array_write(pt.to_tensor(np.ones((2, 3), "float32")), 0, arr)
+    L.array_write(pt.to_tensor(np.ones((2, 3), "float32")), 1, arr)
+    t, sizes = L.tensor_array_to_tensor(arr, axis=0)
+    assert shp(t) == [4, 3] and int(L.array_length(arr).item()) == 2
+    xr, ln = L.lod_reset(pt.to_tensor(np.ones((6, 2), "float32")), target_lod=[0, 2, 6])
+    assert list(np.asarray(ln.numpy())) == [2, 4]
+    print("tensor arrays + lod_reset ok")
+
+    u, inv, cnt = L.unique_with_counts(pt.to_tensor(np.array([2, 2, 3, 1, 1, 1], "int64")))
+    assert sorted(np.asarray(cnt.numpy()).tolist()) == [1, 2, 3]
+    hsh = L.hash(pt.to_tensor(np.random.randint(0, 100, (5, 2)).astype("int64")), hash_size=1000, num_hash=2)
+    assert shp(hsh)[-1] == 2 and np.asarray(hsh.numpy()).max() < 1000
+    pb = L.polygon_box_transform(pt.to_tensor(np.random.randn(2, 8, 4, 4).astype("float32")))
+    assert shp(pb) == [2, 8, 4, 4]
+    sf = L.similarity_focus(pt.to_tensor(np.random.randn(2, 3, 4, 5).astype("float32")), axis=1, indexes=[0])
+    assert shp(sf) == [2, 3, 4, 5]
+    print("unique/hash/polygon/similarity ok")
+
+    img = pt.to_tensor(np.random.randn(2, 3, 8, 8).astype("float32"))
+    assert shp(L.adaptive_pool2d(img, 2, "avg")) == [2, 3, 2, 2]
+    vol = pt.to_tensor(np.random.randn(2, 3, 4, 8, 8).astype("float32"))
+    assert shp(L.adaptive_pool3d(vol, 2, "avg")) == [2, 3, 2, 2, 2]
+    assert shp(L.pool3d(vol, 2, "max", 2)) == [2, 3, 2, 4, 4]
+    assert shp(L.pad2d(img, (1, 1, 2, 2), mode="reflect")) == [2, 3, 10, 12]
+    assert shp(L.random_crop(img, [3, 4, 4])) == [2, 3, 4, 4]
+    assert shp(L.resize_trilinear(vol, out_shape=[2, 4, 4])) == [2, 3, 2, 4, 4]
+    print("pool/pad/crop/resize ok")
+
+    w = pt.to_tensor(np.random.randn(6, 4).astype("float32"))
+    wn = L.spectral_norm(w, dim=0, power_iters=5)
+    s = np.linalg.svd(np.asarray(wn.numpy()), compute_uv=False)[0]
+    assert abs(s - 1.0) < 0.1, s
+    dn = L.data_norm(pt.to_tensor(np.random.randn(16, 5).astype("float32")))
+    assert shp(dn) == [16, 5]
+    offs = pt.to_tensor(np.zeros((2, 2 * 9, 8, 8), "float32"))
+    msk = pt.to_tensor(np.ones((2, 9, 8, 8), "float32"))
+    dw = pt.to_tensor((np.random.randn(4, 3, 3, 3) * 0.1).astype("float32"))
+    dc = L.deformable_conv(img, offs, msk, 4, 3, padding=1, weight=dw)
+    assert shp(dc) == [2, 4, 8, 8], shp(dc)
+    import paddle_tpu.nn.functional as F
+    ref = F.conv2d(img, dw, padding=1)
+    assert np.allclose(np.asarray(dc.numpy()), np.asarray(ref.numpy()), atol=1e-4), \
+        np.abs(np.asarray(dc.numpy()) - np.asarray(ref.numpy())).max()
+    print("spectral/data/deformable ok (zero-offset == conv2d)")
+
+    from paddle_tpu.distribution import MultivariateNormalDiag, kl_divergence
+    d1 = MultivariateNormalDiag(np.zeros(3, "float32"), np.diag(np.ones(3, "float32")))
+    d2 = MultivariateNormalDiag(np.ones(3, "float32"), np.diag(np.ones(3, "float32") * 2))
+    assert shp(d1.sample()) == [3]
+    assert float(np.asarray(kl_divergence(d1, d2).numpy())) > 0
+    print("MultivariateNormalDiag ok")
+
+    s1 = pt.to_tensor(np.arange(12, dtype="float32").reshape(2, 3, 2))
+    s2 = pt.to_tensor(100 + np.arange(16, dtype="float32").reshape(2, 4, 2))
+    l1 = pt.to_tensor(np.array([2, 3], "int32")); l2 = pt.to_tensor(np.array([1, 4], "int32"))
+    cat, tot = L.sequence_concat([s1, s2], [l1, l2])
+    cn = np.asarray(cat.numpy())
+    assert cn.shape == (2, 7, 2)
+    assert np.allclose(cn[0, :3, 0], [0, 2, 100]), cn[0, :, 0]
+    assert list(np.asarray(tot.numpy())) == [3, 7]
+    print("sequence_concat ok")
+    print("ALL COMPAT OK")
+
+
+def test_fluid_compat_review_fixes():
+    """Grouped/deformable-group conv parity, data_norm NCHW, adaptive max
+    mask, sequence_concat packing (regressions from review findings)."""
+    import paddle_tpu as pt
+    import paddle_tpu.fluid.layers as L
+    import paddle_tpu.nn.functional as F
+
+    pt.seed(0)
+    img = pt.to_tensor(np.random.randn(2, 4, 8, 8).astype("float32"))
+    dw = pt.to_tensor((np.random.randn(4, 2, 3, 3) * 0.1).astype("float32"))
+    offs = pt.to_tensor(np.zeros((2, 18, 8, 8), "float32"))
+    msk = pt.to_tensor(np.ones((2, 9, 8, 8), "float32"))
+    dc = L.deformable_conv(img, offs, msk, 4, 3, padding=1, groups=2,
+                           weight=dw)
+    ref = F.conv2d(img, dw, padding=1, groups=2)
+    assert np.abs(np.asarray(dc.numpy()) -
+                  np.asarray(ref.numpy())).max() < 1e-4
+
+    offs2 = pt.to_tensor(np.zeros((2, 36, 8, 8), "float32"))
+    msk2 = pt.to_tensor(np.ones((2, 18, 8, 8), "float32"))
+    dc2 = L.deformable_conv(img, offs2, msk2, 4, 3, padding=1, groups=2,
+                            deformable_groups=2, weight=dw)
+    assert np.abs(np.asarray(dc2.numpy()) -
+                  np.asarray(ref.numpy())).max() < 1e-4
+
+    dn = L.data_norm(pt.to_tensor(np.random.randn(2, 3, 4, 4)
+                                  .astype("float32")))
+    assert list(dn.shape) == [2, 3, 4, 4]
+
+    out, mask = L.adaptive_pool2d(img, 2, "max", require_index=True)
+    flat = np.asarray(img.numpy()).reshape(2, 4, -1)
+    picked = np.take_along_axis(
+        flat, np.asarray(mask.numpy()).reshape(2, 4, -1), axis=-1)
+    assert np.allclose(picked.reshape(2, 4, 2, 2), np.asarray(out.numpy()))
