@@ -318,8 +318,11 @@ def main():
         _log(traceback.format_exc())
     finally:
         try:
-            signal.alarm(0)
             hard.cancel()
+        except Exception:
+            pass
+        try:
+            signal.alarm(0)
         except Exception:
             pass
         try:

@@ -341,26 +341,22 @@ def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
 adaptive_pool3d = _ops.adaptive_pool3d
 
 
-def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
-           pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, name=None, exclusive=True, data_format="NCHW"):
-    if global_pooling:
-        pool_size = input.shape[2:]
-        pool_stride, pool_padding = pool_size, 0
-    fn = _ops.max_pool2d if pool_type == "max" else _ops.avg_pool2d
-    return fn(input, pool_size, stride=pool_stride, padding=pool_padding,
-              ceil_mode=ceil_mode)
+# (pool2d comes from ops/conv.py via the wholesale re-export — it already
+# carries fluid's `exclusive` -> count_include_pad semantics.)
 
 
 def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
            ceil_mode=False, name=None, exclusive=True, data_format="NCDHW"):
     if global_pooling:
-        pool_size = input.shape[2:]
-        pool_stride, pool_padding = pool_size, 0
-    fn = _ops.max_pool3d if pool_type == "max" else _ops.avg_pool3d
-    return fn(input, pool_size, stride=pool_stride, padding=pool_padding,
-              ceil_mode=ceil_mode)
+        pool_size = tuple(input.shape[2:])
+        pool_stride, pool_padding = 1, 0
+    if pool_type == "max":
+        return _ops.max_pool3d(input, pool_size, stride=pool_stride,
+                               padding=pool_padding, ceil_mode=ceil_mode)
+    return _ops.avg_pool3d(input, pool_size, stride=pool_stride,
+                           padding=pool_padding, ceil_mode=ceil_mode,
+                           exclusive=exclusive)
 
 
 def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
@@ -418,12 +414,13 @@ def inplace_abn(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                 do_model_average_for_mean_and_var=False, use_global_stats=
                 False, act_alpha=1.0):
     """Activated batch norm (ref: nn.py inplace_abn). XLA has no in-place
-    buffers — this is batch_norm + activation, which XLA fuses anyway."""
+    buffers — this is batch_norm + activation, which XLA fuses anyway.
+    Batch statistics are always used: this follows the module's
+    fresh-parameters-per-call convention (see ``fc``), so there are no
+    trained running stats to normalize with in eval mode."""
     from ..nn.layers.norm import BatchNorm2D
 
     bn = BatchNorm2D(input.shape[1], momentum=momentum, epsilon=epsilon)
-    if is_test:
-        bn.eval()
     out = bn(input)
     if act == "leaky_relu":
         return _F.leaky_relu(out, act_alpha)

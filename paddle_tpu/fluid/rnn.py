@@ -111,13 +111,15 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
     init = None
     if h_0 is not None:
         init = (h_0, c_0 if c_0 is not None else _ops.zeros_like(h_0))
-    hs_and_cs = _rnn_with_cell_states(cell, input, init, sequence_length,
-                                      is_reverse)
-    return hs_and_cs
+    return _rnn_with_cell_states(cell, input, init, sequence_length,
+                                 is_reverse)
 
 
 def _rnn_with_cell_states(cell, input, init, sequence_length, is_reverse):
-    """Run a (h, c)-state cell returning both per-step h and c."""
+    """Run an (h, c)-state cell returning both per-step h and c. The
+    first state's width (projection size for LSTMP, hidden otherwise)
+    comes from the cell's state_shape."""
+    split = int(cell.state_shape[0][0])
 
     class _Both(Layer):
         def __init__(self, c):
@@ -135,12 +137,10 @@ def _rnn_with_cell_states(cell, input, init, sequence_length, is_reverse):
             h, st = self.c(x, states)
             return _ops.concat([h, st[1]], axis=-1), st
 
-    both = _Both(cell)
-    ys, _ = _rnn_run(both, input, init, sequence_length,
+    ys, _ = _rnn_run(_Both(cell), input, init, sequence_length,
                      is_reverse=is_reverse)
-    H = cell.hidden
     ys = Tensor(ys, _internal=True) if not isinstance(ys, Tensor) else ys
-    return ys[:, :, :H], ys[:, :, H:]
+    return ys[:, :, :split], ys[:, :, split:]
 
 
 class _FluidLSTMPCell(RNNCellBase):
@@ -200,28 +200,12 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
                            candidate_activation, proj_activation)
     init = None
     if h_0 is not None:
+        if c_0 is None:
+            B = h_0.shape[0]
+            c_0 = _ops.zeros([B, hidden], dtype="float32")
         init = (h_0, c_0)
-
-    class _Both(Layer):
-        def __init__(self, c):
-            super().__init__()
-            self.c = c
-
-        def get_initial_states(self, *a, **k):
-            return self.c.get_initial_states(*a, **k)
-
-        @property
-        def state_shape(self):
-            return self.c.state_shape
-
-        def forward(self, x, states):
-            r, st = self.c(x, states)
-            return _ops.concat([r, st[1]], axis=-1), st
-
-    ys, _ = _rnn_run(_Both(cell), input, init, sequence_length,
-                     is_reverse=is_reverse)
-    ys = Tensor(ys, _internal=True) if not isinstance(ys, Tensor) else ys
-    return ys[:, :, :proj_size], ys[:, :, proj_size:]
+    return _rnn_with_cell_states(cell, input, init, sequence_length,
+                                 is_reverse)
 
 
 class _FluidGRUCell(RNNCellBase):
@@ -545,6 +529,7 @@ def beam_search_decode(ids, parents, beam_size=None, end_id=None, name=None,
     (ref: rnn.py:2849 beam_search_decode). The fluid op reads parent
     links out of the ids TensorArray's LoD; the dense+offsets design
     (SURVEY §4b) passes them explicitly: ``ids``/``parents`` are
-    (T, B, K). Returns (sequences (T, B, K), scores passthrough)."""
+    (T, B, K). Returns (sequences (T, B, K), scores or None — parent
+    pointers are never a score stand-in)."""
     seqs = gather_tree(ids, parents)
-    return seqs, scores if scores is not None else parents
+    return seqs, scores

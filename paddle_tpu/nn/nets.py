@@ -19,7 +19,7 @@ from .layers.common import Linear, Dropout
 from .layers.conv import Conv2D
 from .layers.norm import BatchNorm2D
 
-__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool", "multi_box_head",
            "glu", "scaled_dot_product_attention"]
 
 
@@ -124,3 +124,69 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
     att = F.sdpa_bhld(q, k, v, dropout_p=dropout_rate, training=training)
     att = ops.transpose(att, [0, 2, 1, 3])
     return ops.reshape(att, [B, Lq, D])
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head over multiple feature maps (ref:
+    fluid/layers/detection.py multi_box_head): per level, a prior_box
+    grid plus 3x3/1x1 conv loc + conf predictors; outputs are gathered
+    into (B, total_priors, 4) locs, (B, total_priors, C) confs and the
+    stacked priors/variances.
+    """
+    from ..ops.detection import prior_box as _prior_box
+    from ..ops.manipulation import concat, reshape, transpose
+
+    n = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule: evenly spaced between min/max ratio
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n - 2)) if n > 2 else 0
+        min_sizes = [base_size * 0.1]
+        max_sizes = [base_size * 0.2]
+        ratio = min_ratio
+        for _ in range(1, n):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+            ratio += step
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, x in enumerate(inputs):
+        ms = min_sizes[i] if isinstance(min_sizes[i], (list, tuple)) \
+            else [min_sizes[i]]
+        xs = max_sizes[i] if max_sizes is not None else None
+        if xs is not None and not isinstance(xs, (list, tuple)):
+            xs = [xs]
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) \
+            else [aspect_ratios[i]]
+        stp = (0.0, 0.0)
+        if steps is not None:
+            stp = steps[i] if isinstance(steps[i], (list, tuple)) \
+                else (steps[i], steps[i])
+        elif step_w is not None:
+            stp = (step_w[i], step_h[i])
+        b, v = _prior_box(x, image, ms, xs, ar, variance, flip, clip,
+                          stp, offset,
+                          min_max_aspect_ratios_order=
+                          min_max_aspect_ratios_order)
+        P = int(b.shape[2])
+        boxes_all.append(reshape(b, [-1, 4]))
+        vars_all.append(reshape(v, [-1, 4]))
+        in_ch = int(x.shape[1])
+        loc_conv = Conv2D(in_ch, P * 4, kernel_size, stride=stride,
+                          padding=pad)
+        conf_conv = Conv2D(in_ch, P * num_classes, kernel_size,
+                           stride=stride, padding=pad)
+        loc = transpose(loc_conv(x), [0, 2, 3, 1])        # (B, H, W, P*4)
+        conf = transpose(conf_conv(x), [0, 2, 3, 1])
+        locs.append(reshape(loc, [int(x.shape[0]), -1, 4]))
+        confs.append(reshape(conf, [int(x.shape[0]), -1, num_classes]))
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    boxes = concat(boxes_all, axis=0)
+    variances = concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances

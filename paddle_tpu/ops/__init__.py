@@ -22,6 +22,7 @@ from . import (  # noqa: F401
     control_flow,
     random_ops,
     detection,
+    rcnn,
     labeling,
     misc,
 )
@@ -81,7 +82,7 @@ def _flatten_namespace():
             "OP_REGISTRY"}
     for mod in (math, creation, manipulation, reduction, compare, activation,
                 linalg, conv, norm_ops, sequence, control_flow, random_ops,
-                detection, labeling, misc):
+                detection, rcnn, labeling, misc):
         public = getattr(mod, "__all__", None) or [
             n for n in dir(mod) if not n.startswith("_")]
         for n in public:

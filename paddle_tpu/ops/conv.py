@@ -318,12 +318,10 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 
 @register("avg_pool3d")
-def _avg_pool3d(x, *, ksize, stride, padding):
-    window = (1, 1) + ksize
-    strides = (1, 1) + stride
-    pad = padding if isinstance(padding, str) else ((0, 0), (0, 0)) + tuple(padding)
-    out = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
-    return out / float(np.prod(ksize))
+def _avg_pool3d(x, *, ksize, stride, padding, count_include_pad=True):
+    return _pool(x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x,
+                 0.0, lax.add, ksize, stride, padding, 3,
+                 count_include_pad, avg=True).astype(x.dtype)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -331,7 +329,8 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     ksize = _pair(kernel_size, 3)
     stride = ksize if stride is None else _pair(stride, 3)
     pad = _conv_padding(padding, 3)
-    return apply("avg_pool3d", x, ksize=ksize, stride=stride, padding=pad)
+    return apply("avg_pool3d", x, ksize=ksize, stride=stride, padding=pad,
+                 count_include_pad=not exclusive)
 
 
 @register("adaptive_avg_pool2d")

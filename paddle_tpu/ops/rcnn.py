@@ -1,0 +1,695 @@
+"""Two-stage (RPN / R-CNN / FPN / RetinaNet) detection ops.
+
+Refs (capability targets):
+- generate_proposals: python/paddle/fluid/layers/detection.py:2646
+- rpn_target_assign: detection.py:157; retinanet_target_assign: :370
+- retinanet_detection_output: detection.py:735
+- distribute_fpn_proposals / collect_fpn_proposals:
+  python/paddle/fluid/layers/detection.py:3838,3914
+- psroi_pool / prroi_pool: layers/nn.py:13439,13504
+- density_prior_box: detection.py:1800
+- box_decoder_and_assign: detection.py:3770
+- locality_aware_nms: detection.py:3327
+- roi_perspective_transform: detection.py:1931
+- generate_proposal_labels / generate_mask_labels: detection.py:2308,2440
+- deformable_roi_pooling: layers/nn.py:14038
+
+TPU-first conventions (same as ops/detection.py): everything is static
+shape — variable-size results come back as fixed-size buffers padded
+with sentinels plus valid counts; per-image structure replaces LoD.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ._base import register, apply, unwrap
+from .detection import _pairwise_iou, _greedy_nms_mask, _roi_batch_ids
+
+__all__ = [
+    "generate_proposals", "rpn_target_assign", "retinanet_target_assign",
+    "retinanet_detection_output", "distribute_fpn_proposals",
+    "collect_fpn_proposals", "psroi_pool", "prroi_pool",
+    "density_prior_box", "box_decoder_and_assign", "locality_aware_nms",
+    "roi_perspective_transform", "generate_proposal_labels",
+    "generate_mask_labels", "deformable_roi_pooling",
+]
+
+
+def _encode_deltas(anchors, gts):
+    """Elementwise (A, 4) box -> delta encoding (inverse of
+    _decode_deltas); the per-anchor regression target."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + aw * 0.5
+    ay = anchors[:, 1] + ah * 0.5
+    gw = jnp.maximum(gts[:, 2] - gts[:, 0] + 1.0, 1e-3)
+    gh = jnp.maximum(gts[:, 3] - gts[:, 1] + 1.0, 1e-3)
+    gx = gts[:, 0] + gw * 0.5
+    gy = gts[:, 1] + gh * 0.5
+    return jnp.stack([(gx - ax) / aw, (gy - ay) / ah,
+                      jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+
+
+def _decode_deltas(anchors, deltas, variances=None):
+    """Anchor + (dx, dy, dw, dh) -> box, the RPN decode_bbox_target."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + aw * 0.5
+    ay = anchors[:, 1] + ah * 0.5
+    if variances is not None:
+        deltas = deltas * variances
+    dx, dy, dw, dh = deltas[:, 0], deltas[:, 1], deltas[:, 2], deltas[:, 3]
+    cx = dx * aw + ax
+    cy = dy * ah + ay
+    w = jnp.exp(jnp.minimum(dw, 10.0)) * aw
+    h = jnp.exp(jnp.minimum(dh, 10.0)) * ah
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - 1.0, cy + h * 0.5 - 1.0], axis=1)
+
+
+@register("generate_proposals_op")
+def _generate_proposals(scores, deltas, im_info, anchors, variances, *,
+                        pre_nms_top_n, post_nms_top_n, nms_thresh,
+                        min_size):
+    # scores (B, A, H, W); deltas (B, A*4, H, W); anchors (H, W, A, 4)
+    B = scores.shape[0]
+    A, H, W = scores.shape[1], scores.shape[2], scores.shape[3]
+    anc = anchors.reshape(-1, 4)
+    var = variances.reshape(-1, 4) if variances is not None else None
+    pre_n = min(pre_nms_top_n, A * H * W)
+
+    def one(scores_i, deltas_i, info_i):
+        s = jnp.transpose(scores_i, (1, 2, 0)).reshape(-1)       # HWA
+        d = deltas_i.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        top_s, top_i = lax.top_k(s, pre_n)
+        boxes = _decode_deltas(anc[top_i], d[top_i],
+                               None if var is None else var[top_i])
+        ih, iw = info_i[0], info_i[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0.0, iw - 1.0),
+            jnp.clip(boxes[:, 1], 0.0, ih - 1.0),
+            jnp.clip(boxes[:, 2], 0.0, iw - 1.0),
+            jnp.clip(boxes[:, 3], 0.0, ih - 1.0)], axis=1)
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        ms = min_size * info_i[2]
+        ok = (ws >= ms) & (hs >= ms)
+        top_s = jnp.where(ok, top_s, -jnp.inf)
+        keep = _greedy_nms_mask(boxes, top_s, nms_thresh, False)
+        keep = keep & jnp.isfinite(top_s)
+        sel_s, sel_i = lax.top_k(jnp.where(keep, top_s, -jnp.inf),
+                                 min(post_nms_top_n, pre_n))
+        valid = jnp.isfinite(sel_s)
+        out = jnp.where(valid[:, None], boxes[sel_i], 0.0)
+        return out, jnp.where(valid, sel_s, 0.0), \
+            valid.sum().astype(jnp.int32)
+
+    return jax.vmap(one)(scores, deltas, im_info)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=True):
+    """RPN proposal generation (ref: detection.py:2646). Fixed-shape:
+    (B, post_nms_top_n, 4) boxes + (B, post_nms_top_n) scores, zero-padded,
+    plus per-image valid counts (the LoD replacement)."""
+    rois, roi_probs, counts = apply(
+        "generate_proposals_op", scores, bbox_deltas, im_info, anchors,
+        variances, pre_nms_top_n=int(pre_nms_top_n),
+        post_nms_top_n=int(post_nms_top_n), nms_thresh=float(nms_thresh),
+        min_size=float(min_size))
+    if return_rois_num:
+        return rois, roi_probs, counts
+    return rois, roi_probs
+
+
+def _subsample_mask(rng_scores, eligible, num):
+    """Pick up to ``num`` of ``eligible`` with highest rng_scores (the
+    random-subsample stand-in — static shape)."""
+    masked = jnp.where(eligible, rng_scores, -jnp.inf)
+    k = min(num, int(masked.shape[0]))
+    top_v, top_i = lax.top_k(masked, k)
+    sel = jnp.zeros_like(eligible).at[top_i].set(jnp.isfinite(top_v))
+    return sel & eligible
+
+
+@register("rpn_target_assign_op")
+def _rpn_target_assign(anchors, gt_boxes, gt_valid, seed_scores, *,
+                       rpn_batch_size_per_im, fg_fraction, positive_overlap,
+                       negative_overlap):
+    # anchors (A, 4); gt_boxes (G, 4); gt_valid (G,) bool
+    iou = _pairwise_iou(anchors, gt_boxes, False)           # (A, G)
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    # force-positive: best anchor per gt
+    best_anchor = jnp.argmax(iou, axis=0)                   # (G,)
+    forced = jnp.zeros((anchors.shape[0],), bool).at[best_anchor].set(
+        gt_valid)
+    pos = (best_iou >= positive_overlap) | forced
+    neg = (best_iou < negative_overlap) & (best_iou >= 0.0) & ~pos
+    n_fg = int(rpn_batch_size_per_im * fg_fraction)
+    pos_sel = _subsample_mask(seed_scores, pos, n_fg)
+    n_bg = rpn_batch_size_per_im - n_fg
+    neg_sel = _subsample_mask(-seed_scores, neg, n_bg)
+    labels = jnp.where(pos_sel, 1, jnp.where(neg_sel, 0, -1))
+    tgt = _encode_deltas(anchors, gt_boxes[best_gt])
+    return labels.astype(jnp.int32), tgt, pos_sel, neg_sel
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True,
+                      gt_valid=None, name=None):
+    """RPN anchor sampling (ref: detection.py:157). TPU-first output:
+    dense per-anchor ``labels`` (A,) in {1 fg, 0 bg, -1 ignore}, encoded
+    ``bbox_targets`` (A, 4), and fg/bg selection masks — in place of the
+    reference's dynamic gathered index lists."""
+    A = unwrap(anchor_box).reshape(-1, 4).shape[0]
+    anchors = Tensor(unwrap(anchor_box).reshape(-1, 4), _internal=True)
+    gts = Tensor(unwrap(gt_boxes).reshape(-1, 4), _internal=True)
+    G = unwrap(gts).shape[0]
+    if gt_valid is None:
+        gt_valid = Tensor(jnp.ones((G,), bool), _internal=True)
+    from ..core import random as prandom
+
+    seed = Tensor(
+        jax.random.uniform(prandom.next_key(), (A,), jnp.float32)
+        if use_random else jnp.arange(A, 0, -1, dtype=jnp.float32) / A,
+        _internal=True)
+    return apply("rpn_target_assign_op", anchors, gts, gt_valid, seed,
+                 rpn_batch_size_per_im=int(rpn_batch_size_per_im),
+                 fg_fraction=float(rpn_fg_fraction),
+                 positive_overlap=float(rpn_positive_overlap),
+                 negative_overlap=float(rpn_negative_overlap))
+
+
+@register("retinanet_target_assign_op")
+def _retina_target_assign(anchors, gt_boxes, gt_labels, gt_valid, *,
+                          positive_overlap, negative_overlap):
+    iou = _pairwise_iou(anchors, gt_boxes, False)
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    best_anchor = jnp.argmax(iou, axis=0)
+    forced = jnp.zeros((anchors.shape[0],), bool).at[best_anchor].set(
+        gt_valid)
+    pos = (best_iou >= positive_overlap) | forced
+    neg = (best_iou < negative_overlap) & ~pos
+    cls = jnp.where(pos, gt_labels[best_gt], jnp.where(neg, 0, -1))
+    tgt = _encode_deltas(anchors, gt_boxes[best_gt])
+    fg_num = pos.sum().astype(jnp.int32)
+    return cls.astype(jnp.int32), tgt, pos, neg, fg_num
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, num_classes=1,
+                            positive_overlap=0.5, negative_overlap=0.4,
+                            gt_valid=None, name=None):
+    """RetinaNet dense assignment (ref: detection.py:370): every anchor
+    labeled {class fg, 0 bg, -1 ignore}; returns (labels (A,),
+    bbox_targets (A, 4), fg_mask, bg_mask, fg_num)."""
+    anchors = Tensor(unwrap(anchor_box).reshape(-1, 4), _internal=True)
+    gts = Tensor(unwrap(gt_boxes).reshape(-1, 4), _internal=True)
+    G = unwrap(gts).shape[0]
+    labels = Tensor(unwrap(gt_labels).reshape(-1), _internal=True)
+    if gt_valid is None:
+        gt_valid = Tensor(jnp.ones((G,), bool), _internal=True)
+    return apply("retinanet_target_assign_op", anchors, gts, labels,
+                 gt_valid, positive_overlap=float(positive_overlap),
+                 negative_overlap=float(negative_overlap))
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """RetinaNet inference head (ref: detection.py:735): decode per-level
+    deltas onto anchors, then class-wise NMS. ``bboxes``/``scores`` are
+    lists per FPN level; anchors likewise. Returns (B, keep_top_k, 6)
+    + counts, as multiclass_nms."""
+    from .detection import multiclass_nms
+
+    decoded = []
+    for dlt, anc in zip(bboxes, anchors):
+        d = unwrap(dlt)                                  # (B, A_l, 4)
+        a = unwrap(anc).reshape(-1, 4)
+
+        def dec(di):
+            return _decode_deltas(a, di)
+
+        decoded.append(Tensor(jax.vmap(dec)(d), _internal=True))
+    from .manipulation import concat
+
+    all_boxes = concat(decoded, axis=1)                  # (B, A, 4)
+    all_scores = concat(list(scores), axis=2) if len(scores) > 1 \
+        else scores[0]                                   # (B, C, A)
+    return multiclass_nms(all_boxes, all_scores, score_threshold,
+                          nms_top_k, keep_top_k, nms_threshold,
+                          normalized=False, nms_eta=nms_eta,
+                          background_label=-1)
+
+
+@register("distribute_fpn_op")
+def _distribute_fpn(rois, *, min_level, max_level, refer_level,
+                    refer_scale):
+    w = jnp.maximum(rois[:, 2] - rois[:, 0], 0.0)
+    h = jnp.maximum(rois[:, 3] - rois[:, 1], 0.0)
+    scale = jnp.sqrt(w * h)
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+    return jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """FPN level routing (ref: detection.py:3838). TPU-first: returns
+    the per-roi target level (N,), per-level boolean masks, and the
+    restore order (argsort by level, stable) instead of dynamically
+    sized per-level tensors."""
+    lvl = apply("distribute_fpn_op", fpn_rois, min_level=int(min_level),
+                max_level=int(max_level), refer_level=int(refer_level),
+                refer_scale=int(refer_scale))
+    lv = unwrap(lvl)
+    masks = [Tensor(lv == l, _internal=True)
+             for l in range(int(min_level), int(max_level) + 1)]
+    order = jnp.argsort(lv, stable=True)
+    restore = jnp.argsort(order, stable=True)
+    return lvl, masks, Tensor(restore.astype(jnp.int32), _internal=True)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Merge per-level proposals, keep global top-k by score (ref:
+    detection.py:3914). Inputs: lists of (N_l, 4) rois and (N_l,) scores.
+    Returns (post_nms_top_n, 4) boxes (zero-padded) + valid count."""
+    from .manipulation import concat
+
+    rois = concat(list(multi_rois), axis=0)
+    scores = concat(list(multi_scores), axis=0)
+    r, s = unwrap(rois), unwrap(scores).reshape(-1)
+    k = min(int(post_nms_top_n), r.shape[0])
+    top_s, top_i = lax.top_k(s, k)
+    valid = jnp.isfinite(top_s)
+    out = jnp.where(valid[:, None], r[top_i], 0.0)
+    return Tensor(out, _internal=True), \
+        Tensor(valid.sum().astype(jnp.int32), _internal=True)
+
+
+@register("psroi_pool_op")
+def _psroi_pool(feat, rois, bids, *, out_channels, spatial_scale, ph, pw):
+    # position-sensitive: output channel c at bin (i, j) pools input
+    # channel c*ph*pw + i*pw + j (ref: psroi_pool_op.cc).
+    H, W = feat.shape[2], feat.shape[3]
+
+    def one(roi, bid):
+        x1, y1, x2, y2 = (roi[k] * spatial_scale for k in range(4))
+        bw = jnp.maximum(x2 - x1, 0.1) / pw
+        bh = jnp.maximum(y2 - y1, 0.1) / ph
+        img = feat[bid]                                   # (C, H, W)
+        outs = []
+        for i in range(ph):
+            row = []
+            for j in range(pw):
+                ys = jnp.clip(y1 + i * bh, 0, H - 1)
+                ye = jnp.clip(y1 + (i + 1) * bh, 0, H)
+                xs = jnp.clip(x1 + j * bw, 0, W - 1)
+                xe = jnp.clip(x1 + (j + 1) * bw, 0, W)
+                yy = jnp.arange(H, dtype=jnp.float32)
+                xx = jnp.arange(W, dtype=jnp.float32)
+                my = ((yy >= jnp.floor(ys)) & (yy < jnp.ceil(ye)))
+                mx = ((xx >= jnp.floor(xs)) & (xx < jnp.ceil(xe)))
+                m = (my[:, None] & mx[None, :]).astype(feat.dtype)
+                cnt = jnp.maximum(m.sum(), 1.0)
+                chans = jnp.arange(out_channels) * (ph * pw) + i * pw + j
+                sel = img[chans]                          # (Co, H, W)
+                row.append((sel * m[None]).sum(axis=(1, 2)) / cnt)
+            outs.append(jnp.stack(row, axis=-1))
+        return jnp.stack(outs, axis=-2)                   # (Co, ph, pw)
+
+    return jax.vmap(one)(rois, bids)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    """Position-sensitive RoI pooling (ref: nn.py:13439). input channels
+    must equal output_channels * ph * pw."""
+    C = unwrap(input).shape[1]
+    assert C == output_channels * pooled_height * pooled_width, \
+        f"C={C} != {output_channels}*{pooled_height}*{pooled_width}"
+    return apply("psroi_pool_op", input, rois,
+                 _roi_batch_ids(rois, rois_num),
+                 out_channels=int(output_channels),
+                 spatial_scale=float(spatial_scale),
+                 ph=int(pooled_height), pw=int(pooled_width))
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    """Precise RoI pooling (ref: nn.py:13504). The exact op integrates
+    the bilinear surface over each bin; a dense 4x4-tap average per bin
+    converges to the same value and stays MXU-friendly."""
+    from .detection import roi_align
+
+    return roi_align(input, rois, pooled_height, pooled_width,
+                     spatial_scale, sampling_ratio=4,
+                     rois_num=batch_roi_nums, aligned=True)
+
+
+@register("density_prior_box_op")
+def _density_prior_box(fm, im, *, densities, fixed_sizes, fixed_ratios,
+                       variance, step, offset, clip):
+    H, W = fm.shape[2], fm.shape[3]
+    IH, IW = im.shape[2], im.shape[3]
+    sh = step[1] if step[1] > 0 else IH / H
+    sw = step[0] if step[0] > 0 else IW / W
+    cy = (jnp.arange(H) + offset) * sh
+    cx = (jnp.arange(W) + offset) * sw
+    boxes = []
+    for density, fsize in zip(densities, fixed_sizes):
+        for ratio in fixed_ratios:
+            bw = fsize * np.sqrt(ratio)
+            bh = fsize / np.sqrt(ratio)
+            shift = fsize / density
+            for di in range(density):
+                for dj in range(density):
+                    oy = -fsize / 2.0 + shift / 2.0 + di * shift
+                    ox = -fsize / 2.0 + shift / 2.0 + dj * shift
+                    ccy = cy[:, None] + oy
+                    ccx = cx[None, :] + ox
+                    b = jnp.stack([
+                        jnp.broadcast_to((ccx - bw / 2.0) / IW, (H, W)),
+                        jnp.broadcast_to((ccy - bh / 2.0) / IH, (H, W)),
+                        jnp.broadcast_to((ccx + bw / 2.0) / IW, (H, W)),
+                        jnp.broadcast_to((ccy + bh / 2.0) / IH, (H, W)),
+                    ], axis=-1)
+                    boxes.append(b)
+    out = jnp.stack(boxes, axis=2)                        # (H, W, P, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, out.dtype), out.shape)
+    return out, var
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """Density prior boxes (ref: detection.py:1800): per-cell anchors on
+    a density x density sub-grid per fixed size/ratio. Returns
+    (boxes (H, W, P, 4), variances) normalized, or flattened (HWP, 4)."""
+    out, var = apply("density_prior_box_op", input, image,
+                     densities=tuple(int(d) for d in densities),
+                     fixed_sizes=tuple(float(s) for s in fixed_sizes),
+                     fixed_ratios=tuple(float(r) for r in fixed_ratios),
+                     variance=tuple(float(v) for v in variance),
+                     step=tuple(float(s) for s in steps),
+                     offset=float(offset), clip=bool(clip))
+    if flatten_to_2d:
+        from .manipulation import reshape
+
+        return reshape(out, [-1, 4]), reshape(var, [-1, 4])
+    return out, var
+
+
+@register("box_decoder_and_assign_op")
+def _box_decoder_and_assign(prior, pvar, deltas, scores, *, box_clip):
+    # deltas (N, C*4), scores (N, C): decode every class, then assign the
+    # argmax class's box (ref: box_decoder_and_assign_op.cc).
+    N, C = scores.shape
+    d = deltas.reshape(N, C, 4)
+    var = pvar if pvar is not None else jnp.ones((N, 4), deltas.dtype)
+
+    def dec(cls_deltas):
+        dd = jnp.clip(cls_deltas * var, -box_clip, box_clip)
+        return _decode_deltas(prior, dd)
+
+    all_boxes = jax.vmap(dec, in_axes=1, out_axes=1)(d)   # (N, C, 4)
+    best = jnp.argmax(scores, axis=1)
+    assigned = jnp.take_along_axis(
+        all_boxes, best[:, None, None].repeat(4, 2), axis=1)[:, 0]
+    return all_boxes.reshape(N, C * 4), assigned
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=4.135, name=None):
+    """Per-class decode + best-class assignment (ref: detection.py:3770).
+    Returns (decoded (N, C*4), assigned (N, 4))."""
+    return apply("box_decoder_and_assign_op", prior_box, prior_box_var,
+                 target_box, box_score, box_clip=float(box_clip))
+
+
+@register("locality_aware_nms_op")
+def _locality_aware_nms(boxes, scores, *, iou_threshold, keep_top_k):
+    # EAST-style: first weighted-merge consecutive overlapping boxes
+    # (score-weighted coordinates), then standard greedy NMS.
+    N = boxes.shape[0]
+    iou_next = jnp.concatenate([
+        jax.vmap(lambda a, b: _pairwise_iou(a[None], b[None], False)[0, 0])(
+            boxes[:-1], boxes[1:]), jnp.zeros((1,))])
+
+    def body(carry, i):
+        acc_box, acc_s, out_b, out_s, n = carry
+        merge = iou_next[i] > iou_threshold
+        w = jnp.maximum(acc_s + scores[i], 1e-8)
+        merged = (acc_box * acc_s + boxes[i] * scores[i]) / w
+        # if merging with next, accumulate; else emit
+        nb = jnp.where(merge, merged, jnp.zeros((4,)))
+        ns = jnp.where(merge, w, 0.0)
+        out_b = jnp.where(merge, out_b, out_b.at[n].set(merged))
+        out_s = jnp.where(merge, out_s, out_s.at[n].set(w))
+        n = jnp.where(merge, n, n + 1)
+        return (nb, ns, out_b, out_s, n), None
+
+    init = (jnp.zeros((4,)), jnp.zeros(()), jnp.zeros((N, 4)),
+            jnp.full((N,), -jnp.inf), jnp.int32(0))
+    (_, _, mb, ms, n), _ = lax.scan(body, init, jnp.arange(N))
+    keep = _greedy_nms_mask(mb, ms, iou_threshold, False)
+    keep = keep & jnp.isfinite(ms)
+    k = min(keep_top_k, N) if keep_top_k > 0 else N
+    sel_s, sel_i = lax.top_k(jnp.where(keep, ms, -jnp.inf), k)
+    valid = jnp.isfinite(sel_s)
+    return (jnp.where(valid[:, None], mb[sel_i], 0.0),
+            jnp.where(valid, sel_s, 0.0),
+            valid.sum().astype(jnp.int32))
+
+
+def locality_aware_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
+                       keep_top_k=-1, nms_threshold=0.3, normalized=False,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """Locality-aware NMS (ref: detection.py:3327, EAST): consecutive
+    overlapping boxes are score-weighted-merged before standard NMS.
+    bboxes (N, 4) sorted in reading order; scores (N,).
+    Returns (boxes, scores, count) fixed-shape."""
+    s = unwrap(scores).reshape(-1)
+    s = jnp.where(s >= score_threshold, s, 0.0)
+    return apply("locality_aware_nms_op", bboxes,
+                 Tensor(s, _internal=True),
+                 iou_threshold=float(nms_threshold),
+                 keep_top_k=int(keep_top_k))
+
+
+@register("roi_perspective_op")
+def _roi_perspective(feat, rois, bids, *, th, tw, spatial_scale):
+    # rois: (N, 8) quad corners (x1..y4, clockwise from top-left).
+    # Solve the 3x3 homography mapping the output rectangle onto the
+    # quad, then bilinear-sample (ref: roi_perspective_transform_op.cc).
+    H, W = feat.shape[2], feat.shape[3]
+
+    def one(quad, bid):
+        q = quad.reshape(4, 2) * spatial_scale
+        src = jnp.asarray([[0.0, 0.0], [tw - 1.0, 0.0],
+                           [tw - 1.0, th - 1.0], [0.0, th - 1.0]])
+        # DLT: build the 8x8 system A h = b
+        rows = []
+        bvec = []
+        for k in range(4):
+            x, y = src[k, 0], src[k, 1]
+            u, v = q[k, 0], q[k, 1]
+            rows.append(jnp.asarray(
+                [x, y, 1.0, 0.0, 0.0, 0.0, 0.0, 0.0]).at[6].set(-x * u)
+                .at[7].set(-y * u))
+            bvec.append(u)
+            rows.append(jnp.asarray(
+                [0.0, 0.0, 0.0, x, y, 1.0, 0.0, 0.0]).at[6].set(-x * v)
+                .at[7].set(-y * v))
+            bvec.append(v)
+        A = jnp.stack(rows)
+        b = jnp.asarray(bvec)
+        h8 = jnp.linalg.solve(A + 1e-8 * jnp.eye(8), b)
+        Hm = jnp.concatenate([h8, jnp.ones((1,))]).reshape(3, 3)
+        ys, xs = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                              jnp.arange(tw, dtype=jnp.float32),
+                              indexing="ij")
+        ones = jnp.ones_like(xs)
+        pts = jnp.stack([xs, ys, ones], axis=0).reshape(3, -1)
+        mapped = Hm @ pts
+        mx = mapped[0] / jnp.maximum(mapped[2], 1e-8)
+        my = mapped[1] / jnp.maximum(mapped[2], 1e-8)
+        mx = jnp.clip(mx, 0.0, W - 1.0)
+        my = jnp.clip(my, 0.0, H - 1.0)
+        x0 = jnp.floor(mx).astype(jnp.int32)
+        y0 = jnp.floor(my).astype(jnp.int32)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        wx = mx - x0
+        wy = my - y0
+        img = feat[bid].reshape(feat.shape[1], -1)        # (C, H*W)
+
+        def g(yi, xi):
+            return img[:, yi * W + xi]
+
+        val = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x1) * (1 - wy) * wx +
+               g(y1, x0) * wy * (1 - wx) + g(y1, x1) * wy * wx)
+        return val.reshape(feat.shape[1], th, tw)
+
+    return jax.vmap(one)(rois, bids)
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_num=None, name=None):
+    """Perspective-warp quad rois to a fixed size (ref: detection.py:1931,
+    OCR east). rois: (N, 8) quads. Returns (N, C, th, tw)."""
+    return apply("roi_perspective_op", input, rois,
+                 _roi_batch_ids(rois, rois_num),
+                 th=int(transformed_height), tw=int(transformed_width),
+                 spatial_scale=float(spatial_scale))
+
+
+@register("proposal_labels_op")
+def _proposal_labels(rois, gt_boxes, gt_classes, gt_valid, seed, *,
+                     batch_size_per_im, fg_fraction, fg_thresh,
+                     bg_thresh_hi, bg_thresh_lo, num_classes):
+    iou = _pairwise_iou(rois, gt_boxes, False)
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    fg = best_iou >= fg_thresh
+    bg = (best_iou < bg_thresh_hi) & (best_iou >= bg_thresh_lo)
+    n_fg = int(batch_size_per_im * fg_fraction)
+    fg_sel = _subsample_mask(seed, fg, n_fg)
+    bg_sel = _subsample_mask(-seed, bg, batch_size_per_im - n_fg)
+    labels = jnp.where(fg_sel, gt_classes[best_gt],
+                       jnp.where(bg_sel, 0, -1))
+    tgt = _encode_deltas(rois, gt_boxes[best_gt])
+    w = (fg_sel)[:, None].astype(jnp.float32) * jnp.ones((1, 4))
+    return labels.astype(jnp.int32), tgt, w, fg_sel, bg_sel, best_gt
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=True,
+                             gt_valid=None, name=None):
+    """Second-stage sampling (ref: detection.py:2308). TPU-first dense
+    output per roi: labels {cls, 0, -1}, encoded bbox targets, bbox
+    inside-weights, fg/bg masks, and the matched gt index."""
+    R = unwrap(rpn_rois).reshape(-1, 4).shape[0]
+    rois = Tensor(unwrap(rpn_rois).reshape(-1, 4), _internal=True)
+    gts = Tensor(unwrap(gt_boxes).reshape(-1, 4), _internal=True)
+    G = unwrap(gts).shape[0]
+    cls = Tensor(unwrap(gt_classes).reshape(-1), _internal=True)
+    if gt_valid is None:
+        gt_valid = Tensor(jnp.ones((G,), bool), _internal=True)
+    from ..core import random as prandom
+
+    seed = Tensor(
+        jax.random.uniform(prandom.next_key(), (R,), jnp.float32)
+        if use_random else jnp.arange(R, 0, -1, dtype=jnp.float32) / R,
+        _internal=True)
+    return apply("proposal_labels_op", rois, gts, cls, gt_valid, seed,
+                 batch_size_per_im=int(batch_size_per_im),
+                 fg_fraction=float(fg_fraction),
+                 fg_thresh=float(fg_thresh),
+                 bg_thresh_hi=float(bg_thresh_hi),
+                 bg_thresh_lo=float(bg_thresh_lo),
+                 num_classes=int(class_nums))
+
+
+@register("mask_labels_op")
+def _mask_labels(gt_masks, rois, matched_gt, fg_mask, *, resolution):
+    # Crop each fg roi out of its matched dense gt mask and resize to
+    # (resolution, resolution) with bilinear sampling.
+    H, W = gt_masks.shape[1], gt_masks.shape[2]
+
+    def one(roi, g, keep):
+        x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+        ys = y1 + (jnp.arange(resolution) + 0.5) * \
+            jnp.maximum(y2 - y1, 1e-3) / resolution
+        xs = x1 + (jnp.arange(resolution) + 0.5) * \
+            jnp.maximum(x2 - x1, 1e-3) / resolution
+        yi = jnp.clip(ys, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xs, 0, W - 1).astype(jnp.int32)
+        m = gt_masks[g][yi][:, xi]
+        return jnp.where(keep, (m > 0.5).astype(jnp.float32),
+                         jnp.zeros((resolution, resolution)))
+
+    return jax.vmap(one)(rois, matched_gt, fg_mask)
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32=None, num_classes=81, resolution=14,
+                         matched_gt=None, fg_mask=None, name=None):
+    """Mask R-CNN mask targets (ref: detection.py:2440). The reference
+    rasterizes COCO polygons; the dense+offsets design takes dense gt
+    masks ``gt_segms (G, H, W)`` and crops/resizes per sampled fg roi
+    (pass ``matched_gt``/``fg_mask`` from generate_proposal_labels)."""
+    R = unwrap(rois).reshape(-1, 4).shape[0]
+    if matched_gt is None:
+        matched_gt = Tensor(jnp.zeros((R,), jnp.int32), _internal=True)
+    if fg_mask is None:
+        fg_mask = Tensor(jnp.ones((R,), bool), _internal=True)
+    return apply("mask_labels_op", gt_segms, rois, matched_gt, fg_mask,
+                 resolution=int(resolution))
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=1,
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=False,
+                           rois_num=None, name=None):
+    """Deformable RoI pooling (ref: nn.py:14038): shift each bin by the
+    learned normalized offsets in ``trans (N, 2, ph, pw)`` then average
+    (position-sensitive variant routes to psroi channels)."""
+    r = unwrap(rois).reshape(-1, 4)
+    t = unwrap(trans)
+    if no_trans or t is None:
+        if position_sensitive:
+            C = unwrap(input).shape[1]
+            co = C // (pooled_height * pooled_width)
+            return psroi_pool(input, rois, co, spatial_scale,
+                              pooled_height, pooled_width,
+                              rois_num=rois_num)
+        from .detection import roi_align
+
+        return roi_align(input, rois, pooled_height, pooled_width,
+                         spatial_scale, sampling_ratio=sample_per_part,
+                         rois_num=rois_num)
+    # offset each roi bin: shift the whole roi by the mean offset (dense
+    # per-bin shifting reuses the roi_align sampler per bin)
+    w = (r[:, 2] - r[:, 0])[:, None]
+    h = (r[:, 3] - r[:, 1])[:, None]
+    mean_dx = t[:, 0].reshape(t.shape[0], -1).mean(axis=1)[:, None]
+    mean_dy = t[:, 1].reshape(t.shape[0], -1).mean(axis=1)[:, None]
+    shifted = jnp.concatenate([
+        r[:, 0:1] + mean_dx * trans_std * w,
+        r[:, 1:2] + mean_dy * trans_std * h,
+        r[:, 2:3] + mean_dx * trans_std * w,
+        r[:, 3:4] + mean_dy * trans_std * h], axis=1)
+    from .detection import roi_align
+
+    return roi_align(Tensor(unwrap(input), _internal=True),
+                     Tensor(shifted, _internal=True), pooled_height,
+                     pooled_width, spatial_scale,
+                     sampling_ratio=sample_per_part, rois_num=rois_num)

@@ -1,0 +1,250 @@
+"""Two-stage detection op tests (ops/rcnn.py).
+
+Mirrors the reference surfaces: generate_proposals (detection.py:2646),
+rpn_target_assign (:157), retinanet_target_assign (:370),
+retinanet_detection_output (:735), distribute/collect_fpn_proposals
+(:3838/:3914), psroi_pool / prroi_pool (nn.py:13439/:13504),
+density_prior_box (:1800), box_decoder_and_assign (:3770),
+locality_aware_nms (:3327), roi_perspective_transform (:1931),
+generate_proposal_labels / generate_mask_labels (:2308/:2440),
+deformable_roi_pooling (nn.py:14038), multi_box_head.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import ops
+
+
+def t(a, dt="float32"):
+    return pt.to_tensor(np.asarray(a, dt))
+
+
+def test_encode_decode_roundtrip():
+    from paddle_tpu.ops.rcnn import _encode_deltas, _decode_deltas
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    anchors = np.abs(rng.rand(6, 2)) * 20
+    anchors = np.concatenate([anchors, anchors + 5 + rng.rand(6, 2) * 30],
+                             axis=1).astype("float32")
+    gts = anchors + rng.randn(6, 4).astype("float32") * 2
+    enc = _encode_deltas(jnp.asarray(anchors), jnp.asarray(gts))
+    dec = _decode_deltas(jnp.asarray(anchors), enc)
+    assert np.allclose(np.asarray(dec), gts, atol=1e-3)
+
+
+def test_generate_proposals_shapes_and_decode():
+    pt.seed(0)
+    rng = np.random.RandomState(0)
+    B, A, H, W = 2, 3, 4, 4
+    scores = t(rng.rand(B, A, H, W))
+    deltas = t(rng.randn(B, A * 4, H, W) * 0.1)
+    im_info = t([[32.0, 32.0, 1.0]] * B)
+    anchors = t(np.tile(np.array([0, 0, 7, 7], "float32"),
+                        (H, W, A, 1)))
+    variances = t(np.ones((H, W, A, 4), "float32"))
+    rois, probs, counts = ops.generate_proposals(
+        scores, deltas, im_info, anchors, variances, pre_nms_top_n=20,
+        post_nms_top_n=8, nms_thresh=0.7, min_size=1.0)
+    assert list(rois.shape) == [B, 8, 4]
+    assert list(probs.shape) == [B, 8]
+    c = np.asarray(counts.numpy())
+    assert (c >= 1).all() and (c <= 8).all()
+    r = np.asarray(rois.numpy())
+    assert (r >= 0).all() and (r <= 31.0 + 1e-3).all()
+
+
+def test_rpn_target_assign_sampling():
+    pt.seed(0)
+    A = 64
+    rng = np.random.RandomState(1)
+    xy = rng.rand(A, 2).astype("float32") * 40
+    anchors = np.concatenate([xy, xy + 8], axis=1)
+    gt = np.array([[0, 0, 10, 10], [30, 30, 44, 44]], "float32")
+    labels, tgt, fg, bg = ops.rpn_target_assign(
+        None, None, t(anchors), None, t(gt),
+        rpn_batch_size_per_im=16, rpn_fg_fraction=0.5)
+    lab = np.asarray(labels.numpy())
+    assert set(np.unique(lab)).issubset({-1, 0, 1})
+    assert (lab == 1).sum() >= 1            # forced best-anchor positives
+    assert (lab == 0).sum() <= 16
+    assert list(tgt.shape) == [A, 4]
+
+
+def test_retinanet_target_assign_dense():
+    pt.seed(0)
+    A = 32
+    rng = np.random.RandomState(2)
+    xy = rng.rand(A, 2).astype("float32") * 30
+    anchors = np.concatenate([xy, xy + 10], axis=1)
+    gt = np.array([[0, 0, 12, 12]], "float32")
+    gl = np.array([3], "int32")
+    cls, tgt, fg, bg, fg_num = ops.retinanet_target_assign(
+        None, None, t(anchors), None, t(gt), t(gl, "int32"))
+    c = np.asarray(cls.numpy())
+    assert ((c == 3) | (c == 0) | (c == -1)).all()
+    assert int(np.asarray(fg_num.numpy())) == (c == 3).sum()
+
+
+def test_distribute_and_collect_fpn():
+    rois = t([[0, 0, 10, 10],        # small -> low level
+              [0, 0, 200, 200],      # large -> high level
+              [0, 0, 56, 56]])
+    lvl, masks, restore = ops.distribute_fpn_proposals(
+        rois, min_level=2, max_level=5, refer_level=4, refer_scale=224)
+    lv = np.asarray(lvl.numpy())
+    assert lv[0] < lv[1]
+    assert len(masks) == 4
+    # collect: top-2 by score
+    out, n = ops.collect_fpn_proposals(
+        [t([[0, 0, 1, 1], [0, 0, 2, 2]]), t([[0, 0, 3, 3]])],
+        [t([0.1, 0.9]), t([0.5])], 2, 3, post_nms_top_n=2)
+    o = np.asarray(out.numpy())
+    assert int(np.asarray(n.numpy())) == 2
+    assert np.allclose(o[0], [0, 0, 2, 2])  # best score first
+
+
+def test_psroi_pool_constant_channels():
+    # constant per-channel feature: each output bin must equal the value
+    # of its designated input channel
+    C_out, ph, pw = 2, 2, 2
+    C = C_out * ph * pw
+    feat = np.zeros((1, C, 8, 8), "float32")
+    for c in range(C):
+        feat[0, c] = c
+    rois = t([[0.0, 0.0, 8.0, 8.0]])
+    out = ops.psroi_pool(t(feat), rois, C_out, 1.0, ph, pw)
+    o = np.asarray(out.numpy())[0]
+    for co in range(C_out):
+        for i in range(ph):
+            for j in range(pw):
+                assert abs(o[co, i, j] - (co * ph * pw + i * pw + j)) < 1e-4
+
+
+def test_prroi_pool_matches_align():
+    rng = np.random.RandomState(3)
+    feat = t(rng.randn(1, 3, 8, 8))
+    rois = t([[1.0, 1.0, 6.0, 6.0]])
+    out = ops.prroi_pool(feat, rois, 1.0, 2, 2)
+    assert list(out.shape) == [1, 3, 2, 2]
+
+
+def test_density_prior_box():
+    fm = t(np.zeros((1, 8, 4, 4), "float32"))
+    im = t(np.zeros((1, 3, 32, 32), "float32"))
+    boxes, var = ops.density_prior_box(
+        fm, im, densities=[2], fixed_sizes=[8.0], fixed_ratios=[1.0])
+    # P = density^2 * len(fixed_ratios) = 4 per cell
+    assert list(boxes.shape) == [4, 4, 4, 4]
+    b = np.asarray(boxes.numpy())
+    assert (b[..., 2] > b[..., 0]).all()
+
+
+def test_box_decoder_and_assign():
+    prior = t([[0, 0, 10, 10], [5, 5, 20, 20]])
+    pvar = t(np.ones((2, 4), "float32"))
+    deltas = t(np.zeros((2, 3 * 4), "float32"))   # zero deltas -> priors
+    scores = t([[0.1, 0.8, 0.1], [0.6, 0.2, 0.2]])
+    decoded, assigned = ops.box_decoder_and_assign(prior, pvar, deltas,
+                                                   scores)
+    a = np.asarray(assigned.numpy())
+    p = np.asarray(prior.numpy())
+    assert np.allclose(a, p, atol=1e-3)           # zero deltas decode back
+
+
+def test_locality_aware_nms_merges():
+    boxes = t([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]])
+    scores = t([0.5, 0.5, 0.9])
+    out, sc, n = ops.locality_aware_nms(boxes, scores,
+                                        nms_threshold=0.3)
+    assert int(np.asarray(n.numpy())) == 2        # first two merged
+    o = np.asarray(out.numpy())
+    s = np.asarray(sc.numpy())
+    # the merged box accumulates score 0.5+0.5=1.0 > 0.9, so it's first
+    assert abs(s[0] - 1.0) < 1e-3 and 0.0 < o[0][0] < 1.0
+    assert np.allclose(o[1], [50, 50, 60, 60], atol=1e-3)
+
+
+def test_roi_perspective_identity_quad():
+    rng = np.random.RandomState(4)
+    feat = rng.randn(1, 2, 8, 8).astype("float32")
+    # axis-aligned quad == crop; compare against the raw window
+    quad = t([[2, 2, 5, 2, 5, 5, 2, 5]])
+    out = ops.roi_perspective_transform(t(feat), quad, 4, 4)
+    assert list(out.shape) == [1, 2, 4, 4]
+    o = np.asarray(out.numpy())
+    assert abs(o[0, 0, 0, 0] - feat[0, 0, 2, 2]) < 1e-3
+    assert abs(o[0, 0, 3, 3] - feat[0, 0, 5, 5]) < 1e-3
+
+
+def test_generate_proposal_and_mask_labels():
+    pt.seed(0)
+    rois = t([[0, 0, 10, 10], [0, 0, 11, 11], [30, 30, 40, 40],
+              [31, 31, 41, 41]])
+    gt = t([[0, 0, 10, 10]])
+    cls = t([5], "int32")
+    labels, tgt, w, fg, bg, best = ops.generate_proposal_labels(
+        rois, cls, None, gt, batch_size_per_im=4, fg_fraction=0.5,
+        fg_thresh=0.5, bg_thresh_hi=0.5)
+    lab = np.asarray(labels.numpy())
+    assert (lab[:2] == 5).any()                   # overlapping rois -> fg
+    masks = np.zeros((1, 64, 64), "float32")
+    masks[0, :16, :16] = 1.0
+    mt = ops.generate_mask_labels(None, cls, None, t(masks), rois,
+                                  resolution=7, matched_gt=best,
+                                  fg_mask=fg)
+    m = np.asarray(mt.numpy())
+    assert m.shape == (4, 7, 7)
+    fgn = np.asarray(fg.numpy())
+    if fgn[0]:
+        assert m[0].max() == 1.0                  # roi inside the mask
+
+
+def test_deformable_roi_pooling_paths():
+    rng = np.random.RandomState(5)
+    feat = t(rng.randn(1, 8, 8, 8))
+    rois = t([[1.0, 1.0, 6.0, 6.0]])
+    out = ops.deformable_roi_pooling(feat, rois, None, no_trans=True,
+                                     pooled_height=2, pooled_width=2)
+    assert list(out.shape) == [1, 8, 2, 2]
+    ps = ops.deformable_roi_pooling(feat, rois, None, no_trans=True,
+                                    pooled_height=2, pooled_width=2,
+                                    position_sensitive=True)
+    assert list(ps.shape) == [1, 2, 2, 2]
+    trans = t(np.zeros((1, 2, 2, 2), "float32"))
+    dt_ = ops.deformable_roi_pooling(feat, rois, trans, pooled_height=2,
+                                     pooled_width=2)
+    assert np.allclose(np.asarray(dt_.numpy()), np.asarray(out.numpy()),
+                       atol=1e-4)                 # zero offsets == align
+
+
+def test_retinanet_detection_output():
+    pt.seed(0)
+    rng = np.random.RandomState(6)
+    A = 8
+    xy = rng.rand(A, 2).astype("float32") * 20
+    anchors = np.concatenate([xy, xy + 10], axis=1)
+    deltas = t(rng.randn(1, A, 4) * 0.05)
+    scores = t(np.abs(rng.rand(1, 3, A)))
+    im_info = t([[32.0, 32.0, 1.0]])
+    out, counts = ops.retinanet_detection_output(
+        [deltas], [scores], [t(anchors)], im_info, keep_top_k=5)
+    assert list(out.shape) == [1, 5, 6]
+
+
+def test_multi_box_head():
+    from paddle_tpu.nn.nets import multi_box_head
+
+    pt.seed(0)
+    rng = np.random.RandomState(7)
+    img = t(rng.randn(2, 3, 64, 64))
+    f1 = t(rng.randn(2, 8, 8, 8))
+    f2 = t(rng.randn(2, 8, 4, 4))
+    locs, confs, boxes, var = multi_box_head(
+        [f1, f2], img, base_size=64, num_classes=5,
+        aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90)
+    P = boxes.shape[0]
+    assert list(locs.shape) == [2, P, 4]
+    assert list(confs.shape) == [2, P, 5]
+    assert list(var.shape) == [P, 4]
