@@ -341,8 +341,19 @@ def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
 adaptive_pool3d = _ops.adaptive_pool3d
 
 
-# (pool2d comes from ops/conv.py via the wholesale re-export — it already
-# carries fluid's `exclusive` -> count_include_pad semantics.)
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCHW"):
+    """fluid signature shim over ops.pool2d (which owns the
+    exclusive -> count_include_pad semantics); use_cudnn/name are
+    legacy no-ops and global pooling derives the window here."""
+    if global_pooling or pool_size == -1:
+        return _ops.pool2d(input, tuple(input.shape[2:]),
+                           pool_type=pool_type, global_pooling=True,
+                           exclusive=exclusive)
+    return _ops.pool2d(input, pool_size, pool_type=pool_type,
+                       pool_stride=pool_stride, pool_padding=pool_padding,
+                       ceil_mode=ceil_mode, exclusive=exclusive)
 
 
 def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
@@ -427,3 +438,173 @@ def inplace_abn(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
     if act is not None:
         return getattr(_F, act)(out)
     return out
+
+
+# -- remaining fluid.layers long tail ---------------------------------------
+from ..nn.nets import multi_box_head  # noqa: F401,E402
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=
+            None):
+    """Host-side python op (ref: nn.py py_func). TPU-native: routes
+    through ``jax.pure_callback`` so the call stays jit-compatible; the
+    callback runs on host per execution. ``out`` supplies the result
+    shape/dtype template (a Tensor or list of Tensors)."""
+    import jax
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    arrays = [v._data if hasattr(v, "_data") else v for v in xs]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    templates = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype
+                                      if hasattr(o, "_data") else o.dtype)
+                 for o in outs]
+
+    def host_fn(*args):
+        res = func(*args)
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return [np.asarray(r._data if hasattr(r, "_data") else r)
+                for r in res]
+
+    result = jax.pure_callback(
+        host_fn, templates if len(templates) > 1 else templates[0],
+        *arrays)
+    if isinstance(result, (list, tuple)):
+        return [Tensor(r, _internal=True) for r in result]
+    return Tensor(result, _internal=True)
+
+
+def load(out, file_path, load_as_fp16=False):
+    """Load a tensor saved by ``save`` (ref: io.py load op): npy/npz."""
+    arr = np.load(file_path, allow_pickle=False)
+    if hasattr(arr, "files"):
+        arr = arr[arr.files[0]]
+    if load_as_fp16:
+        arr = arr.astype(np.float16)
+    t = _ops.to_tensor(arr)
+    if out is not None and hasattr(out, "set_value"):
+        out.set_value(t)
+        return out
+    return t
+
+
+def read_file(reader):
+    """Pull one batch from a reader (ref: io.py read_file): with the
+    DataLoader pipeline (SURVEY §4b) a reader is any iterator."""
+    return next(iter(reader))
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    raise NotImplementedError(
+        "py_reader/double_buffer are replaced by paddle_tpu.io.DataLoader "
+        "with the native prefetch ring (SURVEY §4b descope)")
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    raise NotImplementedError(
+        "py_reader/double_buffer are replaced by paddle_tpu.io.DataLoader "
+        "with the native prefetch ring (SURVEY §4b descope)")
+
+
+def double_buffer(reader, place=None, name=None):
+    """Device prefetch overlap is owned by the DataLoader's native ring
+    buffer (runtime/cc); pass the reader through."""
+    return reader
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Reorder batch rows by a rank order (ref: control_flow.py
+    reorder_lod_tensor_by_rank). ``rank_table``: (B,) int order, e.g.
+    argsort of lengths descending."""
+    idx = rank_table.astype("int64") if hasattr(rank_table, "astype") \
+        else _ops.to_tensor(np.asarray(rank_table, np.int64))
+    return _ops.index_select(x, idx, axis=0)
+
+
+def merge_selected_rows(x, name=None):
+    """Sum duplicate rows of a (rows, values) sparse-gradient pair (ref:
+    merge_selected_rows_op). Dense-gradient design: accepts either a
+    (rows, values) tuple — merged host-side — or a dense tensor, which
+    passes through (XLA grads are already dense)."""
+    if isinstance(x, tuple) and len(x) == 2:
+        rows, values = x
+        r = np.asarray(rows.numpy() if hasattr(rows, "numpy") else rows)
+        v = np.asarray(values.numpy() if hasattr(values, "numpy")
+                       else values)
+        uniq, inv = np.unique(r, return_inverse=True)
+        merged = np.zeros((len(uniq),) + v.shape[1:], v.dtype)
+        np.add.at(merged, inv, v)
+        return _ops.to_tensor(uniq), _ops.to_tensor(merged)
+    return x
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """SelectedRows -> dense tensor (ref: get_tensor_from_selected_rows_op):
+    returns the values half of a (rows, values) pair, or the tensor
+    itself under the dense-grad design."""
+    if isinstance(x, tuple) and len(x) == 2:
+        return x[1]
+    return x
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """CTR continuous-value feature op (ref: nn.py continuous_value_model):
+    keeps the leading (show, click) pair when ``use_cvm`` else drops it."""
+    if use_cvm:
+        return input
+    return input[:, 2:]
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """Filter instances whose tag set intersects filter_tag (ref:
+    filter_by_instag_op, CTR). Host-side (dynamic output): returns
+    (filtered, index_map (M, 1), loss_weight (M,))."""
+    tags = np.asarray(ins_tag.numpy() if hasattr(ins_tag, "numpy")
+                      else ins_tag).reshape(-1)
+    want = set(np.asarray(filter_tag.numpy() if hasattr(filter_tag, "numpy")
+                          else filter_tag).reshape(-1).tolist())
+    keep = np.asarray([int(t) in want for t in tags], bool)
+    idx = np.nonzero(keep)[0]
+    data = np.asarray(ins.numpy() if hasattr(ins, "numpy") else ins)
+    if len(idx) == 0:
+        out = np.full((1,) + data.shape[1:], out_val_if_empty, data.dtype)
+        return (_ops.to_tensor(out),
+                _ops.to_tensor(np.zeros((1, 1), np.int64)),
+                _ops.to_tensor(np.zeros((1,), np.float32)))
+    return (_ops.to_tensor(data[idx]),
+            _ops.to_tensor(idx.reshape(-1, 1).astype(np.int64)),
+            _ops.to_tensor(np.ones((len(idx),), np.float32)))
+
+
+# -- doc/codegen machinery: API-compat no-ops --------------------------------
+
+
+def autodoc(comment=""):
+    def wrapper(func):
+        return func
+
+    return wrapper
+
+
+def templatedoc(op_type=None):
+    def wrapper(func):
+        return func
+
+    return wrapper
+
+
+def deprecated(since=None, instead=None, reason=""):
+    def wrapper(func):
+        return func
+
+    return wrapper
+
+
+def generate_activation_fn(op_type):
+    return getattr(_F, op_type)
+
+
+def generate_layer_fn(op_type):
+    return globals()[op_type]

@@ -167,8 +167,11 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         if steps is not None:
             stp = steps[i] if isinstance(steps[i], (list, tuple)) \
                 else (steps[i], steps[i])
-        elif step_w is not None:
-            stp = (step_w[i], step_h[i])
+        elif step_w is not None or step_h is not None:
+            # each is independently optional in the fluid API
+            sw = step_w[i] if step_w is not None else step_h[i]
+            sh = step_h[i] if step_h is not None else step_w[i]
+            stp = (sw, sh)
         b, v = _prior_box(x, image, ms, xs, ar, variance, flip, clip,
                           stp, offset,
                           min_max_aspect_ratios_order=

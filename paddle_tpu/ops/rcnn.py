@@ -238,15 +238,22 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
     + counts, as multiclass_nms."""
     from .detection import multiclass_nms
 
+    info = unwrap(im_info)                               # (B, 3) h, w, scale
     decoded = []
     for dlt, anc in zip(bboxes, anchors):
         d = unwrap(dlt)                                  # (B, A_l, 4)
         a = unwrap(anc).reshape(-1, 4)
 
-        def dec(di):
-            return _decode_deltas(a, di)
+        def dec(di, inf):
+            b = _decode_deltas(a, di)
+            # clip to image bounds per the reference op
+            return jnp.stack([
+                jnp.clip(b[:, 0], 0.0, inf[1] - 1.0),
+                jnp.clip(b[:, 1], 0.0, inf[0] - 1.0),
+                jnp.clip(b[:, 2], 0.0, inf[1] - 1.0),
+                jnp.clip(b[:, 3], 0.0, inf[0] - 1.0)], axis=1)
 
-        decoded.append(Tensor(jax.vmap(dec)(d), _internal=True))
+        decoded.append(Tensor(jax.vmap(dec)(d, info), _internal=True))
     from .manipulation import concat
 
     all_boxes = concat(decoded, axis=1)                  # (B, A, 4)
@@ -450,7 +457,9 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
 @register("locality_aware_nms_op")
 def _locality_aware_nms(boxes, scores, *, iou_threshold, keep_top_k):
     # EAST-style: first weighted-merge consecutive overlapping boxes
-    # (score-weighted coordinates), then standard greedy NMS.
+    # (score-weighted coordinates), then standard greedy NMS. Boxes with
+    # score <= 0 (filtered by the threshold) are ineligible: they never
+    # merge, never emit, and flush any open accumulator.
     N = boxes.shape[0]
     iou_next = jnp.concatenate([
         jax.vmap(lambda a, b: _pairwise_iou(a[None], b[None], False)[0, 0])(
@@ -458,22 +467,30 @@ def _locality_aware_nms(boxes, scores, *, iou_threshold, keep_top_k):
 
     def body(carry, i):
         acc_box, acc_s, out_b, out_s, n = carry
-        merge = iou_next[i] > iou_threshold
-        w = jnp.maximum(acc_s + scores[i], 1e-8)
-        merged = (acc_box * acc_s + boxes[i] * scores[i]) / w
-        # if merging with next, accumulate; else emit
-        nb = jnp.where(merge, merged, jnp.zeros((4,)))
-        ns = jnp.where(merge, w, 0.0)
-        out_b = jnp.where(merge, out_b, out_b.at[n].set(merged))
-        out_s = jnp.where(merge, out_s, out_s.at[n].set(w))
-        n = jnp.where(merge, n, n + 1)
+        si = scores[i]
+        eligible = si > 0.0
+        w = acc_s + jnp.where(eligible, si, 0.0)
+        merged = jnp.where(
+            w > 0.0,
+            (acc_box * acc_s + boxes[i] * jnp.where(eligible, si, 0.0))
+            / jnp.maximum(w, 1e-8), acc_box)
+        cont = eligible & (iou_next[i] > iou_threshold)  # keep accumulating
+        emit = (w > 0.0) & ~cont
+        out_b = jnp.where(emit, out_b.at[n].set(merged), out_b)
+        out_s = jnp.where(emit, out_s.at[n].set(w), out_s)
+        n = jnp.where(emit, n + 1, n)
+        nb = jnp.where(cont, merged, jnp.zeros((4,)))
+        ns = jnp.where(cont, w, 0.0)
         return (nb, ns, out_b, out_s, n), None
 
     init = (jnp.zeros((4,)), jnp.zeros(()), jnp.zeros((N, 4)),
             jnp.full((N,), -jnp.inf), jnp.int32(0))
-    (_, _, mb, ms, n), _ = lax.scan(body, init, jnp.arange(N))
+    (nb, ns, mb, ms, n), _ = lax.scan(body, init, jnp.arange(N))
+    # flush a still-open accumulator from the final step
+    mb = jnp.where(ns > 0.0, mb.at[n].set(nb), mb)
+    ms = jnp.where(ns > 0.0, ms.at[n].set(ns), ms)
     keep = _greedy_nms_mask(mb, ms, iou_threshold, False)
-    keep = keep & jnp.isfinite(ms)
+    keep = keep & jnp.isfinite(ms) & (ms > 0.0)
     k = min(keep_top_k, N) if keep_top_k > 0 else N
     sel_s, sel_i = lax.top_k(jnp.where(keep, ms, -jnp.inf), k)
     valid = jnp.isfinite(sel_s)
@@ -490,7 +507,7 @@ def locality_aware_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
     bboxes (N, 4) sorted in reading order; scores (N,).
     Returns (boxes, scores, count) fixed-shape."""
     s = unwrap(scores).reshape(-1)
-    s = jnp.where(s >= score_threshold, s, 0.0)
+    s = jnp.where(s >= score_threshold, s, 0.0)  # 0 marks ineligible
     return apply("locality_aware_nms_op", bboxes,
                  Tensor(s, _internal=True),
                  iou_threshold=float(nms_threshold),

@@ -248,3 +248,26 @@ def test_multi_box_head():
     assert list(locs.shape) == [2, P, 4]
     assert list(confs.shape) == [2, P, 5]
     assert list(var.shape) == [P, 4]
+
+
+def test_locality_aware_nms_score_threshold():
+    """Sub-threshold boxes must be dropped entirely, not emitted as
+    zero-coordinate detections (review regression)."""
+    boxes = t([[0, 0, 10, 10], [50, 50, 60, 60]])
+    scores = t([0.9, 0.05])
+    out, s, n = ops.locality_aware_nms(boxes, scores, score_threshold=0.5,
+                                       nms_threshold=0.3)
+    assert int(np.asarray(n.numpy())) == 1
+    assert np.allclose(np.asarray(out.numpy())[0], [0, 0, 10, 10])
+
+
+def test_retinanet_output_clipped_to_image():
+    A = 4
+    anchors = np.array([[0, 0, 10, 10]] * A, "float32")
+    deltas = t(np.full((1, A, 4), 2.0))
+    scores = t(np.ones((1, 2, A)))
+    im_info = t([[20.0, 20.0, 1.0]])
+    out, cnt = ops.retinanet_detection_output(
+        [deltas], [scores], [t(anchors)], im_info, keep_top_k=3,
+        score_threshold=0.1)
+    assert (np.asarray(out.numpy())[..., 2:] <= 19.0 + 1e-3).all()
