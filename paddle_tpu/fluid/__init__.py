@@ -80,3 +80,74 @@ class CompiledProgram:  # re-export with the fluid name
         from ..static_.compiler import CompiledProgram as CP
 
         return CP(*args, **kwargs)
+
+
+# -- places / flags / version (ref: fluid/framework.py __all__) --------------
+
+
+def cpu_places(device_count=None):
+    import os
+
+    n = device_count or int(os.environ.get("CPU_NUM", "1"))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places: TPU chips here (ref: framework.py
+    cuda_places). Sized by jax.local_device_count()."""
+    import jax
+
+    ids = device_ids if device_ids is not None else \
+        range(jax.local_device_count())
+    return [TPUPlace(i) for i in ids]
+
+
+def cuda_pinned_places(device_count=None):
+    """Host staging places: the runtime arena owns pinned buffers
+    (runtime/cc); exposed as CPU places."""
+    return cpu_places(device_count)
+
+
+@_contextlib.contextmanager
+def device_guard(device=None):
+    """ref: framework.py device_guard. Op-level device pinning inside
+    one XLA program is owned by the compiler; the guard is accepted for
+    source compatibility."""
+    yield
+
+
+_FLAGS = {}
+
+
+def set_flags(flags):
+    """ref: framework.py set_flags (FLAGS_* gflags). XLA equivalents
+    ride XLA_FLAGS; unknown keys are stored for get_flags round-trip."""
+    _FLAGS.update(dict(flags))
+
+
+def get_flags(flags):
+    keys = [flags] if isinstance(flags, str) else list(flags)
+    return {k: _FLAGS.get(k) for k in keys}
+
+
+def load_op_library(path):
+    raise NotImplementedError(
+        "custom C++ op libraries are CUDA-era; TPU custom kernels are "
+        "pallas (ops/pallas/) or host callbacks (fluid.layers.py_func)")
+
+
+def require_version(min_version, max_version=None):
+    """ref: framework.py require_version: raise unless the installed
+    version is inside [min_version, max_version]."""
+    import paddle_tpu as _pt
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3])
+
+    cur = parse(_pt.__version__)
+    if parse(min_version) > cur or (
+            max_version is not None and parse(max_version) < cur):
+        raise Exception(
+            f"paddle_tpu version {_pt.__version__} outside required "
+            f"[{min_version}, {max_version or 'any'}]")
+    return _pt.__version__

@@ -47,3 +47,340 @@ def no_grad(fn=None):
             return fn(*a, **k)
 
     return wrapped
+
+
+# -- fluid.dygraph layer catalogue (ref: fluid/dygraph/nn.py) ---------------
+from ..nn.layers.conv import (Conv2DTranspose, Conv3D,  # noqa: F401,E402
+                              Conv3DTranspose)
+from ..nn.layers.norm import (GroupNorm, LayerNorm)  # noqa: F401,E402
+from ..nn.layer import Parameter  # noqa: F401,E402
+from .. import ops as _ops  # noqa: E402
+from ..nn import functional as _F  # noqa: E402
+from ..optim import lr as _lr  # noqa: E402
+
+
+class Pool2D(Layer):
+    """ref: dygraph/nn.py Pool2D — config-object pooling layer."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True, data_format="NCHW"):
+        super().__init__()
+        self._cfg = dict(pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride, pool_padding=pool_padding,
+                         global_pooling=global_pooling, ceil_mode=ceil_mode,
+                         exclusive=exclusive)
+
+    def forward(self, x):
+        from .layers import pool2d
+
+        return pool2d(x, **self._cfg)
+
+
+class PRelu(Layer):
+    """ref: dygraph/nn.py PRelu; mode in {'all', 'channel', 'element'}."""
+
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__()
+        if mode == "all":
+            shape = (1,)
+        elif mode == "channel":
+            shape = (channel,)
+        else:
+            shape = tuple(input_shape[1:])
+        from ..nn import initializer as I
+
+        self.weight = self.create_parameter(
+            shape, attr=param_attr, dtype=dtype,
+            default_initializer=I.Constant(0.25))
+        self.mode = mode
+
+    def forward(self, x):
+        w = self.weight
+        if self.mode == "channel":
+            shp = [1, -1] + [1] * (len(x.shape) - 2)
+            w = w.reshape(shp)
+        return _ops.maximum(x, x * 0.0) + w * _ops.minimum(x, x * 0.0)
+
+
+class SpectralNorm(Layer):
+    """ref: dygraph/nn.py SpectralNorm: normalizes the input weight by
+    its leading singular value (power iteration each call)."""
+
+    def __init__(self, weight_shape=None, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+
+    def forward(self, weight):
+        from ..ops.norm_ops import spectral_norm
+
+        return spectral_norm(weight, dim=self.dim,
+                             power_iters=self.power_iters, eps=self.eps)
+
+
+class BilinearTensorProduct(Layer):
+    """ref: dygraph/nn.py BilinearTensorProduct: out_k = x W_k y + b."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (output_dim, input1_dim, input2_dim), attr=param_attr,
+            dtype=dtype)
+        self.bias = self.create_parameter((output_dim,), attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+        self.act = act
+
+    def forward(self, x, y):
+        from ..ops.misc import bilinear_tensor_product
+
+        out = bilinear_tensor_product(x, y, weight=self.weight,
+                                      bias=self.bias)
+        if self.act is not None:
+            out = getattr(_F, self.act)(out)
+        return out
+
+
+class NCE(Layer):
+    """ref: dygraph/nn.py NCE: holds the (V, D) weight/bias and applies
+    the NCE loss op."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype="float32"):
+        super().__init__()
+        self.weight = self.create_parameter((num_total_classes, dim),
+                                            attr=param_attr, dtype=dtype)
+        self.bias = self.create_parameter((num_total_classes,),
+                                          attr=bias_attr, dtype=dtype,
+                                          is_bias=True)
+        self.num_total_classes = num_total_classes
+        self.num_neg_samples = num_neg_samples
+        self.sampler = sampler
+
+    def forward(self, input, label, sample_weight=None):
+        from ..ops.labeling import nce
+
+        return nce(input, label, self.num_total_classes,
+                   num_neg_samples=self.num_neg_samples,
+                   sampler=self.sampler, weight=self.weight,
+                   bias=self.bias)
+
+
+class GRUUnit(Layer):
+    """ref: dygraph/nn.py GRUUnit: single fused GRU step with held
+    recurrent weights (size is 3*D, fluid convention)."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__()
+        from .rnn import _FluidGRUCell
+
+        self.cell = _FluidGRUCell(size // 3, param_attr, bias_attr,
+                                  gate_activation, activation, origin_mode)
+        self.origin_mode = origin_mode
+        self.gate_activation = gate_activation
+        self.activation = activation
+
+    def forward(self, input, hidden):
+        from .rnn import _gru_step
+
+        return _gru_step(self.cell, input, hidden, self.gate_activation,
+                         self.activation, self.origin_mode)
+
+
+class TreeConv(Layer):
+    """ref: dygraph/nn.py TreeConv over the TBCNN tree_conv op."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (feature_size, 3, output_size, num_filters), attr=param_attr,
+            dtype=dtype)
+        self.bias = self.create_parameter((num_filters,), attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+        self.max_depth = max_depth
+        self.act = act
+
+    def forward(self, nodes_vector, edge_set):
+        from ..ops.misc import tree_conv
+
+        out = tree_conv(nodes_vector, edge_set, self.weight.shape[2],
+                        self.weight.shape[3], self.max_depth, act=None,
+                        weight=self.weight)
+        out = out + self.bias.reshape([1, 1, 1, -1])
+        if self.act is not None:
+            out = getattr(_F, self.act)(out)
+        return out
+
+
+# -- LR decay classes under the dygraph names -------------------------------
+NoamDecay = _lr.NoamDecay
+PiecewiseDecay = _lr.PiecewiseDecay
+PolynomialDecay = _lr.PolynomialDecay
+CosineDecay = _lr.CosineAnnealingDecay
+ExponentialDecay = _lr.ExponentialDecay
+InverseTimeDecay = _lr.InverseTimeDecay
+NaturalExpDecay = _lr.NaturalExpDecay
+
+
+# -- mode switches / misc (ref: fluid/dygraph/base.py) ----------------------
+
+
+def enable_dygraph(place=None):
+    """Eager IS the default mode; provided for source compatibility."""
+    import paddle_tpu as _pt
+
+    _pt.disable_static()
+
+
+def disable_dygraph():
+    import paddle_tpu as _pt
+
+    _pt.enable_static()
+
+
+def enabled():
+    from ..static_ import in_static_mode
+
+    return not in_static_mode()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    import paddle_tpu as _pt
+
+    return _pt.grad(outputs, inputs, grad_outputs=grad_outputs,
+                    retain_graph=retain_graph, create_graph=create_graph,
+                    allow_unused=allow_unused)
+
+
+def save_dygraph(state_dict, model_path):
+    """ref: dygraph/checkpoint.py save_dygraph -> <path>.pdparams (npz)."""
+    import paddle_tpu as _pt
+
+    _pt.save(state_dict, model_path + ".pdparams")
+
+
+def load_dygraph(model_path, keep_name_table=False):
+    """ref: dygraph/checkpoint.py load_dygraph; returns (params, opt)."""
+    import os
+
+    import paddle_tpu as _pt
+
+    p = model_path + ".pdparams" if not model_path.endswith(".pdparams") \
+        else model_path
+    params = _pt.load(p)
+    opt_path = model_path + ".pdopt"
+    opt = _pt.load(opt_path) if os.path.exists(opt_path) else None
+    return params, opt
+
+
+class BackwardStrategy:
+    """ref: imperative BackwardStrategy: sort_sum_gradient toggles
+    deterministic gradient accumulation order. XLA accumulation is
+    already deterministic; the knob is accepted and recorded."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False
+
+
+class ParallelEnv:
+    """ref: dygraph/parallel.py ParallelEnv — rank/world info."""
+
+    def __init__(self):
+        from ..dist import env as _denv
+
+        self._rank = _denv.get_rank() if hasattr(_denv, "get_rank") else 0
+        self._world = _denv.get_world_size() \
+            if hasattr(_denv, "get_world_size") else 1
+
+    @property
+    def nranks(self):
+        return self._world
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def dev_id(self):
+        return self._rank
+
+    @property
+    def current_endpoint(self):
+        return "127.0.0.1:0"
+
+    @property
+    def trainer_endpoints(self):
+        return ["127.0.0.1:0"]
+
+
+def prepare_context(strategy=None):
+    """ref: dygraph/parallel.py prepare_context: collective init. Mesh
+    setup happens via dist.init_parallel_env/fleet.init here."""
+    from ..dist import env as _denv
+
+    return _denv
+
+
+class TracedLayer:
+    """ref: dygraph/jit.py TracedLayer: trace a Layer once, then run /
+    save the traced program (here: a jitted callable +
+    save_inference_model)."""
+
+    def __init__(self, fn, example_args):
+        self._fn = fn
+        self._args = example_args
+
+    @staticmethod
+    def trace(layer, inputs):
+        import paddle_tpu as _pt
+
+        fn = _pt.jit(layer)
+        out = fn(*inputs)
+        traced = TracedLayer(fn, inputs)
+        return out, traced
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        if hasattr(self._fn, "save"):
+            return self._fn.save(dirname)
+        raise NotImplementedError(
+            "trace target lacks save(); use paddle_tpu.jit + "
+            "save_inference_model")
+
+
+def dygraph_to_static_func(fn):
+    from ..framework.jit import to_static
+
+    return to_static(fn)
+
+
+dygraph_to_static_code = dygraph_to_static_func
+dygraph_to_static_output = dygraph_to_static_func
+dygraph_to_static_program = dygraph_to_static_func
+
+
+def start_gperf_profiler():
+    from ..utils.profiler import start_profiler
+
+    return start_profiler()
+
+
+def stop_gperf_profiler():
+    from ..utils.profiler import stop_profiler
+
+    return stop_profiler()

@@ -259,14 +259,11 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None,
     return Tensor(ys, _internal=True) if not isinstance(ys, Tensor) else ys
 
 
-def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
-             activation="tanh", gate_activation="sigmoid",
-             origin_mode=False):
-    """One GRU step (ref: rnn.py:2549). ``size`` is 3*D as in fluid.
-    Returns (new_hidden, reset_hidden_prev, gate)."""
-    D = size // 3
-    cell = _FluidGRUCell(D, param_attr, bias_attr, gate_activation,
-                         activation, origin_mode)
+def _gru_step(cell, input, hidden, gate_activation, activation,
+              origin_mode):
+    """Single fused GRU step over a _FluidGRUCell's weights — shared by
+    gru_unit and fluid.dygraph.GRUUnit so the gate math lives once."""
+    D = cell.hidden
     xb = input + cell.bias
     gates = xb[:, :2 * D] + _ops.matmul(hidden, cell.weight[:, :2 * D])
     act_g, act_c = _act(gate_activation), _act(activation)
@@ -278,8 +275,18 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
         new_h = u * hidden + (1.0 - u) * c
     else:
         new_h = (1.0 - u) * hidden + u * c
-    gate = _ops.concat([u, r, c], axis=-1)
-    return new_h, r_h, gate
+    return new_h, r_h, _ops.concat([u, r, c], axis=-1)
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """One GRU step (ref: rnn.py:2549). ``size`` is 3*D as in fluid.
+    Returns (new_hidden, reset_hidden_prev, gate)."""
+    cell = _FluidGRUCell(size // 3, param_attr, bias_attr, gate_activation,
+                         activation, origin_mode)
+    return _gru_step(cell, input, hidden, gate_activation, activation,
+                     origin_mode)
 
 
 def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,

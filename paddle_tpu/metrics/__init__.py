@@ -14,7 +14,7 @@ from ..core.tensor import Tensor
 
 __all__ = ["Metric", "MetricBase", "Accuracy", "Precision", "Recall", "F1",
            "Auc", "MAE", "MSE", "RMSE", "CompositeMetric", "accuracy",
-           "ChunkEvaluator"]
+           "ChunkEvaluator", "EditDistance", "DetectionMAP"]
 
 
 def _np(x):
@@ -312,3 +312,121 @@ class ChunkEvaluator(Metric):
         r = self.n_correct / self.n_label if self.n_label else 0.0
         f1 = 2 * p * r / (p + r) if p + r else 0.0
         return p, r, f1
+
+
+class EditDistance(Metric):
+    """Streaming average edit distance (ref: fluid/metrics.py
+    EditDistance). update() takes per-batch (distances, seq_num) as
+    produced by ``ops.edit_distance``."""
+
+    def __init__(self, name=None):
+        super().__init__(name or "edit_distance")
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num=None):
+        d = _np(distances).reshape(-1)
+        self.total_distance += float(d.sum())
+        self.seq_num += int(seq_num if seq_num is not None else d.size)
+        self.instance_error += int((d > 0).sum())
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("no data in EditDistance")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+    def accumulate(self):
+        return self.eval()
+
+
+class DetectionMAP(Metric):
+    """Mean average precision over padded detection outputs (ref:
+    fluid/metrics.py DetectionMAP / detection_map op). update() takes
+    per-image detections (label, score, x1, y1, x2, y2) rows — the
+    multiclass_nms output — and gt rows (label, x1, y1, x2, y2);
+    11-point or integral interpolation."""
+
+    def __init__(self, overlap_threshold=0.5, map_type="11point",
+                 evaluate_difficult=False, class_num=None, name=None):
+        super().__init__(name or "detection_map")
+        self.thr = overlap_threshold
+        self.map_type = map_type
+        self.reset()
+
+    def reset(self):
+        self._dets = []   # (cls, score, box, img_id)
+        self._gts = []    # (cls, box, img_id)
+        self._img = 0
+
+    @staticmethod
+    def _iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = (a[2] - a[0]) * (a[3] - a[1]) + \
+            (b[2] - b[0]) * (b[3] - b[1]) - inter
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, detections, gts):
+        det = _np(detections)
+        gt = _np(gts)
+        for d in det.reshape(-1, 6):
+            if d[0] >= 0:  # -1 pads
+                self._dets.append((int(d[0]), float(d[1]),
+                                   d[2:6].tolist(), self._img))
+        for g in gt.reshape(-1, 5):
+            if g[0] >= 0:
+                self._gts.append((int(g[0]), g[1:5].tolist(), self._img))
+        self._img += 1
+
+    def eval(self):
+        classes = sorted({c for c, *_ in self._gts})
+        if not classes:
+            raise ValueError("no ground truth in DetectionMAP")
+        aps = []
+        for c in classes:
+            gts_c = [(b, i) for (cc, b, i) in self._gts if cc == c]
+            dets_c = sorted([(s, b, i) for (cc, s, b, i) in self._dets
+                             if cc == c], key=lambda x: -x[0])
+            matched = set()
+            tp = []
+            for s, b, i in dets_c:
+                best, best_j = 0.0, -1
+                for j, (gb, gi) in enumerate(gts_c):
+                    if gi == i and j not in matched:
+                        o = self._iou(b, gb)
+                        if o > best:
+                            best, best_j = o, j
+                if best >= self.thr and best_j >= 0:
+                    matched.add(best_j)
+                    tp.append(1)
+                else:
+                    tp.append(0)
+            if not gts_c:
+                continue
+            cum_tp = np.cumsum(tp) if tp else np.zeros((0,))
+            recall = cum_tp / len(gts_c)
+            precision = cum_tp / np.maximum(
+                np.arange(1, len(tp) + 1), 1) if tp else np.zeros((0,))
+            if self.map_type == "11point":
+                ap = 0.0
+                for r in np.linspace(0, 1, 11):
+                    pmax = precision[recall >= r].max() \
+                        if (recall >= r).any() else 0.0
+                    ap += pmax / 11.0
+            else:  # integral
+                ap = 0.0
+                prev_r = 0.0
+                for p_, r_ in zip(precision, recall):
+                    ap += p_ * (r_ - prev_r)
+                    prev_r = r_
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0
+
+    def accumulate(self):
+        return self.eval()

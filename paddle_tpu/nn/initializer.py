@@ -244,3 +244,8 @@ XavierInitializer = XavierUniform
 MSRAInitializer = KaimingNormal
 BilinearInitializer = Bilinear
 NumpyArrayInitializer = Assign
+
+# fluid short names (ref: fluid/initializer.py __all__: Xavier, MSRA)
+Xavier = XavierUniform
+MSRA = KaimingNormal
+__all__ += ["Xavier", "MSRA", "XavierInitializer", "MSRAInitializer"]

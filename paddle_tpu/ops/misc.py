@@ -28,7 +28,7 @@ __all__ = [
     "gather_tree", "sampling_id", "ctc_greedy_decoder", "fsp_matrix",
     "clip_by_norm", "brelu", "soft_relu",
     "unique_with_counts", "hash", "similarity_focus",
-    "polygon_box_transform",
+    "polygon_box_transform", "tree_conv",
 ]
 
 
@@ -496,3 +496,80 @@ def _polygon_box_transform(x):
 
 def polygon_box_transform(input, name=None):
     return apply("polygon_box_transform_op", input)
+
+
+@register("tree_conv_op")
+def _tree_conv(nodes, edges, W, *, max_depth):
+    # ref: contrib/layers/nn.py:376 tree_conv (tree_conv_op.cc), the
+    # TBCNN continuous binary tree convolution (Mou et al.): each node's
+    # window is its subtree to ``max_depth``; every window member mixes
+    # three filter banks W[:, (t, l, r)] by coefficients from its
+    # relative depth and sibling position. Dense adjacency keeps it XLA
+    # (matmul powers for depth-d reachability), O(N^2 * depth).
+    B, N, F = nodes.shape
+    Fs, three, O, M = W.shape
+
+    def one(x, e):
+        # adjacency: edge rows are (parent, child); zero rows are pads
+        p = e[:, 0].astype(jnp.int32)
+        c = e[:, 1].astype(jnp.int32)
+        real = (p != c)                    # pad rows repeat a node id
+        adj = jnp.zeros((N, N))
+        adj = adj.at[p, c].add(jnp.where(real, 1.0, 0.0))
+        adj = jnp.minimum(adj, 1.0)
+        parent_of = jnp.argmax(adj, axis=0)            # (N,)
+        has_parent = adj.max(axis=0) > 0
+        # sibling rank/count by node-id order
+        sib_cnt = adj.sum(axis=1)[parent_of]           # siblings incl self
+        # rank of node i among its siblings = earlier children of parent
+        par_rows = adj[parent_of]                      # (N, N)
+        earlier = jnp.arange(N)[None, :] < jnp.arange(N)[:, None]
+        rank = (par_rows * earlier).sum(axis=1)
+        out = jnp.zeros((N, O, M))
+        reach = jnp.eye(N)
+        for d in range(max_depth):
+            # window coefficients for members at relative depth d
+            denom = max(max_depth - 1, 1)
+            eta_t = (max_depth - 1 - d) / denom
+            div = jnp.maximum(sib_cnt - 1.0, 1.0)
+            frac = jnp.where(sib_cnt > 1, rank / div, 0.5)
+            eta_r = (1.0 - eta_t) * frac
+            eta_l = (1.0 - eta_t) * (1.0 - frac)
+            if d == 0:                      # window root: all weight on t
+                eta = jnp.stack([jnp.ones((N,)), jnp.zeros((N,)),
+                                 jnp.zeros((N,))], axis=1)
+            else:
+                eta = jnp.stack([jnp.full((N,), eta_t), eta_l, eta_r],
+                                axis=1)
+            # mixed per-member features: (N, O, M)
+            mixed = jnp.einsum("nf,fkom,nk->nom", x, W, eta)
+            out = out + jnp.einsum("rn,nom->rom", reach, mixed)
+            reach = reach @ adj
+        return out
+
+    return jax.vmap(one)(nodes, edges)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None, weight=None):
+    """Tree-based convolution (ref: contrib/layers/nn.py:376).
+    nodes_vector (B, N, F); edge_set (B, E, 2) directed (parent, child)
+    pairs (pad rows repeat one id). Returns (B, N, output_size,
+    num_filters). Functional form takes ``weight (F, 3, O, M)``; without
+    it a fresh parameter is created (fluid convention)."""
+    F_dim = unwrap(nodes_vector).shape[2]
+    if weight is None:
+        # A fresh throwaway parameter would be untrainable and re-drawn
+        # every eager call; require the owned weight (the TreeConv Layer
+        # in fluid.dygraph holds one).
+        raise ValueError(
+            f"pass weight=({F_dim}, 3, {output_size}, {num_filters}) — "
+            "use fluid.dygraph.TreeConv for a parameter-owning layer")
+    out = apply("tree_conv_op", nodes_vector, edge_set, weight,
+                max_depth=int(max_depth))
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out

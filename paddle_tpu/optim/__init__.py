@@ -6,7 +6,25 @@ Mirrors ``paddle.optimizer`` + ``fluid/optimizer.py``/``clip.py``/
 from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adagrad, Adadelta, RMSProp, Adam, AdamW,
     Adamax, Lamb, Ftrl, ExponentialMovingAverage, LookAhead,
+    DecayedAdagrad, Dpsgd, LarsMomentum, DGCMomentum, ModelAverage,
+    RecomputeOptimizer, PipelineOptimizer,
 )
+
+# fluid-era *Optimizer names (ref: fluid/optimizer.py __all__)
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdagradOptimizer = Adagrad
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+FtrlOptimizer = Ftrl
+LambOptimizer = Lamb
+DecayedAdagradOptimizer = DecayedAdagrad
+DpsgdOptimizer = Dpsgd
+LarsMomentumOptimizer = LarsMomentum
+DGCMomentumOptimizer = DGCMomentum
+LookaheadOptimizer = LookAhead
 from . import lr  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,

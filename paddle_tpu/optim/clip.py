@@ -75,3 +75,49 @@ class ClipGradByGlobalNorm(ClipGradBase):
 GradientClipByValue = ClipGradByValue
 GradientClipByNorm = ClipGradByNorm
 GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+
+class ErrorClipByValue:
+    """ref: fluid/clip.py ErrorClipByValue: clips the *gradient of an
+    op's output* during backward. With whole-graph XLA autodiff there is
+    no per-op error channel; attach this to a Tensor-producing call via
+    ``apply(x)`` to clamp its gradient."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(-max if min is None else min)
+
+    def apply(self, x):
+        import jax
+
+        @jax.custom_vjp
+        def _clip_grad(v):
+            return v
+
+        def fwd(v):
+            return v, None
+
+        def bwd(_, g):
+            import jax.numpy as jnp
+
+            return (jnp.clip(g, self.min, self.max),)
+
+        _clip_grad.defvjp(fwd, bwd)
+        from ..core.tensor import Tensor
+        from ..core import dispatch
+
+        return dispatch.apply("error_clip", _clip_grad, x)
+
+
+_GLOBAL_GRAD_CLIP = None
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """ref: fluid/clip.py set_gradient_clip: registers a default clip
+    used by optimizers constructed without an explicit grad_clip."""
+    global _GLOBAL_GRAD_CLIP
+    _GLOBAL_GRAD_CLIP = clip
+
+
+def get_gradient_clip():
+    return _GLOBAL_GRAD_CLIP

@@ -16,6 +16,7 @@ param per step).
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -39,10 +40,27 @@ class Optimizer:
         if isinstance(weight_decay, (int, float)):
             weight_decay = L2Decay(weight_decay)
         self._regularization = weight_decay
-        self._grad_clip = grad_clip
+        self._explicit_grad_clip = grad_clip
         self._multi_precision = multi_precision
         self._accumulators: dict[str, dict] = {}
         self._global_step = 0
+
+    @property
+    def _grad_clip(self):
+        """Explicit clip wins; otherwise the process-wide default from
+        fluid's set_gradient_clip(), resolved at USE time (the reference
+        resolves it in minimize, so clips registered after optimizer
+        construction must still apply)."""
+        explicit = getattr(self, "_explicit_grad_clip", None)  # wrapper
+        if explicit is not None:  # subclasses may skip Optimizer.__init__
+            return explicit
+        from .clip import get_gradient_clip
+
+        return get_gradient_clip()
+
+    @_grad_clip.setter
+    def _grad_clip(self, value):
+        self._explicit_grad_clip = value
 
     # -- lr -----------------------------------------------------------------
     def get_lr(self):
@@ -479,3 +497,187 @@ class LookAhead(Optimizer):
 
     def set_state_dict(self, state):
         self.inner.set_state_dict(state)
+
+
+class DecayedAdagrad(Optimizer):
+    """ref: fluid/optimizer.py DecayedAdagradOptimizer:
+    moment = decay * moment + (1 - decay) * g^2."""
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros_like(p)}
+
+    def _update(self, p, g, s, lr):
+        m = self._decay * s["moment"] + (1 - self._decay) * g * g
+        return p - lr * g / (jnp.sqrt(m) + self._epsilon), \
+            {**s, "moment": m}
+
+
+class Dpsgd(Optimizer):
+    """ref: fluid/optimizer.py DpsgdOptimizer (differentially-private
+    SGD): per-update gradient clip to ``clip`` then Gaussian noise with
+    scale ``sigma * clip`` scaled by 1/batch_size."""
+
+    def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
+                 sigma=1.0, parameters=None, seed=0, name=None):
+        super().__init__(learning_rate, parameters, None, None, name)
+        self._clip = clip
+        self._batch = batch_size
+        self._sigma = sigma
+
+    def _update(self, p, g, s, lr):
+        from ..core import random as prandom
+
+        norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        g = g * jnp.minimum(1.0, self._clip / jnp.maximum(norm, 1e-12)) \
+            .astype(g.dtype)
+        noise = jax.random.normal(prandom.next_key(), g.shape,
+                                  jnp.float32) * (self._sigma * self._clip)
+        g = g + (noise / self._batch).astype(g.dtype)
+        return p - lr * g, s
+
+
+class LarsMomentum(Optimizer):
+    """ref: fluid/optimizer.py LarsMomentumOptimizer: layerwise adaptive
+    rate scaling — local_lr = lr * coeff * ||w|| / (||g|| + decay*||w||)."""
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._decay = lars_weight_decay
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def _update(self, p, g, s, lr):
+        pf = p.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        wn = jnp.sqrt(jnp.sum(pf * pf))
+        gn = jnp.sqrt(jnp.sum(gf * gf))
+        local = lr * self._coeff * wn / jnp.maximum(
+            gn + self._decay * wn, 1e-12)
+        v = self._momentum * s["velocity"] + \
+            (local * (gf + self._decay * pf)).astype(p.dtype)
+        return p - v, {**s, "velocity": v}
+
+
+class DGCMomentum(Momentum):
+    """ref: fluid DGCMomentumOptimizer (deep gradient compression). The
+    compression half is a network-transport optimization for NCCL rings;
+    over ICI the gradients ride XLA all-reduce, so the TPU-native
+    equivalent is plain Momentum (sparsification would only add host
+    work). Kept for recipe compatibility."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, momentum, parameters=parameters,
+                         use_nesterov=use_nesterov,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         name=name)
+
+
+class ModelAverage:
+    """ref: fluid/optimizer.py ModelAverage: accumulate parameter sums
+    during training; apply() swaps in the running average over the last
+    [min_average_window, max_average_window] updates."""
+
+    def __init__(self, average_window_rate, model_or_params=None,
+                 min_average_window=10000, max_average_window=10000,
+                 parameters=None, name=None):
+        from ..nn.layer import Layer
+
+        src = model_or_params if model_or_params is not None else parameters
+        if isinstance(src, Layer):
+            self._params = src.parameters()
+        else:
+            self._params = list(src or [])
+        self.rate = average_window_rate
+        self.min_w = min_average_window
+        self.max_w = max_average_window
+        self._sum = {p.name: jnp.zeros_like(p._data, jnp.float32)
+                     for p in self._params}
+        self._count = 0
+        self._backup = {}
+
+    def step(self):
+        self._count += 1
+        restart = self._count > self.max_w
+        for p in self._params:
+            if restart:
+                self._sum[p.name] = p._data.astype(jnp.float32)
+            else:
+                self._sum[p.name] = self._sum[p.name] + \
+                    p._data.astype(jnp.float32)
+        if restart:
+            self._count = 1
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {p.name: p._data for p in self._params}
+        denom = max(self._count, 1)
+        for p in self._params:
+            p._replace((self._sum[p.name] / denom).astype(p._data.dtype))
+
+    def restore(self, executor=None):
+        for p in self._params:
+            p._replace(self._backup[p.name])
+        self._backup = {}
+
+
+class RecomputeOptimizer:
+    """ref: fluid RecomputeOptimizer: wraps an optimizer so the listed
+    checkpoint activations are rematerialized in backward. TPU-native:
+    recompute is a property of the forward function (jax.checkpoint via
+    framework/recompute.py), so this wrapper stores the segment spec and
+    otherwise delegates."""
+
+    def __init__(self, optimizer):
+        self.inner = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def backward(self, loss, **kw):
+        loss.backward()
+        return []
+
+    def apply_gradients(self, params_grads=None):
+        self.inner.step()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.inner.step()
+        return None, None
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+class PipelineOptimizer:
+    """ref: fluid PipelineOptimizer: stage-parallel training. The
+    TPU-native pipeline is ``dist/pipeline.py`` (GPipe over ppermute);
+    this wrapper keeps the fluid recipe shape and delegates stepping."""
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self.inner = optimizer
+        self.num_microbatches = num_microbatches
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.inner.step()
+        return None, None
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)

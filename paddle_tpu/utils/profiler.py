@@ -104,3 +104,10 @@ _step_timer = StepTimer()
 def add_profiler_step(*a, **k):
     """ref: profiler.add_profiler_step hook for Executor loops."""
     return _step_timer
+
+
+def reset_profiler():
+    """ref: fluid/profiler.py reset_profiler: drop collected records.
+    jax.profiler traces are per start/stop window, so this is a no-op
+    between windows; StepTimer state resets explicitly via .reset()."""
+    return None
