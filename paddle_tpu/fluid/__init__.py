@@ -71,7 +71,9 @@ __all__ = [
     "CPUPlace", "CUDAPlace", "TPUPlace", "CUDAPinnedPlace", "ParamAttr",
     "optimizer", "initializer", "clip", "regularizer", "layers",
     "dygraph", "nets", "metrics", "io", "data", "save_inference_model",
-    "load_inference_model", "to_static", "Layer",
+    "load_inference_model", "to_static", "Layer", "contrib",
+    "cpu_places", "cuda_places", "cuda_pinned_places", "device_guard",
+    "get_flags", "set_flags", "load_op_library", "require_version",
 ]
 
 
@@ -142,7 +144,13 @@ def require_version(min_version, max_version=None):
     import paddle_tpu as _pt
 
     def parse(v):
-        return tuple(int(x) for x in str(v).split(".")[:3])
+        import re
+
+        parts = []
+        for x in str(v).split(".")[:3]:
+            m = re.match(r"\d+", x)  # "0-rc0" / "0rc1" -> 0
+            parts.append(int(m.group(0)) if m else 0)
+        return tuple(parts)
 
     cur = parse(_pt.__version__)
     if parse(min_version) > cur or (
@@ -151,3 +159,4 @@ def require_version(min_version, max_version=None):
             f"paddle_tpu version {_pt.__version__} outside required "
             f"[{min_version}, {max_version or 'any'}]")
     return _pt.__version__
+from . import contrib  # noqa: F401,E402

@@ -1,0 +1,526 @@
+"""fluid.contrib compatibility surface.
+
+Refs: python/paddle/fluid/contrib/ —
+- layers/rnn_impl.py: BasicGRUUnit/basic_gru/BasicLSTMUnit/basic_lstm
+- layers/nn.py: fused_elemwise_activation, sequence_topk_avg_pooling,
+  var_conv_2d, match_matrix_tensor, fused_embedding_seq_pool,
+  multiclass_nms2, shuffle_batch, partial_concat, partial_sum,
+  tdm_child, rank_attention, search_pyramid_hash
+- layers/metric_op.py: ctr_metric_bundle
+- mixed_precision/: AutoMixedPrecisionLists, decorate (live in amp/)
+- slim/quantization/: PostTrainingQuantization, WeightQuantization
+  (live in quant/)
+- extend_optimizer/: extend_with_decoupled_weight_decay
+- reader/distributed_reader.py: distributed_batch_reader
+- memory_usage_calc.py / op_frequence.py: program introspection
+
+Dense/静态-shape conventions as everywhere: LoD inputs become padded
+tensors + lengths.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import ops as _ops
+from ..core.tensor import Tensor
+from ..nn import functional as _F
+from ..nn.layer import Layer
+from ..ops._base import register, apply, unwrap
+
+# re-exports from the native homes
+from ..amp import AutoMixedPrecisionLists, decorate  # noqa: F401
+from ..quant import PostTrainingQuantization  # noqa: F401
+from ..ops.misc import tree_conv  # noqa: F401
+from .rnn import _FluidGRUCell, _gru_step
+
+__all__ = [
+    "BasicGRUUnit", "basic_gru", "BasicLSTMUnit", "basic_lstm",
+    "fused_elemwise_activation", "sequence_topk_avg_pooling",
+    "var_conv_2d", "match_matrix_tensor", "fused_embedding_seq_pool",
+    "multiclass_nms2", "shuffle_batch", "partial_concat", "partial_sum",
+    "tdm_child", "rank_attention", "search_pyramid_hash",
+    "ctr_metric_bundle", "AutoMixedPrecisionLists", "decorate",
+    "PostTrainingQuantization", "WeightQuantization",
+    "extend_with_decoupled_weight_decay", "distributed_batch_reader",
+    "memory_usage", "op_freq_statistic", "tree_conv",
+]
+
+
+# -- basic RNN units (ref: contrib/layers/rnn_impl.py) ----------------------
+
+
+class BasicGRUUnit(Layer):
+    """ref: rnn_impl.py BasicGRUUnit — raw GRU step cell.
+
+    The input projection is built on first forward (the reference's
+    _build_once behavior): run one forward BEFORE handing parameters()
+    to an optimizer, or pass ``input_size`` to build eagerly."""
+
+    def __init__(self, name_scope=None, hidden_size=None, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype="float32"):
+        super().__init__()
+        if hidden_size is None:  # fluid passes (name_scope, hidden)
+            hidden_size = name_scope
+        self.cell = _FluidGRUCell(hidden_size, param_attr, bias_attr,
+                                  "sigmoid", "tanh", False)
+        self.hidden_size = hidden_size
+        # input projection (BasicGRUUnit takes raw features)
+        self._proj = None
+        self._param_attr = param_attr
+
+    def forward(self, input, pre_hidden):
+        from .layers import fc
+
+        if self._proj is None:
+            from ..nn.layers.common import Linear
+
+            self._proj = Linear(int(input.shape[-1]),
+                                3 * self.hidden_size,
+                                weight_attr=self._param_attr)
+        x = self._proj(input)
+        new_h, _, _ = _gru_step(self.cell, x, pre_hidden, "sigmoid",
+                                "tanh", False)
+        return new_h
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype="float32",
+              name="basic_gru"):
+    """Stacked GRU (ref: rnn_impl.py basic_gru). Returns
+    (output_seq, last_hidden (L*dirs, B, H))."""
+    from ..nn.layers.rnn import GRU
+
+    x = input if batch_first else _ops.transpose(input, [1, 0, 2])
+    net = GRU(int(x.shape[-1]), hidden_size, num_layers=num_layers,
+              direction="bidirect" if bidirectional else "forward",
+              dropout=dropout_prob)
+    out, h = net(x, init_hidden, sequence_length=sequence_length)
+    if not batch_first:
+        out = _ops.transpose(out, [1, 0, 2])
+    return out, h
+
+
+class BasicLSTMUnit(Layer):
+    """ref: rnn_impl.py BasicLSTMUnit — raw LSTM step cell over
+    concat([x, h]) with a forget-gate bias."""
+
+    def __init__(self, name_scope=None, hidden_size=None, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        super().__init__()
+        if hidden_size is None:
+            hidden_size = name_scope
+        self.hidden_size = hidden_size
+        self.forget_bias = forget_bias
+        self._lin = None
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+
+    def forward(self, input, pre_hidden, pre_cell):
+        if self._lin is None:
+            from ..nn.layers.common import Linear
+
+            self._lin = Linear(
+                int(input.shape[-1]) + self.hidden_size,
+                4 * self.hidden_size, weight_attr=self._param_attr,
+                bias_attr=self._bias_attr)
+        H = self.hidden_size
+        g = self._lin(_ops.concat([input, pre_hidden], axis=-1))
+        i, f, c_cand, o = (g[:, :H], g[:, H:2 * H], g[:, 2 * H:3 * H],
+                           g[:, 3 * H:])
+        new_c = _F.sigmoid(f + self.forget_bias) * pre_cell + \
+            _F.sigmoid(i) * _F.tanh(c_cand)
+        new_h = _F.sigmoid(o) * _F.tanh(new_c)
+        return new_h, new_c
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0, bidirectional=False,
+               batch_first=True, param_attr=None, bias_attr=None,
+               gate_activation=None, activation=None, forget_bias=1.0,
+               dtype="float32", name="basic_lstm"):
+    """Stacked LSTM (ref: rnn_impl.py basic_lstm). Returns
+    (output_seq, last_hidden, last_cell)."""
+    from ..nn.layers.rnn import LSTM
+
+    x = input if batch_first else _ops.transpose(input, [1, 0, 2])
+    net = LSTM(int(x.shape[-1]), hidden_size, num_layers=num_layers,
+               direction="bidirect" if bidirectional else "forward",
+               dropout=dropout_prob)
+    init = None if init_hidden is None else (init_hidden, init_cell)
+    out, (h, c) = net(x, init, sequence_length=sequence_length)
+    if not batch_first:
+        out = _ops.transpose(out, [1, 0, 2])
+    return out, h, c
+
+
+# -- fused / CTR / text-matching ops (ref: contrib/layers/nn.py) ------------
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """ref: fused_elemwise_activation_op. XLA fuses elementwise chains
+    natively; this applies functor_list right-to-left."""
+    fns = {"elementwise_add": lambda a, b: a + b,
+           "elementwise_mul": lambda a, b: a * b,
+           "relu": lambda a: _F.relu(a),
+           "scale": lambda a: a * scale,
+           "tanh": lambda a: _F.tanh(a),
+           "sigmoid": lambda a: _F.sigmoid(a)}
+    f0, f1 = functor_list[0], functor_list[1]
+    if f1.startswith("elementwise"):
+        inner = fns[f1](x, y)
+        return fns[f0](inner) if f0 not in ("elementwise_add",
+                                            "elementwise_mul") \
+            else fns[f0](inner, y)
+    inner = fns[f1](y)
+    return fns[f0](x, inner)
+
+
+@register("seq_topk_avg_pool")
+def _seq_topk_avg_pool(x, lengths, *, topks):
+    # x (B, C, L) scores; per channel, average of the top-k valid entries
+    B, C, L = x.shape
+    mask = (jnp.arange(L)[None, :] < lengths[:, None])[:, None, :]
+    neg = jnp.where(mask, x, -jnp.inf)
+    srt = jnp.sort(neg, axis=-1)[..., ::-1]              # desc
+    outs = []
+    for k in topks:
+        top = srt[..., :k]
+        finite = jnp.isfinite(top)
+        s = jnp.where(finite, top, 0.0).sum(-1)
+        outs.append(s / jnp.maximum(finite.sum(-1), 1))
+    return jnp.stack(outs, axis=-1).reshape(B, C * len(topks))
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num,
+                              lengths=None):
+    """Top-k average pooling per channel over variable-length score rows
+    (ref: contrib/layers/nn.py sequence_topk_avg_pooling). Dense form:
+    input (B, C, L) + lengths (B,)."""
+    if lengths is None:
+        L = unwrap(input).shape[-1]
+        lengths = Tensor(jnp.full((unwrap(input).shape[0],), L, jnp.int32),
+                         _internal=True)
+    return apply("seq_topk_avg_pool", input, lengths,
+                 topks=tuple(int(k) for k in topks))
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
+                stride=1, param_attr=None, act=None, dtype="float32",
+                name=None, weight=None, lengths=None):
+    """Variable-size 2-D conv (ref: var_conv_2d_op): each row's image has
+    its own (h, w). Dense form: input (B, C, H, W) padded + per-row
+    (h, w) in ``row``/``col``; padding is masked out before the conv so
+    results match per-image convs."""
+    x = unwrap(input)
+    B, C, H, W = x.shape
+    hs = unwrap(row).reshape(-1)
+    ws = unwrap(col).reshape(-1)
+    ym = jnp.arange(H)[None, :] < hs[:, None]
+    xm = jnp.arange(W)[None, :] < ws[:, None]
+    mask = (ym[:, :, None] & xm[:, None, :])[:, None]
+    masked = Tensor(jnp.where(mask, x, 0.0), _internal=True)
+    if weight is None:
+        raise ValueError("pass weight=(O, C, k, k)")
+    out = _F.conv2d(masked, weight, stride=stride,
+                    padding=(int(filter_size) - 1) // 2)
+    if act is not None:
+        out = getattr(_F, act)(out)
+    return out
+
+
+@register("match_matrix")
+def _match_matrix(x, y, w):
+    # x (B, Lx, D), y (B, Ly, D), w (D, C, D) -> (B, C, Lx, Ly)
+    return jnp.einsum("bxd,dce,bye->bcxy", x, w, y)
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None, weight=None):
+    """Text-match similarity cube (ref: match_matrix_tensor_op):
+    out[b, c] = X W_c Y^T. Functional: pass weight (D, C, D). Returns
+    (out (B, C, Lx, Ly), out)."""
+    if weight is None:
+        raise ValueError("pass weight=(D, channel_num, D)")
+    out = apply("match_matrix", x, y, weight)
+    if act is not None:
+        out = getattr(_F, act)(out)
+    return out, out
+
+
+@register("fused_emb_seq_pool")
+def _fused_emb_seq_pool(table, ids, lengths, *, combiner):
+    # ids (B, L) -> lookup + masked sum/mean over L
+    emb = table[ids.astype(jnp.int32)]                   # (B, L, D)
+    mask = (jnp.arange(ids.shape[1])[None, :] <
+            lengths[:, None])[..., None]
+    s = jnp.where(mask, emb, 0.0).sum(axis=1)
+    if combiner == "mean":
+        s = s / jnp.maximum(lengths[:, None], 1).astype(s.dtype)
+    return s
+
+
+def fused_embedding_seq_pool(input, size=None, is_sparse=False,
+                             padding_idx=None, combiner="sum",
+                             param_attr=None, dtype="float32", weight=None,
+                             lengths=None):
+    """Embedding lookup fused with sequence sum/mean pool (ref:
+    fused_embedding_seq_pool_op). Functional: pass weight (V, D);
+    input (B, L) ids + lengths."""
+    if weight is None:
+        raise ValueError("pass weight=(V, D)")
+    ids = input
+    if lengths is None:
+        L = unwrap(ids).shape[1]
+        lengths = Tensor(jnp.full((unwrap(ids).shape[0],), L, jnp.int32),
+                         _internal=True)
+    return apply("fused_emb_seq_pool", weight, ids, lengths,
+                 combiner=combiner)
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=True, name=None):
+    """multiclass_nms that also returns selection indices (ref:
+    multiclass_nms2 op). Index is the flat (class * M + original box)
+    candidate id per kept row, -1 padded — computed inside the NMS
+    kernel, not reconstructed after."""
+    from ..ops.detection import multiclass_nms_with_index
+
+    out, index, counts = multiclass_nms_with_index(
+        bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+        nms_threshold, normalized, nms_eta, background_label)
+    if not return_index:
+        return out, counts
+    return out, index, counts
+
+
+def shuffle_batch(x, seed=None):
+    """Random batch-row permutation (ref: shuffle_batch_op)."""
+    from ..core import random as prandom
+
+    n = unwrap(x).shape[0]
+    perm = jax.random.permutation(prandom.next_key(), n)
+    return Tensor(unwrap(x)[perm], _internal=True)
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """Concat a feature slice of every input (ref: partial_concat_op)."""
+    parts = []
+    for t in input:
+        d = unwrap(t).shape[1]
+        end = d if length < 0 else start_index + length
+        parts.append(unwrap(t)[:, start_index:end])
+    return Tensor(jnp.concatenate(parts, axis=1), _internal=True)
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """Sum a feature slice across inputs (ref: partial_sum_op)."""
+    acc = None
+    for t in input:
+        d = unwrap(t).shape[1]
+        end = d if length < 0 else start_index + length
+        sl = unwrap(t)[:, start_index:end]
+        acc = sl if acc is None else acc + sl
+    return Tensor(acc, _internal=True)
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32",
+              tree_info=None):
+    """Tree-index child lookup (ref: tdm_child_op, tree-based deep
+    match): for each node id, return its children ids and a leaf mask.
+    ``tree_info`` (node_nums, 3 + child_nums): [item_id, layer, parent,
+    child_0..child_n] (0 = none)."""
+    if tree_info is None:
+        raise ValueError("pass tree_info=(node_nums, 3 + child_nums)")
+    info = unwrap(tree_info).astype(jnp.int32)
+    ids = unwrap(x).astype(jnp.int32).reshape(-1)
+    children = info[ids, 3:3 + child_nums]               # (N, child)
+    item_ids = info[children, 0]
+    leaf_mask = ((children != 0) & (item_ids != 0)).astype(jnp.int32)
+    shp = list(unwrap(x).shape) + [child_nums]
+    return (Tensor(children.reshape(shp), _internal=True),
+            Tensor(leaf_mask.reshape(shp), _internal=True))
+
+
+@register("rank_attention")
+def _rank_attention(x, rank_offset, rank_param, *, max_rank):
+    # x (B, D); rank_offset (B, >=1) with rank id in col 0;
+    # rank_param (max_rank * max_rank, D, out) the per-(rank, rank) block
+    B, D = x.shape
+    out_dim = rank_param.shape[-1]
+    rank = jnp.clip(rank_offset[:, 0].astype(jnp.int32), 0, max_rank - 1)
+    # per-sample block-diag attention: use the (rank, rank) block
+    block = rank_param.reshape(max_rank, max_rank, D, out_dim)
+    w = block[rank, rank]                                # (B, D, out)
+    return jnp.einsum("bd,bdo->bo", x, w)
+
+
+def rank_attention(input, rank_offset, rank_param_shape, rank_param_attr,
+                   max_rank=3, max_size=0, rank_param=None):
+    """CTR rank attention (ref: rank_attention_op): per-sample parameter
+    block selected by its rank feature. Functional: pass
+    ``rank_param (max_rank*max_rank*D, out)``."""
+    if rank_param is None:
+        raise ValueError("pass rank_param=(max_rank*max_rank, D, out)")
+    return apply("rank_attention", input, rank_offset, rank_param,
+                 max_rank=int(max_rank))
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,
+                        drop_out_percent=0.0, is_training=False,
+                        use_filter=False, white_list_len=0,
+                        black_list_len=0, seed=0, lr=1.0, param_attr=None,
+                        param_attr_wl=None, param_attr_bl=None, name=None,
+                        distribute_update_vars=None, embedding=None,
+                        lengths=None):
+    """Pyramid-hash embedding (ref: search_pyramid_hash op, CTR text
+    match): every n-gram (n = 2..pyramid_layer) of the id sequence is
+    hashed into ``embedding (space_len, rand_len)`` and the pieces
+    concatenate to num_emb per position, sum-pooled over the sequence.
+    Functional: pass ``embedding``."""
+    if embedding is None:
+        raise ValueError("pass embedding=(space_len, rand_len)")
+    ids = unwrap(input).astype(jnp.uint32)               # (B, L)
+    table = unwrap(embedding)
+    B, L = ids.shape
+    pieces = num_emb // rand_len
+    out = jnp.zeros((B, num_emb), table.dtype)
+    for n in range(2, pyramid_layer + 1):
+        if L < n:
+            break
+        # rolling n-gram keys
+        key = jnp.zeros((B, L - n + 1), jnp.uint32)
+        for j in range(n):
+            key = key * jnp.uint32(1000003) + ids[:, j:L - n + 1 + j]
+        for p in range(pieces):
+            mul = jnp.uint32(2654435761) * jnp.uint32(2 * p + 1) | \
+                jnp.uint32(1)
+            slot = (key * mul) % jnp.uint32(table.shape[0])
+            emb = table[slot.astype(jnp.int32)]          # (B, Lg, rand)
+            out = out.at[:, p * rand_len:(p + 1) * rand_len].add(
+                emb.sum(axis=1))
+    return Tensor(out, _internal=True)
+
+
+def ctr_metric_bundle(input, label):
+    """CTR aggregate stats (ref: contrib/layers/metric_op.py
+    ctr_metric_bundle): returns (local_sqrerr, local_abserr, local_prob,
+    local_q, local_pos_num, local_ins_num)."""
+    p = unwrap(input).astype(jnp.float32).reshape(-1)
+    y = unwrap(label).astype(jnp.float32).reshape(-1)
+    sqrerr = jnp.sum((p - y) ** 2)
+    abserr = jnp.sum(jnp.abs(p - y))
+    prob = jnp.sum(p)
+    q = jnp.sum(p / jnp.maximum(1.0 - p, 1e-6))
+    pos = jnp.sum(y)
+    n = jnp.asarray(float(p.shape[0]))
+    return tuple(Tensor(v, _internal=True)
+                 for v in (sqrerr, abserr, prob, q, pos, n))
+
+
+# -- slim / optimizer / reader extras ---------------------------------------
+
+
+class WeightQuantization:
+    """Weight-only int8/int16 quantization of a saved state dict (ref:
+    slim/quantization/post_training_quantization.py WeightQuantization)."""
+
+    def __init__(self, model_dir, model_filename=None,
+                 params_filename=None, state_dict=None):
+        self._state = state_dict
+        self._dir = model_dir
+
+    def quantize_weight_to_int(self, save_model_dir=None,
+                               weight_bits=8, quantizable_op_type=None,
+                               weight_quantize_type="channel_wise_abs_max",
+                               generate_test_model=False):
+        from ..quant import quantize_abs_max
+
+        state = self._state
+        if state is None:
+            import paddle_tpu as _pt
+
+            state = _pt.load(self._dir)
+        channel_axis = 0 if str(weight_quantize_type).startswith(
+            "channel_wise") else None
+        out = {}
+        for k, v in state.items():
+            arr = unwrap(v) if hasattr(v, "_data") else jnp.asarray(v)
+            if arr.ndim >= 2:
+                q, scale = quantize_abs_max(
+                    Tensor(arr, _internal=True), bits=weight_bits,
+                    channel_axis=channel_axis)
+                out[k] = (q, scale)
+            else:
+                out[k] = arr
+        return out
+
+
+def extend_with_decoupled_weight_decay(base_optimizer_cls):
+    """ref: extend_optimizer_with_weight_decay.py: returns a subclass
+    whose update applies decoupled (AdamW-style) weight decay."""
+
+    class DecoupledWeightDecay(base_optimizer_cls):
+        def __init__(self, *args, coeff=0.0, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._coeff = coeff
+
+        def _update(self, p, g, s, lr):
+            new_p, ns = super()._update(p, g, s, lr)
+            return new_p - lr * self._coeff * p, ns
+
+    DecoupledWeightDecay.__name__ = \
+        base_optimizer_cls.__name__ + "WithDecoupledWeightDecay"
+    return DecoupledWeightDecay
+
+
+def distributed_batch_reader(batch_reader):
+    """Shard a batch reader by trainer rank (ref:
+    reader/distributed_reader.py)."""
+
+    def impl():
+        from ..dist import env as denv
+
+        rank = denv.get_rank() if hasattr(denv, "get_rank") else 0
+        world = denv.get_world_size() if hasattr(denv, "get_world_size") \
+            else 1
+        for i, batch in enumerate(batch_reader()):
+            if i % world == rank:
+                yield batch
+
+    return impl
+
+
+def memory_usage(program, batch_size=1):
+    """Rough activation+param memory of a Program in MB (ref:
+    memory_usage_calc.py)."""
+    total = 0
+    for block in getattr(program, "blocks", []):
+        for var in getattr(block, "vars", {}).values():
+            shape = getattr(var, "shape", None)
+            if not shape:
+                continue
+            n = 1
+            for s in shape:
+                n *= batch_size if s in (-1, None) else int(s)
+            total += n * 4
+    return total / 1024.0 / 1024.0
+
+
+def op_freq_statistic(program):
+    """Count ops by type in a Program (ref: op_frequence.py)."""
+    uni, counts = {}, {}
+    for block in getattr(program, "blocks", []):
+        for op in getattr(block, "ops", []):
+            t = getattr(op, "type", str(op))
+            counts[t] = counts.get(t, 0) + 1
+            uni.setdefault(t, 0)
+            uni[t] += 1
+    return uni, counts

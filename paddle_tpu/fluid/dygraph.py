@@ -20,7 +20,18 @@ from ..dist.parallel import DataParallel  # noqa: F401
 
 __all__ = ["guard", "to_variable", "Layer", "Sequential", "LayerList",
            "ParameterList", "Linear", "Embedding", "Dropout", "Conv2D",
-           "BatchNorm", "DataParallel", "no_grad", "jit"]
+           "BatchNorm", "DataParallel", "no_grad", "jit",
+           "Conv2DTranspose", "Conv3D", "Conv3DTranspose", "GroupNorm",
+           "LayerNorm", "Pool2D", "PRelu", "SpectralNorm",
+           "BilinearTensorProduct", "NCE", "GRUUnit", "TreeConv",
+           "NoamDecay", "PiecewiseDecay", "PolynomialDecay", "CosineDecay",
+           "ExponentialDecay", "InverseTimeDecay", "NaturalExpDecay",
+           "enable_dygraph", "disable_dygraph", "enabled", "grad",
+           "save_dygraph", "load_dygraph", "BackwardStrategy",
+           "ParallelEnv", "prepare_context", "TracedLayer",
+           "dygraph_to_static_func", "dygraph_to_static_code",
+           "dygraph_to_static_output", "dygraph_to_static_program",
+           "start_gperf_profiler", "stop_gperf_profiler", "Parameter"]
 
 
 @contextlib.contextmanager
@@ -66,6 +77,9 @@ class Pool2D(Layer):
                  pool_padding=0, global_pooling=False, use_cudnn=True,
                  ceil_mode=False, exclusive=True, data_format="NCHW"):
         super().__init__()
+        if data_format != "NCHW":
+            raise NotImplementedError(
+                "Pool2D: NCHW only (transpose NHWC inputs first)")
         self._cfg = dict(pool_size=pool_size, pool_type=pool_type,
                          pool_stride=pool_stride, pool_padding=pool_padding,
                          global_pooling=global_pooling, ceil_mode=ceil_mode,

@@ -320,20 +320,32 @@ def _multiclass_nms(bboxes, scores, *, score_threshold, nms_top_k,
                 keep = keep & (c != background_label)
             return top_s, cand, keep
 
+        def one_class_idx(c):
+            s = scores_i[c]
+            s = jnp.where(s >= score_threshold, s, -jnp.inf)
+            _, top_i = lax.top_k(s, nms_top_k)
+            return top_i
+
         cs = jnp.arange(C)
         top_s, cand, keep = jax.vmap(one_class)(cs)  # (C, K), (C, K, 4)
+        orig = jax.vmap(one_class_idx)(cs)           # (C, K) box index in M
         flat_s = jnp.where(keep.reshape(-1), top_s.reshape(-1), -jnp.inf)
         flat_b = cand.reshape(-1, 4)
         flat_c = jnp.repeat(cs, nms_top_k)
+        # flat candidate id = class * M + original box index (the
+        # reference multiclass_nms2 index contract)
+        flat_id = (flat_c * M + orig.reshape(-1)).astype(jnp.int32)
         sel_s, sel_i = lax.top_k(flat_s, keep_top_k)
         valid = jnp.isfinite(sel_s)
         out = jnp.concatenate([
             jnp.where(valid, flat_c[sel_i], -1).astype(bboxes.dtype)[:, None],
             jnp.where(valid, sel_s, 0.0)[:, None],
             jnp.where(valid[:, None], flat_b[sel_i], 0.0)], axis=1)
-        return out, valid.sum().astype(jnp.int32)
+        index = jnp.where(valid, flat_id[sel_i], -1)
+        return out, index, valid.sum().astype(jnp.int32)
 
-    return jax.vmap(one_image)(bboxes, scores)
+    out, index, counts = jax.vmap(one_image)(bboxes, scores)
+    return out, index, counts
 
 
 def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
@@ -343,13 +355,27 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
     fixed (B, keep_top_k, 6) [label, score, x1, y1, x2, y2] padded with
     label -1, plus valid counts (B,) (the reference emits LoD instead).
     """
-    out, counts = apply(
+    out, _, counts = apply(
         "multiclass_nms", bboxes, scores,
         score_threshold=float(score_threshold), nms_top_k=int(nms_top_k),
         keep_top_k=int(keep_top_k), nms_threshold=float(nms_threshold),
         normalized=normalized, background_label=int(background_label),
         nms_eta=float(nms_eta))
     return out, counts
+
+
+def multiclass_nms_with_index(bboxes, scores, score_threshold, nms_top_k,
+                              keep_top_k, nms_threshold=0.3,
+                              normalized=True, nms_eta=1.0,
+                              background_label=0, name=None):
+    """multiclass_nms that also returns the flat candidate index
+    (class * M + original box index), -1 padded (ref: multiclass_nms2)."""
+    return apply(
+        "multiclass_nms", bboxes, scores,
+        score_threshold=float(score_threshold), nms_top_k=int(nms_top_k),
+        keep_top_k=int(keep_top_k), nms_threshold=float(nms_threshold),
+        normalized=normalized, background_label=int(background_label),
+        nms_eta=float(nms_eta))
 
 
 # ---------------------------------------------------------------------------

@@ -28,7 +28,8 @@ from .regularizer import L1Decay, L2Decay, WeightDecayRegularizer
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp", "Adam",
     "AdamW", "Adamax", "Lamb", "Ftrl", "ExponentialMovingAverage",
-    "LookAhead",
+    "LookAhead", "DecayedAdagrad", "Dpsgd", "LarsMomentum", "DGCMomentum",
+    "ModelAverage", "RecomputeOptimizer", "PipelineOptimizer",
 ]
 
 
@@ -535,6 +536,15 @@ class Dpsgd(Optimizer):
     def _update(self, p, g, s, lr):
         from ..core import random as prandom
 
+        if isinstance(g, jax.core.Tracer) and prandom._STATE.get("ctx") \
+                is None:
+            # Without a threaded key the noise would bake into the
+            # compiled update as a constant — identical (cancellable)
+            # noise every step, voiding the DP guarantee.
+            raise RuntimeError(
+                "Dpsgd under jit needs a threaded PRNG key: drive it "
+                "through paddle_tpu.TrainStep / paddle_tpu.jit (which "
+                "thread one per step), not a bare jax.jit")
         norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
         g = g * jnp.minimum(1.0, self._clip / jnp.maximum(norm, 1e-12)) \
             .astype(g.dtype)
@@ -613,7 +623,9 @@ class ModelAverage:
 
     def step(self):
         self._count += 1
-        restart = self._count > self.max_w
+        # restart the window past max_average_window, but never while the
+        # window is still shorter than min_average_window
+        restart = self._count > self.max_w and self._count > self.min_w
         for p in self._params:
             if restart:
                 self._sum[p.name] = p._data.astype(jnp.float32)
@@ -624,10 +636,14 @@ class ModelAverage:
             self._count = 1
 
     def apply(self, executor=None, need_restore=True):
+        if self._count == 0:
+            raise RuntimeError(
+                "ModelAverage.apply() before any step(): no accumulated "
+                "window to average (parameters would be zeroed)")
         self._backup = {p.name: p._data for p in self._params}
-        denom = max(self._count, 1)
         for p in self._params:
-            p._replace((self._sum[p.name] / denom).astype(p._data.dtype))
+            p._replace((self._sum[p.name] / self._count)
+                       .astype(p._data.dtype))
 
     def restore(self, executor=None):
         for p in self._params:

