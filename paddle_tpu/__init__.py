@@ -121,3 +121,22 @@ optimizer = optim  # paddle.optimizer namespace alias
 bool = bool_  # paddle.bool
 
 __all__ = [n for n in dir() if not n.startswith("_")]
+
+# reader-creator combinators + batching (ref: paddle/reader, batch.py)
+from . import reader  # noqa: E402
+from . import compat  # noqa: E402
+from .reader import batch  # noqa: E402
+
+# 1.x tensor-API aliases (ref: python/paddle/tensor/math.py __all__)
+div = ops.divide
+elementwise_equal = ops.equal
+elementwise_sum = ops.add_n
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """ref: tensor/creation.py create_tensor."""
+    return ops.zeros([1], dtype=dtype)
+
+
+__all__ += ["reader", "compat", "batch", "div", "elementwise_equal",
+            "elementwise_sum", "create_tensor"]

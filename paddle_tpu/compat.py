@@ -1,0 +1,62 @@
+"""py2/py3 compatibility helpers (ref: python/paddle/compat.py).
+
+Python 3-only now; kept so fluid-era code importing paddle.compat runs.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["long_type", "to_text", "to_bytes", "round", "floor_division",
+           "get_exception_message"]
+
+long_type = int
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """bytes/containers-of-bytes -> str (ref: compat.py to_text)."""
+    if obj is None:
+        return obj
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    if isinstance(obj, list):
+        return [to_text(o, encoding) for o in obj]
+    if isinstance(obj, set):
+        return {to_text(o, encoding) for o in obj}
+    if isinstance(obj, dict):
+        return {to_text(k, encoding): to_text(v, encoding)
+                for k, v in obj.items()}
+    return str(obj) if not isinstance(obj, str) else obj
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """str/containers-of-str -> bytes (ref: compat.py to_bytes)."""
+    if obj is None:
+        return obj
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    if isinstance(obj, list):
+        return [to_bytes(o, encoding) for o in obj]
+    if isinstance(obj, set):
+        return {to_bytes(o, encoding) for o in obj}
+    if isinstance(obj, dict):
+        return {to_bytes(k, encoding): to_bytes(v, encoding)
+                for k, v in obj.items()}
+    return obj
+
+
+def round(x, d=0):  # noqa: A001 (reference name)
+    """Python-2 style round-half-away-from-zero (ref: compat.py)."""
+    p = 10 ** d
+    if x > 0:
+        return float(math.floor((x * p) + 0.5)) / p
+    if x < 0:
+        return float(math.ceil((x * p) - 0.5)) / p
+    return 0.0
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

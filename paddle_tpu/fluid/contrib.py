@@ -92,7 +92,11 @@ def basic_gru(input, init_hidden, hidden_size, num_layers=1,
               gate_activation=None, activation=None, dtype="float32",
               name="basic_gru"):
     """Stacked GRU (ref: rnn_impl.py basic_gru). Returns
-    (output_seq, last_hidden (L*dirs, B, H))."""
+    (output_seq, last_hidden (L*dirs, B, H)).
+
+    Creates fresh parameters per call — the fluid build-time convention
+    (same as ``fluid.layers.fc``): call while building a static Program,
+    or hold an ``nn.layers.GRU`` module for eager training."""
     from ..nn.layers.rnn import GRU
 
     x = input if batch_first else _ops.transpose(input, [1, 0, 2])
@@ -145,7 +149,10 @@ def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
                gate_activation=None, activation=None, forget_bias=1.0,
                dtype="float32", name="basic_lstm"):
     """Stacked LSTM (ref: rnn_impl.py basic_lstm). Returns
-    (output_seq, last_hidden, last_cell)."""
+    (output_seq, last_hidden, last_cell).
+
+    Creates fresh parameters per call (fluid build-time convention, as
+    ``fc``); hold an ``nn.layers.LSTM`` module for eager training."""
     from ..nn.layers.rnn import LSTM
 
     x = input if batch_first else _ops.transpose(input, [1, 0, 2])
@@ -302,11 +309,14 @@ def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
 
 
 def shuffle_batch(x, seed=None):
-    """Random batch-row permutation (ref: shuffle_batch_op)."""
+    """Random batch-row permutation (ref: shuffle_batch_op); a fixed
+    ``seed`` gives a reproducible permutation."""
     from ..core import random as prandom
 
     n = unwrap(x).shape[0]
-    perm = jax.random.permutation(prandom.next_key(), n)
+    key = jax.random.PRNGKey(int(seed)) if seed is not None \
+        else prandom.next_key()
+    perm = jax.random.permutation(key, n)
     return Tensor(unwrap(x)[perm], _internal=True)
 
 
@@ -366,7 +376,7 @@ def rank_attention(input, rank_offset, rank_param_shape, rank_param_attr,
                    max_rank=3, max_size=0, rank_param=None):
     """CTR rank attention (ref: rank_attention_op): per-sample parameter
     block selected by its rank feature. Functional: pass
-    ``rank_param (max_rank*max_rank*D, out)``."""
+    ``rank_param (max_rank*max_rank, D, out)``."""
     if rank_param is None:
         raise ValueError("pass rank_param=(max_rank*max_rank, D, out)")
     return apply("rank_attention", input, rank_offset, rank_param,

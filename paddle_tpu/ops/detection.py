@@ -318,17 +318,11 @@ def _multiclass_nms(bboxes, scores, *, score_threshold, nms_top_k,
             keep = keep & jnp.isfinite(top_s)
             if background_label >= 0:
                 keep = keep & (c != background_label)
-            return top_s, cand, keep
-
-        def one_class_idx(c):
-            s = scores_i[c]
-            s = jnp.where(s >= score_threshold, s, -jnp.inf)
-            _, top_i = lax.top_k(s, nms_top_k)
-            return top_i
+            return top_s, cand, keep, top_i
 
         cs = jnp.arange(C)
-        top_s, cand, keep = jax.vmap(one_class)(cs)  # (C, K), (C, K, 4)
-        orig = jax.vmap(one_class_idx)(cs)           # (C, K) box index in M
+        top_s, cand, keep, orig = jax.vmap(one_class)(cs)
+        # top_s/keep (C, K); cand (C, K, 4); orig (C, K) box index in M
         flat_s = jnp.where(keep.reshape(-1), top_s.reshape(-1), -jnp.inf)
         flat_b = cand.reshape(-1, 4)
         flat_c = jnp.repeat(cs, nms_top_k)

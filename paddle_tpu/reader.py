@@ -1,0 +1,228 @@
+"""Reader decorators (ref: python/paddle/reader/decorator.py + batch.py).
+
+The classic composable reader-creator library: a "reader creator" is a
+zero-arg callable returning an iterator of samples. These combinators
+are host-side plumbing; device overlap is owned by io_/DataLoader and
+the native prefetch ring (runtime/cc) — SURVEY §4b.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _pyrandom
+import threading
+
+__all__ = [
+    "batch", "map_readers", "buffered", "compose", "chain", "shuffle",
+    "firstn", "cache", "xmap_readers", "multiprocess_reader",
+    "ComposeNotAligned",
+]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of ``batch_size`` (ref: batch.py)."""
+
+    def impl():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return impl
+
+
+def map_readers(func, *readers):
+    """Element-wise map over zipped readers (ref: decorator.py)."""
+
+    def impl():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            yield func(*items)
+
+    return impl
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer (ref: decorator.py shuffle)."""
+
+    def impl():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                _pyrandom.shuffle(buf)
+                for s in buf:
+                    yield s
+                buf = []
+        if buf:
+            _pyrandom.shuffle(buf)
+            for s in buf:
+                yield s
+
+    return impl
+
+
+def chain(*readers):
+    """Concatenate readers end-to-end (ref: decorator.py chain)."""
+
+    def impl():
+        return itertools.chain(*[r() for r in readers])
+
+    return impl
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples (ref: decorator.py compose).
+    check_alignment=True raises ComposeNotAligned on length mismatch."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def to_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def impl():
+        its = [r() for r in readers]
+        sentinel = object()
+        for items in itertools.zip_longest(*its, fillvalue=sentinel):
+            # identity test: `in` would run numpy elementwise equality
+            if any(i is sentinel for i in items):
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "readers have different lengths")
+                return
+            yield sum((to_tuple(i) for i in items), ())
+
+    return impl
+
+
+def buffered(reader, size):
+    """Prefetch up to ``size`` samples on a worker thread (ref:
+    decorator.py buffered)."""
+
+    def impl():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+        end = object()
+
+        def worker():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                break
+            yield s
+
+    return impl
+
+
+def firstn(reader, n):
+    """First n samples (ref: decorator.py firstn)."""
+
+    def impl():
+        return itertools.islice(reader(), n)
+
+    return impl
+
+
+def cache(reader):
+    """Materialize once, replay from memory (ref: decorator.py cache)."""
+    holder = {}
+
+    def impl():
+        if "data" not in holder:
+            holder["data"] = list(reader())
+        return iter(holder["data"])
+
+    return impl
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker THREADS (ref:
+    decorator.py xmap_readers; thread-based here — jax arrays and the
+    GIL make processes a poor trade on the host side)."""
+
+    def impl():
+        in_q: _queue.Queue = _queue.Queue(buffer_size)
+        out_q: _queue.Queue = _queue.Queue(buffer_size)
+        end = object()
+
+        def feeder():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def worker():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feeder, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=worker, daemon=True).start()
+        finished = 0
+        pending = {}
+        next_i = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if not order:
+                yield item[1]
+                continue
+            pending[item[0]] = item[1]
+            while next_i in pending:
+                yield pending.pop(next_i)
+                next_i += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return impl
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers via worker threads (ref:
+    decorator.py multiprocess_reader; thread-backed for the same reason
+    as xmap_readers)."""
+
+    def impl():
+        q: _queue.Queue = _queue.Queue(queue_size)
+        end = object()
+
+        def worker(r):
+            try:
+                for sample in r():
+                    q.put(sample)
+            finally:
+                q.put(end)
+
+        for r in readers:
+            threading.Thread(target=worker, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            s = q.get()
+            if s is end:
+                finished += 1
+                continue
+            yield s
+
+    return impl

@@ -1,0 +1,37 @@
+"""Reader-decorator + compat tests (ref: python/paddle/reader/decorator.py,
+batch.py, compat.py, tensor-API 1.x aliases)."""
+import numpy as np
+import paddle_tpu as pt
+
+
+def test_reader_decorators_and_compat():
+    r = pt.batch(lambda: iter(range(7)), 3)
+    assert list(r()) == [[0, 1, 2], [3, 4, 5], [6]]
+    r2 = pt.batch(lambda: iter(range(7)), 3, drop_last=True)
+    assert list(r2()) == [[0, 1, 2], [3, 4, 5]]
+    from paddle_tpu.reader import (map_readers, shuffle, chain, compose, buffered,
+                                   firstn, cache, xmap_readers, multiprocess_reader,
+                                   ComposeNotAligned)
+    assert list(map_readers(lambda a, b: a + b, lambda: iter([1, 2]), lambda: iter([10, 20]))()) == [11, 22]
+    assert sorted(shuffle(lambda: iter(range(5)), 2)()) == [0, 1, 2, 3, 4]
+    assert list(chain(lambda: iter([1]), lambda: iter([2]))()) == [1, 2]
+    assert list(compose(lambda: iter([1, 2]), lambda: iter([(3, 4), (5, 6)]))()) == [(1, 3, 4), (2, 5, 6)]
+    try:
+        list(compose(lambda: iter([1]), lambda: iter([1, 2]))())
+        raise AssertionError("compose should raise")
+    except ComposeNotAligned:
+        pass
+    assert list(buffered(lambda: iter(range(4)), 2)()) == [0, 1, 2, 3]
+    assert list(firstn(lambda: iter(range(9)), 3)()) == [0, 1, 2]
+    c = cache(lambda: iter(range(3)))
+    assert list(c()) == [0, 1, 2] and list(c()) == [0, 1, 2]
+    assert list(xmap_readers(lambda v: v * 2, lambda: iter(range(5)), 2, 4, order=True)()) == [0, 2, 4, 6, 8]
+    assert sorted(multiprocess_reader([lambda: iter([1, 2]), lambda: iter([3])])()) == [1, 2, 3]
+    from paddle_tpu import compat
+    assert compat.to_text(b"hi") == "hi" and compat.to_bytes("hi") == b"hi"
+    assert compat.round(2.5) == 3.0 and compat.round(-2.5) == -3.0
+    assert compat.floor_division(7, 2) == 3
+    assert int(np.asarray(pt.div(pt.to_tensor(np.array([4.0])), pt.to_tensor(np.array([2.0]))).numpy())) == 2
+    assert bool(np.asarray(pt.elementwise_equal(pt.to_tensor(np.array([1])), pt.to_tensor(np.array([1]))).numpy()))
+    assert list(pt.create_tensor("float32").shape) == [1]
+    print("READER/COMPAT OK")
