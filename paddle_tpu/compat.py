@@ -13,34 +13,62 @@ long_type = int
 
 
 def to_text(obj, encoding="utf-8", inplace=False):
-    """bytes/containers-of-bytes -> str (ref: compat.py to_text)."""
+    """bytes/containers-of-bytes -> str (ref: compat.py to_text);
+    ``inplace`` mutates list/dict containers like the reference."""
     if obj is None:
         return obj
     if isinstance(obj, bytes):
         return obj.decode(encoding)
     if isinstance(obj, list):
+        if inplace:
+            obj[:] = [to_text(o, encoding) for o in obj]
+            return obj
         return [to_text(o, encoding) for o in obj]
     if isinstance(obj, set):
-        return {to_text(o, encoding) for o in obj}
+        new_set = {to_text(o, encoding) for o in obj}
+        if inplace:
+            obj.clear()
+            obj |= new_set
+            return obj
+        return new_set
     if isinstance(obj, dict):
-        return {to_text(k, encoding): to_text(v, encoding)
-                for k, v in obj.items()}
+        new_d = {to_text(k, encoding): to_text(v, encoding)
+                 for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(new_d)
+            return obj
+        return new_d
     return str(obj) if not isinstance(obj, str) else obj
 
 
 def to_bytes(obj, encoding="utf-8", inplace=False):
-    """str/containers-of-str -> bytes (ref: compat.py to_bytes)."""
+    """str/containers-of-str -> bytes (ref: compat.py to_bytes);
+    ``inplace`` mutates list/dict containers like the reference."""
     if obj is None:
         return obj
     if isinstance(obj, str):
         return obj.encode(encoding)
     if isinstance(obj, list):
+        if inplace:
+            obj[:] = [to_bytes(o, encoding) for o in obj]
+            return obj
         return [to_bytes(o, encoding) for o in obj]
     if isinstance(obj, set):
-        return {to_bytes(o, encoding) for o in obj}
+        new_set = {to_bytes(o, encoding) for o in obj}
+        if inplace:
+            obj.clear()
+            obj |= new_set
+            return obj
+        return new_set
     if isinstance(obj, dict):
-        return {to_bytes(k, encoding): to_bytes(v, encoding)
-                for k, v in obj.items()}
+        new_d = {to_bytes(k, encoding): to_bytes(v, encoding)
+                 for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(new_d)
+            return obj
+        return new_d
     return obj
 
 

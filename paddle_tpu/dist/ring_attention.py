@@ -94,5 +94,14 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, mesh=None):
             return jax.shard_map(fn, mesh=m, in_specs=(spec, spec, spec),
                                  out_specs=spec)(qa, ka, va)
 
-    return apply("ring_attention", q, k, v, axis_name=axis_name,
-                 causal=bool(causal), mesh_id=id(mesh))
+    from . import env as denv
+
+    prev = denv.get_mesh()
+    if mesh is not prev:  # the op kernel resolves the mesh via get_mesh()
+        denv.set_mesh(mesh)
+    try:
+        return apply("ring_attention", q, k, v, axis_name=axis_name,
+                     causal=bool(causal), mesh_id=id(mesh))
+    finally:
+        if mesh is not prev:
+            denv.set_mesh(prev)

@@ -1,0 +1,103 @@
+"""All-to-all (Ulysses-style) sequence parallelism.
+
+The second long-context mode alongside ring attention (SURVEY §2 #24,
+"ring attention or all-to-all sequence/context parallelism"): activations
+arrive sharded on the SEQUENCE axis; two all_to_all collectives re-shard
+q/k/v onto the HEAD axis for the attention proper (each device then holds
+full-length sequences for H/n heads, so any dense/flash kernel applies
+unchanged), and a final all_to_all restores sequence sharding.
+
+Versus the ring: a2a moves each activation twice over ICI but keeps the
+attention itself completely local (no per-step ppermute on the critical
+path), which wins when H >= n and the per-device attention block is
+MXU-bound. Patterned on the public DeepSpeed-Ulysses formulation.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .env import get_mesh
+
+__all__ = ["all_to_all_attention_inner", "all_to_all_attention"]
+
+
+def _a2a(x, axis_name, split_axis, concat_axis):
+    """lax.all_to_all with tiled=True: split ``split_axis`` across the
+    group, concatenate received blocks on ``concat_axis``."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def all_to_all_attention_inner(q, k, v, axis_name, causal=False,
+                               scale=None):
+    """Per-shard kernel: call inside shard_map over ``axis_name``.
+
+    q,k,v: (B, H, L_local, D) — sequence-sharded like the ring kernel.
+    Internally re-shards to (B, H/n, L_full, D), runs local dense
+    attention with the full sequence in view, and re-shards back.
+    Requires H % axis_size == 0.
+    """
+    B, H, Lq, D = q.shape
+    n = jax.lax.axis_size(axis_name)
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    # seq-sharded -> head-sharded: split heads, gather sequence
+    qh = _a2a(q, axis_name, 1, 2)        # (B, H/n, L_full, D)
+    kh = _a2a(k, axis_name, 1, 2)
+    vh = _a2a(v, axis_name, 1, 2)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                   kh.astype(jnp.float32)) * scale
+    if causal:
+        L = s.shape[-1]
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    oh = jnp.einsum("bhqk,bhkd->bhqd", p,
+                    vh.astype(jnp.float32)).astype(q.dtype)
+    # head-sharded -> seq-sharded
+    return _a2a(oh, axis_name, 2, 1)
+
+
+def all_to_all_attention(q, k, v, axis_name="sp", causal=False, mesh=None):
+    """Layer-level entry, drop-in alternative to ``ring_attention``:
+    q,k,v (B, H, L, D) Tensors with L sharded over ``axis_name``."""
+    mesh = mesh or get_mesh()
+    if mesh is None or axis_name not in mesh.shape or \
+            mesh.shape[axis_name] == 1:
+        from ..nn.functional.attention import sdpa_bhld
+
+        return sdpa_bhld(q, k, v, is_causal=causal)
+
+    from ..ops._base import register, apply, OP_REGISTRY
+
+    if "ulysses_attention" not in OP_REGISTRY:
+        @register("ulysses_attention")
+        def _ua(qa, ka, va, *, axis_name, causal, mesh_id):
+            m = get_mesh()
+            n = m.shape[axis_name]
+            if qa.shape[1] % n:
+                raise ValueError(
+                    f"all_to_all attention needs heads ({qa.shape[1]}) "
+                    f"divisible by the '{axis_name}' axis ({n}); use "
+                    "ring_attention otherwise")
+            spec = P(None, None, axis_name, None)
+            fn = functools.partial(all_to_all_attention_inner,
+                                   axis_name=axis_name, causal=causal)
+            return jax.shard_map(fn, mesh=m, in_specs=(spec, spec, spec),
+                                 out_specs=spec)(qa, ka, va)
+
+    from . import env as denv
+
+    prev = denv.get_mesh()
+    if mesh is not prev:  # the op kernel resolves the mesh via get_mesh()
+        denv.set_mesh(mesh)
+    try:
+        return apply("ulysses_attention", q, k, v, axis_name=axis_name,
+                     causal=bool(causal), mesh_id=id(mesh))
+    finally:
+        if mesh is not prev:
+            denv.set_mesh(prev)

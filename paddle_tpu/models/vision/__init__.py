@@ -5,4 +5,5 @@ from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import MobileNetV1, MobileNetV2  # noqa: F401
 from .ssd import SSD, ssd_tiny  # noqa: F401
+from .faster_rcnn import FasterRCNN, faster_rcnn_tiny  # noqa: F401
 from .yolov3 import YOLOv3, yolov3_tiny  # noqa: F401

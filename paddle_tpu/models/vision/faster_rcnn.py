@@ -1,0 +1,201 @@
+"""Faster R-CNN two-stage detector.
+
+Ref (capability target): the reference's two-stage recipe assembled from
+its core ops — rpn_target_assign (layers/detection.py:157),
+generate_proposals (:2646), generate_proposal_labels (:2308),
+roi_align (layers/nn.py:6680), smooth_l1 + softmax heads (the PaddleCV
+Faster R-CNN configuration).
+
+TPU-native: every stage is static shape. Anchors are host-baked
+constants; proposals come back as a fixed (B, post_nms_top_n, 4) buffer
+with valid counts; second-stage sampling emits dense per-roi labels and
+masks instead of gathered index lists; the whole train step (backbone +
+RPN losses + RoI head losses) fuses into one XLA program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ops
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ...nn.layers.common import Linear
+from ...nn.layers.conv import Conv2D
+from ...nn.layers.norm import BatchNorm2D
+from ...nn import functional as F
+
+__all__ = ["FasterRCNN", "faster_rcnn_tiny"]
+
+
+class _ConvBN(Layer):
+    def __init__(self, cin, cout, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=padding,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+def _make_anchors(feat_hw, stride, sizes, ratios):
+    """Host-baked anchor grid (H, W, A, 4) in image coordinates."""
+    H, W = feat_hw
+    ws, hs = [], []
+    for s in sizes:
+        for r in ratios:
+            ws.append(s * np.sqrt(r))
+            hs.append(s / np.sqrt(r))
+    ws = np.asarray(ws, np.float32)
+    hs = np.asarray(hs, np.float32)
+    cx = (np.arange(W, dtype=np.float32) + 0.5) * stride
+    cy = (np.arange(H, dtype=np.float32) + 0.5) * stride
+    out = np.zeros((H, W, len(ws), 4), np.float32)
+    out[..., 0] = cy[:, None, None] * 0 + cx[None, :, None] - ws / 2
+    out[..., 1] = cy[:, None, None] - hs / 2
+    out[..., 2] = cx[None, :, None] + ws / 2
+    out[..., 3] = cy[:, None, None] + hs / 2
+    return out
+
+
+class FasterRCNN(Layer):
+    """Compact two-stage detector over the framework's RPN/RoI op suite.
+
+    Single feature level; ``image_size`` fixes the anchor grid (static
+    shapes end to end).
+    """
+
+    def __init__(self, num_classes=5, image_size=64, channels=32,
+                 anchor_sizes=(16.0, 32.0), anchor_ratios=(1.0,),
+                 post_nms_top_n=32, pooled_size=5, in_channels=3,
+                 rcnn_batch_per_im=32):
+        super().__init__()
+        self.num_classes = num_classes
+        self.image_size = image_size
+        self.stride = 8
+        self.post_nms_top_n = post_nms_top_n
+        self.pooled = pooled_size
+        self.rcnn_batch = rcnn_batch_per_im
+        A = len(anchor_sizes) * len(anchor_ratios)
+        self.A = A
+        # backbone: stride-8 feature map
+        self.c1 = _ConvBN(in_channels, channels, 3, stride=2, padding=1)
+        self.c2 = _ConvBN(channels, channels, 3, stride=2, padding=1)
+        self.c3 = _ConvBN(channels, channels, 3, stride=2, padding=1)
+        # RPN head
+        self.rpn_conv = Conv2D(channels, channels, 3, padding=1)
+        self.rpn_cls = Conv2D(channels, A, 1)
+        self.rpn_reg = Conv2D(channels, A * 4, 1)
+        # RoI head
+        head_in = channels * pooled_size * pooled_size
+        self.fc1 = Linear(head_in, 64)
+        self.cls_score = Linear(64, num_classes)
+        self.bbox_pred = Linear(64, num_classes * 4)
+        fh = image_size // self.stride
+        self._anchors = _make_anchors((fh, fh), self.stride, anchor_sizes,
+                                      anchor_ratios)
+
+    def backbone(self, x):
+        return self.c3(self.c2(self.c1(x)))
+
+    def rpn(self, feat):
+        h = F.relu(self.rpn_conv(feat))
+        return self.rpn_cls(h), self.rpn_reg(h)
+
+    def proposals(self, rpn_scores, rpn_deltas):
+        B = rpn_scores.shape[0]
+        im_info = Tensor(
+            np.tile(np.asarray(
+                [[self.image_size, self.image_size, 1.0]], np.float32),
+                (int(B), 1)), _internal=True)
+        return ops.generate_proposals(
+            F.sigmoid(rpn_scores), rpn_deltas, im_info,
+            Tensor(self._anchors, _internal=True), None,
+            pre_nms_top_n=4 * self.post_nms_top_n,
+            post_nms_top_n=self.post_nms_top_n, nms_thresh=0.7,
+            min_size=2.0)
+
+    def roi_head(self, feat, rois_flat, rois_per_im):
+        pooled = ops.roi_align(
+            feat, rois_flat, self.pooled, self.pooled,
+            spatial_scale=1.0 / self.stride,
+            rois_num=Tensor(np.full((int(feat.shape[0]),), rois_per_im,
+                                    np.int32), _internal=True))
+        flat = ops.reshape(pooled, [pooled.shape[0], -1])
+        h = F.relu(self.fc1(flat))
+        return self.cls_score(h), self.bbox_pred(h)
+
+    def forward(self, x):
+        """Inference path: (cls_scores, bbox_deltas, rois, roi_counts)."""
+        feat = self.backbone(x)
+        scores, deltas = self.rpn(feat)
+        rois, probs, counts = self.proposals(scores, deltas)
+        flat = ops.reshape(rois, [-1, 4])
+        cls, reg = self.roi_head(feat, flat, self.post_nms_top_n)
+        return cls, reg, rois, counts
+
+    def loss(self, x, gt_boxes, gt_labels):
+        """End-to-end two-stage loss for ONE-image batches of padded gts:
+        gt_boxes (B, G, 4), gt_labels (B, G) with -1 padding."""
+        feat = self.backbone(x)
+        rpn_scores, rpn_deltas = self.rpn(feat)
+        B = int(x.shape[0])
+        total = None
+        for b in range(B):  # static python loop over the (small) batch
+            gb = gt_boxes[b]
+            gl = gt_labels[b]
+            valid = ops.greater_equal(
+                gl, ops.zeros_like(gl))
+            # -- RPN losses over dense anchor targets
+            labels, tgt, fg, bg = ops.rpn_target_assign(
+                None, None, Tensor(self._anchors, _internal=True), None,
+                gb, rpn_batch_size_per_im=64, gt_valid=valid)
+            s = ops.reshape(ops.transpose(
+                rpn_scores[b:b + 1], [0, 2, 3, 1]), [-1])
+            d = ops.reshape(ops.transpose(ops.reshape(
+                rpn_deltas[b:b + 1],
+                [1, self.A, 4, feat.shape[2], feat.shape[3]]),
+                [0, 3, 4, 1, 2]), [-1, 4])
+            pos = labels.astype("float32") * (labels.astype("float32") > 0)
+            use = (labels.astype("float32") >= 0).astype("float32")
+            cls_loss = ops.sum(
+                F.binary_cross_entropy_with_logits(
+                    s, pos.astype("float32"), reduction="none") * use
+            ) / ops.maximum(ops.sum(use),
+                            ops.full([], 1.0))
+            fg_f = fg.astype("float32")
+            reg_loss = ops.sum(
+                F.smooth_l1_loss(d, tgt, reduction="none").sum(-1) * fg_f
+            ) / ops.maximum(ops.sum(fg_f), ops.full([], 1.0))
+            # -- proposals + second stage
+            rois, probs, counts = self.proposals(
+                rpn_scores[b:b + 1], rpn_deltas[b:b + 1])
+            flat = ops.reshape(rois, [-1, 4])
+            rlab, rtgt, rw, rfg, rbg, best = ops.generate_proposal_labels(
+                flat, gl, None, gb, batch_size_per_im=self.rcnn_batch,
+                class_nums=self.num_classes, gt_valid=valid)
+            cls, reg = self.roi_head(feat[b:b + 1], flat,
+                                     self.post_nms_top_n)
+            sel = (rlab.astype("float32") >= 0).astype("float32")
+            safe = ops.maximum(rlab, ops.zeros_like(rlab))
+            rcnn_cls = ops.sum(
+                F.cross_entropy(cls, safe, reduction="none") * sel
+            ) / ops.maximum(ops.sum(sel), ops.full([], 1.0))
+            reg_sel = ops.reshape(
+                reg, [-1, self.num_classes, 4])
+            picked = ops.take_along_axis(
+                reg_sel, ops.reshape(safe, [-1, 1, 1]).astype("int64")
+                .tile([1, 1, 4]), axis=1)[:, 0]
+            rfg_f = rfg.astype("float32")
+            rcnn_reg = ops.sum(
+                F.smooth_l1_loss(picked, rtgt, reduction="none").sum(-1)
+                * rfg_f) / ops.maximum(ops.sum(rfg_f), ops.full([], 1.0))
+            li = cls_loss + reg_loss + rcnn_cls + rcnn_reg
+            total = li if total is None else total + li
+        return total / B
+
+
+def faster_rcnn_tiny(num_classes=5, image_size=64):
+    return FasterRCNN(num_classes=num_classes, image_size=image_size,
+                      channels=16, post_nms_top_n=16, pooled_size=3,
+                      rcnn_batch_per_im=16)

@@ -109,11 +109,14 @@ def buffered(reader, size):
     def impl():
         q: _queue.Queue = _queue.Queue(maxsize=size)
         end = object()
+        err = []
 
         def worker():
             try:
                 for sample in reader():
                     q.put(sample)
+            except BaseException as e:  # re-raised on the consumer side
+                err.append(e)
             finally:
                 q.put(end)
 
@@ -124,6 +127,8 @@ def buffered(reader, size):
             if s is end:
                 break
             yield s
+        if err:
+            raise err[0]
 
     return impl
 
@@ -158,21 +163,30 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         in_q: _queue.Queue = _queue.Queue(buffer_size)
         out_q: _queue.Queue = _queue.Queue(buffer_size)
         end = object()
+        err = []
 
         def feeder():
-            for i, sample in enumerate(reader()):
-                in_q.put((i, sample))
-            for _ in range(process_num):
-                in_q.put(end)
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as e:
+                err.append(e)
+            finally:  # sentinels must flow even on failure, or we hang
+                for _ in range(process_num):
+                    in_q.put(end)
 
         def worker():
-            while True:
-                item = in_q.get()
-                if item is end:
-                    out_q.put(end)
-                    return
-                i, sample = item
-                out_q.put((i, mapper(sample)))
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end:
+                        return
+                    i, sample = item
+                    out_q.put((i, mapper(sample)))
+            except BaseException as e:
+                err.append(e)
+            finally:
+                out_q.put(end)
 
         threading.Thread(target=feeder, daemon=True).start()
         for _ in range(process_num):
@@ -192,9 +206,10 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             while next_i in pending:
                 yield pending.pop(next_i)
                 next_i += 1
-        if order:
-            for i in sorted(pending):
-                yield pending[i]
+        if err:
+            raise err[0]
+        # single FIFO: every item precedes its worker's end sentinel
+        assert not pending, "xmap_readers lost ordered items"
 
     return impl
 
@@ -207,11 +222,14 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     def impl():
         q: _queue.Queue = _queue.Queue(queue_size)
         end = object()
+        err = []
 
         def worker(r):
             try:
                 for sample in r():
                     q.put(sample)
+            except BaseException as e:
+                err.append(e)
             finally:
                 q.put(end)
 
@@ -224,5 +242,7 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
                 finished += 1
                 continue
             yield s
+        if err:
+            raise err[0]
 
     return impl
