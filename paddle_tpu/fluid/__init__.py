@@ -160,3 +160,4 @@ def require_version(min_version, max_version=None):
             f"[{min_version}, {max_version or 'any'}]")
     return _pt.__version__
 from . import contrib  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402

@@ -1,0 +1,199 @@
+"""fluid.incubate compatibility surface.
+
+Refs: python/paddle/fluid/incubate/ —
+- fleet/base/role_maker.py: Role, RoleMakerBase, UserDefinedRoleMaker,
+  UserDefinedCollectiveRoleMaker, PaddleCloudRoleMaker (env-driven)
+- fleet/base/fleet_base.py worker/server introspection + split_files
+- data_generator/__init__.py: MultiSlotDataGenerator,
+  MultiSlotStringDataGenerator (the CTR text-protocol generators)
+- fleet/utils/utils.py: save_program/load_program
+
+The parameter-server fleet mode itself is descoped (SURVEY §4b):
+role makers exist so PS-era launch scripts can still introspect
+rank/world and route into collective mode.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = [
+    "Role", "RoleMakerBase", "UserDefinedRoleMaker",
+    "UserDefinedCollectiveRoleMaker", "PaddleCloudRoleMaker",
+    "MultiSlotDataGenerator", "MultiSlotStringDataGenerator",
+    "split_files", "save_program", "load_program", "fleet",
+]
+
+from ..dist.fleet import fleet  # noqa: F401,E402
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    """ref: role_maker.py RoleMakerBase."""
+
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_endpoints = []
+        self._server_endpoints = []
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return len(self._worker_endpoints) or 1
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+    def generate_role(self):
+        pass
+
+    def barrier_worker(self):
+        """Collective barrier over the mesh (dist.collective.barrier)."""
+        from ..dist import env as denv
+
+        if denv.get_world_size() <= 1:
+            return
+        from ..dist.collective import barrier
+
+        barrier()
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """ref: role_maker.py UserDefinedRoleMaker."""
+
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=0,
+                 server_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._worker_endpoints = ["127.0.0.1:0"] * worker_num
+        self._server_endpoints = list(server_endpoints or [])
+
+
+class UserDefinedCollectiveRoleMaker(RoleMakerBase):
+    """ref: role_maker.py UserDefinedCollectiveRoleMaker."""
+
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._worker_endpoints = list(worker_endpoints or ["127.0.0.1:0"])
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """ref: role_maker.py PaddleCloudRoleMaker: rank/world from the
+    launch environment (here: the jax distributed env)."""
+
+    def __init__(self, is_collective=True):
+        super().__init__()
+        from ..dist import env as denv
+
+        self._current_id = int(os.environ.get(
+            "PADDLE_TRAINER_ID", denv.get_rank()))
+        n = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                               denv.get_world_size()))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
+        self._worker_endpoints = eps.split(",") if eps \
+            else ["127.0.0.1:0"] * n
+
+
+def split_files(files, trainer_id=None, trainers=None):
+    """Shard a file list across workers (ref: fleet_base.py
+    split_files)."""
+    from ..dist import env as denv
+
+    trainer_id = denv.get_rank() if trainer_id is None else trainer_id
+    trainers = denv.get_world_size() if trainers is None else trainers
+    return [f for i, f in enumerate(sorted(files))
+            if i % trainers == trainer_id]
+
+
+class MultiSlotDataGenerator:
+    """ref: data_generator/__init__.py MultiSlotDataGenerator — the CTR
+    slot-data text protocol: each sample is [(slot_name, [values])...]
+    serialized per slot as "<n> v1 .. vn" (names are schema, not wire
+    data). Subclasses override generate_sample(line) returning an
+    iterator of samples; generate_batch may be overridden to transform
+    each sample stream before serialization."""
+
+    def __init__(self):
+        self._proto_info = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            parts.append(str(len(values)))
+            parts += [str(v) for v in values]
+        return " ".join(parts)
+
+    def run_from_memory(self, lines=("",)):
+        """Yield serialized sample lines (test/dev path)."""
+        for line in lines:
+            it = self.generate_sample(line)
+            for sample in self.generate_batch(list(it()))():
+                yield self._format(sample)
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            it = self.generate_sample(line)
+            for sample in self.generate_batch(list(it()))():
+                sys.stdout.write(self._format(sample) + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-valued slots (ref: MultiSlotStringDataGenerator)."""
+
+
+def save_program(program, model_filename):
+    """Serialize a Program's symbolic description (ref:
+    fleet/utils/utils.py save_program)."""
+    with open(model_filename, "w") as f:
+        f.write(program.to_string() if hasattr(program, "to_string")
+                else str(program))
+
+
+def load_program(model_filename, is_text=True):
+    """Load a saved Program DESCRIPTION (text, for inspection — the
+    reference pairs these utils with PS-mode debugging). The executable
+    round-trip is save_inference_model/load_inference_model; binary
+    protos don't exist here, so is_text=False raises."""
+    if not is_text:
+        raise NotImplementedError(
+            "binary program protos are fluid-era; use "
+            "save_inference_model/load_inference_model for an "
+            "executable round-trip")
+    with open(model_filename) as f:
+        return f.read()

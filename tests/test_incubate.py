@@ -1,0 +1,42 @@
+"""fluid.incubate compat tests: role makers, split_files, the CTR
+MultiSlotDataGenerator text protocol, save/load_program.
+Ref: python/paddle/fluid/incubate/fleet/base/role_maker.py,
+data_generator/__init__.py, fleet/utils/utils.py."""
+import numpy as np
+import paddle_tpu as pt
+import paddle_tpu.fluid.incubate as inc
+
+
+def test_incubate_surface():
+
+    rm = inc.UserDefinedRoleMaker(current_id=1, role=inc.Role.WORKER, worker_num=4)
+    assert rm.is_worker() and not rm.is_first_worker() and rm.worker_num() == 4
+    rm2 = inc.UserDefinedCollectiveRoleMaker(0, ["a:1", "b:2"])
+    assert rm2.is_first_worker() and rm2.get_trainer_endpoints() == ["a:1", "b:2"]
+    rm3 = inc.PaddleCloudRoleMaker()
+    assert rm3.worker_num() >= 1
+    rm3.barrier_worker()
+    files = [f"part-{i}" for i in range(10)]
+    mine = inc.split_files(files, trainer_id=1, trainers=4)
+    assert mine == ["part-1", "part-5", "part-9"]
+
+    class Gen(inc.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("click", [1]), ("feat", [3, 4, 5])]
+            return it
+
+    lines = list(Gen().run_from_memory([""]))
+    assert lines == ["1 1 3 3 4 5"], lines
+
+    import tempfile
+    pt.enable_static()
+    prog = pt.static.Program()
+    with pt.static.program_guard(prog):
+        x = pt.static.data("x", [2, 3], "float32")
+    pt.disable_static()
+    p = tempfile.mktemp()
+    inc.save_program(prog, p)
+    txt = inc.load_program(p)
+    assert "x" in txt
+    print("INCUBATE OK")
