@@ -74,6 +74,8 @@ __all__ = [
     "load_inference_model", "to_static", "Layer", "contrib",
     "cpu_places", "cuda_places", "cuda_pinned_places", "device_guard",
     "get_flags", "set_flags", "load_op_library", "require_version",
+    "incubate", "transpiler", "DistributeTranspiler",
+    "DistributeTranspilerConfig", "memory_optimize", "release_memory",
 ]
 
 
@@ -161,3 +163,7 @@ def require_version(min_version, max_version=None):
     return _pt.__version__
 from . import contrib  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
+from . import transpiler  # noqa: F401,E402
+from .transpiler import (DistributeTranspiler,  # noqa: F401,E402
+                         DistributeTranspilerConfig, memory_optimize,
+                         release_memory)

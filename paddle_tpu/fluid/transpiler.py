@@ -1,0 +1,107 @@
+"""fluid.transpiler compatibility surface.
+
+Ref: python/paddle/fluid/transpiler/__init__.py — DistributeTranspiler,
+DistributeTranspilerConfig, HashName, RoundRobin, memory_optimize,
+release_memory.
+
+The parameter-server transpilation itself is a recorded descope
+(SURVEY §4b): on TPU pods, SPMD collectives over ICI subsume the PS
+mode, and ``fleet.init`` + DistributedStrategy is the supported path.
+The config/dispatcher objects are real so PS-era recipes can construct
+them and be routed to collective mode with a clear error at transpile
+time; memory passes are no-ops because XLA owns buffer planning.
+"""
+from __future__ import annotations
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "HashName", "RoundRobin", "memory_optimize", "release_memory"]
+
+
+class DistributeTranspilerConfig:
+    """ref: distribute_transpiler.py DistributeTranspilerConfig."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+        self.enable_dc_asgd = False
+        self.mode = "pserver"
+        self.print_log = False
+        self.wait_port = True
+        self.sync_mode = True
+        self.runtime_split_send_recv = False
+        self.geo_sgd_mode = False
+        self.geo_sgd_need_push_nums = 100
+
+
+class _PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    def reset(self):
+        self._step = 0
+
+    def eps(self):
+        return self._eps
+
+
+class HashName(_PSDispatcher):
+    """ref: ps_dispatcher.py HashName: var -> endpoint by name hash."""
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            name = v if isinstance(v, str) else v.name
+            out.append(self._eps[hash(name) % len(self._eps)])
+        return out
+
+
+class RoundRobin(_PSDispatcher):
+    """ref: ps_dispatcher.py RoundRobin: vars -> endpoints cyclically."""
+
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out
+
+
+class DistributeTranspiler:
+    """ref: distribute_transpiler.py DistributeTranspiler. Construction
+    succeeds (recipes build it unconditionally); ``transpile`` raises
+    with the collective-mode route — there are no CPU parameter shards
+    to host on a TPU pod (SURVEY §4b)."""
+
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint="127.0.0.1:6174"):
+        raise NotImplementedError(
+            "parameter-server transpilation is descoped on TPU "
+            "(SURVEY §4b): sparse tables shard over the mesh "
+            "(VocabParallelEmbedding) and gradients ride XLA "
+            "collectives. Use fleet.init(strategy) / "
+            "dist.init_parallel_env() instead.")
+
+    def get_trainer_program(self, wait_port=True):
+        raise NotImplementedError("call transpile() first (descoped)")
+
+    def get_pserver_program(self, endpoint):
+        raise NotImplementedError("call transpile() first (descoped)")
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """ref: memory_optimization_transpiler.py memory_optimize — a no-op
+    here AND in late fluid (deprecated): XLA's buffer assignment already
+    performs liveness-based reuse on the whole fused program."""
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """ref: release_memory — no-op; XLA owns buffer lifetimes."""
+    return None

@@ -22,6 +22,10 @@ from ..core.tensor import Tensor
 __all__ = [
     "save", "load", "save_inference_model", "load_inference_model",
     "save_checkpoint", "load_checkpoint",
+    "save_vars", "load_vars", "save_params", "load_params",
+    "save_persistables", "load_persistables",
+    "get_program_parameter", "get_program_persistable_vars",
+    "load_program_state", "set_program_state", "batch",
 ]
 
 
@@ -203,3 +207,168 @@ def load_checkpoint(directory, model=None, optimizer=None, scheduler=None,
     if scheduler is not None and "scheduler" in meta:
         scheduler.set_state_dict(meta["scheduler"])
     return meta["step"]
+
+
+# -- fluid.io var-level save/load (ref: fluid/io.py __all__) -----------------
+
+
+def _program_vars(program, predicate):
+    out = []
+    for v in program.global_block.vars.values():
+        if predicate(v):
+            out.append(v)
+    return out
+
+
+def get_program_parameter(program):
+    """ref: io.py get_program_parameter."""
+    return _program_vars(program, lambda v: v.is_parameter)
+
+
+def get_program_persistable_vars(program):
+    """ref: io.py get_program_persistable_vars."""
+    return _program_vars(program, lambda v: v.persistable)
+
+
+def _var_values(program, vars_, scope=None):
+    from ..static_.program import global_scope
+
+    scope = scope or global_scope()
+    out = {}
+    for v in vars_:
+        name = v if isinstance(v, str) else v.name
+        arr = scope.find_var(name)
+        if arr is None and hasattr(v, "_data") and v._data is not None:
+            arr = v._data
+        if arr is not None:
+            out[name] = np.asarray(arr)
+    return out
+
+
+def _vars_path(dirname, filename, default):
+    """np.savez appends .npz on write but np.load does NOT on read —
+    normalize once so non-default filenames round-trip."""
+    p = os.path.join(dirname, filename or default) if dirname \
+        else (filename or default)
+    return p if p.endswith(".npz") else p + ".npz"
+
+
+def save_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Save selected program variables as one npz (ref: io.py
+    save_vars; per-var files collapse into one archive here)."""
+    from ..static_.program import default_main_program
+
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = _program_vars(program, predicate or
+                             (lambda v: v.persistable))
+    values = _var_values(program, vars)
+    path = _vars_path(dirname, filename, "__vars__.npz")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path, **values)
+    return path
+
+
+def load_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    """Load variables saved by save_vars into the scope (ref: io.py
+    load_vars)."""
+    from ..static_.program import default_main_program, global_scope
+
+    program = main_program or default_main_program()
+    path = _vars_path(dirname, filename, "__vars__.npz")
+    data = np.load(path, allow_pickle=False)
+    scope = scope or global_scope()
+    want = None
+    if vars is not None:
+        want = {v if isinstance(v, str) else v.name for v in vars}
+    elif predicate is not None:
+        want = {v.name for v in _program_vars(program, predicate)}
+    if want is not None:
+        missing = sorted(want - set(data.files))
+        if missing:  # a silent partial restore looks like success
+            raise ValueError(
+                f"load_vars: {path} is missing variables {missing}")
+    for name in data.files:
+        if want is None or name in want:
+            scope.set(name, jnp.asarray(data[name]))
+
+
+def save_params(executor=None, dirname=None, main_program=None,
+                filename=None):
+    """ref: io.py save_params — parameters only."""
+    from ..static_.program import default_main_program
+
+    program = main_program or default_main_program()
+    return save_vars(executor, dirname, program,
+                     vars=get_program_parameter(program),
+                     filename=filename or "__params__.npz")
+
+
+def load_params(executor=None, dirname=None, main_program=None,
+                filename=None):
+    from ..static_.program import default_main_program
+
+    program = main_program or default_main_program()
+    load_vars(executor, dirname, program,
+              vars=get_program_parameter(program),
+              filename=filename or "__params__.npz")
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """ref: io.py save_persistables — all persistable vars (params +
+    optimizer state recorded in the program)."""
+    from ..static_.program import default_main_program
+
+    program = main_program or default_main_program()
+    return save_vars(executor, dirname, program,
+                     vars=get_program_persistable_vars(program),
+                     filename=filename or "__persistables__.npz")
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    from ..static_.program import default_main_program
+
+    program = main_program or default_main_program()
+    load_vars(executor, dirname, program,
+              vars=get_program_persistable_vars(program),
+              filename=filename or "__persistables__.npz")
+
+
+def load_program_state(model_path, var_list=None):
+    """ref: io.py load_program_state -> dict name->ndarray."""
+    p = model_path if model_path.endswith(".npz") else model_path + ".npz"
+    if not os.path.exists(p):
+        if not os.path.exists(model_path):
+            raise FileNotFoundError(
+                f"no program state at {model_path} (tried {p} too)")
+        obj = load(model_path, return_numpy=True)  # a save() pickle
+        if not isinstance(obj, dict):
+            raise ValueError(
+                f"{model_path} holds {type(obj).__name__}, not a "
+                "name->array state dict")
+        return {k: np.asarray(v) for k, v in obj.items()}
+    data = np.load(p, allow_pickle=False)
+    want = None if var_list is None else {
+        v if isinstance(v, str) else v.name for v in var_list}
+    return {n: data[n] for n in data.files
+            if want is None or n in want}
+
+
+def set_program_state(program, state_dict):
+    """ref: io.py set_program_state: write arrays into the program's
+    scope (and any materialized Variable handles)."""
+    from ..static_.program import global_scope
+
+    scope = global_scope()
+    blk = program.global_block
+    for name, arr in state_dict.items():
+        scope.set(name, jnp.asarray(arr))
+        if blk.has_var(name):
+            v = blk.var(name)
+            if getattr(v, "_data", None) is not None:
+                v._data = jnp.asarray(arr)
+from ..reader import batch  # noqa: F401,E402  (fluid.io.batch)

@@ -1,0 +1,65 @@
+"""fluid.io var-level save/load + transpiler namespace tests.
+Ref: python/paddle/fluid/io.py __all__ (save/load_params, persistables,
+program state) and transpiler/__init__.py."""
+import numpy as np
+import os
+import tempfile
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+
+
+def test_fluid_io_var_save_load():
+
+    pt.enable_static()
+    prog = pt.static.Program()
+    startup = pt.static.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4, 3], "float32")
+        y = fluid.layers.fc(x, 2)
+    exe = fluid.Executor()
+    exe.run(startup)
+    out1 = exe.run(prog, feed={"x": np.ones((4, 3), "float32")}, fetch_list=[y])[0]
+
+    d = tempfile.mkdtemp()
+    fluid.io.save_params(exe, d, prog)
+    params = fluid.io.get_program_parameter(prog)
+    assert len(params) >= 1
+    pv = fluid.io.get_program_persistable_vars(prog)
+    assert len(pv) >= len(params)
+
+    state = fluid.io.load_program_state(os.path.join(d, "__params__.npz"))
+    assert len(state) == len(params)
+    # zero out, reload, verify restored
+    zeroed = {k: np.zeros_like(v) for k, v in state.items()}
+    fluid.io.set_program_state(prog, zeroed)
+    out_z = exe.run(prog, feed={"x": np.ones((4, 3), "float32")}, fetch_list=[y])[0]
+    assert np.allclose(np.asarray(out_z), 0.0)
+    fluid.io.load_params(exe, d, prog)
+    out2 = exe.run(prog, feed={"x": np.ones((4, 3), "float32")}, fetch_list=[y])[0]
+    assert np.allclose(np.asarray(out1), np.asarray(out2), atol=1e-6)
+
+    fluid.io.save_persistables(exe, d, prog)
+    fluid.io.load_persistables(exe, d, prog)
+    assert callable(fluid.io.batch)
+    pt.disable_static()
+    print("FLUID IO OK")
+
+
+def test_transpiler_namespace():
+    import pytest
+    import paddle_tpu.fluid as fluid
+
+    cfg = fluid.DistributeTranspilerConfig()
+    assert cfg.sync_mode
+    t = fluid.DistributeTranspiler(cfg)
+    with pytest.raises(NotImplementedError):
+        t.transpile(0)
+    assert fluid.memory_optimize(None) is None
+    assert fluid.release_memory(None) is None
+    from paddle_tpu.fluid.transpiler import HashName, RoundRobin
+
+    rr = RoundRobin(["a", "b"])
+    assert rr.dispatch(["v1", "v2", "v3"]) == ["a", "b", "a"]
+    hn = HashName(["a", "b"])
+    d = hn.dispatch(["v1", "v1"])
+    assert d[0] == d[1]
