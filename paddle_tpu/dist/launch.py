@@ -1,0 +1,92 @@
+"""Distributed launcher (ref: python/paddle/distributed/launch.py).
+
+``python -m paddle_tpu.dist.launch [--nproc_per_node=N] train.py args``
+spawns one trainer process per rank with the PADDLE_TRAINER_* env the
+role makers read (fluid/incubate.py PaddleCloudRoleMaker).
+
+TPU semantics differ from the reference's one-process-per-GPU model:
+one process drives ALL local chips (SPMD over the mesh), so
+``--nproc_per_node`` defaults to 1 per host and exists mainly for
+CPU-simulation runs (each child gets JAX_PLATFORMS=cpu +
+xla_force_host_platform_device_count). Multi-host pods launch one
+process per host with ``--ips`` listing the hosts; jax.distributed
+wires the DCN side in dist/env.py.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+__all__ = ["launch", "get_cluster_endpoints"]
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.dist.launch")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="trainer processes on this host (TPU: keep 1; "
+                        ">1 forces CPU simulation per child)")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma-separated host list (multi-host pods)")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--started_port", type=int, default=6170)
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def get_cluster_endpoints(ips, nproc_per_node, started_port):
+    """All trainer endpoints, hosts-major (ref: get_cluster_from_args)."""
+    eps = []
+    for ip in ips.split(","):
+        for i in range(nproc_per_node):
+            eps.append(f"{ip}:{started_port + i}")
+    return eps
+
+
+def launch(args=None):
+    args = args or _parse_args()
+    eps = get_cluster_endpoints(args.ips, args.nproc_per_node,
+                                args.started_port)
+    nnodes = len(args.ips.split(","))
+    world = len(eps)
+    procs = []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    for local in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + local
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
+            "PADDLE_CURRENT_ENDPOINT": eps[rank],
+        })
+        if args.nproc_per_node > 1:
+            # multiple processes cannot share the TPU client: children
+            # run on the virtual-device CPU backend (test/sim mode)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.setdefault(
+                "XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+        cmd = [sys.executable, args.training_script] + \
+            args.training_script_args
+        out = None
+        if args.log_dir:
+            out = open(os.path.join(args.log_dir,
+                                    f"worker.{rank}.log"), "w")
+        procs.append((subprocess.Popen(cmd, env=env, stdout=out,
+                                       stderr=subprocess.STDOUT
+                                       if out else None), out))
+    rc = 0
+    for p, out in procs:
+        rc |= p.wait()
+        if out:
+            out.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

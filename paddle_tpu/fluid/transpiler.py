@@ -47,13 +47,18 @@ class _PSDispatcher:
 
 
 class HashName(_PSDispatcher):
-    """ref: ps_dispatcher.py HashName: var -> endpoint by name hash."""
+    """ref: ps_dispatcher.py HashName: var -> endpoint by a STABLE name
+    hash (builtin hash() is salted per process, which would give each
+    trainer a different var->endpoint mapping)."""
 
     def dispatch(self, varlist):
+        import zlib
+
         out = []
         for v in varlist:
             name = v if isinstance(v, str) else v.name
-            out.append(self._eps[hash(name) % len(self._eps)])
+            out.append(self._eps[zlib.crc32(name.encode())
+                                 % len(self._eps)])
         return out
 
 

@@ -264,6 +264,12 @@ def save_vars(executor=None, dirname=None, main_program=None, vars=None,
         vars = _program_vars(program, predicate or
                              (lambda v: v.persistable))
     values = _var_values(program, vars)
+    wanted = [v if isinstance(v, str) else v.name for v in vars]
+    valueless = sorted(set(wanted) - set(values))
+    if valueless:  # a silent partial save only fails at restore time
+        raise ValueError(
+            f"save_vars: no value in scope for {valueless} — run the "
+            "startup program (initializers) before saving")
     path = _vars_path(dirname, filename, "__vars__.npz")
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     np.savez(path, **values)
@@ -339,9 +345,22 @@ def load_persistables(executor=None, dirname=None, main_program=None,
 
 
 def load_program_state(model_path, var_list=None):
-    """ref: io.py load_program_state -> dict name->ndarray."""
+    """ref: io.py load_program_state -> dict name->ndarray. Accepts an
+    .npz path, a save_params/save_persistables dirname, or a save()
+    pickle path."""
     p = model_path if model_path.endswith(".npz") else model_path + ".npz"
-    if not os.path.exists(p):
+    if os.path.isdir(model_path):
+        # the reference usage passes the save_* dirname
+        for fn in ("__params__.npz", "__persistables__.npz",
+                   "__vars__.npz"):
+            cand = os.path.join(model_path, fn)
+            if os.path.exists(cand):
+                p = cand
+                break
+        else:
+            raise FileNotFoundError(
+                f"no saved variable archive under {model_path}")
+    elif not os.path.exists(p):
         if not os.path.exists(model_path):
             raise FileNotFoundError(
                 f"no program state at {model_path} (tried {p} too)")
@@ -354,6 +373,11 @@ def load_program_state(model_path, var_list=None):
     data = np.load(p, allow_pickle=False)
     want = None if var_list is None else {
         v if isinstance(v, str) else v.name for v in var_list}
+    if want is not None:
+        missing = sorted(want - set(data.files))
+        if missing:  # same strictness as load_vars
+            raise ValueError(
+                f"load_program_state: {p} is missing {missing}")
     return {n: data[n] for n in data.files
             if want is None or n in want}
 

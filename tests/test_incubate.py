@@ -40,3 +40,32 @@ def test_incubate_surface():
     txt = inc.load_program(p)
     assert "x" in txt
     print("INCUBATE OK")
+
+
+def test_dist_launch_spawns_ranked_workers(tmp_path):
+    """dist/launch.py: PADDLE_TRAINER_* env per child (ref:
+    distributed/launch.py)."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "child.py"
+    script.write_text(
+        "import os\n"
+        "print('rank', os.environ['PADDLE_TRAINER_ID'], 'of',\n"
+        "      os.environ['PADDLE_TRAINERS_NUM'])\n")
+    logdir = tmp_path / "logs"
+    rc = subprocess.call(
+        [sys.executable, "-m", "paddle_tpu.dist.launch",
+         "--nproc_per_node=2", f"--log_dir={logdir}", str(script)],
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert rc == 0
+    logs = sorted(p.read_text() for p in logdir.iterdir())
+    assert "rank 0 of 2" in logs[0] and "rank 1 of 2" in logs[1]
+
+
+def test_launch_endpoints():
+    from paddle_tpu.dist.launch import get_cluster_endpoints
+
+    eps = get_cluster_endpoints("10.0.0.1,10.0.0.2", 2, 6170)
+    assert eps == ["10.0.0.1:6170", "10.0.0.1:6171",
+                   "10.0.0.2:6170", "10.0.0.2:6171"]
