@@ -51,7 +51,6 @@ def launch(args=None):
     args = args or _parse_args()
     eps = get_cluster_endpoints(args.ips, args.nproc_per_node,
                                 args.started_port)
-    nnodes = len(args.ips.split(","))
     world = len(eps)
     procs = []
     if args.log_dir:
@@ -82,7 +81,9 @@ def launch(args=None):
                                        if out else None), out))
     rc = 0
     for p, out in procs:
-        rc |= p.wait()
+        code = p.wait()
+        if code != 0:  # collapse: OR-ing codes garbles signals/values
+            rc = 1
         if out:
             out.close()
     return rc

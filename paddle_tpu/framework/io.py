@@ -369,7 +369,17 @@ def load_program_state(model_path, var_list=None):
             raise ValueError(
                 f"{model_path} holds {type(obj).__name__}, not a "
                 "name->array state dict")
-        return {k: np.asarray(v) for k, v in obj.items()}
+        state = {k: np.asarray(v) for k, v in obj.items()}
+        if var_list is not None:  # same strictness as the npz branch
+            want = {v if isinstance(v, str) else v.name
+                    for v in var_list}
+            missing = sorted(want - set(state))
+            if missing:
+                raise ValueError(
+                    f"load_program_state: {model_path} is missing "
+                    f"{missing}")
+            state = {k: v for k, v in state.items() if k in want}
+        return state
     data = np.load(p, allow_pickle=False)
     want = None if var_list is None else {
         v if isinstance(v, str) else v.name for v in var_list}
