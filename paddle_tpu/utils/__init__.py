@@ -1,2 +1,3 @@
 """Utility subpackage (ref: python/paddle/fluid/unique_name.py, utils/)."""
 from . import unique_name  # noqa: F401
+from .plot import Ploter, PlotData, dump_config  # noqa: F401

@@ -1,0 +1,101 @@
+"""Training-curve plotting (ref: python/paddle/utils/plot.py Ploter).
+
+Headless-first: points are recorded and savable as CSV; if matplotlib
+is importable the classic .plot()/.savefig flow works too.
+"""
+from __future__ import annotations
+
+__all__ = ["Ploter", "PlotData", "dump_config"]
+
+
+class PlotData:
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(float(value))
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter:
+    """ref: plot.py Ploter — named train/test curve recorder."""
+
+    def __init__(self, *args):
+        self.__args__ = args
+        self.__plot_data__ = {t: PlotData() for t in args}
+
+    def append(self, title, step, value):
+        assert title in self.__plot_data__, \
+            f"{title} not in {self.__args__}"
+        self.__plot_data__[title].append(step, value)
+
+    def plot(self, path=None):
+        if path:
+            self.savefig(path)
+            return
+        try:
+            import matplotlib.pyplot as plt
+        except ImportError:  # headless image: text fallback
+            for title, data in self.__plot_data__.items():
+                if data.value:
+                    print(f"{title}: last={data.value[-1]:.6f} "
+                          f"over {len(data.value)} points")
+            return
+        for title, data in self.__plot_data__.items():
+            plt.plot(data.step, data.value, label=title)
+        plt.legend()
+        plt.show()
+
+    def savefig(self, path):
+        """Save curves; .csv always works, image formats need
+        matplotlib."""
+        if path.endswith(".csv"):
+            with open(path, "w") as f:
+                f.write("title,step,value\n")
+                for title, data in self.__plot_data__.items():
+                    for s, v in zip(data.step, data.value):
+                        f.write(f"{title},{s},{v}\n")
+            return path
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots()
+        for title, data in self.__plot_data__.items():
+            ax.plot(data.step, data.value, label=title)
+        ax.legend()
+        fig.savefig(path)
+        return path
+
+    def reset(self):
+        for data in self.__plot_data__.values():
+            data.reset()
+
+
+def dump_config(obj, path=None, indent=2):
+    """Serialize a config-ish object to readable text (ref:
+    utils/__init__.py dump_config, protobuf-era)."""
+    import json
+
+    def conv(o):
+        if hasattr(o, "__dict__"):
+            return {k: conv(v) for k, v in vars(o).items()
+                    if not k.startswith("_")}
+        if isinstance(o, (list, tuple)):
+            return [conv(v) for v in o]
+        if isinstance(o, dict):
+            return {k: conv(v) for k, v in o.items()}
+        return o if isinstance(o, (int, float, str, bool, type(None))) \
+            else str(o)
+
+    text = json.dumps(conv(obj), indent=indent)
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text

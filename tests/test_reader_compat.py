@@ -35,3 +35,26 @@ def test_reader_decorators_and_compat():
     assert bool(np.asarray(pt.elementwise_equal(pt.to_tensor(np.array([1])), pt.to_tensor(np.array([1]))).numpy()))
     assert list(pt.create_tensor("float32").shape) == [1]
     print("READER/COMPAT OK")
+
+
+def test_ploter_and_dump_config(tmp_path):
+    """paddle.utils Ploter/dump_config (ref: utils/plot.py)."""
+    from paddle_tpu.utils import Ploter, dump_config
+
+    p = Ploter("train", "test")
+    for i in range(5):
+        p.append("train", i, 1.0 / (i + 1))
+    p.append("test", 0, 0.5)
+    path = str(tmp_path / "curves.csv")
+    p.savefig(path)
+    rows = open(path).read().splitlines()
+    assert rows[0] == "title,step,value" and len(rows) == 7
+    p.reset()
+    assert not p.__plot_data__["train"].value
+
+    class Cfg:
+        def __init__(self):
+            self.lr = 0.1
+            self.layers = [1, 2]
+
+    assert '"lr": 0.1' in dump_config(Cfg())

@@ -1,0 +1,54 @@
+"""Real-TPU pallas kernel probe.
+
+Run ON HARDWARE (no CPU env trick) after any kernel change:
+    python tools/tpu_probe.py
+Interpret-mode tests cannot catch Mosaic lowering rejections (the
+(8, 128) min-tile rule) or VMEM overflows — only a compiled run can.
+Keep the tunnel to ONE process at a time (see memory: axon-tunnel-ops).
+"""
+import sys
+import os
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from paddle_tpu.ops.pallas import flash_attention, fused_layer_norm, softmax_cross_entropy
+
+print("backend:", jax.default_backend(), jax.devices())
+
+def try_case(name, fn):
+    try:
+        out = fn()
+        jax.block_until_ready(out)
+        print(f"{name}: OK")
+    except Exception as e:
+        msg = str(e).split("\n")[0][:300]
+        print(f"{name}: FAIL {type(e).__name__}: {msg}")
+
+# layernorm fwd+bwd, bench-ish shape
+x = jnp.asarray(np.random.randn(4096, 768), jnp.bfloat16)
+g = jnp.ones((768,), jnp.bfloat16)
+b = jnp.zeros((768,), jnp.bfloat16)
+try_case("ln fwd", lambda: fused_layer_norm(x, g, b))
+def ln_grad():
+    f = lambda x, g, b: jnp.sum(fused_layer_norm(x, g, b).astype(jnp.float32))
+    return jax.grad(f, argnums=(0, 1, 2))(x, g, b)
+try_case("ln bwd", ln_grad)
+
+# flash attention fwd+bwd, GPT bench shape (B=8,H=12,L=1024,D=64)
+q = jnp.asarray(np.random.randn(2, 12, 1024, 64), jnp.bfloat16)
+try_case("flash fwd", lambda: flash_attention(q, q, q, True))
+def fa_grad():
+    f = lambda q: jnp.sum(flash_attention(q, q, q, True).astype(jnp.float32))
+    return jax.grad(f)(q)
+try_case("flash bwd", fa_grad)
+
+# softmax CE, LM-head shape
+logits = jnp.asarray(np.random.randn(1024, 50304), jnp.bfloat16)
+labels = jnp.asarray(np.random.randint(0, 50304, (1024,)), jnp.int32)
+try_case("ce fwd", lambda: softmax_cross_entropy(logits, labels))
+def ce_grad():
+    f = lambda l: jnp.sum(softmax_cross_entropy(l, labels))
+    return jax.grad(f)(logits)
+try_case("ce bwd", ce_grad)
