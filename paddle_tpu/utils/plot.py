@@ -61,12 +61,14 @@ class Ploter:
                     for s, v in zip(data.step, data.value):
                         f.write(f"{title},{s},{v}\n")
             return path
-        import matplotlib
+        # object-oriented API: no global backend switch, no pyplot
+        # figure registry to leak
+        from matplotlib.backends.backend_agg import FigureCanvasAgg
+        from matplotlib.figure import Figure
 
-        matplotlib.use("Agg")
-        import matplotlib.pyplot as plt
-
-        fig, ax = plt.subplots()
+        fig = Figure()
+        FigureCanvasAgg(fig)
+        ax = fig.add_subplot(111)
         for title, data in self.__plot_data__.items():
             ax.plot(data.step, data.value, label=title)
         ax.legend()
@@ -83,16 +85,25 @@ def dump_config(obj, path=None, indent=2):
     utils/__init__.py dump_config, protobuf-era)."""
     import json
 
+    seen = set()
+
     def conv(o):
-        if hasattr(o, "__dict__"):
-            return {k: conv(v) for k, v in vars(o).items()
-                    if not k.startswith("_")}
-        if isinstance(o, (list, tuple)):
-            return [conv(v) for v in o]
-        if isinstance(o, dict):
-            return {k: conv(v) for k, v in o.items()}
-        return o if isinstance(o, (int, float, str, bool, type(None))) \
-            else str(o)
+        if isinstance(o, (int, float, str, bool, type(None))):
+            return o
+        if id(o) in seen:  # cycle (e.g. child.parent back-references)
+            return f"<cycle: {type(o).__name__}>"
+        seen.add(id(o))
+        try:
+            if hasattr(o, "__dict__"):
+                return {k: conv(v) for k, v in vars(o).items()
+                        if not k.startswith("_")}
+            if isinstance(o, (list, tuple)):
+                return [conv(v) for v in o]
+            if isinstance(o, dict):
+                return {k: conv(v) for k, v in o.items()}
+            return str(o)
+        finally:
+            seen.discard(id(o))
 
     text = json.dumps(conv(obj), indent=indent)
     if path:
