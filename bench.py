@@ -234,7 +234,8 @@ def _run_benches(results):
         bench_gpt = functools.partial(bench_gpt, B=1, L=128)
     for name, fn in (("bert", bench_bert), ("resnet50", bench_resnet50),
                      ("gpt", bench_gpt)):
-        for attempt in (1, 2):  # one retry: the tunnel drops transiently
+        pallas_env0 = os.environ.get("PADDLE_TPU_PALLAS")
+        for attempt in (1, 2, 3):
             try:
                 t0 = time.perf_counter()
                 results[name] = fn()
@@ -247,11 +248,32 @@ def _run_benches(results):
                 _log(f"{name} FAILED (attempt {attempt}): "
                      f"{type(e).__name__}: {e}")
                 _log(traceback.format_exc())
-                transient = "UNAVAILABLE" in str(e) or "Connection" in str(e)
-                if not (transient and attempt == 1):
-                    break
-                time.sleep(10.0)
-    if "gpt" in results and not SMOKE:
+                msg = str(e)
+                ml = msg.lower()
+                transient = "UNAVAILABLE" in msg or "Connection" in msg
+                kernel_bug = "pallas" in ml or "mosaic" in ml \
+                    or "VMEM" in msg
+                pallas_on = os.environ.get("PADDLE_TPU_PALLAS") \
+                    not in ("0", "false", "off")
+                if transient and attempt < 3:  # retry as-is first
+                    time.sleep(10.0)
+                    continue
+                if kernel_bug and pallas_on and attempt < 3:
+                    # a broken kernel must not zero the whole leg: the
+                    # dense XLA path is the measurement fallback
+                    _log(f"{name}: retrying with pallas disabled")
+                    os.environ["PADDLE_TPU_PALLAS"] = "0"
+                    results.setdefault("_extras", {})[
+                        name + "_pallas_disabled"] = True
+                    continue
+                break
+        # a kernel-bug fallback must not leak pallas-off into later legs
+        if pallas_env0 is None:
+            os.environ.pop("PADDLE_TPU_PALLAS", None)
+        else:
+            os.environ["PADDLE_TPU_PALLAS"] = pallas_env0
+    gpt_fell_back = results.get("_extras", {}).get("gpt_pallas_disabled")
+    if "gpt" in results and not SMOKE and not gpt_fell_back:
         # pallas-attributable delta: rerun GPT with the kernels disabled
         old = os.environ.get("PADDLE_TPU_PALLAS")
         os.environ["PADDLE_TPU_PALLAS"] = "0"
@@ -337,6 +359,7 @@ def main():
 
 
 def _score(results, headline, extras):
+    extras.update(results.pop("_extras", {}))
     if "bert" in results:
         headline = {
             "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
