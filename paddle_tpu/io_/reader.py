@@ -12,7 +12,7 @@ import threading
 import numpy as np
 
 __all__ = [
-    "batch", "shuffle", "buffered", "map_readers", "xmap_readers", "chain",
+    "batch", "shuffle", "shuffle_stream", "buffered", "map_readers", "xmap_readers", "chain",
     "compose", "firstn", "cache", "DataFeeder",
 ]
 
@@ -185,3 +185,54 @@ class DataFeeder:
         for name, col in zip(self.feed_names, cols):
             out[name] = np.stack([np.asarray(c) for c in col])
         return out
+
+
+def shuffle_stream(reader, buf_size=1024, seed=0):
+    """Streaming shuffle backed by the native reservoir
+    (runtime/cc PtShufflePool): a producer thread fills the pool while
+    the consumer draws uniformly random samples, so shuffling overlaps
+    with upstream decode work (the python ``shuffle`` drains its buffer
+    in bursts instead). Samples are pickled through the pool."""
+    import pickle
+
+    from ..runtime import ShufflePool
+
+    def impl():
+        pool = ShufflePool(capacity=buf_size, seed=seed,
+                           min_fill=max(buf_size // 2, 1))
+        err = []
+        done = []
+
+        def producer():
+            try:
+                for sample in reader():
+                    if not pool.push(pickle.dumps(sample)):
+                        return          # consumer closed the pool
+            except BaseException as e:
+                err.append(e)
+            finally:
+                done.append(True)
+                pool.close()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                try:
+                    # short waits so a slow producer is a retry, not EOF
+                    blob = pool.pop(timeout_ms=1000)
+                except TimeoutError:
+                    if done and not len(pool):
+                        break
+                    continue
+                if blob is None:
+                    break
+                yield pickle.loads(blob)
+        finally:
+            # unblock a producer stuck in push if the consumer bails
+            pool.close()
+            t.join(timeout=5.0)
+        if err:
+            raise err[0]
+
+    return impl

@@ -81,6 +81,20 @@ def get_lib():
         lib.pt_shard_reader_errors.restype = ctypes.c_int
         lib.pt_shard_reader_errors.argtypes = [ctypes.c_void_p]
         lib.pt_shard_reader_free.argtypes = [ctypes.c_void_p]
+        lib.pt_shuffle_new.restype = ctypes.c_void_p
+        lib.pt_shuffle_new.argtypes = [ctypes.c_size_t, ctypes.c_uint64]
+        lib.pt_shuffle_push.restype = ctypes.c_int
+        lib.pt_shuffle_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_size_t]
+        lib.pt_shuffle_pop.restype = ctypes.c_int
+        lib.pt_shuffle_pop.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_void_p),
+                                       ctypes.POINTER(ctypes.c_size_t),
+                                       ctypes.c_size_t, ctypes.c_long]
+        lib.pt_shuffle_len.restype = ctypes.c_size_t
+        lib.pt_shuffle_len.argtypes = [ctypes.c_void_p]
+        lib.pt_shuffle_close.argtypes = [ctypes.c_void_p]
+        lib.pt_shuffle_free.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -259,5 +273,110 @@ class ShardReader:
     def __del__(self):
         try:
             self.close()
+        except Exception:
+            pass
+
+
+class ShufflePool:
+    """Bounded reservoir with uniform random pops — the native analog of
+    the buffered shuffle reader (cc: PtShufflePool); python-queue-free
+    so producers can feed it from worker threads without GIL churn.
+    Falls back to a pure-python reservoir when the library is absent."""
+
+    def __init__(self, capacity=1024, seed=0, min_fill=None):
+        self._min_fill = min(min_fill if min_fill is not None
+                             else capacity // 2, capacity)
+        lib = get_lib()
+        self._lib = lib
+        if lib is not None:
+            self._h = lib.pt_shuffle_new(capacity, seed or 0)
+        else:
+            import random
+
+            self._h = None
+            self._pool = []
+            self._rng = random.Random(seed)
+            self._cap = capacity
+            self._closed = False
+            import threading as _t
+
+            self._cv = _t.Condition()
+
+    def push(self, data: bytes) -> bool:
+        if self._h is not None:
+            rc = self._lib.pt_shuffle_push(self._h, data, len(data))
+            if rc == -2:  # malloc failure is an error, not a quiet stop
+                raise MemoryError("ShufflePool: native allocation failed")
+            return rc == 0
+        with self._cv:
+            while len(self._pool) >= self._cap and not self._closed:
+                self._cv.wait(0.1)
+            if self._closed:
+                return False
+            self._pool.append(bytes(data))
+            self._cv.notify_all()
+            return True
+
+    def pop(self, timeout_ms=-1):
+        """A uniformly random blob; None when closed and drained; raises
+        TimeoutError when ``timeout_ms`` elapses first (a slow producer
+        is not end-of-stream)."""
+        if self._h is not None:
+            data = ctypes.c_void_p()
+            size = ctypes.c_size_t()
+            rc = self._lib.pt_shuffle_pop(self._h, ctypes.byref(data),
+                                          ctypes.byref(size),
+                                          self._min_fill, timeout_ms)
+            if rc == 1:
+                raise TimeoutError(
+                    f"ShufflePool.pop: no sample within {timeout_ms}ms")
+            if rc != 0:
+                return None
+            out = ctypes.string_at(data, size.value)
+            self._lib.pt_blob_free(data)
+            return out
+        import time as _time
+
+        deadline = None if timeout_ms < 0 \
+            else _time.monotonic() + timeout_ms / 1000.0
+        with self._cv:
+            while True:
+                ready = len(self._pool) >= (1 if self._closed
+                                            else max(self._min_fill, 1))
+                if ready or (self._closed and not self._pool):
+                    break
+                if deadline is not None and _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"ShufflePool.pop: no sample within {timeout_ms}ms")
+                self._cv.wait(0.1)
+            if not self._pool:
+                return None
+            i = self._rng.randrange(len(self._pool))
+            self._pool[i], self._pool[-1] = self._pool[-1], self._pool[i]
+            out = self._pool.pop()
+            self._cv.notify_all()
+            return out
+
+    def __len__(self):
+        if self._h is not None:
+            return self._lib.pt_shuffle_len(self._h)
+        with self._cv:
+            return len(self._pool)
+
+    def close(self):
+        if self._h is not None:
+            self._lib.pt_shuffle_close(self._h)
+        else:
+            with self._cv:
+                self._closed = True
+                self._cv.notify_all()
+
+    def __del__(self):
+        try:
+            if self._h is not None:
+                # close first: freeing under a producer still blocked in
+                # pt_shuffle_push would be a use-after-free
+                self._lib.pt_shuffle_close(self._h)
+                self._lib.pt_shuffle_free(self._h)
         except Exception:
             pass

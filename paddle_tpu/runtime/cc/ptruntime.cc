@@ -336,3 +336,97 @@ void pt_shard_reader_free(PtShardReader* sr) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Shuffle pool: bounded reservoir of byte blobs with uniform random pops.
+// The native analog of the reference's buffered shuffle reader decorator
+// (python/paddle/reader/decorator.py shuffle): producers push decoded
+// samples without holding the GIL; consumers pop a uniformly random
+// element once the pool has warmed up. xorshift64* keeps draws cheap and
+// deterministic per seed.
+// ---------------------------------------------------------------------------
+
+struct PtShufflePool {
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::vector<PtBlob> pool;
+  size_t capacity;
+  uint64_t rng;
+  bool closed = false;
+};
+
+static uint64_t pt_xorshift(uint64_t* s) {
+  uint64_t x = *s;
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  *s = x;
+  return x * 0x2545F4914F6CDD1DULL;
+}
+
+extern "C" {
+
+PtShufflePool* pt_shuffle_new(size_t capacity, uint64_t seed) {
+  auto* p = new PtShufflePool();
+  p->capacity = capacity ? capacity : 1;
+  p->rng = seed ? seed : 0x9E3779B97F4A7C15ULL;
+  p->pool.reserve(p->capacity);
+  return p;
+}
+
+int pt_shuffle_push(PtShufflePool* p, const char* data, size_t size) {
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_push.wait(lk, [&] { return p->pool.size() < p->capacity ||
+                                   p->closed; });
+  if (p->closed) return -1;
+  char* copy = static_cast<char*>(std::malloc(size));
+  if (!copy) return -2;
+  std::memcpy(copy, data, size);
+  p->pool.push_back({copy, size});
+  p->cv_pop.notify_one();
+  return 0;
+}
+
+// Pops a uniformly random element. min_fill: block until the pool holds
+// at least this many (or is closed) so early pops still shuffle well.
+int pt_shuffle_pop(PtShufflePool* p, char** data, size_t* size,
+                   size_t min_fill, long timeout_ms) {
+  std::unique_lock<std::mutex> lk(p->mu);
+  auto ready = [&] {
+    return p->pool.size() >= (p->closed ? 1 : (min_fill ? min_fill : 1)) ||
+           (p->closed && p->pool.empty());
+  };
+  if (timeout_ms < 0) {
+    p->cv_pop.wait(lk, ready);
+  } else if (!p->cv_pop.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                 ready)) {
+    return 1;  // timeout
+  }
+  if (p->pool.empty()) return -1;  // closed and drained
+  size_t i = static_cast<size_t>(pt_xorshift(&p->rng) % p->pool.size());
+  *data = p->pool[i].data;
+  *size = p->pool[i].size;
+  p->pool[i] = p->pool.back();
+  p->pool.pop_back();
+  p->cv_push.notify_one();
+  return 0;
+}
+
+size_t pt_shuffle_len(PtShufflePool* p) {
+  std::lock_guard<std::mutex> lk(p->mu);
+  return p->pool.size();
+}
+
+void pt_shuffle_close(PtShufflePool* p) {
+  std::lock_guard<std::mutex> lk(p->mu);
+  p->closed = true;
+  p->cv_pop.notify_all();
+  p->cv_push.notify_all();
+}
+
+void pt_shuffle_free(PtShufflePool* p) {
+  for (auto& b : p->pool) std::free(b.data);
+  delete p;
+}
+
+}  // extern "C"
