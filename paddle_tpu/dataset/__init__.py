@@ -13,6 +13,13 @@ Swap in the real files by pointing the loaders at a data directory if
 one exists.
 """
 from . import mnist  # noqa: F401
+from . import flowers  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import sentiment  # noqa: F401
+from . import mq2007  # noqa: F401
+from . import image  # noqa: F401
+from . import common  # noqa: F401
 from . import cifar  # noqa: F401
 from . import uci_housing  # noqa: F401
 from . import imdb  # noqa: F401

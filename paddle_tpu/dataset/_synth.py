@@ -45,3 +45,31 @@ def reader_creator(samples):
             yield s
 
     return reader
+
+
+def parallel_sentences(n, src_v, trg_v, min_len, max_len, seed):
+    """(src, trg) pairs where trg is a learnable mapping of src (ids
+    start at 3; 0/1/2 = <s>/<e>/<unk> per the reference convention)."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        L = rng.randint(min_len, max_len + 1)
+        src = rng.randint(3, src_v, L)
+        trg = (src % (trg_v - 3)) + 3
+        out.append((src.astype(np.int64).tolist(),
+                    trg.astype(np.int64).tolist()))
+    return out
+
+
+def labeled_sentences(n, vocab, min_len, max_len, seed):
+    """Binary-labeled id sequences with class-split vocab halves (same
+    separable structure the imdb reader uses)."""
+    rng = np.random.RandomState(seed)
+    half = vocab // 2
+    out = []
+    for _ in range(n):
+        lab = int(rng.randint(0, 2))
+        L = rng.randint(min_len, max_len + 1)
+        ids = rng.randint(0, half, L) + (half if lab else 0)
+        out.append((ids.astype(np.int64).tolist(), lab))
+    return out

@@ -1,0 +1,106 @@
+"""Dataset commons (ref: python/paddle/dataset/common.py). The download
+half is inert in this zero-egress environment; file utilities and the
+converter (to the native record format) are real."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["DATA_HOME", "md5file", "download", "split",
+           "cluster_files_reader", "convert"]
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Zero-egress: only serves an already-present cached file."""
+    d = os.path.join(DATA_HOME, module_name)
+    path = os.path.join(d, save_name or url.split("/")[-1])
+    if os.path.exists(path) and (not md5sum or md5file(path) == md5sum):
+        return path
+    raise RuntimeError(
+        f"cannot download {url}: no network egress; place the file at "
+        f"{path} (the dataset readers default to synthetic data instead)")
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Shard a reader into pickle files of ``line_count`` samples."""
+    import pickle
+
+    dumper = dumper or pickle.dump
+    buf, idx, written = [], 0, []
+
+    def flush():
+        nonlocal buf, idx
+        if not buf:
+            return
+        name = suffix % idx
+        with open(name, "wb") as f:
+            dumper(buf, f)
+        written.append(name)
+        buf = []
+        idx += 1
+
+    for sample in reader():
+        buf.append(sample)
+        if len(buf) == line_count:
+            flush()
+    flush()
+    return written
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Round-robin shard of pickle files across trainers."""
+    import glob
+    import pickle
+
+    loader = loader or pickle.load
+
+    def reader():
+        files = sorted(glob.glob(files_pattern))
+        for i, fn in enumerate(files):
+            if i % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    for sample in loader(f):
+                        yield sample
+
+    return reader
+
+
+def convert(output_path, reader, line_count, name_prefix):
+    """Serialize a reader into the native crc-framed record files
+    (runtime RecordWriter) in ``line_count`` chunks (the reference
+    converts to recordio)."""
+    import pickle
+
+    from ..runtime import RecordWriter
+
+    buf, idx, written = [], 0, []
+
+    def flush():
+        nonlocal buf, idx
+        if not buf:
+            return
+        path = os.path.join(output_path, f"{name_prefix}-{idx:05d}")
+        with RecordWriter(path) as w:
+            for sample in buf:
+                w.write(pickle.dumps(sample))
+        written.append(path)
+        buf = []
+        idx += 1
+
+    for sample in reader():
+        buf.append(sample)
+        if len(buf) == line_count:
+            flush()
+    flush()
+    return written

@@ -1,0 +1,44 @@
+"""Flowers-102 readers (ref: python/paddle/dataset/flowers.py:
+train/test/valid yield ((3, 224, 224) float32 in [-1, 1], int label)).
+Synthetic class-mean images generated LAZILY per sample (a materialized
+512-sample split would hold ~300MB); mapper/cycle are honored."""
+import numpy as np
+
+__all__ = ["train", "test", "valid"]
+
+_CLASSES = 102
+_SHAPE = (3, 224, 224)
+
+
+def _reader_creator(n, seed, mapper=None, cycle=False):
+    def reader():
+        # per-class means re-derived per class id on the fly: fold the
+        # class into the seed instead of holding a (102, 3, 224, 224)
+        # table
+        rng = np.random.RandomState(seed)
+        while True:
+            for _ in range(n):
+                y = int(rng.randint(0, _CLASSES))
+                mean_rng = np.random.RandomState(seed * 1000003 + y)
+                x = mean_rng.randn(*_SHAPE).astype("float32") + \
+                    rng.randn(*_SHAPE).astype("float32") * 0.35
+                sample = (np.tanh(x).astype("float32"), y)
+                if mapper is not None:
+                    sample = mapper(sample)
+                yield sample
+            if not cycle:
+                return
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _reader_creator(512, 40, mapper, cycle)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _reader_creator(128, 41, mapper, cycle)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader_creator(128, 42, mapper, False)

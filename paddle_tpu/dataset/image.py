@@ -1,0 +1,66 @@
+"""Image utilities (ref: python/paddle/dataset/image.py) — numpy-only
+versions of the transform helpers (the reference shells out to cv2)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["resize_short", "center_crop", "random_crop",
+           "left_right_flip", "simple_transform", "to_chw"]
+
+
+def _resize(im, h, w):
+    """Nearest-neighbor HWC resize (dependency-free)."""
+    H, W = im.shape[:2]
+    ys = (np.arange(h) * (H / h)).astype(int).clip(0, H - 1)
+    xs = (np.arange(w) * (W / w)).astype(int).clip(0, W - 1)
+    return im[ys][:, xs]
+
+
+def resize_short(im, size):
+    """Resize so the short edge equals ``size`` (HWC)."""
+    H, W = im.shape[:2]
+    if H < W:
+        return _resize(im, size, int(W * size / H))
+    return _resize(im, int(H * size / W), size)
+
+
+def center_crop(im, size, is_color=True):
+    H, W = im.shape[:2]
+    h0 = (H - size) // 2
+    w0 = (W - size) // 2
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    rng = rng or np.random
+    H, W = im.shape[:2]
+    h0 = rng.randint(0, H - size + 1)
+    w0 = rng.randint(0, W - size + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """resize_short -> crop(+flip when training) -> CHW -> mean-subtract
+    (ref: image.py simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        rng2 = rng or np.random
+        if rng2.randint(0, 2):
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(im).astype("float32")
+    if mean is not None:
+        m = np.asarray(mean, "float32")
+        im -= m.reshape((-1, 1, 1)) if m.ndim == 1 else m
+    return im

@@ -14,17 +14,11 @@ def word_dict():
 
 
 def _make(n, seed, word_idx):
-    rng = np.random.RandomState(seed)
-    v = len(word_idx)
-    half = v // 2
-    out = []
-    for _ in range(n):
-        lab = int(rng.randint(0, 2))
-        L = rng.randint(16, 64)
-        base = rng.randint(0, half, L)
-        ids = base + (half if lab else 0)
-        out.append((ids.astype(np.int64).tolist(), lab))
-    return reader_creator(out)
+    from ._synth import labeled_sentences
+
+    # randint(16, 64) exclusive == min 16 / max 63 inclusive
+    return reader_creator(
+        labeled_sentences(n, len(word_idx), 16, 63, seed))
 
 
 def train(word_idx):

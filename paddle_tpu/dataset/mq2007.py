@@ -1,0 +1,50 @@
+"""MQ2007 learning-to-rank readers (ref: python/paddle/dataset/mq2007.py:
+train/test with format in {pointwise, pairwise, listwise}).
+Synthetic: 46-dim feature vectors whose relevance is a noisy linear
+function, so rankers have signal to learn."""
+import numpy as np
+
+from ._synth import reader_creator
+
+__all__ = ["train", "test"]
+
+_DIM = 46
+
+
+def _queries(n_q, seed):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(_DIM).astype("float32")
+    out = []
+    for _ in range(n_q):
+        docs = rng.randint(5, 15)
+        x = rng.randn(docs, _DIM).astype("float32")
+        score = x @ w + rng.randn(docs) * 0.5
+        rel = np.digitize(score, np.quantile(score, [0.5, 0.85]))
+        out.append((x, rel.astype("int64")))
+    return out
+
+
+def _reader(n_q, seed, format):
+    qs = _queries(n_q, seed)
+    if format == "pointwise":
+        samples = [(x[i], int(r[i])) for x, r in qs for i in range(len(r))]
+    elif format == "pairwise":
+        samples = []
+        for x, r in qs:
+            for i in range(len(r)):
+                for j in range(len(r)):
+                    if r[i] > r[j]:
+                        samples.append((x[i], x[j]))
+    elif format == "listwise":
+        samples = [(x, r) for x, r in qs]
+    else:
+        raise ValueError(f"unknown format {format!r}")
+    return reader_creator(samples)
+
+
+def train(format="pairwise"):
+    return _reader(64, 80, format)
+
+
+def test(format="pairwise"):
+    return _reader(16, 81, format)

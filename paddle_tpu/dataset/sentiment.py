@@ -1,0 +1,24 @@
+"""IMDB-style movie-review sentiment (ref: python/paddle/dataset/
+sentiment.py: get_word_dict(); train()/test() yield (ids, 0/1)).
+Synthetic: class-conditioned Zipfian text."""
+from ._synth import labeled_sentences, reader_creator
+
+__all__ = ["train", "test", "get_word_dict"]
+
+_VOCAB = 5000
+
+
+def get_word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _make(n, seed):
+    return reader_creator(labeled_sentences(n, _VOCAB, 8, 40, seed))
+
+
+def train():
+    return _make(1024, 70)
+
+
+def test():
+    return _make(256, 71)

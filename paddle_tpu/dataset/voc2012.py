@@ -1,0 +1,41 @@
+"""VOC2012 segmentation readers (ref: python/paddle/dataset/voc2012.py:
+train/test/val yield (image (3, H, W) float32, label mask (H, W) int64)).
+Synthetic: blob masks with consistent image/label structure."""
+import numpy as np
+
+from ._synth import reader_creator
+
+__all__ = ["train", "test", "val"]
+
+_CLASSES = 21
+_HW = 64
+
+
+def _make(n, seed):
+    rng = np.random.RandomState(seed)
+    samples = []
+    for _ in range(n):
+        lab = np.zeros((_HW, _HW), np.int64)
+        img = rng.randn(3, _HW, _HW).astype("float32") * 0.1
+        for _ in range(rng.randint(1, 4)):
+            c = rng.randint(1, _CLASSES)
+            cy, cx = rng.randint(8, _HW - 8, 2)
+            r = rng.randint(4, 12)
+            yy, xx = np.mgrid[0:_HW, 0:_HW]
+            blob = (yy - cy) ** 2 + (xx - cx) ** 2 < r * r
+            lab[blob] = c
+            img[:, blob] += (c / _CLASSES) * 2 - 1  # class-coded intensity
+        samples.append((np.tanh(img).astype("float32"), lab))
+    return reader_creator(samples)
+
+
+def train():
+    return _make(256, 50)
+
+
+def test():
+    return _make(64, 51)
+
+
+def val():
+    return _make(64, 52)

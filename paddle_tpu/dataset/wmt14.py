@@ -1,0 +1,36 @@
+"""WMT14 en-fr readers (ref: python/paddle/dataset/wmt14.py:
+train/test/gen(dict_size) yield (src_ids, trg_ids, trg_next);
+get_dict(dict_size) -> (src_dict, trg_dict)). Synthetic parallel text."""
+from ._synth import parallel_sentences, reader_creator
+
+__all__ = ["train", "test", "gen", "get_dict"]
+
+
+def _make(n, seed, dict_size):
+    pairs = parallel_sentences(n, dict_size, dict_size, 4, 12, seed)
+    samples = []
+    for src, trg in pairs:
+        trg_in = [0] + list(trg)          # <s>
+        trg_next = list(trg) + [1]        # </e>
+        samples.append((list(src), trg_in, trg_next))
+    return reader_creator(samples)
+
+
+def train(dict_size):
+    return _make(1024, 60, dict_size)
+
+
+def test(dict_size):
+    return _make(128, 61, dict_size)
+
+
+def gen(dict_size):
+    return _make(64, 62, dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    words = {i: f"w{i}" for i in range(dict_size)}
+    if reverse:
+        return words, dict(words)
+    inv = {v: k for k, v in words.items()}
+    return inv, dict(inv)

@@ -1,0 +1,55 @@
+"""Dataset modules added for full paddle.dataset parity: flowers,
+voc2012, wmt14, sentiment, mq2007, image utilities, common file tools.
+Ref: python/paddle/dataset/{flowers,voc2012,wmt14,sentiment,mq2007,
+image,common}.py."""
+import os
+
+import numpy as np
+
+import paddle_tpu.dataset as D
+
+
+def test_extra_dataset_readers():
+    s = next(D.flowers.train()())
+    assert s[0].shape == (3, 224, 224) and 0 <= s[1] < 102
+    img, lab = next(D.voc2012.train()())
+    assert img.shape == (3, 64, 64) and lab.shape == (64, 64)
+    src, tin, tnext = next(D.wmt14.train(1000)())
+    assert tin[0] == 0 and tnext[-1] == 1 and len(tin) == len(tnext)
+    d1, d2 = D.wmt14.get_dict(100)
+    assert d1[5] == "w5"
+    ids, y = next(D.sentiment.train()())
+    assert y in (0, 1) and len(D.sentiment.get_word_dict()) == 5000
+    a, b = next(D.mq2007.train("pairwise")())
+    assert a.shape == (46,) and b.shape == (46,)
+    x, r = next(D.mq2007.train("listwise")())
+    assert x.shape[1] == 46 and len(r) == x.shape[0]
+    f, rel = next(D.mq2007.train("pointwise")())
+    assert f.shape == (46,) and rel in (0, 1, 2)
+
+
+def test_image_utilities():
+    im = np.random.rand(100, 80, 3).astype("float32")
+    out = D.image.simple_transform(im, 72, 64, True,
+                                   rng=np.random.RandomState(0))
+    assert out.shape == (3, 64, 64)
+    out2 = D.image.simple_transform(im, 72, 64, False,
+                                    mean=[0.5, 0.5, 0.5])
+    assert out2.shape == (3, 64, 64)
+    assert D.image.left_right_flip(im).shape == im.shape
+    assert D.image.resize_short(im, 50).shape[0] == 62  # 100*50/80
+
+
+def test_common_split_cluster_convert(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    files = D.common.split(lambda: iter(range(25)), 10)
+    assert len(files) == 3
+    rd = D.common.cluster_files_reader(str(tmp_path / "*.pickle"), 2, 0)
+    got = list(rd())
+    assert len(got) == 15 and got[0] == 0   # files 0 and 2 of 3
+    rd1 = D.common.cluster_files_reader(str(tmp_path / "*.pickle"), 2, 1)
+    assert len(list(rd1())) == 10           # file 1
+    outs = D.common.convert(str(tmp_path), lambda: iter(range(7)), 5,
+                            "rec")
+    assert len(outs) == 2
+    assert all(os.path.getsize(p) > 0 for p in outs)
