@@ -1,0 +1,54 @@
+"""New op families through the STATIC Program/Executor path: the ops
+registered this round (detection/rcnn/sequence/geometric) must record
+into a Program and replay inside the compiled executable, not just run
+eagerly (ref: the reference's OpDesc round-trip guarantees)."""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+import paddle_tpu.nn.functional as F
+
+
+def test_static_records_new_ops():
+    pt.enable_static()
+    try:
+        main, startup = pt.static.Program(), pt.static.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [2, 3, 8, 8], "float32")
+            rois = fluid.layers.data("rois", [4, 4], "float32")
+            pooled = fluid.layers.roi_align(
+                x, rois, pooled_height=2, pooled_width=2,
+                rois_num=pt.to_tensor(np.array([2, 2], "int32")))
+            gs = fluid.layers.spectral_norm(
+                fluid.layers.reshape(pooled, [4, -1]), power_iters=2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        out = exe.run(
+            main,
+            feed={"x": np.random.randn(2, 3, 8, 8).astype("float32"),
+                  "rois": np.array([[0, 0, 4, 4]] * 4, "float32")},
+            fetch_list=[pooled, gs])
+        assert np.asarray(out[0]).shape == (4, 3, 2, 2)
+        s = np.linalg.svd(np.asarray(out[1]), compute_uv=False)[0]
+        assert abs(s - 1.0) < 0.2
+    finally:
+        pt.disable_static()
+
+
+def test_static_sequence_and_geometric():
+    pt.enable_static()
+    try:
+        main, startup = pt.static.Program(), pt.static.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [2, 3, 8, 8], "float32")
+            up = fluid.layers.resize_bilinear(x, out_shape=[16, 16])
+            pooled = fluid.layers.adaptive_pool2d(up, 4, "avg")
+        exe = fluid.Executor()
+        exe.run(startup)
+        out = exe.run(
+            main,
+            feed={"x": np.random.randn(2, 3, 8, 8).astype("float32")},
+            fetch_list=[pooled])
+        assert np.asarray(out[0]).shape == (2, 3, 4, 4)
+    finally:
+        pt.disable_static()
