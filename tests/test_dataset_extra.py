@@ -53,3 +53,17 @@ def test_common_split_cluster_convert(tmp_path, monkeypatch):
                             "rec")
     assert len(outs) == 2
     assert all(os.path.getsize(p) > 0 for p in outs)
+
+
+def test_convert_roundtrips_through_native_shard_reader(tmp_path):
+    """dataset.common.convert writes the crc-framed record format the
+    native threaded ShardReader consumes — full pipeline round-trip."""
+    import pickle
+
+    from paddle_tpu.runtime import ShardReader
+
+    files = D.common.convert(str(tmp_path), lambda: iter(range(23)), 10,
+                             "chunk")
+    assert len(files) == 3
+    got = sorted(pickle.loads(b) for b in ShardReader(files, n_threads=2))
+    assert got == list(range(23))
