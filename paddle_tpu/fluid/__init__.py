@@ -33,6 +33,11 @@ def program_guard(main_program, startup_program=None):
         if not was_static:
             _pt.disable_static()
 from ..static_.executor import Executor  # noqa: F401
+from ..static_.program import (Scope, scope_guard,  # noqa: F401
+                               name_scope)
+from ..static_ import backward  # noqa: F401
+from ..static_.backward import gradients, append_backward  # noqa: F401
+from ..static_.program import Variable  # noqa: F401
 from ..framework.jit import to_static  # noqa: F401
 from ..framework import io  # noqa: F401
 from ..framework.io import (save_inference_model,  # noqa: F401
@@ -51,6 +56,16 @@ from ..optim import clip  # noqa: F401
 from ..optim import regularizer  # noqa: F401
 from ..io_ import reader as io_reader
 from ..io_.reader import DataFeeder  # noqa: F401
+from ..utils import unique_name  # noqa: F401
+from ..nn.param_attr import WeightNormParamAttr  # noqa: F401
+from ..framework.io import (save, load, load_program_state,  # noqa: F401
+                            set_program_state)
+from .lod_tensor import (LoDTensor, LoDTensorArray,  # noqa: F401
+                         create_lod_tensor, create_random_int_lodtensor)
+from . import average  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import profiler  # noqa: F401
+from . import install_check  # noqa: F401
 from ..nn.layer import Layer  # noqa: F401
 from .. import metrics  # noqa: F401
 from .. import nn as _nn
@@ -60,6 +75,15 @@ from . import dygraph  # noqa: F401
 
 # top-level conveniences the reference exposes on fluid itself
 data = _static.data
+one_hot = layers.one_hot  # ref: fluid/input.py re-exported at top level
+embedding = layers.embedding
+Tensor = LoDTensor  # ref: fluid/__init__.py:92 "Tensor = LoDTensor"
+from ..core.tensor import Tensor as VarBase  # noqa: E402  (dygraph tensor)
+from ..optim import lr as learning_rate_decay  # noqa: E402
+from .transpiler import HashName, RoundRobin  # noqa: F401,E402
+from . import trainer_desc  # noqa: E402
+from .trainer_desc import (TrainerDesc, MultiTrainer,  # noqa: F401,E402
+                           DistMultiTrainer, PipelineTrainer, DataFeedDesc)
 enable_dygraph = lambda place=None: None  # dygraph (eager) is the default
 disable_dygraph = lambda: None
 in_dygraph_mode = lambda: not _static.in_static_mode() \
@@ -76,6 +100,18 @@ __all__ = [
     "get_flags", "set_flags", "load_op_library", "require_version",
     "incubate", "transpiler", "DistributeTranspiler",
     "DistributeTranspilerConfig", "memory_optimize", "release_memory",
+    "backward", "gradients", "scope_guard", "name_scope", "Scope",
+    "unique_name", "LoDTensor", "LoDTensorArray", "Tensor",
+    "create_lod_tensor", "create_random_int_lodtensor", "one_hot",
+    "embedding", "average", "evaluator", "profiler", "install_check",
+    "WeightNormParamAttr", "save", "load", "load_program_state",
+    "set_program_state", "save_dygraph", "load_dygraph",
+    "CompiledProgram", "BuildStrategy", "ExecutionStrategy",
+    "ParallelExecutor", "enable_dygraph", "disable_dygraph",
+    "in_dygraph_mode", "is_compiled_with_cuda", "Variable", "VarBase",
+    "append_backward", "HashName", "RoundRobin", "learning_rate_decay",
+    "TrainerDesc", "MultiTrainer", "DistMultiTrainer", "PipelineTrainer",
+    "DataFeedDesc", "trainer_desc",
 ]
 
 
@@ -84,6 +120,11 @@ class CompiledProgram:  # re-export with the fluid name
         from ..static_.compiler import CompiledProgram as CP
 
         return CP(*args, **kwargs)
+
+
+from ..static_.compiler import (BuildStrategy,  # noqa: F401,E402
+                                ExecutionStrategy, ParallelExecutor)
+from .dygraph import (save_dygraph, load_dygraph)  # noqa: F401,E402
 
 
 # -- places / flags / version (ref: fluid/framework.py __all__) --------------

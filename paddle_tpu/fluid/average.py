@@ -1,0 +1,47 @@
+"""fluid.average (ref: python/paddle/fluid/average.py).
+
+Pure-python running weighted mean; deprecated in the reference in favour
+of fluid.metrics but still part of the fluid surface.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+class WeightedAverage:
+    """Running weighted average (ref: average.py:40). ``add`` accepts a
+    scalar or ndarray value with a scalar weight; ``eval`` returns
+    numerator/denominator."""
+
+    def __init__(self):
+        warnings.warn(
+            f"{type(self).__name__} is deprecated; use metrics.Accuracy "
+            "or a plain running mean", Warning)
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if isinstance(value, np.ndarray) and value.shape == (1,):
+            value = float(value[0])
+        if not isinstance(value, (int, float, np.ndarray)):
+            raise ValueError("value must be a number or numpy ndarray")
+        if not isinstance(weight, (int, float)):
+            raise ValueError("weight must be a number")
+        if self.numerator is None:
+            self.numerator = value * weight
+            self.denominator = weight
+        else:
+            self.numerator += value * weight
+            self.denominator += weight
+
+    def eval(self):
+        if self.numerator is None or not self.denominator:
+            raise ValueError("eval() before add(): nothing accumulated")
+        return self.numerator / self.denominator

@@ -1,0 +1,96 @@
+"""fluid.evaluator (ref: python/paddle/fluid/evaluator.py).
+
+The reference's Evaluator classes are deprecated static-graph state
+accumulators (each keeps counter Variables in the scope and reads them
+back through the Executor); the streaming metrics in
+``paddle_tpu.metrics`` are the living equivalents, so these classes are
+thin program-independent fronts over them that keep the
+``reset(executor)`` / ``eval(executor)`` calling convention.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from .. import metrics as _metrics
+
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance", "DetectionMAP"]
+
+
+class Evaluator:
+    """Base evaluator (ref: evaluator.py:45). State lives host-side; the
+    executor arguments are accepted for source compatibility and unused
+    (there are no scope counter variables to zero — XLA programs are
+    pure)."""
+
+    def __init__(self, name=None, **kwargs):
+        warnings.warn(
+            f"fluid.evaluator.{type(self).__name__} is deprecated; use "
+            "paddle_tpu.metrics instead", Warning)
+        self.name = name or type(self).__name__.lower()
+        self.states = []
+        self.metrics = []
+
+    def reset(self, executor=None, reset_program=None):
+        self._metric.reset()
+
+    def eval(self, executor=None, eval_program=None):
+        raise NotImplementedError
+
+
+class ChunkEvaluator(Evaluator):
+    """Chunk-level P/R/F1 accumulator (ref: evaluator.py:127). ``update``
+    feeds per-batch tag sequences; ``eval`` returns (precision, recall,
+    f1) like the reference's eval()."""
+
+    def __init__(self, input=None, label=None, chunk_scheme="IOB",
+                 num_chunk_types=1, excluded_chunk_types=None, **kwargs):
+        super().__init__(**kwargs)
+        self._metric = _metrics.ChunkEvaluator(
+            chunk_scheme=chunk_scheme, num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+
+    def update(self, pred, label, seq_length=None):
+        self._metric.update(pred, label, seq_length)
+
+    def eval(self, executor=None, eval_program=None):
+        return self._metric.accumulate()
+
+
+class EditDistance(Evaluator):
+    """Average edit distance accumulator (ref: evaluator.py:218)."""
+
+    def __init__(self, input=None, label=None, ignored_tokens=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.ignored_tokens = ignored_tokens
+        self._metric = _metrics.EditDistance()
+
+    def update(self, distances, seq_num):
+        self._metric.update(np.asarray(distances), int(seq_num))
+
+    def eval(self, executor=None, eval_program=None):
+        return self._metric.accumulate()
+
+
+class DetectionMAP(Evaluator):
+    """Detection mAP accumulator (ref: evaluator.py:299)."""
+
+    def __init__(self, input=None, gt_label=None, gt_box=None,
+                 gt_difficult=None, class_num=None,
+                 background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral", **kwargs):
+        super().__init__(**kwargs)
+        self._metric = _metrics.DetectionMAP(
+            overlap_threshold=overlap_threshold, map_type=ap_version,
+            evaluate_difficult=evaluate_difficult, class_num=class_num)
+
+    def update(self, detections, gts):
+        self._metric.update(detections, gts)
+
+    def get_map_var(self):
+        return None  # no scope variable: the accumulator is host-side
+
+    def eval(self, executor=None, eval_program=None):
+        return self._metric.accumulate()

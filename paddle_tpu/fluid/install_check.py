@@ -1,0 +1,35 @@
+"""fluid.install_check (ref: python/paddle/fluid/install_check.py).
+
+``run_check()`` trains one step of a 2x2 linear model end-to-end (fwd,
+bwd, SGD update) on whatever backend jax resolved to, proving the stack
+is importable and executable.
+"""
+from __future__ import annotations
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn, optim
+
+    class _SimpleLayer(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(2, 2)
+
+        def forward(self, x):
+            return self.fc(x).sum()
+
+    model = _SimpleLayer()
+    opt = optim.SGD(learning_rate=0.1, parameters=model.parameters())
+    x = pt.to_tensor(np.ones((2, 2), np.float32))
+    loss = model(x)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    print("Your paddle_tpu is installed successfully! Backend:",
+          pt.get_device())
+    return True

@@ -1,0 +1,113 @@
+"""LoDTensor construction helpers (ref: python/paddle/fluid/lod_tensor.py).
+
+The reference's LoDTensor carries a level-of-detail offset table beside a
+flattened buffer; this framework's convention is dense data + explicit
+per-sequence lengths (SURVEY §3), so ``LoDTensor`` here is a thin record
+of (ndarray, recursive_seq_lens) that converts freely to/from the dense
+representation the ops consume.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["LoDTensor", "LoDTensorArray", "create_lod_tensor",
+           "create_random_int_lodtensor"]
+
+
+def _lens_to_offsets(lens):
+    off = [0]
+    for n in lens:
+        off.append(off[-1] + int(n))
+    return off
+
+
+class LoDTensor:
+    """Flattened buffer + recursive sequence lengths (ref: core LoDTensor,
+    python interface in fluid/lod_tensor.py). ``lod()`` returns the
+    offset-form table the reference exposes; ``recursive_sequence_lengths``
+    the length form."""
+
+    def __init__(self, data=None, recursive_seq_lens=None):
+        self._data = None if data is None else np.asarray(data)
+        self._seq_lens = [list(map(int, lv))
+                          for lv in (recursive_seq_lens or [])]
+
+    # reference-core API surface -------------------------------------------
+    def set(self, data, place=None):
+        self._data = np.asarray(data)
+
+    def set_recursive_sequence_lengths(self, lens):
+        self._seq_lens = [list(map(int, lv)) for lv in lens]
+
+    def recursive_sequence_lengths(self):
+        return [list(lv) for lv in self._seq_lens]
+
+    def set_lod(self, lod):
+        self._seq_lens = [list(np.diff(lv).astype(int)) for lv in lod]
+
+    def lod(self):
+        return [_lens_to_offsets(lv) for lv in self._seq_lens]
+
+    def has_valid_recursive_sequence_lengths(self):
+        if not self._seq_lens:
+            return True
+        # each deeper level must partition the one above; the last level
+        # must partition the rows of the buffer
+        for above, below in zip(self._seq_lens, self._seq_lens[1:]):
+            if len(below) != sum(above):
+                return False
+        n_rows = 0 if self._data is None else self._data.shape[0]
+        return sum(self._seq_lens[-1]) == n_rows
+
+    def shape(self):
+        return [] if self._data is None else list(self._data.shape)
+
+    def __array__(self, dtype=None):
+        arr = np.zeros((0,)) if self._data is None else self._data
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __repr__(self):
+        return (f"LoDTensor(shape={self.shape()}, "
+                f"recursive_seq_lens={self._seq_lens})")
+
+
+class LoDTensorArray(list):
+    """ref: core.LoDTensorArray — a growable list of LoDTensors; python
+    list semantics are exactly the TensorArray contract here."""
+
+    def append(self, t):  # accept raw ndarrays for convenience
+        super().append(t if isinstance(t, LoDTensor) else LoDTensor(t))
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Build a LoDTensor from an ndarray / nested list / LoDTensor
+    (ref: fluid/lod_tensor.py create_lod_tensor). Nested-list input is
+    flattened to a column the way the reference does."""
+    if isinstance(data, LoDTensor):
+        return create_lod_tensor(np.asarray(data), recursive_seq_lens, place)
+    if isinstance(data, list):
+        flat = [x for seq in data for x in seq]
+        arr = np.asarray(flat)
+        if arr.ndim == 1:
+            arr = arr.reshape(-1, 1)
+        inferred = [[len(seq) for seq in data]]
+        if recursive_seq_lens is None:
+            recursive_seq_lens = inferred
+        return LoDTensor(arr, recursive_seq_lens)
+    arr = np.asarray(data)
+    t = LoDTensor(arr, recursive_seq_lens)
+    if not t.has_valid_recursive_sequence_lengths():
+        raise ValueError(
+            f"recursive_seq_lens {recursive_seq_lens} do not partition the "
+            f"{arr.shape[0]} rows of data")
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1):
+    """ref: fluid/lod_tensor.py create_random_int_lodtensor: total rows =
+    sum of the last-level lengths, element shape = base_shape."""
+    rows = int(sum(recursive_seq_lens[-1]))
+    shape = [rows] + list(base_shape)
+    data = np.random.randint(low, high + 1, size=shape).astype(np.int64)
+    return LoDTensor(data, recursive_seq_lens)

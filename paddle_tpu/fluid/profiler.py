@@ -1,0 +1,13 @@
+"""fluid.profiler submodule (ref: python/paddle/fluid/profiler.py).
+
+The reference drives the C++ platform profiler (nvprof ranges, per-op
+timers); here every name forwards to ``paddle_tpu.utils.profiler``,
+whose backend is ``jax.profiler`` trace collection (XPlane traces for
+xprof/tensorboard — the TPU-native equivalent of the op timeline).
+"""
+from ..utils.profiler import (profiler, start_profiler,  # noqa: F401
+                              stop_profiler, reset_profiler, cuda_profiler,
+                              add_profiler_step, StepTimer)
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "cuda_profiler", "add_profiler_step", "StepTimer"]

@@ -1,0 +1,101 @@
+"""TrainerDesc / DataFeedDesc surface (ref: python/paddle/fluid/
+trainer_desc.py, data_feed_desc.py).
+
+In the reference these are protobuf builders consumed by the C++
+multi-threaded trainer (device_worker / data_feed) of the parameter-
+server era — infrastructure recorded as a descope in SURVEY §4b (XLA owns
+the execution loop; the io_/runtime shard readers own ingestion). The
+classes survive as plain config containers so fluid-era scripts that
+build them keep importing; anything that would launch the PS trainer
+raises with the descope pointer.
+"""
+from __future__ import annotations
+
+__all__ = ["TrainerDesc", "MultiTrainer", "DistMultiTrainer",
+           "PipelineTrainer", "DataFeedDesc"]
+
+_DESCOPE = ("the parameter-server trainer stack is descoped (SURVEY "
+            "§4b); use Executor / ParallelExecutor or dist.fleet")
+
+
+class TrainerDesc:
+    """Config container; ``_gen_trainer_desc`` etc. are proto-era hooks."""
+
+    def __init__(self):
+        self.proto_desc = {"class_name": type(self).__name__,
+                           "thread_num": 1, "fetch_config": {}}
+        self._program = None
+        self._infer = False
+
+    def set_thread(self, n):
+        self.proto_desc["thread_num"] = int(n)
+
+    def set_program(self, program):
+        self._program = program
+
+    def set_infer(self, infer):
+        self._infer = bool(infer)
+
+    def set_fetch_var_and_info(self, fetch_vars, fetch_info, print_period):
+        self.proto_desc["fetch_config"] = {
+            "vars": [getattr(v, "name", str(v)) for v in fetch_vars],
+            "info": list(fetch_info), "print_period": int(print_period)}
+
+    def _desc(self):
+        return dict(self.proto_desc)
+
+
+class MultiTrainer(TrainerDesc):
+    def run(self, *a, **k):
+        raise NotImplementedError(_DESCOPE)
+
+
+class DistMultiTrainer(TrainerDesc):
+    def run(self, *a, **k):
+        raise NotImplementedError(_DESCOPE)
+
+
+class PipelineTrainer(TrainerDesc):
+    def run(self, *a, **k):
+        raise NotImplementedError(_DESCOPE)
+
+
+class DataFeedDesc:
+    """ref: data_feed_desc.py — wraps a text-proto describing slots. Here
+    a minimal parser keeps the slot/batch accessors working for configs
+    written against the reference."""
+
+    def __init__(self, proto_file=None):
+        self.proto_desc = {"name": "MultiSlotDataFeed", "batch_size": 32,
+                           "slots": []}
+        if proto_file is not None:
+            import os
+
+            if os.path.exists(proto_file):
+                self._parse(open(proto_file).read())
+
+    def _parse(self, text):
+        import re
+
+        m = re.search(r"batch_size\s*:\s*(\d+)", text)
+        if m:
+            self.proto_desc["batch_size"] = int(m.group(1))
+        for sm in re.finditer(r"name\s*:\s*\"([^\"]+)\"", text):
+            self.proto_desc["slots"].append(
+                {"name": sm.group(1), "is_used": False})
+
+    def set_batch_size(self, n):
+        self.proto_desc["batch_size"] = int(n)
+
+    def set_dense_slots(self, names):
+        for s in self.proto_desc["slots"]:
+            if s["name"] in names:
+                s["is_dense"] = True
+
+    def set_use_slots(self, names):
+        for s in self.proto_desc["slots"]:
+            if s["name"] in names:
+                s["is_used"] = True
+
+    def desc(self):
+        return str(self.proto_desc)

@@ -424,14 +424,87 @@ def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
 # ---------------------------------------------------------------------------
 
 
+def _src_positions(n_in, n_out, align_corners, align_mode):
+    """Output-pixel -> source coordinate (ref interpolate_op.h:118,572):
+    out==1 -> ratio 0, i.e. src row 0; align_corners -> src =
+    dst*(in-1)/(out-1); else align_mode==0 -> half-pixel centers
+    src = (dst+0.5)*in/out - 0.5 (clamped at 0), align_mode==1 ->
+    src = dst*in/out."""
+    dst = jnp.arange(n_out, dtype=jnp.float32)
+    if n_out <= 1:
+        pos = jnp.zeros((n_out,), jnp.float32)
+    elif align_corners:
+        pos = dst * ((n_in - 1) / (n_out - 1))
+    elif align_mode == 0:
+        pos = (dst + 0.5) * (n_in / n_out) - 0.5
+    else:
+        pos = dst * (n_in / n_out)
+    return pos
+
+
+def _clamped_positions(n_in, n_out, align_corners, align_mode):
+    return jnp.clip(
+        _src_positions(n_in, n_out, align_corners, align_mode),
+        0.0, n_in - 1)
+
+
+def _cubic_contrib(t, a=-0.75):
+    """Keys cubic convolution kernel (the 2.x bicubic convention)."""
+    t = jnp.abs(t)
+    w1 = ((a + 2.0) * t - (a + 3.0)) * t * t + 1.0       # |t| <= 1
+    w2 = a * (((t - 5.0) * t + 8.0) * t - 4.0)           # 1 < |t| < 2
+    return jnp.where(t <= 1.0, w1, jnp.where(t < 2.0, w2, 0.0))
+
+
+def _resize_weights(n_in, n_out, align_corners, align_mode, mode="linear"):
+    """(n_out, n_in) interpolation matrix for one spatial axis; resize
+    becomes a per-axis matmul — the MXU-native formulation (vs gathers).
+    Edge handling matches the reference kernels: positions clamp into
+    [0, in-1] and out-of-range taps accumulate at the clamped index."""
+    rows = jnp.arange(n_out)
+    if mode == "nearest":
+        # ref interpolate_op.h:88: nearest ignores align_mode —
+        # floor(ratio*dst + 0.5) when align_corners else floor(ratio*dst)
+        pos = _clamped_positions(n_in, n_out, align_corners, 1)
+        idx = jnp.floor(pos + (0.5 if align_corners else 0.0))
+        idx = jnp.clip(idx.astype(jnp.int32), 0, n_in - 1)
+        return jax.nn.one_hot(idx, n_in, dtype=jnp.float32)
+    if mode == "cubic":
+        # bicubic (a 2.x-surface extension; no 1.x kernel): half-pixel
+        # unless align_corners; weights come from the UNCLAMPED source
+        # position (only tap indices clamp — the cubic kernel's border
+        # convention), 4 taps accumulated at clamped indices
+        pos = _src_positions(n_in, n_out, align_corners, 0)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        W = jnp.zeros((n_out, n_in), jnp.float32)
+        for tap in (-1, 0, 1, 2):
+            i = lo + tap
+            wgt = _cubic_contrib(pos - i.astype(jnp.float32))
+            W = W.at[rows, jnp.clip(i, 0, n_in - 1)].add(wgt)
+        return W
+    pos = _clamped_positions(n_in, n_out, align_corners, align_mode)
+    lo = jnp.floor(pos).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, n_in - 1)
+    frac = pos - lo
+    W = jnp.zeros((n_out, n_in), jnp.float32)
+    W = W.at[rows, lo].add(1.0 - frac)
+    W = W.at[rows, hi].add(frac)
+    return W
+
+
 @register("interpolate")
-def _interpolate(x, *, size, mode, align_corners):
+def _interpolate(x, *, size, mode, align_corners, align_mode=1):
     n, c, h, w = x.shape
     oh, ow = size
-    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
-    xt = jnp.transpose(x, (0, 2, 3, 1))
-    out = jax.image.resize(xt, (n, oh, ow, c), method=method)
-    return jnp.transpose(out, (0, 3, 1, 2))
+    axis_mode = {"nearest": "nearest", "bilinear": "linear",
+                 "bicubic": "cubic", "area": "linear"}[mode]
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    Wh = _resize_weights(h, oh, align_corners, align_mode, mode=axis_mode)
+    Ww = _resize_weights(w, ow, align_corners, align_mode, mode=axis_mode)
+    out = jnp.einsum("nchw,oh->ncow", xf, Wh)
+    out = jnp.einsum("nchw,ow->ncho", out, Ww)
+    return out.astype(dt)
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
@@ -444,12 +517,31 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         if isinstance(size, Tensor):
             size = [int(v) for v in np.asarray(size._data)]
         size = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in size)
-    return apply("interpolate", x, size=tuple(size), mode=mode, align_corners=align_corners)
+    return apply("interpolate", x, size=tuple(size), mode=mode,
+                 align_corners=bool(align_corners),
+                 align_mode=int(align_mode))
 
 
 upsample = interpolate
-resize_bilinear = lambda x, out_shape=None, **kw: interpolate(x, size=out_shape, mode="bilinear")
-resize_nearest = lambda x, out_shape=None, **kw: interpolate(x, size=out_shape, mode="nearest")
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    """ref: layers/nn.py resize_bilinear — fluid defaults are
+    align_corners=True, align_mode=1."""
+    return interpolate(input, size=out_shape, scale_factor=scale,
+                       mode="bilinear", align_corners=align_corners,
+                       align_mode=align_mode)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True,
+                   data_format="NCHW"):
+    """ref: layers/nn.py resize_nearest."""
+    return interpolate(input, size=out_shape, scale_factor=scale,
+                       mode="nearest", align_corners=align_corners,
+                       align_mode=1)
 
 
 @register("pixel_shuffle")
@@ -589,23 +681,23 @@ def image_resize(input, out_shape=None, scale=None, name=None,
                  resample="BILINEAR", actual_shape=None,
                  align_corners=True, align_mode=1, data_format="NCHW"):
     """ref: layers/nn.py image_resize — thin front over interpolate.
-
-    Sampling follows the half-pixel-center convention (the reference's
-    align_mode=1 behavior); align_mode=0 is not implemented."""
+    align_corners and align_mode 0/1 follow the fluid interpolate_op
+    conventions (weight-matrix resize, see _resize_weights)."""
     modes = {"BILINEAR": "bilinear", "NEAREST": "nearest",
              "BICUBIC": "bicubic"}
     key = str(resample).upper()
+    if key == "TRILINEAR":
+        return resize_trilinear(input, out_shape=out_shape, scale=scale,
+                                actual_shape=actual_shape,
+                                align_corners=align_corners,
+                                align_mode=align_mode)
     if key not in modes:
         raise ValueError(
             f"resample={resample!r} not supported (have "
-            f"{sorted(modes)}; TRILINEAR needs 5-D resize, not "
-            "implemented)")
-    if align_mode == 0:
-        raise NotImplementedError(
-            "align_mode=0 (src_idx = scale*dst_idx) not implemented; "
-            "only the half-pixel align_mode=1 convention is")
+            f"{sorted(modes) + ['TRILINEAR']})")
     return interpolate(input, size=out_shape, scale_factor=scale,
-                       mode=modes[key], align_corners=align_corners)
+                       mode=modes[key], align_corners=align_corners,
+                       align_mode=align_mode)
 
 
 @register("unfold")
@@ -721,24 +813,42 @@ def im2sequence(input, filter_size=1, stride=1, padding=0,
 
 
 @register("resize_trilinear_op")
-def _resize_trilinear(x, *, size):
+def _resize_trilinear(x, *, size, align_corners=True, align_mode=1):
+    # attr defaults match the fluid signature so programs saved before
+    # these attrs existed still replay
     n, c, d, h, w = x.shape
     od, oh, ow = size
-    xt = jnp.transpose(x, (0, 2, 3, 4, 1))
-    out = jax.image.resize(xt, (n, od, oh, ow, c), method="linear")
-    return jnp.transpose(out, (0, 4, 1, 2, 3))
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    Wd = _resize_weights(d, od, align_corners, align_mode)
+    Wh = _resize_weights(h, oh, align_corners, align_mode)
+    Ww = _resize_weights(w, ow, align_corners, align_mode)
+    out = jnp.einsum("ncdhw,ed->ncehw", xf, Wd)
+    out = jnp.einsum("ncdhw,eh->ncdew", out, Wh)
+    out = jnp.einsum("ncdhw,ew->ncdhe", out, Ww)
+    return out.astype(dt)
 
 
 def resize_trilinear(input, out_shape=None, scale=None, name=None,
                      actual_shape=None, align_corners=True, align_mode=1,
                      data_format="NCDHW"):
-    """Trilinear resize of NCDHW volumes (ref: nn.py resize_trilinear)."""
+    """Trilinear resize of NCDHW volumes (ref: nn.py resize_trilinear).
+    Honors align_corners (corner-aligned src = dst*(in-1)/(out-1), the
+    fluid default) and align_mode 0/1; ``actual_shape`` — the
+    reference's runtime-tensor output shape — supplies out_shape when
+    given (static ints here)."""
     shp = unwrap(input).shape
+    if actual_shape is not None:
+        out_shape = [int(v) for v in np.asarray(
+            actual_shape._data if isinstance(actual_shape, Tensor)
+            else actual_shape)][-3:]
     if out_shape is None:
         out_shape = [int(shp[2] * scale), int(shp[3] * scale),
                      int(shp[4] * scale)]
     out_shape = tuple(int(v) for v in out_shape)
-    return apply("resize_trilinear_op", input, size=out_shape)
+    return apply("resize_trilinear_op", input, size=out_shape,
+                 align_corners=bool(align_corners),
+                 align_mode=int(align_mode))
 
 
 @register("adaptive_pool3d_op")

@@ -483,15 +483,16 @@ def similarity_focus(input, axis, indexes, name=None):
 @register("polygon_box_transform_op")
 def _polygon_box_transform(x):
     # ref: detection.py polygon_box_transform (polygon_box_transform_op.cc):
-    # converts per-pixel quad offsets to absolute coordinates: for channel
-    # 2k (x-offset) add pixel col, channel 2k+1 (y-offset) add pixel row.
+    # converts per-pixel quad offsets to absolute coordinates. EAST geo
+    # maps are 1/4-resolution, so the kernel uses 4*col - in for channel
+    # 2k (x-offset) and 4*row - in for channel 2k+1 (y-offset).
     B, C, H, W = x.shape
     cols = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
     rows = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
     is_x = (jnp.arange(C) % 2 == 0)[None, :, None, None]
     base = jnp.where(is_x, jnp.broadcast_to(cols, x.shape),
                      jnp.broadcast_to(rows, x.shape))
-    return base - x
+    return 4.0 * base - x
 
 
 def polygon_box_transform(input, name=None):

@@ -296,13 +296,28 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
                           post_nms_top_n, rois_num_per_level=None,
                           name=None):
     """Merge per-level proposals, keep global top-k by score (ref:
-    detection.py:3914). Inputs: lists of (N_l, 4) rois and (N_l,) scores.
-    Returns (post_nms_top_n, 4) boxes (zero-padded) + valid count."""
+    detection.py:3914). Inputs: lists of (N_l, 4) rois and (N_l,) scores,
+    zero-padded the way generate_proposals emits them, plus
+    ``rois_num_per_level`` — per-level valid counts. Pad rows are masked
+    to -inf before the top-k so padding never competes, and the returned
+    count reflects real proposals. Returns (post_nms_top_n, 4) boxes
+    (zero-padded) + valid count."""
     from .manipulation import concat
 
     rois = concat(list(multi_rois), axis=0)
     scores = concat(list(multi_scores), axis=0)
     r, s = unwrap(rois), unwrap(scores).reshape(-1)
+    if rois_num_per_level is not None:
+        # mask per-level pad rows: row i of level l is valid iff
+        # i < rois_num_per_level[l]
+        valid_rows = []
+        for lvl_scores, n in zip(multi_scores, rois_num_per_level):
+            n_l = n if isinstance(n, (int, np.integer)) else unwrap(n)
+            n_l = jnp.asarray(n_l).reshape(()).astype(jnp.int32)
+            size = int(np.prod(unwrap(lvl_scores).shape))
+            valid_rows.append(jnp.arange(size) < n_l)
+        row_valid = jnp.concatenate(valid_rows)
+        s = jnp.where(row_valid, s, -jnp.inf)
     k = min(int(post_nms_top_n), r.shape[0])
     top_s, top_i = lax.top_k(s, k)
     valid = jnp.isfinite(top_s)
@@ -436,7 +451,12 @@ def _box_decoder_and_assign(prior, pvar, deltas, scores, *, box_clip):
     var = pvar if pvar is not None else jnp.ones((N, 4), deltas.dtype)
 
     def dec(cls_deltas):
-        dd = jnp.clip(cls_deltas * var, -box_clip, box_clip)
+        dd = cls_deltas * var
+        # box_clip upper-bounds only the log-scale dw/dh columns (ref:
+        # box_decoder_and_assign_op.h:53 std::min(dw, clip) — caps exp()
+        # growth); dx/dy pass through unclipped, no lower bound
+        dd = jnp.concatenate(
+            [dd[:, :2], jnp.minimum(dd[:, 2:4], box_clip)], axis=1)
         return _decode_deltas(prior, dd)
 
     all_boxes = jax.vmap(dec, in_axes=1, out_axes=1)(d)   # (N, C, 4)

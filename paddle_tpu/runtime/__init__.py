@@ -288,6 +288,13 @@ class ShufflePool:
                              else capacity // 2, capacity)
         lib = get_lib()
         self._lib = lib
+        import threading as _t
+
+        # liveness guard: counts callers inside native push/pop so free
+        # can wait for them (mirrors the C-side inflight drain; this
+        # layer also stops NEW callers once the handle is retired)
+        self._guard = _t.Condition()
+        self._users = 0
         if lib is not None:
             self._h = lib.pt_shuffle_new(capacity, seed or 0)
         else:
@@ -298,16 +305,34 @@ class ShufflePool:
             self._rng = random.Random(seed)
             self._cap = capacity
             self._closed = False
-            import threading as _t
-
             self._cv = _t.Condition()
 
+    def _enter(self):
+        """Claim the native handle for one call; None once retired."""
+        with self._guard:
+            if self._h is None:
+                return None
+            self._users += 1
+            return self._h
+
+    def _exit(self):
+        with self._guard:
+            self._users -= 1
+            if self._users == 0:
+                self._guard.notify_all()
+
     def push(self, data: bytes) -> bool:
-        if self._h is not None:
-            rc = self._lib.pt_shuffle_push(self._h, data, len(data))
+        h = self._enter()
+        if h is not None:
+            try:
+                rc = self._lib.pt_shuffle_push(h, data, len(data))
+            finally:
+                self._exit()
             if rc == -2:  # malloc failure is an error, not a quiet stop
                 raise MemoryError("ShufflePool: native allocation failed")
             return rc == 0
+        if self._lib is not None:
+            return False  # native pool already freed
         with self._cv:
             while len(self._pool) >= self._cap and not self._closed:
                 self._cv.wait(0.1)
@@ -321,12 +346,16 @@ class ShufflePool:
         """A uniformly random blob; None when closed and drained; raises
         TimeoutError when ``timeout_ms`` elapses first (a slow producer
         is not end-of-stream)."""
-        if self._h is not None:
-            data = ctypes.c_void_p()
-            size = ctypes.c_size_t()
-            rc = self._lib.pt_shuffle_pop(self._h, ctypes.byref(data),
-                                          ctypes.byref(size),
-                                          self._min_fill, timeout_ms)
+        h = self._enter() if self._lib is not None else None
+        if h is not None:
+            try:
+                data = ctypes.c_void_p()
+                size = ctypes.c_size_t()
+                rc = self._lib.pt_shuffle_pop(h, ctypes.byref(data),
+                                              ctypes.byref(size),
+                                              self._min_fill, timeout_ms)
+            finally:
+                self._exit()
             if rc == 1:
                 raise TimeoutError(
                     f"ShufflePool.pop: no sample within {timeout_ms}ms")
@@ -335,6 +364,8 @@ class ShufflePool:
             out = ctypes.string_at(data, size.value)
             self._lib.pt_blob_free(data)
             return out
+        if self._lib is not None:
+            return None  # native pool already freed
         import time as _time
 
         deadline = None if timeout_ms < 0 \
@@ -358,25 +389,43 @@ class ShufflePool:
             return out
 
     def __len__(self):
-        if self._h is not None:
-            return self._lib.pt_shuffle_len(self._h)
+        h = self._enter() if self._lib is not None else None
+        if h is not None:
+            try:
+                return self._lib.pt_shuffle_len(h)
+            finally:
+                self._exit()
+        if self._lib is not None:
+            return 0
         with self._cv:
             return len(self._pool)
 
     def close(self):
-        if self._h is not None:
-            self._lib.pt_shuffle_close(self._h)
-        else:
-            with self._cv:
-                self._closed = True
-                self._cv.notify_all()
+        h = self._enter() if self._lib is not None else None
+        if h is not None:
+            try:
+                self._lib.pt_shuffle_close(h)
+            finally:
+                self._exit()
+            return
+        if self._lib is not None:
+            return
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
 
     def __del__(self):
         try:
-            if self._h is not None:
-                # close first: freeing under a producer still blocked in
-                # pt_shuffle_push would be a use-after-free
-                self._lib.pt_shuffle_close(self._h)
-                self._lib.pt_shuffle_free(self._h)
+            if self._lib is None or self._h is None:
+                return
+            # retire the handle first so no NEW caller can enter, then
+            # wait for in-flight push/pop to leave; the C free() adds a
+            # second drain (closed + inflight cv) for non-python callers
+            with self._guard:
+                h, self._h = self._h, None
+                self._lib.pt_shuffle_close(h)  # wakes blocked callers
+                while self._users:
+                    self._guard.wait(0.1)
+            self._lib.pt_shuffle_free(h)
         except Exception:
             pass

@@ -348,11 +348,14 @@ void pt_shard_reader_free(PtShardReader* sr) {
 
 struct PtShufflePool {
   std::mutex mu;
-  std::condition_variable cv_push, cv_pop;
+  std::condition_variable cv_push, cv_pop, cv_drain;
   std::vector<PtBlob> pool;
   size_t capacity;
   uint64_t rng;
   bool closed = false;
+  // callers currently inside push/pop; pt_shuffle_free waits for this
+  // to hit zero after close so a woken producer can't touch freed state
+  int inflight = 0;
 };
 
 static uint64_t pt_xorshift(uint64_t* s) {
@@ -374,16 +377,28 @@ PtShufflePool* pt_shuffle_new(size_t capacity, uint64_t seed) {
   return p;
 }
 
+static void pt_shuffle_exit(PtShufflePool* p) {
+  if (--p->inflight == 0 && p->closed) p->cv_drain.notify_all();
+}
+
 int pt_shuffle_push(PtShufflePool* p, const char* data, size_t size) {
   std::unique_lock<std::mutex> lk(p->mu);
+  ++p->inflight;
   p->cv_push.wait(lk, [&] { return p->pool.size() < p->capacity ||
                                    p->closed; });
-  if (p->closed) return -1;
+  if (p->closed) {
+    pt_shuffle_exit(p);
+    return -1;
+  }
   char* copy = static_cast<char*>(std::malloc(size));
-  if (!copy) return -2;
+  if (!copy) {
+    pt_shuffle_exit(p);
+    return -2;
+  }
   std::memcpy(copy, data, size);
   p->pool.push_back({copy, size});
   p->cv_pop.notify_one();
+  pt_shuffle_exit(p);
   return 0;
 }
 
@@ -392,6 +407,7 @@ int pt_shuffle_push(PtShufflePool* p, const char* data, size_t size) {
 int pt_shuffle_pop(PtShufflePool* p, char** data, size_t* size,
                    size_t min_fill, long timeout_ms) {
   std::unique_lock<std::mutex> lk(p->mu);
+  ++p->inflight;
   auto ready = [&] {
     return p->pool.size() >= (p->closed ? 1 : (min_fill ? min_fill : 1)) ||
            (p->closed && p->pool.empty());
@@ -400,15 +416,20 @@ int pt_shuffle_pop(PtShufflePool* p, char** data, size_t* size,
     p->cv_pop.wait(lk, ready);
   } else if (!p->cv_pop.wait_for(lk, std::chrono::milliseconds(timeout_ms),
                                  ready)) {
+    pt_shuffle_exit(p);
     return 1;  // timeout
   }
-  if (p->pool.empty()) return -1;  // closed and drained
+  if (p->pool.empty()) {
+    pt_shuffle_exit(p);
+    return -1;  // closed and drained
+  }
   size_t i = static_cast<size_t>(pt_xorshift(&p->rng) % p->pool.size());
   *data = p->pool[i].data;
   *size = p->pool[i].size;
   p->pool[i] = p->pool.back();
   p->pool.pop_back();
   p->cv_push.notify_one();
+  pt_shuffle_exit(p);
   return 0;
 }
 
@@ -425,6 +446,15 @@ void pt_shuffle_close(PtShufflePool* p) {
 }
 
 void pt_shuffle_free(PtShufflePool* p) {
+  {
+    // close + drain: wake every blocked push/pop and wait until the last
+    // one has left the monitor, so delete cannot race a woken producer
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->closed = true;
+    p->cv_pop.notify_all();
+    p->cv_push.notify_all();
+    p->cv_drain.wait(lk, [&] { return p->inflight == 0; });
+  }
   for (auto& b : p->pool) std::free(b.data);
   delete p;
 }

@@ -1,15 +1,17 @@
-"""CompiledProgram / BuildStrategy / ExecutionStrategy.
+"""CompiledProgram / BuildStrategy / ExecutionStrategy / ParallelExecutor.
 
-Ref: python/paddle/fluid/compiler.py + parallel_executor.cc. The reference's
+Ref: python/paddle/fluid/compiler.py + parallel_executor.py. The reference's
 ParallelExecutor replicates the graph per GPU and all-reduces grads over
-NCCL; on TPU the same thing is a sharding annotation: the Executor runs the
-single fused XLA program, and ``with_data_parallel`` marks the feed batch
-axis to be sharded over the device mesh so XLA partitions the program and
-inserts ICI all-reduces itself (see dist/ for the Mesh machinery).
+NCCL; on TPU the same thing is a sharding annotation: ``with_data_parallel``
+makes the Executor jit the one program over a ``Mesh(('data',))`` with the
+feed batch axis sharded and persistables replicated
+(``Executor._compile(data_parallel=True)``), so XLA partitions the program
+and inserts the ICI grad all-reduces itself (GSPMD).
 """
 from __future__ import annotations
 
-__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy",
+           "ParallelExecutor"]
 
 
 class BuildStrategy:
@@ -47,6 +49,11 @@ class CompiledProgram:
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
                            places=None):
+        """Mark this program for SPMD data parallelism: the Executor will
+        shard the feed batch axis over all local devices and keep
+        persistables replicated; since it is ONE logical program over the
+        global batch, the loss/grads match a single-device run of the same
+        global batch (no explicit grad averaging needed)."""
         self._data_parallel = True
         self._loss_name = loss_name
         if build_strategy is not None:
@@ -54,3 +61,43 @@ class CompiledProgram:
         if exec_strategy is not None:
             self._exec_strategy = exec_strategy
         return self
+
+
+class ParallelExecutor:
+    """Data-parallel executor (ref: python/paddle/fluid/parallel_executor.py
+    :28). The reference builds per-device SSA graphs + NCCL all-reduce ops;
+    here it is a thin front over ``CompiledProgram.with_data_parallel`` —
+    the single jitted SPMD program sharded over the local device mesh.
+    """
+
+    def __init__(self, use_cuda=None, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from .executor import Executor
+        from .program import default_main_program
+
+        program = main_program
+        if program is None:
+            program = default_main_program()
+        self._compiled = CompiledProgram(
+            program, build_strategy=build_strategy).with_data_parallel(
+                loss_name=loss_name, exec_strategy=exec_strategy,
+                share_vars_from=share_vars_from)
+        self._exe = Executor()
+        self._scope = scope
+
+    @property
+    def device_count(self):
+        import jax
+
+        return jax.local_device_count()
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._exe.run(self._compiled, feed=feed,
+                             fetch_list=fetch_list, scope=self._scope,
+                             return_numpy=return_numpy)
+
+    def drop_local_exe_scopes(self):
+        self._exe._cache.clear()

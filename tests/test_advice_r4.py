@@ -1,0 +1,173 @@
+"""Value-level regression tests for the round-4 advisor fixes
+(ADVICE.md r3): polygon_box_transform x4 scale, collect_fpn_proposals
+pad masking, box_decoder_and_assign clip scope, resize align_corners,
+ShufflePool close/free race.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.ops as ops
+
+
+def t(a, dtype="float32"):
+    return pt.to_tensor(np.asarray(a, dtype))
+
+
+def test_polygon_box_transform_values():
+    """ref polygon_box_transform_op.cc: out = 4*id_w - in (even chans),
+    4*id_h - in (odd chans) — EAST geo maps are quarter-resolution."""
+    x = np.zeros((1, 2, 2, 3), np.float32)
+    x[0, 0, 1, 2] = 1.0   # x-offset channel
+    x[0, 1, 1, 2] = 2.0   # y-offset channel
+    out = np.asarray(ops.polygon_box_transform(t(x)).numpy())
+    # channel 0: 4*col - in
+    exp0 = 4.0 * np.arange(3)[None, :].repeat(2, 0) - x[0, 0]
+    # channel 1: 4*row - in
+    exp1 = 4.0 * np.arange(2)[:, None].repeat(3, 1) - x[0, 1]
+    assert np.allclose(out[0, 0], exp0)
+    assert np.allclose(out[0, 1], exp1)
+
+
+def test_collect_fpn_masks_pad_rows():
+    """Zero-padded pad rows (score 0.0, the generate_proposals padding
+    convention) must not enter the top-k, and the returned count must
+    reflect only real proposals."""
+    # level 1: 1 real (score 0.2) + 2 pad rows; level 2: 1 real (0.1) + 1 pad
+    rois1 = t([[0, 0, 1, 1], [0, 0, 0, 0], [0, 0, 0, 0]])
+    scores1 = t([0.2, 0.0, 0.0])
+    rois2 = t([[0, 0, 3, 3], [0, 0, 0, 0]])
+    scores2 = t([0.1, 0.0])
+    out, n = ops.collect_fpn_proposals(
+        [rois1, rois2], [scores1, scores2], 2, 3, post_nms_top_n=4,
+        rois_num_per_level=[t([1], "int32"), t([1], "int32")])
+    assert int(np.asarray(n.numpy())) == 2  # NOT min(top_n, N)=4
+    o = np.asarray(out.numpy())
+    assert np.allclose(o[0], [0, 0, 1, 1])   # best real first
+    assert np.allclose(o[1], [0, 0, 3, 3])
+    assert np.allclose(o[2:], 0.0)           # pads zeroed
+
+
+def test_collect_fpn_without_counts_keeps_old_shape():
+    out, n = ops.collect_fpn_proposals(
+        [t([[0, 0, 1, 1]]), t([[0, 0, 3, 3]])],
+        [t([0.9]), t([0.5])], 2, 3, post_nms_top_n=2)
+    assert int(np.asarray(n.numpy())) == 2
+    assert np.allclose(np.asarray(out.numpy())[0], [0, 0, 1, 1])
+
+
+def test_box_decoder_clips_only_log_deltas():
+    """ref box_decoder_and_assign_op.h:53: box_clip upper-bounds dw/dh
+    only; dx/dy pass through unclipped."""
+    prior = t([[0.0, 0.0, 9.0, 9.0]])          # w=h=10 (plus-one conv)
+    pvar = t([1.0, 1.0, 1.0, 1.0])
+    clip = 1.0
+    # dx huge (should shift freely), dw huge (should clamp at clip)
+    deltas = t([[100.0, 0.0, 5.0, 0.0]])
+    scores = t([[1.0]])
+    decoded, assigned = ops.box_decoder_and_assign(
+        prior, pvar, deltas, scores, box_clip=clip)
+    d = np.asarray(decoded.numpy())[0]
+    cx = (d[0] + d[2] + 1) / 2.0
+    w = d[2] - d[0] + 1
+    assert cx > 500.0                      # dx unclipped: 100*10+4.5
+    assert np.isclose(w, 10.0 * np.e, rtol=1e-3)  # dw clamped to 1.0
+
+
+def test_resize_trilinear_align_corners():
+    """align_corners=True: corners map to corners exactly; a 2->3 upscale
+    of [0, 2] must hit the midpoint exactly (src = dst*(in-1)/(out-1))."""
+    x = np.zeros((1, 1, 2, 2, 2), np.float32)
+    x[0, 0, :, 0, 0] = [0.0, 2.0]
+    out = np.asarray(ops.resize_trilinear(
+        t(x), out_shape=[3, 2, 2], align_corners=True).numpy())
+    assert np.allclose(out[0, 0, :, 0, 0], [0.0, 1.0, 2.0], atol=1e-5)
+    # 2->4: corner-aligned src=dst/3 -> [0, 2/3, 4/3, 2]; align_mode=0
+    # (half-pixel, ref interpolate_op.h:118 align_flag) src=(dst+.5)/2-.5
+    # -> [0, .5, 1.5, 2]; align_mode=1 src=dst/2 -> [0, 1, 2, 2]
+    out4 = np.asarray(ops.resize_trilinear(
+        t(x), out_shape=[4, 2, 2], align_corners=True).numpy())
+    assert np.allclose(out4[0, 0, :, 0, 0], [0, 2 / 3, 4 / 3, 2],
+                       atol=1e-5)
+    out4_hp = np.asarray(ops.resize_trilinear(
+        t(x), out_shape=[4, 2, 2], align_corners=False,
+        align_mode=0).numpy())
+    assert np.allclose(out4_hp[0, 0, :, 0, 0], [0, 0.5, 1.5, 2],
+                       atol=1e-5)
+    out4_m1 = np.asarray(ops.resize_trilinear(
+        t(x), out_shape=[4, 2, 2], align_corners=False,
+        align_mode=1).numpy())
+    assert np.allclose(out4_m1[0, 0, :, 0, 0], [0, 1, 2, 2], atol=1e-5)
+
+
+def test_resize_nearest_reference_rules():
+    """ref interpolate_op.h:88: nearest ignores align_mode; src index =
+    floor(ratio*dst) (ratio=in/out) when not align_corners, else
+    floor(ratio*dst + 0.5) with ratio=(in-1)/(out-1)."""
+    import paddle_tpu.fluid.layers as L
+
+    x = np.arange(2, dtype=np.float32).reshape(1, 1, 2, 1)
+    x = np.tile(x, (1, 1, 1, 2))
+    out = np.asarray(L.resize_nearest(
+        t(x), out_shape=[4, 2], align_corners=False).numpy())
+    assert np.allclose(out[0, 0, :, 0], [0, 0, 1, 1])  # floor(dst*0.5)
+    x3 = np.arange(3, dtype=np.float32).reshape(1, 1, 3, 1)
+    x3 = np.tile(x3, (1, 1, 1, 2))
+    out3 = np.asarray(L.resize_nearest(
+        t(x3), out_shape=[5, 2], align_corners=True).numpy())
+    assert np.allclose(out3[0, 0, :, 0], [0, 1, 1, 2, 2])
+
+
+def test_resize_out_size_one():
+    """out==1 -> ratio 0 -> source row 0 (ref interpolate_op.h:572)."""
+    import paddle_tpu.fluid.layers as L
+
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 4, 1)
+    x = np.tile(x, (1, 1, 1, 2))
+    out = np.asarray(L.resize_bilinear(
+        t(x), out_shape=[1, 2], align_corners=True).numpy())
+    assert np.allclose(out[0, 0, 0, 0], 0.0)
+
+
+def test_resize_bilinear_align_corners():
+    import paddle_tpu.fluid.layers as L
+
+    x = np.zeros((1, 1, 2, 2), np.float32)
+    x[0, 0, :, 0] = [0.0, 2.0]
+    out = np.asarray(L.resize_bilinear(
+        t(x), out_shape=[3, 2], align_corners=True).numpy())
+    assert np.allclose(out[0, 0, :, 0], [0.0, 1.0, 2.0], atol=1e-5)
+
+
+def test_shuffle_pool_free_race():
+    """Producers blocked in push while the pool is closed + freed: free
+    must drain in-flight callers (no crash/UAF)."""
+    from paddle_tpu.runtime import ShufflePool
+
+    for _ in range(5):
+        pool = ShufflePool(capacity=2, seed=7)
+        stop = []
+
+        def produce():
+            i = 0
+            while not stop:
+                try:
+                    if not pool.push(b"x" * 64):
+                        return  # closed
+                except Exception:
+                    return
+                i += 1
+
+        threads = [threading.Thread(target=produce) for _ in range(3)]
+        for th in threads:
+            th.start()
+        time.sleep(0.02)  # let producers fill the pool and block
+        pool.close()
+        pool.__del__()    # close + drain + free explicitly
+        stop.append(1)
+        for th in threads:
+            th.join(timeout=5)
+            assert not th.is_alive()
