@@ -3,10 +3,14 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
-Workloads (BASELINE.json configs):
+Workloads (all 5 BASELINE.json configs):
   - BERT-Base pretrain step, seq 128 (headline: tokens/sec/chip)
   - ResNet-50 train step (imgs/sec/chip)
   - GPT-2-small train step, seq 1024 (tokens/sec/chip + MFU)
+  - Transformer-base WMT beam-4 inference (single-executable
+    lax.while_loop decode; output tokens/sec + per-sentence latency)
+  - MNIST LeNet static Program/Executor train step (imgs/sec incl.
+    host feed/fetch — the static-path overhead measurement)
 
 All run the fused donated TrainStep (fwd+bwd+clip+update in one XLA
 executable), bf16 params with f32 master weights — the standard TPU
@@ -34,6 +38,11 @@ class _Deadline(BaseException):
 BASELINE_BERT_TOKENS_S = 25600.0
 BASELINE_RESNET_IMGS_S = 980.0
 BASELINE_GPT_TOKENS_S = 25000.0  # GPT-2-small-class LM, V100 fp16
+# Transformer-base beam-4 batched decode, V100 fp16 stand-in (~50 sent/s
+# at ~30 output tokens each); LeNet-MNIST through the fluid Executor on
+# GPU was host-bound around 10k imgs/s.
+BASELINE_WMT_TOKENS_S = 1500.0
+BASELINE_LENET_IMGS_S = 10000.0
 
 PEAK_FLOPS = {  # per-chip peak bf16 FLOP/s
     "TPU v5e": 197e12,
@@ -157,6 +166,77 @@ def bench_gpt(B=8, L=1024):
             "loss": loss, "params": n_params}
 
 
+def bench_wmt_beam(B=16, L_src=32, beam=4, max_len=32):
+    """Transformer-base WMT en-de beam-search inference through the
+    single-executable decode (encode + static-KV-cache lax.while_loop
+    beam in ONE XLA program — no per-token host sync)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.nlp.transformer import WMTTransformer
+
+    pt.seed(0)
+    model = WMTTransformer(32000, 32000, d_model=512, nhead=8,
+                           num_layers=6, dim_feedforward=2048,
+                           dropout=0.0, max_len=max_len)
+    model.bfloat16()
+    model.eval()
+    rng = np.random.RandomState(0)
+    src = rng.randint(2, 32000, (B, L_src)).astype("int64")
+    warmup, iters = (1, 2) if SMOKE else (2, 8)
+    import jax
+
+    for _ in range(warmup):
+        toks, _ = model.beam_search_decode_xla(src, beam_size=beam,
+                                               max_len=max_len)
+    jax.block_until_ready(toks._data)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        toks, _ = model.beam_search_decode_xla(src, beam_size=beam,
+                                               max_len=max_len)
+    jax.block_until_ready(toks._data)
+    dt = (time.perf_counter() - t0) / iters
+    return {"tokens_per_sec": B * max_len / dt,
+            "sentences_per_sec": B / dt,
+            "latency_ms_per_batch": dt * 1e3, "beam": beam}
+
+
+def bench_lenet_exec(B=256):
+    """MNIST LeNet through the static Program/Executor feed/fetch loop
+    (BASELINE config 1) — measures compiled-program dispatch + host
+    round-trip overhead, the role the fluid Executor played."""
+    import paddle_tpu as pt
+    from paddle_tpu import optim
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models.vision import LeNet
+
+    pt.seed(0)
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, 1, 28, 28).astype("float32")
+    y = rng.randint(0, 10, (B,)).astype("int64")
+    pt.enable_static()
+    try:
+        main, startup = pt.static.Program(), pt.static.Program()
+        with pt.program_guard(main, startup):
+            xv = pt.static.data("x", [B, 1, 28, 28], "float32")
+            yv = pt.static.data("y", [B], "int64")
+            model = LeNet()
+            loss = F.cross_entropy(model(xv), yv)
+            optim.Momentum(0.01, 0.9,
+                           parameters=model.parameters()).minimize(loss)
+    finally:
+        pt.disable_static()
+    exe = pt.static.Executor()
+    exe.run(startup)
+    warmup, iters = (1, 2) if SMOKE else (3, 20)
+    for _ in range(warmup):
+        exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+    dt = (time.perf_counter() - t0) / iters
+    return {"imgs_per_sec": B / dt, "step_ms": dt * 1e3,
+            "loss": float(np.asarray(out[0]))}
+
+
 def _devices_blocking_guard(timeout_s):
     """jax.devices() through a worker thread: the axon tunnel client can
     BLOCK FOREVER inside PJRT init (observed live: relay down -> no
@@ -225,15 +305,20 @@ def _init_backend():
 def _run_benches(results):
     """Mutates `results` in place so legs finished before a watchdog
     deadline still reach the JSON line."""
-    global bench_bert, bench_resnet50, bench_gpt
+    global bench_bert, bench_resnet50, bench_gpt, bench_wmt_beam, \
+        bench_lenet_exec
     if SMOKE:
         import functools
 
         bench_bert = functools.partial(bench_bert, B=2, L=128)
         bench_resnet50 = functools.partial(bench_resnet50, B=2, size=64)
         bench_gpt = functools.partial(bench_gpt, B=1, L=128)
+        bench_wmt_beam = functools.partial(bench_wmt_beam, B=2, L_src=8,
+                                           beam=2, max_len=8)
+        bench_lenet_exec = functools.partial(bench_lenet_exec, B=8)
     for name, fn in (("bert", bench_bert), ("resnet50", bench_resnet50),
-                     ("gpt", bench_gpt)):
+                     ("gpt", bench_gpt), ("wmt_beam", bench_wmt_beam),
+                     ("lenet_exec", bench_lenet_exec)):
         pallas_env0 = os.environ.get("PADDLE_TPU_PALLAS")
         for attempt in (1, 2, 3):
             try:
@@ -400,6 +485,20 @@ def _score(results, headline, extras):
         extras["gpt_tokens_per_sec_no_pallas"] = round(off, 1)
         extras["pallas_speedup"] = round(
             results["gpt"]["tokens_per_sec"] / off, 3) if off else 0.0
+    if "wmt_beam" in results:
+        extras["wmt_beam_tokens_per_sec"] = round(
+            results["wmt_beam"]["tokens_per_sec"], 1)
+        extras["wmt_beam_latency_ms"] = round(
+            results["wmt_beam"]["latency_ms_per_batch"], 1)
+        extras["wmt_beam_vs_baseline"] = round(
+            results["wmt_beam"]["tokens_per_sec"] / BASELINE_WMT_TOKENS_S,
+            3)
+    if "lenet_exec" in results:
+        extras["lenet_exec_imgs_per_sec"] = round(
+            results["lenet_exec"]["imgs_per_sec"], 1)
+        extras["lenet_exec_vs_baseline"] = round(
+            results["lenet_exec"]["imgs_per_sec"] / BASELINE_LENET_IMGS_S,
+            3)
     return {**headline, **extras}
 
 

@@ -33,7 +33,12 @@ class Evaluator:
         self.metrics = []
 
     def reset(self, executor=None, reset_program=None):
-        self._metric.reset()
+        # subclasses here carry a streaming metric; a user subclass of the
+        # reference pattern (custom self.states) just gets them zeroed
+        m = getattr(self, "_metric", None)
+        if m is not None:
+            m.reset()
+        self.states = []
 
     def eval(self, executor=None, eval_program=None):
         raise NotImplementedError

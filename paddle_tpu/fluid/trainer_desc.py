@@ -72,7 +72,8 @@ class DataFeedDesc:
             import os
 
             if os.path.exists(proto_file):
-                self._parse(open(proto_file).read())
+                with open(proto_file) as f:
+                    self._parse(f.read())
 
     def _parse(self, text):
         import re
@@ -80,9 +81,13 @@ class DataFeedDesc:
         m = re.search(r"batch_size\s*:\s*(\d+)", text)
         if m:
             self.proto_desc["batch_size"] = int(m.group(1))
-        for sm in re.finditer(r"name\s*:\s*\"([^\"]+)\"", text):
-            self.proto_desc["slots"].append(
-                {"name": sm.group(1), "is_used": False})
+        # only names INSIDE slots{...} blocks are slots (the top-level
+        # name: "MultiSlotDataFeed" is the feed class, not a slot)
+        for block in re.finditer(r"slots\s*\{([^}]*)\}", text):
+            sm = re.search(r"name\s*:\s*\"([^\"]+)\"", block.group(1))
+            if sm:
+                self.proto_desc["slots"].append(
+                    {"name": sm.group(1), "is_used": False})
 
     def set_batch_size(self, n):
         self.proto_desc["batch_size"] = int(n)

@@ -24,7 +24,8 @@ from .. import ops
 from ..core.tensor import Tensor
 
 __all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode",
-           "beam_search", "greedy_search", "tile_beam", "gather_beams"]
+           "beam_search", "beam_search_xla", "greedy_search", "tile_beam",
+           "gather_beams"]
 
 _NEG_INF = -1e9
 
@@ -144,6 +145,108 @@ def beam_search(step_fn, init_state, batch_size, bos_id, eos_id, beam_size,
     if return_all:
         return tokens, scores
     return tokens[:, 0], scores[:, 0]
+
+
+def beam_search_xla(step_fn, init_state, batch_size, bos_id, eos_id,
+                    beam_size, max_len, length_penalty=0.6,
+                    return_all=False):
+    """Fully-traced beam search: one ``lax.while_loop`` whose body is a
+    decode step, so the whole decode compiles to a SINGLE XLA executable
+    with on-device early exit. The eager ``beam_search`` above syncs the
+    host every token (``bool(all(finished))``) — one device round-trip
+    per step, which dominates latency on a remote TPU; this version
+    never leaves the device.
+
+    Contract as ``beam_search`` with ``state_is_tiled=True``: step_fn
+    takes/returns framework Tensors; ``init_state`` leaves carry the
+    merged batch*beam leading dim and must be FIXED-SHAPE (use
+    ``TransformerDecoder.gen_static_cache``, not the concat-growing
+    ``gen_cache``). Call under ``jax.jit`` (or let the model wrapper jit
+    the surrounding encode+decode).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    B, K = batch_size, beam_size
+
+    def _unwrap(tree):
+        return jax.tree.map(
+            lambda x: x._data if isinstance(x, Tensor) else x, tree,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    def _wrap(tree):
+        return jax.tree.map(
+            lambda x: Tensor(x, _internal=True)
+            if isinstance(x, jnp.ndarray) else x, tree)
+
+    def _gather(tree, flat_idx):
+        def g(x):
+            if getattr(x, "ndim", 0) >= 1 and x.shape[0] == B * K:
+                return x[flat_idx]
+            return x  # scalars (cache idx) are beam-invariant
+
+        return jax.tree.map(g, tree)
+
+    state0 = _unwrap(init_state)
+    tokens0 = jnp.full((B, K, max_len), eos_id, jnp.int32)
+    tokens0 = tokens0.at[:, :, 0].set(bos_id)
+    # beam 0 live, the rest dead-on-arrival so identical initial beams
+    # don't crowd the first topk (same convention as the eager path)
+    lps0 = jnp.tile(jnp.array([0.0] + [_NEG_INF] * (K - 1), jnp.float32),
+                    (B, 1))
+    carry0 = (jnp.zeros((), jnp.int32),
+              jnp.full((B * K, 1), bos_id, jnp.int32),
+              tokens0, lps0,
+              jnp.zeros((B, K), bool),
+              jnp.ones((B, K), jnp.int32),
+              state0)
+
+    def cond(c):
+        t, _, _, _, finished, _, _ = c
+        return jnp.logical_and(t < max_len - 1, ~jnp.all(finished))
+
+    def body(c):
+        t, cur, tokens, log_probs, finished, lengths, state = c
+        logits_t, new_state_t = step_fn(
+            Tensor(cur, _internal=True), _wrap(state), t)
+        logits = logits_t._data.astype(jnp.float32)
+        V = logits.shape[-1]
+        lp = jax.nn.log_softmax(logits.reshape(B, K, V), axis=-1)
+        eos_row = jnp.full((V,), _NEG_INF, jnp.float32).at[eos_id].set(0.0)
+        lp = jnp.where(finished[:, :, None], eos_row[None, None, :], lp)
+        total = log_probs[:, :, None] + lp
+        top_v, top_i = lax.top_k(total.reshape(B, K * V), K)
+        beam_idx = top_i // V
+        tok = (top_i % V).astype(jnp.int32)
+        flat = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+        tokens = tokens.reshape(B * K, max_len)[flat] \
+            .reshape(B, K, max_len).at[:, :, t + 1].set(tok)
+        finished = finished.reshape(B * K)[flat].reshape(B, K)
+        lengths = lengths.reshape(B * K)[flat].reshape(B, K)
+        lengths = lengths + (~finished).astype(jnp.int32)
+        finished = jnp.logical_or(finished, tok == eos_id)
+        new_state = _gather(_unwrap(new_state_t), flat)
+        return (t + 1, tok.reshape(B * K, 1), tokens, top_v, finished,
+                lengths, new_state)
+
+    _, _, tokens, log_probs, finished, lengths, _ = lax.while_loop(
+        cond, body, carry0)
+
+    if length_penalty:
+        pen = jnp.power((lengths.astype(jnp.float32) + 5.0) / 6.0,
+                        length_penalty)
+    else:
+        pen = jnp.ones_like(lengths, jnp.float32)
+    scores = log_probs / pen
+    order = jnp.argsort(-scores, axis=-1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    flat = (jnp.arange(B)[:, None] * K + order).reshape(-1)
+    tokens = tokens.reshape(B * K, max_len)[flat].reshape(B, K, max_len)
+    if return_all:
+        return Tensor(tokens, _internal=True), Tensor(scores, _internal=True)
+    return Tensor(tokens[:, 0], _internal=True), \
+        Tensor(scores[:, 0], _internal=True)
 
 
 def greedy_search(step_fn, init_state, batch_size, bos_id, eos_id, max_len):

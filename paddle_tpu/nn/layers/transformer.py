@@ -27,6 +27,13 @@ __all__ = [
 class MultiHeadAttention(Layer):
     Cache = collections.namedtuple("Cache", ["k", "v"])
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+    # Fixed-size incremental KV cache: (B, H, max_len, D) buffers + a
+    # write index. Unlike Cache (which GROWS by concat and so re-traces
+    # every step), the static buffer keeps all shapes constant — the form
+    # lax.while_loop decode loops require, and the standard TPU KV-cache
+    # layout (one dynamic_update_slice per step, no reallocation).
+    StaticKVCache = collections.namedtuple("StaticKVCache",
+                                           ["k", "v", "idx"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
                  need_weights=False, weight_attr=None, bias_attr=None):
@@ -70,10 +77,21 @@ class MultiHeadAttention(Layer):
                                  key._data.dtype), _internal=True)
         return self.Cache(empty, empty)
 
+    def gen_static_kv_cache(self, batch_size, max_length, dtype="float32"):
+        """Zeroed fixed-size incremental cache (see StaticKVCache)."""
+        import jax.numpy as jnp
+
+        buf = Tensor(jnp.zeros(
+            (batch_size, self.num_heads, max_length, self.head_dim),
+            dtype), _internal=True)
+        return self.StaticKVCache(buf, buf, jnp.zeros((), jnp.int32))
+
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         key = query if key is None else key
         value = key if value is None else value
         q = self._split_heads(self.q_proj(query))
+        if isinstance(cache, self.StaticKVCache):
+            return self._forward_static_kv(q, key, value, attn_mask, cache)
         if isinstance(cache, self.StaticCache):
             k, v = cache.k, cache.v
         else:
@@ -94,6 +112,48 @@ class MultiHeadAttention(Layer):
         if isinstance(cache, self.Cache):
             outs.append(cache)
         return out if len(outs) == 1 else tuple(outs)
+
+    def _forward_static_kv(self, q, key, value, attn_mask, cache):
+        """One incremental step against the fixed-size buffer: write the
+        new K/V row at ``idx`` (dynamic_update_slice), attend over the
+        whole buffer with future rows masked out. Shapes never change,
+        so the step traces once inside lax.while_loop decode."""
+        import jax
+        import jax.numpy as jnp
+
+        k_new, v_new = self.compute_kv(key, value)      # (B, H, 1, D)
+        kb = cache.k._data if isinstance(cache.k, Tensor) else cache.k
+        vb = cache.v._data if isinstance(cache.v, Tensor) else cache.v
+        idx = cache.idx._data if isinstance(cache.idx, Tensor) else cache.idx
+        idx = jnp.asarray(idx, jnp.int32)
+        zero = jnp.zeros((), jnp.int32)
+        k_upd = jax.lax.dynamic_update_slice(
+            kb, k_new._data.astype(kb.dtype), (zero, zero, idx, zero))
+        v_upd = jax.lax.dynamic_update_slice(
+            vb, v_new._data.astype(vb.dtype), (zero, zero, idx, zero))
+        Lmax = kb.shape[2]
+        valid = (jnp.arange(Lmax) <= idx).reshape(1, 1, 1, Lmax)
+        mask_t = Tensor(valid, _internal=True)
+        if attn_mask is not None:
+            am = attn_mask._data if isinstance(attn_mask, Tensor) \
+                else attn_mask
+            if am.dtype == jnp.bool_:
+                mask_t = Tensor(jnp.logical_and(valid, am), _internal=True)
+            else:  # additive mask: fold the validity window into it
+                mask_t = Tensor(
+                    jnp.where(valid, am.astype(jnp.float32), -1e30),
+                    _internal=True)
+        out = F.sdpa_bhld(q, Tensor(k_upd, _internal=True),
+                          Tensor(v_upd, _internal=True), attn_mask=mask_t,
+                          dropout_p=self.dropout, training=self.training)
+        b = out.shape[0]
+        out = transpose(out, [0, 2, 1, 3])
+        out = reshape(out, [b, out.shape[1], self.embed_dim])
+        out = self.out_proj(out)
+        new_cache = self.StaticKVCache(Tensor(k_upd, _internal=True),
+                                       Tensor(v_upd, _internal=True),
+                                       idx + 1)
+        return out, new_cache
 
 
 def _activation(name):
@@ -268,6 +328,20 @@ class TransformerDecoder(Layer):
         if do_zip:
             cache = list(zip(*cache))
         return cache
+
+    def gen_static_cache(self, memory, max_length):
+        """Per-layer (StaticKVCache, StaticCache) pairs for fixed-shape
+        (jittable) incremental decode; cross-attention K/V precomputed
+        from ``memory`` as usual."""
+        b = memory.shape[0]
+        dtype = memory._data.dtype
+        out = []
+        for layer in self.layers:
+            inc = layer.self_attn.gen_static_kv_cache(b, max_length, dtype)
+            static = layer.cross_attn.gen_cache(
+                memory, memory, type=MultiHeadAttention.StaticCache)
+            out.append((inc, static))
+        return out
 
 
 class Transformer(Layer):

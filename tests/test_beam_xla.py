@@ -1,0 +1,94 @@
+"""Single-executable beam search (inference/decoder.py beam_search_xla +
+MultiHeadAttention.StaticKVCache): the lax.while_loop decode must produce
+the same tokens/scores as the eager per-step beam_search path.
+Capability ref: fluid/layers/rnn.py:2699 beam_search (+ the fused decode
+the reference's inference engine aspires to)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.optim as optim
+from paddle_tpu.models.nlp.transformer import WMTTransformer, wmt_loss
+from paddle_tpu.nn.layers.transformer import MultiHeadAttention
+
+
+def _tiny_trained_model(seed=0, steps=8):
+    pt.seed(seed)
+    rng = np.random.RandomState(seed)
+    src = rng.randint(2, 30, (8, 6)).astype("int64")
+    tgt_full = np.concatenate(
+        [np.zeros((8, 1), "int64"), (src + 1) % 40], axis=1)
+    model = WMTTransformer(30, 40, d_model=16, nhead=2, num_layers=2,
+                           dim_feedforward=32, dropout=0.0, max_len=16)
+    opt = optim.Adam(3e-3, parameters=model.parameters())
+    step = pt.TrainStep(
+        model, opt,
+        lambda m, s, ti, tl: wmt_loss(m, s, ti, tl, pad_id=None))
+    for _ in range(steps):
+        step(src, tgt_full[:, :-1], tgt_full[:, 1:])
+    model.eval()
+    return model, src
+
+
+def test_static_kv_cache_matches_growing_cache():
+    """One incremental step via StaticKVCache == the concat Cache."""
+    pt.seed(0)
+    mha = MultiHeadAttention(8, 2)
+    mha.eval()
+    x1 = pt.to_tensor(np.random.RandomState(0).randn(2, 1, 8)
+                      .astype("float32"))
+    x2 = pt.to_tensor(np.random.RandomState(1).randn(2, 1, 8)
+                      .astype("float32"))
+    grow = mha.gen_cache(pt.to_tensor(np.zeros((2, 1, 8), "float32")))
+    stat = mha.gen_static_kv_cache(2, 4, "float32")
+    o1g, grow = mha(x1, x1, x1, None, grow)
+    o1s, stat = mha(x1, x1, x1, None, stat)
+    np.testing.assert_allclose(np.asarray(o1g.numpy()),
+                               np.asarray(o1s.numpy()), rtol=1e-5)
+    o2g, grow = mha(x2, x2, x2, None, grow)
+    o2s, stat = mha(x2, x2, x2, None, stat)
+    np.testing.assert_allclose(np.asarray(o2g.numpy()),
+                               np.asarray(o2s.numpy()), rtol=1e-5)
+    assert int(stat.idx) == 2
+
+
+def test_xla_beam_matches_eager_beam():
+    model, src = _tiny_trained_model()
+    toks_e, scores_e = model.beam_search_decode(
+        pt.to_tensor(src[:4]), beam_size=3, max_len=10)
+    toks_x, scores_x = model.beam_search_decode_xla(
+        pt.to_tensor(src[:4]), beam_size=3, max_len=10)
+    np.testing.assert_array_equal(np.asarray(toks_e.numpy()),
+                                  np.asarray(toks_x.numpy()))
+    np.testing.assert_allclose(np.asarray(scores_e.numpy()),
+                               np.asarray(scores_x.numpy()), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_xla_beam_return_all_sorted():
+    model, src = _tiny_trained_model()
+    toks, scores = model.beam_search_decode_xla(
+        pt.to_tensor(src[:2]), beam_size=4, max_len=8, return_all=True)
+    s = np.asarray(scores.numpy())
+    assert s.shape == (2, 4)
+    assert (np.diff(s, axis=1) <= 1e-6).all()  # best-first
+    assert np.asarray(toks.numpy()).shape == (2, 4, 8)
+
+
+def test_xla_beam_is_one_executable():
+    """The decode must not sync per step: trace count == 1 and the jitted
+    fn is cached across calls with the same signature."""
+    model, src = _tiny_trained_model()
+    model.beam_search_decode_xla(pt.to_tensor(src[:2]), beam_size=2,
+                                 max_len=8)
+    assert len(model._xla_decode_cache) == 1
+    fn1 = next(iter(model._xla_decode_cache.values()))
+    model.beam_search_decode_xla(pt.to_tensor(src[2:4]), beam_size=2,
+                                 max_len=8)
+    assert next(iter(model._xla_decode_cache.values())) is fn1
+    # a different signature gets its own executable, the first survives
+    model.beam_search_decode_xla(pt.to_tensor(src[:2]), beam_size=3,
+                                 max_len=8)
+    assert len(model._xla_decode_cache) == 2
