@@ -87,6 +87,7 @@ from . import io_ as io
 from . import runtime
 from . import inference
 from . import quant
+from . import slim
 from . import hapi
 from . import dataset
 from . import vision

@@ -534,3 +534,8 @@ def op_freq_statistic(program):
             uni.setdefault(t, 0)
             uni[t] += 1
     return uni, counts
+
+
+# fluid.contrib.slim namespace (ref: fluid/contrib/slim/): pruning +
+# distillation live in paddle_tpu.slim; quantization in paddle_tpu.quant
+from .. import slim  # noqa: E402,F401

@@ -108,7 +108,11 @@ class TrainStep:
                     dispatch.fresh_tape():
                 ts = [Tensor(a, _internal=True) for a in batch]
                 loss = self.loss_fn(self.model, *ts)
-                for p in trainable:
+                for p in self._params:
+                    # ALL collected params, not just trainable: a frozen
+                    # teacher's stale .grad (possibly a tracer from its
+                    # own earlier TrainStep trace) must not be
+                    # accumulated into by this backward
                     p.grad = None
                 if scaler is not None:
                     scale = scaler_state["scale"]

@@ -1,0 +1,329 @@
+"""Model compression (slim): pruning + distillation.
+
+Capability refs:
+- magnitude/structured pruning:
+  python/paddle/fluid/contrib/slim/prune/pruner.py:22 (Pruner,
+  StructurePruner: pruning_axis + l1_norm criterion, lazy zeroing vs
+  real removal), prune_strategy.py (SensitivePruneStrategy,
+  UniformPruneStrategy — per-param ratios from a sensitivity scan)
+- distillation: slim/distillation/distiller.py:25,108,195 (L2Distiller,
+  FSPDistiller, SoftLabelDistiller)
+- quantization lives in ``paddle_tpu.quant`` (re-exported here).
+- light-NAS (slim/nas/light_nas_strategy.py) is a recorded descope
+  (SURVEY §4b): its controller-server search loop is orthogonal
+  infrastructure, not a modeling capability.
+
+TPU-first design: pruning is mask-based — weights stay DENSE with zeros
+(the layout XLA/MXU execute anyway; there is no sparse speedup to win on
+TPU without 2:4-style hardware support), masks are device arrays applied
+in one fused multiply, and "real" channel removal is offered as explicit
+layer surgery for Sequential-style graphs where shapes may legally
+shrink. Distillation losses are plain functions composed into TrainStep
+(the frozen teacher rides ``TrainStep(models=[teacher])``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear
+from ..nn.layers.conv import Conv2D
+from .. import ops
+
+__all__ = [
+    "Pruner", "MagnitudePruner", "StructuredPruner",
+    "sensitivity", "sensitive_prune_ratios", "uniform_prune",
+    "prune_conv_pair",
+    "l2_distill", "fsp_matrix", "fsp_distill", "soft_label_distill",
+    "DistillConfig", "distill_loss",
+]
+
+
+# ---------------------------------------------------------------------------
+# pruning
+# ---------------------------------------------------------------------------
+
+
+def _l1(w, axes):
+    return jnp.sum(jnp.abs(w), axis=axes)
+
+
+def _l2(w, axes):
+    return jnp.sqrt(jnp.sum(w * w, axis=axes))
+
+
+_CRITERIA = {"l1_norm": _l1, "l2_norm": _l2}
+
+
+class Pruner:
+    """Base pruner (ref pruner.py:22): computes a keep-mask per
+    parameter; ``prune`` zeroes the dropped entries in place and records
+    the mask so ``reapply`` can re-zero after optimizer steps (the
+    mask-based analog of the reference's scope surgery)."""
+
+    def __init__(self):
+        self.masks: dict = {}
+
+    def _mask_for(self, param, ratio):
+        raise NotImplementedError
+
+    def prune(self, model_or_params, ratio=0.5, ratios=None):
+        """Zero the lowest-criterion entries. ``ratios`` maps param name
+        -> ratio and wins over the uniform ``ratio``."""
+        params = model_or_params.parameters() \
+            if isinstance(model_or_params, Layer) else list(model_or_params)
+        for p in params:
+            if p.ndim < 2:  # biases/norm scales are never pruned
+                continue
+            r = (ratios or {}).get(p.name, ratio)
+            if r <= 0.0:
+                continue
+            mask = self._mask_for(p, float(r))
+            self.masks[p.name] = (p, mask)
+        self.reapply()
+        return self.masks
+
+    def reapply(self):
+        """Re-zero pruned entries (call after each optimizer step: dense
+        updates regrow pruned weights otherwise)."""
+        for p, mask in self.masks.values():
+            p._data = p._data * mask.astype(p._data.dtype)
+
+    def sparsity(self):
+        """Fraction of zeroed weight entries over all pruned params."""
+        tot = zeroed = 0
+        for p, mask in self.masks.values():
+            tot += mask.size
+            zeroed += int(mask.size - jnp.count_nonzero(mask))
+        return zeroed / tot if tot else 0.0
+
+
+class MagnitudePruner(Pruner):
+    """Unstructured magnitude pruning: drop the smallest |w| fraction
+    per parameter (ref pruner.py Pruner + the lazy path of
+    prune_tensor)."""
+
+    def _mask_for(self, param, ratio):
+        w = jnp.abs(param._data.astype(jnp.float32)).reshape(-1)
+        k = int(np.round(ratio * w.size))
+        if k <= 0:
+            return jnp.ones(param._data.shape, bool)
+        thresh = jnp.sort(w)[k - 1]
+        return (jnp.abs(param._data.astype(jnp.float32)) > thresh) \
+            .reshape(param._data.shape)
+
+
+class StructuredPruner(Pruner):
+    """Whole-filter (channel) pruning (ref pruner.py:34
+    StructurePruner): rank channels along ``pruning_axis`` by the
+    criterion over the remaining axes, zero the weakest ``ratio``.
+    Default axis 0 — conv filters (out_c, in_c, kh, kw); use axis 1 for
+    this framework's (in, out) Linear layout."""
+
+    def __init__(self, pruning_axis=0, criterion="l1_norm"):
+        super().__init__()
+        self.axis = int(pruning_axis)
+        self.criterion = _CRITERIA[criterion]
+
+    def _mask_for(self, param, ratio):
+        w = param._data.astype(jnp.float32)
+        axes = tuple(i for i in range(w.ndim) if i != self.axis)
+        scores = self.criterion(w, axes)
+        n = scores.shape[0]
+        k = int(np.round(ratio * n))
+        if k <= 0:
+            return jnp.ones(param._data.shape, bool)
+        order = jnp.argsort(scores)
+        keep = jnp.ones((n,), bool).at[order[:k]].set(False)
+        shape = [1] * w.ndim
+        shape[self.axis] = n
+        return jnp.broadcast_to(keep.reshape(shape), w.shape)
+
+    def pruned_channels(self, param):
+        """Indices of zeroed channels after prune() (for surgery)."""
+        _, mask = self.masks[param.name]
+        flat = jnp.moveaxis(mask, self.axis, 0).reshape(mask.shape[self.axis],
+                                                        -1)
+        return np.where(~np.asarray(flat[:, 0]))[0]
+
+
+def prune_conv_pair(conv, next_layer, ratio, criterion="l1_norm"):
+    """REAL channel removal for a conv -> (conv | linear) pair: rebuild
+    both layers with the weak output channels of ``conv`` physically
+    dropped (ref pruner.py prune_tensor lazy=False). Returns the kept
+    channel indices. ``next_layer`` may be None (prune the tail)."""
+    w = np.asarray(conv.weight.numpy())  # keep the model's dtype
+    wf = w.astype(np.float32)
+    scores = np.abs(wf).sum(axis=(1, 2, 3)) if criterion == "l1_norm" \
+        else np.sqrt((wf * wf).sum(axis=(1, 2, 3)))
+    n = w.shape[0]
+    k = int(np.round(ratio * n))
+    keep = np.sort(np.argsort(scores)[k:])
+    conv.weight._data = jnp.asarray(w[keep])
+    if conv.bias is not None:
+        conv.bias._data = jnp.asarray(
+            np.asarray(conv.bias.numpy())[keep])
+    conv._out_channels = len(keep)
+    if isinstance(next_layer, Conv2D):
+        nw = np.asarray(next_layer.weight.numpy())
+        next_layer.weight._data = jnp.asarray(nw[:, keep])
+        next_layer._in_channels = len(keep)
+    elif isinstance(next_layer, Linear):
+        # (in, out) rows grouped per input channel (e.g. after flatten):
+        # keep the row blocks belonging to surviving channels
+        nw = np.asarray(next_layer.weight.numpy())
+        per = nw.shape[0] // n
+        rows = np.concatenate([np.arange(c * per, (c + 1) * per)
+                               for c in keep])
+        next_layer.weight._data = jnp.asarray(nw[rows])
+    elif next_layer is not None:
+        raise TypeError(f"cannot rewire {type(next_layer).__name__} "
+                        "after channel removal")
+    return keep
+
+
+def sensitivity(model, eval_fn, params=None, ratios=(0.1, 0.3, 0.5, 0.7),
+                pruner=None):
+    """Per-parameter sensitivity scan (ref prune_strategy.py
+    SensitivePruneStrategy._compute_sensitivities): prune ONE parameter
+    at a time at each ratio, measure ``eval_fn()`` (higher = better),
+    restore, and return {param_name: {ratio: metric_loss}} where
+    metric_loss = baseline - pruned metric."""
+    pruner = pruner or StructuredPruner()
+    params = [p for p in (params or model.parameters()) if p.ndim >= 2]
+    base = float(eval_fn())
+    out = {}
+    for p in params:
+        saved = p._data
+        out[p.name] = {}
+        for r in ratios:
+            mask = pruner._mask_for(p, float(r))
+            p._data = saved * mask.astype(saved.dtype)
+            out[p.name][float(r)] = base - float(eval_fn())
+            p._data = saved
+    return out
+
+
+def sensitive_prune_ratios(sens, target_loss=0.05):
+    """Turn a sensitivity table into per-param ratios: the largest
+    scanned ratio whose metric loss stays within ``target_loss``
+    (greedy rule of SensitivePruneStrategy)."""
+    ratios = {}
+    for name, table in sens.items():
+        best = 0.0
+        for r in sorted(table):
+            if table[r] <= target_loss:
+                best = r
+        if best > 0.0:
+            ratios[name] = best
+    return ratios
+
+
+def uniform_prune(model, ratio, pruner=None):
+    """UniformPruneStrategy: one ratio for every prunable param."""
+    pruner = pruner or StructuredPruner()
+    pruner.prune(model, ratio=ratio)
+    return pruner
+
+
+# ---------------------------------------------------------------------------
+# distillation
+# ---------------------------------------------------------------------------
+
+
+def l2_distill(teacher_feat, student_feat):
+    """Mean squared feature distance (ref distiller.py:25 L2Distiller)."""
+    d = teacher_feat - student_feat
+    return ops.mean(d * d)
+
+
+def fsp_matrix(feat_a, feat_b):
+    """Flow-of-solution-procedure matrix (ref distiller.py:191
+    _fsp_matrix): (N, C1, H, W) x (N, C2, H, W) -> (N, C1, C2),
+    normalized by H*W. Built from taped ops so gradients flow to the
+    student features."""
+    n, c1, h, w = feat_a.shape
+    c2 = feat_b.shape[1]
+    am = ops.reshape(feat_a.astype("float32"), [n, c1, h * w])
+    bm = ops.reshape(feat_b.astype("float32"), [n, c2, h * w])
+    return ops.matmul(am, ops.transpose(bm, [0, 2, 1])) * (1.0 / (h * w))
+
+
+def fsp_distill(teacher_pairs, student_pairs):
+    """Mean L2 between teacher and student FSP matrices over
+    corresponding (begin, end) feature pairs (ref distiller.py:108
+    FSPDistiller)."""
+    losses = []
+    for (ta, tb), (sa, sb) in zip(teacher_pairs, student_pairs):
+        tm = fsp_matrix(ta, tb)
+        sm = fsp_matrix(sa, sb)
+        d = tm - sm
+        losses.append(ops.mean(d * d))
+    total = losses[0]
+    for l in losses[1:]:
+        total = total + l
+    return total / float(len(losses))
+
+
+def soft_label_distill(teacher_logits, student_logits,
+                       teacher_temperature=2.0, student_temperature=2.0):
+    """Soft-target cross entropy (ref distiller.py:195
+    SoftLabelDistiller): CE(softmax(t/Tt), log_softmax(s/Ts)). Taped ops
+    throughout — the student side must receive gradients."""
+    p_t = ops.softmax(
+        teacher_logits.astype("float32") * (1.0 / teacher_temperature),
+        axis=-1)
+    log_s = ops.log_softmax(
+        student_logits.astype("float32") * (1.0 / student_temperature),
+        axis=-1)
+    return ops.mean(ops.sum(p_t * log_s, axis=-1)) * -1.0
+
+
+class DistillConfig:
+    """Weights for the combined distillation objective."""
+
+    def __init__(self, task_weight=1.0, soft_label_weight=1.0,
+                 l2_weight=0.0, fsp_weight=0.0, temperature=2.0):
+        self.task_weight = task_weight
+        self.soft_label_weight = soft_label_weight
+        self.l2_weight = l2_weight
+        self.fsp_weight = fsp_weight
+        self.temperature = temperature
+
+
+def distill_loss(task_loss, teacher_logits, student_logits,
+                 config=None, teacher_feats=None, student_feats=None):
+    """Compose the standard distillation objective. Use inside a
+    TrainStep loss_fn with the frozen teacher passed via
+    ``TrainStep(models=[teacher])`` so its (non-trainable) params ride
+    the compiled step."""
+    cfg = config or DistillConfig()
+    loss = task_loss * cfg.task_weight
+    if cfg.soft_label_weight:
+        loss = loss + soft_label_distill(
+            teacher_logits, student_logits,
+            cfg.temperature, cfg.temperature) * cfg.soft_label_weight
+    if (cfg.l2_weight or cfg.fsp_weight) and (teacher_feats or
+                                              student_feats):
+        if not (teacher_feats and student_feats) or \
+                len(teacher_feats) != len(student_feats):
+            raise ValueError(
+                "feature distillation needs teacher_feats and "
+                "student_feats of equal length")
+    if cfg.l2_weight and teacher_feats:
+        for tf, sf in zip(teacher_feats, student_feats):
+            loss = loss + l2_distill(tf, sf) * cfg.l2_weight
+    if cfg.fsp_weight and teacher_feats and len(teacher_feats) >= 2:
+        pairs_t = list(zip(teacher_feats[:-1], teacher_feats[1:]))
+        pairs_s = list(zip(student_feats[:-1], student_feats[1:]))
+        loss = loss + fsp_distill(pairs_t, pairs_s) * cfg.fsp_weight
+    return loss
+
+
+# quantization is the fourth slim pillar — implemented in paddle_tpu.quant
+from .. import quant  # noqa: E402,F401
+from ..quant import (quantize_model, PostTrainingQuantization,  # noqa: E402,F401
+                     fake_quantize_abs_max)

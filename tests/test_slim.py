@@ -1,0 +1,257 @@
+"""slim pruning + distillation (VERDICT r3 Missing #2 / Next #5).
+
+Model: the reference's slim tests (contrib/slim/tests/
+test_filter_pruning.py style) — train a small model, compress, assert
+the accuracy cost is bounded and the compression is real.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optim as optim
+from paddle_tpu import slim
+from paddle_tpu.models.vision import LeNet
+
+
+def _digits(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    means = rng.randn(10, 1, 28, 28).astype("float32") * 2.0
+    y = rng.randint(0, 10, n)
+    x = means[y] + rng.randn(n, 1, 28, 28).astype("float32") * 0.5
+    return x, y.astype("int64")
+
+
+def _accuracy(model, x, y):
+    model.eval()
+    pred = np.asarray(model(pt.to_tensor(x)).numpy()).argmax(-1)
+    model.train()
+    return float((pred == y).mean())
+
+
+@pytest.fixture(scope="module")
+def trained_lenet():
+    pt.seed(0)
+    x, y = _digits()
+    model = LeNet()
+    opt = optim.Adam(2e-3, parameters=model.parameters())
+    step = pt.TrainStep(model, opt,
+                        lambda m, xb, yb: F.cross_entropy(m(xb), yb))
+    for _ in range(30):
+        step(x, y)
+    acc = _accuracy(model, x, y)
+    assert acc > 0.9, acc
+    return model, x, y, acc
+
+
+def test_magnitude_prune_keeps_accuracy(trained_lenet):
+    model, x, y, acc = trained_lenet
+    saved = {p.name: np.asarray(p.numpy()) for p in model.parameters()}
+    try:
+        pruner = slim.MagnitudePruner()
+        pruner.prune(model, ratio=0.5)
+        assert 0.45 <= pruner.sparsity() <= 0.55
+        pruned_acc = _accuracy(model, x, y)
+        assert pruned_acc >= acc - 0.15, (acc, pruned_acc)
+    finally:
+        for p in model.parameters():
+            p._data = jnp.asarray(saved[p.name])
+
+
+def test_magnitude_prune_hurts_at_extreme(trained_lenet):
+    """95% magnitude pruning must visibly damage the model — proves the
+    mask really zeroes weight mass, not a no-op."""
+    model, x, y, acc = trained_lenet
+    saved = {p.name: np.asarray(p.numpy()) for p in model.parameters()}
+    try:
+        slim.MagnitudePruner().prune(model, ratio=0.95)
+        assert _accuracy(model, x, y) < acc - 0.2
+    finally:
+        for p in model.parameters():
+            p._data = jnp.asarray(saved[p.name])
+
+
+def test_structured_prune_zeroes_whole_channels(trained_lenet):
+    model, x, y, acc = trained_lenet
+    saved = {p.name: np.asarray(p.numpy()) for p in model.parameters()}
+    try:
+        pruner = slim.StructuredPruner(pruning_axis=0)
+        conv_params = [p for p in model.parameters() if p.ndim == 4]
+        pruner.prune(conv_params, ratio=0.25)
+        for p in conv_params:
+            w = np.asarray(p.numpy())
+            ch_mass = np.abs(w).sum(axis=(1, 2, 3))
+            n_zero = int((ch_mass == 0.0).sum())
+            assert n_zero == int(np.round(0.25 * w.shape[0])), p.name
+    finally:
+        for p in model.parameters():
+            p._data = jnp.asarray(saved[p.name])
+
+
+def test_reapply_after_optimizer_step(trained_lenet):
+    """Dense optimizer updates regrow pruned weights; reapply() must
+    re-zero them (the training-loop contract)."""
+    model, x, y, _ = trained_lenet
+    saved = {p.name: np.asarray(p.numpy()) for p in model.parameters()}
+    def zeros_frac():
+        tot = z = 0
+        for p in model.parameters():
+            if p.ndim >= 2:
+                w = np.asarray(p.numpy())
+                tot += w.size
+                z += int((w == 0.0).sum())
+        return z / tot
+
+    try:
+        pruner = slim.MagnitudePruner()
+        pruner.prune(model, ratio=0.5)
+        zf_pruned = zeros_frac()
+        assert zf_pruned >= 0.45
+        opt = optim.SGD(0.05, parameters=model.parameters())
+        step = pt.TrainStep(model, opt,
+                            lambda m, xb, yb: F.cross_entropy(m(xb), yb))
+        step(x[:64], y[:64])
+        assert zeros_frac() < zf_pruned - 0.2  # dense update regrew them
+        pruner.reapply()
+        assert zeros_frac() >= 0.45            # reapply re-zeroed
+    finally:
+        for p in model.parameters():
+            p._data = jnp.asarray(saved[p.name])
+
+
+def test_sensitivity_scan_and_ratio_selection(trained_lenet):
+    model, x, y, acc = trained_lenet
+    conv_params = [p for p in model.parameters() if p.ndim == 4][:2]
+    sens = slim.sensitivity(model, lambda: _accuracy(model, x, y),
+                            params=conv_params, ratios=(0.2, 0.6))
+    assert set(sens) == {p.name for p in conv_params}
+    # scan must restore weights: accuracy unchanged afterwards
+    assert abs(_accuracy(model, x, y) - acc) < 1e-6
+    ratios = slim.sensitive_prune_ratios(sens, target_loss=0.5)
+    assert all(r in (0.2, 0.6) for r in ratios.values())
+
+
+def test_real_channel_removal_lenet():
+    """prune_conv_pair physically shrinks conv1 and rewires conv2;
+    the pruned network still runs and keeps most of its accuracy."""
+    pt.seed(1)
+    x, y = _digits(seed=1)
+    model = LeNet()
+    opt = optim.Adam(2e-3, parameters=model.parameters())
+    step = pt.TrainStep(model, opt,
+                        lambda m, xb, yb: F.cross_entropy(m(xb), yb))
+    for _ in range(30):
+        step(x, y)
+    acc = _accuracy(model, x, y)
+    convs = [m for m in model.sublayers() if isinstance(m, nn.Conv2D)]
+    c0 = int(convs[0].weight.shape[0])
+    keep = slim.prune_conv_pair(convs[0], convs[1], ratio=0.5)
+    assert len(keep) == c0 - int(np.round(0.5 * c0))
+    assert convs[0].weight.shape[0] == len(keep)
+    assert convs[1].weight.shape[1] == len(keep)
+    # the physically smaller network still runs end to end
+    assert np.asarray(model(pt.to_tensor(x[:4])).numpy()).shape == (4, 10)
+    # and recovers with the standard post-surgery fine-tune (fresh
+    # optimizer: slot shapes changed with the weights)
+    opt2 = optim.Adam(2e-3, parameters=model.parameters())
+    step2 = pt.TrainStep(model, opt2,
+                         lambda m, xb, yb: F.cross_entropy(m(xb), yb))
+    for _ in range(15):
+        step2(x, y)
+    pruned_acc = _accuracy(model, x, y)
+    assert pruned_acc >= acc - 0.1, (acc, pruned_acc)
+
+
+def test_soft_label_distillation_trains_student():
+    """Student distilled from a trained teacher must learn the task
+    (TrainStep(models=[teacher]) carries the frozen teacher)."""
+    pt.seed(0)
+    x, y = _digits(n=128)
+
+    class Tiny(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.flat = nn.Flatten()
+            self.fc = nn.Linear(784, 10)
+
+        def forward(self, v):
+            return self.fc(self.flat(v))
+
+    teacher = LeNet()
+    topt = optim.Adam(2e-3, parameters=teacher.parameters())
+    tstep = pt.TrainStep(teacher, topt,
+                         lambda m, xb, yb: F.cross_entropy(m(xb), yb))
+    for _ in range(25):
+        tstep(x, y)
+    teacher.eval()
+    for p in teacher.parameters():
+        p.trainable = False
+        p.stop_gradient = True
+
+    student = Tiny()
+    cfg = slim.DistillConfig(task_weight=0.5, soft_label_weight=0.5,
+                             temperature=3.0)
+
+    def loss_fn(m, xb, yb):
+        s_logits = m(xb)
+        t_logits = teacher(xb)
+        return slim.distill_loss(F.cross_entropy(s_logits, yb),
+                                 t_logits, s_logits, cfg)
+
+    sopt = optim.Adam(2e-3, parameters=student.parameters())
+    sstep = pt.TrainStep(student, sopt, loss_fn, models=[teacher])
+    losses = [float(sstep(x, y)) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.6, losses
+    assert _accuracy(student, x, y) > 0.6
+
+
+def test_distill_losses_are_taped():
+    """The student side of every distillation loss must receive
+    gradients (regression: raw-jnp implementations were invisible to
+    the autograd tape)."""
+    rng = np.random.RandomState(0)
+    s = pt.to_tensor(rng.randn(4, 10).astype("float32"))
+    s.stop_gradient = False
+    t = pt.to_tensor(rng.randn(4, 10).astype("float32"))
+    slim.soft_label_distill(t, s).backward()
+    assert s.grad is not None
+    assert float(np.abs(np.asarray(s.grad.numpy())).sum()) > 0.0
+
+    sa = pt.to_tensor(rng.randn(2, 3, 4, 4).astype("float32"))
+    sb = pt.to_tensor(rng.randn(2, 5, 4, 4).astype("float32"))
+    sa.stop_gradient = False
+    sb.stop_gradient = False
+    ta = pt.to_tensor(rng.randn(2, 3, 4, 4).astype("float32"))
+    tb = pt.to_tensor(rng.randn(2, 5, 4, 4).astype("float32"))
+    slim.fsp_distill([(ta, tb)], [(sa, sb)]).backward()
+    assert sa.grad is not None and sb.grad is not None
+    assert float(np.abs(np.asarray(sa.grad.numpy())).sum()) > 0.0
+
+    s2 = pt.to_tensor(rng.randn(4, 8).astype("float32"))
+    s2.stop_gradient = False
+    slim.l2_distill(pt.to_tensor(rng.randn(4, 8).astype("float32")),
+                    s2).backward()
+    assert s2.grad is not None
+
+
+def test_distill_loss_feature_guard():
+    t = pt.to_tensor(np.zeros((2, 4), "float32"))
+    with pytest.raises(ValueError):
+        slim.distill_loss(pt.to_tensor(np.float32(0.0)), t, t,
+                          slim.DistillConfig(l2_weight=1.0),
+                          teacher_feats=[t], student_feats=None)
+
+
+def test_fsp_matrix_shape_and_l2():
+    a = pt.to_tensor(np.random.RandomState(0)
+                     .randn(2, 3, 4, 4).astype("float32"))
+    b = pt.to_tensor(np.random.RandomState(1)
+                     .randn(2, 5, 4, 4).astype("float32"))
+    m = slim.fsp_matrix(a, b)
+    assert np.asarray(m.numpy()).shape == (2, 3, 5)
+    assert float(np.asarray(slim.l2_distill(a, a).numpy())) == 0.0
+    loss = slim.fsp_distill([(a, b)], [(a, b)])
+    assert float(np.asarray(loss.numpy())) == 0.0
