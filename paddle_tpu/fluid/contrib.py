@@ -539,3 +539,14 @@ def op_freq_statistic(program):
 # fluid.contrib.slim namespace (ref: fluid/contrib/slim/): pruning +
 # distillation live in paddle_tpu.slim; quantization in paddle_tpu.quant
 from .. import slim  # noqa: E402,F401
+
+
+# contrib analysis tools (ref: fluid/contrib/memory_usage_calc.py:46,
+# model_stat.py:40, op_frequence.py) — implementations in utils/stats.py
+# read the compiled executable's own memory/cost analysis
+from ..utils.stats import memory_usage, summary as model_summary  # noqa: E402,F401
+import types as _types  # noqa: E402
+
+memory_usage_calc = _types.SimpleNamespace(memory_usage=memory_usage)
+model_stat = _types.SimpleNamespace(summary=model_summary)
+op_frequence = _types.SimpleNamespace(op_freq_statistic=op_freq_statistic)

@@ -25,7 +25,26 @@ from ..core.tensor import Tensor
 
 __all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode",
            "beam_search", "beam_search_xla", "greedy_search", "tile_beam",
-           "gather_beams"]
+           "gather_beams", "tree_unwrap", "tree_wrap"]
+
+
+def tree_unwrap(tree):
+    """Framework-Tensor pytree -> raw jnp pytree (Tensors are leaves)."""
+    import jax
+
+    return jax.tree.map(
+        lambda x: x._data if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def tree_wrap(tree):
+    """Raw jnp pytree -> framework-Tensor pytree."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda x: Tensor(x, _internal=True)
+        if isinstance(x, jnp.ndarray) else x, tree)
 
 _NEG_INF = -1e9
 
@@ -169,16 +188,7 @@ def beam_search_xla(step_fn, init_state, batch_size, bos_id, eos_id,
     from jax import lax
 
     B, K = batch_size, beam_size
-
-    def _unwrap(tree):
-        return jax.tree.map(
-            lambda x: x._data if isinstance(x, Tensor) else x, tree,
-            is_leaf=lambda x: isinstance(x, Tensor))
-
-    def _wrap(tree):
-        return jax.tree.map(
-            lambda x: Tensor(x, _internal=True)
-            if isinstance(x, jnp.ndarray) else x, tree)
+    _unwrap, _wrap = tree_unwrap, tree_wrap
 
     def _gather(tree, flat_idx):
         def g(x):

@@ -33,6 +33,9 @@ from ...dist.tp_layers import (ColumnParallelLinear, RowParallelLinear,
                                VocabParallelEmbedding, mark_sharding,
                                _constrain)
 from ...dist.env import get_mesh
+from ...nn.layers.transformer import MultiHeadAttention as _MHA
+
+StaticKVCache = _MHA.StaticKVCache  # shared fixed-size KV-cache record
 
 __all__ = ["GPTConfig", "GPT", "GPTBlock", "gpt_loss", "GPTPipeline",
            "gpt_tiny", "gpt_small"]
@@ -101,6 +104,8 @@ class GPTAttention(Layer):
             return ops.transpose(t, [0, 2, 1, 3])
 
         q, k, v = heads_of(q, L), heads_of(k, L), heads_of(v, L)
+        if isinstance(cache, StaticKVCache):
+            return self._forward_static_kv(q, k, v, cache, B, L)
         new_cache = None
         if cache is not None:
             pk, pv = cache
@@ -123,6 +128,20 @@ class GPTAttention(Layer):
                           [B, L, self.cfg.hidden])
         out = self.drop(self.proj(att))
         return out if cache is None and new_cache is None else (out, new_cache)
+
+    def _forward_static_kv(self, q, k_new, v_new, cache, B, L):
+        """Incremental attention against fixed-size KV buffers — the
+        shared jittable decode core (nn/layers/transformer.py
+        static_kv_attention) plus this block's output projection."""
+        from ...nn.layers.transformer import static_kv_attention
+
+        att, new_cache = static_kv_attention(
+            q, k_new, v_new, cache, dropout_p=self.cfg.dropout,
+            training=self.training)
+        att = ops.reshape(ops.transpose(att, [0, 2, 1, 3]),
+                          [B, L, self.cfg.hidden])
+        out = self.drop(self.proj(att))
+        return out, new_cache
 
 
 class GPTBlock(Layer):
@@ -178,8 +197,18 @@ class GPT(Layer):
 
     def forward(self, ids, cache=None):
         B, L = ids.shape[0], ids.shape[1]
-        pos0 = 0 if cache is None else cache[0][0].shape[2]
-        pos = ops.arange(pos0, pos0 + L, dtype="int64")
+        if cache is None:
+            pos = ops.arange(0, L, dtype="int64")
+        elif isinstance(cache[0], StaticKVCache):
+            # write index (possibly traced) is the global position;
+            # int32 — positions fit trivially and x64 is never enabled
+            idx = cache[0].idx
+            idx = idx._data if isinstance(idx, Tensor) else idx
+            pos = Tensor(jnp.arange(L, dtype=jnp.int32) +
+                         jnp.asarray(idx, jnp.int32), _internal=True)
+        else:
+            pos = ops.arange(cache[0][0].shape[2],
+                             cache[0][0].shape[2] + L, dtype="int64")
         x = self.wte(ids) + self.wpe(pos)
         x = self.drop(x)
         x = _sp_constrain(x, self.cfg)
@@ -212,6 +241,15 @@ class GPT(Layer):
         z = Tensor(jnp.zeros(shape, self.wte.weight.dtype), _internal=True)
         return [(z, z) for _ in range(self.cfg.layers)]
 
+    def init_static_cache(self, batch_size, max_length):
+        """Fixed-size per-layer KV buffers for the jittable decode."""
+        shape = (batch_size, self.cfg.heads, max_length,
+                 self.cfg.hidden // self.cfg.heads)
+        return [StaticKVCache(
+            Tensor(jnp.zeros(shape, self.wte.weight.dtype), _internal=True),
+            Tensor(jnp.zeros(shape, self.wte.weight.dtype), _internal=True),
+            jnp.zeros((), jnp.int32)) for _ in range(self.cfg.layers)]
+
     def generate(self, ids, max_new_tokens=32, temperature=1.0, top_k=None):
         """Greedy/sampled decode with KV cache (eager path)."""
         import numpy as np
@@ -236,6 +274,81 @@ class GPT(Layer):
             out = ops.concat([out, nxt], axis=1)
             cur = nxt
         return out
+
+    # -- single-executable decode (static KV cache + lax.scan) -------------
+    def _traced_generate(self, ids, key, *, max_new_tokens, temperature,
+                         top_k):
+        from ...inference.decoder import tree_unwrap, tree_wrap
+
+        B, Lp = ids.shape
+        max_len = Lp + max_new_tokens
+        caches = self.init_static_cache(B, max_len)
+
+        def pick(last, k):  # last: (B, V) raw array
+            if temperature == 0.0:
+                return jnp.argmax(last, axis=-1)
+            logits = last.astype(jnp.float32) / temperature
+            if top_k is not None:
+                kth = jax.lax.top_k(logits, int(top_k))[0][:, -1:]
+                logits = jnp.where(logits < kth, -1e30, logits)
+            return jax.random.categorical(k, logits, axis=-1)
+
+        keys = jax.random.split(key, max_new_tokens)
+        logits, caches = self.forward(Tensor(ids, _internal=True),
+                                      cache=caches)  # prefill
+        nxt = pick(logits._data[:, -1], keys[0])
+
+        def body(carry, k):
+            cur, st = carry
+            lg, st_t = self.forward(
+                Tensor(cur[:, None], _internal=True), cache=tree_wrap(st))
+            tok = pick(lg._data[:, -1], k)
+            return (tok, tree_unwrap(st_t)), tok
+
+        (_, _), toks = jax.lax.scan(body, (nxt, tree_unwrap(caches)),
+                                    keys[1:])
+        gen = jnp.concatenate([nxt[:, None],
+                               jnp.transpose(toks, (1, 0))], axis=1) \
+            if max_new_tokens > 1 else nxt[:, None]
+        # int32 throughout (x64 is never enabled; values are token ids)
+        return jnp.concatenate([ids.astype(jnp.int32),
+                                gen.astype(jnp.int32)], axis=1)
+
+    def generate_xla(self, ids, max_new_tokens=32, temperature=0.0,
+                     top_k=None, seed=0):
+        """Whole-decode jit: prefill + lax.scan token loop in ONE XLA
+        executable over fixed-size KV buffers — no per-token dispatch or
+        host sync (``generate`` above pays both every token). Greedy at
+        temperature 0.0, else top-k/temperature sampling. One cached
+        executable per (shape, knobs) signature; parameters are threaded
+        as jit ARGUMENTS (not baked constants), so weight updates between
+        calls are honored without retracing."""
+        import functools
+
+        from ...framework.jit import _rebind
+
+        ids_arr = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        key = jax.random.PRNGKey(seed)
+        sig = (tuple(ids_arr.shape), int(max_new_tokens),
+               float(temperature), top_k, self.training)
+        cache = getattr(self, "_xla_gen_cache", None)
+        if cache is None:
+            cache = self._xla_gen_cache = {}
+        if sig not in cache:
+            params = list(self.parameters())
+            traced = functools.partial(
+                self._traced_generate, max_new_tokens=int(max_new_tokens),
+                temperature=float(temperature), top_k=top_k)
+
+            def with_params(param_arrs, ids_a, k, _traced=traced,
+                            _params=params):
+                with _rebind(_params, list(param_arrs)):
+                    return _traced(ids_a, k)
+
+            cache[sig] = (params, jax.jit(with_params))
+        params, fn = cache[sig]
+        return Tensor(fn([p._data for p in params], ids_arr, key),
+                      _internal=True)
 
 
 def gpt_loss(model, ids, labels):

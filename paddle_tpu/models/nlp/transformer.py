@@ -193,13 +193,16 @@ class WMTTransformer(Layer):
         """Whole-decode jit: encode + lax.while_loop beam search compile
         to ONE XLA executable with on-device early exit — no per-token
         host sync (the eager ``beam_search_decode`` pays a device
-        round-trip every step). Weights are constant-folded into the
-        executable (inference-engine convention; recompiles per
-        (batch, src_len, beam, max_len) signature)."""
+        round-trip every step). One executable per (batch, src_len,
+        beam, max_len) signature; parameters are threaded as jit
+        ARGUMENTS (not baked constants), so training between calls is
+        honored without retracing."""
         import functools
 
         import jax
         import jax.numpy as jnp
+
+        from ...framework.jit import _rebind
 
         max_len = max_len or self.max_len
         src_arr = src._data if isinstance(src, Tensor) \
@@ -211,11 +214,20 @@ class WMTTransformer(Layer):
         if cache is None:
             cache = self._xla_decode_cache = {}  # one executable per key
         if key not in cache:
-            cache[key] = jax.jit(functools.partial(
+            params = list(self.parameters())
+            traced = functools.partial(
                 self._traced_beam_decode, beam_size=beam_size,
                 max_len=max_len, src_pad_id=src_pad_id,
-                length_penalty=length_penalty, return_all=return_all))
-        toks, scores = cache[key](src_arr)
+                length_penalty=length_penalty, return_all=return_all)
+
+            def with_params(param_arrs, src_a, _traced=traced,
+                            _params=params):
+                with _rebind(_params, list(param_arrs)):
+                    return _traced(src_a)
+
+            cache[key] = (params, jax.jit(with_params))
+        params, fn = cache[key]
+        toks, scores = fn([p._data for p in params], src_arr)
         return Tensor(toks, _internal=True), Tensor(scores, _internal=True)
 
 

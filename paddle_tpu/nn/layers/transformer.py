@@ -114,46 +114,61 @@ class MultiHeadAttention(Layer):
         return out if len(outs) == 1 else tuple(outs)
 
     def _forward_static_kv(self, q, key, value, attn_mask, cache):
-        """One incremental step against the fixed-size buffer: write the
-        new K/V row at ``idx`` (dynamic_update_slice), attend over the
-        whole buffer with future rows masked out. Shapes never change,
-        so the step traces once inside lax.while_loop decode."""
-        import jax
-        import jax.numpy as jnp
-
-        k_new, v_new = self.compute_kv(key, value)      # (B, H, 1, D)
-        kb = cache.k._data if isinstance(cache.k, Tensor) else cache.k
-        vb = cache.v._data if isinstance(cache.v, Tensor) else cache.v
-        idx = cache.idx._data if isinstance(cache.idx, Tensor) else cache.idx
-        idx = jnp.asarray(idx, jnp.int32)
-        zero = jnp.zeros((), jnp.int32)
-        k_upd = jax.lax.dynamic_update_slice(
-            kb, k_new._data.astype(kb.dtype), (zero, zero, idx, zero))
-        v_upd = jax.lax.dynamic_update_slice(
-            vb, v_new._data.astype(vb.dtype), (zero, zero, idx, zero))
-        Lmax = kb.shape[2]
-        valid = (jnp.arange(Lmax) <= idx).reshape(1, 1, 1, Lmax)
-        mask_t = Tensor(valid, _internal=True)
-        if attn_mask is not None:
-            am = attn_mask._data if isinstance(attn_mask, Tensor) \
-                else attn_mask
-            if am.dtype == jnp.bool_:
-                mask_t = Tensor(jnp.logical_and(valid, am), _internal=True)
-            else:  # additive mask: fold the validity window into it
-                mask_t = Tensor(
-                    jnp.where(valid, am.astype(jnp.float32), -1e30),
-                    _internal=True)
-        out = F.sdpa_bhld(q, Tensor(k_upd, _internal=True),
-                          Tensor(v_upd, _internal=True), attn_mask=mask_t,
-                          dropout_p=self.dropout, training=self.training)
+        """One incremental step against the fixed-size buffer (shared
+        machinery in ``static_kv_attention``)."""
+        k_new, v_new = self.compute_kv(key, value)      # (B, H, L, D)
+        out, new_cache = static_kv_attention(
+            q, k_new, v_new, cache, attn_mask=attn_mask,
+            dropout_p=self.dropout, training=self.training)
         b = out.shape[0]
         out = transpose(out, [0, 2, 1, 3])
         out = reshape(out, [b, out.shape[1], self.embed_dim])
         out = self.out_proj(out)
-        new_cache = self.StaticKVCache(Tensor(k_upd, _internal=True),
-                                       Tensor(v_upd, _internal=True),
-                                       idx + 1)
         return out, new_cache
+
+
+def static_kv_attention(q, k_new, v_new, cache, attn_mask=None,
+                        dropout_p=0.0, training=False):
+    """Fixed-buffer incremental attention, the jittable decode core:
+    write the L new K/V rows at ``idx`` (dynamic_update_slice), attend
+    over the whole buffer with a causal+validity mask — query i at
+    global position idx+i sees keys j <= idx+i (L=1 per-token decode and
+    L=prompt prefill are the same formula). Shapes never change, so the
+    step traces once inside lax.while_loop/scan decode loops. Returns
+    ((B, H, L, D) attention output, advanced StaticKVCache)."""
+    import jax
+    import jax.numpy as jnp
+
+    kb = cache.k._data if isinstance(cache.k, Tensor) else cache.k
+    vb = cache.v._data if isinstance(cache.v, Tensor) else cache.v
+    idx = cache.idx._data if isinstance(cache.idx, Tensor) else cache.idx
+    idx = jnp.asarray(idx, jnp.int32)
+    L = q._data.shape[2]
+    zero = jnp.zeros((), jnp.int32)
+    k_upd = jax.lax.dynamic_update_slice(
+        kb, k_new._data.astype(kb.dtype), (zero, zero, idx, zero))
+    v_upd = jax.lax.dynamic_update_slice(
+        vb, v_new._data.astype(vb.dtype), (zero, zero, idx, zero))
+    Lmax = kb.shape[2]
+    j = jnp.arange(Lmax)[None, :]
+    i = jnp.arange(L)[:, None]
+    valid = (j <= idx + i).reshape(1, 1, L, Lmax)
+    mask_t = Tensor(valid, _internal=True)
+    if attn_mask is not None:
+        am = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+        if am.dtype == jnp.bool_:
+            mask_t = Tensor(jnp.logical_and(valid, am), _internal=True)
+        else:  # additive mask: fold the validity window into it
+            mask_t = Tensor(
+                jnp.where(valid, am.astype(jnp.float32), -1e30),
+                _internal=True)
+    out = F.sdpa_bhld(q, Tensor(k_upd, _internal=True),
+                      Tensor(v_upd, _internal=True), attn_mask=mask_t,
+                      dropout_p=dropout_p, training=training)
+    new_cache = MultiHeadAttention.StaticKVCache(
+        Tensor(k_upd, _internal=True), Tensor(v_upd, _internal=True),
+        idx + L)
+    return out, new_cache
 
 
 def _activation(name):

@@ -111,9 +111,12 @@ class MagnitudePruner(Pruner):
         k = int(np.round(ratio * w.size))
         if k <= 0:
             return jnp.ones(param._data.shape, bool)
-        thresh = jnp.sort(w)[k - 1]
-        return (jnp.abs(param._data.astype(jnp.float32)) > thresh) \
-            .reshape(param._data.shape)
+        # exactly-k selection via argsort (a magnitude THRESHOLD would
+        # drop every tied weight — a constant-filled param at ratio 0.1
+        # would be 100% zeroed)
+        order = jnp.argsort(w)
+        keep = jnp.ones((w.size,), bool).at[order[:k]].set(False)
+        return keep.reshape(param._data.shape)
 
 
 class StructuredPruner(Pruner):

@@ -1,3 +1,5 @@
 """Utility subpackage (ref: python/paddle/fluid/unique_name.py, utils/)."""
 from . import unique_name  # noqa: F401
 from .plot import Ploter, PlotData, dump_config  # noqa: F401
+from . import stats  # noqa: F401
+from .stats import compiled_stats, memory_usage, summary  # noqa: F401

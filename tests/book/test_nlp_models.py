@@ -125,6 +125,31 @@ class TestGPT:
             cur = np.concatenate([cur, nxt.astype("int64")], axis=1)
         np.testing.assert_array_equal(out.numpy(), cur)
 
+    def test_generate_xla_matches_eager_generate(self):
+        """The single-executable decode (static KV cache + lax.scan)
+        must reproduce the eager greedy decode token-for-token, and
+        reuse its compiled executable across same-signature calls."""
+        cfg = gpt_tiny(dropout=0.0)
+        pt.seed(3)
+        model = GPT(cfg)
+        model.eval()
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, cfg.vocab_size, (2, 8)).astype("int64")
+        eager = model.generate(pt.to_tensor(ids), max_new_tokens=6,
+                               temperature=0.0)
+        fused = model.generate_xla(ids, max_new_tokens=6, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(eager.numpy()),
+                                      np.asarray(fused.numpy()))
+        assert len(model._xla_gen_cache) == 1
+        model.generate_xla(ids, max_new_tokens=6, temperature=0.0)
+        assert len(model._xla_gen_cache) == 1
+        # sampled path: right shape, tokens in range
+        samp = model.generate_xla(ids, max_new_tokens=4, temperature=1.0,
+                                  top_k=5, seed=7)
+        s = np.asarray(samp.numpy())
+        assert s.shape == (2, 12)
+        assert (s >= 0).all() and (s < cfg.vocab_size).all()
+
 
 class TestRecommender:
     def test_two_tower_trains(self):
@@ -150,3 +175,26 @@ class TestRecommender:
 
         losses = _fit(model, loss_fn, (*ids, y), steps=12, lr=5e-3)
         assert losses[-1] < losses[0], losses
+
+
+class TestGPTXlaWeights:
+    def test_generate_xla_sees_weight_updates(self):
+        """The cached decode executable must use CURRENT weights
+        (constant-folding regression: params are jit arguments)."""
+        cfg = gpt_tiny(dropout=0.0)
+        pt.seed(5)
+        model = GPT(cfg)
+        model.eval()
+        ids = np.random.RandomState(2).randint(
+            0, cfg.vocab_size, (2, 6)).astype("int64")
+        out1 = np.asarray(model.generate_xla(
+            ids, max_new_tokens=4, temperature=0.0).numpy())
+        import jax.numpy as jnp
+        for p in model.parameters():
+            p._data = p._data * 0.0  # zero the model
+        out2 = np.asarray(model.generate_xla(
+            ids, max_new_tokens=4, temperature=0.0).numpy())
+        eager2 = np.asarray(model.generate(
+            pt.to_tensor(ids), max_new_tokens=4, temperature=0.0).numpy())
+        np.testing.assert_array_equal(out2, eager2)  # matches CURRENT model
+        assert not (out1 == out2).all() or (out1[:, 6:] == out2[:, 6:]).all()

@@ -255,3 +255,16 @@ def test_fsp_matrix_shape_and_l2():
     assert float(np.asarray(slim.l2_distill(a, a).numpy())) == 0.0
     loss = slim.fsp_distill([(a, b)], [(a, b)])
     assert float(np.asarray(loss.numpy())) == 0.0
+
+
+def test_magnitude_prune_exact_k_on_ties():
+    """A constant-filled parameter pruned at ratio 0.1 must lose exactly
+    10% of entries, not all of them (threshold-comparison regression)."""
+    import paddle_tpu.nn as nn2
+
+    lin = nn2.Linear(8, 8)
+    lin.weight._data = jnp.full((8, 8), 0.5)
+    pruner = slim.MagnitudePruner()
+    pruner.prune([lin.weight], ratio=0.1)
+    w = np.asarray(lin.weight.numpy())
+    assert int((w == 0.0).sum()) == int(round(0.1 * 64))

@@ -1,0 +1,89 @@
+"""HLO-native memory/model stats (VERDICT r3 Missing #4 / Next #7):
+memory_usage reads the compiled executable's real reservation;
+summary() builds the per-layer param/FLOP table via forward hooks.
+Refs: fluid/contrib/memory_usage_calc.py:46, model_stat.py:40."""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optim as optim
+from paddle_tpu.models.vision import LeNet, resnet50
+from paddle_tpu.utils import stats
+
+
+def test_compiled_stats_trainstep():
+    def fn(a, b):
+        return (a @ b).sum()
+
+    out = stats.compiled_stats(fn, np.zeros((128, 64), "float32"),
+                               np.zeros((64, 32), "float32"))
+    assert isinstance(out["memory"], dict)
+    if out["cost"].get("flops"):
+        # 2*M*N*K matmul MACs (backend may fold the reduce)
+        assert out["cost"]["flops"] >= 2 * 128 * 64 * 32 * 0.5
+
+
+def test_memory_usage_static_program():
+    pt.enable_static()
+    try:
+        main, startup = pt.static.Program(), pt.static.Program()
+        with pt.program_guard(main, startup):
+            x = pt.static.data("x", [32, 1, 28, 28], "float32")
+            y = pt.static.data("y", [32], "int64")
+            model = LeNet()
+            loss = F.cross_entropy(model(x), y)
+            optim.SGD(0.1, parameters=model.parameters()).minimize(loss)
+    finally:
+        pt.disable_static()
+    pt.static.Executor().run(startup)
+    lo, hi, unit = fluid.contrib.memory_usage(main, batch_size=32)
+    assert unit == "B"
+    # at minimum the feed (32*1*28*28*4) and the ~61k LeNet params
+    assert hi >= 32 * 28 * 28 * 4
+    assert lo <= hi
+
+
+def test_model_summary_resnet50():
+    model = resnet50()
+    out = stats.summary(model, (1, 3, 64, 64), print_table=False)
+    assert out["total_params"] > 2.3e7            # ~25.5M
+    assert out["total_flops"] > 1e8               # conv FLOPs counted
+    assert any(r["layer"] == "Conv2D" for r in out["rows"])
+    conv_rows = [r for r in out["rows"] if r["layer"] == "Conv2D"]
+    assert all(r["flops"] > 0 for r in conv_rows)
+
+
+def test_model_summary_matches_parameter_count():
+    model = LeNet()
+    out = stats.summary(model, (2, 1, 28, 28), print_table=False)
+    want = sum(int(np.prod(p.shape)) if len(p.shape) else 1
+               for p in model.parameters())
+    assert out["total_params"] == want
+
+
+def test_contrib_namespaces():
+    assert fluid.contrib.memory_usage_calc.memory_usage is \
+        fluid.contrib.memory_usage
+    assert callable(fluid.contrib.model_stat.summary)
+    assert callable(fluid.contrib.op_frequence.op_freq_statistic)
+
+
+def test_summary_counts_composite_direct_params():
+    """Params created directly on a composite layer (one with children)
+    must be counted (leaf-only-hook regression)."""
+    import paddle_tpu.nn as nn
+
+    class WithDirect(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.extra = self.create_parameter((7,))
+
+        def forward(self, v):
+            return self.fc(v) + self.extra[:4]
+
+    m = WithDirect()
+    out = stats.summary(m, (2, 4), print_table=False)
+    want = sum(int(np.prod(p.shape)) for p in m.parameters())
+    assert out["total_params"] == want  # includes the direct (7,) param
