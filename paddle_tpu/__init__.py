@@ -100,6 +100,29 @@ from .hapi import Model
 import importlib as _importlib
 
 distributed = _importlib.import_module(".dist", __name__)
+# top-level module surface parity (ref: python/paddle/__init__.py):
+# paddle.device, paddle.fleet, paddle.tensor, paddle.sysconfig
+device = _importlib.import_module(".core.device", __name__)
+fleet = _importlib.import_module(".dist.fleet", __name__)
+tensor = ops  # paddle.tensor: the functional op namespace
+from . import sysconfig  # noqa: E402
+
+
+def summary(net, input_size, dtypes="float32"):
+    """Per-layer param/FLOP table (2.x ``paddle.summary`` shape; built
+    on utils.stats.summary — forward hooks over a sample run)."""
+    from .utils.stats import summary as _s
+
+    return _s(net, input_size, dtypes=dtypes)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Total forward FLOPs (2.x ``paddle.flops``); ``custom_ops`` maps
+    LayerClass -> fn(layer, in_shape, out_shape) for user layers."""
+    from .utils.stats import summary as _s
+
+    return _s(net, input_size, print_table=print_detail,
+              custom_ops=custom_ops)["total_flops"]
 # the submodule import rebinds the package attr 'dist' to the module;
 # restore the function for paddle.dist parity
 from .ops.linalg import dist  # noqa: E402,F811

@@ -328,6 +328,8 @@ class GPT(Layer):
         from ...framework.jit import _rebind
 
         ids_arr = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        if max_new_tokens <= 0:  # degenerate case: eager returns prompt
+            return Tensor(ids_arr.astype(jnp.int32), _internal=True)
         key = jax.random.PRNGKey(seed)
         sig = (tuple(ids_arr.shape), int(max_new_tokens),
                float(temperature), top_k, self.training)

@@ -1,0 +1,21 @@
+"""Build configuration introspection (ref: python/paddle/sysconfig.py:
+get_include/get_lib point at the installed headers/libs; here they point
+at this package and its native runtime library)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory containing this package's sources (the reference
+    returns its C++ header dir; the analog here is the package root —
+    the runtime's only native artifact lives beside it)."""
+    return os.path.join(_HERE, "runtime", "cc")
+
+
+def get_lib():
+    """Directory containing the native runtime library
+    (libptruntime.so, built on first use)."""
+    return os.path.join(_HERE, "runtime")

@@ -73,7 +73,11 @@ def _program_feed_zeros(program, batch_size):
     feed = {}
     for v in program.global_block.vars.values():
         if getattr(v, "is_data", False):
-            shape = [batch_size if s in (-1, None) else s for s in v.shape]
+            # dynamic (-1/None) dims default to 1 when no batch_size is
+            # given (the fluid reference requires batch_size; measuring
+            # a 1-batch program is the graceful analog)
+            shape = [(batch_size or 1) if s in (-1, None) else s
+                     for s in v.shape]
             if batch_size and len(shape) >= 1:
                 shape[0] = batch_size
             dt = str(getattr(v, "dtype", "float32"))
@@ -126,13 +130,18 @@ def memory_usage(program, batch_size=None, fetch_list=None):
     # estimate fallback: the reference's dtype arithmetic
     total = 0
     for v in program.global_block.vars.values():
-        shape = [batch_size if s in (-1, None) else s for s in v.shape]
+        shape = [(batch_size or 1) if s in (-1, None) else s
+                 for s in v.shape]
         n = int(np.prod([abs(int(s)) for s in shape])) if shape else 1
         total += n * _DTYPE_BYTES.get(str(v.dtype), 4)
     return int(total * 0.8), int(total * 1.2), "B"
 
 
-def _layer_flops(layer, in_shape, out_shape):
+def _layer_flops(layer, in_shape, out_shape, custom_ops=None):
+    if custom_ops:
+        for cls, fn in custom_ops.items():
+            if isinstance(layer, cls):
+                return int(fn(layer, in_shape, out_shape))
     name = type(layer).__name__
     if name in ("Conv2D", "Conv1D", "Conv3D"):
         k = int(np.prod(layer._kernel_size))
@@ -143,10 +152,13 @@ def _layer_flops(layer, in_shape, out_shape):
     return 0
 
 
-def summary(layer, input_shapes, dtypes="float32", print_table=True):
+def summary(layer, input_shapes, dtypes="float32", print_table=True,
+            custom_ops=None):
     """Per-layer param/FLOP table for an nn.Layer (ref: model_stat.py:40
     summary — there a Program walk; here forward hooks capture real
-    shapes). ``input_shapes``: one shape tuple or a list of them.
+    shapes). ``input_shapes``: one shape tuple or a list of them;
+    ``custom_ops``: {LayerClass: fn(layer, in_shape, out_shape) -> flops}
+    for layers the built-in Conv/Linear rules don't cover.
     Returns {"total_params", "total_flops", "rows"}."""
     from ..core.tensor import Tensor
 
@@ -176,7 +188,8 @@ def summary(layer, input_shapes, dtypes="float32", print_table=True):
             rows.append({"layer": type(mod).__name__,
                          "output_shape": tuple(outs) if outs else None,
                          "params": n_params,
-                         "flops": _layer_flops(mod, ins, outs)})
+                         "flops": _layer_flops(mod, ins, outs,
+                                               custom_ops)})
 
         return fn
 

@@ -189,7 +189,6 @@ class TestGPTXlaWeights:
             0, cfg.vocab_size, (2, 6)).astype("int64")
         out1 = np.asarray(model.generate_xla(
             ids, max_new_tokens=4, temperature=0.0).numpy())
-        import jax.numpy as jnp
         for p in model.parameters():
             p._data = p._data * 0.0  # zero the model
         out2 = np.asarray(model.generate_xla(
@@ -197,4 +196,7 @@ class TestGPTXlaWeights:
         eager2 = np.asarray(model.generate(
             pt.to_tensor(ids), max_new_tokens=4, temperature=0.0).numpy())
         np.testing.assert_array_equal(out2, eager2)  # matches CURRENT model
-        assert not (out1 == out2).all() or (out1[:, 6:] == out2[:, 6:]).all()
+        # zero weights -> uniform logits -> argmax token 0 everywhere;
+        # the pre-zeroing decode must differ (constant-folding signal)
+        assert (out2[:, 6:] == 0).all()
+        assert not np.array_equal(out1[:, 6:], out2[:, 6:])

@@ -133,3 +133,16 @@ def test_fluid_backward_module_path():
         assert params_grads
     finally:
         pt.disable_static()
+
+
+def test_top_level_module_parity():
+    """Every module directory/file of the reference's python/paddle/
+    top level resolves on paddle_tpu (ref: python/paddle/__init__.py)."""
+    top = ["batch", "compat", "dataset", "device", "distributed",
+           "distribution", "fleet", "fluid", "framework", "io", "metric",
+           "nn", "optimizer", "reader", "regularizer", "sysconfig",
+           "tensor", "utils"]
+    missing = [n for n in top if getattr(pt, n, None) is None]
+    assert not missing, missing
+    assert callable(pt.sysconfig.get_include)
+    assert pt.tensor.concat is pt.ops.concat

@@ -87,3 +87,47 @@ def test_summary_counts_composite_direct_params():
     out = stats.summary(m, (2, 4), print_table=False)
     want = sum(int(np.prod(p.shape)) for p in m.parameters())
     assert out["total_params"] == want  # includes the direct (7,) param
+
+
+def test_paddle_summary_and_flops_entry_points():
+    model = LeNet()
+    out = pt.summary(model, (1, 1, 28, 28))
+    assert out["total_params"] > 0
+    assert pt.flops(model, (1, 1, 28, 28)) == out["total_flops"] > 0
+
+
+def test_memory_usage_dynamic_dims_default_batch():
+    pt.enable_static()
+    try:
+        main, startup = pt.static.Program(), pt.static.Program()
+        with pt.program_guard(main, startup):
+            x = pt.static.data("x", [-1, 4], "float32")
+            import paddle_tpu.fluid.layers as L
+            out = L.fc(x, size=3)
+    finally:
+        pt.disable_static()
+    pt.static.Executor().run(startup)
+    lo, hi, unit = stats.memory_usage(main)  # no batch_size given
+    assert lo > 0 and unit == "B"
+
+
+def test_flops_custom_ops():
+    import paddle_tpu.nn as nn
+
+    class Odd(nn.Layer):
+        def forward(self, v):
+            return v * 2.0
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.odd = Odd()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, v):
+            return self.fc(self.odd(v))
+
+    base = pt.flops(Net(), (2, 4))
+    with_custom = pt.flops(Net(), (2, 4),
+                           custom_ops={Odd: lambda m, i, o: 1000})
+    assert with_custom == base + 1000
