@@ -331,8 +331,10 @@ class GPT(Layer):
         if max_new_tokens <= 0:  # degenerate case: eager returns prompt
             return Tensor(ids_arr.astype(jnp.int32), _internal=True)
         key = jax.random.PRNGKey(seed)
+        # the active mesh shapes the traced sharding constraints, so it
+        # is part of the executable's identity (tp-sharded serving)
         sig = (tuple(ids_arr.shape), int(max_new_tokens),
-               float(temperature), top_k, self.training)
+               float(temperature), top_k, self.training, get_mesh())
         cache = getattr(self, "_xla_gen_cache", None)
         if cache is None:
             cache = self._xla_gen_cache = {}

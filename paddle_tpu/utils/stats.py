@@ -207,9 +207,13 @@ def summary(layer, input_shapes, dtypes="float32", print_table=True,
             h.remove()
     total_p = sum(r["params"] for r in rows)
     total_f = sum(r["flops"] for r in rows)
+    trainable = sum(
+        int(np.prod(p.shape)) if len(p.shape) else 1
+        for p in layer.parameters() if getattr(p, "trainable", True))
     if print_table:
         for r in rows:
             print(f"{r['layer']:<20} {str(r['output_shape']):<24} "
                   f"{r['params']:>12,} {r['flops']:>16,}")
         print(f"Total params: {total_p:,}  Total FLOPs/fwd: {total_f:,}")
-    return {"total_params": total_p, "total_flops": total_f, "rows": rows}
+    return {"total_params": total_p, "trainable_params": trainable,
+            "total_flops": total_f, "rows": rows}

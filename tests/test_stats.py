@@ -131,3 +131,14 @@ def test_flops_custom_ops():
     with_custom = pt.flops(Net(), (2, 4),
                            custom_ops={Odd: lambda m, i, o: 1000})
     assert with_custom == base + 1000
+
+
+def test_summary_reports_trainable_params():
+    model = LeNet()
+    for p in model.parameters():
+        if p.ndim == 1:
+            p.trainable = False  # freeze biases
+    out = stats.summary(model, (1, 1, 28, 28), print_table=False)
+    frozen = sum(int(np.prod(p.shape)) for p in model.parameters()
+                 if p.ndim == 1)
+    assert out["trainable_params"] == out["total_params"] - frozen
