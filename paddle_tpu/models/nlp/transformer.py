@@ -58,7 +58,13 @@ class WMTTransformer(Layer):
     def _embed(self, table, ids):
         L = ids.shape[1]
         x = table(ids) * float(np.sqrt(self.d_model))
-        pos = Tensor(self.pos_table[:L], _internal=True)
+        # cast the f32 sinusoid table to the embedding dtype — an f32
+        # add here would silently upcast the whole encoder for bf16
+        # models (jnp promotion), halving MXU throughput
+        import jax.numpy as jnp
+
+        pos = Tensor(jnp.asarray(self.pos_table[:L], x._data.dtype),
+                     _internal=True)
         return self.drop(x + pos)
 
     def _src_mask(self, src, pad_id=None):

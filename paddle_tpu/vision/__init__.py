@@ -19,3 +19,15 @@ __all__ = [
     "resize_short", "center_crop", "random_crop", "left_right_flip",
     "to_chw", "simple_transform",
 ]
+
+
+def __getattr__(name):
+    # paddle.vision.models parity (2.x surface), loaded lazily so a bare
+    # ``import paddle_tpu`` doesn't pay for the whole model zoo
+    if name == "models":
+        # importlib (not ``from . import``): the fromlist getattr of the
+        # latter re-enters this __getattr__ mid-import and recurses
+        import importlib
+
+        return importlib.import_module(".models", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,5 @@
+"""paddle.vision.models (2.x surface): the model zoo classes live in
+models/vision (LeNet/ResNet/VGG/MobileNet/SSD/YOLOv3/Faster R-CNN);
+this real submodule makes both ``import paddle_tpu.vision.models`` and
+``from paddle_tpu.vision.models import resnet50`` work."""
+from ..models.vision import *  # noqa: F401,F403

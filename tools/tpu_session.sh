@@ -1,0 +1,38 @@
+#!/bin/bash
+# One-shot real-TPU measurement session. Runs everything the round needs
+# from the hardware, STRICTLY SERIALLY (the axon relay dies under
+# concurrent TPU processes — see r2/r3 ops notes):
+#   1. bench.py            -> bench_out.json + bench_out.log
+#   2. tools/tpu_probe.py  -> probe_out.log (pallas kernels on hardware)
+#   3. record the bench line into BASELINE.json "published"
+# Usage (default env, PYTHONPATH untouched so the axon hook loads):
+#   bash tools/tpu_session.sh [outdir]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/tpu_session_$(date +%H%M%S)}"
+mkdir -p "$OUT"
+echo "== bench.py (sole TPU process) -> $OUT"
+python bench.py > "$OUT/bench_out.json" 2> "$OUT/bench_out.log"
+echo "bench rc=$? json:"
+cat "$OUT/bench_out.json"
+if grep -q bench_failed "$OUT/bench_out.json"; then
+  echo "bench failed (tunnel still down?) — skipping probe to avoid"
+  echo "a second TPU process against a sick relay"
+  exit 1
+fi
+echo "== tools/tpu_probe.py (after bench fully exited)"
+python tools/tpu_probe.py > "$OUT/probe_out.log" 2>&1
+echo "probe rc=$?"
+cat "$OUT/probe_out.log"
+echo "== recording published numbers into BASELINE.json"
+python - "$OUT/bench_out.json" <<'EOF'
+import json, sys
+line = open(sys.argv[1]).read().strip().splitlines()[-1]
+bench = json.loads(line)
+base = json.load(open("BASELINE.json"))
+base["published"] = bench
+json.dump(base, open("BASELINE.json", "w"), indent=2)
+print("BASELINE.json published <-", bench.get("metric"), bench.get("value"))
+EOF
+cp "$OUT/probe_out.log" tools/probe_hw_last.log 2>/dev/null || true
+echo "== done; commit BASELINE.json + tools/probe_hw_last.log"
