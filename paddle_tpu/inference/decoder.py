@@ -253,10 +253,14 @@ def beam_search_xla(step_fn, init_state, batch_size, bos_id, eos_id,
     scores = jnp.take_along_axis(scores, order, axis=1)
     flat = (jnp.arange(B)[:, None] * K + order).reshape(-1)
     tokens = tokens.reshape(B * K, max_len)[flat].reshape(B, K, max_len)
+    # dtype contract (advisor r4): tokens come back in the framework's
+    # canonical "int64" — which core/dtype.py maps to int32 (the TPU int)
+    # — exactly like the eager beam_search's ops.full(dtype="int64")
+    # tensors, so the two decode paths are interchangeable for callers.
     if return_all:
         return Tensor(tokens, _internal=True), Tensor(scores, _internal=True)
-    return Tensor(tokens[:, 0], _internal=True), \
-        Tensor(scores[:, 0], _internal=True)
+    return (Tensor(tokens[:, 0], _internal=True),
+            Tensor(scores[:, 0], _internal=True))
 
 
 def greedy_search(step_fn, init_state, batch_size, bos_id, eos_id, max_len):

@@ -163,6 +163,20 @@ def prune_conv_pair(conv, next_layer, ratio, criterion="l1_norm"):
     scores = np.abs(wf).sum(axis=(1, 2, 3)) if criterion == "l1_norm" \
         else np.sqrt((wf * wf).sum(axis=(1, 2, 3)))
     n = w.shape[0]
+    # validate the pair BEFORE mutating anything: a caller catching the
+    # error must be left with an untouched, still-runnable model
+    if isinstance(next_layer, Linear) and \
+            np.asarray(next_layer.weight.numpy()).shape[0] % n != 0:
+        raise ValueError(
+            f"cannot rewire {type(next_layer).__name__} after "
+            f"{type(conv).__name__}: Linear in_features="
+            f"{next_layer.weight.shape[0]} is not a multiple of the "
+            f"conv's {n} output channels (is there a non-channel-major "
+            "flatten or global pooling between them?)")
+    if next_layer is not None and \
+            not isinstance(next_layer, (Conv2D, Linear)):
+        raise TypeError(f"cannot rewire {type(next_layer).__name__} "
+                        "after channel removal")
     k = int(np.round(ratio * n))
     keep = np.sort(np.argsort(scores)[k:])
     conv.weight._data = jnp.asarray(w[keep])
@@ -178,7 +192,7 @@ def prune_conv_pair(conv, next_layer, ratio, criterion="l1_norm"):
         # (in, out) rows grouped per input channel (e.g. after flatten):
         # keep the row blocks belonging to surviving channels
         nw = np.asarray(next_layer.weight.numpy())
-        per = nw.shape[0] // n
+        per = nw.shape[0] // n  # divisibility validated up front
         rows = np.concatenate([np.arange(c * per, (c + 1) * per)
                                for c in keep])
         next_layer.weight._data = jnp.asarray(nw[rows])

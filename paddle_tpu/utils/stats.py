@@ -162,8 +162,13 @@ def summary(layer, input_shapes, dtypes="float32", print_table=True,
     Returns {"total_params", "total_flops", "rows"}."""
     from ..core.tensor import Tensor
 
-    if isinstance(input_shapes[0], int):
+    # normalize 2.x dynamic-batch conventions: a lone shape whose first
+    # dim is None/-1 (e.g. (None, 1, 28, 28)) is ONE shape, and dynamic
+    # dims probe with batch=1 (ref: model_stat substitutes 1 likewise)
+    if isinstance(input_shapes[0], int) or input_shapes[0] in (None, -1):
         input_shapes = [input_shapes]
+    input_shapes = [tuple(1 if s in (None, -1) else int(s) for s in shp)
+                    for shp in input_shapes]
     if isinstance(dtypes, str):
         dtypes = [dtypes] * len(input_shapes)
     rows = []

@@ -1,0 +1,79 @@
+"""Regression tests for the round-5 advisor fixes (ADVICE.md r4):
+summary() dynamic-batch shapes, prune_conv_pair divisibility guard,
+beam_search_xla token dtype contract.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+
+
+def test_summary_dynamic_batch_none():
+    """(None, C, H, W) is ONE shape with a dynamic batch, not a list of
+    shapes; dynamic dims probe with 1 (ref model_stat.py substitutes 1)."""
+    from paddle_tpu.utils.stats import summary
+
+    m = nn.Sequential(nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(),
+                      nn.Flatten(), nn.Linear(4 * 28 * 28, 10))
+    out = summary(m, (None, 1, 28, 28), print_table=False)
+    assert out["total_params"] > 0
+    shapes = [r["output_shape"] for r in out["rows"] if r["output_shape"]]
+    assert all(s[0] == 1 for s in shapes)
+
+
+def test_summary_dynamic_batch_minus_one():
+    """(-1, C, ...) must not reach np.zeros (negative dims ValueError)."""
+    from paddle_tpu.utils.stats import summary
+
+    m = nn.Linear(8, 3)
+    out = summary(m, (-1, 8), print_table=False)
+    assert out["total_params"] == 8 * 3 + 3
+
+
+def test_prune_conv_pair_indivisible_raises():
+    """Linear rows not a multiple of conv out-channels (e.g. global
+    pooling between them) must raise, not silently drop rows."""
+    from paddle_tpu.slim import prune_conv_pair
+
+    conv = nn.Conv2D(3, 8, 3)
+    lin = nn.Linear(12, 4)  # 12 % 8 != 0
+    w_before = np.asarray(conv.weight.numpy()).copy()
+    with pytest.raises(ValueError, match="not a multiple"):
+        prune_conv_pair(conv, lin, ratio=0.5)
+    # the error path must leave the pair untouched and runnable
+    assert conv._out_channels == 8
+    assert np.array_equal(np.asarray(conv.weight.numpy()), w_before)
+
+
+def test_prune_conv_pair_divisible_still_works():
+    from paddle_tpu.slim import prune_conv_pair
+
+    conv = nn.Conv2D(3, 8, 3)
+    lin = nn.Linear(8 * 4, 5)
+    keep = prune_conv_pair(conv, lin, ratio=0.5)
+    assert len(keep) == 4
+    assert tuple(lin.weight._data.shape) == (16, 5)
+    assert conv.weight._data.shape[0] == 4
+
+
+def test_beam_xla_token_dtype_matches_eager():
+    """Both decode paths must hand back the same ("int64") token dtype so
+    callers can concatenate with int64 prompt ids interchangeably."""
+    from paddle_tpu.inference.decoder import beam_search, beam_search_xla
+
+    V, B, K, L = 7, 2, 3, 5
+
+    def step_fn(cur, state, t):
+        logits = pt.to_tensor(
+            np.tile(np.linspace(0.0, 1.0, V, dtype=np.float32),
+                    (cur.shape[0], 1)))
+        return logits, state
+
+    tok_e, _ = beam_search(step_fn, None, B, bos_id=0, eos_id=1,
+                           beam_size=K, max_len=L)
+    tok_x, _ = beam_search_xla(step_fn, None, B, bos_id=0, eos_id=1,
+                               beam_size=K, max_len=L)
+    assert tok_e.dtype == tok_x.dtype, (tok_e.dtype, tok_x.dtype)
+    ref64 = pt.ops.full([1], 0, dtype="int64").dtype
+    assert tok_x.dtype == ref64

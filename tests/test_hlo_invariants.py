@@ -1,0 +1,177 @@
+"""Perf-critical invariants asserted on the compiled (post-optimization)
+HLO text + XLA memory analysis — CPU-runnable stand-ins for hardware perf
+evidence while the TPU tunnel is down (VERDICT r4 Next #2).
+
+The reference enforces analogous properties with IR passes over its graph
+(paddle/fluid/framework/ir/graph_pattern_detector.cc); here the invariants
+are asserted directly on what XLA will execute:
+  (a) the static-DP executable contains grad all-reduces, the
+      single-device one doesn't;
+  (b) donation really aliases: every donated persistable (static
+      Executor) / every param+opt-state leaf (TrainStep) has an
+      input_output_alias entry, so params are not double-buffered;
+  (c) the fused beam search is ONE while-loop executable with zero host
+      transfers;
+  (d) the fused train step performs no full-size copy of optimizer
+      moment buffers (scalar beta-pow copies are immaterial).
+"""
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+import paddle_tpu.nn as nn
+import paddle_tpu.optim as optim
+
+
+def _build_mlp_program(lr=0.1, batch=16):
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[batch, 8])
+        y = fluid.data(name="y", shape=[batch, 1])
+        h = fluid.layers.fc(x, size=16, act="relu")
+        out = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(out, y))
+        opt = fluid.optimizer.SGD(learning_rate=lr)
+        opt.minimize(loss)
+    return prog, startup, loss
+
+
+def _compiled_text(exe, prog, feed, fetch, data_parallel):
+    """Optimized-HLO text of the Executor's cached executable for a feed."""
+    from paddle_tpu.static_.program import global_scope
+
+    compiled = exe._compile(prog, feed, fetch, data_parallel=data_parallel)
+    scope = global_scope()
+    feeds = [jnp.asarray(np.asarray(feed[n])) for n in compiled.feed_names]
+    upd = [scope.find_var(n) for n in compiled.updated]
+    frz = [scope.find_var(n) for n in compiled.frozen]
+    lowered = compiled.fn.lower(feeds, upd, frz)
+    return lowered.compile().as_text(), compiled
+
+
+@pytest.fixture
+def static_mode():
+    pt.enable_static()
+    yield
+    pt.disable_static()
+
+
+def _train_feed(prog):
+    feed = {"x": np.zeros((16, 8), np.float32),
+            "y": np.zeros((16, 1), np.float32)}
+    if prog._lr_getter is not None:
+        feed["@lr"] = np.asarray(prog._lr_getter(), np.float32)
+    return feed
+
+
+class TestStaticExecutorHLO:
+    def test_dp_executable_has_allreduce_single_does_not(self, static_mode):
+        pt.seed(0)
+        prog, startup, loss = _build_mlp_program()
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = _train_feed(prog)
+        txt_dp, _ = _compiled_text(exe, prog, feed, [loss], True)
+        txt_1, _ = _compiled_text(exe, prog, feed, [loss], False)
+        assert "all-reduce" in txt_dp, "DP step lost its grad all-reduce"
+        assert "all-reduce" not in txt_1
+
+    def test_updated_persistables_are_aliased(self, static_mode):
+        """donate_argnums=(1,) must alias EVERY updated persistable
+        (params + opt slots) into the outputs — no double-buffering."""
+        pt.seed(0)
+        prog, startup, loss = _build_mlp_program()
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = _train_feed(prog)
+        txt, compiled = _compiled_text(exe, prog, feed, [loss], False)
+        assert "input_output_alias" in txt
+        n_updated = len(compiled.updated)
+        assert n_updated >= 4  # 2xW, 2xb at minimum
+        assert txt.count("alias") - txt.count("input_output_alias") \
+            >= n_updated or txt.count("may-alias") >= n_updated, \
+            f"expected >= {n_updated} alias entries"
+
+
+class TestTrainStepHLO:
+    def _compiled_step(self):
+        from paddle_tpu.framework.jit import TrainStep
+        from paddle_tpu.core import random as prandom
+
+        m = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 8))
+        opt = optim.Adam(parameters=m.parameters(), learning_rate=1e-3)
+
+        def loss_fn(model, x, y):
+            d = model(x) - y
+            return (d * d).mean()
+
+        step = TrainStep(m, opt, loss_fn)
+        x = np.zeros((16, 32), np.float32)
+        y = np.zeros((16, 8), np.float32)
+        step(x, y)
+        fn = next(iter(step._compiled.values()))
+        opt_state = {p.name: opt._accumulators[p.name]
+                     for p in step._trainable}
+        lowered = fn.lower([p._data for p in step._trainable],
+                           [b._data for b in step._buffers], opt_state,
+                           jnp.float32(1e-3), prandom.next_key(),
+                           [jnp.asarray(x), jnp.asarray(y)], {})
+        comp = lowered.compile()
+        n_leaves = len(step._trainable) + len(step._buffers) + sum(
+            len(v) for v in opt_state.values())
+        return comp, n_leaves
+
+    def test_all_params_and_state_aliased(self):
+        comp, n_leaves = self._compiled_step()
+        txt = comp.as_text()
+        assert txt.count("may-alias") == n_leaves, \
+            f"{txt.count('may-alias')} aliased of {n_leaves} donated leaves"
+        ma = comp.memory_analysis()
+        # aliased bytes must cover the params+state (less scalar slack):
+        # if donation regressed, alias_size collapses and the step
+        # double-buffers every parameter in HBM
+        assert ma.alias_size_in_bytes >= 0.9 * ma.output_size_in_bytes
+
+    def test_no_fullsize_copies_of_optimizer_state(self):
+        comp, _ = self._compiled_step()
+        txt = comp.as_text()
+        bad = [ln for ln in txt.splitlines()
+               if re.search(r"\w+\[\d[0-9,]*\]\S* copy\(\S*opt_state", ln)]
+        assert not bad, "moment buffers copied instead of updated " \
+            f"in place:\n" + "\n".join(bad[:5])
+
+
+class TestFusedDecodeHLO:
+    def test_beam_xla_single_while_no_host_transfers(self):
+        from paddle_tpu.inference.decoder import beam_search_xla
+
+        V, B, K, L = 11, 2, 3, 8
+
+        def run(table):
+            def step_fn(cur, state, t):
+                logits = pt.Tensor(
+                    jnp.tile(table, (cur.shape[0], 1)), _internal=True)
+                return logits, state
+
+            toks, scores = beam_search_xla(step_fn, None, B, bos_id=0,
+                                           eos_id=1, beam_size=K, max_len=L)
+            return toks._data, scores._data
+
+        table = jnp.linspace(0.0, 1.0, V)
+        txt = jax.jit(run).lower(table).compile().as_text()
+        # op defs look like `%while.2 = (<tuple shape>) while(%tuple.N)`;
+        # metadata op_names only ever contain "/while/" so ' while(' is
+        # unambiguous
+        n_while = txt.count(" while(")
+        assert n_while == 1, f"expected ONE fused decode loop, got {n_while}"
+        for marker in ("infeed", "outfeed", " send(", " recv(",
+                       "SendToHost", "RecvFromHost"):
+            assert marker not in txt, f"host transfer {marker!r} in decode"

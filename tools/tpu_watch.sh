@@ -13,9 +13,10 @@
 # (PYTHONPATH=/root/repo JAX_PLATFORMS=cpu). Each probe is a fresh
 # process that fully exits before the next, and the session only starts
 # after a probe process has exited successfully.
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
-OUT="${1:-/tmp/tpu_watch}"
+mkdir -p "${1:-/tmp/tpu_watch}"
+OUT="$(realpath "${1:-/tmp/tpu_watch}")"
 PROBE_INTERVAL="${PROBE_INTERVAL:-900}"
 MAX_ITERS="${MAX_ITERS:-46}"   # ~11.5h at 15min
 mkdir -p "$OUT"
@@ -46,7 +47,7 @@ for i in $(seq 1 "$MAX_ITERS"); do
     echo "[$ts] iter $i: RELAY ALIVE — starting serial session" | tee -a "$OUT/watch.log"
     touch "$OUT/RECOVERED"
     bash tools/tpu_session.sh "$OUT/session" 2>&1 | tee -a "$OUT/watch.log"
-    rc=$?
+    rc=${PIPESTATUS[0]}
     echo "session rc=$rc" | tee -a "$OUT/watch.log"
     touch "$OUT/SESSION_DONE"
     exit $rc
