@@ -21,6 +21,10 @@ from .env import get_mesh
 
 __all__ = ["pipeline_forward", "PipelineStage", "gpipe_inner"]
 
+# jitted partial-manual schedules, keyed on (stage_fn, mesh, axes,
+# microbatches, param tree/shapes, input aval) — see pipeline_forward
+_partial_manual_cache: dict = {}
+
 
 def gpipe_inner(stage_fn, stage_params, x_mb, axis_name):
     """Per-shard GPipe loop. Call inside shard_map over ``axis_name``.
@@ -112,10 +116,34 @@ def pipeline_forward(stage_fn, stacked_params, x, num_microbatches,
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     xspec = P(None, batch_axis) if batch_axis else P()
-    out = jax.shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(pspec, xspec), out_specs=xspec,
-        check_vma=False)(stacked_params, mb)
+    # manual only over the pipe (+ dp batch) axes: any OTHER mesh axis
+    # (e.g. the 'model' tensor-parallel axis) stays automatic, so GSPMD
+    # keeps honoring the TP layers' sharding constraints INSIDE each
+    # stage — this is what composes dp x tp x pp into one executable
+    manual = frozenset({axis_name} | ({batch_axis} if batch_axis else set()))
+    if manual != frozenset(mesh.axis_names):
+        # partial-manual + check_vma=False hits a jax-0.9 bug in the
+        # EAGER dispatch path (_unmatch builds a dst spec over ALL mesh
+        # axes); under jit the rearrangement never runs, so compile the
+        # call — inside an outer trace this just inlines. Cached so
+        # repeated eager calls (e.g. batched eval) don't retrace.
+        leaves, treedef = jax.tree_util.tree_flatten(stacked_params)
+        key = (stage_fn, mesh, axis_name, batch_axis, M, treedef,
+               tuple((l.shape, str(l.dtype)) for l in leaves),
+               mb.shape, str(mb.dtype))
+        sm_fn = _partial_manual_cache.get(key)
+        if sm_fn is None:
+            sm_fn = jax.jit(jax.shard_map(
+                shard_fn, mesh=mesh,
+                in_specs=(pspec, xspec), out_specs=xspec,
+                axis_names=manual, check_vma=False))
+            _partial_manual_cache[key] = sm_fn
+    else:
+        sm_fn = jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(pspec, xspec), out_specs=xspec,
+            axis_names=manual, check_vma=False)
+    out = sm_fn(stacked_params, mb)
     out = out.reshape((B,) + out.shape[2:])
     return Tensor(out, _internal=True) if isinstance(x, Tensor) else out
 

@@ -196,9 +196,6 @@ def prune_conv_pair(conv, next_layer, ratio, criterion="l1_norm"):
         rows = np.concatenate([np.arange(c * per, (c + 1) * per)
                                for c in keep])
         next_layer.weight._data = jnp.asarray(nw[rows])
-    elif next_layer is not None:
-        raise TypeError(f"cannot rewire {type(next_layer).__name__} "
-                        "after channel removal")
     return keep
 
 

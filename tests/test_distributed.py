@@ -477,6 +477,69 @@ class TestGPTPipeline:
         assert np.isfinite(losses).all(), losses
         assert losses[-1] < losses[0], losses
 
+    def test_forward_parity_dp2_tp2_pp2(self):
+        """The composed 3-axis flagship (VERDICT r4 Next #3): TP-layer
+        blocks inside the GPipe schedule over Mesh(('data','model','pipe'))
+        — 'model' stays an auto (GSPMD) axis inside the manual
+        shard_map, so the same executable carries dp + tp + pp."""
+        _require8()
+        from paddle_tpu.models.nlp.gpt import GPTPipeline
+
+        model = self._model(layers=2)
+        model.eval()
+        from jax.sharding import Mesh
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                    ("data", "model", "pipe"))
+        dist.set_mesh(mesh)
+        rng = np.random.RandomState(3)
+        ids = rng.randint(0, model.cfg.vocab_size, (4, 16)).astype("int64")
+        try:
+            with mesh:
+                pipe = GPTPipeline(model, num_microbatches=2,
+                                   batch_axis="data")
+                got = np.asarray(pipe(pt.to_tensor(ids)).numpy())
+        finally:
+            dist.set_mesh(None)
+        want = np.asarray(model(pt.to_tensor(ids)).numpy())
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_train_step_dp2_tp2_pp2_one_executable(self):
+        """One jitted dp2 x tp2 x pp2 train step: loss decreases AND the
+        compiled HLO really carries both parallelism mechanisms —
+        collective-permute (the pp ring) and all-reduce (tp partial sums
+        / dp grad sync)."""
+        _require8()
+        from paddle_tpu.models.nlp.gpt import GPTPipeline
+
+        model = self._model(layers=2)
+        model.eval()
+        from jax.sharding import Mesh
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                    ("data", "model", "pipe"))
+        dist.set_mesh(mesh)
+        rng = np.random.RandomState(4)
+        ids = rng.randint(0, model.cfg.vocab_size, (4, 16)).astype("int64")
+        labels = np.roll(ids, -1, axis=1)
+        try:
+            with mesh:
+                pipe = GPTPipeline(model, num_microbatches=2,
+                                   batch_axis="data")
+                step = jax.jit(pipe.train_step_fn(lr=1e-1))
+                txt = step.lower(pipe.stacked, jnp.asarray(ids),
+                                 jnp.asarray(labels)).compile().as_text()
+                assert "collective-permute" in txt, "pp ring missing"
+                assert "all-reduce" in txt, "tp/dp reductions missing"
+                stacked = pipe.stacked
+                losses = []
+                for _ in range(4):
+                    loss, stacked = step(stacked, jnp.asarray(ids),
+                                         jnp.asarray(labels))
+                    losses.append(float(loss))
+        finally:
+            dist.set_mesh(None)
+        assert np.isfinite(losses).all(), losses
+        assert losses[-1] < losses[0], losses
+
     def test_uneven_layers_raise(self):
         _require8()
         from paddle_tpu.models.nlp.gpt import GPTPipeline

@@ -19,7 +19,6 @@ mkdir -p "${1:-/tmp/tpu_watch}"
 OUT="$(realpath "${1:-/tmp/tpu_watch}")"
 PROBE_INTERVAL="${PROBE_INTERVAL:-900}"
 MAX_ITERS="${MAX_ITERS:-46}"   # ~11.5h at 15min
-mkdir -p "$OUT"
 
 cat > "$OUT/ping.py" <<'EOF'
 import threading, sys, os, json, time
