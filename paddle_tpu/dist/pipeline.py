@@ -22,8 +22,13 @@ from .env import get_mesh
 __all__ = ["pipeline_forward", "PipelineStage", "gpipe_inner"]
 
 # jitted partial-manual schedules, keyed on (stage_fn, mesh, axes,
-# microbatches, param tree/shapes, input aval) — see pipeline_forward
-_partial_manual_cache: dict = {}
+# microbatches, param tree/shapes, input aval) — see pipeline_forward.
+# Bounded LRU: entries strongly reference stage_fn (usually a bound
+# method pinning a whole model) plus its executables, so evict oldest.
+from collections import OrderedDict
+
+_partial_manual_cache: OrderedDict = OrderedDict()
+_PARTIAL_MANUAL_CACHE_MAX = 16
 
 
 def gpipe_inner(stage_fn, stage_params, x_mb, axis_name):
@@ -138,6 +143,10 @@ def pipeline_forward(stage_fn, stacked_params, x, num_microbatches,
                 in_specs=(pspec, xspec), out_specs=xspec,
                 axis_names=manual, check_vma=False))
             _partial_manual_cache[key] = sm_fn
+            while len(_partial_manual_cache) > _PARTIAL_MANUAL_CACHE_MAX:
+                _partial_manual_cache.popitem(last=False)
+        else:
+            _partial_manual_cache.move_to_end(key)
     else:
         sm_fn = jax.shard_map(
             shard_fn, mesh=mesh,

@@ -31,7 +31,8 @@ from ..ops._base import register, apply, unwrap
 
 # re-exports from the native homes
 from ..amp import AutoMixedPrecisionLists, decorate  # noqa: F401
-from ..quant import PostTrainingQuantization  # noqa: F401
+from ..quant import (PostTrainingQuantization,  # noqa: F401
+                     quantize_inference_model)  # noqa: F401
 from ..ops.misc import tree_conv  # noqa: F401
 from .rnn import _FluidGRUCell, _gru_step
 

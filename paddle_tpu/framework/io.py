@@ -143,6 +143,31 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
             scope.set(k[2:], jnp.asarray(data[k]))
         elif k.startswith("c!"):
             program._constants[k[2:]] = jnp.asarray(data[k])
+    # int8 bundle entries (quant.quantize_inference_model): the q!/s!
+    # pair becomes two persistables and a prepended dequantize_weight op
+    # re-emitting the original weight name — downstream ops, the
+    # Executor, and the Predictor all run unchanged, with the int8 array
+    # as the resident HBM copy and the dequant fused by XLA
+    dequant_ops = []
+    for k in data.files:
+        if not k.startswith("q!"):
+            continue
+        name = k[2:]
+        qarr, sarr = data[k], data["s!" + name]
+        dtype = desc["vars"].get(name, (None, "float32"))[1]
+        qv = blk.create_var(name=name + "@INT8", shape=list(qarr.shape),
+                            dtype=str(qarr.dtype))
+        qv.persistable = True
+        sv = blk.create_var(name=name + "@SCALE", shape=list(sarr.shape),
+                            dtype=str(sarr.dtype))
+        sv.persistable = True
+        scope.set(name + "@INT8", jnp.asarray(qarr))
+        scope.set(name + "@SCALE", jnp.asarray(sarr))
+        dequant_ops.append(Operator(
+            "dequantize_weight", OP_REGISTRY["dequantize_weight"],
+            [name + "@INT8", name + "@SCALE"], [name], {"dtype": dtype}))
+    for op in dequant_ops:
+        blk.append_op(op)
     for type_, in_names, out_names, attrs in desc["ops"]:
         if type_ not in OP_REGISTRY:
             raise ValueError(

@@ -574,3 +574,13 @@ def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
 
         out = getattr(F, act)(out)
     return out
+
+
+@register("dequantize_weight")
+def _dequantize_weight(q, s, *, dtype="float32"):
+    """Graph-pass dequant for int8-stored weights (TPU analog of the
+    reference's quant_dequant ops, quantization_pass.py:703): the int8
+    array is the HBM-resident copy passed as a jit argument; this op
+    runs inside the compiled program so XLA fuses the multiply into the
+    consuming matmul/conv — weight memory traffic shrinks 4x."""
+    return q.astype(dtype) * s.astype(dtype)

@@ -310,3 +310,99 @@ class QAT:
             else:
                 self.convert(child)
         return model
+
+
+# ---------------------------------------------------------------------------
+# static inference-bundle quantization (save -> PTQ pass -> Predictor)
+# ---------------------------------------------------------------------------
+
+# weight-consuming op types and the output-channel axis of their weight
+# operand (input slot 1); ref quantization_pass.py _weight_quantize_type
+_QUANT_OPS = {
+    "conv2d": 0,          # weight (out, in, kh, kw)
+    "linear": 1,          # weight (in, out)
+    "linear_nobias": 1,
+    "matmul": 1,
+}
+
+
+def quantize_inference_model(path_prefix, out_prefix=None, bits=8,
+                             min_elems=512, quantizable_op_type=None):
+    """Post-training int8 pass over a ``save_inference_model`` bundle
+    (ref: post_training_quantization.py:60 + the freeze pass in
+    quantization_pass.py:703 — there a Program rewrite inserting
+    quant/dequant ops; here the pass rewrites the saved bundle).
+
+    Weights feeding matmul-like/conv ops are stored int8 with
+    per-output-channel scales; ``load_inference_model`` rebuilds them as
+    int8 persistables plus a prepended ``dequantize_weight`` op, so the
+    Predictor keeps the int8 copy resident in HBM and XLA fuses the
+    dequant into the consumer (4x weight-memory traffic cut, the right
+    int8 trade on TPU where the MXU natively runs bf16).
+
+    Weights also consumed by non-quantizable ops, smaller than
+    ``min_elems``, or not floating-point are kept fp32. Returns the list
+    of quantized weight names. ``out_prefix`` defaults to
+    ``path_prefix + "_int8"``.
+    """
+    import os
+    import pickle
+
+    op_types = dict(_QUANT_OPS)
+    if quantizable_op_type is not None:
+        op_types = {k: v for k, v in op_types.items()
+                    if k in set(quantizable_op_type)}
+    out_prefix = out_prefix or (path_prefix + "_int8")
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        desc = pickle.load(f)
+    params_path = (path_prefix + ".pdiparams.npz"
+                   if os.path.exists(path_prefix + ".pdiparams.npz")
+                   else path_prefix + ".pdiparams")
+    data = np.load(params_path, allow_pickle=True)
+    if any(k.startswith("q!") for k in data.files):
+        raise ValueError(
+            f"{path_prefix!r} is already an int8 bundle (contains q!/s! "
+            "entries); quantize the original fp32 bundle instead")
+    weights = {k[2:]: data[k] for k in data.files if k.startswith("w!")}
+    consts = {k[2:]: data[k] for k in data.files if k.startswith("c!")}
+
+    # role scan: weight name -> channel axis; conflicted/other-use -> None
+    roles: dict = {}
+    for type_, in_names, out_names, attrs in desc["ops"]:
+        axis = op_types.get(type_)
+        for slot, name in enumerate(in_names):
+            if name not in weights:
+                continue
+            if axis is not None and slot == 1:
+                roles[name] = axis if roles.get(name, axis) == axis else None
+            else:
+                roles[name] = None  # consumed elsewhere: keep exact
+
+    quantized = []
+    out_arrays = {}
+    for name, arr in weights.items():
+        axis = roles.get(name)
+        if (axis is None or arr.size < min_elems or arr.ndim < 2
+                or not np.issubdtype(arr.dtype, np.floating)):
+            out_arrays["w!" + name] = arr
+            continue
+        q, s = quantize_abs_max(arr, bits=bits, channel_axis=axis)
+        out_arrays["q!" + name] = q
+        out_arrays["s!" + name] = s.astype(np.float32)
+        quantized.append(name)
+
+    desc = dict(desc)
+    desc["quant"] = {"bits": bits, "weights": sorted(quantized)}
+    d = os.path.dirname(out_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(out_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(desc, f, protocol=4)
+    np.savez(out_prefix + ".pdiparams",
+             __consts__=np.array(list(consts)),
+             **{("c!" + k): v for k, v in consts.items()},
+             **out_arrays)
+    return quantized
+
+
+__all__ += ["quantize_inference_model"]
