@@ -93,6 +93,7 @@ from . import dataset
 from . import vision
 from . import fluid
 from .hapi import Model
+from .io_.dataloader import DataLoader  # noqa: F401  (paddle.DataLoader)
 # NB: ``paddle_tpu.dist`` is the p-norm distance op (paddle parity);
 # the distributed package binds as ``paddle_tpu.distributed``. A plain
 # ``from . import dist`` would silently resolve to the already-bound

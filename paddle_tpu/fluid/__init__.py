@@ -39,9 +39,11 @@ from ..static_ import backward  # noqa: F401
 from ..static_.backward import gradients, append_backward  # noqa: F401
 from ..static_.program import Variable  # noqa: F401
 from ..framework.jit import to_static  # noqa: F401
-from ..framework import io  # noqa: F401
+from . import io  # noqa: F401  (framework io + fluid-era loaders)
 from ..framework.io import (save_inference_model,  # noqa: F401
                             load_inference_model)
+from . import reader  # noqa: F401
+from . import data_feeder  # noqa: F401
 from ..core.device import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
 
 CUDAPinnedPlace = CPUPlace  # host-staging place: plain host memory here

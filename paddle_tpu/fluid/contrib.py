@@ -551,3 +551,13 @@ import types as _types  # noqa: E402
 memory_usage_calc = _types.SimpleNamespace(memory_usage=memory_usage)
 model_stat = _types.SimpleNamespace(summary=model_summary)
 op_frequence = _types.SimpleNamespace(op_freq_statistic=op_freq_statistic)
+
+# fluid.contrib.utils (hdfs + lookup-table utils): real submodule
+# registered under the dotted name so `from paddle_tpu.fluid.contrib
+# import utils` and `import paddle_tpu.fluid.contrib.utils` both work
+# even though contrib is a flat module (ref: fluid/contrib/utils/)
+import sys as _sys  # noqa: E402
+
+from . import contrib_utils as utils  # noqa: E402,F401
+
+_sys.modules[__name__ + ".utils"] = utils

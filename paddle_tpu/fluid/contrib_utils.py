@@ -1,0 +1,161 @@
+"""fluid.contrib.utils (ref: python/paddle/fluid/contrib/utils/).
+
+Two members in the reference:
+- hdfs_utils (hdfs_utils.py:35 HDFSClient): a subprocess wrapper over the
+  ``hadoop fs`` CLI. Same design here — thin, real, and dependency-free;
+  it errors clearly when no hadoop binary is on PATH.
+- lookup_table_utils (lookup_table_utils.py:85): program surgery for the
+  parameter-server sparse lookup tables. PS mode is a recorded descope
+  (SURVEY §4b — ICI/SPMD subsumes it; sparse embeddings shard over the
+  mesh via VocabParallelEmbedding), so these raise the descope error.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload", "getfilelist",
+           "convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference"]
+
+
+class HDFSClient:
+    """ref hdfs_utils.py:35 — shells out to ``hadoop fs`` exactly like
+    the reference (there via java_home/hadoop_home; here any ``hadoop``
+    on PATH or an explicit ``hadoop_home``)."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        self._bin = (os.path.join(hadoop_home, "bin", "hadoop")
+                     if hadoop_home else shutil.which("hadoop"))
+        self._configs = []
+        for k, v in (configs or {}).items():
+            self._configs += ["-D", f"{k}={v}"]
+
+    def _run(self, *args, check=True):
+        if self._bin is None or not os.path.exists(self._bin):
+            raise RuntimeError(
+                "no hadoop binary found (PATH or hadoop_home); HDFSClient "
+                "wraps the 'hadoop fs' CLI just like the reference "
+                "hdfs_utils.py")
+        cmd = [self._bin, "fs", *self._configs, *args]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if check and proc.returncode != 0:
+            raise RuntimeError(
+                f"hadoop fs {' '.join(args)} failed: {proc.stderr.strip()}")
+        return proc
+
+    def ls(self, path):
+        proc = self._run("-ls", path)
+        files = []
+        for line in proc.stdout.splitlines():
+            parts = line.split()
+            if len(parts) >= 8:
+                files.append(parts[-1])
+        return files
+
+    def lsr(self, path):
+        proc = self._run("-ls", "-R", path)
+        return [ln.split()[-1] for ln in proc.stdout.splitlines()
+                if len(ln.split()) >= 8]
+
+    def is_exist(self, path):
+        return self._run("-test", "-e", path, check=False).returncode == 0
+
+    def is_dir(self, path):
+        return self._run("-test", "-d", path, check=False).returncode == 0
+
+    def is_file(self, path):
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def delete(self, path):
+        return self._run("-rm", "-r", "-skipTrash", path).returncode == 0
+
+    def rename(self, src, dst):
+        return self._run("-mv", src, dst).returncode == 0
+
+    def makedirs(self, path):
+        return self._run("-mkdir", "-p", path).returncode == 0
+
+    def upload(self, hdfs_path, local_path, overwrite=False, retry_times=5):
+        args = ["-put"] + (["-f"] if overwrite else []) + \
+            [local_path, hdfs_path]
+        return self._run(*args).returncode == 0
+
+    def download(self, hdfs_path, local_path, overwrite=False,
+                 unzip=False):
+        if os.path.exists(local_path):
+            if not overwrite:
+                raise ValueError(
+                    f"local path {local_path!r} exists; pass "
+                    "overwrite=True to replace it")
+            if os.path.isdir(local_path):
+                shutil.rmtree(local_path)
+            else:
+                os.remove(local_path)
+        return self._run("-get", hdfs_path, local_path).returncode == 0
+
+
+def getfilelist(path):
+    """ref hdfs_utils.py:508 — local walk variant used by multi_*."""
+    rlist = []
+    for dirname, _, files in os.walk(path):
+        for f in files:
+            rlist.append(os.path.join(dirname, f))
+    return rlist
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
+                   multi_processes=5):
+    """ref hdfs_utils.py:437 — this trainer downloads its round-robin
+    share of the files under ``hdfs_path``."""
+    files = client.lsr(hdfs_path)
+    mine = [f for i, f in enumerate(sorted(files))
+            if i % trainers == trainer_id]
+    base = hdfs_path.rstrip("/") + "/"
+    for f in mine:
+        # keep the path relative to hdfs_path: same-named files in
+        # different subdirs (a/part-00000, b/part-00000) must not collide
+        rel = f[len(base):] if f.startswith(base) else os.path.basename(f)
+        dst = os.path.join(local_path, rel)
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        client.download(f, dst)
+    return mine
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False, sync=True):
+    """ref hdfs_utils.py:518."""
+    client.makedirs(hdfs_path)
+    uploaded = []
+    for f in getfilelist(local_path):
+        rel = os.path.relpath(f, local_path)
+        dst = hdfs_path.rstrip("/") + "/" + rel
+        rd = os.path.dirname(dst)
+        if rd:
+            client.makedirs(rd)
+        client.upload(dst, f, overwrite=overwrite)
+        uploaded.append(dst)
+    return uploaded
+
+
+def _ps_descoped(name):
+    raise NotImplementedError(
+        f"{name} is parameter-server lookup-table plumbing "
+        "(ref contrib/utils/lookup_table_utils.py) — PS mode is a "
+        "recorded descope (SURVEY §4b): on TPU, sparse embeddings shard "
+        "over the mesh (VocabParallelEmbedding) and ICI collectives "
+        "subsume the PS round-trips")
+
+
+def convert_dist_to_sparse_program(program):
+    _ps_descoped("convert_dist_to_sparse_program")
+
+
+def load_persistables_for_increment(dirname, executor, program, *a, **k):
+    _ps_descoped("load_persistables_for_increment")
+
+
+def load_persistables_for_inference(dirname, executor, program, *a, **k):
+    _ps_descoped("load_persistables_for_inference")

@@ -1,0 +1,57 @@
+"""fluid.data_feeder submodule (ref: python/paddle/fluid/data_feeder.py).
+
+The reference module carries the DataFeeder class plus the dtype/type
+validators that nearly every fluid layer calls on its inputs. Here the
+validators are real (they raise the same error classes with the same
+spirit of message) and DataFeeder is the shared io_ implementation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtype import convert_dtype as _to_jax_dtype
+from ..core.tensor import Tensor
+from ..io_.reader import DataFeeder  # noqa: F401
+from ..static_.program import Variable
+
+__all__ = ["DataFeeder", "convert_dtype", "check_variable_and_dtype",
+           "check_type", "check_dtype"]
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spelling to the canonical string name
+    (ref data_feeder.py:30 — there VarDesc enum -> str)."""
+    return str(np.dtype(_to_jax_dtype(dtype)))
+
+
+def check_type(input, input_name, expected_type, op_name, extra_message=""):
+    """ref data_feeder.py:83."""
+    if not isinstance(input, expected_type):
+        raise TypeError(
+            f"The type of '{input_name}' in {op_name} must be "
+            f"{expected_type}, but received {type(input)}. {extra_message}")
+
+
+def check_dtype(input_dtype, input_name, expected_dtype, op_name,
+                extra_message=""):
+    """ref data_feeder.py:99."""
+    canon = convert_dtype(input_dtype)
+    expected = tuple(convert_dtype(d) for d in (
+        expected_dtype if isinstance(expected_dtype, (list, tuple))
+        else (expected_dtype,)))
+    if canon not in expected:
+        raise TypeError(
+            f"The data type of '{input_name}' in {op_name} must be one of "
+            f"{list(expected)}, but received {canon}. {extra_message}")
+
+
+def check_variable_and_dtype(input, input_name, expected_dtype, op_name,
+                             extra_message=""):
+    """ref data_feeder.py:74 — input must be a Variable/Tensor of one of
+    the expected dtypes."""
+    check_type(input, input_name, (Variable, Tensor), op_name,
+               extra_message)
+    dtype = getattr(input, "dtype", None)
+    if dtype is None and getattr(input, "_data", None) is not None:
+        dtype = input._data.dtype
+    check_dtype(dtype, input_name, expected_dtype, op_name, extra_message)

@@ -1,0 +1,14 @@
+"""fluid.io (ref: python/paddle/fluid/io.py): the framework io surface
+plus the fluid-era loaders, exactly as the reference re-exports
+``reader.__all__`` from fluid/io.py:38."""
+from __future__ import annotations
+
+from ..framework.io import *  # noqa: F401,F403
+from ..framework.io import (save_inference_model,  # noqa: F401
+                            load_inference_model, save, load,
+                            load_program_state, set_program_state,
+                            save_checkpoint, load_checkpoint)
+from ..io_.reader import (batch, shuffle, buffered, map_readers,  # noqa: F401
+                          xmap_readers, chain, compose, firstn, cache,
+                          DataFeeder)
+from .reader import DataLoader, PyReader, GeneratorLoader  # noqa: F401

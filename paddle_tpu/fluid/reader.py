@@ -1,0 +1,131 @@
+"""fluid.reader submodule (ref: python/paddle/fluid/reader.py).
+
+The reference module hosts the feeding loaders of the fluid era:
+``DataLoader.from_generator`` (ref reader.py:179) and ``PyReader``
+(ref reader.py:1064), both wrappers that move user generators into the
+executor feed loop (there via C++ LoDTensor queues and a double-buffer
+thread). On TPU the Executor compiles the whole program and feeds are
+host numpy arrays, so the loaders reduce to honest generator adapters:
+they batch samples, name the arrays after the feed_list variables, and
+yield executor-ready feed dicts. The modern path is paddle.io.DataLoader
+(io_/dataloader.py) with the native prefetch ring; these exist so
+fluid-era scripts run unmodified.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io_.dataloader import DataLoader as _ModernDataLoader
+from ..static_.program import Variable
+
+__all__ = ["DataLoader", "PyReader", "GeneratorLoader"]
+
+
+def _feed_names(feed_list):
+    names = []
+    for v in feed_list or []:
+        names.append(v.name if isinstance(v, Variable) else str(v))
+    return names
+
+
+class GeneratorLoader:
+    """Generator-fed loader (ref reader.py:791 GeneratorLoader). Yields
+    ``{name: np.ndarray}`` feed dicts for ``Executor.run``."""
+
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        self._names = _feed_names(feed_list)
+        self._gen = None
+        self._iterable = iterable
+        self._return_list = return_list
+
+    # -- decoration (ref GeneratorLoader.set_* / PyReader.decorate_*) ------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        def batches():
+            buf = []
+            for sample in reader():
+                buf.append(sample if isinstance(sample, (list, tuple))
+                           else (sample,))
+                if len(buf) == batch_size:
+                    yield [np.stack([np.asarray(s[i]) for s in buf])
+                           for i in range(len(buf[0]))]
+                    buf = []
+            if buf and not drop_last:
+                yield [np.stack([np.asarray(s[i]) for s in buf])
+                       for i in range(len(buf[0]))]
+
+        self._gen = batches
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        def batches():
+            for samples in reader():
+                yield [np.stack([np.asarray(s[i]) for s in samples])
+                       for i in range(len(samples[0]))]
+
+        self._gen = batches
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        def batches():
+            for batch in reader():
+                yield [np.asarray(a) for a in
+                       (batch if isinstance(batch, (list, tuple))
+                        else (batch,))]
+
+        self._gen = batches
+        return self
+
+    # -- iteration ----------------------------------------------------------
+    def __iter__(self):
+        if self._gen is None:
+            raise RuntimeError(
+                "no generator set: call set_sample_generator / "
+                "set_sample_list_generator / set_batch_generator first")
+        for arrays in self._gen():
+            if self._return_list:
+                yield list(arrays)
+            else:
+                if len(arrays) != len(self._names):
+                    raise ValueError(
+                        f"generator yielded {len(arrays)} arrays but "
+                        f"feed_list has {len(self._names)} variables "
+                        f"({self._names})")
+                yield dict(zip(self._names, arrays))
+
+    def __call__(self):
+        return iter(self)
+
+    # non-iterable (start/reset) protocol degenerates to iteration here
+    def start(self):
+        return None
+
+    def reset(self):
+        return None
+
+
+class DataLoader(_ModernDataLoader):
+    """fluid.reader.DataLoader: the modern loader plus the fluid-era
+    ``from_generator`` constructor (ref reader.py:179)."""
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False, drop_last=True):
+        return GeneratorLoader(feed_list=feed_list, capacity=capacity,
+                               use_double_buffer=use_double_buffer,
+                               iterable=iterable, return_list=return_list)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        return _ModernDataLoader(dataset, drop_last=drop_last)
+
+
+class PyReader(GeneratorLoader):
+    """ref reader.py:1064 — the deprecated generator reader; identical
+    adapter with the decorate_* method names."""
+
+    decorate_sample_generator = GeneratorLoader.set_sample_generator
+    decorate_sample_list_generator = GeneratorLoader.set_sample_list_generator
+    decorate_batch_generator = GeneratorLoader.set_batch_generator

@@ -17,3 +17,8 @@ from .layers import (  # noqa: F401
 )
 
 functional_api = functional
+
+# paddle.nn re-exports the gradient clippers (ref: python/paddle/nn
+# exposing ClipGradByValue/Norm/GlobalNorm; impl lives in optim/clip.py)
+from ..optim.clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
+                          ClipGradByGlobalNorm)

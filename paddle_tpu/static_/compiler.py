@@ -36,6 +36,10 @@ class ExecutionStrategy:
         self.num_threads = 1
         self.num_iteration_per_drop_scope = 100
         self.use_thread_pool = False
+        # reference ParallelExecutor ERRORS when a batch can't split
+        # across devices (parallel_executor.py:28); opt in to run such
+        # feeds replicated (correct result, zero DP speedup) instead
+        self.allow_replicated_fallback = False
 
 
 class CompiledProgram:

@@ -75,7 +75,8 @@ class Executor:
 
         return Mesh(np.asarray(jax.local_devices()), ("data",))
 
-    def _compile(self, program, feed, fetch_list, data_parallel=False):
+    def _compile(self, program, feed, fetch_list, data_parallel=False,
+                 allow_replicated_fallback=False):
         feed_names = tuple(sorted(feed))
         fetch_names = tuple(
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list)
@@ -83,7 +84,7 @@ class Executor:
             (np.asarray(feed[n]).shape, str(np.asarray(feed[n]).dtype))
             for n in feed_names)
         key = (id(program), program._version, feed_names, shapes, fetch_names,
-               bool(data_parallel))
+               bool(data_parallel), bool(allow_replicated_fallback))
         if key in self._cache:
             return self._cache[key]
 
@@ -116,20 +117,35 @@ class Executor:
             def feed_sharding(shape):
                 if len(shape) >= 1 and shape[0] > 0 and shape[0] % ndev == 0:
                     return NamedSharding(mesh, P("data"))
-                if len(shape) >= 1 and shape[0] > 1:
-                    # the reference ParallelExecutor errors when a batch
-                    # can't split across devices; here the feed still
-                    # runs (replicated) but never silently — the user
-                    # asked for DP and is getting none for this input
-                    import warnings
-
-                    warnings.warn(
-                        f"data-parallel feed with leading dim {shape[0]} "
-                        f"not divisible by {ndev} devices: replicating "
-                        "(no DP speedup for this input)", RuntimeWarning)
                 return rep  # non-batched / indivisible feeds replicate
 
-            in_sh = ([feed_sharding(s) for s, _ in shapes],
+            feed_sh = [feed_sharding(s) for s, _ in shapes]
+            if shapes and not any(sh is not rep for sh in feed_sh):
+                # NOTHING sharded: the "data-parallel" step would run
+                # fully replicated — reference ParallelExecutor errors on
+                # unsplittable batches (parallel_executor.py:28), so
+                # refuse unless the user opted into the fallback. (An
+                # indivisible AUXILIARY feed next to properly-sharded
+                # batch feeds replicates quietly — that is correct, not
+                # a degraded run.)
+                dims = {n: s for (s, _), n in zip(shapes, feed_names)}
+                if not allow_replicated_fallback:
+                    raise ValueError(
+                        f"data-parallel run but no feed's leading dim "
+                        f"divides the {ndev} devices of the data mesh "
+                        f"(feed shapes: {dims}): the step would execute "
+                        "fully replicated with 0% DP speedup. Pad or "
+                        "rebatch the feed, or opt in with "
+                        "ExecutionStrategy.allow_replicated_fallback"
+                        "=True")
+                import warnings
+
+                warnings.warn(
+                    f"data-parallel feeds {dims} have no leading dim "
+                    f"divisible by {ndev} devices: running fully "
+                    "replicated (no DP speedup)", RuntimeWarning)
+
+            in_sh = (feed_sh,
                      [rep] * len(updated), [rep] * len(frozen))
             out_sh = ([rep] * len(fetch_names), [rep] * len(updated))
             jit_fn = jax.jit(raw, donate_argnums=(1,), in_shardings=in_sh,
@@ -153,8 +169,11 @@ class Executor:
         if program is None:
             program = default_main_program()
         data_parallel = False
+        allow_replicated_fallback = False
         if isinstance(program, CompiledProgram):
             data_parallel = program._data_parallel
+            allow_replicated_fallback = getattr(
+                program._exec_strategy, "allow_replicated_fallback", False)
             program = program._program
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -168,8 +187,9 @@ class Executor:
             feed = dict(feed)
             feed["@lr"] = np.asarray(program._lr_getter(), np.float32)
 
-        compiled = self._compile(program, feed, fetch_list,
-                                 data_parallel=data_parallel)
+        compiled = self._compile(
+            program, feed, fetch_list, data_parallel=data_parallel,
+            allow_replicated_fallback=allow_replicated_fallback)
         feeds = [jnp.asarray(np.asarray(feed[n])) for n in compiled.feed_names]
         updated = [scope.find_var(n) for n in compiled.updated]
         frozen = [scope.find_var(n) for n in compiled.frozen]

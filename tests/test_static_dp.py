@@ -80,7 +80,7 @@ def test_dp_shards_batch_axis(static_mode):
     # the executor compiled under the DP cache key, and the jit carries
     # batch-axis shardings: the traced executable's input sharding for
     # the feed spans all devices
-    assert any(k[-1] is True for k in exe._cache)
+    assert any(k[-2] is True for k in exe._cache)  # data_parallel slot
     (compiled_entry,) = exe._cache.values()
     feed_shardings = compiled_entry.feed_shardings
     ndev = jax.local_device_count()
@@ -109,9 +109,10 @@ def test_parallel_executor_api(static_mode):
     assert np.allclose(single, losses, rtol=1e-4, atol=1e-5)
 
 
-def test_dp_indivisible_batch_replicates(static_mode):
-    """A feed whose batch doesn't divide the mesh must still run (it
-    falls back to replication instead of erroring)."""
+def test_dp_indivisible_batch_errors_by_default(static_mode):
+    """Reference ParallelExecutor semantics: a batch that can't split
+    across the devices errors (a silent replication would hand the user
+    0% of the DP speedup they asked for)."""
     pt.seed(0)
     prog, startup, loss = _build_mlp_program(batch=6)
     compiled = fluid.CompiledProgram(prog).with_data_parallel(
@@ -120,5 +121,58 @@ def test_dp_indivisible_batch_replicates(static_mode):
     exe.run(startup)
     xb = np.random.RandomState(0).randn(6, 8).astype(np.float32)
     yb = np.zeros((6, 1), np.float32)
-    (lv,) = exe.run(compiled, feed={"x": xb, "y": yb}, fetch_list=[loss])
+    with pytest.raises(ValueError, match="allow_replicated_fallback"):
+        exe.run(compiled, feed={"x": xb, "y": yb}, fetch_list=[loss])
+
+
+def test_dp_indivisible_batch_replicates_with_optout(static_mode):
+    """ExecutionStrategy.allow_replicated_fallback=True restores the
+    run-replicated behavior, loudly (RuntimeWarning)."""
+    pt.seed(0)
+    prog, startup, loss = _build_mlp_program(batch=6)
+    strat = fluid.ExecutionStrategy()
+    strat.allow_replicated_fallback = True
+    compiled = fluid.CompiledProgram(prog).with_data_parallel(
+        loss_name=loss.name, exec_strategy=strat)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xb = np.random.RandomState(0).randn(6, 8).astype(np.float32)
+    yb = np.zeros((6, 1), np.float32)
+    with pytest.warns(RuntimeWarning, match="fully replicated"):
+        (lv,) = exe.run(compiled, feed={"x": xb, "y": yb},
+                        fetch_list=[loss])
+    assert np.isfinite(np.asarray(lv)).all()
+
+
+def test_dp_indivisible_aux_feed_replicates_quietly(static_mode):
+    """An auxiliary feed whose leading dim doesn't divide the mesh must
+    NOT trip the divisibility error while the batch feeds shard fine —
+    it just replicates (the correct placement for a non-batch input)."""
+    import warnings
+
+    pt.seed(0)
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[16, 8])
+        y = fluid.data(name="y", shape=[16, 1])
+        coef = fluid.data(name="coef", shape=[3])  # aux, 3 % 8 != 0
+        h = fluid.layers.fc(x, size=16, act="relu")
+        out = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(out, y)) + \
+            fluid.layers.reduce_mean(coef)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    compiled = fluid.CompiledProgram(prog).with_data_parallel(
+        loss_name=loss.name)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)  # no warning either
+        (lv,) = exe.run(compiled,
+                        feed={"x": rng.randn(16, 8).astype(np.float32),
+                              "y": rng.randn(16, 1).astype(np.float32),
+                              "coef": np.ones(3, np.float32)},
+                        fetch_list=[loss])
     assert np.isfinite(np.asarray(lv)).all()
