@@ -52,6 +52,20 @@ PEAK_FLOPS = {  # per-chip peak bf16 FLOP/s
     "TPU v6e": 918e12,
 }
 
+# Per-leg perf targets on ONE v5e chip (VERDICT r4 Next #8) — the legs
+# are sized so a healthy run should hit these; bench output records
+# target + met so a regression is visible in BENCH_r*.json itself:
+# - bert  B=64 L=128:  ~674 MFLOP/token (6N + attn); >=40% MFU =
+#   ~117k tok/s. B=64 (8192 tok/step) keeps the MXU fed; fits 16G HBM.
+# - gpt   B=16 L=1024: ~857 MFLOP/token; >=40% MFU = ~92k tok/s.
+# - resnet50 B=128: ~12.3 GFLOP/img trained (3x 4.1 GFLOP fwd); conv
+#   stacks reach lower MFU than transformer matmuls — expect 2000-3000
+#   imgs/s on v5e (>=2x the 980 imgs/s V100 baseline), target >=2000.
+MFU_TARGET_BERT = 0.40
+MFU_TARGET_GPT = 0.40
+RESNET50_TRAIN_FLOPS_PER_IMG = 12.3e9
+IMGS_TARGET_RESNET50 = 2000.0
+
 
 def _log(msg):
     print(msg, file=sys.stderr, flush=True)
@@ -90,7 +104,7 @@ def _time_step(step, batch, warmup=3, iters=10):
     return (time.perf_counter() - t0) / iters, float(np.asarray(loss._data))
 
 
-def bench_bert(B=32, L=128):
+def bench_bert(B=64, L=128):
     import paddle_tpu as pt
     from paddle_tpu import optim
     from paddle_tpu.models.nlp.bert import (BertForPretraining, bert_base,
@@ -118,7 +132,7 @@ def bench_bert(B=32, L=128):
             "loss": loss, "params": n_params}
 
 
-def bench_resnet50(B=64, size=224):
+def bench_resnet50(B=128, size=224):
     import paddle_tpu as pt
     from paddle_tpu import optim
     import paddle_tpu.nn.functional as F
@@ -138,10 +152,15 @@ def bench_resnet50(B=64, size=224):
     x = rng.randn(B, 3, size, size).astype(np.float32)
     y = rng.randint(0, 1000, (B,)).astype("int32")
     dt, loss = _time_step(step, (x, y))
-    return {"imgs_per_sec": B / dt, "step_ms": dt * 1e3, "loss": loss}
+    # the 12.3 GFLOP/img constant is a 224x224 figure: scale for other
+    # probe sizes (conv FLOPs go with spatial area)
+    flops_img = RESNET50_TRAIN_FLOPS_PER_IMG * (size / 224.0) ** 2
+    mfu = flops_img * B / dt / _peak_flops()
+    return {"imgs_per_sec": B / dt, "step_ms": dt * 1e3, "mfu": mfu,
+            "loss": loss}
 
 
-def bench_gpt(B=8, L=1024):
+def bench_gpt(B=16, L=1024):
     import paddle_tpu as pt
     from paddle_tpu import optim
     from paddle_tpu.models.nlp.gpt import GPT, GPTConfig, gpt_loss
@@ -454,6 +473,10 @@ def _score(results, headline, extras):
                 results["bert"]["tokens_per_sec"] / BASELINE_BERT_TOKENS_S, 3),
         }
         extras["bert_mfu"] = round(results["bert"]["mfu"], 4)
+        if not SMOKE:  # tiny-shape CPU numbers would always read false
+            extras["bert_mfu_target"] = MFU_TARGET_BERT
+            extras["bert_target_met"] = bool(
+                results["bert"]["mfu"] >= MFU_TARGET_BERT)
     elif "gpt" in results:
         headline = {
             "metric": "gpt2_small_train_tokens_per_sec_per_chip",
@@ -476,10 +499,20 @@ def _score(results, headline, extras):
             results["resnet50"]["imgs_per_sec"], 1)
         extras["resnet50_vs_baseline"] = round(
             results["resnet50"]["imgs_per_sec"] / BASELINE_RESNET_IMGS_S, 3)
+        if "mfu" in results["resnet50"]:
+            extras["resnet50_mfu"] = round(results["resnet50"]["mfu"], 4)
+        if not SMOKE:
+            extras["resnet50_imgs_target"] = IMGS_TARGET_RESNET50
+            extras["resnet50_target_met"] = bool(
+                results["resnet50"]["imgs_per_sec"] >= IMGS_TARGET_RESNET50)
     if "gpt" in results:
         extras["gpt_tokens_per_sec"] = round(
             results["gpt"]["tokens_per_sec"], 1)
         extras["gpt_mfu"] = round(results["gpt"]["mfu"], 4)
+        if not SMOKE:
+            extras["gpt_mfu_target"] = MFU_TARGET_GPT
+            extras["gpt_target_met"] = bool(
+                results["gpt"]["mfu"] >= MFU_TARGET_GPT)
     if "gpt_no_pallas" in results and "gpt" in results:
         off = results["gpt_no_pallas"]["tokens_per_sec"]
         extras["gpt_tokens_per_sec_no_pallas"] = round(off, 1)

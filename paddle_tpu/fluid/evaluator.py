@@ -27,7 +27,11 @@ class Evaluator:
     def __init__(self, name=None, **kwargs):
         warnings.warn(
             f"fluid.evaluator.{type(self).__name__} is deprecated; use "
-            "paddle_tpu.metrics instead", Warning)
+            "paddle_tpu.metrics instead. NOTE: executor/program arguments "
+            "are accepted for source compatibility but IGNORED — metrics "
+            "come only from values passed to update(); accumulator "
+            "sub-programs the reference would build are never run",
+            Warning)
         self.name = name or type(self).__name__.lower()
         self.states = []
         self.metrics = []

@@ -15,18 +15,21 @@ __all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy",
 
 
 class BuildStrategy:
-    """Knob container for API parity; XLA owns the actual fusion/memory
-    decisions that these flags tuned in the reference."""
+    """Knob container for API parity. Every flag below is accepted and
+    INERT: the optimization it tuned in the reference's SSA-graph build
+    is owned by XLA here (fusion passes, buffer assignment/donation,
+    GSPMD all-reduce combining) and happens unconditionally — there is
+    nothing to toggle. Setting a flag never changes behavior."""
 
     def __init__(self):
-        self.reduce_strategy = "all_reduce"
-        self.gradient_scale_strategy = "coeff_num_device"
-        self.memory_optimize = None
-        self.enable_inplace = None
-        self.fuse_all_optimizer_ops = True
-        self.fuse_all_reduce_ops = True
-        self.fuse_elewise_add_act_ops = True
-        self.sync_batch_norm = False
+        self.reduce_strategy = "all_reduce"        # inert: GSPMD decides
+        self.gradient_scale_strategy = "coeff_num_device"  # inert
+        self.memory_optimize = None        # inert: XLA buffer assignment
+        self.enable_inplace = None         # inert: donation covers it
+        self.fuse_all_optimizer_ops = True     # inert: one fused step
+        self.fuse_all_reduce_ops = True        # inert: XLA combiner
+        self.fuse_elewise_add_act_ops = True   # inert: XLA fusion
+        self.sync_batch_norm = False  # inert: BN stats ride the program
         self.num_trainers = 1
         self.trainer_id = 0
 
