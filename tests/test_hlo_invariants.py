@@ -175,3 +175,131 @@ class TestFusedDecodeHLO:
         for marker in ("infeed", "outfeed", " send(", " recv(",
                        "SendToHost", "RecvFromHost"):
             assert marker not in txt, f"host transfer {marker!r} in decode"
+
+
+class TestInt8PredictorHLO:
+    def test_int8_weights_enter_executable_as_s8(self, tmp_path):
+        """The int8 serving claim, proven on the compiled executable:
+        quantized weights are s8[...] PARAMETERS of the HLO module (the
+        resident HBM copy), and the convert to float happens inside the
+        program (fused dequant), not on the host before the call."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.inference import Predictor
+        from paddle_tpu.models.vision import LeNet
+        from paddle_tpu.quant import quantize_inference_model
+
+        pt.seed(0)
+        pt.enable_static()
+        try:
+            main, startup = pt.static.Program(), pt.static.Program()
+            with pt.program_guard(main, startup):
+                x = pt.static.data("x", [8, 1, 28, 28], "float32")
+                prob = F.softmax(LeNet()(x), axis=-1)
+        finally:
+            pt.disable_static()
+        exe = pt.static.Executor()
+        exe.run(startup)
+        prefix = str(tmp_path / "lenet")
+        pt.framework.io.save_inference_model(prefix, ["x"], [prob],
+                                             program=main)
+        quantized = quantize_inference_model(prefix)
+        assert quantized
+
+        pred = Predictor(prefix + "_int8")
+        xs = np.zeros((8, 1, 28, 28), np.float32)
+        pred.run({"x": xs})  # compile
+        (fn,) = pred._compiled.values()
+        txt = fn.lower([jnp.asarray(xs)], pred._weights) \
+                .compile().as_text()
+        assert re.search(r"s8\[\d", txt), "no int8 parameter in HLO"
+        assert "convert" in txt, "dequant not inside the executable"
+
+
+class TestDistributedHLOSignatures:
+    """The collective 'signature' of each parallelism mode, pinned on
+    compiled HLO: the cheapest regression guard for the mechanisms the
+    bench can't measure without hardware."""
+
+    def test_ring_attention_permutes_never_gathers(self):
+        """Ring attention must rotate K/V blocks (collective-permute)
+        and must NOT fall back to all-gathering the full sequence —
+        that would silently forfeit the O(L/n) memory the mode exists
+        for."""
+        from paddle_tpu.dist import env as denv
+        from paddle_tpu.dist.ring_attention import ring_attention
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("sp",))
+        denv.set_mesh(mesh)
+        try:
+            q = jnp.ones((2, 4, 16, 8))
+
+            def ra(q):
+                t = pt.Tensor(q, _internal=True)
+                return ring_attention(t, t, t, axis_name="sp",
+                                      causal=True)._data
+
+            with mesh:
+                txt = jax.jit(ra).lower(q).compile().as_text()
+        finally:
+            denv.set_mesh(None)
+        assert txt.count("collective-permute(") >= 1, "no ring rotation"
+        assert txt.count("all-gather(") == 0, \
+            "ring attention gathered the full sequence"
+
+    def test_moe_exactly_two_all_to_alls(self):
+        """Expert parallel is dispatch + combine: exactly TWO all-to-all
+        ops. More means a shuffle crept in; zero means tokens never
+        crossed experts."""
+        from paddle_tpu.dist import env as denv
+        from paddle_tpu.dist.moe import MoEMLP
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("expert",))
+        denv.set_mesh(mesh)
+        try:
+            pt.seed(0)
+            layer = MoEMLP(16, 32, num_experts=4)
+            x = jnp.ones((2, 8, 16))
+
+            def moe(x):
+                return layer(pt.Tensor(x, _internal=True))._data
+
+            with mesh:
+                txt = jax.jit(moe).lower(x).compile().as_text()
+        finally:
+            denv.set_mesh(None)
+        assert txt.count("all-to-all(") == 2, \
+            f"expected dispatch+combine, got {txt.count('all-to-all(')}"
+
+    def test_tp_block_megatron_signature(self):
+        """Column->Row parallel pairs need exactly ONE all-reduce per
+        row-parallel output (attn proj + mlp fc2 = 2 for a GPT block)
+        and ZERO weight all-gathers — the Megatron communication
+        contract the TP layers exist to honor."""
+        from paddle_tpu.core import dispatch
+        from paddle_tpu.dist import env as denv
+        from paddle_tpu.models.nlp.gpt import GPTBlock, gpt_tiny
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("model",))
+        denv.set_mesh(mesh)
+        try:
+            pt.seed(0)
+            cfg = gpt_tiny(dropout=0.0)
+            blk = GPTBlock(cfg)
+            blk.eval()
+            x = jnp.ones((2, 16, cfg.hidden))
+
+            def fwd(x):
+                with dispatch.no_grad(), dispatch.fresh_tape():
+                    return blk(pt.Tensor(x, _internal=True))._data
+
+            with mesh:
+                txt = jax.jit(fwd).lower(x).compile().as_text()
+        finally:
+            denv.set_mesh(None)
+        assert txt.count("all-reduce(") == 2, \
+            f"expected 2 partial-sum all-reduces, got " \
+            f"{txt.count('all-reduce(')}"
+        assert txt.count("all-gather(") == 0, "weights were all-gathered"
