@@ -43,6 +43,9 @@ from . import io  # noqa: F401  (framework io + fluid-era loaders)
 from ..framework.io import (save_inference_model,  # noqa: F401
                             load_inference_model)
 from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from .dataset import (DatasetFactory, InMemoryDataset,  # noqa: F401
+                      QueueDataset)
 from . import data_feeder  # noqa: F401
 from ..core.device import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
 

@@ -1,0 +1,230 @@
+"""fluid.dataset: DatasetFactory / InMemoryDataset / QueueDataset
+(ref: python/paddle/fluid/dataset.py:22,325,847).
+
+The reference wires these to the C++ MultiSlotDataset + the PS-era
+multi-threaded trainer; here they are real host-side slot-file readers
+feeding `Executor.train_from_dataset` batches of the exact static-graph
+feed shapes. Kept: the MultiSlot text format (count-prefixed values per
+slot, one sample per line, in `set_use_var` order), pipe commands
+(each file is streamed through the command, as the reference does),
+local/global shuffle, batching. The XLA executor replaces the
+device-worker thread pool: `thread_num` is accepted and recorded, but a
+single compiled program consumes the batches.
+
+Line format per sample (MultiSlotDataFeed):
+    <n0> v0_1 ... v0_n0  <n1> v1_1 ... v1_n1  ...
+one count-prefixed group per slot; dense slots must supply exactly
+prod(sample_shape) values.
+"""
+from __future__ import annotations
+
+import subprocess
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "DatasetBase", "InMemoryDataset",
+           "QueueDataset"]
+
+
+class DatasetFactory:
+    """ref dataset.py:22 — create_dataset by class name."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        try:
+            cls = {"InMemoryDataset": InMemoryDataset,
+                   "QueueDataset": QueueDataset}[datafeed_class]
+        except KeyError:
+            raise ValueError(
+                f"datafeed class {datafeed_class} does not exist")
+        return cls()
+
+
+class _Slot:
+    def __init__(self, name, sample_shape, dtype):
+        self.name = name
+        self.sample_shape = tuple(int(abs(s)) for s in sample_shape)
+        self.size = int(np.prod(self.sample_shape)) if self.sample_shape \
+            else 1
+        self.dtype = dtype
+
+
+class DatasetBase:
+    """ref dataset.py:64 DatasetBase."""
+
+    def __init__(self):
+        self.pipe_command = "cat"
+        self.thread_num = 1
+        self.batch_size = 1
+        self.filelist = []
+        self.slots = []
+        self.hdfs_config = None
+        self._rows = None  # parsed samples: list of per-slot arrays
+
+    # -- configuration (reference surface) ---------------------------------
+    def set_pipe_command(self, pipe_command):
+        self.pipe_command = pipe_command
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self.thread_num = int(thread_num)
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        self.hdfs_config = (fs_name, fs_ugi)
+
+    def set_use_var(self, var_list):
+        """Declare the feed variables, in slot order (ref dataset.py:224).
+        float32 and int ("int64") dtypes only, like the reference."""
+        self.slots = []
+        for var in var_list:
+            dt = str(np.dtype(getattr(var, "dtype", np.float32)))
+            if dt.startswith("float"):
+                dtype = np.float32
+            elif dt.startswith("int") or dt.startswith("uint"):
+                dtype = np.int64
+            else:
+                raise ValueError(
+                    "fluid.dataset only supports dtype=float32 and "
+                    f"dtype=int64, got {dt} for {var.name}")
+            shape = tuple(getattr(var, "shape", ()) or ())
+            self.slots.append(_Slot(var.name, shape[1:], dtype))
+
+    def desc(self):
+        """Text description (reference returns the proto text)."""
+        return "\n".join(
+            [f"pipe_command: {self.pipe_command}",
+             f"batch_size: {self.batch_size}",
+             f"thread_num: {self.thread_num}"] +
+            [f"slot: {s.name} shape={s.sample_shape} "
+             f"dtype={np.dtype(s.dtype).name}" for s in self.slots])
+
+    # -- reading -----------------------------------------------------------
+    def _read_file_lines(self, path):
+        if self.pipe_command and self.pipe_command != "cat":
+            # the reference streams every file through the user's pipe
+            # command; same here (stdin=file, stdout=samples)
+            with open(path, "rb") as f:
+                proc = subprocess.run(
+                    self.pipe_command, shell=True, stdin=f,
+                    capture_output=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pipe_command {self.pipe_command!r} failed on "
+                    f"{path}: {proc.stderr.decode()[:500]}")
+            text = proc.stdout.decode()
+        else:
+            with open(path) as f:
+                text = f.read()
+        return [ln for ln in text.splitlines() if ln.strip()]
+
+    def _parse_line(self, line, path):
+        toks = line.split()
+        out = []
+        i = 0
+        for slot in self.slots:
+            if i >= len(toks):
+                raise ValueError(
+                    f"{path}: line ran out of tokens at slot "
+                    f"{slot.name!r}: {line[:80]!r}")
+            n = int(toks[i])
+            i += 1
+            vals = toks[i:i + n]
+            if len(vals) != n:
+                raise ValueError(
+                    f"{path}: slot {slot.name!r} declares {n} values, "
+                    f"found {len(vals)}: {line[:80]!r}")
+            i += n
+            if slot.size != n:
+                raise ValueError(
+                    f"{path}: dense slot {slot.name!r} needs "
+                    f"{slot.size} values (shape {slot.sample_shape}), "
+                    f"got {n}")
+            arr = np.asarray(vals, dtype=slot.dtype)
+            out.append(arr.reshape(slot.sample_shape) if slot.sample_shape
+                       else arr.reshape(()))
+        return out
+
+    def _iter_samples(self):
+        if not self.slots:
+            raise RuntimeError("call set_use_var(...) before reading")
+        for path in self.filelist:
+            for line in self._read_file_lines(path):
+                yield self._parse_line(line, path)
+
+    def _batches(self, samples, drop_last=True):
+        buf = []
+        self.last_dropped = 0
+        for s in samples:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield self._stack(buf)
+                buf = []
+        if buf:
+            if drop_last:
+                # static programs bake concrete feed shapes, so a ragged
+                # tail can't run through the same executable; record the
+                # drop so the executor can say so out loud
+                self.last_dropped = len(buf)
+            else:
+                yield self._stack(buf)
+
+    def _stack(self, buf):
+        return {slot.name: np.stack([row[j] for row in buf])
+                for j, slot in enumerate(self.slots)}
+
+    def iter_batches(self, drop_last=True):
+        """Batched feed dicts {var_name: (B, *sample_shape) array}."""
+        yield from self._batches(self._iter_samples(), drop_last=drop_last)
+
+
+class QueueDataset(DatasetBase):
+    """ref dataset.py:847 — streaming: every pass re-reads the files."""
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset streams files and cannot shuffle; use "
+            "InMemoryDataset.local_shuffle (ref dataset.py:897 raises "
+            "the same way)")
+
+    def global_shuffle(self, fleet=None):
+        self.local_shuffle()
+
+
+class InMemoryDataset(DatasetBase):
+    """ref dataset.py:325 — load once, shuffle in memory."""
+
+    def __init__(self):
+        super().__init__()
+        self._seed = None
+
+    def load_into_memory(self):
+        self._rows = list(self._iter_samples())
+
+    def set_shuffle_seed(self, seed):
+        self._seed = int(seed)
+
+    def local_shuffle(self):
+        if self._rows is None:
+            raise RuntimeError("call load_into_memory() first")
+        rng = np.random.RandomState(self._seed)
+        rng.shuffle(self._rows)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single-host collective world: global == local (the PS fleet
+        # shuffle service is descoped, SURVEY §4b)
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._rows = None
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._rows or [])
+
+    def iter_batches(self, drop_last=True):
+        rows = self._rows if self._rows is not None \
+            else self._iter_samples()
+        yield from self._batches(iter(rows), drop_last=drop_last)

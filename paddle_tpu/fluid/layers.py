@@ -30,7 +30,31 @@ for _src in (_ops, _F):
 # decode / beam API lives in inference
 from ..inference.decoder import (dynamic_decode, BeamSearchDecoder,  # noqa: F401,E402
                                  Decoder, beam_search, greedy_search)
-from ..metrics import accuracy, Auc  # noqa: F401,E402
+from ..metrics import Auc  # noqa: F401,E402
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Graph-compatible top-k batch accuracy (ref: the accuracy op in
+    layers/metric_op.py:31): built from ops, so it records into a static
+    Program (the book-example `acc = layers.accuracy(prob, label)`
+    fetched per batch) and also runs eagerly. The host-side numpy
+    variant with fluid top_k tie semantics stays at
+    ``paddle_tpu.metrics.accuracy``."""
+    from .. import ops as _ops
+
+    if correct is not None or total is not None:
+        import warnings
+
+        warnings.warn(
+            "layers.accuracy(correct=, total=): the running-counter "
+            "outputs are ignored here (stream with metrics.Accuracy "
+            "instead); only the batch accuracy is returned",
+            RuntimeWarning)
+    _, topi = _ops.topk(input, k, axis=-1)
+    lab = _ops.reshape(label, [-1, 1]).astype("int64")
+    hit = _ops.cast(_ops.equal(topi.astype("int64"), lab), "float32")
+    # top-k indices are distinct, so each row hits at most once
+    return _ops.mean(_ops.sum(hit, axis=-1))
 from ..ops.control_flow import (cond, while_loop, case,  # noqa: F401,E402
                                 switch_case)
 

@@ -8,6 +8,11 @@ the execution loop; the io_/runtime shard readers own ingestion). The
 classes survive as plain config containers so fluid-era scripts that
 build them keep importing; anything that would launch the PS trainer
 raises with the descope pointer.
+
+The dataset-driven training path itself is NOT descoped: use
+``fluid.DatasetFactory`` (fluid/dataset.py — real MultiSlot file
+readers) with ``Executor.train_from_dataset`` / ``infer_from_dataset``,
+which consume the same slot files through the compiled program.
 """
 from __future__ import annotations
 

@@ -200,6 +200,70 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return [Tensor(f, _internal=True) for f in fetches]
 
+    # -- dataset-driven loops (ref: executor.py:1436 train_from_dataset /
+    # :1369 infer_from_dataset). The reference hands the dataset to the
+    # C++ device-worker thread pool; here the dataset yields batches of
+    # the program's exact feed shapes and ONE compiled executable
+    # consumes them (thread/debug accepted for source compat).
+    def _run_from_dataset(self, program, dataset, scope, fetch_list,
+                          fetch_info, print_period, fetch_handler):
+        if dataset is None:
+            raise ValueError("dataset is required (build one with "
+                             "fluid.DatasetFactory().create_dataset())")
+        fetch_list = list(fetch_list or [])
+        if fetch_info is not None and len(fetch_info) != len(fetch_list):
+            raise ValueError(
+                f"fetch_info has {len(fetch_info)} entries for "
+                f"{len(fetch_list)} fetch_list variables (reference "
+                "asserts equal lengths)")
+        names = list(fetch_info) if fetch_info else [
+            getattr(v, "name", str(v)) for v in fetch_list]
+        last = None
+        for step, feed in enumerate(dataset.iter_batches()):
+            last = self.run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+            if fetch_list and print_period and \
+                    (step + 1) % print_period == 0:
+                msg = ", ".join(f"{n}={np.asarray(v).ravel()[:4]}"
+                                for n, v in zip(names, last))
+                print(f"[step {step + 1}] {msg}")
+            if fetch_handler is not None and last is not None:
+                fetch_handler.handler(dict(zip(names, last)))
+        dropped = getattr(dataset, "last_dropped", 0)
+        if dropped:
+            import warnings
+
+            warnings.warn(
+                f"train/infer_from_dataset dropped the final partial "
+                f"batch ({dropped} samples): static programs bake "
+                f"concrete feed shapes. Pad the data to a multiple of "
+                f"batch_size={dataset.batch_size} to consume every "
+                "sample", RuntimeWarning)
+        return last
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Run ``dataset`` through ``program`` batch by batch
+        (ref executor.py:1436); a ragged final batch is dropped WITH a
+        RuntimeWarning (static feed shapes are concrete). Returns the
+        last fetch values (the reference returns None; returning the
+        fetches is strictly more useful and costs nothing)."""
+        return self._run_from_dataset(program, dataset, scope, fetch_list,
+                                      fetch_info, print_period,
+                                      fetch_handler)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """ref executor.py:1369 — identical loop; the program simply has
+        no optimizer ops."""
+        return self._run_from_dataset(program, dataset, scope, fetch_list,
+                                      fetch_info, print_period,
+                                      fetch_handler)
+
 
 def build_optimize_ops(optimizer, loss, parameter_list=None):
     """Append backward + optimizer-update ops to the current program

@@ -1,0 +1,206 @@
+"""fluid.dataset (DatasetFactory / InMemoryDataset / QueueDataset) +
+Executor.train_from_dataset / infer_from_dataset
+(ref: python/paddle/fluid/dataset.py:22,325,847; executor.py:1369,1436).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+
+
+def _write_slot_file(path, xs, ys):
+    """MultiSlot format: count-prefixed groups per slot (x then y)."""
+    with open(path, "w") as f:
+        for x, y in zip(xs, ys):
+            vals = " ".join(f"{v:.6f}" for v in x)
+            f.write(f"{len(x)} {vals} 1 {int(y)}\n")
+
+
+def _make_files(tmp_path, n_files=2, rows=32, dim=4, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(dim).astype(np.float32)
+    paths = []
+    for i in range(n_files):
+        xs = rng.randn(rows, dim).astype(np.float32)
+        ys = (xs @ W > 0).astype(np.int64)
+        p = str(tmp_path / f"part-{i}.txt")
+        _write_slot_file(p, xs, ys)
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture
+def static_mode():
+    pt.enable_static()
+    yield
+    pt.disable_static()
+
+
+def _build_program(batch, dim=4):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[batch, dim])
+        y = fluid.data(name="y", shape=[batch], dtype="int64")
+        logits = fluid.layers.fc(x, size=2)
+        import paddle_tpu.nn.functional as F
+
+        loss = F.cross_entropy(logits, y)
+        fluid.optimizer.Adam(learning_rate=5e-2).minimize(loss)
+    return prog, startup, x, y, loss
+
+
+def test_queue_dataset_batches(tmp_path, static_mode):
+    paths = _make_files(tmp_path)
+    prog, startup, x, y, loss = _build_program(batch=8)
+    ds = fluid.DatasetFactory().create_dataset()  # QueueDataset default
+    assert isinstance(ds, fluid.QueueDataset)
+    ds.set_use_var([x, y])
+    ds.set_batch_size(8)
+    ds.set_filelist(paths)
+    batches = list(ds.iter_batches())
+    assert len(batches) == 8  # 64 rows / 8
+    assert batches[0]["x"].shape == (8, 4)
+    assert batches[0]["y"].shape == (8,)
+    assert batches[0]["y"].dtype == np.int64
+
+
+def test_train_from_dataset_learns(tmp_path, static_mode):
+    pt.seed(0)
+    paths = _make_files(tmp_path, n_files=4, rows=64)
+    prog, startup, x, y, loss = _build_program(batch=16)
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_use_var([x, y])
+    ds.set_batch_size(16)
+    ds.set_filelist(paths)
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 256
+    ds.set_shuffle_seed(0)
+    ds.local_shuffle()
+    exe = fluid.Executor()
+    exe.run(startup)
+    first = exe.train_from_dataset(program=prog, dataset=ds,
+                                   fetch_list=[loss], print_period=0)
+    l0 = float(np.asarray(first[0]))
+    for _ in range(5):
+        last = exe.train_from_dataset(program=prog, dataset=ds,
+                                      fetch_list=[loss], print_period=0)
+    assert float(np.asarray(last[0])) < l0, (l0, last)
+
+
+def test_infer_from_dataset(tmp_path, static_mode):
+    paths = _make_files(tmp_path)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[8, 4])
+        y = fluid.data(name="y", shape=[8], dtype="int64")
+        out = fluid.layers.fc(x, size=2)
+    ds = fluid.DatasetFactory().create_dataset()
+    ds.set_use_var([x, y])
+    ds.set_batch_size(8)
+    ds.set_filelist(paths)
+    exe = fluid.Executor()
+    exe.run(startup)
+    last = exe.infer_from_dataset(program=prog, dataset=ds,
+                                  fetch_list=[out], print_period=0)
+    assert np.asarray(last[0]).shape == (8, 2)
+
+
+def test_pipe_command_streams_files(tmp_path, static_mode):
+    """The reference pipes every file through the user command; verify
+    a real transformation (drop the first line) happens."""
+    paths = _make_files(tmp_path, n_files=1, rows=9)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[4, 4])
+        y = fluid.data(name="y", shape=[4], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset()
+    ds.set_use_var([x, y])
+    ds.set_batch_size(4)
+    ds.set_filelist(paths)
+    ds.set_pipe_command("tail -n +2")  # 9 rows -> 8 -> two 4-batches
+    assert len(list(ds.iter_batches())) == 2
+    ds.set_pipe_command("false")
+    with pytest.raises(RuntimeError, match="pipe_command"):
+        list(ds.iter_batches())
+
+
+def test_queue_dataset_cannot_shuffle(static_mode):
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    with pytest.raises(NotImplementedError):
+        ds.local_shuffle()
+
+
+def test_malformed_slot_line_raises(tmp_path, static_mode):
+    p = str(tmp_path / "bad.txt")
+    with open(p, "w") as f:
+        f.write("4 1.0 2.0 3.0 4.0 1\n")  # y slot count missing values
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[1, 4])
+        y = fluid.data(name="y", shape=[1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset()
+    ds.set_use_var([x, y])
+    ds.set_batch_size(1)
+    ds.set_filelist([p])
+    with pytest.raises(ValueError, match="declares"):
+        list(ds.iter_batches())
+
+
+def test_unknown_datafeed_class_raises(static_mode):
+    with pytest.raises(ValueError, match="does not exist"):
+        fluid.DatasetFactory().create_dataset("NoSuchDataset")
+
+
+def test_layers_accuracy_records_into_program(tmp_path, static_mode):
+    """The book-example pattern: acc = layers.accuracy(prob, label)
+    INSIDE program_guard, fetched per batch (ref layers/metric_op.py:31
+    is a graph op, not a host function)."""
+    import paddle_tpu.nn.functional as F
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[4, 3])
+        y = fluid.data(name="y", shape=[4], dtype="int64")
+        acc = fluid.layers.accuracy(F.softmax(x, axis=-1), y)
+    exe = fluid.Executor()
+    exe.run(startup)
+    logits = np.array([[9, 0, 0], [0, 9, 0], [0, 0, 9], [9, 0, 0]],
+                      np.float32)
+    labels = np.array([0, 1, 2, 1], np.int64)  # 3 of 4 hit
+    (a,) = exe.run(prog, feed={"x": logits, "y": labels},
+                   fetch_list=[acc])
+    assert abs(float(np.asarray(a)) - 0.75) < 1e-6
+
+
+def test_partial_batch_drop_warns(tmp_path, static_mode):
+    paths = _make_files(tmp_path, n_files=1, rows=10)  # 10 % 4 = 2 drop
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[4, 4])
+        y = fluid.data(name="y", shape=[4], dtype="int64")
+        out = fluid.layers.fc(x, size=2)
+    ds = fluid.DatasetFactory().create_dataset()
+    ds.set_use_var([x, y])
+    ds.set_batch_size(4)
+    ds.set_filelist(paths)
+    exe = fluid.Executor()
+    exe.run(startup)
+    with pytest.warns(RuntimeWarning, match="partial batch"):
+        exe.infer_from_dataset(program=prog, dataset=ds,
+                               fetch_list=[out], print_period=0)
+
+
+def test_fetch_info_length_mismatch_raises(tmp_path, static_mode):
+    paths = _make_files(tmp_path, n_files=1, rows=8)
+    prog, startup, x, y, loss = _build_program(batch=8)
+    ds = fluid.DatasetFactory().create_dataset()
+    ds.set_use_var([x, y])
+    ds.set_batch_size(8)
+    ds.set_filelist(paths)
+    exe = fluid.Executor()
+    exe.run(startup)
+    with pytest.raises(ValueError, match="fetch_info"):
+        exe.train_from_dataset(program=prog, dataset=ds,
+                               fetch_list=[loss],
+                               fetch_info=["a", "b"])
