@@ -103,7 +103,7 @@ class DatasetBase:
              f"dtype={np.dtype(s.dtype).name}" for s in self.slots])
 
     # -- reading -----------------------------------------------------------
-    def _read_file_lines(self, path):
+    def _read_file_bytes(self, path):
         if self.pipe_command and self.pipe_command != "cat":
             # the reference streams every file through the user's pipe
             # command; same here (stdin=file, stdout=samples)
@@ -115,11 +115,9 @@ class DatasetBase:
                 raise RuntimeError(
                     f"pipe_command {self.pipe_command!r} failed on "
                     f"{path}: {proc.stderr.decode()[:500]}")
-            text = proc.stdout.decode()
-        else:
-            with open(path) as f:
-                text = f.read()
-        return [ln for ln in text.splitlines() if ln.strip()]
+            return proc.stdout
+        with open(path, "rb") as f:
+            return f.read()
 
     def _parse_line(self, line, path):
         toks = line.split()
@@ -146,14 +144,45 @@ class DatasetBase:
             arr = np.asarray(vals, dtype=slot.dtype)
             out.append(arr.reshape(slot.sample_shape) if slot.sample_shape
                        else arr.reshape(()))
+        if i != len(toks):
+            # reference MultiSlotDataFeed: a line must contain exactly
+            # its slots (same strictness as the native parser)
+            raise ValueError(
+                f"{path}: {len(toks) - i} trailing tokens after the "
+                f"last slot: {line[:80]!r}")
         return out
 
     def _iter_samples(self):
         if not self.slots:
             raise RuntimeError("call set_use_var(...) before reading")
         for path in self.filelist:
-            for line in self._read_file_lines(path):
-                yield self._parse_line(line, path)
+            raw = self._read_file_bytes(path)
+            native = self._parse_native(raw, path)
+            if native is not None:
+                n = native[0].shape[0] if native else 0
+                for j in range(n):
+                    yield [native[i][j].reshape(s.sample_shape)
+                           for i, s in enumerate(self.slots)]
+                continue
+            for line in raw.decode().splitlines():
+                if line.strip():
+                    yield self._parse_line(line, path)
+
+    def _parse_native(self, raw, path):
+        """C++ MultiSlot parser (runtime/cc pt_multislot_parse — the
+        reference data_feed.cc role) over the RAW file bytes, so format
+        errors carry real line numbers; None -> Python fallback."""
+        try:
+            from ..runtime import multislot_parse
+
+            out = multislot_parse(
+                raw, [s.size for s in self.slots],
+                [s.dtype == np.float32 for s in self.slots])
+        except ValueError as e:
+            raise ValueError(f"{path}: {e}") from None
+        except Exception:
+            return None
+        return out
 
     def _batches(self, samples, drop_last=True):
         buf = []

@@ -95,6 +95,11 @@ def get_lib():
         lib.pt_shuffle_len.argtypes = [ctypes.c_void_p]
         lib.pt_shuffle_close.argtypes = [ctypes.c_void_p]
         lib.pt_shuffle_free.argtypes = [ctypes.c_void_p]
+        lib.pt_multislot_parse.restype = ctypes.c_long
+        lib.pt_multislot_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_long]
         _lib = lib
         return _lib
 
@@ -429,3 +434,34 @@ class ShufflePool:
             self._lib.pt_shuffle_free(h)
         except Exception:
             pass
+
+
+def multislot_parse(text, slot_sizes, slot_is_float):
+    """Native MultiSlot sample parsing (the reference data_feed.cc role:
+    MultiSlotDataFeed::ParseOneInstance). ``text``: bytes of one file's
+    samples; returns a list of sample-major arrays, one per slot
+    (float32 or int64, shape (n_samples, slot_size)), or None when the
+    native library is unavailable (caller falls back to Python parsing).
+    Raises ValueError with the 0-based line index on a format error.
+    """
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    if isinstance(text, str):
+        text = text.encode()
+    # upper bound on samples: number of newlines + 1
+    max_samples = text.count(b"\n") + 1
+    n = len(slot_sizes)
+    sizes = (ctypes.c_long * n)(*[int(s) for s in slot_sizes])
+    isf = (ctypes.c_int * n)(*[1 if f else 0 for f in slot_is_float])
+    bufs = [np.empty((max_samples, int(sz)),
+                     np.float32 if f else np.int64)
+            for sz, f in zip(slot_sizes, slot_is_float)]
+    outs = (ctypes.c_void_p * n)(*[b.ctypes.data for b in bufs])
+    got = lib.pt_multislot_parse(text, len(text), n, sizes, isf, outs,
+                                 max_samples)
+    if got < 0:
+        raise ValueError(f"malformed MultiSlot sample at line {-got - 1}")
+    return [b[:got] for b in bufs]

@@ -460,3 +460,80 @@ void pt_shuffle_free(PtShufflePool* p) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// MultiSlot sample parser (the reference's C++ data_feed.cc role:
+// MultiSlotDataFeed::ParseOneInstance). One sample per line; per slot a
+// count-prefixed group of values. Dense slots must match slot_sizes[i].
+// ---------------------------------------------------------------------------
+
+#include <cstdlib>
+
+extern "C" {
+
+// Parses samples from text[0..len). outs[i] receives slot i's values,
+// sample-major: float32 buffers when slot_is_float[i], int64 otherwise;
+// each caller-allocated with capacity max_samples * slot_sizes[i].
+// text must be NUL-terminated (CPython bytes are) — strtol/strtof stop
+// there. Tokens NEVER cross a newline: a line with too few values is a
+// format error, not a frame-shifted read into the next sample; trailing
+// extra tokens on a line are an error too (reference MultiSlotDataFeed
+// semantics). Blank / whitespace-only lines are skipped. Returns the
+// number of samples parsed, or -(line_index+1) on a format error at
+// that (0-based, raw-text) line.
+long pt_multislot_parse(const char* text, size_t len, int n_slots,
+                        const long* slot_sizes, const int* slot_is_float,
+                        void** outs, long max_samples) {
+  const char* p = text;
+  const char* end = text + len;
+  long sample = 0;
+  long line = 0;
+  auto skip_sp = [&](const char* q) {
+    while (q < end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+    return q;
+  };
+  while (p < end && sample < max_samples) {
+    // skip blank / whitespace-only lines (counting them)
+    for (;;) {
+      p = skip_sp(p);
+      if (p < end && *p == '\n') {
+        ++line;
+        ++p;
+        continue;
+      }
+      break;
+    }
+    if (p >= end) break;
+    for (int s = 0; s < n_slots; ++s) {
+      p = skip_sp(p);
+      if (p >= end || *p == '\n') return -(line + 1);  // missing count
+      char* next = nullptr;
+      long n = std::strtol(p, &next, 10);
+      if (next == p) return -(line + 1);
+      p = next;
+      if (n != slot_sizes[s]) return -(line + 1);  // dense-size mismatch
+      for (long j = 0; j < n; ++j) {
+        p = skip_sp(p);
+        if (p >= end || *p == '\n') return -(line + 1);  // short line
+        if (slot_is_float[s]) {
+          static_cast<float*>(outs[s])[sample * n + j] =
+              std::strtof(p, &next);
+        } else {
+          static_cast<long long*>(outs[s])[sample * n + j] =
+              std::strtoll(p, &next, 10);
+        }
+        if (next == p) return -(line + 1);
+        p = next;
+      }
+    }
+    // only whitespace may remain on the line
+    p = skip_sp(p);
+    if (p < end && *p != '\n') return -(line + 1);  // trailing tokens
+    if (p < end) ++p;
+    ++line;
+    ++sample;
+  }
+  return sample;
+}
+
+}  // extern "C"

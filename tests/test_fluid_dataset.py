@@ -143,7 +143,9 @@ def test_malformed_slot_line_raises(tmp_path, static_mode):
     ds.set_use_var([x, y])
     ds.set_batch_size(1)
     ds.set_filelist([p])
-    with pytest.raises(ValueError, match="declares"):
+    # native parser says "malformed ... at line N", the Python
+    # fallback names the slot; both carry the file path
+    with pytest.raises(ValueError, match="declares|malformed"):
         list(ds.iter_batches())
 
 
@@ -204,3 +206,90 @@ def test_fetch_info_length_mismatch_raises(tmp_path, static_mode):
         exe.train_from_dataset(program=prog, dataset=ds,
                                fetch_list=[loss],
                                fetch_info=["a", "b"])
+
+
+def test_native_and_python_parsers_agree(tmp_path, static_mode):
+    """runtime/cc pt_multislot_parse must produce byte-identical batches
+    to the Python fallback parser."""
+    from paddle_tpu.runtime import multislot_parse
+
+    if multislot_parse(b"1 1\n", [1], [True]) is None:
+        pytest.skip("native runtime unavailable")
+    paths = _make_files(tmp_path, n_files=2, rows=17)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[1, 4])
+        y = fluid.data(name="y", shape=[1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset()
+    ds.set_use_var([x, y])
+    ds.set_batch_size(1)
+    ds.set_filelist(paths)
+    native = [(b["x"].copy(), b["y"].copy()) for b in ds.iter_batches()]
+    # force the Python path
+    ds._parse_native = lambda text, path: None
+    python = [(b["x"].copy(), b["y"].copy()) for b in ds.iter_batches()]
+    assert len(native) == len(python) == 34
+    for (nx, ny), (px, py) in zip(native, python):
+        np.testing.assert_array_equal(nx, px)
+        np.testing.assert_array_equal(ny, py)
+        assert nx.dtype == px.dtype and ny.dtype == py.dtype
+
+
+def test_short_line_never_frame_shifts(tmp_path, static_mode):
+    """A line missing its value must ERROR in both parsers — never
+    silently consume tokens from the next line (data corruption)."""
+    p = str(tmp_path / "short.txt")
+    with open(p, "w") as f:
+        f.write("1\n5\n")  # line 0 declares 1 value but has none
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        y = fluid.data(name="y", shape=[1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset()
+    ds.set_use_var([y])
+    ds.set_batch_size(1)
+    ds.set_filelist([p])
+    with pytest.raises(ValueError):
+        list(ds.iter_batches())
+    ds._parse_native = lambda raw, path: None  # python fallback
+    with pytest.raises(ValueError):
+        list(ds.iter_batches())
+
+
+def test_trailing_tokens_error_in_both_parsers(tmp_path, static_mode):
+    p = str(tmp_path / "trail.txt")
+    with open(p, "w") as f:
+        f.write("1 2.0 1 7 9\n")  # leftover '9'
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[1, 1])
+        y = fluid.data(name="y", shape=[1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset()
+    ds.set_use_var([x, y])
+    ds.set_batch_size(1)
+    ds.set_filelist([p])
+    with pytest.raises(ValueError):
+        list(ds.iter_batches())
+    ds._parse_native = lambda raw, path: None
+    with pytest.raises(ValueError, match="trailing"):
+        list(ds.iter_batches())
+
+
+def test_blank_lines_skipped_and_line_numbers_raw(tmp_path, static_mode):
+    """Blank/whitespace-only lines are skipped by both parsers, and the
+    native error reports the RAW file line number."""
+    from paddle_tpu.runtime import multislot_parse
+
+    p = str(tmp_path / "blanks.txt")
+    with open(p, "w") as f:
+        f.write("1 7\n\n   \n1 8\n")
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        y = fluid.data(name="y", shape=[2], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset()
+    ds.set_use_var([y])
+    ds.set_batch_size(2)
+    (b,) = list(ds.set_filelist([p]) or ds.iter_batches())
+    assert b["y"].tolist() == [7, 8]
+    if multislot_parse(b"1 1\n", [1], [True]) is not None:
+        with pytest.raises(ValueError, match="line 3"):
+            multislot_parse(b"1 7\n\n   \n1 bad\n", [1], [False])
