@@ -26,7 +26,7 @@ from .core.dtype import (
 )
 from .core.device import (
     set_device, get_device, device_count, is_compiled_with_tpu,
-    TPUPlace, CPUPlace, CUDAPlace, Place,
+    TPUPlace, CPUPlace, CUDAPlace, Place, set_compilation_cache,
 )
 from .core.random import seed
 

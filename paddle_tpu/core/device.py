@@ -93,3 +93,26 @@ def device_count(kind: str = None) -> int:
 
 def is_compiled_with_tpu() -> bool:
     return any(_kind_of(d) == "tpu" for d in jax.devices())
+
+
+def set_compilation_cache(directory, min_compile_time_secs=1.0):
+    """Persist compiled XLA executables across processes (the TPU analog
+    of the reference's program/kernel caches): every jit/pjit whose
+    compile took >= ``min_compile_time_secs`` is stored under
+    ``directory`` and reloaded on the next run — first-step latency on a
+    tunnel-attached chip drops from tens of seconds to cache-read time.
+    Pass ``None`` to disable. Returns the directory."""
+    import jax
+
+    if directory is None:
+        jax.config.update("jax_enable_compilation_cache", False)
+        return None
+    import os
+
+    directory = os.path.abspath(str(directory))
+    os.makedirs(directory, exist_ok=True)
+    jax.config.update("jax_enable_compilation_cache", True)
+    jax.config.update("jax_compilation_cache_dir", directory)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_time_secs))
+    return directory

@@ -39,67 +39,77 @@ def get_lib():
                     os.path.getmtime(_SO) < os.path.getmtime(_SRC):
                 _build()
             lib = ctypes.CDLL(_SO)
+            if not hasattr(lib, "pt_multislot_parse"):
+                # stale .so from older source with equal/newer mtime
+                # (docker COPY / zip extraction): rebuild once
+                _build()
+                lib = ctypes.CDLL(_SO)
         except Exception:
             return None
-        # signatures
-        lib.pt_arena_new.restype = ctypes.c_void_p
-        lib.pt_arena_new.argtypes = [ctypes.c_size_t]
-        lib.pt_arena_alloc.restype = ctypes.c_void_p
-        lib.pt_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
-        lib.pt_arena_reset.argtypes = [ctypes.c_void_p]
-        lib.pt_arena_free.argtypes = [ctypes.c_void_p]
-        lib.pt_arena_stats.argtypes = [ctypes.c_void_p] + \
-            [ctypes.POINTER(ctypes.c_uint64)] * 4
-        lib.pt_ring_new.restype = ctypes.c_void_p
-        lib.pt_ring_new.argtypes = [ctypes.c_size_t]
-        lib.pt_ring_push.restype = ctypes.c_int
-        lib.pt_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                     ctypes.c_size_t]
-        lib.pt_ring_pop.restype = ctypes.c_int
-        lib.pt_ring_pop.argtypes = [ctypes.c_void_p,
-                                    ctypes.POINTER(ctypes.c_void_p),
-                                    ctypes.POINTER(ctypes.c_size_t),
-                                    ctypes.c_long]
-        lib.pt_blob_free.argtypes = [ctypes.c_void_p]
-        lib.pt_ring_close.argtypes = [ctypes.c_void_p]
-        lib.pt_ring_len.restype = ctypes.c_size_t
-        lib.pt_ring_len.argtypes = [ctypes.c_void_p]
-        lib.pt_ring_free.argtypes = [ctypes.c_void_p]
-        lib.pt_rec_writer_open.restype = ctypes.c_void_p
-        lib.pt_rec_writer_open.argtypes = [ctypes.c_char_p]
-        lib.pt_rec_write.restype = ctypes.c_int
-        lib.pt_rec_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                     ctypes.c_uint32]
-        lib.pt_rec_writer_close.restype = ctypes.c_uint64
-        lib.pt_rec_writer_close.argtypes = [ctypes.c_void_p]
-        lib.pt_shard_reader_start.restype = ctypes.c_void_p
-        lib.pt_shard_reader_start.argtypes = [
-            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
-            ctypes.c_size_t]
-        lib.pt_shard_reader_ring.restype = ctypes.c_void_p
-        lib.pt_shard_reader_ring.argtypes = [ctypes.c_void_p]
-        lib.pt_shard_reader_errors.restype = ctypes.c_int
-        lib.pt_shard_reader_errors.argtypes = [ctypes.c_void_p]
-        lib.pt_shard_reader_free.argtypes = [ctypes.c_void_p]
-        lib.pt_shuffle_new.restype = ctypes.c_void_p
-        lib.pt_shuffle_new.argtypes = [ctypes.c_size_t, ctypes.c_uint64]
-        lib.pt_shuffle_push.restype = ctypes.c_int
-        lib.pt_shuffle_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                        ctypes.c_size_t]
-        lib.pt_shuffle_pop.restype = ctypes.c_int
-        lib.pt_shuffle_pop.argtypes = [ctypes.c_void_p,
-                                       ctypes.POINTER(ctypes.c_void_p),
-                                       ctypes.POINTER(ctypes.c_size_t),
-                                       ctypes.c_size_t, ctypes.c_long]
-        lib.pt_shuffle_len.restype = ctypes.c_size_t
-        lib.pt_shuffle_len.argtypes = [ctypes.c_void_p]
-        lib.pt_shuffle_close.argtypes = [ctypes.c_void_p]
-        lib.pt_shuffle_free.argtypes = [ctypes.c_void_p]
-        lib.pt_multislot_parse.restype = ctypes.c_long
-        lib.pt_multislot_parse.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_void_p), ctypes.c_long]
+        # signatures (a missing symbol means an unusable lib:
+        # fall back to pure Python rather than crash consumers)
+        try:
+            # signatures
+            lib.pt_arena_new.restype = ctypes.c_void_p
+            lib.pt_arena_new.argtypes = [ctypes.c_size_t]
+            lib.pt_arena_alloc.restype = ctypes.c_void_p
+            lib.pt_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+            lib.pt_arena_reset.argtypes = [ctypes.c_void_p]
+            lib.pt_arena_free.argtypes = [ctypes.c_void_p]
+            lib.pt_arena_stats.argtypes = [ctypes.c_void_p] + \
+                [ctypes.POINTER(ctypes.c_uint64)] * 4
+            lib.pt_ring_new.restype = ctypes.c_void_p
+            lib.pt_ring_new.argtypes = [ctypes.c_size_t]
+            lib.pt_ring_push.restype = ctypes.c_int
+            lib.pt_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_size_t]
+            lib.pt_ring_pop.restype = ctypes.c_int
+            lib.pt_ring_pop.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_void_p),
+                                        ctypes.POINTER(ctypes.c_size_t),
+                                        ctypes.c_long]
+            lib.pt_blob_free.argtypes = [ctypes.c_void_p]
+            lib.pt_ring_close.argtypes = [ctypes.c_void_p]
+            lib.pt_ring_len.restype = ctypes.c_size_t
+            lib.pt_ring_len.argtypes = [ctypes.c_void_p]
+            lib.pt_ring_free.argtypes = [ctypes.c_void_p]
+            lib.pt_rec_writer_open.restype = ctypes.c_void_p
+            lib.pt_rec_writer_open.argtypes = [ctypes.c_char_p]
+            lib.pt_rec_write.restype = ctypes.c_int
+            lib.pt_rec_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_uint32]
+            lib.pt_rec_writer_close.restype = ctypes.c_uint64
+            lib.pt_rec_writer_close.argtypes = [ctypes.c_void_p]
+            lib.pt_shard_reader_start.restype = ctypes.c_void_p
+            lib.pt_shard_reader_start.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+                ctypes.c_size_t]
+            lib.pt_shard_reader_ring.restype = ctypes.c_void_p
+            lib.pt_shard_reader_ring.argtypes = [ctypes.c_void_p]
+            lib.pt_shard_reader_errors.restype = ctypes.c_int
+            lib.pt_shard_reader_errors.argtypes = [ctypes.c_void_p]
+            lib.pt_shard_reader_free.argtypes = [ctypes.c_void_p]
+            lib.pt_shuffle_new.restype = ctypes.c_void_p
+            lib.pt_shuffle_new.argtypes = [ctypes.c_size_t, ctypes.c_uint64]
+            lib.pt_shuffle_push.restype = ctypes.c_int
+            lib.pt_shuffle_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                            ctypes.c_size_t]
+            lib.pt_shuffle_pop.restype = ctypes.c_int
+            lib.pt_shuffle_pop.argtypes = [ctypes.c_void_p,
+                                           ctypes.POINTER(ctypes.c_void_p),
+                                           ctypes.POINTER(ctypes.c_size_t),
+                                           ctypes.c_size_t, ctypes.c_long]
+            lib.pt_shuffle_len.restype = ctypes.c_size_t
+            lib.pt_shuffle_len.argtypes = [ctypes.c_void_p]
+            lib.pt_shuffle_close.argtypes = [ctypes.c_void_p]
+            lib.pt_shuffle_free.argtypes = [ctypes.c_void_p]
+            lib.pt_multislot_parse.restype = ctypes.c_long
+            lib.pt_multislot_parse.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_long]
+        except AttributeError:
+            return None
         _lib = lib
         return _lib
 

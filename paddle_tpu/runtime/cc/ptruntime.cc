@@ -489,7 +489,10 @@ long pt_multislot_parse(const char* text, size_t len, int n_slots,
   long sample = 0;
   long line = 0;
   auto skip_sp = [&](const char* q) {
-    while (q < end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+    // every non-newline whitespace strtol/strtof would skip must be
+    // consumed HERE, or a token could silently cross the '\n' check
+    while (q < end && (*q == ' ' || *q == '\t' || *q == '\r' ||
+                       *q == '\v' || *q == '\f')) ++q;
     return q;
   };
   while (p < end && sample < max_samples) {

@@ -241,3 +241,25 @@ class TestReviewRegressions:
         X = np.ones((2, 3), "float32")
         r = exe.run(prog, feed={feeds[0]: X}, fetch_list=fetches)[0]
         assert r.shape == (2, 3)
+
+
+def test_set_compilation_cache_persists_executables(tmp_path):
+    """pt.set_compilation_cache(dir) must actually write compiled
+    executables to disk (the cross-process warm-start path bench.py
+    uses on hardware)."""
+    import os
+
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+
+    d = str(tmp_path / "xla_cache")
+    try:
+        assert pt.set_compilation_cache(d, min_compile_time_secs=0.0) == d
+        m = nn.Linear(64, 32)
+        opt = pt.optim.SGD(parameters=m.parameters(), learning_rate=0.1)
+        step = pt.TrainStep(m, opt,
+                            lambda mm, x, y: ((mm(x) - y) ** 2).mean())
+        step(np.zeros((8, 64), "float32"), np.zeros((8, 32), "float32"))
+        assert os.listdir(d), "no executables persisted"
+    finally:
+        pt.set_compilation_cache(None)
