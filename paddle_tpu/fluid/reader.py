@@ -119,7 +119,28 @@ class DataLoader(_ModernDataLoader):
 
     @staticmethod
     def from_dataset(dataset, places=None, drop_last=True):
+        """ref reader.py:437 — loader over a ``fluid.dataset`` slot-file
+        Dataset (iterates its already-batched feed dicts); a paddle.io
+        map-style Dataset gets the modern loader."""
+        from .dataset import DatasetBase
+
+        if isinstance(dataset, DatasetBase):
+            return _SlotDatasetLoader(dataset, drop_last)
         return _ModernDataLoader(dataset, drop_last=drop_last)
+
+
+class _SlotDatasetLoader:
+    """Loader face over a fluid.dataset slot-file Dataset: each
+    iteration restarts the dataset's batch stream."""
+
+    def __init__(self, dataset, drop_last):
+        self._dataset = dataset
+        self._drop_last = drop_last
+
+    def __iter__(self):
+        return self._dataset.iter_batches(drop_last=self._drop_last)
+
+    __call__ = __iter__
 
 
 class PyReader(GeneratorLoader):

@@ -293,3 +293,21 @@ def test_blank_lines_skipped_and_line_numbers_raw(tmp_path, static_mode):
     if multislot_parse(b"1 1\n", [1], [True]) is not None:
         with pytest.raises(ValueError, match="line 3"):
             multislot_parse(b"1 7\n\n   \n1 bad\n", [1], [False])
+
+
+def test_dataloader_from_dataset(tmp_path, static_mode):
+    """ref reader.py:437 DataLoader.from_dataset over a slot-file
+    Dataset yields executor-ready feed dicts."""
+    paths = _make_files(tmp_path, n_files=1, rows=16)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[8, 4])
+        y = fluid.data(name="y", shape=[8], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset()
+    ds.set_use_var([x, y])
+    ds.set_batch_size(8)
+    ds.set_filelist(paths)
+    loader = fluid.io.DataLoader.from_dataset(ds)
+    feeds = list(loader())
+    assert len(feeds) == 2
+    assert feeds[0]["x"].shape == (8, 4)
