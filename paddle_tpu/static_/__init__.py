@@ -57,3 +57,35 @@ class _DynamicTracer(ProgramTracer):
     @program.setter
     def program(self, v):
         pass
+
+
+# 2.x paddle.static surface: the op-level builders live in fluid.layers;
+# expose the common ones here so static-mode scripts written either way
+# resolve (paddle.static.nn.fc == fluid.layers.fc, etc.)
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """ref: paddle.static.create_parameter (impl: fluid.layers).
+    ``is_bias`` forwards: bias parameters initialize to zero."""
+    from ..fluid.layers import create_parameter as _cp
+
+    return _cp(shape, dtype=dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def _nn_namespace():
+    import types
+
+    from ..fluid import layers as _layers
+
+    ns = types.SimpleNamespace()
+    for name in ("fc", "conv2d", "conv3d", "batch_norm", "layer_norm",
+                 "embedding", "sequence_conv", "conv2d_transpose",
+                 "deformable_conv", "group_norm", "instance_norm",
+                 "nce", "prelu", "row_conv", "spectral_norm",
+                 "multi_box_head"):
+        if hasattr(_layers, name):
+            setattr(ns, name, getattr(_layers, name))
+    return ns
+
+
+nn = _nn_namespace()

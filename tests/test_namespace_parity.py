@@ -155,3 +155,28 @@ def test_reference_namespace_all_resolved():
             dyg |= get_all(base + "dygraph/" + f)
     missing = sorted(n for n in dyg if n not in set(dir(D2)))
     assert missing == [], f"dygraph: {missing}"
+
+
+def test_static_2x_surface():
+    """paddle.static.create_parameter / static.nn.* resolve and build
+    (2.x static spellings next to the fluid ones)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+
+    pt.enable_static()
+    try:
+        main, startup = pt.static.Program(), pt.static.Program()
+        with pt.program_guard(main, startup):
+            x = pt.static.data("x", [4, 8])
+            w = pt.static.create_parameter([8, 2])
+            h = pt.static.nn.fc(x, size=2)
+        exe = pt.static.Executor()
+        exe.run(startup)
+        (o,) = exe.run(main, feed={"x": np.ones((4, 8), "float32")},
+                       fetch_list=[h])
+        assert np.asarray(o).shape == (4, 2)
+        assert callable(pt.static.nn.conv2d)
+        assert callable(pt.static.nn.batch_norm)
+    finally:
+        pt.disable_static()
