@@ -3,7 +3,7 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
-Workloads (all 5 BASELINE.json configs):
+Workloads (the 5 BASELINE.json configs + one serving extra):
   - BERT-Base pretrain step, seq 128 (headline: tokens/sec/chip)
   - ResNet-50 train step (imgs/sec/chip)
   - GPT-2-small train step, seq 1024 (tokens/sec/chip + MFU)
@@ -11,6 +11,8 @@ Workloads (all 5 BASELINE.json configs):
     lax.while_loop decode; output tokens/sec + per-sentence latency)
   - MNIST LeNet static Program/Executor train step (imgs/sec incl.
     host feed/fetch — the static-path overhead measurement)
+  - LeNet int8-bundle Predictor serving (imgs/sec int8 vs fp32 +
+    max prob diff -> int8_imgs_per_sec / int8_vs_fp32 extras)
 
 All run the fused donated TrainStep (fwd+bwd+clip+update in one XLA
 executable), bf16 params with f32 master weights — the standard TPU
@@ -218,6 +220,56 @@ def bench_wmt_beam(B=16, L_src=32, beam=4, max_len=32):
             "latency_ms_per_batch": dt * 1e3, "beam": beam}
 
 
+def bench_int8_predictor(B=256):
+    """LeNet served via the int8 bundle (save -> quantize_inference_model
+    -> Predictor): imgs/sec int8 vs fp32 through the same Predictor path.
+    The int8 copy is HBM-resident with the dequant fused into the
+    consumer — on small models this measures dispatch + weight-traffic,
+    the serving overhead axis."""
+    import tempfile
+
+    import paddle_tpu as pt
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.inference import Predictor
+    from paddle_tpu.models.vision import LeNet
+    from paddle_tpu.quant import quantize_inference_model
+
+    pt.seed(0)
+    pt.enable_static()
+    try:
+        main, startup = pt.static.Program(), pt.static.Program()
+        with pt.program_guard(main, startup):
+            xv = pt.static.data("x", [B, 1, 28, 28], "float32")
+            prob = F.softmax(LeNet()(xv), axis=-1)
+    finally:
+        pt.disable_static()
+    exe = pt.static.Executor()
+    exe.run(startup)
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "lenet")
+        pt.framework.io.save_inference_model(prefix, ["x"], [prob],
+                                             program=main)
+        quantize_inference_model(prefix)
+        p32 = Predictor(prefix)
+        p8 = Predictor(prefix + "_int8")
+        x = np.random.RandomState(0).randn(B, 1, 28, 28).astype("float32")
+        warmup, iters = (1, 2) if SMOKE else (3, 20)
+
+        def rate(pred):
+            for _ in range(warmup):
+                pred.run({"x": x})
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out, = pred.run({"x": x})
+            return B / ((time.perf_counter() - t0) / iters), out
+
+        r32, o32 = rate(p32)
+        r8, o8 = rate(p8)
+        return {"imgs_per_sec_int8": r8, "imgs_per_sec_fp32": r32,
+                "int8_vs_fp32": r8 / r32 if r32 else 0.0,
+                "max_prob_diff": float(np.abs(o32 - o8).max())}
+
+
 def bench_lenet_exec(B=256):
     """MNIST LeNet through the static Program/Executor feed/fetch loop
     (BASELINE config 1) — measures compiled-program dispatch + host
@@ -335,7 +387,7 @@ def _run_benches(results):
     """Mutates `results` in place so legs finished before a watchdog
     deadline still reach the JSON line."""
     global bench_bert, bench_resnet50, bench_gpt, bench_wmt_beam, \
-        bench_lenet_exec
+        bench_lenet_exec, bench_int8_predictor
     if SMOKE:
         import functools
 
@@ -345,9 +397,11 @@ def _run_benches(results):
         bench_wmt_beam = functools.partial(bench_wmt_beam, B=2, L_src=8,
                                            beam=2, max_len=8)
         bench_lenet_exec = functools.partial(bench_lenet_exec, B=8)
+        bench_int8_predictor = functools.partial(bench_int8_predictor, B=8)
     for name, fn in (("bert", bench_bert), ("resnet50", bench_resnet50),
                      ("gpt", bench_gpt), ("wmt_beam", bench_wmt_beam),
-                     ("lenet_exec", bench_lenet_exec)):
+                     ("lenet_exec", bench_lenet_exec),
+                     ("int8_predictor", bench_int8_predictor)):
         pallas_env0 = os.environ.get("PADDLE_TPU_PALLAS")
         for attempt in (1, 2, 3):
             try:
@@ -542,6 +596,13 @@ def _score(results, headline, extras):
         extras["lenet_exec_vs_baseline"] = round(
             results["lenet_exec"]["imgs_per_sec"] / BASELINE_LENET_IMGS_S,
             3)
+    if "int8_predictor" in results:
+        extras["int8_imgs_per_sec"] = round(
+            results["int8_predictor"]["imgs_per_sec_int8"], 1)
+        extras["int8_vs_fp32"] = round(
+            results["int8_predictor"]["int8_vs_fp32"], 3)
+        extras["int8_max_prob_diff"] = round(
+            results["int8_predictor"]["max_prob_diff"], 5)
     return {**headline, **extras}
 
 
