@@ -213,3 +213,11 @@ from . import transpiler  # noqa: F401,E402
 from .transpiler import (DistributeTranspiler,  # noqa: F401,E402
                          DistributeTranspilerConfig, memory_optimize,
                          release_memory)
+
+# fluid-era submodule names (fluid.core / framework / executor / ...):
+# installed last so every implementation they alias already exists
+import sys as _sys  # noqa: E402
+
+from . import modules_compat as _modules_compat  # noqa: E402
+
+_modules_compat.install(_sys.modules[__name__])

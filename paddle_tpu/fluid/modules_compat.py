@@ -1,0 +1,160 @@
+"""Fluid-era submodule names (ref: python/paddle/fluid/__init__.py:34-84).
+
+Reference scripts import these as MODULES — ``from paddle.fluid import
+core``, ``fluid.framework.default_main_program()``,
+``fluid.executor.global_scope()`` — rather than through the flat fluid
+namespace. Each is a small real module face over the implementation's
+actual home, registered under the dotted name so both attribute access
+and ``import paddle_tpu.fluid.core`` work. Built here (not as .py files)
+because several names collide with top-level packages
+(paddle_tpu.framework is the jit/io package; fluid.framework is the
+Program surface).
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+__all__ = ["install"]
+
+
+def _module(name, doc, members):
+    m = types.ModuleType(name, doc)
+    for k, v in members.items():
+        setattr(m, k, v)
+    sys.modules[name] = m
+    return m
+
+
+def install(fluid_pkg):
+    """Create and attach the compat submodules onto the fluid package."""
+    base = fluid_pkg.__name__
+
+    from ..core.device import CPUPlace, CUDAPlace, TPUPlace
+    from ..core.tensor import Tensor
+    from ..static_ import (CompiledProgram, BuildStrategy,
+                           ExecutionStrategy, Executor, Program, Scope,
+                           Variable, default_main_program,
+                           default_startup_program, global_scope,
+                           name_scope, scope_guard)
+    from ..static_.compiler import ParallelExecutor
+    from .lod_tensor import (LoDTensor, LoDTensorArray, create_lod_tensor,
+                             create_random_int_lodtensor)
+
+    framework = _module(
+        base + ".framework",
+        "fluid.framework (ref framework.py): the Program surface.",
+        dict(Program=Program, Variable=Variable, Parameter=Variable,
+             default_main_program=default_main_program,
+             default_startup_program=default_startup_program,
+             # the PACKAGE-level guard (it switches static mode on for
+             # the block — fluid-era scripts never call enable_static)
+             program_guard=fluid_pkg.program_guard,
+             name_scope=name_scope,
+             in_dygraph_mode=fluid_pkg.in_dygraph_mode,
+             grad_var_name=lambda name: name + "@GRAD",
+             cpu_places=fluid_pkg.cpu_places,
+             cuda_places=fluid_pkg.cuda_places))
+
+    executor = _module(
+        base + ".executor",
+        "fluid.executor (ref executor.py).",
+        dict(Executor=Executor, global_scope=global_scope,
+             scope_guard=scope_guard, Scope=Scope))
+
+    compiler = _module(
+        base + ".compiler",
+        "fluid.compiler (ref compiler.py).",
+        dict(CompiledProgram=CompiledProgram, BuildStrategy=BuildStrategy,
+             ExecutionStrategy=ExecutionStrategy))
+
+    parallel_executor = _module(
+        base + ".parallel_executor",
+        "fluid.parallel_executor (ref parallel_executor.py).",
+        dict(ParallelExecutor=ParallelExecutor,
+             BuildStrategy=BuildStrategy,
+             ExecutionStrategy=ExecutionStrategy))
+
+    core = _module(
+        base + ".core",
+        "fluid.core (ref pybind core.so): the handful of types fluid-era "
+        "scripts reach into core for; everything is the Python-level "
+        "equivalent (there is deliberately no C++ binding layer here — "
+        "XLA owns the device runtime).",
+        dict(LoDTensor=LoDTensor, LoDTensorArray=LoDTensorArray,
+             CPUPlace=CPUPlace, CUDAPlace=CUDAPlace,
+             CUDAPinnedPlace=CPUPlace, TPUPlace=TPUPlace, Scope=Scope,
+             VarBase=Tensor,
+             is_compiled_with_cuda=lambda: False,
+             get_cuda_device_count=lambda: 0))
+
+    from .trainer_desc import DataFeedDesc
+
+    data_feed_desc = _module(
+        base + ".data_feed_desc",
+        "fluid.data_feed_desc (ref data_feed_desc.py).",
+        dict(DataFeedDesc=DataFeedDesc))
+
+    from .incubate import (MultiSlotDataGenerator,
+                           MultiSlotStringDataGenerator)
+
+    data_generator = _module(
+        base + ".data_generator",
+        "fluid.data_generator (ref incubate/data_generator).",
+        dict(MultiSlotDataGenerator=MultiSlotDataGenerator,
+             MultiSlotStringDataGenerator=MultiSlotStringDataGenerator))
+
+    def _distribute_lookup_table(*a, **k):
+        raise NotImplementedError(
+            "distribute_lookup_table is parameter-server plumbing "
+            "(SURVEY §4b descope); sparse embeddings shard over the mesh "
+            "via VocabParallelEmbedding")
+
+    distribute_lookup_table = _module(
+        base + ".distribute_lookup_table",
+        "fluid.distribute_lookup_table (PS-era; recorded descope).",
+        dict(find_distributed_lookup_table=_distribute_lookup_table))
+
+    class Inferencer:
+        """ref inferencer.py (deprecated in the reference itself): thin
+        loader+runner over save_inference_model output."""
+
+        def __init__(self, infer_func=None, param_path=None, place=None,
+                     parallel=False):
+            import warnings
+
+            warnings.warn("fluid.Inferencer is deprecated; use "
+                          "paddle_tpu.inference.Predictor", Warning)
+            from ..inference.predictor import Predictor
+
+            self._pred = Predictor(param_path)
+
+        def infer(self, inputs, return_numpy=True):
+            return self._pred.run(inputs, return_numpy=return_numpy)
+
+    inferencer = _module(
+        base + ".inferencer",
+        "fluid.inferencer (ref inferencer.py, deprecated).",
+        dict(Inferencer=Inferencer))
+
+    def monkey_patch_variable():
+        """ref math_op_patch.py: Variables here already carry operator
+        methods natively — nothing to patch."""
+        return None
+
+    def monkey_patch_varbase():
+        return None
+
+    mods = dict(framework=framework, executor=executor, compiler=compiler,
+                parallel_executor=parallel_executor, core=core,
+                data_feed_desc=data_feed_desc,
+                data_generator=data_generator,
+                distribute_lookup_table=distribute_lookup_table,
+                inferencer=inferencer)
+    for k, v in mods.items():
+        setattr(fluid_pkg, k, v)
+    fluid_pkg.monkey_patch_variable = monkey_patch_variable
+    fluid_pkg.monkey_patch_varbase = monkey_patch_varbase
+    # ref fluid/__init__.py:72: fleet is re-exported from incubate
+    fluid_pkg.fleet = fluid_pkg.incubate.fleet
+    return mods

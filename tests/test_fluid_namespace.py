@@ -240,3 +240,36 @@ def test_nn_clip_and_top_level_dataloader():
     assert pt.nn.ClipGradByNorm is optim.ClipGradByNorm
     assert pt.nn.ClipGradByValue is optim.ClipGradByValue
     assert pt.DataLoader is pt.io.DataLoader
+
+
+def test_fluid_submodule_names_resolve():
+    """Module-name spellings fluid-era scripts use (ref fluid/__init__
+    .py:34-84): from paddle.fluid import core/framework/executor/..."""
+    import importlib
+
+    for name in ("core", "framework", "executor", "compiler",
+                 "parallel_executor", "data_feed_desc", "data_generator",
+                 "inferencer", "distribute_lookup_table"):
+        mod = importlib.import_module(f"paddle_tpu.fluid.{name}")
+        assert getattr(fluid, name) is mod, name
+    assert fluid.framework.Program is fluid.Program
+    assert fluid.executor.global_scope is fluid.global_scope
+    assert fluid.core.LoDTensor is fluid.LoDTensor
+    assert fluid.parallel_executor.ParallelExecutor is \
+        fluid.ParallelExecutor
+    assert fluid.fleet is fluid.incubate.fleet
+    assert fluid.monkey_patch_variable() is None
+    with pytest.raises(NotImplementedError, match="4b"):
+        fluid.distribute_lookup_table.find_distributed_lookup_table()
+
+
+def test_fluid_framework_module_surface():
+    """The framework-module helpers scripts actually call."""
+    assert fluid.framework.grad_var_name("w") == "w@GRAD"
+    assert len(fluid.framework.cpu_places(2)) == 2
+    pt.enable_static()
+    try:
+        assert fluid.framework.in_dygraph_mode() is False
+    finally:
+        pt.disable_static()
+    assert fluid.framework.in_dygraph_mode() is True
