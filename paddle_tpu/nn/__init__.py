@@ -22,3 +22,8 @@ functional_api = functional
 # exposing ClipGradByValue/Norm/GlobalNorm; impl lives in optim/clip.py)
 from ..optim.clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
                           ClipGradByGlobalNorm)
+
+# the reference's python/paddle/nn/__init__.py binds the functional
+# conv ops at nn level too (plain imports; it has no real __all__)
+from .functional import (conv2d, conv2d_transpose,  # noqa: F401
+                         conv3d, conv3d_transpose)
