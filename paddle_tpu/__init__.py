@@ -9,6 +9,7 @@ checkpointing, inference, and a model zoo.
 from __future__ import annotations
 
 __version__ = "0.1.0"
+from . import version  # noqa: F401,E402
 
 from .core import (
     Tensor,

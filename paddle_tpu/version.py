@@ -1,0 +1,19 @@
+"""paddle.version surface (ref: python/paddle/version.py, generated at
+build time there; static here)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_mkl = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+
+
+def mkl():
+    return with_mkl
