@@ -11,6 +11,11 @@ from __future__ import annotations
 __version__ = "0.1.0"
 from . import version  # noqa: F401,E402
 
+import os as _os
+from .check_import_scipy import check_import_scipy  # noqa: E402
+
+check_import_scipy(_os.name)
+
 from .core import (
     Tensor,
     Parameter,
@@ -37,7 +42,7 @@ from .ops.creation import (
     zeros, ones, full, empty, zeros_like, ones_like, full_like, empty_like,
     arange, linspace, logspace, eye, tril, triu, meshgrid, diagflat, assign,
     clone, rand, randn, randint, randperm, uniform, normal, bernoulli,
-    multinomial, standard_normal,
+    multinomial, standard_normal, fill_constant,
 )
 from .ops.math import (
     add, subtract, multiply, divide, floor_divide, remainder, mod, pow,
@@ -80,11 +85,9 @@ from . import nn
 from . import optim
 from . import amp
 from . import metrics
-from . import metrics as metric  # paddle.metric alias
 from . import distribution
-from . import static_ as static
+from . import static_
 from . import framework
-from . import io_ as io
 from . import runtime
 from . import inference
 from . import quant
@@ -96,17 +99,11 @@ from . import fluid
 from .hapi import Model
 from .io_.dataloader import DataLoader  # noqa: F401  (paddle.DataLoader)
 # NB: ``paddle_tpu.dist`` is the p-norm distance op (paddle parity);
-# the distributed package binds as ``paddle_tpu.distributed``. A plain
-# ``from . import dist`` would silently resolve to the already-bound
-# function, so import the submodule explicitly.
-import importlib as _importlib
-
-distributed = _importlib.import_module(".dist", __name__)
-# top-level module surface parity (ref: python/paddle/__init__.py):
-# paddle.device, paddle.fleet, paddle.tensor, paddle.sysconfig
-device = _importlib.import_module(".core.device", __name__)
-fleet = _importlib.import_module(".dist.fleet", __name__)
-tensor = ops  # paddle.tensor: the functional op namespace
+# the distributed package binds as ``paddle_tpu.distributed`` — that
+# alias, and the rest of the 2.x module surface (paddle.tensor, .io,
+# .metric, .optimizer, .static, .device, .fleet, .imperative,
+# .regularizer), are bound by modules_compat.install() at the bottom
+# of this file so the alias table lives in ONE place.
 from . import sysconfig  # noqa: E402
 
 
@@ -142,8 +139,6 @@ from .optim import regularizer
 from .nn.param_attr import ParamAttr
 from .utils import unique_name
 
-optimizer = optim  # paddle.optimizer namespace alias
-
 bool = bool_  # paddle.bool
 
 __all__ = [n for n in dir() if not n.startswith("_")]
@@ -166,3 +161,12 @@ def create_tensor(dtype, name=None, persistable=False):
 
 __all__ += ["reader", "compat", "batch", "div", "elementwise_equal",
             "elementwise_sum", "create_tensor"]
+
+# 2.x module surface (paddle.tensor/io/metric/optimizer/distributed/
+# fleet/imperative/static/device/regularizer): attribute binds + the
+# module-import spellings (import paddle_tpu.tensor, python -m
+# paddle_tpu.distributed.launch, ...) — registered last so every
+# implementation module they alias already exists.
+from . import modules_compat as _modules_compat  # noqa: E402
+
+_modules_compat.install(__name__)

@@ -1,0 +1,21 @@
+"""Windows scipy DLL-load sanity check.
+
+ref: python/paddle/check_import_scipy.py — on Windows ('nt') a broken
+scipy install manifests as a 'DLL load failed' ImportError at
+``import scipy.io``; the reference probes it at package import and
+re-raises with install guidance. On the TPU/Linux images this is a
+no-op, but the name is part of the public surface.
+"""
+
+
+def check_import_scipy(OsName):
+    if OsName != "nt":
+        return
+    try:
+        import scipy.io  # noqa: F401
+    except ImportError as e:
+        if "DLL load failed" in str(e):
+            raise ImportError(
+                str(e) + "\nplease reinstall the Visual C++ Redistributable "
+                "so scipy's compiled extensions can load"
+            )

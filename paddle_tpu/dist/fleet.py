@@ -165,3 +165,17 @@ distributed_optimizer = fleet.distributed_optimizer
 worker_num = fleet.worker_num
 worker_index = fleet.worker_index
 is_first_worker = fleet.is_first_worker
+
+
+def __getattr__(name):
+    """Forward the rest of the singleton API (strategy, init_worker,
+    build_train_step, ...) at module level. Any submodule import
+    (``import paddle_tpu.dist.fleet`` or the 2.x alias spelling)
+    makes the import system clobber the parent package's ``fleet``
+    attribute with this MODULE; forwarding makes the module a strict
+    superset of the instance so both spellings expose the same API."""
+    try:
+        return getattr(fleet, name)
+    except AttributeError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None

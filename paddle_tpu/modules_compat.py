@@ -1,0 +1,142 @@
+"""2.x-era top-level module names as real importable modules.
+
+ref: python/paddle/__init__.py binds `paddle.tensor`, `paddle.io`,
+`paddle.metric`, `paddle.optimizer`, `paddle.distributed`,
+`paddle.fleet`, `paddle.imperative`, `paddle.regularizer` as PACKAGES —
+reference scripts spell `import paddle.distributed.launch`,
+`python -m paddle.distributed.launch train.py`, `from paddle.tensor
+import creation`. paddle_tpu already exposes all of them as top-level
+*attributes*; this module additionally registers the dotted names in
+``sys.modules`` and installs a meta-path finder so EVERY submodule
+reachable through an alias resolves to the same module object as the
+real spelling. Without the finder, the default PathFinder would locate
+alias submodules through the aliased package's ``__path__`` and
+re-execute the source under the alias name — a duplicate module with
+independent state (e.g. a second ``dist/env.py`` whose mesh globals
+the real collectives never see).
+"""
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import sys
+
+__all__ = ["install"]
+
+# alias (under paddle_tpu.) -> implementation home (relative import)
+_ALIASES = {
+    "tensor": ".ops",                 # ref: python/paddle/tensor/__init__.py
+    "tensor.creation": ".ops.creation",
+    "tensor.math": ".ops.math",
+    "tensor.linalg": ".ops.linalg",
+    "tensor.manipulation": ".ops.manipulation",
+    "tensor.logic": ".ops.compare",   # ref tensor/logic.py: equal/allclose
+    "tensor.random": ".ops.random_ops",
+    "tensor.search": ".ops.manipulation",  # ref search.py: where/sort/index_sample
+    "io": ".io_",                     # ref: python/paddle/io (DataLoader home in 2.x)
+    "metric": ".metrics",
+    "optimizer": ".optim",
+    "regularizer": ".optim.regularizer",
+    "distributed": ".dist",           # ref: python/paddle/distributed/launch.py
+    "fleet": ".dist.fleet",
+    "imperative": ".fluid.dygraph",   # ref: python/paddle/imperative (dygraph alias)
+    "static": ".static_",
+    "device": ".core.device",
+}
+
+
+class _AliasLoader:
+    """Loader that hands back the REAL module object (shared identity)
+    for plain imports, while still exposing get_code/get_source so
+    ``python -m`` (runpy) can exec the real source as __main__."""
+
+    def __init__(self, real_name):
+        self._real = real_name
+
+    def create_module(self, spec):
+        mod = importlib.import_module(self._real)
+        # module_from_spec overwrites these with the alias spelling;
+        # remember the real values so exec_module can restore them
+        # (otherwise importlib.reload of the real module would route
+        # through this loader's no-op exec and silently do nothing)
+        self._saved = {k: getattr(mod, k, None)
+                       for k in ("__spec__", "__loader__", "__package__",
+                                 "__name__")}
+        return mod
+
+    def exec_module(self, module):  # already executed under its real name
+        for k, v in self._saved.items():
+            if v is not None:
+                setattr(module, k, v)
+
+    def _real_spec(self):
+        return importlib.util.find_spec(self._real)
+
+    def get_code(self, fullname):
+        return self._real_spec().loader.get_code(self._real)
+
+    def get_source(self, fullname):
+        return self._real_spec().loader.get_source(self._real)
+
+    def is_package(self, fullname):
+        return self._real_spec().submodule_search_locations is not None
+
+
+class _AliasFinder:
+    """Meta-path finder mapping ``<pkg>.<alias>[.rest]`` onto the real
+    dotted name. Must sit ahead of PathFinder, which would otherwise
+    re-load alias submodules through the aliased package's __path__."""
+
+    def __init__(self, pkg_name):
+        self._pkg_prefix = pkg_name + "."
+        self._map = {f"{pkg_name}.{a}": f"{pkg_name}{t}"
+                     for a, t in _ALIASES.items()}
+        # longest alias prefix wins (tensor.creation over tensor)
+        self._prefixes = sorted(self._map, key=len, reverse=True)
+
+    def _real_name(self, fullname):
+        # this finder sits at meta_path[0] and sees EVERY import in the
+        # process — bail on the common case with one str compare
+        if not fullname.startswith(self._pkg_prefix):
+            return None
+        if fullname in self._map:
+            return self._map[fullname]
+        for alias in self._prefixes:
+            if fullname.startswith(alias + "."):
+                return self._map[alias] + fullname[len(alias):]
+        return None
+
+    def find_spec(self, fullname, path=None, target=None):
+        real = self._real_name(fullname)
+        if real is None:
+            return None
+        try:
+            real_spec = importlib.util.find_spec(real)
+        except (ImportError, ValueError):
+            return None
+        if real_spec is None:
+            return None
+        return importlib.util.spec_from_loader(
+            fullname, _AliasLoader(real),
+            is_package=real_spec.submodule_search_locations is not None)
+
+
+def install(pkg_name):
+    """Register the dotted names, bind the single-segment aliases as
+    top-level package attributes (the ONLY place they're bound — keeps
+    the alias table in one file), and mount the finder."""
+    pkg = sys.modules[pkg_name]
+    for alias, target in _ALIASES.items():
+        mod = importlib.import_module(target, pkg_name)
+        sys.modules[f"{pkg_name}.{alias}"] = mod
+        if "." not in alias:
+            setattr(pkg, alias, mod)
+            if alias not in pkg.__all__:
+                pkg.__all__.append(alias)
+    # reload-safe: never stack a second finder for the same package
+    # (type identity won't survive a reload, so match by name+prefix)
+    for f in sys.meta_path:
+        if (type(f).__name__ == "_AliasFinder"
+                and getattr(f, "_pkg_prefix", None) == pkg_name + "."):
+            return
+    sys.meta_path.insert(0, _AliasFinder(pkg_name))
