@@ -1,0 +1,126 @@
+"""Static-graph mixed precision (fluid.contrib.mixed_precision surface).
+
+ref: python/paddle/fluid/contrib/mixed_precision/decorator.py:218
+``decorate`` + OptimizerWithMixedPrecision, fp16_utils.py rewrite_program.
+
+The reference rewrites the ProgramDesc: inserts cast ops per the
+white/black lists, scales the loss, and guards updates with the
+check_finite_and_unscale / update_loss_scaling ops. Here the same three
+pieces map onto the one-executable TPU design:
+
+- list-driven casts are applied when the Executor interprets the program
+  (``static_/executor.py`` honors ``program._amp_cfg``); XLA fuses the
+  casts into the ops, so there is no separate cast pass to run;
+- loss scaling, the finite check, the inf-guarded update, and the
+  dynamic scale adjustment are appended as ordinary program ops by
+  ``build_optimize_ops(amp_decorator=...)`` — the whole AMP train step
+  still compiles to ONE fused executable.
+
+bfloat16 is the TPU-native half type (same exponent range as f32), so
+loss scaling is mathematically a no-op there — the machinery is still
+real and exercised, and ``dtype='float16'`` gets the full protection.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .grad_scaler import DynamicLossScaler
+from .lists import AutoMixedPrecisionLists
+
+__all__ = ["decorate", "OptimizerWithMixedPrecision"]
+
+
+class OptimizerWithMixedPrecision:
+    """Wraps an optimizer for static-mode AMP training
+    (ref: decorator.py:40). Use through :func:`decorate`."""
+
+    def __init__(self, optimizer, amp_lists, init_loss_scaling,
+                 use_dynamic_loss_scaling, incr_every_n_steps,
+                 decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+                 dtype="bfloat16"):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._scaler = DynamicLossScaler(
+            init_loss_scaling=init_loss_scaling, incr_ratio=incr_ratio,
+            decr_ratio=decr_ratio, incr_every_n_steps=incr_every_n_steps,
+            decr_every_n_nan_or_inf=decr_every_n_nan_or_inf)
+        self._init_loss_scaling = float(init_loss_scaling)
+        self._use_dynamic = bool(use_dynamic_loss_scaling)
+        self._dtype = dtype
+        self._scaled_loss = None
+        self._params_grads = None
+        self._found_inf_name = None
+
+    # -- reference accessors ------------------------------------------------
+    def get_loss_scaling(self):
+        """Current loss scale (host value, read from the scope)."""
+        from ..static_.program import global_scope
+
+        v = global_scope().find_var("@amp@scale")
+        return float(v) if v is not None else self._init_loss_scaling
+
+    def get_scaled_loss(self):
+        return self._scaled_loss
+
+    def get_lr(self):  # the Executor feeds @lr through this
+        return self._optimizer.get_lr()
+
+    # -- reference API: backward / apply_gradients / minimize ---------------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        """Scale the loss, append grad ops, check-finite + unscale."""
+        from ..static_.executor import append_amp_backward
+
+        self._params_grads, self._found_inf_name = append_amp_backward(
+            self, loss, parameter_list)
+        return self._params_grads
+
+    def apply_gradients(self, params_grads):
+        from ..static_.executor import append_update_ops
+
+        append_update_ops(self._optimizer, params_grads,
+                          amp_decorator=self,
+                          found_inf_name=self._found_inf_name)
+        return []
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        self.apply_gradients(params_grads)
+        return None, params_grads
+
+    # -- pure rules used by the appended ops --------------------------------
+    def check_and_unscale_rule(self, scale, *grads):
+        """found_inf flag + grads/scale in f32 (master-grad flow; the
+        update op casts to the param dtype)."""
+        finite = jnp.asarray(True)
+        for g in grads:
+            finite &= jnp.all(jnp.isfinite(g))
+        inv = jnp.float32(1.0) / scale.astype(jnp.float32)
+        return (~finite,) + tuple(g.astype(jnp.float32) * inv for g in grads)
+
+    def update_scaling_rule(self, scale, good, bad, found_inf):
+        s = self._scaler.update_state(
+            {"scale": scale, "good": good, "bad": bad}, found_inf)
+        return s["scale"], s["good"], s["bad"]
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True, dtype="bfloat16"):
+    """ref: decorator.py:218 fluid.contrib.mixed_precision.decorate.
+
+    Returns an :class:`OptimizerWithMixedPrecision` whose ``minimize``
+    builds a loss-scaled, inf-guarded, list-casted train step. ``dtype``
+    is a TPU-era extension (the reference is fp16-only): 'bfloat16'
+    (default, native) or 'float16'.
+    """
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+        dtype=dtype)

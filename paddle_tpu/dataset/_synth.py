@@ -73,3 +73,11 @@ def labeled_sentences(n, vocab, min_len, max_len, seed):
         ids = rng.randint(0, half, L) + (half if lab else 0)
         out.append((ids.astype(np.int64).tolist(), lab))
     return out
+
+
+def fetch():
+    """ref: dataset fetch() — download-ahead hook. Synthetic data is
+    generated in-process (zero-egress environment), so there is nothing
+    to pre-download; kept so common.fetch_all() and user warm-up scripts
+    run unmodified."""
+    return None

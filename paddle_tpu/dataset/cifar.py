@@ -1,5 +1,6 @@
 """CIFAR readers (ref: python/paddle/dataset/cifar.py: train10/test10,
 train100/test100 yield ((3072,) float32, int)). Synthetic."""
+from ._synth import fetch  # noqa: F401
 from ._synth import class_mean_images, reader_creator
 
 _N_TRAIN, _N_TEST = 2048, 512
@@ -24,3 +25,4 @@ def train100():
 
 def test100():
     return _make(_N_TEST, 100, 13)
+

@@ -6,7 +6,7 @@ from __future__ import annotations
 import hashlib
 import os
 
-__all__ = ["DATA_HOME", "md5file", "download", "split",
+__all__ = ["DATA_HOME", "md5file", "download", "split", "fetch_all",
            "cluster_files_reader", "convert"]
 
 DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
@@ -104,3 +104,19 @@ def convert(output_path, reader, line_count, name_prefix):
             flush()
     flush()
     return written
+
+
+def fetch_all():
+    """ref: common.py:117 fetch_all — call every dataset module's
+    fetch()."""
+    import importlib
+    import pkgutil
+
+    import paddle_tpu.dataset as _ds
+
+    for info in pkgutil.iter_modules(_ds.__path__):
+        if info.name.startswith("_") or info.name in ("common", "image"):
+            continue
+        mod = importlib.import_module(f"paddle_tpu.dataset.{info.name}")
+        if hasattr(mod, "fetch"):
+            mod.fetch()

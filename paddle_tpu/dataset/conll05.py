@@ -4,6 +4,7 @@ ctx_p2, verb_ids, mark, label_ids)). Synthetic: labels follow word
 identity + predicate distance, which a BiLSTM-CRF tagger can learn."""
 import numpy as np
 
+from ._synth import fetch  # noqa: F401
 from ._synth import reader_creator
 
 _WORDS, _VERBS, _LABELS = 4459, 3162, 59
@@ -47,3 +48,4 @@ def train():
 
 def test():
     return _make(128, 19)
+

@@ -2,6 +2,7 @@
 train/test/valid yield ((3, 224, 224) float32 in [-1, 1], int label)).
 Synthetic class-mean images generated LAZILY per sample (a materialized
 512-sample split would hold ~300MB); mapper/cycle are honored."""
+from ._synth import fetch  # noqa: F401
 import numpy as np
 
 __all__ = ["train", "test", "valid"]
@@ -42,3 +43,4 @@ def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
 
 def valid(mapper=None, buffered_size=1024, use_xmap=True):
     return _reader_creator(128, 42, mapper, False)
+

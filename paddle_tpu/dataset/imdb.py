@@ -4,6 +4,7 @@ Synthetic: positive/negative classes draw from shifted vocab regions,
 so conv/LSTM sentiment models separate them."""
 import numpy as np
 
+from ._synth import fetch  # noqa: F401
 from ._synth import reader_creator
 
 _VOCAB = 5148  # mirrors the real dict size order
@@ -27,3 +28,4 @@ def train(word_idx):
 
 def test(word_idx):
     return _make(256, 5, word_idx)
+

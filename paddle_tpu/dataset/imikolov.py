@@ -1,6 +1,7 @@
 """PTB-style n-gram readers (ref: python/paddle/dataset/imikolov.py:
 build_dict(), train(word_idx, n)/test(word_idx, n) yield n-gram tuples).
 Synthetic Markov text — word2vec learns its transition structure."""
+from ._synth import fetch  # noqa: F401
 from ._synth import zipf_sentences, reader_creator
 
 _VOCAB = 2074
@@ -25,3 +26,4 @@ def train(word_idx, n):
 
 def test(word_idx, n):
     return _make(64, 7, word_idx, n)
+

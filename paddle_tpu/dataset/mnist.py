@@ -1,5 +1,6 @@
 """MNIST readers (ref: python/paddle/dataset/mnist.py API: train()/test()
 yield ((784,) float32 in [-1,1], int label)). Synthetic — see package doc."""
+from ._synth import fetch  # noqa: F401
 from ._synth import class_mean_images, reader_creator
 
 _N_TRAIN, _N_TEST = 2048, 512
@@ -16,3 +17,4 @@ def train():
 
 def test():
     return _make(_N_TEST, 1)
+

@@ -4,6 +4,7 @@ category_ids, title_ids, rating)). Synthetic with a low-rank
 user x movie preference structure the recommender can learn."""
 import numpy as np
 
+from ._synth import fetch  # noqa: F401
 from ._synth import reader_creator
 
 _USERS, _MOVIES, _CATS, _TITLE_VOCAB = 944, 1683, 19, 512
@@ -51,3 +52,4 @@ def train():
 
 def test():
     return _make(512, 9)
+

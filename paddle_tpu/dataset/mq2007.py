@@ -4,6 +4,7 @@ Synthetic: 46-dim feature vectors whose relevance is a noisy linear
 function, so rankers have signal to learn."""
 import numpy as np
 
+from ._synth import fetch  # noqa: F401
 from ._synth import reader_creator
 
 __all__ = ["train", "test"]
@@ -48,3 +49,4 @@ def train(format="pairwise"):
 
 def test(format="pairwise"):
     return _reader(16, 81, format)
+

@@ -1,6 +1,7 @@
 """IMDB-style movie-review sentiment (ref: python/paddle/dataset/
 sentiment.py: get_word_dict(); train()/test() yield (ids, 0/1)).
 Synthetic: class-conditioned Zipfian text."""
+from ._synth import fetch  # noqa: F401
 from ._synth import labeled_sentences, reader_creator
 
 __all__ = ["train", "test", "get_word_dict"]
@@ -22,3 +23,4 @@ def train():
 
 def test():
     return _make(256, 71)
+

@@ -3,6 +3,7 @@ train()/test() yield ((13,) float32, (1,) float32)). Synthetic linear
 task with noise — fit_a_line trains to low loss on it."""
 import numpy as np
 
+from ._synth import fetch  # noqa: F401
 from ._synth import reader_creator
 
 feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
@@ -24,3 +25,4 @@ def train():
 
 def test():
     return _make(102, 3)
+

@@ -3,6 +3,7 @@ train/test/val yield (image (3, H, W) float32, label mask (H, W) int64)).
 Synthetic: blob masks with consistent image/label structure."""
 import numpy as np
 
+from ._synth import fetch  # noqa: F401
 from ._synth import reader_creator
 
 __all__ = ["train", "test", "val"]
@@ -39,3 +40,4 @@ def test():
 
 def val():
     return _make(64, 52)
+

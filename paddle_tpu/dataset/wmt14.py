@@ -1,6 +1,7 @@
 """WMT14 en-fr readers (ref: python/paddle/dataset/wmt14.py:
 train/test/gen(dict_size) yield (src_ids, trg_ids, trg_next);
 get_dict(dict_size) -> (src_dict, trg_dict)). Synthetic parallel text."""
+from ._synth import fetch  # noqa: F401
 from ._synth import parallel_sentences, reader_creator
 
 __all__ = ["train", "test", "gen", "get_dict"]
@@ -34,3 +35,4 @@ def get_dict(dict_size, reverse=True):
         return words, dict(words)
     inv = {v: k for k, v in words.items()}
     return inv, dict(inv)
+

@@ -5,6 +5,7 @@ source, so the transformer chapter trains to low loss. ids 0/1/2 =
 <s>/<e>/<unk> like the reference."""
 import numpy as np
 
+from ._synth import fetch  # noqa: F401
 from ._synth import reader_creator
 
 
@@ -28,3 +29,19 @@ def train(src_dict_size=1000, trg_dict_size=1000, tar_fname=None):
 
 def test(src_dict_size=1000, trg_dict_size=1000, tar_fname=None):
     return _make(128, 15, src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size=1000, trg_dict_size=1000, tar_fname=None):
+    """ref: wmt16.py validation()."""
+    return _make(128, 16, src_dict_size, trg_dict_size)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    """ref: wmt16.py get_dict(lang, ...) — synthetic ids are their own
+    tokens; 0/1/2 are <s>/<e>/<unk>."""
+    specials = {0: "<s>", 1: "<e>", 2: "<unk>"}
+    d = {i: specials.get(i, f"{lang}_{i}") for i in range(dict_size)}
+    if reverse:
+        return d
+    return {v: k for k, v in d.items()}
+

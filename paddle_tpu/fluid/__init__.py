@@ -213,6 +213,15 @@ from . import transpiler  # noqa: F401,E402
 from .transpiler import (DistributeTranspiler,  # noqa: F401,E402
                          DistributeTranspilerConfig, memory_optimize,
                          release_memory)
+from . import log_helper  # noqa: F401,E402
+from . import wrapped_decorator  # noqa: F401,E402
+from . import default_scope_funcs  # noqa: F401,E402
+from . import communicator  # noqa: F401,E402
+from . import device_worker  # noqa: F401,E402
+from . import trainer_factory  # noqa: F401,E402
+from . import fleet_utils  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from .trainer_factory import FetchHandler  # noqa: F401,E402
 
 # fluid-era submodule names (fluid.core / framework / executor / ...):
 # installed last so every implementation they alias already exists

@@ -1,0 +1,36 @@
+"""fluid.communicator (ref: python/paddle/fluid/communicator.py).
+
+The reference Communicator is the async parameter-server send/recv
+thread pool used by distribute_transpiler mode. Parameter-server mode
+is a recorded descope (SURVEY §4b): on TPU pods, gradient exchange is
+an XLA collective inside the compiled step, so there is no background
+communication to start or stop. The class keeps the reference's
+lifecycle surface so PS-era drivers run unmodified; start/stop manage
+only the running flag.
+"""
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["Communicator"]
+
+
+class Communicator:
+    def __init__(self, program, mode=None, kwargs=None, envs=None):
+        warnings.warn(
+            "fluid.communicator.Communicator is parameter-server "
+            "machinery; on TPU, gradient exchange happens via XLA "
+            "collectives inside the compiled step — start()/stop() "
+            "manage only a flag here", Warning)
+        self._program = program
+        self._mode = mode
+        self._running = False
+
+    def start(self):
+        self._running = True
+
+    def stop(self):
+        self._running = False
+
+    def is_running(self):
+        return self._running

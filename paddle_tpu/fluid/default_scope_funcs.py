@@ -1,0 +1,60 @@
+"""fluid.default_scope_funcs (ref: python/paddle/fluid/default_scope_funcs.py).
+
+A thread-local stack of Scopes; the top is the current scope. The
+reference keeps C++ Scope kids alive via new_scope/drop_kids — here a
+Scope is a plain name→array dict (static_/program.py Scope), so local
+scopes are independent dicts pushed/popped on the stack.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..static_.program import Scope
+
+__all__ = [
+    "get_cur_scope", "enter_local_scope", "leave_local_scope",
+    "var", "find_var", "scoped_function",
+]
+
+__tl_scope__ = threading.local()
+
+
+def get_cur_scope():
+    """Current (top-of-stack) scope; the bottom scope is created lazily."""
+    stack = getattr(__tl_scope__, "cur_scope", None)
+    if stack is None:
+        stack = __tl_scope__.cur_scope = []
+    if not stack:
+        stack.append(Scope())
+    return stack[-1]
+
+
+def enter_local_scope():
+    get_cur_scope()  # materialize the parent
+    __tl_scope__.cur_scope.append(Scope())
+
+
+def leave_local_scope():
+    __tl_scope__.cur_scope.pop()
+    get_cur_scope().drop_kids()
+
+
+def var(name):
+    """Create (or fetch) a variable slot in the current scope."""
+    scope = get_cur_scope()
+    if scope.find_var(name) is None:
+        scope.set(name, None)
+    return scope.var(name)
+
+
+def find_var(name):
+    return get_cur_scope().find_var(name)
+
+
+def scoped_function(func):
+    """Invoke ``func`` inside a fresh local scope."""
+    enter_local_scope()
+    try:
+        return func()
+    finally:
+        leave_local_scope()

@@ -1,0 +1,75 @@
+"""fluid.device_worker (ref: python/paddle/fluid/device_worker.py).
+
+Reference DeviceWorkers generate the protobuf descriptions the C++
+trainer threads execute (Hogwild lock-free CPU threads, DownpourSGD
+parameter-server pulls/pushes, Section pipeline stages). In the XLA
+design the Executor compiles the whole program into one fused
+executable, so there are no per-thread worker descs to generate —
+``_gen_worker_desc`` fills the (inert, documented) trainer_desc config
+containers so reference driver scripts that wire
+``TrainerFactory -> DeviceWorker -> trainer_desc`` run unmodified.
+Parallel execution itself comes from the data-parallel Executor path
+(static_/executor.py) and dist/ pipelines.
+"""
+from __future__ import annotations
+
+__all__ = ["DeviceWorker", "Hogwild", "DownpourSGD", "DownpourSGDOPT",
+           "Section", "DeviceWorkerFactory"]
+
+
+class DeviceWorker:
+    def __init__(self):
+        self._infer = None
+        self._fleet_desc = None
+        self._program = None
+
+    def _set_infer(self, infer=False):
+        self._infer = infer
+
+    def _set_fleet_desc(self, fleet_desc):
+        self._fleet_desc = fleet_desc
+
+    def _set_program(self, program):
+        self._program = program
+
+    def _gen_worker_desc(self, trainer_desc):
+        raise NotImplementedError(
+            "DeviceWorker is a base class; use Hogwild/DownpourSGD/Section")
+
+
+class Hogwild(DeviceWorker):
+    """Lock-free multithread CPU worker in the reference; here the name
+    records that the program runs through the (single fused executable)
+    Executor dataset loop."""
+
+    def _gen_worker_desc(self, trainer_desc):
+        trainer_desc.device_worker_name = "HogwildWorker"
+        if self._infer:
+            trainer_desc.hogwild_param = {"skip_ops": ["feed", "fetch"]}
+
+
+class DownpourSGD(DeviceWorker):
+    """Parameter-server pull/push worker (recorded descope §4b)."""
+
+    def _gen_worker_desc(self, trainer_desc):
+        trainer_desc.device_worker_name = "DownpourWorker"
+
+
+class DownpourSGDOPT(DownpourSGD):
+    def _gen_worker_desc(self, trainer_desc):
+        trainer_desc.device_worker_name = "DownpourWorkerOpt"
+
+
+class Section(DeviceWorker):
+    """Pipeline-stage worker; the live pipeline engine is
+    dist/pipeline.py (GPipe over shard_map + ppermute)."""
+
+    def _gen_worker_desc(self, trainer_desc):
+        trainer_desc.device_worker_name = "SectionWorker"
+
+
+class DeviceWorkerFactory:
+    def _create_device_worker(self, worker_type):
+        classes = {c.__name__.lower(): c for c in
+                   (Hogwild, DownpourSGD, DownpourSGDOPT, Section)}
+        return classes[str(worker_type).lower()]()

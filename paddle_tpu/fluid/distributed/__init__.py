@@ -1,0 +1,9 @@
+"""fluid.distributed (ref: python/paddle/fluid/distributed/) — the
+downpour/pslib parameter-server client package. PS mode is a recorded
+descope (SURVEY §4b); the Fleet here keeps worker-side lifecycle
+working over the collective design and raises the descope error on
+pserver-side entry points.
+"""
+from .fleet import Fleet  # noqa: F401
+
+__all__ = ["Fleet"]

@@ -1,0 +1,47 @@
+"""ref: python/paddle/fluid/distributed/fleet.py — downpour Fleet."""
+from __future__ import annotations
+
+__all__ = ["Fleet"]
+
+_DESCOPE = ("parameter-server mode is descoped on TPU (SURVEY §4b): "
+            "sparse tables shard over the mesh via "
+            "VocabParallelEmbedding; use dist.fleet / fleet.init")
+
+
+class Fleet:
+    """ref: distributed/fleet.py:20. Worker-side lifecycle is live
+    (rank/size from the jax distributed env); pserver-side methods
+    raise the recorded descope."""
+
+    def __init__(self):
+        self._opt_info = None
+
+    def stop(self):
+        from ...dist import env as denv
+
+        if denv.get_world_size() > 1:
+            from ...dist.collective import barrier
+
+            barrier()
+
+    def init_worker(self, opt_info=None):
+        self._opt_info = opt_info
+
+    def worker_num(self):
+        from ...dist import env as denv
+
+        return denv.get_world_size()
+
+    def worker_index(self):
+        from ...dist import env as denv
+
+        return denv.get_rank()
+
+    def init_pserver(self, opt_info=None):
+        raise NotImplementedError(_DESCOPE)
+
+    def init_pserver_model(self):
+        raise NotImplementedError(_DESCOPE)
+
+    def save_pserver_model(self, save_path):
+        raise NotImplementedError(_DESCOPE)

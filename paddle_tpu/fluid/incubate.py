@@ -197,3 +197,318 @@ def load_program(model_filename, is_text=True):
             "executable round-trip")
     with open(model_filename) as f:
         return f.read()
+
+
+class MPISymetricRoleMaker(RoleMakerBase):
+    """ref: role_maker.py:225 — MPI rank-symmetric roles (every process
+    is both worker and server in the reference's PS clusters). On the
+    TPU single-controller SPMD design there are no server processes, so
+    every rank is a worker; rank/size come from the jax distributed env
+    (the role MPI_COMM_WORLD plays in the reference).
+    """
+
+    def __init__(self):
+        super().__init__()
+        import jax
+
+        # process-level roles: single-controller SPMD means one worker
+        # per HOST process (devices are not workers), matching the role
+        # MPI ranks play in the reference
+        self._current_id = jax.process_index()
+        n = jax.process_count()
+        self._worker_endpoints = ["127.0.0.1:0"] * n
+        self._generated = False
+
+    def generate_role(self):
+        self._generated = True
+
+    def _check_role_generation(self):
+        if not self._generated:
+            raise RuntimeError("call generate_role() first")
+        return True
+
+    def all_gather(self, input):
+        """Gather a host value from every worker process. With one
+        process this is just the singleton list; multi-host gathers ride
+        a device collective on a scalar."""
+        import jax
+
+        if jax.process_count() <= 1:
+            return [input]
+        from ..dist.collective import all_gather as _ag
+
+        import numpy as np
+
+        return list(np.asarray(_ag(np.asarray(input))))
+
+    def all_reduce_worker(self, input, output=None, mode="sum"):
+        import jax
+
+        if jax.process_count() <= 1:
+            return input
+        from ..dist.collective import ReduceOp, all_reduce
+
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        return all_reduce(input, op=op)
+
+    def barrier_all(self):
+        self.barrier_worker()
+
+
+class GeneralRoleMaker(RoleMakerBase):
+    """ref: role_maker.py GeneralRoleMaker — env-driven roles with an
+    http/gloo barrier server. Rank/size resolve exactly like
+    PaddleCloudRoleMaker; barriers ride the mesh collective."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        from ..dist import env as denv
+
+        self._current_id = int(os.environ.get(
+            "PADDLE_TRAINER_ID", denv.get_rank()))
+        n = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                               denv.get_world_size()))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
+        self._worker_endpoints = eps.split(",") if eps \
+            else ["127.0.0.1:0"] * n
+        self._kwargs = kwargs
+
+    def generate_role(self):
+        pass
+
+    def barrier_all(self):
+        self.barrier_worker()
+
+
+# -- parameter-server DistributedStrategy configs ---------------------------
+# (ref: incubate/fleet/parameter_server/distribute_transpiler/
+# distributed_strategy.py). The config classes are real and validate;
+# the PS *runtime* they would configure stays the recorded §4b descope —
+# StrategyFactory maps each mode onto the collective-mode equivalent.
+
+
+class TrainerRuntimeConfig:
+    """ref: distributed_strategy.py:25 — async-communicator knobs."""
+
+    def __init__(self):
+        self.max_merge_var_num = 20
+        self.send_queue_size = 20
+        self.independent_recv_thread = True
+        self.min_send_grad_num_before_recv = 20
+        self.thread_pool_size = 5
+        self.send_wait_times = 5
+
+    def get_communicator_flags(self):
+        return {"communicator_" + k: v for k, v in vars(self).items()}
+
+    def display(self, configs):
+        lines = [f"{k}: {v}" for k, v in sorted(configs.items())]
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return self.display(self.get_communicator_flags())
+
+
+class PSDistributedStrategy:
+    """ref: distributed_strategy.py:127 DistributedStrategy (the PS one —
+    distinct from dist.fleet.DistributedStrategy, which is the collective
+    strategy this maps onto)."""
+
+    def __init__(self):
+        self._program_config = {"sync_mode": True, "runtime_split_send_recv":
+                                False, "geo_sgd_mode": False}
+        self._trainer_runtime_config = TrainerRuntimeConfig()
+        self._server_runtime_config = {}
+        self._execute_strategy = None
+        self._build_strategy = None
+        self._debug_opt = None
+
+    def set_debug_opt(self, opt_info):
+        self._debug_opt = opt_info
+
+    def get_debug_opt(self):
+        return dict(self._debug_opt or {})
+
+    def get_program_config(self):
+        return self._program_config
+
+    def set_program_config(self, config):
+        if isinstance(config, dict):
+            bad = set(config) - set(self._program_config)
+            if bad:
+                raise ValueError(f"unknown program_config keys {sorted(bad)}")
+            self._program_config.update(config)
+        else:
+            self._program_config = config
+
+    def get_trainer_runtime_config(self):
+        return self._trainer_runtime_config
+
+    def set_trainer_runtime_config(self, config):
+        if isinstance(config, dict):
+            for k, v in config.items():
+                if not hasattr(self._trainer_runtime_config, k):
+                    raise ValueError(f"unknown runtime config {k}")
+                setattr(self._trainer_runtime_config, k, v)
+        else:
+            self._trainer_runtime_config = config
+
+    def get_server_runtime_config(self):
+        return self._server_runtime_config
+
+    def set_server_runtime_config(self, config):
+        self._server_runtime_config = config
+
+    def get_execute_strategy(self):
+        return self._execute_strategy
+
+    def set_execute_strategy(self, config):
+        self._execute_strategy = config
+
+    def get_build_strategy(self):
+        return self._build_strategy
+
+    def set_build_strategy(self, config):
+        self._build_strategy = config
+
+    def to_collective(self):
+        """The TPU mapping: every PS mode runs as collective DP."""
+        from ..dist.fleet import DistributedStrategy as _CS
+
+        return _CS()
+
+
+class SyncStrategy(PSDistributedStrategy):
+    def __init__(self):
+        super().__init__()
+        self._program_config["sync_mode"] = True
+
+
+class AsyncStrategy(PSDistributedStrategy):
+    def __init__(self):
+        super().__init__()
+        self._program_config["sync_mode"] = False
+
+
+class HalfAsyncStrategy(AsyncStrategy):
+    pass
+
+
+class GeoStrategy(PSDistributedStrategy):
+    def __init__(self, update_frequency=100):
+        super().__init__()
+        self._program_config["sync_mode"] = False
+        self._program_config["geo_sgd_mode"] = True
+        self._program_config["geo_sgd_need_push_nums"] = update_frequency
+
+
+class StrategyFactory:
+    """ref: distributed_strategy.py StrategyFactory."""
+
+    @staticmethod
+    def create_sync_strategy():
+        return SyncStrategy()
+
+    @staticmethod
+    def create_half_async_strategy():
+        return HalfAsyncStrategy()
+
+    @staticmethod
+    def create_async_strategy():
+        return AsyncStrategy()
+
+    @staticmethod
+    def create_geo_strategy(update_frequency=100):
+        return GeoStrategy(update_frequency)
+
+
+FLEET_GLOBAL_DICT = {
+    # ref: pslib/optimizer_factory.py FLEET_GLOBAL_DICT — plumbing the
+    # pslib op-rewrite passes share; kept for import compat
+    "enable": False, "emb_to_table": {}, "emb_to_accessor": {},
+    "emb_to_size": {}, "cur_sparse_id": 0, "cur_accessor": "",
+    "click_name": "", "scale_sparse_grad": None,
+}
+
+
+class DistributedAdam:
+    """ref: pslib/optimizer_factory.py DistributedAdam — rewrites the
+    program for pslib sparse tables (recorded §4b descope). The TPU
+    equivalent of distributed sparse embeddings is
+    dist.tp_layers.VocabParallelEmbedding + a standard Adam."""
+
+    def __init__(self, optimizer=None):
+        self._optimizer = optimizer
+
+    def minimize(self, *a, **k):
+        raise NotImplementedError(
+            "pslib sparse-table optimization is parameter-server "
+            "machinery (SURVEY §4b descope); shard embeddings with "
+            "VocabParallelEmbedding and use optim.Adam")
+
+
+__all__ += ["MPISymetricRoleMaker", "GeneralRoleMaker",
+            "TrainerRuntimeConfig", "PSDistributedStrategy", "SyncStrategy",
+            "AsyncStrategy", "HalfAsyncStrategy", "GeoStrategy",
+            "StrategyFactory", "DistributedAdam", "FLEET_GLOBAL_DICT"]
+
+
+class CollectiveDistributedStrategy:
+    """ref: incubate/fleet/collective/__init__.py:334 DistributedStrategy
+    (the collective-mode one — extends BuildStrategy with collective
+    knobs). XLA owns graph construction, so the knobs are config-only;
+    collective_mode='grad_allreduce' is what the SPMD executor path
+    implements, 'local_sgd' maps to it (see transpiler.LocalSGD)."""
+
+    def __init__(self):
+        from ..static_ import BuildStrategy, ExecutionStrategy
+
+        self.build_strategy = BuildStrategy()
+        self.use_local_sgd = False
+        self.use_dist_fc = False
+        self.dist_fc_config = None
+        self.mode = "collective"
+        self.collective_mode = "grad_allreduce"
+        self.nccl_comm_num = 1
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+        self.exec_strategy = ExecutionStrategy()
+
+
+class CollectiveOptimizer:
+    """ref: incubate/fleet/collective/__init__.py:382 — wraps an
+    optimizer for collective (data-parallel) static training. The
+    reference transpiles NCCL all-reduce ops into the program; here
+    minimize() appends the standard backward+update ops and marks the
+    program for the Executor's SPMD data-parallel path, which makes XLA
+    insert the gradient all-reduce over ICI."""
+
+    def __init__(self, optimizer, strategy=None):
+        self._optimizer = optimizer
+        self._strategy = strategy or CollectiveDistributedStrategy()
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        from ..static_.backward import append_backward
+
+        return append_backward(loss, parameter_list=parameter_list)
+
+    def apply_gradients(self, params_grads):
+        from ..static_.executor import append_update_ops
+
+        append_update_ops(self._optimizer, params_grads)
+        return []
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ..static_.executor import build_optimize_ops
+        from ..static_.program import default_main_program
+
+        opt_ops, params_grads = build_optimize_ops(
+            self._optimizer, loss, parameter_list=parameter_list)
+        default_main_program()._transpiled_dp = True
+        return opt_ops, params_grads
+
+
+__all__ += ["CollectiveOptimizer", "CollectiveDistributedStrategy"]

@@ -33,6 +33,16 @@ from ..inference.decoder import (dynamic_decode, BeamSearchDecoder,  # noqa: F40
 from ..metrics import Auc  # noqa: F401,E402
 
 
+def tanh_shrink(x, name=None):
+    """Fluid-era spelling (ref: layers/ops.py __activations_noattr__)."""
+    return _ops.activation.tanhshrink(x)
+
+
+def hard_shrink(x, threshold=None):
+    """Fluid-era spelling (ref: layers/ops.py:104; op default 0.5)."""
+    return _F.hardshrink(x, 0.5 if threshold is None else threshold)
+
+
 def accuracy(input, label, k=1, correct=None, total=None):
     """Graph-compatible top-k batch accuracy (ref: the accuracy op in
     layers/metric_op.py:31): built from ops, so it records into a static

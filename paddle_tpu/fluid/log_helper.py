@@ -1,0 +1,27 @@
+"""fluid.log_helper (ref: python/paddle/fluid/log_helper.py).
+
+Logger factory that never touches logging.basicConfig (importing the
+framework must not globally reconfigure the user's logging).
+"""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["get_logger"]
+
+
+def get_logger(name, level, fmt=None):
+    """Named logger with its own handler; repeated calls don't stack
+    duplicate handlers (same guarantee the reference gives by building
+    the handler once per call site)."""
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if not any(getattr(h, "_paddle_tpu_handler", False)
+               for h in logger.handlers):
+        handler = logging.StreamHandler()
+        handler._paddle_tpu_handler = True
+        if fmt:
+            handler.setFormatter(logging.Formatter(fmt=fmt))
+        logger.addHandler(handler)
+    logger.propagate = False
+    return logger

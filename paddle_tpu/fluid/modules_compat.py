@@ -38,6 +38,7 @@ def install(fluid_pkg):
                            default_startup_program, global_scope,
                            name_scope, scope_guard)
     from ..static_.compiler import ParallelExecutor
+    from ..static_.executor import FetchHandler as _FetchHandler
     from .lod_tensor import (LoDTensor, LoDTensorArray, create_lod_tensor,
                              create_random_int_lodtensor)
 
@@ -60,7 +61,8 @@ def install(fluid_pkg):
         base + ".executor",
         "fluid.executor (ref executor.py).",
         dict(Executor=Executor, global_scope=global_scope,
-             scope_guard=scope_guard, Scope=Scope))
+             scope_guard=scope_guard, Scope=Scope,
+             FetchHandler=_FetchHandler))
 
     compiler = _module(
         base + ".compiler",
@@ -157,4 +159,153 @@ def install(fluid_pkg):
     fluid_pkg.monkey_patch_varbase = monkey_patch_varbase
     # ref fluid/__init__.py:72: fleet is re-exported from incubate
     fluid_pkg.fleet = fluid_pkg.incubate.fleet
+    mods.update(_install_contrib_faces(fluid_pkg))
+    mods.update(_install_incubate_faces(fluid_pkg))
     return mods
+
+
+def _install_contrib_faces(fluid_pkg):
+    """contrib submodule spellings (ref: fluid/contrib/__init__.py):
+    mixed_precision is the static AMP package; its real home here is
+    paddle_tpu/amp (+ amp/static_decorator.py for the fluid decorate)."""
+    base = fluid_pkg.__name__
+
+    from ..amp.lists import AutoMixedPrecisionLists
+    from ..amp.static_decorator import OptimizerWithMixedPrecision, decorate
+
+    mp_decorator = _module(
+        base + ".contrib.mixed_precision.decorator",
+        "ref: mixed_precision/decorator.py.",
+        dict(decorate=decorate,
+             OptimizerWithMixedPrecision=OptimizerWithMixedPrecision))
+    from ..amp import lists as _amp_lists
+
+    fp16_lists = _module(
+        base + ".contrib.mixed_precision.fp16_lists",
+        "ref: mixed_precision/fp16_lists.py (home: paddle_tpu/amp/lists).",
+        dict(AutoMixedPrecisionLists=AutoMixedPrecisionLists,
+             white_list=_amp_lists.WHITE_LIST,
+             black_list=_amp_lists.BLACK_LIST))
+    mixed_precision = _module(
+        base + ".contrib.mixed_precision",
+        "ref: fluid/contrib/mixed_precision/__init__.py.",
+        dict(decorate=decorate,
+             OptimizerWithMixedPrecision=OptimizerWithMixedPrecision,
+             AutoMixedPrecisionLists=AutoMixedPrecisionLists,
+             decorator=mp_decorator, fp16_lists=fp16_lists))
+    contrib = fluid_pkg.contrib
+    contrib.mixed_precision = mixed_precision
+    # ref contrib/__init__.py also re-exports the trainer-era Inferencer
+    if not hasattr(contrib, "Inferencer"):
+        contrib.Inferencer = fluid_pkg.inferencer.Inferencer
+    return {"contrib.mixed_precision": mixed_precision}
+
+
+def _install_incubate_faces(fluid_pkg):
+    """Deep incubate.fleet.* spellings (ref: fluid/incubate/fleet/...).
+
+    The implementation homes are fluid/incubate.py, fluid/fleet_utils.py
+    and dist/fleet.py; these faces give the reference's package paths.
+    The fleet face forwards unknown attributes to the fleet singleton so
+    the import-system's parent-attribute clobber (importing
+    ...incubate.fleet replaces the singleton attr with this module) is
+    harmless."""
+    base = fluid_pkg.__name__
+    inc = fluid_pkg.incubate
+
+    role_maker = _module(
+        base + ".incubate.fleet.base.role_maker",
+        "ref: incubate/fleet/base/role_maker.py.",
+        dict(Role=inc.Role, RoleMakerBase=inc.RoleMakerBase,
+             UserDefinedRoleMaker=inc.UserDefinedRoleMaker,
+             UserDefinedCollectiveRoleMaker=(
+                 inc.UserDefinedCollectiveRoleMaker),
+             PaddleCloudRoleMaker=inc.PaddleCloudRoleMaker,
+             MPISymetricRoleMaker=inc.MPISymetricRoleMaker,
+             GeneralRoleMaker=inc.GeneralRoleMaker))
+    fleet_base = _module(
+        base + ".incubate.fleet.base",
+        "ref: incubate/fleet/base/.",
+        dict(role_maker=role_maker))
+
+    collective = _module(
+        base + ".incubate.fleet.collective",
+        "ref: incubate/fleet/collective/__init__.py.",
+        dict(fleet=inc.fleet,
+             CollectiveOptimizer=inc.CollectiveOptimizer,
+             DistributedStrategy=inc.CollectiveDistributedStrategy))
+
+    from . import fleet_utils as _fu
+
+    hdfs = _module(
+        base + ".incubate.fleet.utils.hdfs",
+        "ref: incubate/fleet/utils/hdfs.py (home: fluid/contrib_utils).",
+        dict(HDFSClient=_fu.HDFSClient))
+    fleet_util_mod = _module(
+        base + ".incubate.fleet.utils.fleet_util",
+        "ref: incubate/fleet/utils/fleet_util.py.",
+        dict(FleetUtil=_fu.FleetUtil))
+    utils_mod = _module(
+        base + ".incubate.fleet.utils.utils",
+        "ref: incubate/fleet/utils/utils.py.",
+        dict(program_type_trans=_fu.program_type_trans,
+             check_saved_vars_try_dump=_fu.check_saved_vars_try_dump,
+             parse_program=_fu.parse_program,
+             check_pruned_program_vars=_fu.check_pruned_program_vars,
+             graphviz=_fu.graphviz))
+    fleet_utils = _module(
+        base + ".incubate.fleet.utils",
+        "ref: incubate/fleet/utils/.",
+        dict(hdfs=hdfs, fleet_util=fleet_util_mod, utils=utils_mod,
+             HDFSClient=_fu.HDFSClient, FleetUtil=_fu.FleetUtil))
+
+    distributed_strategy = _module(
+        base + ".incubate.fleet.parameter_server.distribute_transpiler"
+        ".distributed_strategy",
+        "ref: parameter_server/distribute_transpiler/distributed_strategy"
+        ".py.",
+        dict(TrainerRuntimeConfig=inc.TrainerRuntimeConfig,
+             DistributedStrategy=inc.PSDistributedStrategy,
+             SyncStrategy=inc.SyncStrategy,
+             AsyncStrategy=inc.AsyncStrategy,
+             HalfAsyncStrategy=inc.HalfAsyncStrategy,
+             GeoStrategy=inc.GeoStrategy,
+             StrategyFactory=inc.StrategyFactory))
+    dt_mod = _module(
+        base + ".incubate.fleet.parameter_server.distribute_transpiler",
+        "ref: parameter_server/distribute_transpiler/ (PS fleet mode is "
+        "the recorded §4b descope; the strategy configs are live).",
+        dict(fleet=inc.fleet, distributed_strategy=distributed_strategy))
+    optimizer_factory = _module(
+        base + ".incubate.fleet.parameter_server.pslib.optimizer_factory",
+        "ref: parameter_server/pslib/optimizer_factory.py.",
+        dict(DistributedAdam=inc.DistributedAdam,
+             FLEET_GLOBAL_DICT=inc.FLEET_GLOBAL_DICT))
+    pslib = _module(
+        base + ".incubate.fleet.parameter_server.pslib",
+        "ref: parameter_server/pslib/ (recorded §4b descope).",
+        dict(fleet=inc.fleet, optimizer_factory=optimizer_factory))
+    parameter_server = _module(
+        base + ".incubate.fleet.parameter_server",
+        "ref: incubate/fleet/parameter_server/.",
+        dict(distribute_transpiler=dt_mod, pslib=pslib))
+
+    fleet_face = _module(
+        base + ".incubate.fleet",
+        "ref: incubate/fleet/ — forwards to the fleet singleton.",
+        dict(base=fleet_base, collective=collective, utils=fleet_utils,
+             parameter_server=parameter_server))
+    fleet_face.__getattr__ = lambda name: getattr(inc.fleet, name)
+
+    # fluid.transpiler.collective spelling (classes live in
+    # fluid/transpiler.py)
+    from . import transpiler as _tr
+
+    tr_collective = _module(
+        base + ".transpiler.collective",
+        "ref: transpiler/collective.py.",
+        dict(Collective=_tr.Collective, GradAllReduce=_tr.GradAllReduce,
+             LocalSGD=_tr.LocalSGD))
+    _tr.collective = tr_collective
+
+    return {"incubate.fleet": fleet_face}

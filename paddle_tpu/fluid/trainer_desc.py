@@ -41,6 +41,9 @@ class TrainerDesc:
     def set_infer(self, infer):
         self._infer = bool(infer)
 
+    def _set_use_cvm(self, use_cvm):
+        self.proto_desc["use_cvm"] = bool(use_cvm)
+
     def set_fetch_var_and_info(self, fetch_vars, fetch_info, print_period):
         self.proto_desc["fetch_config"] = {
             "vars": [getattr(v, "name", str(v)) for v in fetch_vars],

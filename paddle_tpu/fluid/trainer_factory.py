@@ -1,0 +1,89 @@
+"""fluid.trainer_factory (ref: python/paddle/fluid/trainer_factory.py).
+
+TrainerFactory wires opt_info (trainer + device_worker class names) into
+trainer_desc containers; FetchHandlerMonitor is a LIVE polling thread
+that snapshots scope variables every ``handler.period_secs`` and feeds
+them to a FetchHandler — same observability contract as the reference,
+over our dict-backed Scope (static_/program.py).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..static_.executor import FetchHandler  # noqa: F401 (re-export)
+from .log_helper import get_logger
+from .trainer_desc import MultiTrainer, DistMultiTrainer, PipelineTrainer
+from .device_worker import DeviceWorkerFactory
+
+__all__ = ["TrainerFactory", "FetchHandler", "FetchHandlerMonitor"]
+
+import logging
+
+_logger = get_logger(__name__, logging.INFO,
+                     fmt="%(asctime)s-%(levelname)s: %(message)s")
+
+
+class TrainerFactory:
+    """ref: trainer_factory.py:33 — build (trainer_desc, device_worker)
+    from an optimizer's opt_info dict."""
+
+    def _create_trainer(self, opt_info=None):
+        if opt_info is None or not opt_info.get("trainer"):
+            trainer = MultiTrainer()
+            device_worker = DeviceWorkerFactory()._create_device_worker(
+                "Hogwild")
+        else:
+            classes = {c.__name__: c for c in
+                       (MultiTrainer, DistMultiTrainer, PipelineTrainer)}
+            trainer = classes[opt_info["trainer"]]()
+            device_worker = DeviceWorkerFactory()._create_device_worker(
+                opt_info["device_worker"])
+            if opt_info.get("use_cvm") is not None:
+                trainer._set_use_cvm(opt_info["use_cvm"])
+        device_worker._gen_worker_desc(trainer)
+        trainer.device_worker = device_worker
+        return trainer
+
+
+class FetchHandlerMonitor:
+    """ref: trainer_factory.py:99 — daemon thread polling the scope."""
+
+    def __init__(self, scope, handler):
+        self.fetch_instance = handler
+        self._scope = scope
+        self.fetch_thread = threading.Thread(
+            target=self.handler_launch_func,
+            args=(scope, handler), daemon=True)
+        self.running = False
+
+    def handler_launch_func(self, scope, handler):
+        var_name_to_key = {}
+        for key, v in handler.var_dict.items():
+            name = getattr(v, "name", None)
+            if name is None:
+                _logger.warning(f"the value of {key} is not a Variable")
+                continue
+            var_name_to_key[name] = key
+        elapsed = 0.0
+        tick = min(0.05, handler.period_secs)
+        while self.running:
+            if elapsed < handler.period_secs:
+                time.sleep(tick)
+                elapsed += tick
+                continue
+            elapsed = 0.0
+            res = {}
+            for name, key in var_name_to_key.items():
+                val = scope.find_var(name)
+                res[key] = np.asarray(val) if val is not None else None
+            handler.handler(res)
+
+    def start(self):
+        self.running = True
+        self.fetch_thread.start()
+
+    def stop(self):
+        self.running = False

@@ -110,3 +110,55 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False,
 def release_memory(input_program, skip_opt_set=None):
     """ref: release_memory — no-op; XLA owns buffer lifetimes."""
     return None
+
+
+class Collective:
+    """Collective-mode transpiler base (ref: transpiler/collective.py:36).
+
+    The reference rewrites the program: inserts c_broadcast into startup
+    (rank-0 weight sync) and c_allreduce_sum + scale into main. Here the
+    same contract — "after transpile, running main_program IS data-
+    parallel" — is delivered by marking the program for the Executor's
+    SPMD path (static_/executor.py): the batch axis shards over the
+    ('data',) mesh, persistables stay replicated (XLA broadcasts them at
+    compile time, subsuming the startup c_broadcast), and XLA inserts
+    the gradient all-reduce over ICI.
+    """
+
+    def __init__(self, nrings=1):
+        self.nrings = nrings
+        self.rank = 0
+        self.nranks = 1
+
+    def transpile(self, startup_program=None, main_program=None, rank=0,
+                  endpoints="127.0.0.1:6174", current_endpoint=None,
+                  wait_port=True):
+        from ..static_.program import default_main_program
+
+        if isinstance(endpoints, str):
+            endpoints = endpoints.split(",")
+        self.rank = int(rank)
+        self.nranks = len(endpoints)
+        self.main_program = main_program or default_main_program()
+        self.startup_program = startup_program
+        self._transpile_main_program()
+        return self
+
+    def _transpile_main_program(self):
+        self.main_program._transpiled_dp = True
+        self.main_program.bump()
+
+
+class GradAllReduce(Collective):
+    """ref: collective.py:178 — synchronous gradient all-reduce DP."""
+
+
+class LocalSGD(Collective):
+    """ref: collective.py:270 — run k local steps, then average params.
+
+    The param-averaging round is the same SPMD all-reduce with the
+    params (not grads) as the reduced tensors; with the one-program
+    design each executed step is already globally synchronous, so the
+    local-step window collapses to 1 (documented semantic difference:
+    equivalent at convergence, no stale-weights window).
+    """

@@ -1,0 +1,27 @@
+"""fluid.wrapped_decorator (ref: python/paddle/fluid/wrapped_decorator.py).
+
+``wrap_decorator`` turns a function-transforming decorator into a
+signature-preserving one (the reference uses the ``decorator`` package
+for the same purpose); ``signature_safe_contextmanager`` is the
+signature-preserving contextlib.contextmanager both codebases use on
+public guard APIs so help()/inspect show the real argument list.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import decorator
+
+__all__ = ["wrap_decorator", "signature_safe_contextmanager"]
+
+
+def wrap_decorator(decorator_func):
+    @decorator.decorator
+    def __impl__(func, *args, **kwargs):
+        wrapped_func = decorator_func(func)
+        return wrapped_func(*args, **kwargs)
+
+    return __impl__
+
+
+signature_safe_contextmanager = wrap_decorator(contextlib.contextmanager)

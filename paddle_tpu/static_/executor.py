@@ -42,6 +42,7 @@ class Executor:
                    fetch_names):
         ops = list(program.global_block.ops)
         consts = dict(program._constants)
+        amp_cast = _amp_cast_fn(getattr(program, "_amp_cfg", None))
 
         def fn(feeds, updated, frozen):
             env = dict(consts)
@@ -51,6 +52,8 @@ class Executor:
             for op in ops:
                 args = [env[n] if n is not None else None
                         for n in op.input_names]
+                if amp_cast is not None:
+                    args = amp_cast(op.type, args)
                 out = op.fn(*args, **op.attrs)
                 if isinstance(out, tuple):
                     for name, o in zip(op.output_names, out):
@@ -175,6 +178,11 @@ class Executor:
             allow_replicated_fallback = getattr(
                 program._exec_strategy, "allow_replicated_fallback", False)
             program = program._program
+        if getattr(program, "_transpiled_dp", False):
+            # fluid.transpiler.collective.GradAllReduce marked this
+            # program: run it data-parallel (same SPMD path as
+            # CompiledProgram.with_data_parallel)
+            data_parallel = True
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope or global_scope()
@@ -265,16 +273,124 @@ class Executor:
                                       fetch_handler)
 
 
-def build_optimize_ops(optimizer, loss, parameter_list=None):
-    """Append backward + optimizer-update ops to the current program
-    (ref: Optimizer.minimize static path in fluid/optimizer.py)."""
+class FetchHandler:
+    """ref: executor.py:429 — user callback fed periodic var snapshots
+    during train_from_dataset (and by FetchHandlerMonitor's polling
+    thread). Subclass and override ``handler``."""
+
+    def __init__(self, var_dict=None, period_secs=60):
+        assert var_dict is not None
+        self.var_dict = var_dict
+        self.period_secs = period_secs
+
+    def handler(self, res_dict):
+        import sys
+
+        for key, val in res_dict.items():
+            if isinstance(val, np.ndarray):
+                sys.stdout.write(f"{key}[0]: {val.flat[0]} ")
+        sys.stdout.write("\n")
+
+    @staticmethod
+    def help():
+        print("Subclass FetchHandler({'name': var}) and override "
+              "handler(res_dict) to consume periodic var snapshots.")
+
+
+def _amp_cast_fn(amp_cfg):
+    """List-driven dtype policy for program interpretation — the
+    one-executable analog of the reference's rewrite_program cast-op
+    insertion (fluid/contrib/mixed_precision/fp16_utils.py): white-list
+    op inputs go to the half dtype, black-list inputs back to f32, and
+    XLA fuses the casts into the ops. Grad ops (``<type>@grad``) follow
+    their forward op's list entry, which keeps the vjp's internal
+    forward identical to the casted forward (CSE'd by XLA)."""
+    if not amp_cfg:
+        return None
+    wl = amp_cfg["lists"].white_list
+    bl = amp_cfg["lists"].black_list
+    half = jnp.bfloat16 if amp_cfg["dtype"] == "bfloat16" else jnp.float16
+
+    def amp_cast(op_type, args):
+        base = op_type[:-5] if op_type.endswith("@grad") else op_type
+        if base in wl:
+            dt = half
+        elif base in bl:
+            dt = jnp.float32
+        else:
+            return args
+        return [a.astype(dt)
+                if a is not None and hasattr(a, "dtype")
+                and jnp.issubdtype(a.dtype, jnp.floating) else a
+                for a in args]
+
+    return amp_cast
+
+
+def append_amp_backward(amp_decorator, loss, parameter_list=None):
+    """AMP backward phase (ref: mixed_precision/decorator.py backward +
+    amp_nn.py check_finite_and_unscale): create the persistable scaling
+    state, scale the loss, append grad ops, then one op that both
+    checks every grad for inf/nan and unscales to f32 master grads.
+    Returns (params_grads_on_unscaled, found_inf_var_name)."""
     from .backward import append_backward
+    from .program import Operator, default_main_program
+
+    program = default_main_program()
+    blk = program.global_block
+    scope = global_scope()
+    program._amp_cfg = {"dtype": amp_decorator._dtype,
+                        "lists": amp_decorator._amp_lists}
+
+    if not blk.has_var("@amp@scale"):
+        blk.create_var(name="@amp@scale", shape=(), dtype="float32",
+                       persistable=True)
+        blk.create_var(name="@amp@good", shape=(), dtype="int32",
+                       persistable=True)
+        blk.create_var(name="@amp@bad", shape=(), dtype="int32",
+                       persistable=True)
+        scope.set("@amp@scale",
+                  jnp.float32(amp_decorator._init_loss_scaling))
+        scope.set("@amp@good", jnp.int32(0))
+        scope.set("@amp@bad", jnp.int32(0))
+
+    sname = loss.name + "@SCALED"
+    sv = blk.create_var(name=sname, shape=loss.shape,
+                        dtype=loss._data.dtype, stop_gradient=False)
+    blk.append_op(Operator(
+        "amp_scale_loss", lambda l, s: l * s.astype(l.dtype),
+        [loss.name, "@amp@scale"], [sname], {}))
+    amp_decorator._scaled_loss = sv
+
+    params_grads = append_backward(sv, parameter_list=parameter_list)
+
+    gnames = [g.name for _, g in params_grads]
+    fi = "@amp@found_inf"
+    if not blk.has_var(fi):
+        blk.create_var(name=fi, shape=(), dtype="bool")
+    out_names = [n + "@UNSCALED" for n in gnames]
+    for (_, g), on in zip(params_grads, out_names):
+        blk.create_var(name=on, shape=g.shape, dtype="float32")
+    blk.append_op(Operator(
+        "amp_check_finite_and_unscale",
+        amp_decorator.check_and_unscale_rule,
+        ["@amp@scale"] + gnames, [fi] + out_names, {}))
+    program.bump()
+    return ([(p, blk.var(on)) for (p, _), on in
+             zip(params_grads, out_names)], fi)
+
+
+def append_update_ops(optimizer, params_grads, amp_decorator=None,
+                      found_inf_name=None):
+    """Append clip + per-param optimizer-update ops (the update phase of
+    the reference's Optimizer.minimize / apply_gradients). With an AMP
+    decorator, every update is guarded on the found-inf flag and the
+    dynamic loss-scaling state is advanced in the same executable."""
     from .program import default_main_program
 
     program = default_main_program()
     blk = program.global_block
     scope = global_scope()
-    params_grads = append_backward(loss, parameter_list=parameter_list)
 
     if optimizer._grad_clip is not None:
         clip = optimizer._grad_clip
@@ -312,20 +428,46 @@ def build_optimize_ops(optimizer, loss, parameter_list=None):
                            dtype=state[k].dtype, persistable=True)
             scope.set(sname[k], jnp.asarray(state[k]))
 
-        def upd_fn(pa, ga, lr, *svals, _opt=optimizer, _reg=reg, _skeys=skeys,
-                   _pvar=p):
+        def upd_fn(pa, ga, lr, *rest, _opt=optimizer, _reg=reg, _skeys=skeys,
+                   _pvar=p, _amp=amp_decorator is not None):
             from ..optim.optimizer import AdamW
 
+            if _amp:
+                found_inf, svals = rest[0], rest[1:]
+            else:
+                found_inf, svals = None, rest
             if _reg is not None and not isinstance(_opt, AdamW):
                 ga = _reg(pa, ga)
             s = dict(zip(_skeys, svals))
             _opt._current_param = _pvar  # AdamW decay exclusion / lr_ratio
             new_p, new_s = _opt._update(pa, ga.astype(pa.dtype), s, lr)
+            if found_inf is not None:
+                # inf/nan step: freeze param AND slot state (ref:
+                # update_loss_scaling's skip semantics)
+                new_p = jnp.where(found_inf, pa, new_p)
+                new_s = {k: jnp.where(found_inf, s[k], new_s[k])
+                         for k in _skeys}
             return (new_p, *[new_s[k] for k in _skeys])
 
+        amp_in = [found_inf_name] if amp_decorator is not None else []
         blk.append_op(Operator(
             "optimize_" + type(optimizer).__name__.lower(), upd_fn,
-            [p.name, g.name, "@lr"] + [sname[k] for k in skeys],
+            [p.name, g.name, "@lr"] + amp_in + [sname[k] for k in skeys],
             [p.name] + [sname[k] for k in skeys], {}))
+
+    if amp_decorator is not None and amp_decorator._use_dynamic:
+        blk.append_op(Operator(
+            "amp_update_loss_scaling", amp_decorator.update_scaling_rule,
+            ["@amp@scale", "@amp@good", "@amp@bad", found_inf_name],
+            ["@amp@scale", "@amp@good", "@amp@bad"], {}))
     program.bump()
+
+
+def build_optimize_ops(optimizer, loss, parameter_list=None):
+    """Append backward + optimizer-update ops to the current program
+    (ref: Optimizer.minimize static path in fluid/optimizer.py)."""
+    from .backward import append_backward
+
+    params_grads = append_backward(loss, parameter_list=parameter_list)
+    append_update_ops(optimizer, params_grads)
     return None, params_grads

@@ -1,0 +1,328 @@
+"""Round-5 fluid surface batch: static AMP (contrib.mixed_precision),
+transpiler.collective, trainer_factory/FetchHandler, device_worker,
+communicator, default_scope_funcs, log_helper, wrapped_decorator,
+fleet_utils, incubate role makers + PS strategies + CollectiveOptimizer,
+fluid.distributed.Fleet, dataset fetch/fetch_all, fluid-era activation
+spellings.
+"""
+import logging
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+
+
+class TestStaticAMP:
+    def _build(self, **dec_kw):
+        main, startup = pt.static.Program(), pt.static.Program()
+        with pt.static.program_guard(main, startup):
+            x = pt.static.data("x", [8, 16], "float32")
+            y = pt.static.data("y", [8, 1], "float32")
+            h = fluid.layers.fc(x, size=32, act="relu")
+            p = fluid.layers.fc(h, size=1)
+            loss = pt.mean((p - y) ** 2)
+            from paddle_tpu.fluid.contrib.mixed_precision import decorate
+
+            opt = decorate(pt.optimizer.SGD(learning_rate=0.05), **dec_kw)
+            opt.minimize(loss)
+        return main, startup, loss, opt
+
+    def test_trains_grows_scale_and_skips_inf(self):
+        """One executable: list-casted fwd/bwd, scaled loss, inf-guarded
+        update, dynamic scale (ref: mixed_precision/decorator.py)."""
+        pt.enable_static()
+        try:
+            main, startup, loss, opt = self._build(
+                init_loss_scaling=128.0, incr_every_n_steps=4,
+                decr_every_n_nan_or_inf=1, incr_ratio=2.0, decr_ratio=0.5)
+            exe = pt.static.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            X = rng.randn(8, 16).astype("float32")
+            Y = X @ rng.randn(16, 1).astype("float32")
+            losses = [float(exe.run(main, feed={"x": X, "y": Y},
+                                    fetch_list=[loss])[0])
+                      for _ in range(12)]
+            assert losses[-1] < losses[0] * 0.5
+            s0 = opt.get_loss_scaling()
+            assert s0 > 128.0  # grew on clean steps
+
+            import paddle_tpu.static_.program as prog
+
+            scope = prog.global_scope()
+            pnames = [v.name for v in main.global_block.all_parameters()]
+            before = {n: np.array(scope.find_var(n)) for n in pnames}
+            Xbad = X.copy()
+            Xbad[0, 0] = np.inf
+            exe.run(main, feed={"x": Xbad, "y": Y}, fetch_list=[loss])
+            for n in pnames:
+                assert np.array_equal(before[n],
+                                      np.array(scope.find_var(n))), n
+            assert opt.get_loss_scaling() == s0 * 0.5
+        finally:
+            pt.disable_static()
+
+    def test_scaled_loss_and_accessors(self):
+        pt.enable_static()
+        try:
+            main, startup, loss, opt = self._build(init_loss_scaling=64.0)
+            assert opt.get_scaled_loss() is not None
+            assert opt.get_scaled_loss().name.endswith("@SCALED")
+            assert opt.get_loss_scaling() == 64.0
+        finally:
+            pt.disable_static()
+
+
+class TestTranspilerCollective:
+    def test_grad_allreduce_marks_dp(self):
+        """transpile() makes the program run through the SPMD DP path
+        (ref: transpiler/collective.py GradAllReduce)."""
+        from paddle_tpu.fluid.transpiler.collective import GradAllReduce
+
+        pt.enable_static()
+        try:
+            main, startup = pt.static.Program(), pt.static.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", [16, 8], "float32")
+                y = pt.static.data("y", [16, 1], "float32")
+                p = fluid.layers.fc(x, size=1)
+                loss = pt.mean((p - y) ** 2)
+                pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            t = GradAllReduce()
+            t.transpile(startup_program=startup, main_program=main,
+                        rank=0, endpoints=["a:1", "b:2"],
+                        current_endpoint="a:1", wait_port=False)
+            assert main._transpiled_dp and t.nranks == 2
+            exe = pt.static.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(1)
+            X = rng.randn(16, 8).astype("float32")
+            Y = X @ rng.randn(8, 1).astype("float32")
+            l0 = float(exe.run(main, feed={"x": X, "y": Y},
+                               fetch_list=[loss])[0])
+            for _ in range(20):
+                lv = float(exe.run(main, feed={"x": X, "y": Y},
+                                   fetch_list=[loss])[0])
+            assert lv < l0 * 0.5
+        finally:
+            pt.disable_static()
+
+
+class TestTrainerFactoryAndWorkers:
+    def test_factory_default_and_named(self):
+        from paddle_tpu.fluid.trainer_factory import TrainerFactory
+
+        t = TrainerFactory()._create_trainer()
+        assert t.proto_desc["class_name"] == "MultiTrainer"
+        assert t.device_worker_name == "HogwildWorker"
+        t2 = TrainerFactory()._create_trainer(
+            {"trainer": "DistMultiTrainer", "device_worker": "DownpourSGD",
+             "use_cvm": True})
+        assert t2.device_worker_name == "DownpourWorker"
+        assert t2.proto_desc["use_cvm"] is True
+
+    def test_fetch_handler_monitor_polls_scope(self):
+        from paddle_tpu.fluid.trainer_factory import (FetchHandler,
+                                                      FetchHandlerMonitor)
+        from paddle_tpu.static_.program import Scope
+
+        scope = Scope()
+        scope.set("acc", np.asarray([0.5]))
+        seen = []
+
+        class H(FetchHandler):
+            def handler(self, res):
+                seen.append(res["accuracy"])
+
+        class V:  # duck-typed Variable
+            name = "acc"
+
+        h = H(var_dict={"accuracy": V()}, period_secs=0.05)
+        mon = FetchHandlerMonitor(scope, h)
+        mon.start()
+        time.sleep(0.4)
+        mon.stop()
+        assert seen and np.allclose(seen[-1], [0.5])
+
+    def test_device_worker_factory(self):
+        from paddle_tpu.fluid.device_worker import (DeviceWorkerFactory,
+                                                    Section)
+
+        w = DeviceWorkerFactory()._create_device_worker("section")
+        assert isinstance(w, Section)
+
+
+class TestSmallModules:
+    def test_log_helper_no_duplicate_handlers(self):
+        from paddle_tpu.fluid.log_helper import get_logger
+
+        a = get_logger("ptpu_test_log", logging.INFO, fmt="%(message)s")
+        b = get_logger("ptpu_test_log", logging.INFO)
+        assert a is b and len(a.handlers) == 1
+
+    def test_wrapped_decorator_preserves_signature(self):
+        import inspect
+
+        from paddle_tpu.fluid.wrapped_decorator import (
+            signature_safe_contextmanager)
+
+        @signature_safe_contextmanager
+        def guard(alpha, beta=2):
+            yield alpha + beta
+
+        assert list(inspect.signature(guard).parameters) == ["alpha",
+                                                             "beta"]
+        with guard(1) as v:
+            assert v == 3
+
+    def test_default_scope_funcs(self):
+        from paddle_tpu.fluid import default_scope_funcs as dsf
+
+        dsf.var("x")
+        assert dsf.find_var("x") is None or dsf.find_var("x") is not None
+        outer = dsf.get_cur_scope()
+        dsf.enter_local_scope()
+        assert dsf.get_cur_scope() is not outer
+        dsf.leave_local_scope()
+        assert dsf.get_cur_scope() is outer
+        res = dsf.scoped_function(lambda: 42)
+        assert res == 42
+
+    def test_communicator_lifecycle(self):
+        with pytest.warns(Warning):
+            c = fluid.communicator.Communicator(pt.static.Program())
+        c.start()
+        assert c.is_running()
+        c.stop()
+        assert not c.is_running()
+
+
+class TestFleetSurfaces:
+    def test_role_makers(self):
+        from paddle_tpu.fluid.incubate.fleet.base.role_maker import (
+            GeneralRoleMaker, MPISymetricRoleMaker)
+
+        rm = MPISymetricRoleMaker()
+        rm.generate_role()
+        assert rm._check_role_generation()
+        assert rm.is_worker() and rm.worker_num() >= 1
+        assert rm.all_gather(1) == [1]
+        assert rm.all_reduce_worker(3) == 3
+        GeneralRoleMaker().barrier_all()
+
+    def test_ps_strategy_factory(self):
+        from paddle_tpu.fluid.incubate.fleet.parameter_server.\
+            distribute_transpiler.distributed_strategy import (
+                StrategyFactory, TrainerRuntimeConfig)
+
+        s = StrategyFactory.create_geo_strategy(7)
+        assert s.get_program_config()["geo_sgd_need_push_nums"] == 7
+        assert not s.get_program_config()["sync_mode"]
+        sync = StrategyFactory.create_sync_strategy()
+        assert sync.get_program_config()["sync_mode"]
+        with pytest.raises(ValueError):
+            sync.set_program_config({"bogus": 1})
+        cfg = TrainerRuntimeConfig()
+        assert "communicator_send_queue_size" in \
+            cfg.get_communicator_flags()
+
+    def test_collective_optimizer_static_dp(self):
+        from paddle_tpu.fluid.incubate.fleet.collective import (
+            CollectiveOptimizer)
+
+        pt.enable_static()
+        try:
+            main, startup = pt.static.Program(), pt.static.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", [16, 4], "float32")
+                y = pt.static.data("y", [16, 1], "float32")
+                p = fluid.layers.fc(x, size=1)
+                loss = pt.mean((p - y) ** 2)
+                CollectiveOptimizer(
+                    pt.optimizer.SGD(learning_rate=0.1)).minimize(loss)
+            assert main._transpiled_dp
+            exe = pt.static.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(2)
+            X = rng.randn(16, 4).astype("float32")
+            Y = X @ rng.randn(4, 1).astype("float32")
+            l0 = float(exe.run(main, feed={"x": X, "y": Y},
+                               fetch_list=[loss])[0])
+            for _ in range(15):
+                lv = float(exe.run(main, feed={"x": X, "y": Y},
+                                   fetch_list=[loss])[0])
+            assert lv < l0 * 0.5
+        finally:
+            pt.disable_static()
+
+    def test_fleet_util(self, tmp_path):
+        from paddle_tpu.fluid.incubate.fleet.utils.fleet_util import (
+            FleetUtil)
+        from paddle_tpu.static_.program import Scope
+
+        fu = FleetUtil()
+        fu.rank0_print("hello")
+        scope = Scope()
+        # AUC from bucketed pos/neg counts: perfect separation -> 1.0
+        scope.set("_generated_var_2", np.array([0.0, 0.0, 0.0, 5.0]))
+        scope.set("_generated_var_3", np.array([5.0, 0.0, 0.0, 0.0]))
+        auc = fu.get_global_auc(scope)
+        assert auc == pytest.approx(1.0)
+        scope.set("acc_zero", np.ones((3,), "int64"))
+        fu.set_zero("acc_zero", scope)
+        assert np.all(np.asarray(scope.find_var("acc_zero")) == 0)
+        with pytest.raises(NotImplementedError):
+            fu.save_xbox_base_model("/tmp", 20260731)
+
+    def test_fluid_distributed_fleet(self):
+        from paddle_tpu.fluid.distributed import Fleet
+
+        f = Fleet()
+        f.init_worker()
+        assert f.worker_num() >= 1 and f.worker_index() >= 0
+        with pytest.raises(NotImplementedError):
+            f.init_pserver()
+        f.stop()
+
+    def test_program_helpers(self, tmp_path):
+        from paddle_tpu.fluid.fleet_utils import (check_pruned_program_vars,
+                                                  graphviz, parse_program)
+
+        pt.enable_static()
+        try:
+            main, startup = pt.static.Program(), pt.static.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", [4, 4], "float32")
+                fluid.layers.fc(x, size=2)
+            assert check_pruned_program_vars(main, main)
+            p = parse_program(main, str(tmp_path))
+            assert "fc" in open(p).read() or "Program" in open(p).read()
+            d = graphviz(main.global_block, str(tmp_path), "g")
+            assert open(d).read().startswith("digraph")
+        finally:
+            pt.disable_static()
+
+
+class TestDatasetFetch:
+    def test_fetch_all_and_wmt16_dict(self):
+        import paddle_tpu.dataset as D
+
+        D.common.fetch_all()  # every module's fetch() runs (no-ops)
+        d = D.wmt16.get_dict("en", 30)
+        assert d["<s>"] == 0 and len(d) == 30
+        rd = D.wmt16.get_dict("en", 30, reverse=True)
+        assert rd[0] == "<s>"
+        sample = next(D.wmt16.validation()())
+        assert len(sample) == 3
+
+
+def test_fluid_activation_spellings():
+    x = pt.to_tensor(np.array([-1.0, 0.1, 1.0], "float32"))
+    out = fluid.layers.hard_shrink(x)
+    assert np.allclose(out.numpy(), [-1.0, 0.0, 1.0])
+    out2 = fluid.layers.tanh_shrink(x)
+    assert np.allclose(out2.numpy(), x.numpy() - np.tanh(x.numpy()),
+                       atol=1e-6)
