@@ -1,0 +1,312 @@
+"""fluid.contrib.decoder beam-search decoder API
+(ref: python/paddle/fluid/contrib/decoder/beam_search_decoder.py —
+InitState/StateCell/TrainingDecoder/BeamSearchDecoder, the book ch.8
+machine-translation decoder stack).
+
+Design note (same convention as fluid/rnn.py StaticRNN): the reference
+builds per-step graphs inside ``with decoder.block():`` under a
+DynamicRNN/While op. Python context managers cannot re-run their body,
+and the XLA-era executor replays per-step functions instead of
+sub-block descs — so the step body here is a CALLABLE registered with
+``decoder.block(fn)`` (also usable as a decorator). Everything else —
+the StateCell updater protocol, expansion of states over beams, the
+log-prob accumulation + top-k beam step, end-id freezing — follows the
+reference op for op.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops as _ops
+from ..core.tensor import Tensor
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
+
+
+class InitState:
+    """ref: beam_search_decoder.py:43 — initial decoder state, either a
+    concrete tensor (``init``) or a filled shape."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError(
+                "init_boot must be provided to infer the init batch size")
+        else:
+            B = init_boot.shape[0]
+            self._init = _ops.full([B] + list(shape or []), value,
+                                   dtype=dtype)
+        self._need_reorder = need_reorder
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell:
+    """ref: beam_search_decoder.py:159 — named states + inputs with a
+    user updater::
+
+        cell = StateCell(inputs={'x': None}, states={'h': InitState(...)},
+                         out_state='h')
+
+        @cell.state_updater
+        def updater(cell):
+            h = some_layers(cell.get_input('x'), cell.get_state('h'))
+            cell.set_state('h', h)
+    """
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._input_names = list(inputs)
+        self._init_states = dict(states)
+        self._state_names = list(states)
+        self._out_state = out_state
+        self._updater = None
+        self._cur_states = {}
+        self._new_states = {}
+        self._cur_inputs = {}
+
+    def state_updater(self, updater):
+        self._updater = updater
+        return updater
+
+    def _reset(self):
+        self._cur_states = {k: v.value
+                            for k, v in self._init_states.items()}
+
+    def get_input(self, input_name):
+        if input_name not in self._cur_inputs:
+            raise ValueError(f"input {input_name} not fed this step")
+        return self._cur_inputs[input_name]
+
+    def get_state(self, state_name):
+        if state_name not in self._cur_states:
+            raise ValueError(f"unknown state {state_name}")
+        return self._cur_states[state_name]
+
+    def set_state(self, state_name, state_value):
+        # reference semantics: the new value is visible to get_state
+        # immediately after compute_state (the book pattern reads
+        # get_state('h') BETWEEN compute_state and update_states)
+        self._cur_states[state_name] = state_value
+        self._new_states[state_name] = state_value
+
+    def compute_state(self, inputs):
+        if self._updater is None:
+            raise ValueError("register a @state_cell.state_updater first")
+        unknown = set(inputs) - set(self._input_names)
+        if unknown:
+            raise ValueError(f"inputs {sorted(unknown)} not declared on "
+                             "this StateCell")
+        self._cur_inputs = dict(inputs)
+        self._new_states = {}
+        self._updater(self)
+
+    def update_states(self):
+        """Commit point for the recurrence (ref: writes the RNN memory;
+        states here already live in _cur_states, so this closes the
+        step)."""
+        self._new_states = {}
+
+    def out_state(self):
+        return self._cur_states[self._out_state]
+
+
+class TrainingDecoder:
+    """ref: beam_search_decoder.py:384 — teacher-forced decoding over a
+    target sequence. Step body is a callable (see module note)::
+
+        decoder = TrainingDecoder(cell)
+
+        @decoder.block
+        def _(d):
+            w = d.step_input(trg_emb)          # (B, D) at the current step
+            d.state_cell.compute_state(inputs={'x': w})
+            score = project(d.state_cell.get_state('h'))
+            d.state_cell.update_states()
+            d.output(score)
+
+        outputs = decoder()                    # (B, T, vocab)
+    """
+
+    def __init__(self, state_cell, name=None):
+        self._state_cell = state_cell
+        self._fn = None
+        self._step_inputs = []
+        self._static_inputs = []
+        self._step_outputs = None
+        self._t = 0
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    def block(self, fn=None):
+        if fn is None:
+            raise TypeError(
+                "with decoder.block(): is the reference spelling; here "
+                "the step body is a callable — use @decoder.block or "
+                "decoder.block(fn) (same convention as StaticRNN.step)")
+        self._fn = fn
+        return fn
+
+    def step_input(self, x):
+        """Register a (B, T, ...) sequence; returns the slice for the
+        step being executed. Identity check, not ``in``: Tensor __eq__
+        is elementwise."""
+        if not any(x is s for s in self._step_inputs):
+            self._step_inputs.append(x)
+        return x[:, self._t]
+
+    def static_input(self, x):
+        """A non-stepped input visible in every step."""
+        if not any(x is s for s in self._static_inputs):
+            self._static_inputs.append(x)
+        return x
+
+    def output(self, *outputs):
+        self._step_outputs = outputs if len(outputs) > 1 else outputs[0]
+
+    def __call__(self):
+        if self._fn is None:
+            raise ValueError("register the step body with decoder.block")
+        # discover T by running the body once (step 0 registers inputs)
+        self._state_cell._reset()
+        self._t = 0
+        self._fn(self)
+        T = self._step_inputs[0].shape[1] if self._step_inputs else 1
+        outs = [self._step_outputs]
+        for t in range(1, T):
+            self._t = t
+            self._fn(self)
+            outs.append(self._step_outputs)
+        return _ops.stack(outs, axis=1)
+
+
+class BeamSearchDecoder:
+    """ref: beam_search_decoder.py:523 — beam decode driven by the same
+    StateCell the TrainingDecoder trained. Owns the target-ids embedding
+    and the vocab projection, as the reference decode() does; decode()
+    marks the (built-in) decode graph, ``decoder()`` runs it and returns
+    ``(translation_ids, translation_scores)`` — ids padded with
+    ``end_id`` as ``(B, beam_size, max_len)``, scores ``(B, beam_size)``
+    (the XLA-era dense replacement for the reference's LoD beams)."""
+
+    def __init__(self, state_cell, init_ids, init_scores, target_dict_dim,
+                 word_dim, input_var_dict=None, topk_size=50,
+                 sparse_emb=True, max_len=100, beam_size=1, end_id=1,
+                 name=None):
+        from ..nn.layers.common import Embedding, Linear
+
+        self._state_cell = state_cell
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = int(target_dict_dim)
+        self._word_dim = int(word_dim)
+        self._input_var_dict = dict(input_var_dict or {})
+        self._topk_size = int(topk_size)
+        self._max_len = int(max_len)
+        self._beam_size = int(beam_size)
+        self._end_id = int(end_id)
+        self._emb = Embedding(self._target_dict_dim, self._word_dim)
+        state_dim = int(np.prod(
+            state_cell._init_states[state_cell._out_state].value.shape[1:]))
+        self._fc = Linear(state_dim, self._target_dict_dim)
+        self._decoded = False
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    def decode(self):
+        """The default decode graph is built in; subclass and override
+        to customize (ref contract)."""
+        self._decoded = True
+
+    def __call__(self):
+        import jax.numpy as jnp
+
+        if not self._decoded:
+            self.decode()
+        cell = self._state_cell
+        cell._reset()
+        K, V, E = self._beam_size, self._target_dict_dim, self._end_id
+
+        ids0 = _ops.reshape(self._init_ids, [-1])
+        B = ids0.shape[0]
+        # expand batch -> batch*beam (ref: sequence_expand over beams)
+        ids = _ops.reshape(
+            _ops.tile(_ops.reshape(ids0, [B, 1]), [1, K]), [B * K])
+        scores = np.full((B, K), -1e9, np.float32)
+        scores[:, 0] = 0.0  # only beam 0 live initially (identical beams)
+        scores = Tensor(jnp.asarray(scores.reshape(B * K)), _internal=True)
+        for name in cell._state_names:
+            st = cell.get_state(name)
+            cell._cur_states[name] = _ops.reshape(
+                _ops.tile(_ops.reshape(st, [B, 1] + list(st.shape[1:])),
+                          [1, K] + [1] * (len(st.shape) - 1)),
+                [B * K] + list(st.shape[1:]))
+        static_feeds = {}
+        for iname, ivar in self._input_var_dict.items():
+            if iname not in cell._input_names:
+                raise ValueError(
+                    f"Variable {iname} not found in StateCell!")
+            static_feeds[iname] = _ops.reshape(
+                _ops.tile(_ops.reshape(ivar, [B, 1] + list(ivar.shape[1:])),
+                          [1, K] + [1] * (len(ivar.shape) - 1)),
+                [B * K] + list(ivar.shape[1:]))
+
+        finished = Tensor(jnp.zeros((B * K,), bool), _internal=True)
+        out_ids = []
+        for _t in range(self._max_len):
+            emb = self._emb(ids)
+            feeds = dict(static_feeds)
+            for iname in cell._input_names:
+                if iname not in feeds:
+                    feeds[iname] = emb
+            cell.compute_state(inputs=feeds)
+            cell.update_states()
+            logits = self._fc(cell.out_state())
+            logp = _ops.log_softmax(logits, axis=-1)
+            # finished beams: only end_id continues, at zero added cost
+            mask = np.full((1, V), -np.inf, np.float32)
+            mask[0, E] = 0.0
+            logp = _ops.where(_ops.reshape(finished, [-1, 1]),
+                              Tensor(jnp.asarray(mask), _internal=True)
+                              + _ops.zeros_like(logp), logp)
+            total = _ops.reshape(scores, [-1, 1]) + logp       # (B*K, V)
+            flat = _ops.reshape(total, [B, K * V])
+            top_scores, top_idx = _ops.topk(flat, k=K)         # (B, K)
+            parent = top_idx // V                              # beam index
+            word = top_idx % V                                 # token
+            gather_base = (_ops.arange(0, B, dtype="int64") * K)
+            src = _ops.reshape(
+                _ops.reshape(gather_base, [B, 1]) + parent, [B * K])
+            # reorder beam-major state by parent beam
+            for name in cell._state_names:
+                cell._cur_states[name] = _ops.index_select(
+                    cell._cur_states[name], src, axis=0)
+            for prev in range(len(out_ids)):
+                out_ids[prev] = _ops.index_select(out_ids[prev], src,
+                                                  axis=0)
+            finished = _ops.index_select(finished, src, axis=0)
+            ids = _ops.reshape(word, [B * K])
+            scores = _ops.reshape(top_scores, [B * K])
+            out_ids.append(ids)
+            finished = _ops.logical_or(finished,
+                                       _ops.equal(ids, _ops.full_like(
+                                           ids, E)))
+            if bool(np.all(np.asarray(finished.numpy()))):
+                break
+
+        seq = _ops.stack(out_ids, axis=1)                      # (B*K, L)
+        translation_ids = _ops.reshape(seq, [B, K, seq.shape[1]])
+        translation_scores = _ops.reshape(scores, [B, K])
+        return translation_ids, translation_scores

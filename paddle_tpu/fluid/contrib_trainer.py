@@ -1,0 +1,325 @@
+"""fluid.contrib Trainer/Inferencer high-level API
+(ref: python/paddle/fluid/contrib/trainer.py, inferencer.py — the 1.x
+"high-level API" the book's high-level-api chapters drive).
+
+Trainer owns the program pair + scope: ``train_func`` builds the graph
+(loss first in its returns), ``optimizer_func`` supplies the optimizer,
+and ``train`` runs the epoch/step event loop with Begin/End events,
+periodic checkpointing (CheckpointConfig) and auto-resume from the
+latest serial — all over the one-executable static Executor.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import numpy as np
+
+from .. import static_ as _static
+from ..static_ import Executor, Program, Scope, program_guard, scope_guard
+from ..static_.program import global_scope  # noqa: F401 (re-export compat)
+
+__all__ = ["Trainer", "Inferencer", "BeginEpochEvent", "EndEpochEvent",
+           "BeginStepEvent", "EndStepEvent", "CheckpointConfig"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        #: set False in the handler to skip fetching metrics this step
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """ref: trainer.py:100 — where/how often to checkpoint."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        assert epoch_interval >= 1
+        assert step_interval >= 1
+        self.checkpoint_dir = checkpoint_dir or os.getcwd()
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = epoch_interval
+        self.step_interval = step_interval
+        self.load_serial = None
+        self.epoch_id = 0
+        self.step_id = 0
+
+
+def _serial_dir(cfg, serial):
+    return os.path.join(cfg.checkpoint_dir, f"checkpoint_{serial}")
+
+
+def _latest_serial(checkpoint_dir):
+    best = -1
+    if os.path.isdir(checkpoint_dir):
+        for name in os.listdir(checkpoint_dir):
+            if name.startswith("checkpoint_"):
+                try:
+                    best = max(best, int(name.split("_")[-1]))
+                except ValueError:
+                    pass
+    return best
+
+
+class _ModeGuard:
+    """Enter static mode for a block, restoring the caller's mode."""
+
+    def __enter__(self):
+        self._was_static = _static.in_static_mode()
+        if not self._was_static:
+            _static.enable_static()
+        return self
+
+    def __exit__(self, *exc):
+        if not self._was_static:
+            _static.disable_static()
+
+
+class Trainer:
+    """ref: trainer.py:169."""
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        self.__stop = False
+        self.parallel = parallel
+        self.trainer_id = 0
+        self.checkpoint_cfg = checkpoint_config
+        if self.checkpoint_cfg is not None:
+            assert isinstance(self.checkpoint_cfg, CheckpointConfig)
+            serial = _latest_serial(self.checkpoint_cfg.checkpoint_dir)
+            self.checkpoint_cfg.load_serial = serial if serial >= 0 else None
+
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+        self.place = place
+
+        from ..utils import unique_name
+
+        with _ModeGuard(), scope_guard(self.scope), \
+                program_guard(self.train_program, self.startup_program), \
+                unique_name.guard():
+            outs = train_func()
+            self.train_func_outputs = outs if isinstance(outs, list) \
+                else [outs]
+            self.test_program = self.train_program.clone(for_test=True)
+            loss = self.train_func_outputs[0]
+            from ..optim.optimizer import Optimizer
+
+            optimizer = optimizer_func()
+            if not isinstance(optimizer, Optimizer) and \
+                    not hasattr(optimizer, "minimize"):
+                raise TypeError(
+                    "The optimizer should be an instance of Optimizer")
+            optimizer.minimize(loss)
+
+        with scope_guard(self.scope):
+            exe = Executor(self.place)
+            exe.run(self.startup_program)
+            if param_path:
+                from ..framework.io import load_params
+
+                load_params(exe, param_path,
+                            main_program=self.train_program)
+            if self.checkpoint_cfg and \
+                    self.checkpoint_cfg.load_serial is not None:
+                self._load_checkpoint()
+
+    def stop(self):
+        """Stop training after the current step (ref: trainer.py:373)."""
+        self.__stop = True
+
+    def train(self, num_epochs, event_handler, reader=None,
+              feed_order=None):
+        """Epoch/step event loop (ref: trainer.py:379)."""
+        from .data_feeder import DataFeeder
+
+        feeder = DataFeeder(feed_list=self._feed_list(feed_order))
+        exe = Executor(self.place)
+        fetch = self.train_func_outputs
+        start_epoch = (self.checkpoint_cfg.epoch_id
+                       if self.checkpoint_cfg else 0)
+        # resume mid-epoch: skip the steps already applied before the
+        # checkpoint so updates aren't double-applied
+        resume_step = (self.checkpoint_cfg.step_id
+                       if self.checkpoint_cfg else 0)
+        with scope_guard(self.scope):
+            for epoch_id in range(start_epoch, num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if epoch_id == start_epoch and step_id <= resume_step \
+                            and resume_step > 0:
+                        continue
+                    if self.__stop:
+                        if self.checkpoint_cfg:
+                            self._clean_checkpoint()
+                        return
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    metrics = exe.run(self.train_program,
+                                      feed=feeder.feed(data),
+                                      fetch_list=fetch
+                                      if begin.fetch_metrics else [])
+                    if self.checkpoint_cfg and \
+                            step_id % self.checkpoint_cfg.step_interval \
+                            == 0 and \
+                            epoch_id % self.checkpoint_cfg.epoch_interval \
+                            == 0:
+                        self._save_checkpoint(epoch_id, step_id)
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                event_handler(EndEpochEvent(epoch_id))
+            if self.checkpoint_cfg:
+                self._clean_checkpoint()
+
+    def test(self, reader, feed_order=None):
+        """Mean of the fetch outputs over the reader (ref:
+        trainer.py:407/_test_by_executor)."""
+        from .data_feeder import DataFeeder
+
+        feeder = DataFeeder(feed_list=self._feed_list(feed_order))
+        exe = Executor(self.place)
+        sums, count = None, 0
+        with scope_guard(self.scope):
+            for data in reader():
+                outs = exe.run(self.test_program,
+                               feed=feeder.feed(data),
+                               fetch_list=self.train_func_outputs)
+                vals = [np.asarray(o, dtype=np.float64) for o in outs]
+                n = len(data)
+                sums = ([v * n for v in vals] if sums is None
+                        else [s + v * n for s, v in zip(sums, vals)])
+                count += n
+        if count == 0:
+            return []
+        return [s / count for s in sums]
+
+    def save_params(self, param_path):
+        from ..framework.io import save_params
+
+        with scope_guard(self.scope):
+            exe = Executor(self.place)
+            save_params(exe, param_path, main_program=self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        from .io import save_inference_model
+
+        targets = [self.train_func_outputs[i]
+                   for i in target_var_indexes]
+        with scope_guard(self.scope):
+            exe = Executor(self.place)
+            save_inference_model(param_path, feeded_var_names, targets,
+                                 exe, main_program=self.test_program)
+
+    # -- internals ----------------------------------------------------------
+    def _feed_list(self, feed_order):
+        blk = self.train_program.global_block
+        if feed_order is None:
+            return [v for v in blk.vars.values()
+                    if getattr(v, "is_data", False)]
+        return [blk.var(n) for n in feed_order]
+
+    def _save_checkpoint(self, epoch_id, step_id):
+        from ..framework.io import save_persistables
+
+        cfg = self.checkpoint_cfg
+        serial = _latest_serial(cfg.checkpoint_dir) + 1
+        d = _serial_dir(cfg, serial)
+        os.makedirs(d, exist_ok=True)
+        exe = Executor(self.place)
+        save_persistables(exe, d, main_program=self.train_program)
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump({"epoch_id": epoch_id, "step_id": step_id}, f)
+        serials = sorted(
+            int(n.split("_")[-1])
+            for n in os.listdir(cfg.checkpoint_dir)
+            if n.startswith("checkpoint_"))
+        for old in serials[:-cfg.max_num_checkpoints]:
+            shutil.rmtree(_serial_dir(cfg, old), ignore_errors=True)
+
+    def _load_checkpoint(self):
+        from ..framework.io import load_persistables
+
+        cfg = self.checkpoint_cfg
+        d = _serial_dir(cfg, cfg.load_serial)
+        exe = Executor(self.place)
+        load_persistables(exe, d, main_program=self.train_program)
+        meta = os.path.join(d, "meta.json")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                m = json.load(f)
+            cfg.epoch_id = int(m.get("epoch_id", 0))
+            cfg.step_id = int(m.get("step_id", 0))
+
+    def _clean_checkpoint(self):
+        pass  # keep the last checkpoints on disk (resume-friendly)
+
+
+class Inferencer:
+    """ref: inferencer.py — build the net with ``infer_func`` and load
+    trained params from ``param_path`` (a save_params dir). With
+    ``infer_func=None``, ``param_path`` is instead a
+    ``save_inference_model`` bundle served through
+    ``inference.Predictor`` (the pre-existing shim contract)."""
+
+    def __init__(self, infer_func=None, param_path=None, place=None,
+                 parallel=False):
+        self.scope = Scope()
+        self.place = place
+        self._pred = None
+        if infer_func is None:
+            import warnings
+
+            warnings.warn(
+                "Inferencer without infer_func serves a "
+                "save_inference_model bundle; prefer "
+                "paddle_tpu.inference.Predictor directly", Warning)
+            from ..inference.predictor import Predictor
+
+            self._pred = Predictor(param_path)
+            return
+        self.inference_program = Program()
+        startup = Program()
+        from ..utils import unique_name
+
+        with _ModeGuard(), scope_guard(self.scope), \
+                program_guard(self.inference_program, startup), \
+                unique_name.guard():
+            self.predict_var = infer_func()
+        with scope_guard(self.scope):
+            exe = Executor(place)
+            exe.run(startup)
+            from ..framework.io import load_params
+
+            load_params(exe, param_path,
+                        main_program=self.inference_program)
+
+    def infer(self, inputs, return_numpy=True):
+        """``inputs``: dict of feed name -> ndarray (ref API)."""
+        if self._pred is not None:
+            return self._pred.run(inputs, return_numpy=return_numpy)
+        exe = Executor(self.place)
+        with scope_guard(self.scope):
+            return exe.run(self.inference_program, feed=inputs,
+                           fetch_list=[self.predict_var],
+                           return_numpy=return_numpy)

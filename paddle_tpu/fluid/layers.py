@@ -16,7 +16,7 @@ from ..core.tensor import Tensor
 from ..nn import functional as _F
 from ..nn.layers.common import Linear, Embedding
 from ..nn.param_attr import ParamAttr
-from ..static_ import data  # noqa: F401  (fluid.layers.data legacy)
+from ..static_ import data as _static_data
 from ..optim import lr as _lr
 
 # -- wholesale re-exports: everything the functional namespaces already
@@ -31,6 +31,28 @@ for _src in (_ops, _F):
 from ..inference.decoder import (dynamic_decode, BeamSearchDecoder,  # noqa: F401,E402
                                  Decoder, beam_search, greedy_search)
 from ..metrics import Auc  # noqa: F401,E402
+
+
+def data(name, shape, append_batch_size=True, dtype="float32",
+         lod_level=0, type=None, stop_gradient=True):
+    """Legacy fluid.layers.data (ref: layers/io.py:48): unlike 2.x
+    ``static.data``, the declared ``shape`` is PER-SAMPLE and a batch
+    dimension is prepended by default — unless any dim is already
+    -1/None, which the reference treats as the user declaring the full
+    shape. The batch dim records as 1 (the placeholder for -1 here);
+    the Executor re-traces per fed batch size, so any batch works at
+    run time. A string in the third position is the 2.x positional
+    dtype (``data(name, full_shape, "float32")``) and implies the full
+    shape was given."""
+    if isinstance(append_batch_size, str):
+        dtype, append_batch_size = append_batch_size, False
+    import builtins  # `any` is shadowed by the ops re-export above
+
+    if builtins.any(s in (-1, None) for s in shape):
+        append_batch_size = False  # ref: a variable dim means full shape
+    if append_batch_size:
+        shape = [-1] + list(shape)
+    return _static_data(name, shape, dtype=dtype, lod_level=lod_level)
 
 
 def tanh_shrink(x, name=None):
@@ -127,7 +149,13 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     trailing dims past ``num_flatten_dims`` like the reference."""
     shp = input.shape
     in_dim = int(np.prod(shp[num_flatten_dims:]))
-    x = _ops.reshape(input, list(shp[:num_flatten_dims]) + [in_dim])
+    if len(shp) == num_flatten_dims + 1:
+        x = input  # already flat; skip the no-op reshape
+    else:
+        # -1 for the batch dim: the Executor re-traces per fed batch
+        # size, so the flatten must not bake the build-time batch
+        x = _ops.reshape(input, [-1] + list(shp[1:num_flatten_dims])
+                         + [in_dim])
     lin = Linear(in_dim, size, weight_attr=param_attr,
                  bias_attr=bias_attr)
     out = lin(x)

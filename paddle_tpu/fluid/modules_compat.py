@@ -117,26 +117,12 @@ def install(fluid_pkg):
         "fluid.distribute_lookup_table (PS-era; recorded descope).",
         dict(find_distributed_lookup_table=_distribute_lookup_table))
 
-    class Inferencer:
-        """ref inferencer.py (deprecated in the reference itself): thin
-        loader+runner over save_inference_model output."""
-
-        def __init__(self, infer_func=None, param_path=None, place=None,
-                     parallel=False):
-            import warnings
-
-            warnings.warn("fluid.Inferencer is deprecated; use "
-                          "paddle_tpu.inference.Predictor", Warning)
-            from ..inference.predictor import Predictor
-
-            self._pred = Predictor(param_path)
-
-        def infer(self, inputs, return_numpy=True):
-            return self._pred.run(inputs, return_numpy=return_numpy)
+    from .contrib_trainer import Inferencer
 
     inferencer = _module(
         base + ".inferencer",
-        "fluid.inferencer (ref inferencer.py, deprecated).",
+        "fluid.inferencer (ref inferencer.py — moved to contrib; the "
+        "real class lives in fluid/contrib_trainer.py).",
         dict(Inferencer=Inferencer))
 
     def monkey_patch_variable():
@@ -195,10 +181,55 @@ def _install_contrib_faces(fluid_pkg):
              decorator=mp_decorator, fp16_lists=fp16_lists))
     contrib = fluid_pkg.contrib
     contrib.mixed_precision = mixed_precision
-    # ref contrib/__init__.py also re-exports the trainer-era Inferencer
-    if not hasattr(contrib, "Inferencer"):
-        contrib.Inferencer = fluid_pkg.inferencer.Inferencer
-    return {"contrib.mixed_precision": mixed_precision}
+
+    # contrib trainer-era high-level API (ref: contrib/trainer.py,
+    # contrib/inferencer.py; home: fluid/contrib_trainer.py)
+    from . import contrib_trainer as _ct
+
+    trainer_face = _module(
+        base + ".contrib.trainer",
+        "ref: fluid/contrib/trainer.py.",
+        dict(Trainer=_ct.Trainer, BeginEpochEvent=_ct.BeginEpochEvent,
+             EndEpochEvent=_ct.EndEpochEvent,
+             BeginStepEvent=_ct.BeginStepEvent,
+             EndStepEvent=_ct.EndStepEvent,
+             CheckpointConfig=_ct.CheckpointConfig))
+    inferencer_face = _module(
+        base + ".contrib.inferencer",
+        "ref: fluid/contrib/inferencer.py.",
+        dict(Inferencer=_ct.Inferencer))
+    for name in ("Trainer", "BeginEpochEvent", "EndEpochEvent",
+                 "BeginStepEvent", "EndStepEvent", "CheckpointConfig",
+                 "Inferencer"):
+        setattr(contrib, name, getattr(_ct, name))
+    contrib.trainer = trainer_face
+    contrib.inferencer = inferencer_face
+
+    # contrib.decoder beam-search stack (ref: contrib/decoder/;
+    # home: fluid/contrib_decoder.py)
+    from . import contrib_decoder as _cd
+
+    bsd_face = _module(
+        base + ".contrib.decoder.beam_search_decoder",
+        "ref: contrib/decoder/beam_search_decoder.py.",
+        dict(InitState=_cd.InitState, StateCell=_cd.StateCell,
+             TrainingDecoder=_cd.TrainingDecoder,
+             BeamSearchDecoder=_cd.BeamSearchDecoder))
+    decoder_face = _module(
+        base + ".contrib.decoder",
+        "ref: fluid/contrib/decoder/.",
+        dict(beam_search_decoder=bsd_face, InitState=_cd.InitState,
+             StateCell=_cd.StateCell, TrainingDecoder=_cd.TrainingDecoder,
+             BeamSearchDecoder=_cd.BeamSearchDecoder))
+    contrib.decoder = decoder_face
+    for name in ("InitState", "StateCell", "TrainingDecoder"):
+        setattr(contrib, name, getattr(_cd, name))
+    # NB: contrib re-exports the decoder BeamSearchDecoder in the
+    # reference too, shadowing none of layers' dynamic-decode API
+    contrib.BeamSearchDecoder = _cd.BeamSearchDecoder
+    return {"contrib.mixed_precision": mixed_precision,
+            "contrib.trainer": trainer_face,
+            "contrib.decoder": decoder_face}
 
 
 def _install_incubate_faces(fluid_pkg):

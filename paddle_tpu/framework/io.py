@@ -79,12 +79,13 @@ def _forward_slice(program, feed_names, fetch_names):
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         program=None, **kwargs):
+                         program=None, main_program=None, **kwargs):
     """ref: fluid.io.save_inference_model. Writes <prefix>.pdmodel (program
-    pickle) + <prefix>.pdiparams (weights npz)."""
+    pickle) + <prefix>.pdiparams (weights npz). ``main_program`` is the
+    fluid-era spelling of ``program``."""
     from ..static_.program import default_main_program, global_scope
 
-    program = program or default_main_program()
+    program = program or main_program or default_main_program()
     feed_names = [v if isinstance(v, str) else v.name for v in feed_vars]
     fetch_names = [v if isinstance(v, str) else v.name for v in fetch_vars]
     ops, needed = _forward_slice(program, feed_names, fetch_names)

@@ -1,0 +1,151 @@
+"""fluid.contrib.decoder beam-search stack (ref: fluid/contrib/decoder/
+beam_search_decoder.py): StateCell updater protocol, TrainingDecoder
+teacher-forced training, BeamSearchDecoder decode parity on a learnable
+chain task, and the reference error paths.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.fluid.contrib.decoder.beam_search_decoder import (
+    BeamSearchDecoder, InitState, StateCell, TrainingDecoder)
+
+V, D, H, T, B = 20, 16, 32, 5, 8
+
+
+class _Setup:
+    def __init__(self):
+        pt.seed(0)
+        self.emb = nn.Embedding(V, D)
+        self.wx = nn.Linear(D, H)
+        self.uh = nn.Linear(H, H, bias_attr=False)
+        self.proj = nn.Linear(H, V)
+        self.enc = nn.Linear(D, H)
+        self.rng = np.random.RandomState(0)
+        self.opt = pt.optimizer.Adam(
+            learning_rate=5e-3,
+            parameters=(list(self.emb.parameters())
+                        + list(self.wx.parameters())
+                        + list(self.uh.parameters())
+                        + list(self.proj.parameters())
+                        + list(self.enc.parameters())))
+
+    @staticmethod
+    def rot(x):
+        return ((x - 3 + 1) % (V - 3)) + 3
+
+    def batch(self):
+        # chain task: trg[0]=src[0], trg[t]=rot(trg[t-1]) — every target
+        # token is determined by the previous one, so the RNN cell can
+        # learn it exactly
+        src = self.rng.randint(3, V, (B, 1)).astype("int64")
+        trg = np.zeros((B, T), "int64")
+        trg[:, 0] = src[:, 0]
+        for t in range(1, T):
+            trg[:, t] = self.rot(trg[:, t - 1])
+        return src, trg
+
+    def make_cell(self, src_ids):
+        h0 = pt.tanh(self.enc(pt.mean(self.emb(pt.to_tensor(src_ids)),
+                                      axis=1)))
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=h0)}, out_state="h")
+
+        @cell.state_updater
+        def updater(c):
+            x = c.get_input("x")
+            h = c.get_state("h")
+            c.set_state("h", pt.tanh(self.wx(x) + self.uh(h)))
+
+        return cell
+
+    def train(self, steps=150):
+        losses = []
+        for _ in range(steps):
+            src, trg = self.batch()
+            cell = self.make_cell(src)
+            dec = TrainingDecoder(cell)
+            trg_in = np.concatenate(
+                [np.zeros((B, 1), "int64"), trg[:, :-1]], 1)
+            trg_emb = self.emb(pt.to_tensor(trg_in))
+
+            @dec.block
+            def _(d):
+                w = d.step_input(trg_emb)
+                d.state_cell.compute_state(inputs={"x": w})
+                score = self.proj(d.state_cell.get_state("h"))
+                d.state_cell.update_states()
+                d.output(score)
+
+            logits = dec()
+            loss = pt.nn.functional.cross_entropy(
+                pt.reshape(logits, [B * T, V]),
+                pt.to_tensor(trg.reshape(-1)), reduction="mean")
+            loss.backward()
+            self.opt.step()
+            self.opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        return losses
+
+
+def test_train_then_beam_decode_exact():
+    s = _Setup()
+    losses = s.train()
+    assert losses[-1] < 0.3, losses[-1]
+
+    src, trg = s.batch()
+    cell = s.make_cell(src)
+    bsd = BeamSearchDecoder(
+        cell, init_ids=pt.to_tensor(np.zeros((B, 1), "int64")),
+        init_scores=pt.to_tensor(np.zeros((B, 1), "float32")),
+        target_dict_dim=V, word_dim=D, beam_size=3, max_len=T, end_id=1)
+    # share the trained embedding/projection (the reference shares them
+    # by param-name save/load across the train and infer programs)
+    bsd._emb, bsd._fc = s.emb, s.proj
+    bsd.decode()
+    ids, scores = bsd()
+    assert tuple(np.asarray(ids.numpy()).shape) == (B, 3, T)
+    best = np.asarray(ids.numpy())[:, 0, :]
+    assert (best == trg).mean() > 0.9
+    # beams are sorted by accumulated log-prob
+    sc = np.asarray(scores.numpy())
+    assert np.all(sc[:, 0] >= sc[:, 1] - 1e-5)
+
+
+def test_state_cell_protocol_errors():
+    cell = StateCell(inputs={"x": None},
+                     states={"h": InitState(init=pt.zeros([2, 4]))},
+                     out_state="h")
+    with pytest.raises(ValueError):
+        cell.compute_state(inputs={"x": pt.zeros([2, 4])})  # no updater
+
+    @cell.state_updater
+    def upd(c):
+        c.set_state("h", c.get_state("h"))
+
+    cell._reset()
+    with pytest.raises(ValueError):
+        cell.compute_state(inputs={"bogus": pt.zeros([2, 4])})
+    with pytest.raises(ValueError):
+        cell.get_state("nope")
+    cell.compute_state(inputs={"x": pt.zeros([2, 4])})
+    with pytest.raises(ValueError):
+        cell.get_input("unfed")
+
+
+def test_init_state_shapes():
+    boot = pt.zeros([3, 7])
+    st = InitState(shape=[5], value=1.5, init_boot=boot)
+    assert tuple(st.value.shape) == (3, 5)
+    assert float(np.asarray(st.value.numpy()).max()) == 1.5
+    with pytest.raises(ValueError):
+        InitState(shape=[5])  # needs init or init_boot
+
+
+def test_training_decoder_block_rejects_with():
+    dec = TrainingDecoder(StateCell(
+        inputs={"x": None},
+        states={"h": InitState(init=pt.zeros([2, 4]))}, out_state="h"))
+    with pytest.raises(TypeError):
+        dec.block()  # with-statement spelling: callable required

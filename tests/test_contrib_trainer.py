@@ -1,0 +1,184 @@
+"""fluid.contrib Trainer/Inferencer high-level API (ref:
+fluid/contrib/trainer.py, inferencer.py; book high-level-api chapters):
+event loop, checkpoint save/cap/resume, test() averaging, params
+round-trip into an Inferencer, and the legacy fluid.layers.data
+append_batch_size semantics it depends on.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.contrib.trainer import (BeginStepEvent,
+                                              CheckpointConfig,
+                                              EndStepEvent, Trainer)
+from paddle_tpu.fluid.contrib.inferencer import Inferencer
+
+RNG = np.random.RandomState(0)
+W = RNG.randn(13, 1).astype("float32")
+
+
+def _reader():
+    def r():
+        for _ in range(8):
+            X = RNG.randn(4, 13).astype("float32")
+            yield [(X[i], X[i] @ W) for i in range(4)]
+
+    return r
+
+
+def _train_func():
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    return [loss, pred]
+
+
+def _infer_func():
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    return fluid.layers.fc(input=x, size=1)
+
+
+def _opt_func():
+    return pt.optimizer.SGD(learning_rate=0.05)
+
+
+class TestTrainer:
+    def test_event_loop_checkpoints_and_inference(self, tmp_path):
+        ckpt = str(tmp_path / "ck")
+        events, losses = [], []
+
+        def handler(ev):
+            events.append(type(ev).__name__)
+            if isinstance(ev, EndStepEvent):
+                losses.append(float(np.asarray(ev.metrics[0])))
+
+        tr = Trainer(train_func=_train_func, optimizer_func=_opt_func,
+                     checkpoint_config=CheckpointConfig(
+                         ckpt, max_num_checkpoints=2, step_interval=4))
+        tr.train(num_epochs=3, event_handler=handler, reader=_reader(),
+                 feed_order=["x", "y"])
+        assert losses[-1] < losses[0] * 0.5
+        for name in ("BeginEpochEvent", "BeginStepEvent", "EndStepEvent",
+                     "EndEpochEvent"):
+            assert name in events
+        # keep-last-k: at most max_num_checkpoints serials on disk
+        assert 0 < len(os.listdir(ckpt)) <= 2
+
+        test_metrics = tr.test(reader=_reader(), feed_order=["x", "y"])
+        assert float(test_metrics[0]) < losses[0]
+
+        pdir = str(tmp_path / "params")
+        tr.save_params(pdir)
+        inf = Inferencer(infer_func=_infer_func, param_path=pdir)
+        X = RNG.randn(6, 13).astype("float32")  # any batch size works
+        (out,) = inf.infer({"x": X})
+        assert out.shape == (6, 1)
+        assert np.abs(out - X @ W).mean() < 1.0
+
+        # a fresh Trainer resumes from the latest serial
+        tr2 = Trainer(train_func=_train_func, optimizer_func=_opt_func,
+                      checkpoint_config=CheckpointConfig(
+                          ckpt, max_num_checkpoints=2, step_interval=4))
+        assert tr2.checkpoint_cfg.load_serial is not None
+
+    def test_stop_and_fetch_metrics_flag(self):
+        seen = {"steps": 0, "empty_metrics": False}
+
+        def handler(ev):
+            if isinstance(ev, BeginStepEvent):
+                ev.fetch_metrics = False
+            if isinstance(ev, EndStepEvent):
+                seen["steps"] += 1
+                seen["empty_metrics"] = ev.metrics == []
+                tr.stop()
+
+        tr = Trainer(train_func=_train_func, optimizer_func=_opt_func)
+        tr.train(num_epochs=5, event_handler=handler, reader=_reader(),
+                 feed_order=["x", "y"])
+        assert seen["steps"] == 1  # stop() halts after the first step
+        assert seen["empty_metrics"]
+
+    def test_optimizer_type_check(self):
+        with pytest.raises(TypeError):
+            Trainer(train_func=_train_func, optimizer_func=lambda: object())
+
+
+def test_legacy_data_appends_batch_dim():
+    """fluid.layers.data declares PER-SAMPLE shape (ref layers/io.py:48
+    append_batch_size=True); 2.x static.data takes the full shape."""
+    pt.enable_static()
+    try:
+        main, startup = pt.static.Program(), pt.static.Program()
+        with pt.static.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+            assert tuple(x.shape) == (1, 13)  # batch placeholder prepended
+            x2 = fluid.layers.data(name="x2", shape=[-1, 13],
+                                   dtype="float32")
+            assert tuple(x2.shape) == (1, 13)  # explicit -1 not doubled
+            x3 = fluid.layers.data(name="x3", shape=[7, 13],
+                                   dtype="float32",
+                                   append_batch_size=False)
+            assert tuple(x3.shape) == (7, 13)
+    finally:
+        pt.disable_static()
+
+
+def test_legacy_data_2x_positional_dtype_and_negative_dims():
+    """data(name, full_shape, "float32") is the 2.x positional-dtype
+    call (no batch prepend); any -1/None dim also means full shape
+    (ref layers/io.py append_batch_size handling)."""
+    pt.enable_static()
+    try:
+        main, startup = pt.static.Program(), pt.static.Program()
+        with pt.static.program_guard(main, startup):
+            a = fluid.layers.data("a", [4, 4], "float32")
+            assert tuple(a.shape) == (4, 4)
+            b = fluid.layers.data("b", [3, -1, 5], dtype="float32")
+            assert tuple(b.shape) == (3, 1, 5)  # -1 dim: no prepend
+    finally:
+        pt.disable_static()
+
+
+def test_resume_skips_replayed_steps(tmp_path):
+    """After loading a checkpoint taken at (epoch, step), the resumed
+    run must not re-apply the steps before it."""
+    ckpt = str(tmp_path / "ck")
+    tr = Trainer(train_func=_train_func, optimizer_func=_opt_func,
+                 checkpoint_config=CheckpointConfig(ckpt, step_interval=6))
+    tr.train(num_epochs=1, event_handler=lambda ev: None,
+             reader=_reader(), feed_order=["x", "y"])
+
+    tr2 = Trainer(train_func=_train_func, optimizer_func=_opt_func,
+                  checkpoint_config=CheckpointConfig(ckpt,
+                                                     step_interval=6))
+    assert tr2.checkpoint_cfg.load_serial is not None
+    steps = []
+
+    def handler(ev):
+        if isinstance(ev, EndStepEvent):
+            steps.append((ev.epoch, ev.step))
+
+    tr2.train(num_epochs=1, event_handler=handler, reader=_reader(),
+              feed_order=["x", "y"])
+    resumed_from = tr2.checkpoint_cfg.step_id
+    assert all(s > resumed_from for e, s in steps if e == 0)
+
+
+def test_inferencer_predictor_mode(tmp_path):
+    """infer_func=None serves a save_inference_model bundle through the
+    Predictor (the pre-existing shim contract)."""
+    tr = Trainer(train_func=_train_func, optimizer_func=_opt_func)
+    tr.train(num_epochs=1, event_handler=lambda ev: None,
+             reader=_reader(), feed_order=["x", "y"])
+    bundle = str(tmp_path / "bundle")
+    tr.save_inference_model(bundle, ["x"], [1])
+    with pytest.warns(Warning):
+        inf = Inferencer(param_path=bundle)
+    X = RNG.randn(4, 13).astype("float32")
+    (out,) = inf.infer({"x": X})
+    assert np.asarray(out).shape == (4, 1)
