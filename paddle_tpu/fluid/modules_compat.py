@@ -227,9 +227,99 @@ def _install_contrib_faces(fluid_pkg):
     # NB: contrib re-exports the decoder BeamSearchDecoder in the
     # reference too, shadowing none of layers' dynamic-decode API
     contrib.BeamSearchDecoder = _cd.BeamSearchDecoder
+
+    # contrib.slim package tree (ref: fluid/contrib/slim/; homes:
+    # paddle_tpu/slim/compressor.py + quant/passes.py)
+    from .. import slim as _sl
+
+    slim_faces = {
+        "core.compressor": dict(Compressor=_sl.Compressor,
+                                Context=_sl.Context),
+        "core.config": dict(ConfigFactory=_sl.ConfigFactory),
+        "core.strategy": dict(Strategy=_sl.Strategy),
+        "prune.pruner": dict(StructurePruner=_sl.StructurePruner,
+                             Pruner=_sl.Pruner,
+                             MagnitudePruner=_sl.MagnitudePruner),
+        "prune.prune_strategy": dict(
+            PruneStrategy=_sl.PruneStrategy,
+            UniformPruneStrategy=_sl.UniformPruneStrategy,
+            SensitivePruneStrategy=_sl.SensitivePruneStrategy),
+        "prune.auto_prune_strategy": dict(
+            AutoPruneStrategy=_sl.AutoPruneStrategy),
+        "distillation.distiller": dict(
+            L2Distiller=_sl.L2Distiller, FSPDistiller=_sl.FSPDistiller,
+            SoftLabelDistiller=_sl.SoftLabelDistiller),
+        "distillation.distillation_strategy": dict(
+            DistillationStrategy=_sl.DistillationStrategy),
+        "quantization.quantization_pass": dict(
+            QuantizationTransformPass=_sl.QuantizationTransformPass,
+            QuantizationFreezePass=_sl.QuantizationFreezePass,
+            ConvertToInt8Pass=_sl.ConvertToInt8Pass,
+            TransformForMobilePass=_sl.TransformForMobilePass,
+            OutScaleForTrainingPass=_sl.OutScaleForTrainingPass,
+            OutScaleForInferencePass=_sl.OutScaleForInferencePass,
+            AddQuantDequantPass=_sl.AddQuantDequantPass),
+        "quantization.quantization_strategy": dict(
+            QuantizationStrategy=_sl.QuantizationStrategy),
+        "quantization.mkldnn_post_training_strategy": dict(
+            MKLDNNPostTrainingQuantStrategy=(
+                _sl.MKLDNNPostTrainingQuantStrategy)),
+        "quantization.qat_int8_mkldnn_pass": dict(
+            QatInt8MkldnnPass=_sl.compressor.QatInt8MkldnnPass),
+        "quantization.qat2_int8_mkldnn_pass": dict(
+            Qat2Int8MkldnnPass=_sl.compressor.Qat2Int8MkldnnPass),
+        "graph.graph_wrapper": dict(GraphWrapper=_sl.GraphWrapper,
+                                    VarWrapper=_sl.VarWrapper,
+                                    OpWrapper=_sl.OpWrapper),
+        "graph.executor": dict(SlimGraphExecutor=_sl.SlimGraphExecutor),
+        "searcher.controller": dict(
+            EvolutionaryController=_sl.EvolutionaryController,
+            SAController=_sl.SAController),
+        "nas.light_nas_strategy": dict(
+            LightNASStrategy=_sl.LightNASStrategy),
+        "nas.search_space": dict(SearchSpace=_sl.SearchSpace),
+        "nas.controller_server": dict(
+            ControllerServer=_sl.ControllerServer),
+        "nas.search_agent": dict(SearchAgent=_sl.SearchAgent),
+    }
+    pkg_mods = {}
+    for dotted, members in slim_faces.items():
+        top, leaf = dotted.split(".")
+        leaf_mod = _module(f"{base}.contrib.slim.{dotted}",
+                           f"ref: fluid/contrib/slim/{dotted}.py.",
+                           members)
+        pkg = pkg_mods.get(top)
+        if pkg is None:
+            pkg = pkg_mods[top] = _module(
+                f"{base}.contrib.slim.{top}",
+                f"ref: fluid/contrib/slim/{top}/.", {})
+        setattr(pkg, leaf, leaf_mod)
+        for k, v in members.items():
+            setattr(pkg, k, v)
+    slim_face = _module(
+        base + ".contrib.slim",
+        "ref: fluid/contrib/slim/ (home: paddle_tpu/slim).",
+        dict(Compressor=_sl.Compressor, **pkg_mods))
+    contrib.slim = slim_face
+    contrib.Compressor = _sl.Compressor
+
+    # contrib.quantize (ref: fluid/contrib/quantize/quantize_transpiler)
+    qt_face = _module(
+        base + ".contrib.quantize.quantize_transpiler",
+        "ref: fluid/contrib/quantize/quantize_transpiler.py.",
+        dict(QuantizeTranspiler=_sl.QuantizeTranspiler))
+    quantize_face = _module(
+        base + ".contrib.quantize",
+        "ref: fluid/contrib/quantize/.",
+        dict(quantize_transpiler=qt_face,
+             QuantizeTranspiler=_sl.QuantizeTranspiler))
+    contrib.quantize = quantize_face
+    contrib.QuantizeTranspiler = _sl.QuantizeTranspiler
+
     return {"contrib.mixed_precision": mixed_precision,
             "contrib.trainer": trainer_face,
-            "contrib.decoder": decoder_face}
+            "contrib.decoder": decoder_face,
+            "contrib.slim": slim_face}
 
 
 def _install_incubate_faces(fluid_pkg):

@@ -341,3 +341,33 @@ def distill_loss(task_loss, teacher_logits, student_logits,
 from .. import quant  # noqa: E402,F401
 from ..quant import (quantize_model, PostTrainingQuantization,  # noqa: E402,F401
                      fake_quantize_abs_max)
+
+# 1.x class surface: the Compressor framework (ref: contrib/slim/core)
+from .compressor import (  # noqa: E402,F401
+    Compressor, Context, Strategy, ConfigFactory,
+    PruneStrategy, UniformPruneStrategy, SensitivePruneStrategy,
+    AutoPruneStrategy, StructurePruner,
+    DistillationStrategy, L2Distiller, FSPDistiller, SoftLabelDistiller,
+    QuantizationStrategy, MKLDNNPostTrainingQuantStrategy,
+    LightNASStrategy, SearchSpace, ControllerServer, SearchAgent,
+    EvolutionaryController, SAController,
+    GraphWrapper, VarWrapper, OpWrapper, SlimGraphExecutor,
+)
+from ..quant.passes import (  # noqa: E402,F401
+    QuantizationTransformPass, QuantizationFreezePass, ConvertToInt8Pass,
+    TransformForMobilePass, OutScaleForTrainingPass,
+    OutScaleForInferencePass, AddQuantDequantPass, QuantizeTranspiler,
+)
+
+__all__ += [
+    "Compressor", "Context", "Strategy", "ConfigFactory",
+    "PruneStrategy", "UniformPruneStrategy", "SensitivePruneStrategy",
+    "AutoPruneStrategy", "StructurePruner", "DistillationStrategy",
+    "L2Distiller", "FSPDistiller", "SoftLabelDistiller",
+    "QuantizationStrategy", "EvolutionaryController", "SAController",
+    "GraphWrapper", "VarWrapper", "OpWrapper", "SlimGraphExecutor",
+    "QuantizationTransformPass", "QuantizationFreezePass",
+    "ConvertToInt8Pass", "TransformForMobilePass",
+    "OutScaleForTrainingPass", "OutScaleForInferencePass",
+    "AddQuantDequantPass", "QuantizeTranspiler",
+]

@@ -1,0 +1,902 @@
+"""slim 1.x class surface: the Compressor framework
+(ref: python/paddle/fluid/contrib/slim/{core,prune,distillation,
+quantization,graph,searcher}/).
+
+The reference Compressor rewrites ProgramDesc graphs (channel surgery,
+distiller sub-graphs, quant op insertion) driven by epoch-scheduled
+Strategies from a yaml config. The XLA-era redesign keeps the 1.x
+class names, the yaml schema, and the Strategy callback protocol
+(on_compression_begin/epoch/batch/...), but composes over eager
+``nn.Layer`` models instead of program surgery:
+
+- pruning = persistent magnitude masks re-applied after each update
+  (dense masked arrays — the TPU-friendly form; see slim/__init__.py);
+- distillation = forward hooks capturing named teacher/student
+  features, combined into the loss via slim's distill primitives;
+- quantization = QAT wrapping (quant/) on a schedule.
+
+GraphWrapper remains graph-level: it wraps a static ``Program`` for
+inspection, as the reference wraps IrGraph.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import os
+import re
+
+import numpy as np
+
+from ..fluid.log_helper import get_logger
+
+_logger = get_logger(__name__, logging.INFO,
+                     fmt="%(asctime)s-%(levelname)s: %(message)s")
+
+__all__ = [
+    "Context", "Strategy", "Compressor", "ConfigFactory",
+    "PruneStrategy", "UniformPruneStrategy", "SensitivePruneStrategy",
+    "AutoPruneStrategy", "StructurePruner",
+    "DistillationStrategy", "L2Distiller", "FSPDistiller",
+    "SoftLabelDistiller", "QuantizationStrategy",
+    "MKLDNNPostTrainingQuantStrategy", "QatInt8MkldnnPass",
+    "Qat2Int8MkldnnPass", "LightNASStrategy", "SearchSpace",
+    "ControllerServer", "SearchAgent", "EvolutionaryController",
+    "SAController", "GraphWrapper", "VarWrapper", "OpWrapper",
+    "SlimGraphExecutor",
+]
+
+
+class Context:
+    """ref: core/compressor.py:77 — the state bag strategies see."""
+
+    def __init__(self, place=None, scope=None, train_graph=None,
+                 eval_graph=None, optimizer=None, eval_func=None):
+        self.place = place
+        self.scope = scope
+        self.train_graph = train_graph      # the model (nn.Layer)
+        self.eval_graph = eval_graph or train_graph
+        self.optimizer = optimizer
+        self.eval_func = eval_func
+        self.epoch_id = 0
+        self.batch_id = 0
+        self.batch = None                   # current (inputs...) tuple
+        self.k_v = {}
+        self.eval_results = {}
+
+    def run_eval_graph(self, sampled_rate=None, cached_id=0):
+        """ref: compressor.py:171 — evaluate and record the result."""
+        if self.eval_func is None:
+            raise ValueError("no eval_func configured")
+        res = float(self.eval_func(self.eval_graph))
+        self.eval_results.setdefault("metric", []).append(res)
+        return res, None
+
+    def put(self, key, value):
+        self.k_v[key] = value
+
+    def get(self, key):
+        return self.k_v.get(key)
+
+
+class Strategy:
+    """ref: core/strategy.py:18 — epoch-scheduled callback bundle."""
+
+    def __init__(self, start_epoch=0, end_epoch=0):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def __getstate__(self):
+        d = {}
+        for k, v in self.__dict__.items():
+            if not isinstance(v, (int, float, str, list, dict, tuple,
+                                  type(None))):
+                continue
+            d[k] = v
+        return d
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_batch_begin(self, context):
+        pass
+
+    def on_batch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass
+
+    def restore_from_checkpoint(self, context):
+        pass
+
+    def loss_terms(self, context):
+        """Extra loss tensors the Compressor adds while this strategy is
+        active (XLA-era hook; distillation uses it)."""
+        return []
+
+
+# -- pruning ----------------------------------------------------------------
+
+from . import MagnitudePruner, StructuredPruner  # noqa: E402
+
+# ref: prune/pruner.py StructurePruner — axis/criterion channel pruner;
+# the structured (whole-filter) pruner is the same object here
+StructurePruner = StructuredPruner
+
+
+class PruneStrategy(Strategy):
+    """ref: prune/prune_strategy.py:36 — magnitude masks over params
+    matching ``pruned_params`` (a regex on parameter names), re-applied
+    after every optimizer step so pruned weights stay dead."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, metric_name=None,
+                 pruned_params="conv.*_w.*|.*weight.*"):
+        super().__init__(start_epoch, end_epoch)
+        self.pruner = pruner or MagnitudePruner()
+        self.target_ratio = target_ratio
+        self.metric_name = metric_name
+        self.pruned_params = pruned_params
+
+    def _target_params(self, model):
+        """Params whose hierarchical name OR unique param name matches
+        the regex (the reference matches on param names)."""
+        pat = re.compile(self.pruned_params)
+        out = []
+        for name, p in model.named_parameters():
+            if (pat.search(name) or pat.search(p.name)) and \
+                    len(p.shape) >= 2:  # biases/scalars never pruned
+                out.append((name, p))
+        return out
+
+    def _ratios(self, context):
+        return {name: self.target_ratio
+                for name, _ in self._target_params(context.train_graph)}
+
+    def _build_masks(self, context):
+        by_name = self._ratios(context)
+        targets = self._target_params(context.train_graph)
+        # Pruner keys ratios on the unique param name
+        self.pruner.prune([p for _, p in targets],
+                          ratios={p.name: by_name[n]
+                                  for n, p in targets})
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch:
+            self._build_masks(context)
+            _logger.info(f"pruned {self.sparsity():.1%} of targeted "
+                         "weights")
+
+    def on_batch_end(self, context):
+        if self.pruner.masks and context.epoch_id >= self.start_epoch:
+            self.pruner.reapply()
+
+    def sparsity(self):
+        return self.pruner.sparsity()
+
+
+class UniformPruneStrategy(PruneStrategy):
+    """ref: prune_strategy.py:563 — one ratio for every target param."""
+
+
+class SensitivePruneStrategy(PruneStrategy):
+    """ref: prune_strategy.py:672 — per-param ratios from a sensitivity
+    scan (slim.sensitivity): prune less where the metric degrades
+    fastest."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, metric_name=None,
+                 pruned_params=".*weight.*", eval_rate=None,
+                 sensitivities_file=None, sensitivities=None,
+                 num_steps=1, delta_rate=0.2):
+        super().__init__(pruner, start_epoch, end_epoch, target_ratio,
+                         metric_name, pruned_params)
+        self.sensitivities = sensitivities or {}
+
+    def _ratios(self, context):
+        from . import sensitive_prune_ratios, sensitivity
+
+        model = context.train_graph
+        targets = self._target_params(model)
+        if not self.sensitivities:
+            if context.eval_func is None:
+                raise ValueError(
+                    "SensitivePruneStrategy needs eval_func (or a "
+                    "precomputed sensitivities= dict)")
+            # sensitivity() wants Parameter objects and a zero-arg
+            # eval_fn (higher = better)
+            self.sensitivities = sensitivity(
+                model, lambda: float(context.eval_func(model)),
+                params=[p for _, p in targets])
+        # sensitivities key on unique param names; map back to the
+        # hierarchical names _build_masks ratios use
+        by_pname = sensitive_prune_ratios(self.sensitivities,
+                                          target_loss=self.target_ratio)
+        mean = (sum(by_pname.values()) / len(by_pname)) if by_pname \
+            else self.target_ratio
+        # accept either key spelling (unique param name or hierarchical)
+        return {n: by_pname.get(p.name, by_pname.get(n, mean))
+                for n, p in targets}
+
+
+class AutoPruneStrategy(PruneStrategy):
+    """ref: prune/auto_prune_strategy.py — controller-searched per-param
+    ratios; each on_epoch_begin proposes tokens via SAController, prunes
+    accordingly, and rewards the controller with the eval metric."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=10,
+                 target_ratio=0.5, metric_name=None,
+                 pruned_params=".*weight.*", retrain_epoch=0,
+                 controller=None):
+        super().__init__(pruner, start_epoch, end_epoch, target_ratio,
+                         metric_name, pruned_params)
+        self._controller = controller
+        self._levels = [max(0.0, target_ratio - 0.2), target_ratio,
+                        min(0.95, target_ratio + 0.2)]
+        self._tokens = None
+
+    def on_epoch_begin(self, context):
+        if not (self.start_epoch <= context.epoch_id <= self.end_epoch):
+            return
+        names = [n for n, _ in self._target_params(context.train_graph)]
+        if self._controller is None:
+            self._controller = SAController(
+                range_table=[len(self._levels)] * len(names))
+        self._tokens = self._controller.next_tokens()
+        self._ratio_map = {n: self._levels[t]
+                           for n, t in zip(names, self._tokens)}
+        self._build_masks(context)
+
+    def _ratios(self, context):
+        return getattr(self, "_ratio_map", None) or super()._ratios(context)
+
+    def on_epoch_end(self, context):
+        if self._tokens is not None and context.eval_func is not None:
+            reward, _ = context.run_eval_graph()
+            self._controller.update(self._tokens, reward)
+
+
+# -- distillation ------------------------------------------------------------
+
+
+class _FeatureTap:
+    """Forward hooks capturing named sublayer outputs."""
+
+    def __init__(self, model, names):
+        self.feats = {}
+        self._handles = []
+        wanted = set(names)
+        for name, layer in model.named_sublayers():
+            if name in wanted:
+                self._handles.append(layer.register_forward_post_hook(
+                    self._make(name)))
+                wanted.discard(name)
+        if wanted:
+            raise ValueError(f"sublayers not found for distillation: "
+                             f"{sorted(wanted)}")
+
+    def _make(self, name):
+        def hook(layer, inputs, output):
+            self.feats[name] = output
+            return output
+
+        return hook
+
+    def remove(self):
+        for h in self._handles:
+            h.remove()
+
+
+class L2Distiller:
+    """ref: distillation/distiller.py:25 — L2 between a student and a
+    teacher feature map (sublayer names)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 distillation_loss_weight=1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, s_feats, t_feats):
+        from . import l2_distill
+
+        return self.weight * l2_distill(
+            t_feats[self.teacher_feature_map],
+            s_feats[self.student_feature_map])
+
+    def student_names(self):
+        return [self.student_feature_map]
+
+    def teacher_names(self):
+        return [self.teacher_feature_map]
+
+
+class FSPDistiller:
+    """ref: distiller.py:103 — match flow-of-solution-procedure matrices
+    between (start, end) feature pairs."""
+
+    def __init__(self, student_pairs, teacher_pairs,
+                 distillation_loss_weight=1.0):
+        self.student_pairs = student_pairs
+        self.teacher_pairs = teacher_pairs
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, s_feats, t_feats):
+        from . import fsp_distill
+
+        t = [(t_feats[a], t_feats[b]) for a, b in self.teacher_pairs]
+        s = [(s_feats[a], s_feats[b]) for a, b in self.student_pairs]
+        return self.weight * fsp_distill(t, s)
+
+    def student_names(self):
+        return [n for pair in self.student_pairs for n in pair]
+
+    def teacher_names(self):
+        return [n for pair in self.teacher_pairs for n in pair]
+
+
+class SoftLabelDistiller:
+    """ref: distiller.py:195 — KL between temperature-softened
+    teacher/student logits."""
+
+    def __init__(self, student_feature_map=None, teacher_feature_map=None,
+                 student_temperature=1.0, teacher_temperature=1.0,
+                 distillation_loss_weight=1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.student_temperature = student_temperature
+        self.teacher_temperature = teacher_temperature
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, s_feats, t_feats):
+        from . import soft_label_distill
+
+        return self.weight * soft_label_distill(
+            t_feats[self.teacher_feature_map],
+            s_feats[self.student_feature_map],
+            teacher_temperature=self.teacher_temperature,
+            student_temperature=self.student_temperature)
+
+    def student_names(self):
+        return [self.student_feature_map]
+
+    def teacher_names(self):
+        return [self.teacher_feature_map]
+
+
+class DistillationStrategy(Strategy):
+    """ref: distillation/distillation_strategy.py — while active, the
+    Compressor adds each distiller's loss (teacher features captured by
+    hooks on the teacher model running the same batch)."""
+
+    def __init__(self, distillers=None, start_epoch=0, end_epoch=0,
+                 teacher=None):
+        super().__init__(start_epoch, end_epoch)
+        self.distillers = distillers or []
+        self.teacher = teacher
+        self._s_tap = self._t_tap = None
+
+    def on_compression_begin(self, context):
+        if self.teacher is None:
+            self.teacher = context.get("teacher")
+        if self.teacher is None:
+            raise ValueError("DistillationStrategy needs a teacher model "
+                             "(pass teacher= or context.put('teacher', m))")
+        s_names = [n for d in self.distillers for n in d.student_names()]
+        t_names = [n for d in self.distillers for n in d.teacher_names()]
+        self._s_tap = _FeatureTap(context.train_graph, s_names)
+        self._t_tap = _FeatureTap(self.teacher, t_names)
+        self.teacher.eval()
+
+    def loss_terms(self, context):
+        if not (self.start_epoch <= context.epoch_id <= self.end_epoch):
+            return []
+        # teacher forward on the SAME model inputs the student saw:
+        # batch convention is (inputs..., label), so everything but the
+        # trailing label feeds the teacher (no grad)
+        from ..core import no_grad
+
+        args = context.batch[:-1] if len(context.batch) > 1 \
+            else context.batch
+        with no_grad():
+            self.teacher(*args)
+        return [d.distiller_loss(self._s_tap.feats, self._t_tap.feats)
+                for d in self.distillers]
+
+    def on_compression_end(self, context):
+        if self._s_tap:
+            self._s_tap.remove()
+        if self._t_tap:
+            self._t_tap.remove()
+
+
+# -- quantization ------------------------------------------------------------
+
+
+class QuantizationStrategy(Strategy):
+    """ref: quantization/quantization_strategy.py — QAT-wrap the model
+    at start_epoch (fake-quant STE from quant/); after end_epoch the
+    trained scales ship via quantize_inference_model."""
+
+    def __init__(self, start_epoch=0, end_epoch=0, weight_bits=8,
+                 activation_bits=8, float_model_save_path=None,
+                 int8_model_save_path=None, **kw):
+        super().__init__(start_epoch, end_epoch)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.float_model_save_path = float_model_save_path
+        self.int8_model_save_path = int8_model_save_path
+        self._qat = None
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch and self._qat is None:
+            from ..quant import QAT
+
+            self._qat = QAT(bits=self.weight_bits,
+                            quantize_inputs=self.activation_bits > 0)
+            context.train_graph = self._qat.quantize(context.train_graph)
+            context.eval_graph = context.train_graph
+            _logger.info("QAT wrapping applied "
+                         f"(w{self.weight_bits}/a{self.activation_bits})")
+
+    def on_compression_end(self, context):
+        """ref behavior: emit the float and the converted int8 model at
+        the end of the schedule."""
+        from ..framework.io import save
+
+        if self.float_model_save_path:
+            os.makedirs(self.float_model_save_path, exist_ok=True)
+            save(context.train_graph.state_dict(),
+                 os.path.join(self.float_model_save_path,
+                              "model.pdparams"))
+        if self.int8_model_save_path and self._qat is not None:
+            context.train_graph = self._qat.convert(context.train_graph)
+            context.eval_graph = context.train_graph
+            os.makedirs(self.int8_model_save_path, exist_ok=True)
+            save(context.train_graph.state_dict(),
+                 os.path.join(self.int8_model_save_path,
+                              "model.pdparams"))
+
+
+_MKLDNN_DESCOPE = (
+    "MKLDNN int8 lowering is Intel-x86 specific (SURVEY §4b descope); "
+    "on TPU the int8 path is quant.quantize_inference_model -> "
+    "Predictor (XLA lowering)")
+
+
+class MKLDNNPostTrainingQuantStrategy(Strategy):
+    """ref: quantization/mkldnn_post_training_strategy.py — x86-only
+    graph lowering; recorded descope."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MKLDNN_DESCOPE)
+
+
+class QatInt8MkldnnPass:
+    """ref: qat_int8_mkldnn_pass.py — recorded descope."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MKLDNN_DESCOPE)
+
+
+class Qat2Int8MkldnnPass(QatInt8MkldnnPass):
+    """ref: qat2_int8_mkldnn_pass.py — recorded descope."""
+
+
+_NAS_DESCOPE = (
+    "slim light-NAS is a controller-server search harness (SURVEY §4b "
+    "descope); the searchable capabilities (pruning ratios, quant, "
+    "distillation) are all live in paddle_tpu.slim — drive them with "
+    "SAController in plain user code")
+
+
+class LightNASStrategy(Strategy):
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_NAS_DESCOPE)
+
+
+class SearchSpace:
+    """ref: nas/search_space.py — abstract token space. Subclass and
+    implement init_tokens/range_table/create_net (the controller side,
+    SAController, is live)."""
+
+    def init_tokens(self):
+        raise NotImplementedError
+
+    def range_table(self):
+        raise NotImplementedError
+
+    def create_net(self, tokens=None):
+        raise NotImplementedError
+
+
+class ControllerServer:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_NAS_DESCOPE)
+
+
+class SearchAgent:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_NAS_DESCOPE)
+
+
+# -- searcher ----------------------------------------------------------------
+
+
+class EvolutionaryController:
+    """ref: searcher/controller.py — propose/update protocol."""
+
+    def update(self, tokens, reward):
+        raise NotImplementedError
+
+    def reset(self, range_table, constrain_func=None):
+        raise NotImplementedError
+
+    def next_tokens(self):
+        raise NotImplementedError
+
+
+class SAController(EvolutionaryController):
+    """ref: searcher/controller.py SAController — simulated annealing
+    over integer token vectors."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_try_times=None, seed=0):
+        self._range_table = list(range_table or [])
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_try_times = max_try_times
+        self._rng = np.random.RandomState(seed)
+        self._iter = 0
+        self._tokens = [self._rng.randint(0, r)
+                        for r in self._range_table]
+        self._reward = -math.inf
+        self._best_tokens = list(self._tokens)
+        self._best_reward = -math.inf
+        self._constrain_func = None
+
+    def reset(self, range_table, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = [self._rng.randint(0, r)
+                        for r in self._range_table]
+
+    def next_tokens(self):
+        """Mutate one position of the current tokens."""
+        new = list(self._tokens)
+        if new:
+            for _ in range(100):
+                i = self._rng.randint(0, len(new))
+                new[i] = self._rng.randint(0, self._range_table[i])
+                if self._constrain_func is None or \
+                        self._constrain_func(new):
+                    break
+        return new
+
+    def update(self, tokens, reward):
+        """Metropolis accept/reject at the current temperature."""
+        self._iter += 1
+        temp = self._init_temperature * (self._reduce_rate ** self._iter)
+        if reward > self._reward or self._rng.rand() <= math.exp(
+                min(0.0, (reward - self._reward)) / max(temp, 1e-9)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._best_reward:
+            self._best_reward = reward
+            self._best_tokens = list(tokens)
+
+    @property
+    def best_tokens(self):
+        return list(self._best_tokens)
+
+    @property
+    def max_reward(self):
+        return self._best_reward
+
+
+# -- graph wrappers ----------------------------------------------------------
+
+
+class VarWrapper:
+    """ref: graph/graph_wrapper.py VarWrapper over a Program var."""
+
+    def __init__(self, var, graph):
+        self._var = var
+        self._graph = graph
+
+    def name(self):
+        return self._var.name
+
+    def shape(self):
+        return list(self._var.shape)
+
+    def is_parameter(self):
+        return bool(getattr(self._var, "is_parameter", False))
+
+    def is_persistable(self):
+        return bool(getattr(self._var, "persistable", False))
+
+    def inputs(self):
+        return [OpWrapper(op, self._graph)
+                for op in self._graph._program.global_block.ops
+                if self._var.name in op.output_names]
+
+    def outputs(self):
+        return [OpWrapper(op, self._graph)
+                for op in self._graph._program.global_block.ops
+                if self._var.name in op.input_names]
+
+
+class OpWrapper:
+    """ref: graph_wrapper.py OpWrapper over a Program op."""
+
+    def __init__(self, op, graph):
+        self._op = op
+        self._graph = graph
+
+    def type(self):
+        return self._op.type
+
+    def attr(self, name):
+        return self._op.attrs.get(name)
+
+    def all_inputs(self):
+        blk = self._graph._program.global_block
+        return [VarWrapper(blk.var(n), self._graph)
+                for n in self._op.input_names
+                if n is not None and blk.has_var(n)]
+
+    def all_outputs(self):
+        blk = self._graph._program.global_block
+        return [VarWrapper(blk.var(n), self._graph)
+                for n in self._op.output_names if blk.has_var(n)]
+
+
+class GraphWrapper:
+    """ref: graph_wrapper.py:33 — inspection over a static Program."""
+
+    def __init__(self, program, in_nodes=None, out_nodes=None):
+        self._program = program
+        self.in_nodes = dict(in_nodes or {})
+        self.out_nodes = dict(out_nodes or {})
+
+    def all_parameters(self):
+        return [VarWrapper(v, self)
+                for v in self._program.global_block.all_parameters()]
+
+    def vars(self):
+        return [VarWrapper(v, self)
+                for v in self._program.global_block.vars.values()]
+
+    def var(self, name):
+        return VarWrapper(self._program.global_block.var(name), self)
+
+    def ops(self):
+        return [OpWrapper(op, self)
+                for op in self._program.global_block.ops]
+
+    def numel_params(self):
+        return int(sum(np.prod(v.shape()) or 0
+                       for v in self.all_parameters()))
+
+    def program(self):
+        return self._program
+
+
+class SlimGraphExecutor:
+    """ref: graph/executor.py — thin Executor front over a wrapped
+    graph."""
+
+    def __init__(self, place=None):
+        from ..static_ import Executor
+
+        self._exe = Executor(place)
+
+    def run(self, graph, scope=None, data=None, feed=None,
+            fetch_list=None):
+        program = graph.program() if isinstance(graph, GraphWrapper) \
+            else graph
+        fetches = fetch_list or list(
+            getattr(graph, "out_nodes", {}).values())
+        return self._exe.run(program, feed=feed or data,
+                             fetch_list=fetches, scope=scope)
+
+
+# -- config + compressor ------------------------------------------------------
+
+
+_STRATEGY_CLASSES = {}
+
+
+def _register_strategies():
+    for c in (UniformPruneStrategy, SensitivePruneStrategy,
+              AutoPruneStrategy, PruneStrategy, DistillationStrategy,
+              QuantizationStrategy, MKLDNNPostTrainingQuantStrategy,
+              LightNASStrategy):
+        _STRATEGY_CLASSES[c.__name__] = c
+
+
+_register_strategies()
+
+
+class ConfigFactory:
+    """ref: core/config.py — parse the 1.x slim yaml schema::
+
+        version: 1.0
+        strategies:
+          prune_s:
+            class: UniformPruneStrategy
+            target_ratio: 0.5
+        compressor:
+          epoch: 3
+          strategies: [prune_s]
+
+    Accepts a yaml path or an equivalent dict."""
+
+    def __init__(self, config):
+        if isinstance(config, str):
+            import yaml
+
+            with open(config) as f:
+                config = yaml.safe_load(f)
+        self._cfg = config or {}
+        self.compressor = dict(self._cfg.get("compressor", {}))
+        # auxiliary sections build first so strategies can reference
+        # their entries BY NAME (the 1.x schema: pruner: 'pruner_1')
+        self._named = {}
+        aux_classes = {
+            "pruners": {"StructurePruner": StructurePruner,
+                        "MagnitudePruner": MagnitudePruner,
+                        "Pruner": MagnitudePruner},
+            "distillers": {"L2Distiller": L2Distiller,
+                           "FSPDistiller": FSPDistiller,
+                           "SoftLabelDistiller": SoftLabelDistiller},
+            "controllers": {"SAController": SAController,
+                            "EvolutionaryController":
+                                EvolutionaryController},
+        }
+        for section, classes in aux_classes.items():
+            for name, spec in (self._cfg.get(section) or {}).items():
+                spec = dict(spec)
+                cls = classes[spec.pop("class")]
+                try:
+                    self._named[name] = cls(**spec)
+                except TypeError:
+                    # pruner classes take criterion-style kwargs the
+                    # reference schema sometimes omits/renames; fall
+                    # back to a default instance
+                    self._named[name] = cls()
+        self._instances = {}
+        for name, spec in (self._cfg.get("strategies") or {}).items():
+            spec = {k: self._resolve(v) for k, v in dict(spec).items()}
+            cls_name = spec.pop("class")
+            cls = _STRATEGY_CLASSES[cls_name]
+            self._instances[name] = cls(**spec)
+
+    def _resolve(self, value):
+        """A string (or list of strings) naming an aux-section entry
+        resolves to the built instance."""
+        if isinstance(value, str) and value in self._named:
+            return self._named[value]
+        if isinstance(value, list):
+            return [self._named.get(v, v) if isinstance(v, str) else v
+                    for v in value]
+        return value
+
+    def instance(self, name):
+        return self._instances[name]
+
+    def strategies(self):
+        names = self.compressor.get("strategies") or \
+            list(self._instances)
+        return [self._instances[n] for n in names]
+
+
+class Compressor:
+    """ref: core/compressor.py:238 — the epoch loop driving strategies.
+
+    XLA-era signature: the model is an eager ``nn.Layer`` (``model=``,
+    or positionally where the reference takes ``train_program``); the
+    reader yields ``(inputs..., label)`` numpy batches; ``loss_fn(model,
+    *batch) -> scalar Tensor`` replaces the fetch-list loss var; the
+    optimizer is a live paddle_tpu optimizer. eval_func(model) -> float.
+    """
+
+    def __init__(self, place=None, scope=None, train_program=None,
+                 train_reader=None, train_feed_list=None,
+                 train_fetch_list=None, eval_program=None,
+                 eval_reader=None, eval_feed_list=None,
+                 eval_fetch_list=None, eval_func=None,
+                 save_eval_model=True, prune_infer_model=None,
+                 teacher_programs=(), checkpoint_path=None,
+                 train_optimizer=None, distiller_optimizer=None,
+                 search_space=None, log_period=20, model=None,
+                 loss_fn=None, epoch=1):
+        self.model = model if model is not None else train_program
+        if self.model is None:
+            raise ValueError("pass the model (nn.Layer) as model= or "
+                             "train_program=")
+        self.train_reader = train_reader
+        self.eval_func = eval_func
+        self.optimizer = train_optimizer
+        self.loss_fn = loss_fn
+        self.checkpoint_path = checkpoint_path
+        self.log_period = log_period
+        self.epoch = epoch
+        self.strategies = []
+        self.teachers = list(teacher_programs)
+        self.place = place
+        self.scope = scope
+
+    def config(self, config):
+        """Load strategies from a yaml path / dict / ConfigFactory."""
+        factory = config if isinstance(config, ConfigFactory) \
+            else ConfigFactory(config)
+        self.strategies = factory.strategies()
+        if "epoch" in factory.compressor:
+            self.epoch = int(factory.compressor["epoch"])
+        if factory.compressor.get("checkpoint_path"):
+            self.checkpoint_path = factory.compressor["checkpoint_path"]
+        return self
+
+    def run(self):
+        """Train ``epoch`` epochs with strategy callbacks; returns the
+        (possibly wrapped/pruned) model."""
+        if self.loss_fn is None or self.optimizer is None or \
+                self.train_reader is None:
+            raise ValueError("Compressor.run needs loss_fn, "
+                             "train_optimizer and train_reader")
+        context = Context(place=self.place, scope=self.scope,
+                          train_graph=self.model,
+                          optimizer=self.optimizer,
+                          eval_func=self.eval_func)
+        if self.teachers:
+            context.put("teacher", self.teachers[0])
+        for s in self.strategies:
+            s.on_compression_begin(context)
+        for epoch_id in range(self.epoch):
+            context.epoch_id = epoch_id
+            for s in self.strategies:
+                s.on_epoch_begin(context)
+            for batch_id, batch in enumerate(self.train_reader()):
+                context.batch_id = batch_id
+                from ..core.tensor import to_tensor
+
+                tensors = tuple(to_tensor(np.asarray(b)) for b in batch)
+                context.batch = tensors
+                for s in self.strategies:
+                    s.on_batch_begin(context)
+                loss = self.loss_fn(context.train_graph, *tensors)
+                for s in self.strategies:
+                    for term in s.loss_terms(context):
+                        loss = loss + term
+                loss.backward()
+                self.optimizer.step()
+                self.optimizer.clear_grad()
+                if batch_id % self.log_period == 0:
+                    _logger.info(f"epoch {epoch_id} batch {batch_id} "
+                                 f"loss {float(loss.numpy()):.4f}")
+                for s in self.strategies:
+                    s.on_batch_end(context)
+            for s in self.strategies:
+                s.on_epoch_end(context)
+            if self.eval_func is not None:
+                context.run_eval_graph()
+            if self.checkpoint_path:
+                from ..framework.io import save
+
+                os.makedirs(self.checkpoint_path, exist_ok=True)
+                save(context.train_graph.state_dict(),
+                     os.path.join(self.checkpoint_path,
+                                  f"epoch_{epoch_id}.pdparams"))
+        for s in self.strategies:
+            s.on_compression_end(context)
+        self.model = context.train_graph
+        self.context = context
+        return self.model

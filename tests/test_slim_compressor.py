@@ -1,0 +1,300 @@
+"""slim 1.x Compressor framework (ref: fluid/contrib/slim/): yaml-
+configured strategies over eager models — uniform pruning with
+persistent masks, distillation via feature hooks, QAT scheduling,
+SAController search, GraphWrapper program inspection, quantization
+passes, and the recorded MKLDNN/NAS descopes.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import slim
+
+RNG = np.random.RandomState(0)
+W = RNG.randn(16, 1).astype("float32")
+
+
+def _reader(n=6, b=8):
+    def r():
+        for _ in range(n):
+            X = RNG.randn(b, 16).astype("float32")
+            yield X, X @ W
+
+    return r
+
+
+def _mlp():
+    pt.seed(0)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 1))
+
+
+def _loss_fn(model, x, y):
+    return pt.mean((model(x) - y) ** 2)
+
+
+class TestCompressorPrune:
+    def test_yaml_config_uniform_prune(self, tmp_path):
+        cfg = tmp_path / "slim.yaml"
+        cfg.write_text(
+            "version: 1.0\n"
+            "strategies:\n"
+            "  prune_s:\n"
+            "    class: UniformPruneStrategy\n"
+            "    target_ratio: 0.5\n"
+            "    start_epoch: 0\n"
+            "    pruned_params: '.*weight.*|.*_w_.*'\n"
+            "compressor:\n"
+            "  epoch: 2\n"
+            "  strategies: [prune_s]\n")
+        model = _mlp()
+        opt = pt.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+        evals = []
+        comp = slim.Compressor(
+            model=model, train_reader=_reader(), train_optimizer=opt,
+            loss_fn=_loss_fn,
+            eval_func=lambda m: -float(_loss_fn(
+                m, pt.to_tensor(RNG.randn(8, 16).astype("float32")),
+                pt.to_tensor(np.zeros((8, 1), "float32"))).numpy()))
+        comp.config(str(cfg))
+        assert comp.epoch == 2 and len(comp.strategies) == 1
+        comp.run()
+        strat = comp.strategies[0]
+        # ~half the weights dead, and still dead after training steps
+        assert 0.4 < strat.sparsity() < 0.6
+        for p, m in strat.pruner.masks.values():
+            w = np.asarray(p.numpy())
+            assert np.all(w[~np.asarray(m)] == 0)
+
+    def test_sensitive_strategy_with_given_sensitivities(self):
+        model = _mlp()
+        names = [n for n, p in model.named_parameters()
+                 if len(p.shape) >= 2]
+        sens = {n: {0.1: 0.01, 0.3: 0.05, 0.5: 0.2} for n in names}
+        strat = slim.SensitivePruneStrategy(
+            target_ratio=0.1, sensitivities=sens,
+            pruned_params=".*weight.*|.*_w_.*")
+        opt = pt.optimizer.SGD(learning_rate=1e-2,
+                               parameters=model.parameters())
+        comp = slim.Compressor(model=model, train_reader=_reader(2),
+                               train_optimizer=opt, loss_fn=_loss_fn,
+                               epoch=1)
+        comp.strategies = [strat]
+        comp.run()
+        assert strat.sparsity() > 0.0
+
+
+class TestDistillation:
+    def test_l2_and_soft_label_distillers(self):
+        teacher = _mlp()
+        student = _mlp()
+        # make teacher differ
+        for p in teacher.parameters():
+            p.set_value(np.asarray(p.numpy()) * 1.5)
+        dist = slim.DistillationStrategy(
+            distillers=[
+                slim.L2Distiller("0", "0"),
+                slim.SoftLabelDistiller("2", "2",
+                                        teacher_temperature=2.0)],
+            start_epoch=0, end_epoch=5, teacher=teacher)
+        opt = pt.optimizer.Adam(learning_rate=1e-2,
+                                parameters=student.parameters())
+        comp = slim.Compressor(model=student, train_reader=_reader(4),
+                               train_optimizer=opt, loss_fn=_loss_fn,
+                               epoch=1)
+        comp.strategies = [dist]
+        comp.run()
+        # distiller terms were computable on the last batch
+        terms = dist.loss_terms(comp.context)
+        assert len(terms) == 2
+        assert all(np.isfinite(float(t.numpy())) for t in terms)
+
+    def test_missing_sublayer_raises(self):
+        with pytest.raises(ValueError):
+            slim.DistillationStrategy(
+                distillers=[slim.L2Distiller("nope", "nope")],
+                teacher=_mlp()).on_compression_begin(
+                    slim.Context(train_graph=_mlp()))
+
+
+class TestQuantStrategyAndPasses:
+    def test_quantization_strategy_wraps(self):
+        model = _mlp()
+        opt = pt.optimizer.SGD(learning_rate=1e-3,
+                               parameters=model.parameters())
+        comp = slim.Compressor(model=model, train_reader=_reader(2),
+                               train_optimizer=opt, loss_fn=_loss_fn,
+                               epoch=1)
+        comp.strategies = [slim.QuantizationStrategy(start_epoch=0)]
+        out = comp.run()
+        kinds = {type(l).__name__ for _, l in out.named_sublayers()}
+        assert "QATLinear" in kinds
+
+    def test_pass_pipeline_and_transpiler(self):
+        x = pt.to_tensor(RNG.randn(4, 16).astype("float32"))
+        model = _mlp()
+        ref = np.asarray(model(x).numpy())
+        slim.QuantizationTransformPass().apply(model)
+        qat_out = np.asarray(model(x).numpy())
+        assert np.abs(qat_out - ref).max() < 0.5  # fake-quant approx
+        slim.QuantizationFreezePass().apply(model)
+        kinds = {type(l).__name__ for _, l in model.named_sublayers()}
+        assert "QuantizedLinear" in kinds
+
+        m2 = _mlp()
+        tp = slim.QuantizeTranspiler()
+        tp.training_transpile(m2)
+        tp.freeze_program(m2)
+        kinds = {type(l).__name__ for _, l in m2.named_sublayers()}
+        assert "QuantizedLinear" in kinds
+        assert slim.TransformForMobilePass().apply(m2) is m2
+
+    def test_out_scale_observers(self):
+        model = _mlp()
+        p = slim.OutScaleForTrainingPass(moving_rate=0.5)
+        p.apply(model)
+        x = pt.to_tensor(RNG.randn(4, 16).astype("float32"))
+        model(x)
+        model(x)
+        assert p.out_scales and all(v > 0 for v in p.out_scales.values())
+        slim.OutScaleForInferencePass(training_pass=p).apply(model)
+        assert model._out_threshold == p.out_scales
+        p.remove()
+
+    def test_mkldnn_and_nas_descopes(self):
+        with pytest.raises(NotImplementedError):
+            slim.MKLDNNPostTrainingQuantStrategy()
+        with pytest.raises(NotImplementedError):
+            slim.LightNASStrategy()
+        with pytest.raises(NotImplementedError):
+            slim.ControllerServer()
+
+
+class TestSearcher:
+    def test_sa_controller_finds_optimum(self):
+        # reward = number of 1-tokens; SA should find the all-ones vector
+        ctl = slim.SAController(range_table=[2] * 8, seed=3,
+                                init_temperature=1.0, reduce_rate=0.7)
+        for _ in range(200):
+            t = ctl.next_tokens()
+            ctl.update(t, float(sum(t)))
+        assert ctl.max_reward == 8.0
+        assert ctl.best_tokens == [1] * 8
+
+
+class TestGraphWrapper:
+    def test_program_inspection(self):
+        pt.enable_static()
+        try:
+            import paddle_tpu.fluid as fluid
+
+            main, startup = pt.static.Program(), pt.static.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", [4, 16], "float32")
+                h = fluid.layers.fc(x, size=8, act="relu")
+                fluid.layers.fc(h, size=1)
+            g = slim.GraphWrapper(main)
+            params = g.all_parameters()
+            assert len(params) == 4  # 2x (w, b)
+            assert g.numel_params() == 16 * 8 + 8 + 8 * 1 + 1
+            ops = g.ops()
+            assert any(o.type() == "linear" for o in ops)
+            w = params[0]
+            assert w.is_parameter() and len(w.outputs()) >= 1
+        finally:
+            pt.disable_static()
+
+
+def test_deep_import_spellings():
+    from paddle_tpu.fluid.contrib.slim.core.compressor import Compressor
+    from paddle_tpu.fluid.contrib.slim.prune.prune_strategy import (
+        UniformPruneStrategy)
+    from paddle_tpu.fluid.contrib.slim.quantization.quantization_pass \
+        import QuantizationFreezePass
+    from paddle_tpu.fluid.contrib.slim.graph.graph_wrapper import (
+        GraphWrapper)
+    from paddle_tpu.fluid.contrib.slim.searcher.controller import (
+        SAController)
+    from paddle_tpu.fluid.contrib.quantize.quantize_transpiler import (
+        QuantizeTranspiler)
+    import paddle_tpu.fluid as fluid
+
+    assert fluid.contrib.slim.Compressor is Compressor
+    assert fluid.contrib.Compressor is Compressor
+    assert fluid.contrib.QuantizeTranspiler is QuantizeTranspiler
+
+
+def test_config_factory_named_sections(tmp_path):
+    """1.x schema: pruners:/distillers: entries referenced BY NAME from
+    strategy specs (ref core/config.py)."""
+    cfg = tmp_path / "slim.yaml"
+    cfg.write_text(
+        "version: 1.0\n"
+        "pruners:\n"
+        "  pruner_1:\n"
+        "    class: MagnitudePruner\n"
+        "strategies:\n"
+        "  prune_s:\n"
+        "    class: UniformPruneStrategy\n"
+        "    pruner: 'pruner_1'\n"
+        "    target_ratio: 0.25\n"
+        "    pruned_params: '.*_w_.*'\n"
+        "compressor:\n"
+        "  epoch: 1\n"
+        "  strategies: [prune_s]\n")
+    factory = slim.ConfigFactory(str(cfg))
+    strat = factory.instance("prune_s")
+    assert isinstance(strat.pruner, slim.MagnitudePruner)
+
+    model = _mlp()
+    opt = pt.optimizer.SGD(learning_rate=1e-2,
+                           parameters=model.parameters())
+    comp = slim.Compressor(model=model, train_reader=_reader(2),
+                           train_optimizer=opt, loss_fn=_loss_fn)
+    comp.config(str(cfg))
+    comp.run()
+    assert 0.15 < comp.strategies[0].sparsity() < 0.35
+
+
+def test_sensitive_strategy_auto_scan():
+    """Without precomputed sensitivities the strategy runs the scan
+    itself via eval_func."""
+    model = _mlp()
+    strat = slim.SensitivePruneStrategy(target_ratio=0.05,
+                                        pruned_params=".*_w_.*")
+    Xe = RNG.randn(8, 16).astype("float32")
+    Ye = Xe @ W
+    opt = pt.optimizer.SGD(learning_rate=1e-2,
+                           parameters=model.parameters())
+    comp = slim.Compressor(
+        model=model, train_reader=_reader(1), train_optimizer=opt,
+        loss_fn=_loss_fn,
+        eval_func=lambda m: -float(_loss_fn(
+            m, pt.to_tensor(Xe), pt.to_tensor(Ye)).numpy()),
+        epoch=1)
+    comp.strategies = [strat]
+    comp.run()
+    assert strat.sensitivities  # scan ran
+    assert strat.sparsity() > 0.0
+
+
+def test_quant_strategy_saves_int8(tmp_path):
+    import os
+
+    model = _mlp()
+    opt = pt.optimizer.SGD(learning_rate=1e-3,
+                           parameters=model.parameters())
+    comp = slim.Compressor(model=model, train_reader=_reader(2),
+                           train_optimizer=opt, loss_fn=_loss_fn,
+                           epoch=1)
+    comp.strategies = [slim.QuantizationStrategy(
+        start_epoch=0,
+        float_model_save_path=str(tmp_path / "f32"),
+        int8_model_save_path=str(tmp_path / "int8"))]
+    out = comp.run()
+    assert os.path.exists(tmp_path / "f32" / "model.pdparams")
+    assert os.path.exists(tmp_path / "int8" / "model.pdparams")
+    kinds = {type(l).__name__ for _, l in out.named_sublayers()}
+    assert "QuantizedLinear" in kinds  # converted at compression end
