@@ -2,10 +2,14 @@
 versions of the transform helpers (the reference shells out to cv2)."""
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 __all__ = ["resize_short", "center_crop", "random_crop",
-           "left_right_flip", "simple_transform", "to_chw"]
+           "left_right_flip", "simple_transform", "to_chw",
+           "load_image", "load_image_bytes", "load_and_transform",
+           "batch_images_from_tar"]
 
 
 def _resize(im, h, w):
@@ -44,6 +48,8 @@ def left_right_flip(im, is_color=True):
 
 
 def to_chw(im, order=(2, 0, 1)):
+    if im.ndim == 2:  # grayscale HW: nothing to transpose (ref guard)
+        return im
     return im.transpose(order)
 
 
@@ -64,3 +70,74 @@ def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
         m = np.asarray(mean, "float32")
         im -= m.reshape((-1, 1, 1)) if m.ndim == 1 else m
     return im
+
+
+def load_image_bytes(bytes_, is_color=True):
+    """ref: image.py:141 — decode an encoded image from bytes (the
+    reference uses cv2.imdecode; PIL here) into an HWC uint8 array
+    (HW for grayscale)."""
+    import io
+
+    from PIL import Image
+
+    im = Image.open(io.BytesIO(bytes_))
+    im = im.convert("RGB" if is_color else "L")
+    return np.asarray(im)
+
+
+def load_image(file, is_color=True):
+    """ref: image.py:167 — load an image file (cv2.imread there, PIL
+    here); HWC uint8 (HW for grayscale)."""
+    from PIL import Image
+
+    with Image.open(file) as im:
+        im = im.convert("RGB" if is_color else "L")
+        return np.asarray(im)
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    """ref: image.py:383 — load then simple_transform."""
+    im = load_image(filename, is_color=is_color)
+    return simple_transform(im, resize_size, crop_size, is_train,
+                            is_color=is_color, mean=mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """ref: image.py:80 — read images out of a tar, pickle them into
+    fixed-size batch files (data + label lists) next to the tar, and
+    write a meta file listing the batches. Returns the meta path."""
+    import pickle
+    import tarfile
+
+    out_path = f"{data_file}_{dataset_name}_batch"
+    os.makedirs(out_path, exist_ok=True)
+    batches = []
+    data, labels = [], []
+
+    def flush():
+        if not data:
+            return
+        fname = os.path.join(out_path, f"batch_{len(batches)}")
+        with open(fname, "wb") as f:
+            pickle.dump({"data": list(data), "label": list(labels)}, f,
+                        protocol=4)
+        batches.append(fname)
+        data.clear()
+        labels.clear()
+
+    with tarfile.open(data_file) as tf:
+        for member in tf.getmembers():
+            if not member.isfile() or member.name not in img2label:
+                continue
+            raw = tf.extractfile(member).read()
+            data.append(raw)
+            labels.append(img2label[member.name])
+            if len(data) == num_per_batch:
+                flush()
+    flush()
+    meta = os.path.join(out_path, "batch_meta")
+    with open(meta, "w") as f:
+        f.write("\n".join(batches))
+    return meta

@@ -29,3 +29,12 @@ def train(word_idx):
 def test(word_idx):
     return _make(256, 5, word_idx)
 
+
+
+def build_dict(pattern=None, cutoff=0):
+    """ref: imdb.py build_dict(pattern, cutoff) — corpus word->id dict.
+    The synthetic corpus IS the id space, so this returns the same
+    mapping word_dict() serves (cutoff keeps the signature honest: ids
+    below frequency cutoff would drop; synthetic frequencies are
+    uniform, so nothing drops)."""
+    return word_dict()

@@ -53,3 +53,93 @@ def train():
 def test():
     return _make(512, 9)
 
+
+
+# ref movielens.py:36 — the canonical MovieLens age buckets
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    """ref: movielens.py:48 — id/title/categories record."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = list(categories)
+        self.title = title
+
+    def value(self):
+        cats = movie_categories()
+        titles = get_movie_title_dict()
+        return [self.index, [cats[c] for c in self.categories],
+                [titles[w.lower()] for w in self.title.split()]]
+
+    def __str__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+    __repr__ = __str__
+
+
+class UserInfo:
+    """ref: movielens.py:75 — id/gender/age-bucket/job record."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age,
+                self.job_id]
+
+    def __str__(self):
+        return (f"<UserInfo id({self.index}), "
+                f"gender({'M' if self.is_male else 'F'}), "
+                f"age({age_table[self.age]}), job({self.job_id})>")
+
+    __repr__ = __str__
+
+
+_MOVIE_TITLE_DICT = None
+_MOVIE_INFO = None
+_USER_INFO = None
+
+
+def get_movie_title_dict():
+    """ref API: word -> id over the title vocabulary (synthetic ids are
+    their own words here)."""
+    global _MOVIE_TITLE_DICT
+    if _MOVIE_TITLE_DICT is None:
+        _MOVIE_TITLE_DICT = {f"t{i}": i for i in range(_TITLE_VOCAB)}
+    return _MOVIE_TITLE_DICT
+
+
+def movie_info():
+    """ref API: movie_id -> MovieInfo."""
+    global _MOVIE_INFO
+    if _MOVIE_INFO is None:
+        rng = np.random.RandomState(5)
+        cats = list(movie_categories())
+        _MOVIE_INFO = {}
+        for m in range(1, _MOVIES + 1):
+            title = " ".join(
+                f"t{int(i)}" for i in rng.randint(0, _TITLE_VOCAB,
+                                                  rng.randint(2, 6)))
+            chosen = [cats[int(i)]
+                      for i in rng.randint(0, _CATS, rng.randint(1, 4))]
+            _MOVIE_INFO[m] = MovieInfo(m, chosen, title)
+    return _MOVIE_INFO
+
+
+def user_info():
+    """ref API: user_id -> UserInfo."""
+    global _USER_INFO
+    if _USER_INFO is None:
+        rng = np.random.RandomState(6)
+        _USER_INFO = {
+            u: UserInfo(u, "M" if rng.randint(0, 2) else "F",
+                        age_table[int(rng.randint(0, len(age_table)))],
+                        int(rng.randint(0, _MAX_JOB)))
+            for u in range(1, _USERS + 1)}
+    return _USER_INFO

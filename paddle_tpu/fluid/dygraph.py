@@ -31,7 +31,8 @@ __all__ = ["guard", "to_variable", "Layer", "Sequential", "LayerList",
            "ParallelEnv", "prepare_context", "TracedLayer",
            "dygraph_to_static_func", "dygraph_to_static_code",
            "dygraph_to_static_output", "dygraph_to_static_program",
-           "start_gperf_profiler", "stop_gperf_profiler", "Parameter"]
+           "start_gperf_profiler", "stop_gperf_profiler", "Parameter",
+           "ProgramTranslator", "declarative"]
 
 
 @contextlib.contextmanager
@@ -398,3 +399,8 @@ def stop_gperf_profiler():
     from ..utils.profiler import stop_profiler
 
     return stop_profiler()
+
+# dygraph -> static conversion surface (ref: dygraph/dygraph_to_static/
+# + dygraph/jit.py declarative); home: fluid/dygraph_to_static.py
+from .dygraph_to_static import (ProgramTranslator,  # noqa: F401,E402
+                                declarative)

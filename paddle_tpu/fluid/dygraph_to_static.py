@@ -1,0 +1,257 @@
+"""dygraph-to-static surface (ref: python/paddle/fluid/dygraph/
+dygraph_to_static/ — program_translator.py, ast_transformer.py,
+variable_trans_func.py, static_analysis.py, loop_transformer.py,
+break_continue_transformer.py).
+
+Design note: the reference converts dygraph code to graph mode by
+REWRITING PYTHON SOURCE — gast transforms turn ``if``/``for``/``break``
+into cond/while/select ops, then the rewritten function builds a
+ProgramDesc. The XLA-era conversion is TRACING: eager layer code is
+jax-traceable by design (core/dispatch.py), so ``to_static`` compiles
+the same function directly and ``lax.cond/scan/while_loop`` (via
+ops.control_flow) express data-dependent control flow. The public
+surface (ProgramTranslator, convert_to_static, declarative) is
+therefore fully functional here, while the AST-rewrite internals
+(DygraphToStaticAst, the transformer visitors) survive as documented
+design-replacement stubs — there is no source rewriting to do.
+"""
+from __future__ import annotations
+
+import inspect
+import textwrap
+
+import numpy as np
+
+__all__ = [
+    "ProgramTranslator", "convert_to_static",
+    "convert_function_with_cache", "declarative",
+    "DygraphToStaticAst", "BreakContinueTransformer", "LoopTransformer",
+    "NameVisitor", "AstNodeWrapper", "NodeVarType",
+    "StaticAnalysisVisitor", "to_static_variable_gast_node",
+    "create_static_variable_gast_node", "data_layer_not_check",
+]
+
+_AST_NOTE = (
+    "source-rewrite transformers are replaced by tracing here: eager "
+    "code is jax-traceable, so to_static/jit compile it directly; "
+    "express data-dependent control flow with ops.control_flow "
+    "(lax.cond / while_loop / scan)")
+
+
+def convert_to_static(dyfunc):
+    """ref: ast_transformer.py:237 — return a static-executable version
+    of ``dyfunc``. Tracing-based: the compiled StaticFunction."""
+    from ..framework.jit import to_static
+
+    return to_static(dyfunc)
+
+
+_FUNC_CACHE = {}
+
+
+def convert_function_with_cache(dygraph_func):
+    """ref: program_translator.py:75 — cached conversion."""
+    key = getattr(dygraph_func, "__wrapped__", dygraph_func)
+    if key not in _FUNC_CACHE:
+        _FUNC_CACHE[key] = convert_to_static(dygraph_func)
+    return _FUNC_CACHE[key]
+
+
+def declarative(fn):
+    """ref: dygraph/jit.py @declarative — mark a function for static
+    compilation. The translator flag is consulted at CALL time (the
+    reference contract: ProgramTranslator().enable(False) makes
+    decorated functions run eagerly for debugging); keyword arguments
+    also route to the eager path, since the compiled StaticFunction is
+    positional-only."""
+    import functools
+
+    compiled = convert_to_static(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if kwargs or not ProgramTranslator().enable_declarative:
+            return fn(*args, **kwargs)
+        return compiled(*args)
+
+    return wrapper
+
+
+class ProgramTranslator:
+    """ref: program_translator.py:231 — the singleton front for
+    dygraph→static conversion."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._initialized = False
+        return cls._instance
+
+    def __init__(self):
+        if self._initialized:
+            return
+        self._initialized = True
+        self.enable_declarative = True
+        self._program_cache = {}
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    @classmethod
+    def reset(cls):
+        cls._instance = None
+
+    def enable(self, enable_declarative):
+        """ref: toggle whether declarative functions actually compile
+        (False = run eagerly, for debugging)."""
+        self.enable_declarative = bool(enable_declarative)
+
+    def get_output(self, dygraph_func, *args, **kwargs):
+        """Run ``dygraph_func`` statically (compiled) and return its
+        outputs; eager passthrough when disabled — or when keyword
+        arguments are passed (the compiled StaticFunction is
+        positional-only)."""
+        if not self.enable_declarative or kwargs:
+            return dygraph_func(*args, **kwargs)
+        return convert_function_with_cache(dygraph_func)(*args)
+
+    def get_func(self, dygraph_func):
+        if not self.enable_declarative:
+            return dygraph_func
+        return convert_function_with_cache(dygraph_func)
+
+    def get_program(self, dygraph_func, *args, **kwargs):
+        """Trace ``dygraph_func`` into (main_program, startup_program,
+        inputs, outputs) — the tracing analog of the reference's AST
+        build."""
+        from .. import static_ as _static
+        from ..static_ import Program, program_guard
+        from ..static_.program import data
+
+        key = (id(getattr(dygraph_func, "__wrapped__", dygraph_func)),
+               tuple((np.asarray(a).shape, str(np.asarray(a).dtype))
+                     for a in args),
+               tuple(sorted((k, repr(v)) for k, v in kwargs.items())))
+        if key in self._program_cache:
+            return self._program_cache[key]
+        was_static = _static.in_static_mode()
+        if not was_static:
+            _static.enable_static()
+        try:
+            main, startup = Program(), Program()
+            with program_guard(main, startup):
+                feed_vars = [
+                    data(f"translator_x{i}",
+                         list(np.asarray(a).shape),
+                         dtype=str(np.asarray(a).dtype))
+                    for i, a in enumerate(args)]
+                outs = dygraph_func(*feed_vars, **kwargs)
+            outputs = list(outs) if isinstance(outs, (list, tuple)) \
+                else [outs]
+            result = (main, startup, feed_vars, outputs)
+            self._program_cache[key] = result
+            return result
+        finally:
+            if not was_static:
+                _static.disable_static()
+
+    def get_code(self, dygraph_func):
+        """The static-mode source. Tracing does not rewrite source, so
+        this is the (dedented) original — which IS the code the static
+        build runs."""
+        return textwrap.dedent(inspect.getsource(
+            getattr(dygraph_func, "__wrapped__", dygraph_func)))
+
+    def get_program_cache(self):
+        return self._program_cache
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        """Persist the most recently traced program as an inference
+        bundle (ref: program_translator.py:362)."""
+        from ..framework.io import save_inference_model
+        from ..static_ import Executor
+
+        if not self._program_cache:
+            raise RuntimeError("no traced program yet — call get_output "
+                               "or get_program first")
+        main, startup, inputs, outputs = \
+            list(self._program_cache.values())[-1]
+        feed_vars = [inputs[i] for i in feed] if feed else inputs
+        fetch_vars = [outputs[i] for i in fetch] if fetch else outputs
+        save_inference_model(dirname, feed_vars, fetch_vars, Executor(),
+                             program=main)
+        return dirname
+
+
+def data_layer_not_check(name, shape, dtype="float32", lod_level=0):
+    """ref: variable_trans_func.py — a data var whose dims may be None
+    (variable length); None records as the 1 placeholder here, like
+    static.data."""
+    from ..static_.program import data
+
+    return data(name, [1 if s is None else s for s in shape],
+                dtype=dtype, lod_level=lod_level)
+
+
+def to_static_variable_gast_node(name):
+    raise NotImplementedError(_AST_NOTE)
+
+
+def create_static_variable_gast_node(name):
+    raise NotImplementedError(_AST_NOTE)
+
+
+class DygraphToStaticAst:
+    """ref: ast_transformer.py DygraphToStaticAst (gast rewriter)."""
+
+    def get_static_ast(self, root):
+        raise NotImplementedError(_AST_NOTE)
+
+
+class _AstStub:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_AST_NOTE)
+
+
+class BreakContinueTransformer(_AstStub):
+    """ref: break_continue_transformer.py."""
+
+
+class LoopTransformer(_AstStub):
+    """ref: loop_transformer.py."""
+
+
+class NameVisitor(_AstStub):
+    """ref: loop_transformer.py NameVisitor."""
+
+
+class AstNodeWrapper(_AstStub):
+    """ref: static_analysis.py."""
+
+
+class StaticAnalysisVisitor(_AstStub):
+    """ref: static_analysis.py."""
+
+
+class NodeVarType:
+    """ref: static_analysis.py NodeVarType — the type-lattice constants
+    (kept real: they are plain enums some user tooling imports)."""
+
+    ERROR = -1
+    UNKNOWN = 0
+    STATEMENT = 1
+    CALLABLE = 2
+    NONE = 100
+    BOOLEAN = 101
+    INT = 102
+    FLOAT = 103
+    STRING = 104
+    TENSOR = 200
+    NUMPY_NDARRAY = 201
+    PADDLE_DYGRAPH_API = 300
+    PADDLE_CONTROL_IF = 301
+    PADDLE_CONTROL_WHILE = 302
+    PADDLE_CONTROL_FOR = 303

@@ -418,6 +418,49 @@ def _install_incubate_faces(fluid_pkg):
              parameter_server=parameter_server))
     fleet_face.__getattr__ = lambda name: getattr(inc.fleet, name)
 
+    # dygraph_to_static faces (ref: fluid/dygraph/dygraph_to_static/;
+    # home: fluid/dygraph_to_static.py)
+    from . import dygraph_to_static as _d2s
+
+    d2s_faces = {}
+    for leaf, members in {
+        "program_translator": dict(
+            ProgramTranslator=_d2s.ProgramTranslator,
+            convert_function_with_cache=_d2s.convert_function_with_cache),
+        "ast_transformer": dict(
+            DygraphToStaticAst=_d2s.DygraphToStaticAst,
+            convert_to_static=_d2s.convert_to_static),
+        "loop_transformer": dict(LoopTransformer=_d2s.LoopTransformer,
+                                 NameVisitor=_d2s.NameVisitor),
+        "break_continue_transformer": dict(
+            BreakContinueTransformer=_d2s.BreakContinueTransformer),
+        "static_analysis": dict(
+            AstNodeWrapper=_d2s.AstNodeWrapper,
+            NodeVarType=_d2s.NodeVarType,
+            StaticAnalysisVisitor=_d2s.StaticAnalysisVisitor),
+        "variable_trans_func": dict(
+            to_static_variable_gast_node=(
+                _d2s.to_static_variable_gast_node),
+            create_static_variable_gast_node=(
+                _d2s.create_static_variable_gast_node),
+            data_layer_not_check=_d2s.data_layer_not_check),
+    }.items():
+        d2s_faces[leaf] = _module(
+            f"{base}.dygraph.dygraph_to_static.{leaf}",
+            f"ref: dygraph/dygraph_to_static/{leaf}.py.", members)
+    d2s_pkg = _module(
+        base + ".dygraph.dygraph_to_static",
+        "ref: fluid/dygraph/dygraph_to_static/.",
+        dict(ProgramTranslator=_d2s.ProgramTranslator,
+             convert_to_static=_d2s.convert_to_static, **d2s_faces))
+    jit_face = _module(
+        base + ".dygraph.jit",
+        "ref: fluid/dygraph/jit.py (declarative).",
+        dict(declarative=_d2s.declarative,
+             TracedLayer=fluid_pkg.dygraph.TracedLayer))
+    fluid_pkg.dygraph.dygraph_to_static = d2s_pkg
+    fluid_pkg.dygraph.jit = jit_face
+
     # fluid.transpiler.collective spelling (classes live in
     # fluid/transpiler.py)
     from . import transpiler as _tr

@@ -226,6 +226,7 @@ class StaticFunction:
 
     def __init__(self, fn, model=None, train=False):
         self._fn = fn
+        self.__wrapped__ = fn  # functools convention: inspect/unwrap
         self._model = model
         self._train = train
         self._compiled = {}
