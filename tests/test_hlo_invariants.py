@@ -303,3 +303,41 @@ class TestDistributedHLOSignatures:
             f"expected 2 partial-sum all-reduces, got " \
             f"{txt.count('all-reduce(')}"
         assert txt.count("all-gather(") == 0, "weights were all-gathered"
+
+
+class TestStaticAMPHLO:
+    def test_amp_step_is_one_guarded_bf16_executable(self, static_mode):
+        """The fluid.contrib.mixed_precision step must stay ONE
+        executable: list-driven bf16 casts present on the matmul path,
+        the inf-guard select fused in, and the loss-scaling state
+        updated through the same donated-alias mechanism as optimizer
+        slots (no second program, no host round-trip)."""
+        from paddle_tpu.fluid.contrib.mixed_precision import decorate
+
+        pt.seed(0)
+        prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.data(name="x", shape=[16, 8])
+            y = fluid.data(name="y", shape=[16, 1])
+            h = fluid.layers.fc(x, size=16, act="relu")
+            out = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(out, y))
+            opt = decorate(fluid.optimizer.SGD(learning_rate=0.1),
+                           init_loss_scaling=256.0)
+            opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = _train_feed(prog)
+        txt, compiled = _compiled_text(exe, prog, feed, [loss], False)
+        # (a) white-list casts made it into the compiled program
+        assert "bf16" in txt, "no bf16 anywhere: list casts lost"
+        # (b) the inf-guarded update lowered to selects
+        assert "select(" in txt
+        # (c) scaling state rides the donated persistables (aliased,
+        # not copied back through host)
+        assert "@amp@scale" in compiled.updated
+        assert "@amp@good" in compiled.updated
+        aliases = txt.count("input_output_alias")
+        assert aliases >= 1
