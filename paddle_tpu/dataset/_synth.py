@@ -4,11 +4,19 @@ from __future__ import annotations
 import numpy as np
 
 
-def class_mean_images(n, shape, classes, seed, noise=0.35, flat=True):
+def class_mean_images(n, shape, classes, seed, noise=0.35, flat=True,
+                      task_seed=None):
     """Separable image-classification data: per-class mean + noise,
-    scaled to the reference's [-1, 1] convention."""
+    scaled to the reference's [-1, 1] convention.
+
+    ``task_seed`` fixes the class means independently of the sample
+    draws, so a train/test PAIR shares one task (a model trained on
+    train() generalizes to test(), like the real dataset) while the
+    splits remain disjoint draws."""
     rng = np.random.RandomState(seed)
-    means = rng.randn(classes, *shape).astype("float32")
+    means_rng = rng if task_seed is None else \
+        np.random.RandomState(task_seed)
+    means = means_rng.randn(classes, *shape).astype("float32")
     y = rng.randint(0, classes, n)
     x = means[y] + rng.randn(n, *shape).astype("float32") * noise
     x = np.tanh(x)  # into [-1, 1]

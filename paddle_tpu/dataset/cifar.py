@@ -7,7 +7,9 @@ _N_TRAIN, _N_TEST = 2048, 512
 
 
 def _make(n, classes, seed):
-    x, y = class_mean_images(n, (3, 32, 32), classes, seed)
+    # task seed per label space: train/test splits share class means
+    x, y = class_mean_images(n, (3, 32, 32), classes, seed,
+                             task_seed=classes + 90210)
     return reader_creator(list(zip(x, y)))
 
 

@@ -7,7 +7,9 @@ _N_TRAIN, _N_TEST = 2048, 512
 
 
 def _make(n, seed):
-    x, y = class_mean_images(n, (1, 28, 28), 10, seed)
+    # task_seed=0: train and test share the class means (one task)
+    x, y = class_mean_images(n, (1, 28, 28), 10, seed,
+                             task_seed=90210)
     return reader_creator(list(zip(x, y)))
 
 

@@ -1,0 +1,89 @@
+"""Book high-level-api chapter through the contrib Trainer/Inferencer
+(ref: fluid/tests/book/high-level-api/test_recognize_digits_mlp_new_api
+.py and test_fit_a_line_new_api.py): dataset reader -> paddle.batch ->
+Trainer event loop -> test() -> save_params -> Inferencer — the exact
+reference driver shape over the synthetic MNIST reader.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.contrib.trainer import EndEpochEvent, Trainer
+from paddle_tpu.fluid.contrib.inferencer import Inferencer
+
+BATCH_SIZE = 64
+
+
+def inference_program():
+    img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                            dtype="float32")
+    hidden = fluid.layers.fc(input=img, size=64, act="tanh")
+    hidden = fluid.layers.fc(input=hidden, size=64, act="tanh")
+    return fluid.layers.fc(input=hidden, size=10, act="softmax")
+
+
+def train_program():
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    predict = inference_program()
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=predict, label=label)
+    return [avg_cost, acc]
+
+
+def optimizer_func():
+    return fluid.optimizer.Adam(learning_rate=0.001)
+
+
+def _mnist_batch(reader_fn, n_batches):
+    def r():
+        it = reader_fn()()  # dataset.train() -> reader -> iterator
+        batch = []
+        for sample in it:
+            img, label = sample
+            batch.append((np.asarray(img, "float32").reshape(1, 28, 28),
+                          np.asarray([label], "int64")))
+            if len(batch) == BATCH_SIZE:
+                yield batch
+                batch = []
+                n = getattr(r, "_n", 0) + 1
+                r._n = n
+                if n >= n_batches:
+                    return
+
+    def fresh():
+        r._n = 0
+        return r()
+
+    return fresh
+
+
+def test_recognize_digits_mlp_high_level_api(tmp_path):
+    paddle.seed(0)
+    params_dirname = str(tmp_path / "mlp_params")
+    trainer = Trainer(train_func=train_program,
+                      optimizer_func=optimizer_func)
+    seen = {"acc": 0.0}
+
+    def event_handler(event):
+        if isinstance(event, EndEpochEvent):
+            test_reader = _mnist_batch(paddle.dataset.mnist.test, 4)
+            avg_cost, acc = trainer.test(reader=test_reader,
+                                         feed_order=["img", "label"])
+            seen["acc"] = float(np.asarray(acc))
+            assert not np.isnan(float(np.asarray(avg_cost)))
+            trainer.save_params(params_dirname)
+
+    train_reader = _mnist_batch(paddle.dataset.mnist.train, 20)
+    trainer.train(num_epochs=2, event_handler=event_handler,
+                  reader=train_reader, feed_order=["img", "label"])
+    assert seen["acc"] > 0.5, seen  # synthetic MNIST is easy
+
+    inferencer = Inferencer(infer_func=inference_program,
+                            param_path=params_dirname)
+    batch = next(_mnist_batch(paddle.dataset.mnist.test, 1)())
+    imgs = np.stack([b[0] for b in batch])
+    labels = np.concatenate([b[1] for b in batch])
+    (probs,) = inferencer.infer({"img": imgs})
+    pred = np.argmax(np.asarray(probs), axis=1)
+    assert (pred == labels).mean() > 0.5
