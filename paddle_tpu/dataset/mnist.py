@@ -7,7 +7,8 @@ _N_TRAIN, _N_TEST = 2048, 512
 
 
 def _make(n, seed):
-    # task_seed=0: train and test share the class means (one task)
+    # shared task_seed: train and test draw from ONE set of class
+    # means (disjoint from the sample seeds; None would mean per-split)
     x, y = class_mean_images(n, (1, 28, 28), 10, seed,
                              task_seed=90210)
     return reader_creator(list(zip(x, y)))

@@ -39,6 +39,10 @@ def install(fluid_pkg):
                            name_scope, scope_guard)
     from ..static_.compiler import ParallelExecutor
     from ..static_.executor import FetchHandler as _FetchHandler
+    from ..inference.analysis import (AnalysisConfig as _AnalysisConfig,
+                                      PaddleTensor as _PaddleTensor,
+                                      create_paddle_predictor as
+                                      _create_paddle_predictor)
     from .lod_tensor import (LoDTensor, LoDTensorArray, create_lod_tensor,
                              create_random_int_lodtensor)
 
@@ -88,7 +92,11 @@ def install(fluid_pkg):
              CUDAPinnedPlace=CPUPlace, TPUPlace=TPUPlace, Scope=Scope,
              VarBase=Tensor,
              is_compiled_with_cuda=lambda: False,
-             get_cuda_device_count=lambda: 0))
+             get_cuda_device_count=lambda: 0,
+             # deploy-script entry (ref pybind/inference_api.cc)
+             AnalysisConfig=_AnalysisConfig,
+             create_paddle_predictor=_create_paddle_predictor,
+             PaddleTensor=_PaddleTensor))
 
     from .trainer_desc import DataFeedDesc
 

@@ -12,12 +12,16 @@ beam_search/greedy_search) — the reusable analog of
 python/paddle/fluid/layers/rnn.py:1052 dynamic_decode, :2699 beam_search.
 """
 from .predictor import Config, Predictor, create_predictor
+from .analysis import (AnalysisConfig, AnalysisPredictor, PaddleTensor,
+                       ZeroCopyTensor, create_paddle_predictor)
 from .decoder import (Decoder, BeamSearchDecoder, dynamic_decode,
                       beam_search, beam_search_xla, greedy_search,
                       tile_beam, gather_beams)
 
 __all__ = [
     "Config", "Predictor", "create_predictor",
+    "AnalysisConfig", "AnalysisPredictor", "PaddleTensor",
+    "ZeroCopyTensor", "create_paddle_predictor",
     "Decoder", "BeamSearchDecoder", "dynamic_decode",
     "beam_search", "beam_search_xla", "greedy_search", "tile_beam",
     "gather_beams",

@@ -38,24 +38,18 @@ def optimizer_func():
 def _mnist_batch(reader_fn, n_batches):
     def r():
         it = reader_fn()()  # dataset.train() -> reader -> iterator
-        batch = []
+        batch, n = [], 0
         for sample in it:
             img, label = sample
             batch.append((np.asarray(img, "float32").reshape(1, 28, 28),
                           np.asarray([label], "int64")))
             if len(batch) == BATCH_SIZE:
                 yield batch
-                batch = []
-                n = getattr(r, "_n", 0) + 1
-                r._n = n
+                batch, n = [], n + 1
                 if n >= n_batches:
                     return
 
-    def fresh():
-        r._n = 0
-        return r()
-
-    return fresh
+    return r
 
 
 def test_recognize_digits_mlp_high_level_api(tmp_path):

@@ -1,0 +1,111 @@
+"""Fluid-era deploy API (ref: pybind/inference_api.cc,
+analysis_predictor.cc): the `from paddle.fluid.core import
+AnalysisConfig, create_paddle_predictor` + zero-copy protocol every 1.x
+deployment script uses, served by the shape-bucketed Predictor.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+
+
+@pytest.fixture(scope="module")
+def bundle(tmp_path_factory):
+    """Train a tiny static net and save an inference bundle."""
+    d = tmp_path_factory.mktemp("deploy")
+    prefix = str(d / "model")
+    pt.enable_static()
+    try:
+        main, startup = pt.static.Program(), pt.static.Program()
+        with pt.static.program_guard(main, startup):
+            x = pt.static.data("x", [4, 8], "float32")
+            h = fluid.layers.fc(x, size=16, act="relu")
+            out = fluid.layers.fc(h, size=3)
+        exe = pt.static.Executor()
+        exe.run(startup)
+        from paddle_tpu.framework.io import save_inference_model
+
+        save_inference_model(prefix, ["x"], [out], exe, program=main)
+        ref = exe.run(main, feed={"x": np.ones((4, 8), "float32")},
+                      fetch_list=[out])[0]
+    finally:
+        pt.disable_static()
+    return prefix, np.asarray(ref)
+
+
+class TestAnalysisPredictor:
+    def test_core_import_spelling(self):
+        from paddle_tpu.fluid.core import (AnalysisConfig,
+                                           create_paddle_predictor)
+
+        assert callable(create_paddle_predictor)
+        cfg = AnalysisConfig("/tmp/nope")
+        cfg.disable_gpu()
+        cfg.switch_use_feed_fetch_ops(False)
+        cfg.enable_memory_optim()
+        cfg.set_cpu_math_library_num_threads(4)
+        assert cfg.cpu_math_library_num_threads() == 4
+        assert not cfg.use_gpu()
+
+    def test_zero_copy_protocol(self, bundle):
+        prefix, ref = bundle
+        from paddle_tpu.fluid.core import (AnalysisConfig,
+                                           create_paddle_predictor)
+
+        config = AnalysisConfig(prefix)
+        config.disable_gpu()
+        config.switch_use_feed_fetch_ops(False)
+        predictor = create_paddle_predictor(config)
+        names = predictor.get_input_names()
+        assert names == ["x"]
+        inp = predictor.get_input_tensor(names[0])
+        data = np.ones((4, 8), "float32")
+        inp.reshape([4, 8])
+        inp.copy_from_cpu(data.ravel())
+        assert predictor.zero_copy_run()
+        out_t = predictor.get_output_tensor(
+            predictor.get_output_names()[0])
+        out = out_t.copy_to_cpu()
+        assert np.allclose(out, ref, atol=1e-5)
+        assert out_t.shape() == [4, 3]
+
+    def test_paddle_tensor_run_path(self, bundle):
+        prefix, ref = bundle
+        from paddle_tpu.inference import (AnalysisConfig, PaddleTensor,
+                                          create_paddle_predictor)
+
+        predictor = create_paddle_predictor(AnalysisConfig(prefix))
+        t = PaddleTensor(np.ones((4, 8), "float32"), name="x")
+        (out,) = predictor.run([t])
+        assert isinstance(out, PaddleTensor)
+        assert np.allclose(out.as_ndarray(), ref, atol=1e-5)
+
+    def test_dir_and_pdmodel_resolution(self, bundle, tmp_path):
+        prefix, ref = bundle
+        from paddle_tpu.inference import (AnalysisConfig,
+                                          create_paddle_predictor)
+
+        # a directory holding exactly one bundle resolves
+        import os
+
+        d = os.path.dirname(prefix)
+        p1 = create_paddle_predictor(AnalysisConfig(d))
+        assert p1.get_input_names() == ["x"]
+        # the .pdmodel path spelling resolves too
+        p2 = create_paddle_predictor(
+            AnalysisConfig(prefix + ".pdmodel"))
+        assert p2.get_input_names() == ["x"]
+
+    def test_errors(self, bundle):
+        prefix, _ = bundle
+        from paddle_tpu.inference import (AnalysisConfig,
+                                          create_paddle_predictor)
+
+        predictor = create_paddle_predictor(AnalysisConfig(prefix))
+        with pytest.raises(KeyError):
+            predictor.get_input_tensor("bogus")
+        with pytest.raises(ValueError):
+            predictor.zero_copy_run()  # nothing staged
+        with pytest.raises(NotImplementedError):
+            AnalysisConfig(prefix).enable_tensorrt_engine()
