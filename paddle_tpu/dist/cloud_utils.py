@@ -1,0 +1,47 @@
+"""PaddleCloud environment helpers
+(ref: python/paddle/distributed/cloud_utils.py): build the Cluster
+model from the PADDLE_TRAINERS / POD_IP env the cloud scheduler sets.
+"""
+from __future__ import annotations
+
+import os
+
+from .utils import get_cluster, logger
+
+__all__ = ["get_cloud_cluster", "get_trainers_num"]
+
+
+def get_cloud_cluster(args_node_ips=None, args_node_ip=None,
+                      args_port=6170, selected_gpus=None):
+    """ref: cloud_utils.py:21 — env wins over CLI args (with the same
+    warnings the reference prints)."""
+    env_ips = os.getenv("PADDLE_TRAINERS")
+    if env_ips:
+        node_ips = env_ips.split(",")
+        # POD_IP is only meaningful alongside the env node list (k8s
+        # injects POD_IP into unrelated pods too)
+        node_ip = os.getenv("POD_IP", args_node_ip or node_ips[0])
+        if args_node_ips and isinstance(args_node_ips, str) and \
+                args_node_ips != "127.0.0.1" and \
+                args_node_ips != env_ips:
+            logger.warning(
+                "PADDLE_TRAINERS from the cloud environment overrides "
+                "--cluster_node_ips")
+    else:
+        node_ips = (args_node_ips.split(",")
+                    if isinstance(args_node_ips, str)
+                    else list(args_node_ips or ["127.0.0.1"]))
+        node_ip = args_node_ip or node_ips[0]
+    if node_ip not in node_ips:
+        raise ValueError(
+            f"this node's ip {node_ip!r} is not in the trainer node "
+            f"list {node_ips} (check POD_IP / --node_ip)")
+    selected = list(selected_gpus or [0])
+    started_port = int(os.getenv("PADDLE_PORT", args_port))
+    ports = [started_port + i for i in range(len(selected))]
+    cluster, pod = get_cluster(node_ips, node_ip, ports, selected)
+    return cluster, pod
+
+
+def get_trainers_num():
+    return int(os.getenv("PADDLE_TRAINERS_NUM", "1"))

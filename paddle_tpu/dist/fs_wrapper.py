@@ -1,0 +1,129 @@
+"""Filesystem wrappers (ref: python/paddle/distributed/fs_wrapper.py):
+the FS protocol checkpoint utilities program against. LocalFS is fully
+live; BDFS (Baidu AFS over its client binary) raises the recorded
+descope — HDFS-style remote checkpointing goes through
+fluid.contrib_utils.HDFSClient, which wraps the `hadoop fs` CLI like
+the reference.
+"""
+from __future__ import annotations
+
+import abc
+import os
+import shutil
+
+__all__ = ["FS", "LocalFS", "BDFS"]
+
+
+class FS(abc.ABC):
+    @abc.abstractmethod
+    def list_dirs(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def ls_dir(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def stat(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def upload(self, local_path, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def download(self, fs_path, local_path):
+        ...
+
+    @abc.abstractmethod
+    def mkdir(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def mv(self, fs_src_path, fs_dst_path):
+        ...
+
+    @abc.abstractmethod
+    def rmr(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def rm(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def delete(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def need_upload_download(self):
+        ...
+
+
+class LocalFS(FS):
+    """ref: fs_wrapper.py LocalFS — the local filesystem as an FS."""
+
+    def list_dirs(self, fs_path):
+        if not self.stat(fs_path):
+            return []
+        return [d for d in os.listdir(fs_path)
+                if os.path.isdir(os.path.join(fs_path, d))]
+
+    def ls_dir(self, fs_path):
+        return list(os.listdir(fs_path))
+
+    def stat(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def upload(self, local_path, fs_path):
+        # COPY semantics (the reference renames, which destroys the
+        # caller's local checkpoint and fails across mounts; download
+        # here copies, so upload stays symmetric)
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        if os.path.isdir(fs_path):
+            shutil.copytree(fs_path, local_path, dirs_exist_ok=True)
+        else:
+            shutil.copy2(fs_path, local_path)
+
+    def mkdir(self, fs_path):
+        assert not os.path.isfile(fs_path), f"{fs_path} is a file"
+        os.makedirs(fs_path, exist_ok=True)
+
+    def mv(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def rmr(self, fs_path):
+        shutil.rmtree(fs_path)
+
+    def rm(self, fs_path):
+        os.remove(fs_path)
+
+    def delete(self, fs_path):
+        if not self.stat(fs_path):
+            return
+        if os.path.isfile(fs_path):
+            return self.rm(fs_path)
+        return self.rmr(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+
+class BDFS(FS):
+    """ref: fs_wrapper.py BDFS — Baidu AFS via its client binary;
+    infra-specific, recorded descope (use LocalFS or
+    contrib_utils.HDFSClient)."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "BDFS drives Baidu's AFS client binary (infra-specific); "
+            "use LocalFS, or fluid.contrib_utils.HDFSClient for "
+            "hadoop-compatible stores")
+
+    list_dirs = ls_dir = stat = upload = download = mkdir = mv = rmr = \
+        rm = delete = need_upload_download = None

@@ -1,0 +1,33 @@
+"""Parameter-server launcher (ref: python/paddle/distributed/
+launch_ps.py). PS mode is the recorded SURVEY §4b descope: there are no
+server processes to start on a TPU pod — sparse tables shard over the
+mesh and gradients ride ICI collectives. The entry points exist so
+`python -m paddle.distributed.launch_ps`-era tooling fails with the
+design pointer instead of an ImportError; collective launches go
+through dist/launch.py.
+"""
+from __future__ import annotations
+
+__all__ = ["parse_args", "start_procs", "launch"]
+
+_DESCOPE = (
+    "parameter-server launch is descoped on TPU (SURVEY §4b): use "
+    "python -m paddle_tpu.distributed.launch for collective "
+    "multi-process runs; sparse embeddings shard via "
+    "VocabParallelEmbedding")
+
+
+def parse_args():
+    raise NotImplementedError(_DESCOPE)
+
+
+def start_procs(args):
+    raise NotImplementedError(_DESCOPE)
+
+
+def launch():
+    raise NotImplementedError(_DESCOPE)
+
+
+if __name__ == "__main__":
+    raise SystemExit(_DESCOPE)

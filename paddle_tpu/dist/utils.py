@@ -1,0 +1,255 @@
+"""Cluster topology model + local-process helpers
+(ref: python/paddle/distributed/utils.py).
+
+The Cluster/Pod/Trainer model describes multi-node launch topology —
+ranks, endpoints, per-trainer accelerators. The reference's launch
+scripts build it from node lists or cloud env; dist/launch.py here
+spawns the local trainers. "gpus" keeps the reference field name and
+holds whatever accelerator indices the launcher assigns (TPU chips
+under XLA).
+"""
+from __future__ import annotations
+
+import logging
+import socket
+from contextlib import closing
+
+from ..fluid.log_helper import get_logger as _get_logger
+
+__all__ = [
+    "Hdfs", "Cluster", "JobServer", "Trainer", "Pod", "TrainerProc",
+    "get_logger", "get_cluster", "terminate_local_procs",
+    "get_host_name_ip", "add_arguments", "find_free_ports",
+]
+
+logger = _get_logger(__name__, logging.INFO,
+                     fmt="%(asctime)s %(levelname)s %(message)s")
+
+
+def get_logger(log_level=20, name="root"):
+    return _get_logger(name, log_level,
+                       fmt="%(asctime)s %(levelname)s %(message)s")
+
+
+class Hdfs:
+    """ref: utils.py Hdfs — checkpoint filesystem coordinates."""
+
+    def __init__(self):
+        self.hdfs_ugi = None
+        self.hdfs_name = None
+        self.hdfs_path = None
+
+    def is_valid(self):
+        return (self.hdfs_ugi is not None and self.hdfs_name is not None
+                and self.hdfs_path is not None)
+
+    def __str__(self):
+        return (f"hdfs_ugi:{self.hdfs_ugi} hdfs_name:{self.hdfs_name} "
+                f"hdfs_path:{self.hdfs_path}")
+
+    def __eq__(self, n):
+        return (self.hdfs_ugi == n.hdfs_ugi
+                and self.hdfs_name == n.hdfs_name
+                and self.hdfs_path == n.hdfs_path)
+
+    def __ne__(self, n):
+        return not self == n
+
+
+class JobServer:
+    def __init__(self):
+        self.endpoint = None
+
+    def __str__(self):
+        return f"{self.endpoint}"
+
+    def __eq__(self, j):
+        return self.endpoint == j.endpoint
+
+    def __ne__(self, j):
+        return not self == j
+
+
+class Trainer:
+    def __init__(self):
+        self.gpus = []          # accelerator indices (ref field name)
+        self.endpoint = None
+        self.rank = None
+
+    def __str__(self):
+        return (f"gpu:{self.gpus} endpoint:{self.endpoint} "
+                f"rank:{self.rank}")
+
+    def __eq__(self, t):
+        return (self.gpus == t.gpus and self.endpoint == t.endpoint
+                and self.rank == t.rank)
+
+    def __ne__(self, t):
+        return not self == t
+
+    def rank_(self):
+        return self.rank
+
+
+class Pod:
+    """One node's worth of trainers."""
+
+    def __init__(self):
+        self.rank = None
+        self.id = None
+        self.addr = None
+        self.port = None
+        self.trainers = []
+        self.servers = []
+        self.gpus = []
+
+    def __str__(self):
+        return (f"rank:{self.rank} id:{self.id} addr:{self.addr} "
+                f"port:{self.port} trainers:"
+                f"{[str(t) for t in self.trainers]}")
+
+    def __eq__(self, pod):
+        if (self.rank != pod.rank or self.id != pod.id
+                or self.addr != pod.addr or self.port != pod.port
+                or len(self.trainers) != len(pod.trainers)):
+            return False
+        return all(a == b for a, b in zip(self.trainers, pod.trainers))
+
+    def __ne__(self, pod):
+        return not self == pod
+
+    def parse_response(self, res_pods):
+        pass
+
+    def get_visible_gpus(self):
+        return ",".join(str(g) for t in self.trainers for g in t.gpus)
+
+
+class Cluster:
+    def __init__(self, hdfs=None):
+        self.job_server = None
+        self.pods = []
+        self.hdfs = hdfs
+        self.job_stage_flag = None
+
+    def __str__(self):
+        return (f"job_server:{self.job_server} "
+                f"pods:{[str(p) for p in self.pods]} "
+                f"job_stage_flag:{self.job_stage_flag} hdfs:{self.hdfs}")
+
+    def __eq__(self, cluster):
+        if len(self.pods) != len(cluster.pods):
+            return False
+        return all(a == b for a, b in zip(self.pods, cluster.pods))
+
+    def __ne__(self, cluster):
+        return not self == cluster
+
+    def update_pods(self, cluster):
+        self.pods = list(cluster.pods)
+
+    def trainers_nranks(self):
+        return len(self.trainers_endpoints())
+
+    def pods_nranks(self):
+        return len(self.pods)
+
+    def trainers_endpoints(self):
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def pods_endpoints(self):
+        return [f"{p.addr}:{p.port}" for p in self.pods]
+
+    def get_pod_by_id(self, pod_id):
+        for p in self.pods:
+            if str(p.id) == str(pod_id):
+                return p
+        return None
+
+
+def get_cluster(node_ips, node_ip, paddle_ports, selected_gpus):
+    """Build the Cluster/Pod model for a node list (ref: utils.py:230)."""
+    assert isinstance(paddle_ports, list), "paddle_ports must be list"
+    assert len(paddle_ports) >= len(selected_gpus), (
+        f"need one port per trainer: {len(paddle_ports)} ports for "
+        f"{len(selected_gpus)} trainers")
+    cluster = Cluster(hdfs=None)
+    trainer_rank = 0
+    for node_rank, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = node_rank
+        pod.addr = ip
+        pod.id = node_rank
+        for i, gpu in enumerate(selected_gpus):
+            trainer = Trainer()
+            trainer.gpus.append(gpu)
+            trainer.endpoint = f"{ip}:{paddle_ports[i]}"
+            trainer.rank = trainer_rank
+            trainer_rank += 1
+            pod.trainers.append(trainer)
+        cluster.pods.append(pod)
+    pod_rank = node_ips.index(node_ip)
+    return cluster, cluster.pods[pod_rank]
+
+
+class TrainerProc:
+    def __init__(self):
+        self.proc = None
+        self.log_fn = None
+        self.log_offset = None
+        self.rank = None
+        self.local_rank = None
+        self.cmd = None
+
+
+def terminate_local_procs(procs):
+    """SIGTERM, bounded wait, then SIGKILL; reap and close logs."""
+    import subprocess
+
+    live = []
+    for p in procs:
+        proc = getattr(p, "proc", p)
+        if proc is None:
+            continue
+        if proc.poll() is None:
+            proc.terminate()
+        live.append((p, proc))
+    for p, proc in live:
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        log_fn = getattr(p, "log_fn", None)
+        if log_fn is not None and hasattr(log_fn, "close"):
+            log_fn.close()
+
+
+def get_host_name_ip():
+    try:
+        host_name = socket.gethostname()
+        return host_name, socket.gethostbyname(host_name)
+    except OSError:
+        return None
+
+
+def add_arguments(argname, type, default, help, argparser, **kwargs):
+    """ref: utils.py add_arguments — argparse helper with bool support."""
+    if type is bool:
+        def type(v):  # noqa: A001
+            return str(v).lower() in ("true", "1", "yes")
+    argparser.add_argument("--" + argname, default=default, type=type,
+                           help=f"{help} Default: %(default)s.", **kwargs)
+
+
+def find_free_ports(num):
+    """``num`` distinct currently-free TCP ports (ref: utils.py)."""
+    ports = set()
+    for _ in range(num * 50):
+        with closing(socket.socket(socket.AF_INET,
+                                   socket.SOCK_STREAM)) as s:
+            s.bind(("", 0))
+            ports.add(s.getsockname()[1])
+        if len(ports) >= num:
+            return set(list(ports)[:num])
+    return None

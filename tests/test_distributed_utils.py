@@ -1,0 +1,136 @@
+"""Cluster topology model, filesystem wrappers, cloud env helpers
+(ref: python/paddle/distributed/{utils,fs_wrapper,cloud_utils,
+launch_ps}.py) — the launch-script support surface.
+"""
+import os
+
+import pytest
+
+from paddle_tpu.dist.utils import (Cluster, Pod, Trainer, add_arguments,
+                                   find_free_ports, get_cluster,
+                                   get_host_name_ip)
+from paddle_tpu.dist.fs_wrapper import FS, BDFS, LocalFS
+from paddle_tpu.dist import cloud_utils, launch_ps
+
+
+class TestClusterModel:
+    def test_get_cluster_topology(self):
+        ips = ["10.0.0.1", "10.0.0.2"]
+        cluster, pod = get_cluster(ips, "10.0.0.2", [6170, 6171], [0, 1])
+        assert cluster.pods_nranks() == 2
+        assert cluster.trainers_nranks() == 4
+        assert pod.rank == 1 and pod.addr == "10.0.0.2"
+        eps = cluster.trainers_endpoints()
+        assert eps[0] == "10.0.0.1:6170" and eps[-1] == "10.0.0.2:6171"
+        assert [t.rank for p in cluster.pods for t in p.trainers] == \
+            [0, 1, 2, 3]
+        assert cluster.get_pod_by_id(0).addr == "10.0.0.1"
+        # equality is structural
+        c2, _ = get_cluster(ips, "10.0.0.1", [6170, 6171], [0, 1])
+        assert cluster == c2
+        c3, _ = get_cluster(ips, "10.0.0.1", [7000, 7001], [0, 1])
+        assert cluster != c3
+        assert pod.get_visible_gpus() == "0,1"
+
+    def test_free_ports_and_host(self):
+        ports = find_free_ports(3)
+        assert len(ports) == 3
+        hn = get_host_name_ip()
+        assert hn is None or len(hn) == 2
+
+    def test_add_arguments_bool(self):
+        import argparse
+
+        p = argparse.ArgumentParser()
+        add_arguments("use_thing", bool, False, "a flag", p)
+        assert p.parse_args(["--use_thing", "True"]).use_thing is True
+        assert p.parse_args(["--use_thing", "0"]).use_thing is False
+
+
+class TestFS:
+    def test_local_fs_roundtrip(self, tmp_path):
+        fs = LocalFS()
+        d = str(tmp_path / "a")
+        fs.mkdir(d)
+        assert fs.stat(d) and fs.list_dirs(str(tmp_path)) == ["a"]
+        f = str(tmp_path / "a" / "x.txt")
+        open(f, "w").write("hi")
+        assert "x.txt" in fs.ls_dir(d)
+        fs.mv(f, str(tmp_path / "a" / "y.txt"))
+        fs.download(d, str(tmp_path / "b"))
+        assert open(tmp_path / "b" / "y.txt").read() == "hi"
+        fs.delete(str(tmp_path / "a" / "y.txt"))
+        fs.delete(d)
+        assert not fs.stat(d)
+        assert not fs.need_upload_download()
+        assert isinstance(fs, FS)
+
+    def test_bdfs_descope(self):
+        with pytest.raises(NotImplementedError):
+            BDFS()
+
+
+class TestCloudUtils:
+    def test_env_driven_cluster(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINERS", "10.1.1.1,10.1.1.2")
+        monkeypatch.setenv("POD_IP", "10.1.1.2")
+        monkeypatch.setenv("PADDLE_PORT", "7100")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        cluster, pod = cloud_utils.get_cloud_cluster(selected_gpus=[0])
+        assert cluster.pods_nranks() == 2 and pod.addr == "10.1.1.2"
+        assert cluster.trainers_endpoints()[0] == "10.1.1.1:7100"
+        assert cloud_utils.get_trainers_num() == 2
+
+    def test_defaults_without_env(self, monkeypatch):
+        for k in ("PADDLE_TRAINERS", "POD_IP", "PADDLE_PORT",
+                  "PADDLE_TRAINERS_NUM"):
+            monkeypatch.delenv(k, raising=False)
+        cluster, pod = cloud_utils.get_cloud_cluster()
+        assert cluster.pods_nranks() == 1
+        assert cloud_utils.get_trainers_num() == 1
+
+
+def test_launch_ps_descope():
+    with pytest.raises(NotImplementedError):
+        launch_ps.launch()
+
+
+def test_alias_spellings():
+    import importlib
+
+    a = importlib.import_module("paddle_tpu.distributed.utils")
+    b = importlib.import_module("paddle_tpu.dist.utils")
+    assert a is b
+    importlib.import_module("paddle_tpu.distributed.fs_wrapper")
+    importlib.import_module("paddle_tpu.distributed.cloud_utils")
+
+
+def test_review_regressions(tmp_path, monkeypatch):
+    """r5 review fixes: upload copies (source survives), port/trainer
+    mismatch gets a clear assertion, stray POD_IP without the env node
+    list doesn't crash, and termination reaps processes."""
+    import subprocess
+    import sys
+
+    fs = LocalFS()
+    src = tmp_path / "ckpt.bin"
+    src.write_text("weights")
+    fs.upload(str(src), str(tmp_path / "up.bin"))
+    assert src.exists()  # copy, not rename
+    assert (tmp_path / "up.bin").read_text() == "weights"
+
+    with pytest.raises(AssertionError, match="one port per trainer"):
+        get_cluster(["127.0.0.1"], "127.0.0.1", [6170], [0, 1])
+
+    for k in ("PADDLE_TRAINERS", "PADDLE_PORT"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("POD_IP", "10.9.9.9")  # k8s noise, no env list
+    cluster, pod = cloud_utils.get_cloud_cluster()
+    assert pod.addr == "127.0.0.1"
+
+    from paddle_tpu.dist.utils import terminate_local_procs
+
+    proc = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(60)"])
+    terminate_local_procs([proc])
+    assert proc.poll() is not None  # reaped, no zombie
