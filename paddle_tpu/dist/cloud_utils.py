@@ -20,7 +20,14 @@ def get_cloud_cluster(args_node_ips=None, args_node_ip=None,
         node_ips = env_ips.split(",")
         # POD_IP is only meaningful alongside the env node list (k8s
         # injects POD_IP into unrelated pods too)
-        node_ip = os.getenv("POD_IP", args_node_ip or node_ips[0])
+        node_ip = os.getenv("POD_IP", args_node_ip)
+        if node_ip is None:
+            if len(node_ips) > 1:
+                raise ValueError(
+                    "multi-node PADDLE_TRAINERS is set but neither "
+                    "POD_IP nor --node_ip identifies THIS node — "
+                    "defaulting would give every node rank 0")
+            node_ip = node_ips[0]
         if args_node_ips and isinstance(args_node_ips, str) and \
                 args_node_ips != "127.0.0.1" and \
                 args_node_ips != env_ips:

@@ -48,6 +48,8 @@ class Hdfs:
                 f"hdfs_path:{self.hdfs_path}")
 
     def __eq__(self, n):
+        if not isinstance(n, Hdfs):
+            return NotImplemented
         return (self.hdfs_ugi == n.hdfs_ugi
                 and self.hdfs_name == n.hdfs_name
                 and self.hdfs_path == n.hdfs_path)
@@ -64,6 +66,8 @@ class JobServer:
         return f"{self.endpoint}"
 
     def __eq__(self, j):
+        if not isinstance(j, JobServer):
+            return NotImplemented
         return self.endpoint == j.endpoint
 
     def __ne__(self, j):
@@ -81,6 +85,8 @@ class Trainer:
                 f"rank:{self.rank}")
 
     def __eq__(self, t):
+        if not isinstance(t, Trainer):
+            return NotImplemented
         return (self.gpus == t.gpus and self.endpoint == t.endpoint
                 and self.rank == t.rank)
 
@@ -109,6 +115,8 @@ class Pod:
                 f"{[str(t) for t in self.trainers]}")
 
     def __eq__(self, pod):
+        if not isinstance(pod, Pod):
+            return NotImplemented
         if (self.rank != pod.rank or self.id != pod.id
                 or self.addr != pod.addr or self.port != pod.port
                 or len(self.trainers) != len(pod.trainers)):
@@ -138,6 +146,8 @@ class Cluster:
                 f"job_stage_flag:{self.job_stage_flag} hdfs:{self.hdfs}")
 
     def __eq__(self, cluster):
+        if not isinstance(cluster, Cluster):
+            return NotImplemented
         if len(self.pods) != len(cluster.pods):
             return False
         return all(a == b for a, b in zip(self.pods, cluster.pods))
@@ -214,9 +224,14 @@ def terminate_local_procs(procs):
         if proc.poll() is None:
             proc.terminate()
         live.append((p, proc))
+    # one SHARED deadline (not 10s per process): stragglers past it
+    # are killed together
+    import time
+
+    deadline = time.time() + 10
     for p, proc in live:
         try:
-            proc.wait(timeout=10)
+            proc.wait(timeout=max(0.0, deadline - time.time()))
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait()

@@ -1,0 +1,163 @@
+"""fluid.layer_helper (ref: python/paddle/fluid/layer_helper.py) — the
+parameter/variable factory custom user layers are written against::
+
+    helper = LayerHelper("my_scale", **locals())
+    w = helper.create_parameter(attr=helper.param_attr, shape=[d],
+                                dtype="float32")
+    out = my_math_on(w, x)          # functional ops record the graph
+
+In the reference, append_op writes OpDescs by slot name; here ops
+record themselves when the functional API runs (static tracing), so
+the factory half (create_parameter / create_variable_for_type_inference
+/ input handling / append_activation / append_bias_op) is the live
+surface, and append_op additionally accepts any op in the kernel
+registry with positional inputs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.param_attr import ParamAttr
+from ..utils import unique_name
+
+__all__ = ["LayerHelper"]
+
+
+class LayerHelper:
+    def __init__(self, layer_type, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+        name = kwargs.get("name")
+        self._prefix = name if name is not None else layer_type
+
+    # -- naming / attrs (ref: layer_helper_base.py) -------------------------
+    @property
+    def name(self):
+        return self._prefix
+
+    @property
+    def param_attr(self):
+        return ParamAttr._to_attr(self.kwargs.get("param_attr"))
+
+    @property
+    def bias_attr(self):
+        return ParamAttr._to_attr(self.kwargs.get("bias_attr"))
+
+    def multiple_param_attr(self, length):
+        attr = self.param_attr
+        return [attr] * length
+
+    # -- inputs -------------------------------------------------------------
+    def multiple_input(self, input_param_name="input"):
+        inputs = self.kwargs.get(input_param_name, [])
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        return list(inputs)
+
+    def input(self, input_param_name="input"):
+        inputs = self.multiple_input(input_param_name)
+        if len(inputs) != 1:
+            raise ValueError(
+                f"{self.layer_type} expects one input, got {len(inputs)}")
+        return inputs[0]
+
+    def input_dtype(self, input_param_name="input"):
+        inputs = self.multiple_input(input_param_name)
+        if not inputs:
+            return "float32"
+        x = inputs[0]
+        return str(getattr(getattr(x, "_data", x), "dtype", "float32"))
+
+    # -- factories ----------------------------------------------------------
+    def create_parameter(self, attr, shape, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        """A fresh parameter through the Layer machinery — registers the
+        persistable var + scope value in static mode, a live Parameter
+        eagerly (ref: layer_helper_base.py create_parameter)."""
+        from ..nn.layer import Layer
+
+        holder = Layer(name_scope=self._prefix)
+        return holder.create_parameter(
+            shape, attr=attr, dtype=dtype, is_bias=is_bias,
+            default_initializer=default_initializer)
+
+    def create_variable_for_type_inference(self, dtype, stop_gradient=False):
+        """A temp output var in the current program (static), or a
+        placeholder name eagerly (functional ops make their own
+        outputs)."""
+        from ..core import dispatch
+
+        tracer = dispatch.current_tracer()
+        if tracer is None:
+            return None  # eager: the op's own return is the variable
+        blk = tracer.program.current_block()
+        return blk.create_var(
+            name=unique_name.generate(f"{self._prefix}.tmp"),
+            shape=(), dtype=dtype, stop_gradient=stop_gradient)
+
+    def create_variable(self, *args, **kwargs):
+        return self.create_variable_for_type_inference(
+            kwargs.get("dtype", "float32"))
+
+    def create_global_variable(self, shape, dtype, persistable=False,
+                               *a, **k):
+        from .. import ops as _ops
+
+        return _ops.zeros(list(shape), dtype=dtype)
+
+    # -- op appending -------------------------------------------------------
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        """Run a registry kernel over positional inputs (dict insertion
+        order); the result lands in outputs' first slot when given.
+        Reference ops absent from the registry raise by name so the
+        porter knows which functional API to call instead."""
+        from ..core import dispatch
+        from ..ops._base import OP_REGISTRY
+
+        if type not in OP_REGISTRY:
+            raise NotImplementedError(
+                f"op '{type}' has no registered kernel; call the "
+                f"functional API (paddle_tpu.ops / fluid.layers) instead "
+                "of LayerHelper.append_op")
+        args = []
+        for v in (inputs or {}).values():
+            args.extend(v if isinstance(v, (list, tuple)) else [v])
+        out = dispatch.apply(type, OP_REGISTRY[type], *args,
+                             **(attrs or {}))
+        return out
+
+    def append_activation(self, input_var=None, act=None):
+        act = act if act is not None else self.kwargs.get("act")
+        if act is None:
+            return input_var
+        if isinstance(act, dict):
+            act = act.get("type")
+        from ..nn import functional as F
+
+        return getattr(F, act)(input_var)
+
+    def append_bias_op(self, input_var, dim_start=1, dim_end=None):
+        bias_attr = self.bias_attr
+        if bias_attr is False:
+            return input_var
+        size = int(np.prod(input_var.shape[dim_start:dim_end]))
+        b = self.create_parameter(bias_attr, [size],
+                                  dtype=self.input_dtype(), is_bias=True)
+        return input_var + b
+
+    # -- misc ---------------------------------------------------------------
+    def set_variable_initializer(self, var, initializer):
+        var.initializer = initializer
+        return var
+
+    @property
+    def main_program(self):
+        from ..static_.program import default_main_program
+
+        return default_main_program()
+
+    @property
+    def startup_program(self):
+        from ..static_.program import default_startup_program
+
+        return default_startup_program()

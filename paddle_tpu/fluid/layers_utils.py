@@ -1,0 +1,99 @@
+"""fluid.layers.utils (ref: python/paddle/fluid/layers/utils.py) —
+the nest/structure helpers user RNN cells and decoders program against
+(map_structure over state pytrees, flatten/pack round-trips). Dict
+traversal follows the reference's sorted-key order.
+"""
+from __future__ import annotations
+
+__all__ = ["is_sequence", "flatten", "pack_sequence_as", "map_structure",
+           "assert_same_structure", "to_sequence", "sequence_like"]
+
+
+def is_sequence(seq):
+    """ref: utils.py:70 — dict/list/tuple (but not str) count."""
+    if isinstance(seq, dict):
+        return True
+    return isinstance(seq, (list, tuple)) and not isinstance(seq, str)
+
+
+def _yield_flat(nest):
+    if isinstance(nest, dict):
+        for k in sorted(nest):
+            yield from _yield_flat(nest[k])
+    elif is_sequence(nest):
+        for item in nest:
+            yield from _yield_flat(item)
+    else:
+        yield nest
+
+
+def flatten(nest):
+    """ref: utils.py:113 — leaves in deterministic order."""
+    return list(_yield_flat(nest)) if is_sequence(nest) else [nest]
+
+
+def _packed_iter(structure, flat, idx):
+    if isinstance(structure, dict):
+        out = {}
+        for k in sorted(structure):
+            out[k], idx = _packed_iter(structure[k], flat, idx)
+        return out, idx
+    if is_sequence(structure):
+        items = []
+        for s in structure:
+            v, idx = _packed_iter(s, flat, idx)
+            items.append(v)
+        return (tuple(items) if isinstance(structure, tuple)
+                else items), idx
+    return flat[idx], idx + 1
+
+
+def pack_sequence_as(structure, flat_sequence):
+    """ref: utils.py:162 — inverse of flatten for the same structure."""
+    if not is_sequence(structure):
+        if len(flat_sequence) != 1:
+            raise ValueError("structure is a scalar but "
+                             f"len(flat_sequence)={len(flat_sequence)}")
+        return flat_sequence[0]
+    packed, used = _packed_iter(structure, list(flat_sequence), 0)
+    if used != len(flat_sequence):
+        raise ValueError(
+            f"could not pack {len(flat_sequence)} leaves into the "
+            f"structure (used {used})")
+    return packed
+
+
+def map_structure(func, *structure):
+    """ref: utils.py:184 — apply func leaf-wise across structures."""
+    flats = [flatten(s) for s in structure]
+    n = len(flats[0])
+    if any(len(f) != n for f in flats):
+        raise ValueError("structures have different leaf counts")
+    results = [func(*leaves) for leaves in zip(*flats)]
+    return pack_sequence_as(structure[0], results)
+
+
+def assert_same_structure(nest1, nest2, check_types=True):
+    """ref: utils.py:244."""
+    f1, f2 = flatten(nest1), flatten(nest2)
+    if len(f1) != len(f2):
+        raise ValueError(
+            f"structures differ: {len(f1)} vs {len(f2)} leaves")
+    if check_types:
+        def skeleton(n):
+            if isinstance(n, dict):
+                return {k: skeleton(v) for k, v in n.items()}
+            if is_sequence(n):
+                return [skeleton(v) for v in n]
+            return None
+
+        if skeleton(nest1) != skeleton(nest2):
+            raise TypeError("structure types differ")
+
+
+def to_sequence(nest):
+    return nest if is_sequence(nest) else [nest]
+
+
+def sequence_like(instance, args):
+    return pack_sequence_as(instance, list(args))

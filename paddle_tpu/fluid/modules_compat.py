@@ -153,6 +153,28 @@ def install(fluid_pkg):
     fluid_pkg.monkey_patch_varbase = monkey_patch_varbase
     # ref fluid/__init__.py:72: fleet is re-exported from incubate
     fluid_pkg.fleet = fluid_pkg.incubate.fleet
+    # module-import spellings for the attribute-aliased submodules
+    # (from paddle.fluid import initializer / backward / clip / ... as
+    # MODULES — their homes live elsewhere in the package tree)
+    for alias in ("initializer", "regularizer", "clip", "metrics",
+                  "nets", "optimizer", "unique_name", "backward"):
+        mod = getattr(fluid_pkg, alias)
+        sys.modules[f"{base}.{alias}"] = mod
+
+    # fluid.layer_helper / fluid.input / fluid.layers.utils (real homes:
+    # fluid/layer_helper.py, fluid/layers_utils.py)
+    from . import layer_helper as _lh  # noqa: F401 (registers the file)
+    from . import layers_utils as _lu
+
+    sys.modules[base + ".layers.utils"] = _lu
+    fluid_pkg.layers.utils = _lu
+    input_face = _module(
+        base + ".input",
+        "ref: fluid/input.py (embedding, one_hot).",
+        dict(embedding=fluid_pkg.layers.embedding,
+             one_hot=fluid_pkg.layers.one_hot))
+    fluid_pkg.input = input_face
+
     mods.update(_install_contrib_faces(fluid_pkg))
     mods.update(_install_incubate_faces(fluid_pkg))
     return mods

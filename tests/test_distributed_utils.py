@@ -134,3 +134,13 @@ def test_review_regressions(tmp_path, monkeypatch):
                              "import time; time.sleep(60)"])
     terminate_local_procs([proc])
     assert proc.poll() is not None  # reaped, no zombie
+
+
+def test_eq_tolerates_foreign_types_and_pod_ip_required(monkeypatch):
+    assert Trainer() != None  # noqa: E711  (NotImplemented -> False)
+    assert Pod() != "x"
+    assert Cluster() != None  # noqa: E711
+    monkeypatch.setenv("PADDLE_TRAINERS", "10.0.0.1,10.0.0.2")
+    monkeypatch.delenv("POD_IP", raising=False)
+    with pytest.raises(ValueError, match="POD_IP"):
+        cloud_utils.get_cloud_cluster()

@@ -1,0 +1,111 @@
+"""fluid.layer_helper + fluid.layers.utils + fluid.input surfaces
+(ref: fluid/layer_helper.py, fluid/layers/utils.py, fluid/input.py):
+the factory custom user layers are written against, the nest helpers
+RNN cells use, and the module-import spellings for both.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.layer_helper import LayerHelper
+from paddle_tpu.fluid.layers import utils
+
+
+class TestLayerHelperStatic:
+    def test_custom_fluid_layer_trains(self):
+        """A reference-style custom layer: LayerHelper.create_parameter
+        + functional math, trained through the static Executor."""
+
+        def my_scale_shift(x, size):
+            helper = LayerHelper("my_scale_shift", **locals())
+            w = helper.create_parameter(helper.param_attr, [size],
+                                        dtype="float32")
+            b = helper.create_parameter(helper.bias_attr, [size],
+                                        dtype="float32", is_bias=True)
+            return x * w + b
+
+        pt.enable_static()
+        try:
+            main, startup = pt.static.Program(), pt.static.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", [8, 4], "float32")
+                y = pt.static.data("y", [8, 4], "float32")
+                out = my_scale_shift(x, 4)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(out, y))
+                pt.optimizer.SGD(learning_rate=0.2).minimize(loss)
+            exe = pt.static.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            X = rng.randn(8, 4).astype("float32")
+            Y = X * 3.0 + 0.5
+            losses = [float(exe.run(main, feed={"x": X, "y": Y},
+                                    fetch_list=[loss])[0])
+                      for _ in range(30)]
+            assert losses[-1] < losses[0] * 0.05
+        finally:
+            pt.disable_static()
+
+    def test_helper_accessors_and_append_activation(self):
+        helper = LayerHelper("thing", input=pt.ones([2, 3]), act="relu")
+        assert helper.input().shape == [2, 3]
+        assert helper.input_dtype() == "float32"
+        out = helper.append_activation(pt.to_tensor(
+            np.array([-1.0, 2.0], "float32")))
+        assert np.allclose(out.numpy(), [0.0, 2.0])
+        with pytest.raises(NotImplementedError, match="functional API"):
+            helper.append_op(type="definitely_not_an_op")
+
+    def test_append_op_registry_kernel(self):
+        helper = LayerHelper("t2")
+        out = helper.append_op(type="reshape",
+                               inputs={"X": pt.ones([2, 3])},
+                               attrs={"shape": (3, 2)})
+        assert list(out.shape) == [3, 2]
+
+
+class TestLayersUtils:
+    def test_flatten_pack_roundtrip(self):
+        nest = {"b": [1, (2, 3)], "a": 4}
+        flat = utils.flatten(nest)
+        assert flat == [4, 1, 2, 3]  # dict keys sorted
+        packed = utils.pack_sequence_as(nest, flat)
+        assert packed == {"a": 4, "b": [1, (2, 3)]}
+
+    def test_map_structure(self):
+        a = {"h": 1, "c": (2, 3)}
+        b = {"h": 10, "c": (20, 30)}
+        out = utils.map_structure(lambda x, y: x + y, a, b)
+        assert out == {"h": 11, "c": (22, 33)}
+
+    def test_assert_same_structure(self):
+        utils.assert_same_structure([1, (2,)], [9, (8,)])
+        with pytest.raises((ValueError, TypeError)):
+            utils.assert_same_structure([1, 2], [1, [2]])
+        assert utils.is_sequence([1]) and not utils.is_sequence("ab")
+
+
+def test_fluid_input_module():
+    from paddle_tpu.fluid.input import embedding, one_hot
+
+    assert callable(embedding) and callable(one_hot)
+    x = pt.to_tensor(np.array([0, 2], "int64"))
+    oh = one_hot(x, 4)
+    assert np.asarray(oh.numpy()).shape == (2, 4)
+
+
+def test_module_import_spellings():
+    import importlib
+
+    for name in ("paddle_tpu.fluid.initializer",
+                 "paddle_tpu.fluid.regularizer",
+                 "paddle_tpu.fluid.clip", "paddle_tpu.fluid.metrics",
+                 "paddle_tpu.fluid.nets", "paddle_tpu.fluid.optimizer",
+                 "paddle_tpu.fluid.unique_name",
+                 "paddle_tpu.fluid.backward"):
+        mod = importlib.import_module(name)
+        attr = getattr(fluid, name.rsplit(".", 1)[1])
+        assert mod is attr, name
+    from paddle_tpu.fluid.initializer import Xavier  # noqa: F401
+    from paddle_tpu.fluid.backward import append_backward  # noqa: F401
