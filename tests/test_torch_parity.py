@@ -1,0 +1,256 @@
+"""Independent numerical verification against torch (CPU).
+
+The op tests compare against hand-written numpy; this file adds a
+SECOND independent implementation for the subtle-semantics ops —
+conv variants (stride/padding/dilation/groups), transposed conv,
+pooling, batch/layer norm, LSTM/GRU whole-sequence runs, interpolation
+corner modes, and the optimizer update rules — so an agreement bug in
+our numpy oracle can't hide. Tolerances are float32-accumulation level.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")  # torch is optional in this env
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+@pytest.fixture
+def RNG():
+    # fresh stream per test: inputs don't depend on selection order
+    return np.random.RandomState(7)
+
+
+def t(x):
+    return torch.tensor(x)
+
+
+def ours(x):
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+
+
+class TestConvParity:
+    @pytest.mark.parametrize("stride,padding,dilation,groups", [
+        (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 3),
+    ])
+    def test_conv2d(self, stride, padding, dilation, groups, RNG):
+        cin = 6
+        x = RNG.randn(2, cin, 11, 11).astype("float32")
+        w = RNG.randn(9, cin // groups, 3, 3).astype("float32")
+        b = RNG.randn(9).astype("float32")
+        a = ours(F.conv2d(pt.to_tensor(x), pt.to_tensor(w),
+                          pt.to_tensor(b), stride=stride, padding=padding,
+                          dilation=dilation, groups=groups))
+        e = torch.nn.functional.conv2d(
+            t(x), t(w), t(b), stride=stride, padding=padding,
+            dilation=dilation, groups=groups).numpy()
+        np.testing.assert_allclose(a, e, atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("stride,padding,output_padding", [
+        (1, 0, 0), (2, 1, 1),
+    ])
+    def test_conv2d_transpose(self, stride, padding, output_padding, RNG):
+        x = RNG.randn(2, 4, 7, 7).astype("float32")
+        w = RNG.randn(4, 5, 3, 3).astype("float32")
+        a = ours(F.conv2d_transpose(
+            pt.to_tensor(x), pt.to_tensor(w), stride=stride,
+            padding=padding, output_padding=output_padding))
+        e = torch.nn.functional.conv_transpose2d(
+            t(x), t(w), stride=stride, padding=padding,
+            output_padding=output_padding).numpy()
+        np.testing.assert_allclose(a, e, atol=2e-5, rtol=2e-5)
+
+    def test_conv3d(self, RNG):
+        x = RNG.randn(1, 3, 6, 6, 6).astype("float32")
+        w = RNG.randn(4, 3, 2, 2, 2).astype("float32")
+        a = ours(F.conv3d(pt.to_tensor(x), pt.to_tensor(w), stride=2))
+        e = torch.nn.functional.conv3d(t(x), t(w), stride=2).numpy()
+        np.testing.assert_allclose(a, e, atol=2e-5, rtol=2e-5)
+
+
+class TestPoolNormParity:
+    def test_max_avg_pool(self, RNG):
+        x = RNG.randn(2, 3, 9, 9).astype("float32")
+        a = ours(F.max_pool2d(pt.to_tensor(x), kernel_size=3, stride=2,
+                              padding=1))
+        e = torch.nn.functional.max_pool2d(t(x), 3, stride=2,
+                                           padding=1).numpy()
+        np.testing.assert_allclose(a, e, atol=1e-6)
+        a = ours(F.avg_pool2d(pt.to_tensor(x), kernel_size=2, stride=2))
+        e = torch.nn.functional.avg_pool2d(t(x), 2, stride=2).numpy()
+        np.testing.assert_allclose(a, e, atol=1e-6)
+
+    def test_adaptive_avg_pool(self, RNG):
+        x = RNG.randn(2, 3, 10, 10).astype("float32")
+        a = ours(F.adaptive_avg_pool2d(pt.to_tensor(x), 4))
+        e = torch.nn.functional.adaptive_avg_pool2d(t(x), 4).numpy()
+        np.testing.assert_allclose(a, e, atol=1e-6)
+
+    def test_batch_norm_train_and_eval(self, RNG):
+        x = RNG.randn(4, 5, 6, 6).astype("float32")
+        g = RNG.rand(5).astype("float32") + 0.5
+        b = RNG.randn(5).astype("float32")
+        rm = np.zeros(5, "float32")
+        rv = np.ones(5, "float32")
+        # train mode: batch statistics
+        a = ours(F.batch_norm(pt.to_tensor(x), pt.to_tensor(rm.copy()),
+                              pt.to_tensor(rv.copy()), pt.to_tensor(g),
+                              pt.to_tensor(b), training=True,
+                              epsilon=1e-5))
+        e = torch.nn.functional.batch_norm(
+            t(x), t(rm.copy()), t(rv.copy()), t(g), t(b), training=True,
+            eps=1e-5).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
+        # eval mode: running statistics
+        rm2 = RNG.randn(5).astype("float32")
+        rv2 = RNG.rand(5).astype("float32") + 0.5
+        a = ours(F.batch_norm(pt.to_tensor(x), pt.to_tensor(rm2),
+                              pt.to_tensor(rv2), pt.to_tensor(g),
+                              pt.to_tensor(b), training=False,
+                              epsilon=1e-5))
+        e = torch.nn.functional.batch_norm(
+            t(x), t(rm2), t(rv2), t(g), t(b), training=False,
+            eps=1e-5).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
+
+    def test_layer_norm(self, RNG):
+        x = RNG.randn(4, 10).astype("float32")
+        g = RNG.rand(10).astype("float32") + 0.5
+        b = RNG.randn(10).astype("float32")
+        a = ours(F.layer_norm(pt.to_tensor(x), normalized_shape=[10],
+                              weight=pt.to_tensor(g), bias=pt.to_tensor(b),
+                              epsilon=1e-5))
+        e = torch.nn.functional.layer_norm(t(x), [10], t(g), t(b),
+                                           eps=1e-5).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
+
+
+class TestRNNParity:
+    @staticmethod
+    def _port_weights(torch_rnn, ours_rnn, D, H, gates):
+        """Copy torch l0 weights onto our layer by shape convention
+        (gate order agrees: LSTM i,f,g,o == i,f,c,o; GRU r,z,n)."""
+        wi = torch_rnn.weight_ih_l0.detach().numpy()   # (gates*H, D)
+        wh = torch_rnn.weight_hh_l0.detach().numpy()
+        bi = torch_rnn.bias_ih_l0.detach().numpy()
+        bh = torch_rnn.bias_hh_l0.detach().numpy()
+        sd = ours_rnn.state_dict()
+        new = {}
+        for k in sd:
+            if "weight_ih" in k:
+                new[k] = wi.T if tuple(sd[k].shape) == (D, gates * H) \
+                    else wi
+            elif "weight_hh" in k:
+                new[k] = wh.T if tuple(sd[k].shape) == (H, gates * H) \
+                    else wh
+            elif "bias_ih" in k:
+                new[k] = bi
+            elif "bias_hh" in k:
+                new[k] = bh
+            else:
+                new[k] = np.asarray(sd[k].numpy())
+        ours_rnn.set_state_dict({k: pt.to_tensor(v)
+                                 for k, v in new.items()})
+
+    def test_lstm_sequence(self, RNG):
+        D, H, B, T = 5, 7, 3, 6
+        tl = torch.nn.LSTM(D, H, batch_first=True)
+        ours_lstm = nn.LSTM(D, H)
+        self._port_weights(tl, ours_lstm, D, H, gates=4)
+        x = RNG.randn(B, T, D).astype("float32")
+        a_out, (a_h, a_c) = ours_lstm(pt.to_tensor(x))
+        e_out, (e_h, e_c) = tl(t(x))
+        np.testing.assert_allclose(ours(a_out), e_out.detach().numpy(),
+                                   atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(
+            ours(a_h).reshape(-1), e_h.detach().numpy().reshape(-1),
+            atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(
+            ours(a_c).reshape(-1), e_c.detach().numpy().reshape(-1),
+            atol=2e-5, rtol=2e-5)
+
+    def test_gru_sequence(self, RNG):
+        D, H, B, T = 4, 6, 2, 5
+        tg = torch.nn.GRU(D, H, batch_first=True)
+        ours_gru = nn.GRU(D, H)
+        self._port_weights(tg, ours_gru, D, H, gates=3)
+        x = RNG.randn(B, T, D).astype("float32")
+        a_out, a_h = ours_gru(pt.to_tensor(x))
+        e_out, e_h = tg(t(x))
+        np.testing.assert_allclose(ours(a_out), e_out.detach().numpy(),
+                                   atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(
+            ours(a_h).reshape(-1), e_h.detach().numpy().reshape(-1),
+            atol=2e-5, rtol=2e-5)
+
+
+class TestOptimizerParity:
+    def _run_both(self, rng, make_ours, make_torch, steps=5):
+        w0 = rng.randn(4, 3).astype("float32")
+        grads = [rng.randn(4, 3).astype("float32") for _ in range(steps)]
+
+        p_t = torch.nn.Parameter(torch.tensor(w0.copy()))
+        opt_t = make_torch([p_t])
+        for g in grads:
+            opt_t.zero_grad()
+            p_t.grad = torch.tensor(g)
+            opt_t.step()
+
+        param = pt.Parameter(w0.copy())
+        opt_o = make_ours([param])
+        for g in grads:
+            param.grad = pt.to_tensor(g)
+            opt_o.step()
+            opt_o.clear_grad()
+        return ours(param), p_t.detach().numpy()
+
+    def test_sgd(self, RNG):
+        a, e = self._run_both(
+            RNG,
+            lambda ps: pt.optimizer.SGD(learning_rate=0.1, parameters=ps),
+            lambda ps: torch.optim.SGD(ps, lr=0.1))
+        np.testing.assert_allclose(a, e, atol=1e-6)
+
+    def test_momentum(self, RNG):
+        a, e = self._run_both(
+            RNG,
+            lambda ps: pt.optimizer.Momentum(learning_rate=0.1,
+                                             momentum=0.9, parameters=ps),
+            lambda ps: torch.optim.SGD(ps, lr=0.1, momentum=0.9))
+        np.testing.assert_allclose(a, e, atol=1e-6)
+
+    def test_adam(self, RNG):
+        a, e = self._run_both(
+            RNG,
+            lambda ps: pt.optimizer.Adam(learning_rate=0.01,
+                                         beta1=0.9, beta2=0.999,
+                                         epsilon=1e-8, parameters=ps),
+            lambda ps: torch.optim.Adam(ps, lr=0.01, betas=(0.9, 0.999),
+                                        eps=1e-8))
+        np.testing.assert_allclose(a, e, atol=1e-6)
+
+    def test_adamw(self, RNG):
+        a, e = self._run_both(
+            RNG,
+            lambda ps: pt.optimizer.AdamW(learning_rate=0.01,
+                                          weight_decay=0.05,
+                                          parameters=ps),
+            lambda ps: torch.optim.AdamW(ps, lr=0.01, weight_decay=0.05))
+        np.testing.assert_allclose(a, e, atol=1e-6)
+
+
+class TestInterpolateParity:
+    @pytest.mark.parametrize("mode,align", [
+        ("bilinear", False), ("bilinear", True), ("nearest", False),
+    ])
+    def test_resize(self, mode, align, RNG):
+        x = RNG.randn(2, 3, 6, 6).astype("float32")
+        kw = {} if mode == "nearest" else {"align_corners": align}
+        a = ours(F.interpolate(pt.to_tensor(x), size=[11, 11], mode=mode,
+                               **kw))
+        e = torch.nn.functional.interpolate(
+            t(x), size=(11, 11), mode=mode,
+            **({} if mode == "nearest" else {"align_corners": align})
+        ).numpy()
+        np.testing.assert_allclose(a, e, atol=2e-5, rtol=2e-5)
