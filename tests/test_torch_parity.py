@@ -254,3 +254,130 @@ class TestInterpolateParity:
             **({} if mode == "nearest" else {"align_corners": align})
         ).numpy()
         np.testing.assert_allclose(a, e, atol=2e-5, rtol=2e-5)
+
+
+class TestLossParity:
+    def test_cross_entropy_with_ignore_and_weight(self, RNG):
+        logits = RNG.randn(6, 5).astype("float32")
+        labels = np.array([0, 3, 2, -100, 4, 1], "int64")
+        w = (RNG.rand(5).astype("float32") + 0.5)
+        a = ours(F.cross_entropy(pt.to_tensor(logits),
+                                 pt.to_tensor(labels),
+                                 weight=pt.to_tensor(w),
+                                 ignore_index=-100, reduction="mean"))
+        e = torch.nn.functional.cross_entropy(
+            t(logits), t(labels), weight=t(w), ignore_index=-100,
+            reduction="mean").numpy()
+        np.testing.assert_allclose(a, e, atol=2e-5, rtol=2e-5)
+
+    def test_bce_and_kl(self, RNG):
+        p = RNG.rand(8).astype("float32") * 0.9 + 0.05
+        y = (RNG.rand(8) > 0.5).astype("float32")
+        a = ours(F.binary_cross_entropy(pt.to_tensor(p), pt.to_tensor(y)))
+        e = torch.nn.functional.binary_cross_entropy(t(p), t(y)).numpy()
+        np.testing.assert_allclose(a, e, atol=2e-5, rtol=2e-5)
+
+        logq = np.log(RNG.dirichlet(np.ones(4), 5).astype("float32"))
+        pr = RNG.dirichlet(np.ones(4), 5).astype("float32")
+        a = ours(F.kl_div(pt.to_tensor(logq), pt.to_tensor(pr),
+                          reduction="batchmean"))
+        e = torch.nn.functional.kl_div(t(logq), t(pr),
+                                       reduction="batchmean").numpy()
+        np.testing.assert_allclose(a, e, atol=2e-5, rtol=2e-5)
+
+    def test_ctc_loss(self, RNG):
+        T, B, C = 12, 3, 6
+        logits = RNG.randn(T, B, C).astype("float32")
+        log_probs = torch.log_softmax(t(logits), dim=-1)
+        labels = np.array([[1, 2, 3, 0], [2, 2, 4, 5], [5, 1, 0, 0]],
+                          "int64")
+        in_lens = np.array([12, 10, 9], "int64")
+        lb_lens = np.array([3, 4, 2], "int64")
+        a = ours(F.ctc_loss(pt.to_tensor(log_probs.numpy()),
+                            pt.to_tensor(labels),
+                            pt.to_tensor(in_lens), pt.to_tensor(lb_lens),
+                            blank=0, reduction="none"))
+        e = torch.nn.functional.ctc_loss(
+            log_probs, t(labels), t(in_lens), t(lb_lens), blank=0,
+            reduction="none").numpy()
+        np.testing.assert_allclose(np.asarray(a).ravel(), e.ravel(),
+                                   atol=2e-4, rtol=2e-4)
+
+
+class TestGradParity:
+    """Gradients through the same ops — catches vjp-rule bugs the
+    forward-only checks can't."""
+
+    def test_conv2d_grads(self, RNG):
+        x = RNG.randn(2, 3, 8, 8).astype("float32")
+        w = RNG.randn(4, 3, 3, 3).astype("float32")
+        g = RNG.randn(2, 4, 4, 4).astype("float32")  # cotangent
+
+        xo = pt.to_tensor(x)
+        xo.stop_gradient = False
+        wo = pt.to_tensor(w)
+        wo.stop_gradient = False
+        out = F.conv2d(xo, wo, stride=2, padding=1)
+        (out * pt.to_tensor(g)).sum().backward()
+
+        xt = t(x).requires_grad_(True)
+        wt = t(w).requires_grad_(True)
+        et = torch.nn.functional.conv2d(xt, wt, stride=2, padding=1)
+        (et * t(g)).sum().backward()
+
+        np.testing.assert_allclose(ours(xo.grad), xt.grad.numpy(),
+                                   atol=3e-5, rtol=3e-5)
+        np.testing.assert_allclose(ours(wo.grad), wt.grad.numpy(),
+                                   atol=3e-5, rtol=3e-5)
+
+    def test_batch_norm_train_grads(self, RNG):
+        x = RNG.randn(4, 5, 6, 6).astype("float32")
+        gamma = RNG.rand(5).astype("float32") + 0.5
+        beta = RNG.randn(5).astype("float32")
+        g = RNG.randn(4, 5, 6, 6).astype("float32")
+
+        xo = pt.to_tensor(x)
+        xo.stop_gradient = False
+        go = pt.to_tensor(gamma)
+        go.stop_gradient = False
+        bo = pt.to_tensor(beta)
+        bo.stop_gradient = False
+        out = F.batch_norm(xo, pt.to_tensor(np.zeros(5, "float32")),
+                           pt.to_tensor(np.ones(5, "float32")), go, bo,
+                           training=True, epsilon=1e-5)
+        (out * pt.to_tensor(g)).sum().backward()
+
+        xt = t(x).requires_grad_(True)
+        gt = t(gamma).requires_grad_(True)
+        bt = t(beta).requires_grad_(True)
+        et = torch.nn.functional.batch_norm(
+            xt, torch.zeros(5), torch.ones(5), gt, bt, training=True,
+            eps=1e-5)
+        (et * t(g)).sum().backward()
+
+        np.testing.assert_allclose(ours(xo.grad), xt.grad.numpy(),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(ours(go.grad), gt.grad.numpy(),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(ours(bo.grad), bt.grad.numpy(),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_lstm_input_grads(self, RNG):
+        D, H, B, T = 5, 7, 3, 6
+        tl = torch.nn.LSTM(D, H, batch_first=True)
+        ours_lstm = nn.LSTM(D, H)
+        TestRNNParity._port_weights(tl, ours_lstm, D, H, gates=4)
+        x = RNG.randn(B, T, D).astype("float32")
+        g = RNG.randn(B, T, H).astype("float32")
+
+        xo = pt.to_tensor(x)
+        xo.stop_gradient = False
+        a_out, _ = ours_lstm(xo)
+        (a_out * pt.to_tensor(g)).sum().backward()
+
+        xt = t(x).requires_grad_(True)
+        e_out, _ = tl(xt)
+        (e_out * t(g)).sum().backward()
+
+        np.testing.assert_allclose(ours(xo.grad), xt.grad.numpy(),
+                                   atol=5e-5, rtol=5e-5)
