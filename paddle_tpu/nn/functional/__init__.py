@@ -16,6 +16,7 @@ from ...ops.conv import (  # noqa: F401
     max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d, adaptive_avg_pool1d,
     adaptive_avg_pool2d, adaptive_max_pool1d, adaptive_max_pool2d,
     interpolate, pixel_shuffle, unfold,
+    grid_sample, affine_grid,  # 2.x paddle.nn.functional homes
 )
 from ...ops.norm_ops import (  # noqa: F401
     batch_norm, layer_norm, group_norm, instance_norm, normalize,

@@ -629,16 +629,28 @@ def _grid_sample(x, grid, *, mode, padding_mode, align_corners):
         fy = ((gy + 1.0) * h - 1.0) * 0.5
 
     def reflect(v, lo, hi):
-        # triangular-wave reflection into [lo, hi] around pixel centers
+        """Triangular-wave reflection into [lo, hi]: in-range values
+        come back unchanged, out-of-range fold back from the nearer
+        edge (rng - |mod(v-lo, 2rng) - rng| + lo; the |...| alone
+        would MIRROR in-range values across the interval)."""
         rng = hi - lo
-        return jnp.abs(jnp.mod(v - lo, 2 * rng + 1e-12) - rng) + lo
+        return rng - jnp.abs(jnp.mod(v - lo, 2 * rng + 1e-12) - rng) + lo
 
     if padding_mode == "border":
         fx = jnp.clip(fx, 0.0, w - 1.0)
         fy = jnp.clip(fy, 0.0, h - 1.0)
     elif padding_mode == "reflection":
-        fx = jnp.clip(reflect(fx, 0.0, w - 1.0), 0.0, w - 1.0)
-        fy = jnp.clip(reflect(fy, 0.0, h - 1.0), 0.0, h - 1.0)
+        if align_corners:
+            # reflect around pixel CENTERS (interval [0, size-1])
+            fx = reflect(fx, 0.0, w - 1.0)
+            fy = reflect(fy, 0.0, h - 1.0)
+        else:
+            # reflect around pixel EDGES ([-0.5, size-0.5]), as torch
+            # and the reference kernel do for unaligned corners
+            fx = reflect(fx, -0.5, w - 0.5)
+            fy = reflect(fy, -0.5, h - 0.5)
+        fx = jnp.clip(fx, 0.0, w - 1.0)
+        fy = jnp.clip(fy, 0.0, h - 1.0)
 
     def tap(ix, iy):
         """x[n, :, iy, ix] with zero padding OOB -> (N, H', W', C)."""

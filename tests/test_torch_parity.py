@@ -381,3 +381,51 @@ class TestGradParity:
 
         np.testing.assert_allclose(ours(xo.grad), xt.grad.numpy(),
                                    atol=5e-5, rtol=5e-5)
+
+
+class TestGeometricParity:
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("align", [True, False])
+    @pytest.mark.parametrize("padding_mode",
+                             ["zeros", "border", "reflection"])
+    def test_grid_sample(self, align, padding_mode, mode, RNG):
+        x = RNG.randn(2, 3, 6, 6).astype("float32")
+        grid = (RNG.rand(2, 5, 5, 2).astype("float32") * 2.4 - 1.2)
+        a = ours(F.grid_sample(pt.to_tensor(x), pt.to_tensor(grid),
+                               mode=mode, padding_mode=padding_mode,
+                               align_corners=align))
+        e = torch.nn.functional.grid_sample(
+            t(x), t(grid), mode=mode, padding_mode=padding_mode,
+            align_corners=align).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
+
+    def test_affine_grid(self, RNG):
+        theta = RNG.randn(2, 2, 3).astype("float32")
+        a = ours(F.affine_grid(pt.to_tensor(theta), [2, 3, 5, 7],
+                               align_corners=True))
+        e = torch.nn.functional.affine_grid(t(theta), (2, 3, 5, 7),
+                                            align_corners=True).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
+
+    def test_pixel_shuffle(self, RNG):
+        x = RNG.randn(2, 8, 3, 3).astype("float32")
+        a = ours(F.pixel_shuffle(pt.to_tensor(x), 2))
+        e = torch.nn.functional.pixel_shuffle(t(x), 2).numpy()
+        np.testing.assert_allclose(a, e, atol=1e-6)
+
+    def test_embedding_grads(self, RNG):
+        table = RNG.randn(10, 4).astype("float32")
+        idx = np.array([1, 3, 3, 7], "int64")
+        g = RNG.randn(4, 4).astype("float32")
+
+        to = pt.to_tensor(table)
+        to.stop_gradient = False
+        out = F.embedding(pt.to_tensor(idx), to)
+        (out * pt.to_tensor(g)).sum().backward()
+
+        tt = t(table).requires_grad_(True)
+        et = torch.nn.functional.embedding(t(idx), tt)
+        (et * t(g)).sum().backward()
+        # duplicate index 3 must ACCUMULATE its two cotangent rows
+        np.testing.assert_allclose(ours(to.grad), tt.grad.numpy(),
+                                   atol=1e-6)
