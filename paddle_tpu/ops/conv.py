@@ -494,16 +494,24 @@ def _resize_weights(n_in, n_out, align_corners, align_mode, mode="linear"):
 
 @register("interpolate")
 def _interpolate(x, *, size, mode, align_corners, align_mode=1):
-    n, c, h, w = x.shape
-    oh, ow = size
-    axis_mode = {"nearest": "nearest", "bilinear": "linear",
+    """N-spatial-dim resize as one interpolation matmul per axis —
+    NCL linear, NCHW bilinear/bicubic/nearest, NCDHW trilinear all
+    share the same per-axis weights."""
+    axis_mode = {"nearest": "nearest", "linear": "linear",
+                 "bilinear": "linear", "trilinear": "linear",
                  "bicubic": "cubic", "area": "linear"}[mode]
+    spatial = x.shape[2:]
+    if len(size) != len(spatial):
+        raise ValueError(f"size {size} does not match the "
+                         f"{len(spatial)} spatial dims of {x.shape}")
     dt = x.dtype
-    xf = x.astype(jnp.float32)
-    Wh = _resize_weights(h, oh, align_corners, align_mode, mode=axis_mode)
-    Ww = _resize_weights(w, ow, align_corners, align_mode, mode=axis_mode)
-    out = jnp.einsum("nchw,oh->ncow", xf, Wh)
-    out = jnp.einsum("nchw,ow->ncho", out, Ww)
+    out = x.astype(jnp.float32)
+    for ax, (n_in, n_out) in enumerate(zip(spatial, size)):
+        W = _resize_weights(n_in, n_out, align_corners, align_mode,
+                            mode=axis_mode)
+        out = jnp.moveaxis(
+            jnp.tensordot(jnp.moveaxis(out, 2 + ax, -1), W.T, axes=1),
+            -1, 2 + ax)
     return out.astype(dt)
 
 
@@ -511,8 +519,10 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW", name=None):
     shp = unwrap(x).shape
     if size is None:
-        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (scale_factor, scale_factor)
-        size = (int(shp[2] * sf[0]), int(shp[3] * sf[1]))
+        nsp = len(shp) - 2
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else (scale_factor,) * nsp
+        size = tuple(int(shp[2 + i] * sf[i]) for i in range(nsp))
     else:
         if isinstance(size, Tensor):
             size = [int(v) for v in np.asarray(size._data)]
@@ -827,18 +837,11 @@ def im2sequence(input, filter_size=1, stride=1, padding=0,
 @register("resize_trilinear_op")
 def _resize_trilinear(x, *, size, align_corners=True, align_mode=1):
     # attr defaults match the fluid signature so programs saved before
-    # these attrs existed still replay
-    n, c, d, h, w = x.shape
-    od, oh, ow = size
-    dt = x.dtype
-    xf = x.astype(jnp.float32)
-    Wd = _resize_weights(d, od, align_corners, align_mode)
-    Wh = _resize_weights(h, oh, align_corners, align_mode)
-    Ww = _resize_weights(w, ow, align_corners, align_mode)
-    out = jnp.einsum("ncdhw,ed->ncehw", xf, Wd)
-    out = jnp.einsum("ncdhw,eh->ncdew", out, Wh)
-    out = jnp.einsum("ncdhw,ew->ncdhe", out, Ww)
-    return out.astype(dt)
+    # these attrs existed still replay; the math is the shared N-d
+    # per-axis kernel (one implementation to keep in sync)
+    return _interpolate(x, size=tuple(size), mode="trilinear",
+                        align_corners=align_corners,
+                        align_mode=align_mode)
 
 
 def resize_trilinear(input, out_shape=None, scale=None, name=None,

@@ -364,14 +364,16 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
         # paddle "2*ndim" form: [[d0_l, d0_r], ...] flattened
         widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
     else:
-        # spatial form: applies to trailing spatial dims
+        # spatial form: pairs assign from the LAST spatial dim backwards
+        # (paddle/torch convention: [left, right, top, bottom, ...] —
+        # left/right pad the W axis, i.e. the innermost dim)
         nspatial = len(pad) // 2
         widths = [(0, 0)] * nd
         if data_format.upper().endswith("C"):  # NHWC/NLC/NDHWC: spatial before C
             spatial_dims = list(range(1, 1 + nspatial))
         else:  # NCHW/NCL/NCDHW
             spatial_dims = list(range(nd - nspatial, nd))
-        for i, d in enumerate(spatial_dims):
+        for i, d in enumerate(reversed(spatial_dims)):
             widths[d] = (pad[2 * i], pad[2 * i + 1])
     return apply("pad", x, paddings=tuple(widths), mode=mode, value=value)
 

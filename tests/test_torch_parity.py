@@ -429,3 +429,40 @@ class TestGeometricParity:
         # duplicate index 3 must ACCUMULATE its two cotangent rows
         np.testing.assert_allclose(ours(to.grad), tt.grad.numpy(),
                                    atol=1e-6)
+
+
+class TestPadUnfoldParity:
+    @pytest.mark.parametrize("mode", ["constant", "reflect", "replicate"])
+    def test_pad2d_modes(self, mode, RNG):
+        x = RNG.randn(2, 3, 5, 5).astype("float32")
+        pad = [1, 2, 2, 1]  # (left, right, top, bottom)
+        kw = {"value": 1.5} if mode == "constant" else {}
+        a = ours(F.pad(pt.to_tensor(x), pad, mode=mode, **kw))
+        e = torch.nn.functional.pad(
+            t(x), pad, mode=mode,
+            **({"value": 1.5} if mode == "constant" else {})).numpy()
+        np.testing.assert_allclose(a, e, atol=1e-6)
+
+    def test_circular_pad(self, RNG):
+        x = RNG.randn(1, 2, 4, 4).astype("float32")
+        a = ours(F.pad(pt.to_tensor(x), [1, 1, 1, 1], mode="circular"))
+        e = torch.nn.functional.pad(t(x), [1, 1, 1, 1],
+                                    mode="circular").numpy()
+        np.testing.assert_allclose(a, e, atol=1e-6)
+
+    def test_unfold_im2col(self, RNG):
+        x = RNG.randn(2, 3, 7, 7).astype("float32")
+        a = ours(F.unfold(pt.to_tensor(x), kernel_sizes=3, strides=2,
+                          paddings=1, dilations=1))
+        e = torch.nn.functional.unfold(t(x), kernel_size=3, stride=2,
+                                       padding=1, dilation=1).numpy()
+        np.testing.assert_allclose(a, e, atol=1e-6)
+
+    def test_trilinear_resize(self, RNG):
+        x = RNG.randn(1, 2, 4, 4, 4).astype("float32")
+        a = ours(F.interpolate(pt.to_tensor(x), size=[7, 6, 5],
+                               mode="trilinear", align_corners=True))
+        e = torch.nn.functional.interpolate(
+            t(x), size=(7, 6, 5), mode="trilinear",
+            align_corners=True).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
