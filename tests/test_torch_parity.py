@@ -466,3 +466,62 @@ class TestPadUnfoldParity:
             t(x), size=(7, 6, 5), mode="trilinear",
             align_corners=True).numpy()
         np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
+
+
+class TestAttentionParity:
+    def test_multi_head_attention(self, RNG):
+        """Self-attention parity with torch.nn.MultiheadAttention:
+        torch packs q/k/v into in_proj; ours keeps separate
+        projections — split the packed weights and port."""
+        E, H, B, T = 8, 2, 3, 5
+        tm = torch.nn.MultiheadAttention(E, H, batch_first=True)
+        om = nn.MultiHeadAttention(E, H)
+
+        in_w = tm.in_proj_weight.detach().numpy()      # (3E, E)
+        in_b = tm.in_proj_bias.detach().numpy()
+        out_w = tm.out_proj.weight.detach().numpy()    # (E, E)
+        out_b = tm.out_proj.bias.detach().numpy()
+        qw, kw, vw = in_w[:E], in_w[E:2 * E], in_w[2 * E:]
+        qb, kb, vb = in_b[:E], in_b[E:2 * E], in_b[2 * E:]
+        port = {"q_proj.weight": qw.T, "q_proj.bias": qb,
+                "k_proj.weight": kw.T, "k_proj.bias": kb,
+                "v_proj.weight": vw.T, "v_proj.bias": vb,
+                "out_proj.weight": out_w.T, "out_proj.bias": out_b}
+        om.set_state_dict({k: pt.to_tensor(v.astype("float32"))
+                           for k, v in port.items()})
+
+        x = RNG.randn(B, T, E).astype("float32")
+        a = ours(om(pt.to_tensor(x)))
+        e, _ = tm(t(x), t(x), t(x), need_weights=False)
+        np.testing.assert_allclose(a, e.detach().numpy(), atol=3e-5,
+                                   rtol=3e-5)
+
+    def test_bidirectional_lstm(self, RNG):
+        D, H, B, T = 4, 5, 2, 6
+        tl = torch.nn.LSTM(D, H, batch_first=True, bidirectional=True)
+        om = nn.LSTM(D, H, direction="bidirect")
+        sd = om.state_dict()
+        # port forward (l0) and reverse (l0_reverse) weights by shape
+        maps = {}
+        for ours_key in sd:
+            rev = ours_key.startswith("1.")  # cell 1 = reverse direction
+            suffix = "_reverse" if rev else ""
+            kind = ours_key.split(".", 1)[1]  # LSTMCell layout == torch
+            maps[ours_key] = getattr(
+                tl, f"{kind}_l0{suffix}").detach().numpy()
+            assert tuple(sd[ours_key].shape) == maps[ours_key].shape, \
+                ours_key  # fail loudly on any layout change
+        om.set_state_dict({k: pt.to_tensor(v) for k, v in maps.items()})
+        x = RNG.randn(B, T, D).astype("float32")
+        a_out, (a_h, a_c) = om(pt.to_tensor(x))
+        e_out, (e_h, e_c) = tl(t(x))
+        np.testing.assert_allclose(ours(a_out), e_out.detach().numpy(),
+                                   atol=3e-5, rtol=3e-5)
+        # final states include the (num_directions, B, H) stack order
+        # and the cell state (not derivable from the output sequence)
+        np.testing.assert_allclose(
+            ours(a_h).reshape(-1), e_h.detach().numpy().reshape(-1),
+            atol=3e-5, rtol=3e-5)
+        np.testing.assert_allclose(
+            ours(a_c).reshape(-1), e_c.detach().numpy().reshape(-1),
+            atol=3e-5, rtol=3e-5)
