@@ -129,27 +129,26 @@ class TestPoolNormParity:
 class TestRNNParity:
     @staticmethod
     def _port_weights(torch_rnn, ours_rnn, D, H, gates):
-        """Copy torch l0 weights onto our layer by shape convention
-        (gate order agrees: LSTM i,f,g,o == i,f,c,o; GRU r,z,n)."""
-        wi = torch_rnn.weight_ih_l0.detach().numpy()   # (gates*H, D)
-        wh = torch_rnn.weight_hh_l0.detach().numpy()
-        bi = torch_rnn.bias_ih_l0.detach().numpy()
-        bh = torch_rnn.bias_hh_l0.detach().numpy()
+        """Copy torch l0 weights (both directions when present) onto
+        our layer. Gate orders agree (LSTM i,f,g,o == i,f,c,o; GRU
+        r,z,n); our keys are '<cell>.<kind>' where cell '1.' is the
+        reverse direction. Transpose by shape where layouts differ,
+        and fail loudly on anything else."""
         sd = ours_rnn.state_dict()
         new = {}
         for k in sd:
-            if "weight_ih" in k:
-                new[k] = wi.T if tuple(sd[k].shape) == (D, gates * H) \
-                    else wi
-            elif "weight_hh" in k:
-                new[k] = wh.T if tuple(sd[k].shape) == (H, gates * H) \
-                    else wh
-            elif "bias_ih" in k:
-                new[k] = bi
-            elif "bias_hh" in k:
-                new[k] = bh
+            cell, kind = (k.split(".", 1) if "." in k else ("0", k))
+            suffix = "_reverse" if cell == "1" else ""
+            w = getattr(torch_rnn,
+                        f"{kind}_l0{suffix}").detach().numpy()
+            want = tuple(sd[k].shape)
+            if want == w.shape:
+                new[k] = w
+            elif want == w.shape[::-1]:
+                new[k] = w.T
             else:
-                new[k] = np.asarray(sd[k].numpy())
+                raise AssertionError(f"unportable layout for {k}: "
+                                     f"{want} vs torch {w.shape}")
         ours_rnn.set_state_dict({k: pt.to_tensor(v)
                                  for k, v in new.items()})
 
@@ -500,18 +499,7 @@ class TestAttentionParity:
         D, H, B, T = 4, 5, 2, 6
         tl = torch.nn.LSTM(D, H, batch_first=True, bidirectional=True)
         om = nn.LSTM(D, H, direction="bidirect")
-        sd = om.state_dict()
-        # port forward (l0) and reverse (l0_reverse) weights by shape
-        maps = {}
-        for ours_key in sd:
-            rev = ours_key.startswith("1.")  # cell 1 = reverse direction
-            suffix = "_reverse" if rev else ""
-            kind = ours_key.split(".", 1)[1]  # LSTMCell layout == torch
-            maps[ours_key] = getattr(
-                tl, f"{kind}_l0{suffix}").detach().numpy()
-            assert tuple(sd[ours_key].shape) == maps[ours_key].shape, \
-                ours_key  # fail loudly on any layout change
-        om.set_state_dict({k: pt.to_tensor(v) for k, v in maps.items()})
+        TestRNNParity._port_weights(tl, om, D, H, gates=4)
         x = RNG.randn(B, T, D).astype("float32")
         a_out, (a_h, a_c) = om(pt.to_tensor(x))
         e_out, (e_h, e_c) = tl(t(x))
@@ -525,3 +513,53 @@ class TestAttentionParity:
         np.testing.assert_allclose(
             ours(a_c).reshape(-1), e_c.detach().numpy().reshape(-1),
             atol=3e-5, rtol=3e-5)
+
+
+class TestActivationParity:
+    @pytest.mark.parametrize("approximate", [False, True])
+    def test_gelu_both_forms(self, approximate, RNG):
+        x = RNG.randn(64).astype("float32") * 3
+        a = ours(F.gelu(pt.to_tensor(x), approximate=approximate))
+        e = torch.nn.functional.gelu(
+            t(x), approximate="tanh" if approximate else "none").numpy()
+        np.testing.assert_allclose(a, e, atol=2e-6, rtol=2e-6)
+
+    def test_softplus_beta_threshold(self, RNG):
+        # threshold switches to identity for beta*x > threshold
+        x = np.array([-3.0, 0.0, 2.0, 12.0, 40.0], "float32")
+        a = ours(F.softplus(pt.to_tensor(x), beta=2.0, threshold=15.0))
+        e = torch.nn.functional.softplus(t(x), beta=2.0,
+                                         threshold=15.0).numpy()
+        np.testing.assert_allclose(a, e, atol=2e-6, rtol=2e-6)
+
+    @pytest.mark.parametrize("name,tname,kw", [
+        ("silu", "silu", {}),
+        ("mish", "mish", {}),
+        ("hardswish", "hardswish", {}),
+        ("elu", "elu", {"alpha": 0.7}),
+        ("selu", "selu", {}),
+        ("leaky_relu", "leaky_relu", {}),
+        ("relu6", "relu6", {}),
+        ("log_sigmoid", "logsigmoid", {}),
+        ("tanhshrink", "tanhshrink", {}),
+        ("softsign", "softsign", {}),
+    ])
+    def test_elementwise(self, name, tname, kw, RNG):
+        x = RNG.randn(64).astype("float32") * 3
+        a = ours(getattr(F, name)(pt.to_tensor(x), **kw))
+        e = getattr(torch.nn.functional, tname)(t(x), **kw).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-6, rtol=3e-6)
+
+    def test_hardsigmoid_paddle_slope(self, RNG):
+        """paddle hardsigmoid uses slope 1/6 + offset 0.5 like torch."""
+        x = np.linspace(-4, 4, 33).astype("float32")
+        a = ours(F.hardsigmoid(pt.to_tensor(x)))
+        e = torch.nn.functional.hardsigmoid(t(x)).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-6)
+
+    def test_prelu(self, RNG):
+        x = RNG.randn(2, 4, 5).astype("float32")
+        w = np.array([0.1, 0.2, 0.3, 0.4], "float32")
+        a = ours(F.prelu(pt.to_tensor(x), pt.to_tensor(w)))
+        e = torch.nn.functional.prelu(t(x), t(w)).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-6)
