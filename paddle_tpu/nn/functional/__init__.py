@@ -11,7 +11,8 @@ from ...ops.activation import (  # noqa: F401
     log_sigmoid, gumbel_softmax, maxout, glu,
 )
 from ...ops.conv import (  # noqa: F401
-    conv1d, conv2d, conv3d, conv2d_transpose, conv3d_transpose,
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+    conv3d_transpose,
     max_pool1d, max_pool2d,
     max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d, adaptive_avg_pool1d,
     adaptive_avg_pool2d, adaptive_max_pool1d, adaptive_max_pool2d,

@@ -128,7 +128,7 @@ class TestPoolNormParity:
 
 class TestRNNParity:
     @staticmethod
-    def _port_weights(torch_rnn, ours_rnn, D, H, gates):
+    def _port_weights(torch_rnn, ours_rnn):
         """Copy torch l0 weights (both directions when present) onto
         our layer. Gate orders agree (LSTM i,f,g,o == i,f,c,o; GRU
         r,z,n); our keys are '<cell>.<kind>' where cell '1.' is the
@@ -156,7 +156,7 @@ class TestRNNParity:
         D, H, B, T = 5, 7, 3, 6
         tl = torch.nn.LSTM(D, H, batch_first=True)
         ours_lstm = nn.LSTM(D, H)
-        self._port_weights(tl, ours_lstm, D, H, gates=4)
+        self._port_weights(tl, ours_lstm)
         x = RNG.randn(B, T, D).astype("float32")
         a_out, (a_h, a_c) = ours_lstm(pt.to_tensor(x))
         e_out, (e_h, e_c) = tl(t(x))
@@ -173,7 +173,7 @@ class TestRNNParity:
         D, H, B, T = 4, 6, 2, 5
         tg = torch.nn.GRU(D, H, batch_first=True)
         ours_gru = nn.GRU(D, H)
-        self._port_weights(tg, ours_gru, D, H, gates=3)
+        self._port_weights(tg, ours_gru)
         x = RNG.randn(B, T, D).astype("float32")
         a_out, a_h = ours_gru(pt.to_tensor(x))
         e_out, e_h = tg(t(x))
@@ -365,7 +365,7 @@ class TestGradParity:
         D, H, B, T = 5, 7, 3, 6
         tl = torch.nn.LSTM(D, H, batch_first=True)
         ours_lstm = nn.LSTM(D, H)
-        TestRNNParity._port_weights(tl, ours_lstm, D, H, gates=4)
+        TestRNNParity._port_weights(tl, ours_lstm)
         x = RNG.randn(B, T, D).astype("float32")
         g = RNG.randn(B, T, H).astype("float32")
 
@@ -499,7 +499,7 @@ class TestAttentionParity:
         D, H, B, T = 4, 5, 2, 6
         tl = torch.nn.LSTM(D, H, batch_first=True, bidirectional=True)
         om = nn.LSTM(D, H, direction="bidirect")
-        TestRNNParity._port_weights(tl, om, D, H, gates=4)
+        TestRNNParity._port_weights(tl, om)
         x = RNG.randn(B, T, D).astype("float32")
         a_out, (a_h, a_c) = om(pt.to_tensor(x))
         e_out, (e_h, e_c) = tl(t(x))
@@ -563,3 +563,84 @@ class TestActivationParity:
         a = ours(F.prelu(pt.to_tensor(x), pt.to_tensor(w)))
         e = torch.nn.functional.prelu(t(x), t(w)).numpy()
         np.testing.assert_allclose(a, e, atol=3e-6)
+
+
+class TestMoreLossParity:
+    @pytest.mark.parametrize("delta", [1.0, 0.5])
+    def test_smooth_l1(self, delta, RNG):
+        x = RNG.randn(16).astype("float32") * 2
+        y = RNG.randn(16).astype("float32") * 2
+        a = ours(F.smooth_l1_loss(pt.to_tensor(x), pt.to_tensor(y),
+                                  delta=delta))
+        e = torch.nn.functional.smooth_l1_loss(t(x), t(y),
+                                               beta=delta).numpy()
+        np.testing.assert_allclose(a, e, atol=2e-6, rtol=2e-6)
+
+    def test_margin_ranking(self, RNG):
+        a1 = RNG.randn(8).astype("float32")
+        a2 = RNG.randn(8).astype("float32")
+        yy = np.sign(RNG.randn(8)).astype("float32")
+        a = ours(F.margin_ranking_loss(pt.to_tensor(a1),
+                                       pt.to_tensor(a2),
+                                       pt.to_tensor(yy), margin=0.3))
+        # both define max(0, -label*(x1 - x2) + margin)
+        e = torch.nn.functional.margin_ranking_loss(
+            t(a1), t(a2), t(yy), margin=0.3).numpy()
+        np.testing.assert_allclose(a, e, atol=2e-6, rtol=2e-6)
+
+    def test_nll_loss(self, RNG):
+        logp = torch.log_softmax(t(RNG.randn(6, 4).astype("float32")),
+                                 dim=1)
+        y = np.array([0, 1, 3, 2, 1, 0], "int64")
+        a = ours(F.nll_loss(pt.to_tensor(logp.numpy()), pt.to_tensor(y)))
+        e = torch.nn.functional.nll_loss(logp, t(y)).numpy()
+        np.testing.assert_allclose(a, e, atol=2e-6, rtol=2e-6)
+
+    def test_triplet_and_hinge(self, RNG):
+        a1 = RNG.randn(5, 8).astype("float32")
+        pos = RNG.randn(5, 8).astype("float32")
+        neg = RNG.randn(5, 8).astype("float32")
+        a = ours(F.triplet_margin_loss(pt.to_tensor(a1),
+                                       pt.to_tensor(pos),
+                                       pt.to_tensor(neg), margin=0.8))
+        e = torch.nn.functional.triplet_margin_loss(
+            t(a1), t(pos), t(neg), margin=0.8).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
+
+        x = RNG.randn(10).astype("float32")
+        yy = np.sign(RNG.randn(10)).astype("float32")
+        a = ours(F.hinge_embedding_loss(pt.to_tensor(x),
+                                        pt.to_tensor(yy), margin=1.0))
+        e = torch.nn.functional.hinge_embedding_loss(
+            t(x), t(yy), margin=1.0).numpy()
+        np.testing.assert_allclose(a, e, atol=2e-6, rtol=2e-6)
+
+    def test_cosine_similarity_and_normalize(self, RNG):
+        x = RNG.randn(4, 9).astype("float32")
+        y = RNG.randn(4, 9).astype("float32")
+        a = ours(F.cosine_similarity(pt.to_tensor(x), pt.to_tensor(y),
+                                     axis=1))
+        e = torch.nn.functional.cosine_similarity(t(x), t(y),
+                                                  dim=1).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-6, rtol=3e-6)
+        a = ours(F.normalize(pt.to_tensor(x), p=2, axis=1))
+        e = torch.nn.functional.normalize(t(x), p=2, dim=1).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-6, rtol=3e-6)
+
+
+class TestConv1DParity:
+    def test_conv1d_and_transpose(self, RNG):
+        x = RNG.randn(2, 3, 11).astype("float32")
+        w = RNG.randn(5, 3, 4).astype("float32")
+        a = ours(F.conv1d(pt.to_tensor(x), pt.to_tensor(w), stride=2,
+                          padding=1))
+        e = torch.nn.functional.conv1d(t(x), t(w), stride=2,
+                                       padding=1).numpy()
+        np.testing.assert_allclose(a, e, atol=2e-5, rtol=2e-5)
+
+        wt = RNG.randn(3, 5, 4).astype("float32")
+        a = ours(F.conv1d_transpose(pt.to_tensor(x), pt.to_tensor(wt),
+                                    stride=2, padding=1))
+        e = torch.nn.functional.conv_transpose1d(t(x), t(wt), stride=2,
+                                                 padding=1).numpy()
+        np.testing.assert_allclose(a, e, atol=2e-5, rtol=2e-5)
