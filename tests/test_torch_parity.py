@@ -644,3 +644,47 @@ class TestConv1DParity:
         e = torch.nn.functional.conv_transpose1d(t(x), t(wt), stride=2,
                                                  padding=1).numpy()
         np.testing.assert_allclose(a, e, atol=2e-5, rtol=2e-5)
+
+
+class TestStatsParity:
+    def test_std_var_unbiased(self, RNG):
+        x = RNG.randn(5, 7).astype("float32")
+        for unbiased in (True, False):
+            a = ours(pt.std(pt.to_tensor(x), axis=1, unbiased=unbiased))
+            e = torch.std(t(x), dim=1, unbiased=unbiased).numpy()
+            np.testing.assert_allclose(a, e, atol=3e-6, rtol=3e-6)
+            a = ours(pt.var(pt.to_tensor(x), axis=1, unbiased=unbiased))
+            e = torch.var(t(x), dim=1, unbiased=unbiased).numpy()
+            np.testing.assert_allclose(a, e, atol=3e-6, rtol=3e-6)
+
+    def test_median_even_count(self, RNG):
+        # paddle median averages the two middle values on even counts
+        # (numpy semantics); torch.median takes the LOWER one — compare
+        # via torch.quantile(0.5) which matches paddle's convention
+        x = RNG.randn(4, 6).astype("float32")
+        a = ours(pt.median(pt.to_tensor(x), axis=1))
+        e = torch.quantile(t(x), 0.5, dim=1).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-6, rtol=3e-6)
+
+    def test_quantile_linear_interp(self, RNG):
+        x = RNG.randn(3, 9).astype("float32")
+        for q in (0.25, 0.9):
+            a = ours(pt.quantile(pt.to_tensor(x), q, axis=1))
+            e = torch.quantile(t(x), q, dim=1).numpy()
+            np.testing.assert_allclose(a, e, atol=3e-6, rtol=3e-6)
+
+    def test_kthvalue_and_cumsum(self, RNG):
+        x = RNG.randn(3, 8).astype("float32")
+        av, ai = pt.kthvalue(pt.to_tensor(x), 3, axis=1)
+        ev, ei = torch.kthvalue(t(x), 3, dim=1)
+        np.testing.assert_allclose(ours(av), ev.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(ours(ai), ei.numpy())
+        np.testing.assert_allclose(
+            ours(pt.cumsum(pt.to_tensor(x), axis=1)),
+            torch.cumsum(t(x), dim=1).numpy(), atol=3e-6, rtol=3e-6)
+
+    def test_logsumexp(self, RNG):
+        x = RNG.randn(4, 6).astype("float32") * 3
+        a = ours(pt.logsumexp(pt.to_tensor(x), axis=1))
+        e = torch.logsumexp(t(x), dim=1).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-6, rtol=3e-6)
