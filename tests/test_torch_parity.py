@@ -467,6 +467,22 @@ class TestPadUnfoldParity:
         np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
 
 
+def _port_torch_mha(torch_mha, E, prefix=""):
+    """Split torch's packed in_proj ([q;k;v] rows, (out,in) layout)
+    into our separate (in,out)-layout projections."""
+    in_w = torch_mha.in_proj_weight.detach().numpy()      # (3E, E)
+    in_b = torch_mha.in_proj_bias.detach().numpy()
+    out_w = torch_mha.out_proj.weight.detach().numpy()    # (E, E)
+    out_b = torch_mha.out_proj.bias.detach().numpy()
+    qw, kw, vw = in_w[:E], in_w[E:2 * E], in_w[2 * E:]
+    qb, kb, vb = in_b[:E], in_b[E:2 * E], in_b[2 * E:]
+    return {f"{prefix}q_proj.weight": qw.T, f"{prefix}q_proj.bias": qb,
+            f"{prefix}k_proj.weight": kw.T, f"{prefix}k_proj.bias": kb,
+            f"{prefix}v_proj.weight": vw.T, f"{prefix}v_proj.bias": vb,
+            f"{prefix}out_proj.weight": out_w.T,
+            f"{prefix}out_proj.bias": out_b}
+
+
 class TestAttentionParity:
     def test_multi_head_attention(self, RNG):
         """Self-attention parity with torch.nn.MultiheadAttention:
@@ -476,16 +492,7 @@ class TestAttentionParity:
         tm = torch.nn.MultiheadAttention(E, H, batch_first=True)
         om = nn.MultiHeadAttention(E, H)
 
-        in_w = tm.in_proj_weight.detach().numpy()      # (3E, E)
-        in_b = tm.in_proj_bias.detach().numpy()
-        out_w = tm.out_proj.weight.detach().numpy()    # (E, E)
-        out_b = tm.out_proj.bias.detach().numpy()
-        qw, kw, vw = in_w[:E], in_w[E:2 * E], in_w[2 * E:]
-        qb, kb, vb = in_b[:E], in_b[E:2 * E], in_b[2 * E:]
-        port = {"q_proj.weight": qw.T, "q_proj.bias": qb,
-                "k_proj.weight": kw.T, "k_proj.bias": kb,
-                "v_proj.weight": vw.T, "v_proj.bias": vb,
-                "out_proj.weight": out_w.T, "out_proj.bias": out_b}
+        port = _port_torch_mha(tm, E)
         om.set_state_dict({k: pt.to_tensor(v.astype("float32"))
                            for k, v in port.items()})
 
@@ -688,3 +695,37 @@ class TestStatsParity:
         a = ours(pt.logsumexp(pt.to_tensor(x), axis=1))
         e = torch.logsumexp(t(x), dim=1).numpy()
         np.testing.assert_allclose(a, e, atol=3e-6, rtol=3e-6)
+
+
+class TestTransformerLayerParity:
+    def test_encoder_layer_post_norm(self, RNG):
+        """Whole TransformerEncoderLayer (self-attn + FFN + residuals +
+        post-norm) matches torch with dropout off and ported weights."""
+        E, H, FF, B, T = 8, 2, 16, 3, 5
+        tm = torch.nn.TransformerEncoderLayer(
+            E, H, dim_feedforward=FF, dropout=0.0, batch_first=True,
+            norm_first=False, activation="relu")
+        om = nn.TransformerEncoderLayer(E, H, FF, dropout=0.0)
+
+        port = _port_torch_mha(tm.self_attn, E, prefix="self_attn.")
+        port.update({
+            "linear1.weight": tm.linear1.weight.detach().numpy().T,
+            "linear1.bias": tm.linear1.bias.detach().numpy(),
+            "linear2.weight": tm.linear2.weight.detach().numpy().T,
+            "linear2.bias": tm.linear2.bias.detach().numpy(),
+            "norm1.weight": tm.norm1.weight.detach().numpy(),
+            "norm1.bias": tm.norm1.bias.detach().numpy(),
+            "norm2.weight": tm.norm2.weight.detach().numpy(),
+            "norm2.bias": tm.norm2.bias.detach().numpy(),
+        })
+        sd = om.state_dict()
+        assert set(port) == set(sd)
+        for k, v in port.items():
+            assert tuple(sd[k].shape) == v.shape, k
+        om.set_state_dict({k: pt.to_tensor(v.astype("float32"))
+                           for k, v in port.items()})
+        om.eval()
+        x = RNG.randn(B, T, E).astype("float32")
+        a = ours(om(pt.to_tensor(x)))
+        e = tm(t(x)).detach().numpy()
+        np.testing.assert_allclose(a, e, atol=5e-5, rtol=5e-5)
