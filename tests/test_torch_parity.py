@@ -729,3 +729,30 @@ class TestTransformerLayerParity:
         a = ours(om(pt.to_tensor(x)))
         e = tm(t(x)).detach().numpy()
         np.testing.assert_allclose(a, e, atol=5e-5, rtol=5e-5)
+
+
+def test_batchnorm_layer_momentum_convention(RNG):
+    """paddle momentum is the KEEP factor (running = m*running +
+    (1-m)*batch); torch's is the update factor — paddle 0.9 == torch
+    0.1. Running var uses the unbiased batch estimate in both."""
+    x = RNG.randn(16, 3, 4, 4).astype("float32")
+    om = nn.BatchNorm2D(3, momentum=0.9)
+    tm = torch.nn.BatchNorm2d(3, momentum=0.1)
+    om.train()
+    tm.train()
+    for _ in range(3):
+        om(pt.to_tensor(x))
+        tm(t(x))
+    sd = {k: ours(v) for k, v in om.state_dict().items()}
+    mean_key = [k for k in sd if "mean" in k][0]
+    var_key = [k for k in sd if "var" in k][0]
+    np.testing.assert_allclose(sd[mean_key], tm.running_mean.numpy(),
+                               atol=1e-6)
+    np.testing.assert_allclose(sd[var_key], tm.running_var.numpy(),
+                               atol=1e-5, rtol=1e-5)
+    # eval output then uses the SAME running stats
+    om.eval()
+    tm.eval()
+    np.testing.assert_allclose(ours(om(pt.to_tensor(x))),
+                               tm(t(x)).detach().numpy(), atol=1e-5,
+                               rtol=1e-5)
