@@ -756,3 +756,26 @@ def test_batchnorm_layer_momentum_convention(RNG):
     np.testing.assert_allclose(ours(om(pt.to_tensor(x))),
                                tm(t(x)).detach().numpy(), atol=1e-5,
                                rtol=1e-5)
+
+
+def test_gather_take_along_axis_scatter(RNG):
+    """paddle gather == torch index_select; paddle take_along_axis ==
+    torch gather; paddle put_along_axis == torch scatter."""
+    x = RNG.randn(5, 4).astype("float32")
+    idx = np.array([3, 0, 3], "int64")
+    np.testing.assert_allclose(
+        ours(pt.gather(pt.to_tensor(x), pt.to_tensor(idx))),
+        torch.index_select(t(x), 0, t(idx)).numpy(), atol=1e-6)
+
+    along = np.array([[0, 1, 2, 3], [3, 2, 1, 0]], "int64")
+    xa = RNG.randn(4, 4).astype("float32")
+    np.testing.assert_allclose(
+        ours(pt.take_along_axis(pt.to_tensor(xa), pt.to_tensor(along),
+                                axis=0)),
+        torch.gather(t(xa), 0, t(along)).numpy(), atol=1e-6)
+
+    vals = RNG.randn(2, 4).astype("float32")
+    a = ours(pt.put_along_axis(pt.to_tensor(xa), pt.to_tensor(along),
+                               pt.to_tensor(vals), axis=0))
+    e = t(xa).scatter(0, t(along), t(vals)).numpy()
+    np.testing.assert_allclose(a, e, atol=1e-6)
