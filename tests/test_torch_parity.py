@@ -779,3 +779,48 @@ def test_gather_take_along_axis_scatter(RNG):
                                pt.to_tensor(vals), axis=0))
     e = t(xa).scatter(0, t(along), t(vals)).numpy()
     np.testing.assert_allclose(a, e, atol=1e-6)
+
+
+def test_grouped_dilated_conv_grads(RNG):
+    """Grad parity for the grouped+dilated conv and transposed conv —
+    distinct vjp paths from the plain case."""
+    x = RNG.randn(2, 6, 9, 9).astype("float32")
+    w = RNG.randn(9, 2, 3, 3).astype("float32")  # groups=3
+    g = None
+
+    xo = pt.to_tensor(x)
+    xo.stop_gradient = False
+    wo = pt.to_tensor(w)
+    wo.stop_gradient = False
+    out = F.conv2d(xo, wo, stride=1, padding=2, dilation=2, groups=3)
+    g = RNG.randn(*out.shape).astype("float32")
+    (out * pt.to_tensor(g)).sum().backward()
+
+    xt = t(x).requires_grad_(True)
+    wt = t(w).requires_grad_(True)
+    et = torch.nn.functional.conv2d(xt, wt, stride=1, padding=2,
+                                    dilation=2, groups=3)
+    (et * t(g)).sum().backward()
+    np.testing.assert_allclose(ours(xo.grad), xt.grad.numpy(),
+                               atol=5e-5, rtol=5e-5)
+    np.testing.assert_allclose(ours(wo.grad), wt.grad.numpy(),
+                               atol=5e-5, rtol=5e-5)
+
+    wt2 = RNG.randn(6, 4, 3, 3).astype("float32")
+    xo2 = pt.to_tensor(x)
+    xo2.stop_gradient = False
+    wo2 = pt.to_tensor(wt2)
+    wo2.stop_gradient = False
+    out2 = F.conv2d_transpose(xo2, wo2, stride=2, padding=1)
+    g2 = RNG.randn(*out2.shape).astype("float32")
+    (out2 * pt.to_tensor(g2)).sum().backward()
+
+    xt2 = t(x).requires_grad_(True)
+    wt2_ = t(wt2).requires_grad_(True)
+    et2 = torch.nn.functional.conv_transpose2d(xt2, wt2_, stride=2,
+                                               padding=1)
+    (et2 * t(g2)).sum().backward()
+    np.testing.assert_allclose(ours(xo2.grad), xt2.grad.numpy(),
+                               atol=5e-5, rtol=5e-5)
+    np.testing.assert_allclose(ours(wo2.grad), wt2_.grad.numpy(),
+                               atol=5e-5, rtol=5e-5)
