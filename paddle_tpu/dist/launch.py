@@ -19,7 +19,8 @@ import os
 import subprocess
 import sys
 
-__all__ = ["launch", "get_cluster_endpoints"]
+__all__ = ["launch", "get_cluster_endpoints", "get_gpus",
+           "get_cluster_from_args"]
 
 
 def _parse_args(argv=None):
@@ -87,6 +88,59 @@ def launch(args=None):
         if out:
             out.close()
     return rc
+
+
+def get_gpus(selected_gpus):
+    """ref: launch.py get_gpus — resolve the selected accelerator list
+    against the visible-devices env (CUDA_VISIBLE_DEVICES there; the
+    name is kept, the indices are whatever accelerators the runtime
+    exposes). ``None`` enumerates every visible/local device, like the
+    reference."""
+    visible = os.getenv("CUDA_VISIBLE_DEVICES") or \
+        os.getenv("TPU_VISIBLE_DEVICES")
+    if selected_gpus is None or selected_gpus == "":
+        if visible:
+            return list(range(len(visible.split(","))))
+        import jax
+
+        return list(range(jax.local_device_count()))
+    sel = [s.strip() for s in str(selected_gpus).split(",") if s.strip()]
+    if not visible:
+        return [int(s) for s in sel]
+    vis = [v.strip() for v in visible.split(",")]
+    for s in sel:
+        if s not in vis:
+            raise ValueError(
+                f"selected device {s} not in visible devices {vis}")
+    return [vis.index(s) for s in sel]
+
+
+def get_cluster_from_args(args, selected_gpus):
+    """ref: launch.py get_cluster_from_args — Cluster/Pod from parsed
+    launcher args. Accepts this module's --ips spelling and the
+    reference's cluster_node_ips/node_ip; unknown topology raises
+    rather than silently defaulting."""
+    from .utils import get_cluster
+
+    ips_arg = getattr(args, "ips", None) or \
+        getattr(args, "cluster_node_ips", None)
+    if ips_arg is None:
+        raise ValueError("args carries neither 'ips' nor "
+                         "'cluster_node_ips'")
+    node_ips = [ip.strip() for ip in str(ips_arg).split(",")]
+    node_ip = getattr(args, "node_ip", None)
+    if node_ip is None:
+        rank = getattr(args, "node_rank", 0) or 0
+        node_ip = node_ips[int(rank)]
+    if node_ip not in node_ips:
+        raise ValueError(
+            f"this node's ip {node_ip!r} is not in the node list "
+            f"{node_ips} (check --node_ip / --ips)")
+    started = int(getattr(args, "started_port", 6170) or 6170)
+    sel = get_gpus(None) if selected_gpus is None else list(selected_gpus)
+    ports = [started + i for i in range(len(sel))]
+    return get_cluster(node_ips, node_ip, ports, sel)
+
 
 
 if __name__ == "__main__":

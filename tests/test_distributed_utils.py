@@ -144,3 +144,41 @@ def test_eq_tolerates_foreign_types_and_pod_ip_required(monkeypatch):
     monkeypatch.delenv("POD_IP", raising=False)
     with pytest.raises(ValueError, match="POD_IP"):
         cloud_utils.get_cloud_cluster()
+
+
+def test_launch_helper_functions(monkeypatch):
+    """ref launch.py helpers: get_gpus resolves against visible devices;
+    get_cluster_from_args builds the topology from parsed args."""
+    import types
+
+    from paddle_tpu.dist.launch import get_cluster_from_args, get_gpus
+
+    monkeypatch.delenv("CUDA_VISIBLE_DEVICES", raising=False)
+    monkeypatch.delenv("TPU_VISIBLE_DEVICES", raising=False)
+    assert get_gpus("0,2") == [0, 2]
+    monkeypatch.setenv("CUDA_VISIBLE_DEVICES", "2,0")
+    assert get_gpus("0,2") == [1, 0]  # remapped to visible indices
+    with pytest.raises(ValueError):
+        get_gpus("7")
+
+    args = types.SimpleNamespace(cluster_node_ips="10.0.0.1,10.0.0.2",
+                                 node_ip="10.0.0.2", started_port=7000)
+    cluster, pod = get_cluster_from_args(args, [0, 1])
+    assert cluster.trainers_nranks() == 4 and pod.addr == "10.0.0.2"
+
+    # this module's own --ips spelling works too, node from node_rank
+    args2 = types.SimpleNamespace(ips="10.0.0.1,10.0.0.2", node_rank=1,
+                                  started_port=7000)
+    _, pod2 = get_cluster_from_args(args2, [0])
+    assert pod2.addr == "10.0.0.2"
+    # selected_gpus=None enumerates the visible devices
+    monkeypatch.setenv("CUDA_VISIBLE_DEVICES", "0,1,2")
+    assert get_gpus(None) == [0, 1, 2]
+    cluster3, _ = get_cluster_from_args(args2, None)
+    assert cluster3.trainers_nranks() == 6  # 2 nodes x 3 devices
+    # unknown node ip raises with context, not a bare index error
+    bad = types.SimpleNamespace(ips="10.0.0.1", node_ip="9.9.9.9")
+    with pytest.raises(ValueError, match="node list"):
+        get_cluster_from_args(bad, [0])
+    with pytest.raises(ValueError, match="ips"):
+        get_cluster_from_args(types.SimpleNamespace(), [0])
