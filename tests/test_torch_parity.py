@@ -824,3 +824,127 @@ def test_grouped_dilated_conv_grads(RNG):
                                atol=5e-5, rtol=5e-5)
     np.testing.assert_allclose(ours(wo2.grad), wt2_.grad.numpy(),
                                atol=5e-5, rtol=5e-5)
+
+
+class TestLongTailFunctionalParity:
+    """Functional APIs with no prior test mention, pinned vs torch
+    where torch has the op."""
+
+    def test_pool_1d_3d(self, RNG):
+        x1 = RNG.randn(2, 3, 12).astype("float32")
+        np.testing.assert_allclose(
+            ours(F.max_pool1d(pt.to_tensor(x1), 3, stride=2)),
+            torch.nn.functional.max_pool1d(t(x1), 3, stride=2).numpy(),
+            atol=1e-6)
+        np.testing.assert_allclose(
+            ours(F.avg_pool1d(pt.to_tensor(x1), 2, stride=2)),
+            torch.nn.functional.avg_pool1d(t(x1), 2, stride=2).numpy(),
+            atol=1e-6)
+        np.testing.assert_allclose(
+            ours(F.adaptive_avg_pool1d(pt.to_tensor(x1), 5)),
+            torch.nn.functional.adaptive_avg_pool1d(t(x1), 5).numpy(),
+            atol=1e-6)
+        x3 = RNG.randn(1, 2, 6, 6, 6).astype("float32")
+        np.testing.assert_allclose(
+            ours(F.max_pool3d(pt.to_tensor(x3), 2, stride=2)),
+            torch.nn.functional.max_pool3d(t(x3), 2, stride=2).numpy(),
+            atol=1e-6)
+        np.testing.assert_allclose(
+            ours(F.avg_pool3d(pt.to_tensor(x3), 2, stride=2)),
+            torch.nn.functional.avg_pool3d(t(x3), 2, stride=2).numpy(),
+            atol=1e-6)
+
+    def test_adaptive_max_pool(self, RNG):
+        x = RNG.randn(2, 3, 10, 10).astype("float32")
+        np.testing.assert_allclose(
+            ours(F.adaptive_max_pool2d(pt.to_tensor(x), 4)),
+            torch.nn.functional.adaptive_max_pool2d(t(x), 4).numpy(),
+            atol=1e-6)
+        x1 = RNG.randn(2, 3, 12).astype("float32")
+        np.testing.assert_allclose(
+            ours(F.adaptive_max_pool1d(pt.to_tensor(x1), 4)),
+            torch.nn.functional.adaptive_max_pool1d(t(x1), 4).numpy(),
+            atol=1e-6)
+
+    def test_norms(self, RNG):
+        x = RNG.randn(4, 6, 5, 5).astype("float32")
+        g = RNG.rand(6).astype("float32") + 0.5
+        b = RNG.randn(6).astype("float32")
+        a = ours(F.group_norm(pt.to_tensor(x), num_groups=3,
+                              weight=pt.to_tensor(g), bias=pt.to_tensor(b),
+                              epsilon=1e-5))
+        e = torch.nn.functional.group_norm(t(x), 3, t(g), t(b),
+                                           eps=1e-5).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
+        a = ours(F.instance_norm(pt.to_tensor(x),
+                                 weight=pt.to_tensor(g),
+                                 bias=pt.to_tensor(b), eps=1e-5))
+        e = torch.nn.functional.instance_norm(t(x), weight=t(g),
+                                              bias=t(b), eps=1e-5).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
+        # paddle's lrn alpha is unnormalized; torch divides alpha by
+        # size — paddle(alpha) == torch(alpha*size)
+        a = ours(F.local_response_norm(pt.to_tensor(x), size=3,
+                                       alpha=1e-4, beta=0.75, k=1.0))
+        e = torch.nn.functional.local_response_norm(
+            t(x), 3, alpha=3e-4, beta=0.75, k=1.0).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
+
+    def test_shrinks_and_misc_activations(self, RNG):
+        x = RNG.randn(40).astype("float32") * 2
+        np.testing.assert_allclose(
+            ours(F.hardshrink(pt.to_tensor(x), threshold=0.4)),
+            torch.nn.functional.hardshrink(t(x), lambd=0.4).numpy(),
+            atol=1e-6)
+        np.testing.assert_allclose(
+            ours(F.softshrink(pt.to_tensor(x), threshold=0.3)),
+            torch.nn.functional.softshrink(t(x), lambd=0.3).numpy(),
+            atol=1e-6)
+        np.testing.assert_allclose(
+            ours(F.hardtanh(pt.to_tensor(x), min=-0.7, max=0.9)),
+            torch.nn.functional.hardtanh(t(x), -0.7, 0.9).numpy(),
+            atol=1e-6)
+        np.testing.assert_allclose(
+            ours(F.celu(pt.to_tensor(x), alpha=0.8)),
+            torch.nn.functional.celu(t(x), alpha=0.8).numpy(),
+            atol=3e-6)
+
+    def test_losses_and_distances(self, RNG):
+        x1 = RNG.randn(5, 8).astype("float32")
+        x2 = RNG.randn(5, 8).astype("float32")
+        y = np.sign(RNG.randn(5)).astype("float32")
+        a = ours(F.cosine_embedding_loss(pt.to_tensor(x1),
+                                         pt.to_tensor(x2),
+                                         pt.to_tensor(y), margin=0.2))
+        e = torch.nn.functional.cosine_embedding_loss(
+            t(x1), t(x2), t(y), margin=0.2).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
+        a = ours(F.pairwise_distance(pt.to_tensor(x1), pt.to_tensor(x2),
+                                     p=2.0))
+        e = torch.nn.functional.pairwise_distance(t(x1), t(x2),
+                                                  p=2.0).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
+
+    def test_channel_shuffle(self, RNG):
+        x = RNG.randn(2, 8, 4, 4).astype("float32")
+        cs = ours(F.channel_shuffle(pt.to_tensor(x), groups=2))
+        e = torch.nn.functional.channel_shuffle(t(x), 2).numpy()
+        np.testing.assert_allclose(cs, e, atol=1e-6)
+
+    def test_paddle_only_ops_behave(self, RNG):
+        # no torch analog: pin the documented contract directly
+        sm = ours(F.sequence_mask(pt.to_tensor(
+            np.array([2, 0, 3], "int64")), maxlen=4))
+        np.testing.assert_array_equal(
+            sm, [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+        ls = ours(F.label_smooth(pt.to_tensor(
+            np.eye(3, dtype="float32")), epsilon=0.1))
+        np.testing.assert_allclose(ls.sum(1), [1, 1, 1], atol=1e-6)
+        assert abs(float(ls[0, 0]) - (0.9 + 0.1 / 3)) < 1e-6
+        ll = ours(F.log_loss(pt.to_tensor(
+            np.array([0.2, 0.8], "float32")),
+            pt.to_tensor(np.array([0.0, 1.0], "float32"))))
+        # log_loss clamps with its epsilon (1e-4 default), shifting
+        # the exact -log(0.8) by ~1e-4
+        np.testing.assert_allclose(
+            ll, [-np.log(0.8), -np.log(0.8)], atol=5e-4)
