@@ -948,3 +948,76 @@ class TestLongTailFunctionalParity:
         # the exact -log(0.8) by ~1e-4
         np.testing.assert_allclose(
             ll, [-np.log(0.8), -np.log(0.8)], atol=5e-4)
+
+
+class TestRemainingFunctionalSurface:
+    def test_conv3d_transpose(self, RNG):
+        x = RNG.randn(1, 4, 5, 5, 5).astype("float32")
+        w = RNG.randn(4, 3, 2, 2, 2).astype("float32")
+        a = ours(F.conv3d_transpose(pt.to_tensor(x), pt.to_tensor(w),
+                                    stride=2))
+        e = torch.nn.functional.conv_transpose3d(t(x), t(w),
+                                                 stride=2).numpy()
+        np.testing.assert_allclose(a, e, atol=3e-5, rtol=3e-5)
+
+    def test_dropout_variants_shape_contract(self, RNG):
+        pt.seed(3)
+        x = pt.ones([8, 4, 6, 6])
+        y2 = ours(F.dropout2d(x, p=0.5, training=True))
+        # dropout2d zeroes WHOLE channels: each (n, c) map all-0 or all-keep
+        per_map = y2.reshape(8 * 4, -1)
+        assert all(np.all(m == 0) or np.all(m != 0) for m in per_map)
+        kept = per_map[per_map.sum(1) != 0]
+        np.testing.assert_allclose(kept, 2.0, atol=1e-6)  # upscaled
+
+        x3 = pt.ones([4, 3, 2, 4, 4])
+        y3 = ours(F.dropout3d(x3, p=0.5, training=True))
+        per_vol = y3.reshape(4 * 3, -1)
+        assert all(np.all(m == 0) or np.all(m != 0) for m in per_vol)
+
+        ya = ours(F.alpha_dropout(pt.ones([4000]), p=0.3,
+                                  training=True))
+        # ones input maps onto exactly torch's two affine constants
+        # (kept -> a+b, dropped -> a*alpha'+b); nothing goes to 0
+        torch_vals = np.unique(torch.nn.functional.alpha_dropout(
+            torch.ones(4000), 0.3, True).numpy())
+        np.testing.assert_allclose(np.unique(ya), torch_vals, atol=1e-4)
+        assert not np.any(ya == 0)
+        # and the self-normalizing contract: N(0,1) stats survive
+        g = RNG.randn(20000).astype("float32")
+        yg = ours(F.alpha_dropout(pt.to_tensor(g), p=0.3,
+                                  training=True))
+        assert abs(yg.mean()) < 0.05 and abs(yg.std() - 1.0) < 0.08
+
+    def test_thresholded_relu_and_maxout(self, RNG):
+        x = RNG.randn(32).astype("float32")
+        a = ours(F.thresholded_relu(pt.to_tensor(x), threshold=0.4))
+        e = torch.nn.functional.threshold(t(x), 0.4, 0.0).numpy()
+        np.testing.assert_allclose(a, e, atol=1e-6)
+        xm = RNG.randn(2, 6, 3).astype("float32")
+        mo = ours(F.maxout(pt.to_tensor(xm), groups=2))
+        assert mo.shape == (2, 3, 3)
+        # ref maxouting.cc:44: output channel c maxes over the
+        # CONSECUTIVE input channels [c*groups, (c+1)*groups)
+        np.testing.assert_allclose(
+            mo, xm.reshape(2, 3, 2, 3).max(axis=2), atol=1e-6)
+
+    def test_gumbel_softmax_contract(self, RNG):
+        pt.seed(5)
+        logits = pt.to_tensor(RNG.randn(16, 5).astype("float32"))
+        soft = ours(F.gumbel_softmax(logits, temperature=0.5))
+        np.testing.assert_allclose(soft.sum(1), 1.0, atol=1e-5)
+        hard = ours(F.gumbel_softmax(logits, temperature=0.5,
+                                     hard=True))
+        assert set(np.unique(hard)) <= {0.0, 1.0}
+        np.testing.assert_allclose(hard.sum(1), 1.0, atol=1e-6)
+
+    def test_npair_loss_contract(self, RNG):
+        anchor = RNG.randn(4, 6).astype("float32")
+        positive = RNG.randn(4, 6).astype("float32")
+        labels = np.array([0, 1, 2, 3], "int64")
+        val = float(ours(F.npair_loss(pt.to_tensor(anchor),
+                                      pt.to_tensor(positive),
+                                      pt.to_tensor(labels),
+                                      l2_reg=0.0)))
+        assert np.isfinite(val) and val > 0
