@@ -1,0 +1,152 @@
+"""Pass framework: PassContext / Pass / PassManager.
+
+TPU-native analog of the reference's ``paddle/fluid/framework/ir``
+pass machinery (``pass.h`` Pass::Apply over an ir::Graph, registered and
+sequenced by ``PassBuilder``): here the "graph" is the recorded
+``Block.ops`` list itself — ops are already in SSA-ish program order and
+name-linked, so passes are plain list-to-list rewrites instead of
+pointer-graph surgery.
+
+Two pass families:
+
+- ``AnalysisPass``  — read-only; emits Diagnostics into the report
+  (verifier, lint).
+- ``RewritePass``   — returns a NEW op list (the input Program is never
+  mutated: the Executor compiles the rewritten list, while the user's
+  Program object — and its cache-keying version — stays untouched).
+
+``PassManager.run`` threads one PassContext through the sequence and
+records per-pass op-count deltas in ``report.pass_stats`` (the reference
+logs the same thing per ir pass with VLOG).
+"""
+from __future__ import annotations
+
+import logging
+
+from .diagnostics import DiagnosticReport
+
+_log = logging.getLogger("paddle_tpu.analysis")
+
+__all__ = ["PassContext", "Pass", "AnalysisPass", "RewritePass",
+           "PassManager", "op_reads", "op_writes", "normalize_fetch"]
+
+
+def normalize_fetch(fetch_list):
+    """One canonical fetch_list resolution: ``(names, variable_handles)``.
+    Every consumer (Executor key, verifier/DCE roots, lint) must agree on
+    these names or the pass roots silently diverge from the replay."""
+    from ..static_.program import Variable
+
+    names = tuple(f.name if isinstance(f, Variable) else str(f)
+                  for f in fetch_list)
+    handles = tuple(f for f in fetch_list if isinstance(f, Variable))
+    return names, handles
+
+
+def op_reads(op):
+    """Input names an op actually reads (None slots are absent optionals)."""
+    return [n for n in op.input_names if n is not None]
+
+
+def op_writes(op):
+    return list(op.output_names)
+
+
+class PassContext:
+    """Everything a pass may consult, bundled (ref: ir pass attrs).
+
+    - ``program``      — the Program under analysis (never mutated)
+    - ``ops``          — current working op list (rewrites replace it)
+    - ``fetch_names``  — names the caller will fetch (DCE roots)
+    - ``feed_shapes``  — {name: (shape, dtype)} of the actual feeds, when
+                         known (Executor._compile knows; CLI may not)
+    - ``donated``      — names whose buffers the Executor donates, when known
+    - ``scope_names``  — persistable names the Scope actually holds, when
+                         known (None = assume every persistable is backed)
+    - ``report``       — DiagnosticReport collecting findings
+    """
+
+    def __init__(self, program, ops=None, fetch_names=(), feed_shapes=None,
+                 donated=None, scope_names=None, fetch_vars=(), report=None):
+        self.program = program
+        self.ops = list(ops if ops is not None else program.global_block.ops)
+        self.fetch_names = tuple(fetch_names)
+        self.feed_shapes = feed_shapes
+        self.donated = donated
+        self.scope_names = scope_names
+        self.fetch_vars = tuple(fetch_vars)  # Variable handles, when known
+        self.report = report if report is not None else \
+            DiagnosticReport(program)
+
+    @property
+    def block(self):
+        return self.program.global_block
+
+    def protected_names(self):
+        """Names whose final value is observable outside the replay:
+        fetches, persistables (restored into the Scope), feed/data slots.
+        Rewrites must keep every write to these."""
+        blk = self.block
+        out = set(self.fetch_names)
+        for name, v in blk.vars.items():
+            if v.persistable or v.is_data:
+                out.add(name)
+        return out
+
+
+class Pass:
+    """Base pass (ref: ir/pass.h). ``name`` keys pass_stats and diagnostic
+    provenance."""
+
+    name = "pass"
+
+    def run(self, ctx: PassContext) -> None:
+        raise NotImplementedError
+
+
+class AnalysisPass(Pass):
+    """Read-only pass: inspects ctx.ops / ctx.program, emits diagnostics."""
+
+
+class RewritePass(Pass):
+    """Op-list rewrite: ``rewrite`` returns the new list; the manager
+    records the op-count delta under this pass's name."""
+
+    def run(self, ctx: PassContext) -> None:
+        before = len(ctx.ops)
+        ctx.ops = self.rewrite(ctx)
+        removed = before - len(ctx.ops)
+        ctx.report.pass_stats[self.name] = {
+            "ops_before": before, "ops_after": len(ctx.ops),
+            "removed": removed}
+        if removed:
+            _log.info("pass %s: removed %d of %d ops", self.name, removed,
+                      before)
+
+    def rewrite(self, ctx: PassContext) -> list:
+        raise NotImplementedError
+
+
+class PassManager:
+    """Sequences passes over one PassContext (ref: ir PassBuilder +
+    inference/analysis Analyzer::RunAnalysis)."""
+
+    def __init__(self, passes=()):
+        self.passes = list(passes)
+
+    def add(self, p):
+        self.passes.append(p)
+        return self
+
+    def run(self, program, ops=None, fetch_names=(), feed_shapes=None,
+            donated=None, scope_names=None, fetch_vars=(), report=None):
+        ctx = PassContext(program, ops=ops, fetch_names=fetch_names,
+                          feed_shapes=feed_shapes, donated=donated,
+                          scope_names=scope_names, fetch_vars=fetch_vars,
+                          report=report)
+        return self.run_ctx(ctx)
+
+    def run_ctx(self, ctx):
+        for p in self.passes:
+            p.run(ctx)
+        return ctx

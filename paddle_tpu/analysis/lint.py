@@ -1,0 +1,68 @@
+"""Lint pass: API-level smells that are legal but almost always bugs.
+
+TPU-native analog of the reference's inference analysis warnings
+(``inference/analysis/analyzer.cc`` logging unused feed targets and the
+``ir_graph_to_program_pass`` dropping orphaned nodes): none of these stop
+compilation, but each one usually means the calling code is not doing
+what its author thinks.
+"""
+from __future__ import annotations
+
+from .diagnostics import WARNING
+from .framework import AnalysisPass, op_reads
+
+__all__ = ["LintPass", "lint_program"]
+
+
+class LintPass(AnalysisPass):
+    name = "lint"
+
+    def run(self, ctx):
+        blk, rep = ctx.block, ctx.report
+        read = set()
+        for op in ctx.ops:
+            read.update(op_reads(op))
+
+        # PTL101: declared feed slots nothing consumes
+        for name, v in blk.vars.items():
+            if v.is_data and name not in read \
+                    and name not in ctx.fetch_names and name != "@lr":
+                rep.add("PTL101", WARNING,
+                        f"data var '{name}' is never read by any op and "
+                        "never fetched; feeding it is dead weight",
+                        var=name, pass_name=self.name)
+
+        # PTL102: fetching a stale Variable handle. Needs the actual
+        # handles the caller passed (a name always resolves to the
+        # executed program's own var, which is trivially non-foreign).
+        for f in ctx.fetch_vars:
+            if not (hasattr(f, "block") and hasattr(f, "name")):
+                continue
+            foreign = f.block.program is not ctx.program
+            if getattr(f, "_stale", False) or foreign:
+                why = ("recorded in a different Program (the fetch resolves "
+                       "by name against the executed program)" if foreign
+                       else "marked stale")
+                rep.add("PTL102", WARNING,
+                        f"fetched variable '{f.name}' is {why}; its "
+                        "shape/semantics may have diverged from the handle "
+                        "you hold", var=f.name, pass_name=self.name)
+
+        # PTL103: captured constants nothing consumes
+        for name in ctx.program._constants:
+            if name not in read and name not in ctx.fetch_names:
+                rep.add("PTL103", WARNING,
+                        f"constant '{name}' was captured into the program "
+                        "but no op consumes it", var=name,
+                        pass_name=self.name)
+
+
+def lint_program(program, fetch_list=(), ops=None):
+    """Run only the lint pass; returns the DiagnosticReport."""
+    from .framework import PassContext, normalize_fetch
+
+    fetch_names, fetch_vars = normalize_fetch(fetch_list)
+    ctx = PassContext(program, ops=ops, fetch_names=fetch_names,
+                      fetch_vars=fetch_vars)
+    LintPass().run(ctx)
+    return ctx.report

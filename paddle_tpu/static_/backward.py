@@ -55,6 +55,13 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     block = program.global_block
     no_grad_set = set(no_grad_set or ())
 
+    # differentiating a malformed forward program would crash mid-surgery
+    # (or worse, append wrong grads): check structure up front, with coded
+    # diagnostics instead of a KeyError deep in the reverse walk
+    from ..analysis import verify_program
+
+    verify_program(program, infer_shapes=False)
+
     # seed: d loss/d loss = 1
     gname = grad_name(loss.name)
     seed_var = _ensure_grad_var(block, loss, gname)
@@ -156,6 +163,14 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         if block.has_var(gn) and p.name in have_grad:
             out.append((p, block.var(gn)))
     program.bump()
+    # autodiff surgery is the classic source of malformed graphs (dangling
+    # grad inputs, clobbered accumulators): catch it at append time with
+    # the structural verifier, not as an XLA trace error at run time. The
+    # Executor re-runs the FULL verifier (incl. shape re-inference) at
+    # compile, so the cheap structural pass suffices here.
+    from ..analysis import verify_program
+
+    verify_program(program, infer_shapes=False)
     return out
 
 

@@ -67,6 +67,16 @@ class CompiledProgram:
             self._build_strategy = build_strategy
         if exec_strategy is not None:
             self._exec_strategy = exec_strategy
+        # a DP-transformed program compiles as ONE SPMD executable; verify
+        # its structure now so graph bugs surface at with_data_parallel
+        # (where the reference's SSA-graph build would have failed) rather
+        # than deep inside the partitioner
+        from .program import Program
+        from ..analysis import verify_program
+
+        if isinstance(self._program, Program) and \
+                self._program.global_block.ops:
+            verify_program(self._program, infer_shapes=False)
         return self
 
 

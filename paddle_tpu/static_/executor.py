@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from .program import (Program, Variable, default_main_program, global_scope)
+from .program import (Program, default_main_program, global_scope)
 
 __all__ = ["Executor"]
 
@@ -29,18 +29,27 @@ class _Compiled:
 
 
 class Executor:
-    def __init__(self, place=None):
+    def __init__(self, place=None, optimize_level=None):
+        import os
+
         self.place = place
         self._cache: dict = {}
+        # default pass pipeline level (see analysis.default_optimize_passes):
+        # 0 = verify only, 1 = identity forwarding + DCE, 2 = + CSE.
+        # Overridable per run() call and via PADDLE_TPU_OPT_LEVEL.
+        if optimize_level is None:
+            optimize_level = int(os.environ.get("PADDLE_TPU_OPT_LEVEL", "1"))
+        self.optimize_level = int(optimize_level)
+        self.last_diagnostics = None  # DiagnosticReport of the last compile
 
     def close(self):
         self._cache.clear()
 
     # -- program -> pure function ------------------------------------------
     @staticmethod
-    def _replay_fn(program, feed_names, updated_names, frozen_names,
+    def _replay_fn(program, ops, feed_names, updated_names, frozen_names,
                    fetch_names):
-        ops = list(program.global_block.ops)
+        ops = list(ops)
         consts = dict(program._constants)
         amp_cast = _amp_cast_fn(getattr(program, "_amp_cfg", None))
 
@@ -79,32 +88,57 @@ class Executor:
         return Mesh(np.asarray(jax.local_devices()), ("data",))
 
     def _compile(self, program, feed, fetch_list, data_parallel=False,
-                 allow_replicated_fallback=False):
+                 allow_replicated_fallback=False, optimize_level=None):
+        from ..analysis import normalize_fetch, run_compile_passes
+
+        if optimize_level is None:
+            optimize_level = self.optimize_level
         feed_names = tuple(sorted(feed))
-        fetch_names = tuple(
-            f.name if isinstance(f, Variable) else str(f) for f in fetch_list)
+        fetch_names, _ = normalize_fetch(fetch_list)
         shapes = tuple(
             (np.asarray(feed[n]).shape, str(np.asarray(feed[n]).dtype))
             for n in feed_names)
-        key = (id(program), program._version, feed_names, shapes, fetch_names,
-               bool(data_parallel), bool(allow_replicated_fallback))
+        # program._uid is monotonic and never recycled (unlike id(program),
+        # which the allocator can hand to a NEW Program after the old one
+        # is GC'd — a stale-cache hit that replays the wrong executable)
+        key = (program._uid, program._version, feed_names, shapes,
+               fetch_names, int(optimize_level), bool(data_parallel),
+               bool(allow_replicated_fallback))
         if key in self._cache:
-            return self._cache[key]
+            compiled = self._cache[key]
+            # coherence: uid+version are in the key, so a hit is the right
+            # program UNLESS someone mutated Block.ops without bump() —
+            # the one desync the key cannot see
+            assert compiled.op_count == len(program.global_block.ops), \
+                "executor cache incoherent: Block.ops changed without " \
+                "Program.bump()"
+            self.last_diagnostics = compiled.diagnostics
+            return compiled
 
         scope = global_scope()
         blk = program.global_block
         persist_in = tuple(
             v.name for v in blk.vars.values()
             if v.persistable and scope.find_var(v.name) is not None)
+
+        # -- analysis: verify always, optimize behind optimize_level --------
+        # (raises ProgramVerificationError with coded, op-anchored
+        # diagnostics instead of letting jax.jit fail mid-trace)
+        ops, report = run_compile_passes(
+            program, fetch_list=fetch_list,
+            feed_shapes=dict(zip(feed_names, shapes)),
+            scope_names=set(persist_in), optimize_level=optimize_level)
+        self.last_diagnostics = report
+
         written = set()
-        for op in blk.ops:
+        for op in ops:
             written.update(op.output_names)
         # only buffers the program re-emits may be donated; donating a
         # frozen (read-only) persistable would delete it from the scope
         updated = tuple(n for n in persist_in if n in written)
         frozen = tuple(n for n in persist_in if n not in written)
 
-        raw = self._replay_fn(program, feed_names, updated, frozen,
+        raw = self._replay_fn(program, ops, feed_names, updated, frozen,
                               fetch_names)
         if data_parallel:
             # Shard the feed batch axis over the data mesh; persistables
@@ -160,13 +194,26 @@ class Executor:
         compiled.feed_shardings = in_sh[0] if data_parallel else None
         compiled.updated = updated
         compiled.frozen = frozen
+        compiled.program_uid = program._uid
+        compiled.program_version = program._version
+        compiled.op_count = len(blk.ops)  # pre-optimization: mirrors _version
+        compiled.diagnostics = report
         self._cache[key] = compiled
         return compiled
 
     # -- public API ---------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name=None,
             fetch_var_name=None, scope=None, return_numpy=True,
-            use_program_cache=True):
+            use_program_cache=True, optimize_level=None):
+        """Run ``program`` (ref: executor.py Executor.run). New vs the
+        reference: ``optimize_level`` selects the ``paddle_tpu.analysis``
+        pass pipeline applied before compilation — 0 verify-only,
+        1 (default) identity-forwarding + dead-op elimination,
+        2 additionally CSE. The verifier always runs; a malformed Program
+        raises ``analysis.ProgramVerificationError`` with coded
+        diagnostics. ``None`` inherits the Executor-level default
+        (``Executor(optimize_level=...)`` / env ``PADDLE_TPU_OPT_LEVEL``).
+        """
         from .compiler import CompiledProgram
 
         if program is None:
@@ -197,7 +244,8 @@ class Executor:
 
         compiled = self._compile(
             program, feed, fetch_list, data_parallel=data_parallel,
-            allow_replicated_fallback=allow_replicated_fallback)
+            allow_replicated_fallback=allow_replicated_fallback,
+            optimize_level=optimize_level)
         feeds = [jnp.asarray(np.asarray(feed[n])) for n in compiled.feed_names]
         updated = [scope.find_var(n) for n in compiled.updated]
         frozen = [scope.find_var(n) for n in compiled.frozen]

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import itertools
 
 import numpy as np
 import jax
@@ -44,13 +45,23 @@ class Variable(Tensor):
     """
 
     __slots__ = ("block", "is_parameter", "initializer", "is_data", "_stale",
-                 "trainable", "optimize_attr", "regularizer", "need_clip")
+                 "trainable", "optimize_attr", "regularizer", "need_clip",
+                 "dynamic_dims")
 
     def __init__(self, block, name, shape, dtype, persistable=False,
                  stop_gradient=True, is_data=False):
-        aval = jax.ShapeDtypeStruct(tuple(int(s) if s != -1 else 1 for s in shape),
-                                    convert_dtype(dtype))
+        shape = tuple(shape)
+        # -1/None dims are DYNAMIC: they record as the placeholder 1 (the
+        # Executor re-traces per fed shape) but the original mask is kept
+        # so the verifier can tell an intentional dynamic dim from a feed
+        # that contradicts a declared static dim (analysis PTA009).
+        dynamic = tuple(i for i, s in enumerate(shape) if s in (-1, None))
+        aval = jax.ShapeDtypeStruct(
+            tuple(1 if i in dynamic else int(s)
+                  for i, s in enumerate(shape)),
+            convert_dtype(dtype))
         super().__init__(aval, stop_gradient=stop_gradient, _internal=True)
+        self.dynamic_dims = dynamic
         self.name = name
         self.block = block
         self.persistable = persistable
@@ -148,7 +159,13 @@ class Block:
 class Program:
     """ref: framework.py Program."""
 
+    # monotonic uid: Executor cache keys use this instead of id(program)
+    # — a GC'd Program's id() can be recycled by the allocator, which
+    # would make a stale cache entry hit for a brand-new Program
+    _uid_counter = itertools.count()
+
     def __init__(self):
+        self._uid = next(Program._uid_counter)
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
         self._constants: dict[str, jax.Array] = {}
@@ -179,6 +196,7 @@ class Program:
                           v.stop_gradient, v.is_data)
             nv.is_parameter = v.is_parameter
             nv.initializer = v.initializer
+            nv.dynamic_dims = getattr(v, "dynamic_dims", ())
             blk.vars[name] = nv
         for op in self.global_block.ops:
             attrs = dict(op.attrs)
@@ -188,6 +206,9 @@ class Program:
                                    list(op.output_names), attrs))
         p._constants = dict(self._constants)
         p._lr_getter = self._lr_getter
+        # stochastic replay must be reproducible across clones (ref:
+        # Program.clone copies the desc, random_seed rides the desc)
+        p.random_seed = self.random_seed
         return p
 
     def __str__(self):
@@ -282,9 +303,10 @@ def scope_guard(scope):
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    """ref: fluid.data / static.data."""
+    """ref: fluid.data / static.data. ``-1``/``None`` dims pass through to
+    the Variable, which records them on ``dynamic_dims`` (placeholder 1 in
+    the aval) so the verifier can distinguish them from static dims."""
     prog = default_main_program()
-    shape = [1 if s in (-1, None) else s for s in shape]
     v = prog.global_block.create_var(name=name, shape=shape, dtype=dtype,
                                      is_data=True, stop_gradient=True)
     return v

@@ -1,0 +1,489 @@
+"""paddle_tpu.analysis: verifier diagnostics, optimization passes, and the
+Executor wiring (verify always, optimize behind optimize_level).
+
+Every verifier error class gets a hand-built broken Program asserting the
+EXACT diagnostic code; the pass tests assert op-count reduction AND
+bitwise-identical fetches vs the unoptimized replay (the passes must be
+invisible to numerics by construction)."""
+import gc
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.analysis import (CSEPass, DeadOpEliminationPass,
+                                 ProgramVerificationError, lint_program,
+                                 run_compile_passes, verify_program)
+from paddle_tpu.static_.program import Operator, Program, global_scope
+
+
+def _data_var(blk, name="x", shape=(2, 3)):
+    return blk.create_var(name=name, shape=shape, dtype="float32",
+                          is_data=True)
+
+
+# -- verifier: one broken Program per diagnostic class ----------------------
+
+
+def test_verifier_dangling_input_pta002():
+    p = Program()
+    blk = p.global_block
+    _data_var(blk)
+    blk.create_var(name="y", shape=(2, 3), dtype="float32")
+    blk.append_op(Operator("relu", lambda a: jnp.maximum(a, 0),
+                           ["nowhere"], ["y"], {}))
+    with pytest.raises(ProgramVerificationError) as ei:
+        verify_program(p, fetch_names=("y",))
+    assert [d.code for d in ei.value.errors] == ["PTA002"]
+    assert ei.value.errors[0].var == "nowhere"
+
+
+def test_verifier_use_before_def_pta001():
+    p = Program()
+    blk = p.global_block
+    _data_var(blk)
+    blk.create_var(name="tmp", shape=(2, 3), dtype="float32")
+    blk.create_var(name="o", shape=(2, 3), dtype="float32")
+    # reads tmp before the op that defines it
+    blk.append_op(Operator("scale", lambda a: a * 2.0, ["tmp"], ["o"], {}))
+    blk.append_op(Operator("scale", lambda a: a * 0.5, ["x"], ["tmp"], {}))
+    rep = verify_program(p, fetch_names=("o",), raise_on_error=False)
+    assert "PTA001" in [d.code for d in rep.errors()]
+
+
+def test_verifier_duplicate_output_pta003():
+    p = Program()
+    blk = p.global_block
+    _data_var(blk)
+    blk.create_var(name="y", shape=(2, 3), dtype="float32")
+    blk.append_op(Operator("twin", lambda a: (a, a * 2), ["x"],
+                           ["y", "y"], {}))
+    rep = verify_program(p, raise_on_error=False)
+    assert "PTA003" in [d.code for d in rep.errors()]
+
+
+def test_verifier_waw_clobber_via_record_assign_pta004():
+    """The seeded WAW class: set_value overwrites a computed value no op
+    ever read — built through the REAL recording path."""
+    pt.enable_static()
+    try:
+        main = pt.static.Program()
+        with fluid.program_guard(main):
+            x = fluid.layers.data("x", [-1, 4], "float32")
+            t = fluid.layers.relu(x)       # writes t ... which nothing reads
+            z = fluid.layers.scale(x, scale=3.0)
+            t.set_value(z)                 # assign_to clobbers t
+            fluid.layers.scale(t, scale=1.0)
+    finally:
+        pt.disable_static()
+    rep = verify_program(main, raise_on_error=False)
+    codes = [d.code for d in rep.errors()]
+    assert "PTA004" in codes
+    # and the Executor refuses to compile it
+    exe = fluid.Executor()
+    with pytest.raises(ProgramVerificationError):
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[t])
+
+
+def test_verifier_shape_drift_pta005():
+    p = Program()
+    blk = p.global_block
+    _data_var(blk)
+    blk.create_var(name="s", shape=(5, 7), dtype="float32")  # lie: (2,3)
+    blk.append_op(Operator("relu", lambda a: jnp.maximum(a, 0),
+                           ["x"], ["s"], {}))
+    rep = verify_program(p, raise_on_error=False)
+    assert [d.code for d in rep.errors()] == ["PTA005"]
+
+
+def test_verifier_dtype_drift_pta006():
+    p = Program()
+    blk = p.global_block
+    _data_var(blk)
+    blk.create_var(name="z", shape=(2, 3), dtype="int32")  # lie: float32
+    blk.append_op(Operator("relu", lambda a: jnp.maximum(a, 0),
+                           ["x"], ["z"], {}))
+    rep = verify_program(p, raise_on_error=False)
+    assert [d.code for d in rep.errors()] == ["PTA006"]
+
+
+def test_verifier_donated_then_read_pta007():
+    """Donated (updated) persistable read after its last write: the class
+    that breaks the Executor's buffer-donation discipline."""
+    p = Program()
+    blk = p.global_block
+    _data_var(blk)
+    blk.create_var(name="w@acc", shape=(2, 3), dtype="float32",
+                   persistable=True)
+    blk.create_var(name="r", shape=(2, 3), dtype="float32")
+    blk.append_op(Operator("axpy", lambda a, b: a + b,
+                           ["x", "w@acc"], ["w@acc"], {}))
+    blk.append_op(Operator("scale", lambda a: a * 2.0, ["w@acc"], ["r"], {}))
+    rep = verify_program(p, fetch_names=("r",), raise_on_error=False)
+    assert [d.code for d in rep.errors()] == ["PTA007"]
+    assert rep.errors()[0].var == "w@acc"
+    # through the Executor (scope-held persistable => donated): rejected
+    global_scope().set("w@acc", jnp.ones((2, 3), jnp.float32))
+    try:
+        exe = fluid.Executor()
+        with pytest.raises(ProgramVerificationError):
+            exe.run(p, feed={"x": np.ones((2, 3), np.float32)},
+                    fetch_list=["r"])
+    finally:
+        del global_scope()._vars["w@acc"]  # don't leak into other tests
+    # a persistable the Scope does NOT hold is never donated: a
+    # written-then-read one is plain env state and must verify clean
+    p2 = Program()
+    blk2 = p2.global_block
+    _data_var(blk2)
+    blk2.create_var(name="stat", shape=(2, 3), dtype="float32",
+                    persistable=True)
+    blk2.create_var(name="r2", shape=(2, 3), dtype="float32")
+    blk2.append_op(Operator("copy", lambda a: a * 1.0, ["x"], ["stat"], {}))
+    blk2.append_op(Operator("scale", lambda a: a * 2.0, ["stat"],
+                            ["r2"], {}))
+    rep = verify_program(p2, fetch_names=("r2",), scope_names=set(),
+                         raise_on_error=False)
+    assert rep.errors() == []
+    out = exe.run(p2, feed={"x": np.ones((2, 3), np.float32)},
+                  fetch_list=["r2"])
+    np.testing.assert_array_equal(out[0], np.full((2, 3), 2.0, np.float32))
+
+
+def test_verifier_passes_clean_training_program():
+    """A real forward+backward+update program must verify clean — the
+    checks may not false-positive on the optimizer's in-place writes."""
+    pt.enable_static()
+    try:
+        main, startup = pt.static.Program(), pt.static.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [-1, 4], "float32")
+            y = fluid.layers.data("y", [-1, 1], "float32")
+            h = fluid.layers.fc(x, size=8, act="relu")
+            pred = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.reduce_mean(fluid.layers.square(pred - y))
+            opt = fluid.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        rep = verify_program(main, fetch_names=(loss.name,),
+                             raise_on_error=False)
+        assert rep.errors() == []
+        exe = fluid.Executor()
+        exe.run(startup)
+        out = exe.run(main,
+                      feed={"x": np.random.randn(8, 4).astype(np.float32),
+                            "y": np.random.randn(8, 1).astype(np.float32)},
+                      fetch_list=[loss])
+        assert np.isfinite(out[0]).all()
+    finally:
+        pt.disable_static()
+
+
+# -- satellites -------------------------------------------------------------
+
+
+def test_dynamic_dim_mask_and_static_dim_feed_warning():
+    pt.enable_static()
+    try:
+        main = pt.static.Program()
+        with fluid.program_guard(main):
+            x = pt.static.data("x", [-1, 3], "float32")
+            fluid.layers.relu(x)
+        assert x.dynamic_dims == (0,)
+        assert x.shape == [1, 3]  # placeholder 1, mask remembers dim 0
+    finally:
+        pt.disable_static()
+    # dynamic dim 0 may vary freely: no warning
+    rep = verify_program(main, feed_shapes={"x": ((64, 3), "float32")},
+                         raise_on_error=False)
+    assert not rep.has("PTA009")
+    # static dim 1 contradicted: PTA009 warning, NOT a deep XLA failure
+    with pytest.warns(RuntimeWarning, match="declared static shape"):
+        rep = verify_program(main, feed_shapes={"x": ((64, 5), "float32")},
+                             raise_on_error=False)
+    assert rep.has("PTA009")
+    assert rep.errors() == []  # a warning: the program still re-traces
+
+
+def test_program_uid_monotonic_and_cache_keyed_on_uid():
+    uids = [Program()._uid for _ in range(3)]
+    assert uids == sorted(uids) and len(set(uids)) == 3
+    # a GC'd program's id() can be recycled; its _uid can not
+    p1 = Program()
+    uid1 = p1._uid
+    del p1
+    gc.collect()
+    assert Program()._uid > uid1
+
+    pt.enable_static()
+    try:
+        main = pt.static.Program()
+        with fluid.program_guard(main):
+            x = fluid.layers.data("x", [-1, 4], "float32")
+            out = fluid.layers.relu(x)
+        exe = fluid.Executor()
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[out])
+        assert any(k[0] == main._uid for k in exe._cache)
+        assert not any(k[0] == id(main) for k in exe._cache)
+    finally:
+        pt.disable_static()
+
+
+def test_clone_carries_random_seed_and_replays_identically():
+    pt.enable_static()
+    pt.seed(1234)
+    try:
+        main = pt.static.Program()
+        main.random_seed = 7
+        with fluid.program_guard(main):
+            x = fluid.layers.data("x", [-1, 16], "float32")
+            d = fluid.layers.dropout(x, 0.5)
+            out = fluid.layers.reduce_sum(d)
+        clone = main.clone(for_test=False)
+        assert clone.random_seed == 7
+        exe = fluid.Executor()
+        feed = {"x": np.random.randn(4, 16).astype(np.float32)}
+        a = exe.run(main, feed=feed, fetch_list=[out])[0]
+        b = exe.run(clone, feed=feed, fetch_list=[out])[0]
+        # the PRNG key is a captured constant carried by the clone: the
+        # stochastic replay is bitwise reproducible across clones
+        np.testing.assert_array_equal(a, b)
+    finally:
+        pt.disable_static()
+
+
+# -- optimization passes ----------------------------------------------------
+
+
+def _train_program_with_dropout():
+    """Forward + loss + appended backward: a training program whose
+    eval-mode clone carries a neutered dropout and a dead grad chain."""
+    main = pt.static.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data("x", [-1, 8], "float32")
+        y = fluid.layers.data("y", [-1, 1], "float32")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        h = fluid.layers.dropout(h, 0.5)
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(pred - y))
+        fluid.backward.append_backward(loss)
+    return main, loss
+
+
+def test_dce_on_eval_clone_removes_ops_and_keeps_fetches_bitwise():
+    pt.enable_static()
+    try:
+        main, loss = _train_program_with_dropout()
+        test_prog = main.clone(for_test=True)
+        exe = fluid.Executor()
+        feed = {"x": np.random.randn(4, 8).astype(np.float32),
+                "y": np.random.randn(4, 1).astype(np.float32)}
+        ref = exe.run(test_prog, feed=feed, fetch_list=[loss.name],
+                      optimize_level=0)[0]
+        opt = exe.run(test_prog, feed=feed, fetch_list=[loss.name],
+                      optimize_level=1)[0]
+        np.testing.assert_array_equal(ref, opt)  # bitwise identical
+        stats = exe.last_diagnostics.pass_stats
+        removed = (stats["dead_op_elimination"]["removed"]
+                   + stats["forward_identity"]["removed"])
+        # the whole grad chain + the neutered dropout are unreachable
+        assert removed >= 1
+        assert stats["dead_op_elimination"]["removed"] >= 1
+        assert stats["forward_identity"]["removed"] >= 1
+    finally:
+        pt.disable_static()
+
+
+def test_cse_merges_duplicate_pure_ops():
+    pt.enable_static()
+    try:
+        main = pt.static.Program()
+        with fluid.program_guard(main):
+            x = fluid.layers.data("x", [-1, 6], "float32")
+            a = fluid.layers.relu(x)
+            b = fluid.layers.relu(x)       # identical pure op: CSE fodder
+            out = fluid.layers.reduce_sum(a + b)
+        exe = fluid.Executor()
+        feed = {"x": np.random.randn(3, 6).astype(np.float32)}
+        ref = exe.run(main, feed=feed, fetch_list=[out],
+                      optimize_level=0)[0]
+        opt = exe.run(main, feed=feed, fetch_list=[out],
+                      optimize_level=2)[0]
+        np.testing.assert_array_equal(ref, opt)
+        assert exe.last_diagnostics.pass_stats["cse"]["removed"] >= 1
+    finally:
+        pt.disable_static()
+
+
+def test_cse_respects_inplace_redefinition():
+    """Two textually identical ops must NOT merge when an assign_to
+    redefines their input between them (value-version keying)."""
+    p = Program()
+    blk = p.global_block
+    _data_var(blk, "x", (4,))
+    for n in ("a", "b", "c"):
+        blk.create_var(name=n, shape=(4,), dtype="float32")
+    from paddle_tpu.ops._base import OP_REGISTRY, register
+
+    if "t_double" not in OP_REGISTRY:
+        register("t_double")(lambda v: v * 2.0)
+    fn = OP_REGISTRY["t_double"]
+    blk.append_op(Operator("t_double", fn, ["x"], ["a"], {}))
+    blk.append_op(Operator("assign_to", lambda v: v, ["a"], ["x"], {}))
+    blk.append_op(Operator("t_double", fn, ["x"], ["b"], {}))  # new x!
+    blk.append_op(Operator("axpy", lambda u, v: u + v, ["a", "b"], ["c"], {}))
+    from paddle_tpu.analysis import PassContext
+
+    ops = CSEPass().rewrite(PassContext(p, fetch_names=("c",)))
+    assert len(ops) == 4  # nothing merged
+
+
+def test_forward_identity_blocked_when_source_overwritten_later():
+    """A p=0 dropout must NOT be forwarded when a later assign_to
+    redefines its SOURCE: readers of the dropout output would silently
+    see the new value (stale-rename regression)."""
+    import jax
+
+    from paddle_tpu.ops._base import OP_REGISTRY
+
+    p = Program()
+    blk = p.global_block
+    _data_var(blk, "x", (2,))
+    key = jax.random.PRNGKey(0)
+    blk.create_var(name="k", shape=key.shape, dtype=key.dtype)
+    p._constants["k"] = key
+    blk.create_var(name="c", shape=(2,), dtype="float32")
+    p._constants["c"] = jnp.asarray([100.0, 100.0])
+    blk.create_var(name="h", shape=(2,), dtype="float32")
+    blk.create_var(name="y", shape=(2,), dtype="float32")
+    blk.append_op(Operator("dropout", OP_REGISTRY["dropout"], ["x", "k"],
+                           ["h"], {"p": 0.0, "mode": "upscale_in_train"}))
+    blk.append_op(Operator("assign_to", lambda v: v, ["c"], ["x"], {}))
+    blk.append_op(Operator("scale", lambda a: a * 1.0, ["h"], ["y"], {}))
+    exe = fluid.Executor()
+    feed = {"x": np.asarray([1.0, 2.0], np.float32)}
+    ref = exe.run(p, feed=feed, fetch_list=["y"], optimize_level=0)[0]
+    opt = exe.run(p, feed=feed, fetch_list=["y"], optimize_level=1)[0]
+    np.testing.assert_array_equal(ref, opt)
+    np.testing.assert_array_equal(ref, [1.0, 2.0])  # NOT the assigned 100s
+
+
+def test_cse_blocked_when_merged_source_overwritten_later():
+    """Two identical pure ops must NOT merge when the survivor's output
+    is overwritten in place after the merge point."""
+    from paddle_tpu.analysis import PassContext
+    from paddle_tpu.ops._base import OP_REGISTRY, register
+
+    if "t_exp" not in OP_REGISTRY:
+        register("t_exp")(jnp.exp)
+    fn = OP_REGISTRY["t_exp"]
+    p = Program()
+    blk = p.global_block
+    _data_var(blk, "x", (2,))
+    blk.create_var(name="c", shape=(2,), dtype="float32")
+    p._constants["c"] = jnp.asarray([7.0, 7.0])
+    for n in ("a", "b", "u", "y"):
+        blk.create_var(name=n, shape=(2,), dtype="float32")
+    blk.append_op(Operator("t_exp", fn, ["x"], ["a"], {}))
+    blk.append_op(Operator("scale", lambda v: v * 1.0, ["a"], ["u"], {}))
+    blk.append_op(Operator("t_exp", fn, ["x"], ["b"], {}))  # merge bait
+    blk.append_op(Operator("assign_to", lambda v: v, ["c"], ["a"], {}))
+    blk.append_op(Operator("t_exp", fn, ["b"], ["y"], {}))
+    ops = CSEPass().rewrite(PassContext(p, fetch_names=("u", "y")))
+    assert len(ops) == 5  # nothing merged: 'a' is clobbered after the bait
+    exe = fluid.Executor()
+    feed = {"x": np.asarray([1.0, 2.0], np.float32)}
+    ref = exe.run(p, feed=feed, fetch_list=["y"], optimize_level=0)[0]
+    opt = exe.run(p, feed=feed, fetch_list=["y"], optimize_level=2)[0]
+    np.testing.assert_array_equal(ref, opt)
+
+
+def test_dce_preserves_persistable_updates():
+    """Ops feeding only a persistable's final value are NOT dead."""
+    p = Program()
+    blk = p.global_block
+    _data_var(blk, "x", (4,))
+    blk.create_var(name="stat", shape=(4,), dtype="float32",
+                   persistable=True)
+    blk.create_var(name="o", shape=(4,), dtype="float32")
+    blk.append_op(Operator("upd", lambda a, s: a + s, ["x", "stat"],
+                           ["stat"], {}))
+    blk.append_op(Operator("id", lambda a: a * 1.0, ["x"], ["o"], {}))
+    from paddle_tpu.analysis import PassContext
+
+    ctx = PassContext(p, fetch_names=("o",))
+    ops = DeadOpEliminationPass().rewrite(ctx)
+    assert [op.type for op in ops] == ["upd", "id"]
+
+
+# -- lint -------------------------------------------------------------------
+
+
+def test_lint_unused_feed_stale_fetch_and_dead_constant():
+    pt.enable_static()
+    try:
+        main = pt.static.Program()
+        with fluid.program_guard(main):
+            x = fluid.layers.data("x", [-1, 4], "float32")
+            unused = fluid.layers.data("unused", [-1, 4], "float32")
+            out = fluid.layers.relu(x)
+        other = pt.static.Program()
+        with fluid.program_guard(other):
+            fx = fluid.layers.data("x", [-1, 4], "float32")
+            foreign = fluid.layers.relu(fx)
+        # a constant nothing consumes
+        main._constants["orphan_const"] = jnp.zeros((2,), jnp.float32)
+        rep = lint_program(main, fetch_list=[out, foreign])
+        codes = set(rep.codes())
+        assert {"PTL101", "PTL102", "PTL103"} <= codes
+        assert rep.errors() == []  # lint is warnings-only
+        # explicit stale flag is honored too
+        out._stale = True
+        rep = lint_program(main, fetch_list=[out])
+        assert rep.has("PTL102")
+    finally:
+        pt.disable_static()
+
+
+# -- wiring -----------------------------------------------------------------
+
+
+def test_append_backward_runs_structural_verifier():
+    """autodiff output is itself checked: corrupting the program before
+    append_backward surfaces as a coded diagnostic, not an XLA error."""
+    pt.enable_static()
+    try:
+        main = pt.static.Program()
+        with fluid.program_guard(main):
+            x = fluid.layers.data("x", [-1, 4], "float32")
+            h = fluid.layers.fc(x, size=4)
+            loss = fluid.layers.reduce_mean(h)
+            # sabotage: an op referencing a name that does not exist
+            main.global_block.append_op(Operator(
+                "broken", lambda a: a, ["ghost_var"], [loss.name], {}))
+            with pytest.raises(ProgramVerificationError):
+                fluid.backward.append_backward(loss)
+    finally:
+        pt.disable_static()
+
+
+def test_optimize_level_0_compiles_full_program():
+    pt.enable_static()
+    try:
+        main = pt.static.Program()
+        with fluid.program_guard(main):
+            x = fluid.layers.data("x", [-1, 4], "float32")
+            fluid.layers.scale(x, scale=2.0)       # dead at level>=1
+            out = fluid.layers.relu(x)
+        exe = fluid.Executor()
+        feed = {"x": np.ones((2, 4), np.float32)}
+        exe.run(main, feed=feed, fetch_list=[out], optimize_level=0)
+        assert exe.last_diagnostics.pass_stats == {}
+        exe.run(main, feed=feed, fetch_list=[out], optimize_level=1)
+        assert exe.last_diagnostics.pass_stats[
+            "dead_op_elimination"]["removed"] == 1
+    finally:
+        pt.disable_static()

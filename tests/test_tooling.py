@@ -1,0 +1,51 @@
+"""Repo tooling gates: the analysis self-lint and the pytest marker
+contract ride the tier-1 command path, so a pass regression or an
+unregistered marker fails fast instead of silently weakening CI."""
+import configparser
+import importlib.util
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint_program():
+    spec = importlib.util.spec_from_file_location(
+        "lint_program", os.path.join(ROOT, "tools", "lint_program.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_lint_program_self_test_passes():
+    """tools/lint_program.py --self-test: every seeded malformed-Program
+    class must be rejected with its distinct diagnostic, and DCE must
+    drop the seeded dead op. Run in-process (same interpreter as the
+    suite) so it is part of the tier-1 gate."""
+    mod = _load_lint_program()
+    assert mod.main(["--self-test"]) == 0
+
+
+def test_slow_marker_is_registered():
+    """The tier-1 command filters with -m 'not slow'; if the marker ever
+    vanishes from pytest.ini the filter silently matches nothing it
+    should. Pin the registration."""
+    ini = os.path.join(ROOT, "pytest.ini")
+    assert os.path.exists(ini), "pytest.ini with the slow marker is gone"
+    cp = configparser.ConfigParser()
+    cp.read(ini)
+    markers = cp.get("pytest", "markers", fallback="")
+    assert any(line.strip().startswith("slow")
+               for line in markers.splitlines()), \
+        "the 'slow' marker must stay registered for the tier-1 filter"
+
+
+def test_lint_cli_reports_user_script(tmp_path):
+    """End-to-end CLI path: a script building a Program into the default
+    main program gets a printed report and exit code 0 when clean."""
+    script = tmp_path / "build.py"
+    script.write_text(
+        "import paddle_tpu.fluid as fluid\n"
+        "x = fluid.layers.data('x', [-1, 4], 'float32')\n"
+        "y = fluid.layers.relu(x)\n")
+    mod = _load_lint_program()
+    assert mod.main([str(script)]) == 0
