@@ -88,6 +88,7 @@ from . import metrics
 from . import distribution
 from . import static_
 from . import framework
+from . import resilience
 from . import runtime
 from . import inference
 from . import quant

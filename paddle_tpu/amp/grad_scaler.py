@@ -127,6 +127,16 @@ class GradScaler(DynamicLossScaler):
         self.step(optimizer)
         self.update()
 
+    def notify_skip(self):
+        """Record an externally-discarded step (resilience.GuardedStep's
+        skip_step / rollback policies) as a found-inf event: the dynamic
+        loss scale shrinks exactly as it would for an in-graph overflow,
+        so guard-level and scaler-level skips stay on one state machine."""
+        if not self._enable:
+            return
+        self._found_inf = True
+        self.update()
+
     def update(self):
         if not self._enable:
             return
@@ -157,8 +167,10 @@ class GradScaler(DynamicLossScaler):
         return {"scale": self.loss_scaling, "incr_ratio": self.incr_ratio,
                 "decr_ratio": self.decr_ratio,
                 "incr_every_n_steps": self.incr_every_n_steps,
-                "good_steps": self._good_py()}
+                "good_steps": self._good_py(),
+                "bad_steps": self._bad_py()}
 
     def load_state_dict(self, state):
         self.loss_scaling = float(state["scale"])
         self._good = int(state.get("good_steps", 0))
+        self._bad = int(state.get("bad_steps", 0))

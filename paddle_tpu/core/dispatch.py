@@ -156,6 +156,15 @@ def _all_float(out):
 
 _amp_state = _cast_op_inputs = _nan_guard = None
 
+# Push-style chaos hook (resilience.inject 'nan_op' corruption): None when
+# no injector is active, so the disabled hot path pays one None check.
+_chaos_op_hook = None
+
+
+def set_chaos_op_hook(fn):
+    global _chaos_op_hook
+    _chaos_op_hook = fn
+
 
 def _lazy_hooks():
     """Bind the AMP / nan-guard hooks once (module-level import would be a
@@ -209,6 +218,13 @@ def apply(name, fn, *args, **attrs):
 
     multi = isinstance(out, tuple)
     outs = out if multi else (out,)
+
+    if _chaos_op_hook is not None and not isinstance(
+            outs[0], jax.core.Tracer):
+        # chaos corruption BEFORE the nan-guard check, so detection sees
+        # the injected fault; never under a trace (a corrupted tracer
+        # would bake NaN into the compiled function permanently)
+        outs = _chaos_op_hook(name, outs)
 
     if _nan_guard.check_nan_enabled() and not isinstance(
             outs[0], jax.core.Tracer):

@@ -11,17 +11,23 @@ inference program replays into the same single fused XLA executable.
 """
 from __future__ import annotations
 
+import binascii
+import json
 import os
 import pickle
+import shutil
+import warnings
 
 import numpy as np
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..resilience import inject as _chaos
 
 __all__ = [
     "save", "load", "save_inference_model", "load_inference_model",
-    "save_checkpoint", "load_checkpoint",
+    "save_checkpoint", "load_checkpoint", "verify_checkpoint",
+    "CheckpointError",
     "save_vars", "load_vars", "save_params", "load_params",
     "save_persistables", "load_persistables",
     "get_program_parameter", "get_program_persistable_vars",
@@ -183,53 +189,304 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 # -- training checkpoints (ref: fluid incubate checkpoint + SURVEY §2 #45) --
 
 
+class CheckpointError(RuntimeError):
+    """A checkpoint exists but cannot be trusted (truncated, bit-flipped,
+    unreadable, or missing files the manifest promises)."""
+
+
+def _ckpt_step(dirname):
+    """int step from a ckpt_* dir name, or None for garbage (a stray
+    'ckpt_latest' symlink, 'ckpt_12.bak', editor droppings...)."""
+    tail = dirname[len("ckpt_"):]
+    return int(tail) if tail.isdigit() else None
+
+
+def _array_checksums(state):
+    """{path: {crc32, shape, dtype}} for every array leaf of a (possibly
+    nested) state dict — the per-array integrity record in the manifest."""
+    out = {}
+
+    def walk(obj, path):
+        if isinstance(obj, dict):
+            for k in obj:
+                walk(obj[k], f"{path}/{k}" if path else str(k))
+        elif isinstance(obj, (list, tuple)):
+            for i, v in enumerate(obj):
+                walk(v, f"{path}[{i}]")
+        elif hasattr(obj, "shape") and hasattr(obj, "dtype"):
+            a = np.asarray(obj)
+            if not a.flags.c_contiguous:
+                a = np.ascontiguousarray(a)
+            # crc32 reads the array's buffer directly: no tobytes() copy
+            out[path] = {"crc32": binascii.crc32(a) & 0xFFFFFFFF,
+                         "shape": list(a.shape), "dtype": str(a.dtype)}
+
+    walk(state, "")
+    return out
+
+
+class _CrcWriter:
+    """File-like sink that crc32s what passes through — lets pickle
+    STREAM to disk (no whole-checkpoint blob in host RAM) while still
+    digesting the exact bytes written."""
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+        self.size = 0
+
+    def write(self, b):
+        self._f.write(b)
+        self.crc = binascii.crc32(b, self.crc)
+        self.size += len(b)
+        return len(b)
+
+
+def _dump_with_digest(obj, path):
+    """Stream-pickle an already-numpy-converted tree to ``path``; return
+    the manifest file entry. The crc is computed on the exact bytes
+    written, so any later truncation/bit-flip of the file is
+    detectable."""
+    with open(path, "wb") as f:
+        w = _CrcWriter(f)
+        pickle.dump(obj, w, protocol=4)
+    return {"size": w.size, "crc32": w.crc & 0xFFFFFFFF}
+
+
 def save_checkpoint(directory, step, model=None, optimizer=None,
                     scheduler=None, keep_last=3, extra=None):
-    """Atomic checkpoint with keep-last-k rotation and resume metadata."""
+    """Atomic checkpoint with keep-last-k rotation, resume metadata, and
+    an integrity manifest (per-file and per-array crc32) that
+    ``load_checkpoint`` verifies before trusting the data."""
     os.makedirs(directory, exist_ok=True)
     tmp = os.path.join(directory, f".tmp_ckpt_{step}")
     final = os.path.join(directory, f"ckpt_{step}")
     os.makedirs(tmp, exist_ok=True)
     state = {"step": int(step), "extra": extra or {}}
+    manifest = {"format": 1, "step": int(step), "files": {}, "arrays": {}}
     if model is not None:
-        save({k: v for k, v in model.state_dict().items()},
-             os.path.join(tmp, "model.pdparams"))
+        mstate = _to_numpy_tree({k: v for k, v in model.state_dict().items()})
+        manifest["files"]["model.pdparams"] = _dump_with_digest(
+            mstate, os.path.join(tmp, "model.pdparams"))
+        manifest["arrays"]["model.pdparams"] = _array_checksums(mstate)
     if optimizer is not None:
-        save(optimizer.state_dict(), os.path.join(tmp, "opt.pdopt"))
+        ostate = _to_numpy_tree(optimizer.state_dict())
+        manifest["files"]["opt.pdopt"] = _dump_with_digest(
+            ostate, os.path.join(tmp, "opt.pdopt"))
+        manifest["arrays"]["opt.pdopt"] = _array_checksums(ostate)
     if scheduler is not None:
         state["scheduler"] = scheduler.state_dict()
-    save(state, os.path.join(tmp, "meta.pkl"))
+    manifest["files"]["meta.pkl"] = _dump_with_digest(
+        _to_numpy_tree(state), os.path.join(tmp, "meta.pkl"))
+    with open(os.path.join(tmp, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    if _chaos.ACTIVE:
+        _chaos.fire("ckpt_crash", tmp)  # simulated death: tmp left orphaned
     if os.path.exists(final):
-        import shutil
-
         shutil.rmtree(final)
     os.replace(tmp, final)  # atomic publish: readers never see partial state
-    # rotate
-    ckpts = sorted((d for d in os.listdir(directory) if d.startswith("ckpt_")),
-                   key=lambda d: int(d.split("_")[1]))
+    if _chaos.ACTIVE:  # post-publish media corruption
+        _chaos.fire("ckpt_truncate", final)
+        _chaos.fire("ckpt_bitflip", final)
+    # rotate (ignoring garbage dirs a crashed/foreign writer left behind)
+    ckpts = sorted(
+        (d for d in os.listdir(directory)
+         if d.startswith("ckpt_") and _ckpt_step(d) is not None),
+        key=_ckpt_step)
     for old in ckpts[:-keep_last]:
-        import shutil
-
         shutil.rmtree(os.path.join(directory, old))
     return final
 
 
+def _read_verified(path, name, entry):
+    """Read + verify one checkpoint file against its manifest entry;
+    returns the unpickled object or raises CheckpointError."""
+    fpath = os.path.join(path, name)
+    if not os.path.exists(fpath):
+        raise CheckpointError(f"{path}: manifest lists {name} but the "
+                              "file is missing")
+    if entry is not None:
+        # chunked digest pass: O(chunk) host memory even for huge files
+        crc, size = 0, 0
+        with open(fpath, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                crc = binascii.crc32(chunk, crc)
+                size += len(chunk)
+        if size != entry["size"]:
+            raise CheckpointError(
+                f"{fpath}: size {size} != manifest {entry['size']} "
+                "(truncated write?)")
+        if (crc & 0xFFFFFFFF) != entry["crc32"]:
+            raise CheckpointError(f"{fpath}: crc32 mismatch (corrupt)")
+    try:
+        with open(fpath, "rb") as f:
+            return pickle.load(f)
+    except Exception as e:
+        raise CheckpointError(f"{fpath}: unreadable ({e})") from e
+
+
+def _load_and_verify(path, deep=False):
+    """Load every file of one checkpoint dir, verifying against the
+    manifest when present (legacy manifest-less checkpoints are accepted
+    if their pickles parse). Returns {filename: object}. ``deep``
+    additionally re-verifies every per-array crc32 — the file-level crc
+    over the same bytes already subsumes that on the normal load path,
+    so the deep pass is for ``verify_checkpoint`` audits, where it
+    pins down WHICH array diverged (and catches a file whose file-level
+    digest was regenerated around an array-level edit)."""
+    mpath = os.path.join(path, "manifest.json")
+    manifest = None
+    if os.path.exists(mpath):
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except Exception as e:
+            raise CheckpointError(
+                f"{mpath}: unreadable manifest ({e})") from e
+        # a bit-flipped manifest can stay valid JSON with a broken
+        # shape; that must read as "corrupt checkpoint" (fallback), not
+        # KeyError (abort)
+        if not isinstance(manifest, dict) or \
+                not isinstance(manifest.get("files"), dict) or not all(
+                    isinstance(e, dict) and "size" in e and "crc32" in e
+                    for e in manifest["files"].values()):
+            raise CheckpointError(
+                f"{mpath}: malformed manifest structure (corrupt)")
+    out = {}
+    names = list(manifest["files"]) if manifest else \
+        [n for n in ("meta.pkl", "model.pdparams", "opt.pdopt")
+         if os.path.exists(os.path.join(path, n))]
+    if "meta.pkl" not in names:
+        raise CheckpointError(f"{path}: no meta.pkl")
+    for name in names:
+        entry = manifest["files"][name] if manifest else None
+        obj = _read_verified(path, name, entry)
+        if deep and manifest and name in manifest.get("arrays", {}):
+            got = _array_checksums(obj)
+            want = manifest["arrays"][name]
+            if got != want:
+                bad = sorted(set(want) ^ set(got)) or sorted(
+                    k for k in want if got.get(k) != want[k])
+                raise CheckpointError(
+                    f"{path}/{name}: per-array checksum mismatch "
+                    f"({bad[:4]})")
+        out[name] = obj
+    return out
+
+
+def verify_checkpoint(path):
+    """(ok, problems): integrity audit of one checkpoint dir without
+    applying it to any model — includes the deep per-array checksum
+    pass, so a mismatch names the specific corrupt array."""
+    try:
+        _load_and_verify(path, deep=True)
+        return True, []
+    except CheckpointError as e:
+        return False, [str(e)]
+
+
+def _tmp_age(path):
+    """Seconds since the newest mtime under a tmp artifact (a LIVE
+    save_checkpoint is actively writing, so its newest file is fresh)."""
+    import time
+
+    newest = os.path.getmtime(path)
+    if os.path.isdir(path):
+        for f in os.listdir(path):
+            try:
+                newest = max(newest, os.path.getmtime(
+                    os.path.join(path, f)))
+            except OSError:
+                pass
+    return time.time() - newest
+
+
+def _clean_orphan_tmp(directory, grace_secs=60.0):
+    """Remove ``.tmp_ckpt_*`` dirs (and stray ``*.tmp`` files) a crashed
+    ``save_checkpoint`` left behind — they hold partial state and would
+    otherwise accumulate forever. Artifacts younger than ``grace_secs``
+    are left alone: they may belong to a CONCURRENT saver in another
+    process, and tmp dirs never match the ``ckpt_*`` load pattern, so
+    deferring their cleanup to a later load costs nothing."""
+    for d in os.listdir(directory):
+        if d.startswith(".tmp_ckpt_") or d.endswith(".tmp"):
+            p = os.path.join(directory, d)
+            try:
+                if _tmp_age(p) < grace_secs:
+                    continue
+            except OSError:
+                continue  # vanished: the concurrent saver published it
+            warnings.warn(
+                f"removing orphaned checkpoint artifact {p} (crashed "
+                "save_checkpoint)", RuntimeWarning)
+            if os.path.isdir(p):
+                shutil.rmtree(p, ignore_errors=True)
+            else:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+
 def load_checkpoint(directory, model=None, optimizer=None, scheduler=None,
                     step=None):
-    """Load latest (or given) checkpoint; returns resume step or None."""
+    """Load the newest *intact* checkpoint (or the given ``step``);
+    returns the resume step or None when the directory holds none.
+
+    Robustness contract (chaos-tested): orphaned ``.tmp_ckpt_*`` dirs
+    from crashed saves are cleaned up; garbage ``ckpt_*`` names are
+    ignored with a warning; a corrupt/truncated newest checkpoint makes
+    the loader fall back to the next-newest intact one. Only when every
+    checkpoint fails verification — or an explicitly requested ``step``
+    does — is ``CheckpointError`` raised.
+    """
     if not os.path.isdir(directory):
         return None
-    ckpts = sorted((d for d in os.listdir(directory) if d.startswith("ckpt_")),
-                   key=lambda d: int(d.split("_")[1]))
-    if not ckpts:
+    _clean_orphan_tmp(directory)
+    entries = []
+    for d in os.listdir(directory):
+        if not d.startswith("ckpt_"):
+            continue
+        s = _ckpt_step(d)
+        if s is None:
+            warnings.warn(
+                f"ignoring non-checkpoint entry {d!r} in {directory}",
+                RuntimeWarning)
+            continue
+        entries.append((s, d))
+    entries.sort()
+    if not entries:
         return None
-    name = f"ckpt_{step}" if step is not None else ckpts[-1]
-    path = os.path.join(directory, name)
-    meta = load(os.path.join(path, "meta.pkl"))
+    if step is not None:
+        match = [d for s, d in entries if s == int(step)]
+        if not match:
+            raise CheckpointError(
+                f"no checkpoint for step {step} in {directory} "
+                f"(have steps {[s for s, _ in entries]})")
+        payload = _load_and_verify(os.path.join(directory, match[0]))
+    else:
+        payload, failures = None, []
+        for s, d in reversed(entries):
+            try:
+                payload = _load_and_verify(os.path.join(directory, d))
+                break
+            except CheckpointError as e:
+                failures.append(str(e))
+                warnings.warn(
+                    f"checkpoint {d} failed verification ({e}); falling "
+                    "back to the next-newest", RuntimeWarning)
+        if payload is None:
+            raise CheckpointError(
+                f"every checkpoint in {directory} is corrupt:\n  " +
+                "\n  ".join(failures))
+    meta = payload["meta.pkl"]
     if model is not None:
-        model.set_state_dict(load(os.path.join(path, "model.pdparams")))
-    if optimizer is not None and os.path.exists(os.path.join(path, "opt.pdopt")):
-        optimizer.set_state_dict(load(os.path.join(path, "opt.pdopt")))
+        if "model.pdparams" not in payload:
+            raise CheckpointError(
+                f"checkpoint step {meta['step']} has no model state")
+        model.set_state_dict(payload["model.pdparams"])
+    if optimizer is not None and "opt.pdopt" in payload:
+        optimizer.set_state_dict(payload["opt.pdopt"])
     if scheduler is not None and "scheduler" in meta:
         scheduler.set_state_dict(meta["scheduler"])
     return meta["step"]

@@ -84,6 +84,7 @@ class TrainStep:
                            if isinstance(p, Parameter) and p.trainable]
         self._donate = donate
         self._compiled = {}
+        self.last_found_inf = None  # device bool after each call
         self._scaler_state = scaler.state() if scaler is not None else {}
         # materialize optimizer slots eagerly so they join the carried state
         for p in self._trainable:
@@ -212,12 +213,21 @@ class TrainStep:
             opt._accumulators[n] = s
         self._scaler_state = new_scaler
         opt._global_step += 1
+        # the raw device flag (no sync): resilience.GuardedStep and tests
+        # read it to count in-graph scaler skips without a host round-trip
+        self.last_found_inf = found_bad
         if self.check_nan and self.scaler is None and bool(found_bad):
-            from ..utils.nan_guard import NanInfError
+            from ..utils.nan_guard import NanInfError, nonfinite_summary
 
+            # only the loss is still on hand (grads died with the trace);
+            # attach its summary when IT is the nonfinite value, and an
+            # empty one when the overflow was grad-only — a zero-count
+            # summary would be an actively misleading postmortem
+            s = nonfinite_summary(loss)
             raise NanInfError(
                 f"NaN/Inf in loss or gradients at step {opt._global_step} "
-                f"(loss={float(np.asarray(loss))})")
+                f"(loss={float(np.asarray(loss))})",
+                summary=s if s["num_nan"] or s["num_inf"] else None)
         return Tensor(loss, _internal=True)
 
 

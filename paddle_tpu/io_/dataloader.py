@@ -16,6 +16,7 @@ import threading
 
 import numpy as np
 
+from ..resilience import inject as _chaos
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
@@ -47,9 +48,22 @@ def default_collate_fn(batch):
 
 
 class _Prefetcher:
-    """N worker threads -> native ring buffer -> ordered reassembly."""
+    """N worker threads -> native ring buffer -> ordered reassembly.
 
-    def __init__(self, work_iter, fetch, num_workers, capacity):
+    Fault model (chaos point ``loader_worker``): a per-batch FETCH error
+    is data-level — it is surfaced to the consumer at its batch position
+    immediately (no retry: a corrupt record won't uncorrupt, and
+    re-running a side-effectful fetch is wrong). A worker THREAD death
+    (an error escaping the fetch capture — injected crash, payload
+    pickling failure) is infrastructure-level: a supervisor restarts a
+    replacement (which re-fetches the abandoned index) within a bounded
+    restart budget, and only when that budget is exhausted is the death
+    surfaced, in batch order — the iterator can fail, but it can never
+    hang waiting for an index a dead worker will never deliver.
+    """
+
+    def __init__(self, work_iter, fetch, num_workers, capacity,
+                 max_restarts=2):
         from ..runtime import RingBuffer
 
         self._ring = RingBuffer(capacity)
@@ -58,29 +72,85 @@ class _Prefetcher:
         self._next_out = 0
         self._stash = {}
         self._cursor = 0
+        self._retry: list = []  # indices abandoned by crashed workers
         self._cursor_lock = threading.Lock()
-        self._threads = [
-            threading.Thread(target=self._worker, daemon=True)
-            for _ in range(num_workers)]
-        self._active = len(self._threads)
+        self._restarts_left = int(max_restarts)
+        self.restarts = 0  # observability: how many crashes were absorbed
+        self._threads = []
+        self._active = num_workers
         self._active_lock = threading.Lock()
-        for t in self._threads:
+        for _ in range(num_workers):
+            # start each thread as it is created: a crashed worker may
+            # append its replacement to _threads concurrently, and a
+            # start-them-all-afterwards loop would start that
+            # (already-running) replacement a second time
+            t = threading.Thread(target=self._worker, daemon=True)
+            self._threads.append(t)
             t.start()
 
+    def _next_index(self):
+        with self._cursor_lock:
+            if self._retry:
+                return self._retry.pop()
+            i = self._cursor
+            self._cursor += 1
+            return i if i < len(self._work) else None
+
     def _worker(self):
-        while True:
-            with self._cursor_lock:
-                i = self._cursor
-                self._cursor += 1
-            if i >= len(self._work):
-                break
-            try:
-                batch = self._fetch(self._work[i])
-                payload = pickle.dumps((i, batch), protocol=5)
-            except Exception as e:  # surface errors in the consumer
-                payload = pickle.dumps((i, e), protocol=5)
-            if not self._ring.push(payload):
+        i = None
+        try:
+            while True:
+                i = self._next_index()
+                if i is None:
+                    break
+                if _chaos.ACTIVE:
+                    _chaos.fire("loader_worker")  # may kill this thread
+                try:
+                    batch = self._fetch(self._work[i])
+                    payload = pickle.dumps((i, batch), protocol=5)
+                except Exception as e:
+                    # data-level error: surface at this batch position
+                    # (the consumer raises it in order); the worker
+                    # lives on and its restart budget is untouched
+                    payload = pickle.dumps((i, e), protocol=5)
+                if not self._ring.push(payload):
+                    return  # ring closed by consumer shutdown
+                i = None
+        except BaseException as e:  # worker DEATH (chaos kill, pickling
+            self._crashed(i, e)     # failure, machinery bug)
+            return
+        self._finish()
+
+    def _crashed(self, i, exc):
+        """Restart a replacement worker within budget, else surface the
+        error (in batch order) so the consumer raises instead of hanging."""
+        with self._active_lock:
+            if self._restarts_left > 0:
+                self._restarts_left -= 1
+                self.restarts += 1
+                if i is not None:
+                    with self._cursor_lock:
+                        self._retry.append(i)  # replacement re-fetches it
+                t = threading.Thread(target=self._worker, daemon=True)
+                self._threads.append(t)
+                t.start()  # replacement inherits this slot: _active unchanged
                 return
+        if i is not None:
+            if not isinstance(exc, Exception):
+                exc = RuntimeError(
+                    f"DataLoader worker died ({exc!r}) and the restart "
+                    "budget is exhausted")
+            try:
+                payload = pickle.dumps((i, exc), protocol=5)
+            except Exception:
+                payload = pickle.dumps(
+                    (i, RuntimeError(f"DataLoader worker died: {exc!r} "
+                                     "(original exception unpicklable)")),
+                    protocol=5)
+            self._ring.push(payload)
+        self._finish()
+
+    def _finish(self):
         with self._active_lock:
             self._active -= 1
             if self._active == 0:
@@ -105,8 +175,16 @@ class _Prefetcher:
             i, batch = pickle.loads(blob)
             self._stash[i] = batch  # restore deterministic batch order
 
-    def shutdown(self):
+    def shutdown(self, timeout=5.0):
+        """Close the ring and JOIN the workers: an iterator abandoned
+        mid-epoch (or one whose consumer raised) must not leak daemon
+        threads still fetching batches."""
         self._ring.close()
+        import time
+
+        deadline = time.monotonic() + timeout
+        for t in list(self._threads):  # snapshot: restarts may append
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
 
 
 class DataLoader:
@@ -116,11 +194,13 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=False, timeout=0, worker_init_fn=None):
+                 use_shared_memory=False, timeout=0, worker_init_fn=None,
+                 max_worker_restarts=2):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.max_worker_restarts = max_worker_restarts
         self.prefetch_factor = max(prefetch_factor, 2)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -177,7 +257,8 @@ class DataLoader:
             return
         pf = _Prefetcher(self.batch_sampler, self._fetch_batch,
                          self.num_workers,
-                         capacity=self.num_workers * self.prefetch_factor)
+                         capacity=self.num_workers * self.prefetch_factor,
+                         max_restarts=self.max_worker_restarts)
         try:
             for b in pf:
                 yield to_tensors(b)

@@ -1,0 +1,30 @@
+"""paddle_tpu.resilience — fault injection & recovery.
+
+The policy layer that turns the stack's existing fault *primitives*
+(``utils/nan_guard.py`` detection, atomic ``framework/io.py``
+checkpoints, the threaded ``io_/dataloader.py``) into recovered runs,
+plus the chaos machinery that proves it: every registered injector in
+``inject.INJECTORS`` has a recovery test (``tests/test_resilience.py``)
+and a CLI scenario (``tools/chaos_run.py --self-test``).
+
+Reference analogs: ``FLAGS_check_nan_inf`` (operator.cc per-op abort),
+``fluid/incubate/checkpoint`` + fleet HA utilities (checkpoint hygiene,
+trainer restart). See SURVEY §2 rows 45/61.
+"""
+from . import inject  # noqa: F401
+from .inject import (  # noqa: F401
+    ACTIVE, INJECTORS, ChaosError, SimulatedCrashError, TransientChaosError,
+    WorkerCrashChaos, chaos, install_from_env,
+)
+from .policy import (  # noqa: F401
+    NONFINITE_ACTIONS, RecoveryPolicy, TransientError, retry_call,
+)
+from .guard import GuardedExecutor, GuardedStep, GuardStats  # noqa: F401
+
+__all__ = [
+    "chaos", "install_from_env", "ACTIVE", "INJECTORS",
+    "ChaosError", "TransientChaosError", "WorkerCrashChaos",
+    "SimulatedCrashError", "TransientError",
+    "RecoveryPolicy", "NONFINITE_ACTIONS", "retry_call",
+    "GuardedStep", "GuardedExecutor", "GuardStats",
+]

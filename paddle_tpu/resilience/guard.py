@@ -1,0 +1,375 @@
+"""Guards: turn a detected fault into a recovered run.
+
+Two wrappers, one per execution path:
+
+- ``GuardedStep`` wraps a fused eager ``TrainStep`` (framework/jit.py).
+  Detection is the step's existing on-device nonfinite flag
+  (``TrainStep(check_nan=True)`` raises ``NanInfError`` after the step;
+  no extra host sync is added). The policy then decides: re-raise, skip
+  the step (restore the pre-step snapshot — the step contributes
+  nothing, bitwise identical to a run that never saw that batch for
+  RNG-free models), or roll back to the last-good snapshot.
+
+- ``GuardedExecutor`` wraps the static ``Executor``. It adds bounded
+  retry-with-backoff around compile/execute for transient errors,
+  graceful degradation to ``optimize_level=0`` when the optimized
+  program fails where the unoptimized one succeeds, and the same
+  nonfinite policies over the fetched values (already host-side — no
+  new sync) plus an optional on-device ``found_inf`` fetch.
+
+Snapshots are in-memory device copies (``jnp.copy`` — async, donation-
+safe: the executor/step donates its input buffers, so a bare reference
+would be deleted). AMP interplay: restoring a static AMP program's state
+EXCLUDES the ``@amp@*`` loss-scaling vars, so a skipped/rolled-back step
+keeps the scale shrink the in-program machinery applied (otherwise the
+same overflow repeats forever); for eager steps, pass the
+``amp.GradScaler`` so ``notify_skip()`` advances its dynamic scale.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from ..utils.nan_guard import NanInfError
+from . import inject
+from .policy import RecoveryPolicy, retry_call
+
+__all__ = ["GuardedStep", "GuardedExecutor", "GuardStats"]
+
+
+class GuardStats:
+    """Counters a guard accumulates (one instance per guard)."""
+
+    def __init__(self):
+        self.steps = 0          # committed (good) steps
+        self.nonfinite = 0      # nonfinite detections
+        self.skipped = 0        # steps discarded by skip_step
+        self.rollbacks = 0      # last-good restores
+        self.retries = 0        # transient retries that happened
+        self.degraded = 0       # optimize_level degradations
+
+    def as_dict(self):
+        return dict(self.__dict__)
+
+    def __repr__(self):
+        body = ", ".join(f"{k}={v}" for k, v in self.__dict__.items())
+        return f"GuardStats({body})"
+
+
+def _copy_tree(obj):
+    import jax.numpy as jnp
+
+    if isinstance(obj, dict):
+        return {k: _copy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_copy_tree(v) for v in obj)
+    if hasattr(obj, "dtype") and hasattr(obj, "shape"):
+        return jnp.copy(obj)  # device copy, async, survives donation
+    return obj
+
+
+def _nonfinite_fetches(fetches):
+    """Host-side scan of fetched values (they are already on the host —
+    this adds no device sync)."""
+    for f in fetches:
+        a = np.asarray(getattr(f, "_data", f))
+        if a.dtype.kind == "f" and not np.isfinite(a).all():
+            return True
+    return False
+
+
+def _nonfinite_state(scope, names):
+    """On-device finite-check of committed persistables, fused into ONE
+    scalar sync (per-array syncs would serialize N device round-trips a
+    step). Catches faults the fetches can't show: the executable's
+    fetched values are computed from PRE-update state, so a fault that
+    first materializes in the committed update (NaN learning rate, grad
+    overflow under a finite loss) would otherwise only surface one step
+    later — after the guard has already snapshotted the poisoned state
+    as 'good'."""
+    import jax.numpy as jnp
+
+    flags = []
+    for n in names:
+        a = scope.find_var(n)
+        if a is not None and hasattr(a, "dtype") and \
+                np.issubdtype(np.dtype(a.dtype), np.floating):
+            flags.append(jnp.any(~jnp.isfinite(a)))
+    return bool(jnp.stack(flags).any()) if flags else False
+
+
+class GuardedStep:
+    """Policy wrapper over a fused eager ``TrainStep``.
+
+    >>> step = pt.TrainStep(model, opt, loss_fn, check_nan=True)
+    >>> guarded = GuardedStep(step, RecoveryPolicy(on_nonfinite="skip_step"))
+    >>> loss = guarded(x, y)      # None when the step was discarded
+
+    ``scaler`` (optional ``amp.GradScaler``): a guard-discarded step
+    advances the scaler's dynamic state machine via ``notify_skip()``.
+    This is BOOKKEEPING consistency, not training math: a
+    ``TrainStep(check_nan=True)`` without an in-step scaler does no loss
+    scaling, so the shrink changes nothing inside the step — it keeps a
+    GradScaler used elsewhere (eager protocol runs, checkpointed scaler
+    state) recording the same skip/overflow history the guard observed.
+    A TrainStep built WITH a scaler never reaches the guard's nonfinite
+    path at all (its in-graph found_inf already freezes the update and
+    shrinks the scale); the guard then only adds retry/stats.
+    """
+
+    def __init__(self, step, policy=None, scaler=None):
+        self.step = step
+        self.policy = policy or RecoveryPolicy()
+        self.scaler = scaler
+        self.stats = GuardStats()
+        self._last_good = None
+        if self.policy.on_nonfinite != "raise" and not step.check_nan \
+                and step.scaler is None:
+            raise ValueError(
+                "GuardedStep needs the step's on-device nonfinite flag: "
+                "construct TrainStep(check_nan=True) (or attach a loss "
+                "scaler, whose in-graph found_inf already skips updates)")
+
+    # -- snapshot / restore of the step's entire mutable state ---------------
+    def _take_snapshot(self):
+        st, opt = self.step, self.step.optimizer
+        return {
+            "params": [_copy_tree(p._data) for p in st._trainable],
+            "buffers": [_copy_tree(b._data) for b in st._buffers],
+            "opt": {p.name: _copy_tree(opt._accumulators[p.name])
+                    for p in st._trainable},
+            "scaler": _copy_tree(st._scaler_state),
+            "gstep": opt._global_step,
+        }
+
+    def _restore(self, snap):
+        st, opt = self.step, self.step.optimizer
+        # install copies so the snapshot survives a later donation of
+        # the restored buffers (rollback may restore the same snapshot
+        # more than once)
+        for p, a in zip(st._trainable, snap["params"]):
+            p._data = _copy_tree(a)
+        for b, a in zip(st._buffers, snap["buffers"]):
+            b._data = _copy_tree(a)
+        for name, s in snap["opt"].items():
+            opt._accumulators[name] = _copy_tree(s)
+        st._scaler_state = _copy_tree(snap["scaler"])
+        opt._global_step = snap["gstep"]
+
+    def __call__(self, *batch):
+        pol = self.policy
+        if inject.ACTIVE:
+            batch = inject.fire("nan_feed", list(batch))
+        # snapshot EVERY call: the fused step donates its param/buffer/
+        # opt-state buffers, so a failed execution that a user opted
+        # into retry (policy.retryable) leaves deleted buffers behind —
+        # each re-attempt must restore first. skip_step reuses the same
+        # snapshot, and rollback falls back to it before the first
+        # verified-good snapshot exists.
+        pre = self._take_snapshot()
+
+        def attempt():
+            return self.step(*batch)
+
+        try:
+            loss, attempts = retry_call(attempt, pol,
+                                        before_retry=lambda:
+                                        self._restore(pre))
+        except NanInfError:
+            self.stats.nonfinite += 1
+            if pol.on_nonfinite == "raise":
+                raise
+            if pol.on_nonfinite == "skip_step":
+                self._restore(pre)
+                self.stats.skipped += 1
+            else:
+                self._restore(self._last_good if self._last_good
+                              else pre)
+                self.stats.rollbacks += 1
+            if self.scaler is not None:
+                self.scaler.notify_skip()
+            return None
+        self.stats.retries += attempts - 1
+        self.stats.steps += 1
+        if pol.on_nonfinite == "rollback" and \
+                self.stats.steps % pol.snapshot_every == 0:
+            self._last_good = self._take_snapshot()
+        return loss
+
+
+class GuardedExecutor:
+    """Policy wrapper over the static ``Executor``.
+
+    >>> gexe = GuardedExecutor(policy=RecoveryPolicy(on_nonfinite="skip_step"))
+    >>> gexe.run(startup)
+    >>> out = gexe.run(prog, feed=..., fetch_list=[loss])  # None if skipped
+
+    ``found_inf_var``: name of an on-device bool var (e.g. the static AMP
+    pass's ``"@amp@found_inf"``) fetched alongside the user's fetch_list
+    for detection; without it, detection falls back to a host-side scan
+    of the fetched arrays. The scan cannot tell a fault from a fetch
+    that LEGITIMATELY contains inf (an additive attention mask, a
+    log-prob of an impossible class) — fetching one of those under a
+    skip/rollback policy would discard every step. For such programs
+    pass ``found_inf_var`` (authoritative, scan suppressed) or
+    ``scan_fetches=False``.
+
+    ``scan_state`` (default True, suppressed by ``found_inf_var``): also
+    finite-check the step's COMMITTED persistables on device. The
+    fetched values are computed from pre-update state, so without this a
+    fault that first lands in the committed update (a NaN learning rate,
+    a grad overflow under a finite loss) is seen one step late — after
+    the poisoned weights were snapshotted as "good", which would make
+    skip/rollback restore poison forever. Costs one small device sync
+    per persistable per run; ``scan_state=False`` opts out.
+
+    Every guarded run of a non-empty program snapshots the persistable
+    state first (device copies): retry and degrade re-attempts restore
+    it before re-running, because a failed execution may already have
+    consumed the donated input buffers (and a post-commit failure must
+    not double-apply the update).
+    """
+
+    def __init__(self, executor=None, policy=None, found_inf_var=None,
+                 scan_fetches=True, scan_state=True):
+        if executor is None:
+            from ..static_.executor import Executor
+
+            executor = Executor()
+        self.executor = executor
+        self.policy = policy or RecoveryPolicy()
+        self.found_inf_var = found_inf_var
+        self.scan_fetches = bool(scan_fetches)
+        self.scan_state = bool(scan_state)
+        self.stats = GuardStats()
+        self._last_good = None
+        self._degraded = False
+
+    # -- persistable-state snapshots -----------------------------------------
+    @staticmethod
+    def _persist_names(program):
+        """ALL persistables, including @amp@* loss-scaling state: the
+        retry/degrade restore must reinstate every donated buffer a
+        failed attempt consumed. The nonfinite-policy restore filters
+        @amp@* back OUT (see _restore's keep_amp) so a skipped step
+        retains the loss-scale shrink the in-program machinery applied
+        — or the same overflow would just repeat."""
+        base = getattr(program, "_program", program)
+        return [v.name for v in base.global_block.vars.values()
+                if v.persistable]
+
+    def _take_snapshot(self, names, scope):
+        return {n: _copy_tree(scope.find_var(n)) for n in names
+                if scope.find_var(n) is not None}
+
+    def _restore(self, snap, scope, keep_amp=False):
+        """``keep_amp``: leave the live @amp@* loss-scaling state in
+        place (nonfinite skip/rollback — the in-program scale shrink
+        must survive the restore). The retry path restores EVERYTHING:
+        a failed attempt consumed the donated @amp@ buffers too."""
+        for n, a in snap.items():
+            if keep_amp and n.startswith("@amp@"):
+                continue
+            scope.set(n, _copy_tree(a))
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            optimize_level=None, **kw):
+        from ..static_.program import default_main_program, global_scope
+
+        pol = self.policy
+        if program is None:
+            program = default_main_program()
+        scope = scope or global_scope()
+        fetch_list = list(fetch_list or [])
+        n_user_fetch = len(fetch_list)
+        base = getattr(program, "_program", program)
+        if self.found_inf_var is not None and \
+                base.global_block.has_var(self.found_inf_var):
+            fetch_list.append(self.found_inf_var)
+
+        level = 0 if self._degraded else optimize_level
+        guard_state = bool(base.global_block.ops)
+        names = self._persist_names(program) if guard_state else []
+        # snapshot EVERY guarded run (not just non-raise policies): a
+        # failed execution may have consumed the donated input buffers,
+        # so any retry/degrade re-attempt must first restore the state
+        pre = self._take_snapshot(names, scope) if guard_state else None
+        # NOTE: _last_good is only ever seeded from a committed state
+        # that passed the scan (below); a pre-run snapshot taken before
+        # the scope is populated (e.g. through a startup program) could
+        # be EMPTY, and restoring {} on rollback would recover nothing
+
+        def restore_pre():
+            if pre is not None:
+                self._restore(pre, scope)
+
+        def attempt(lvl):
+            def call():
+                return self.executor.run(
+                    program, feed=feed, fetch_list=fetch_list, scope=scope,
+                    optimize_level=lvl, **kw)
+            return retry_call(call, pol, before_retry=restore_pre)
+
+        try:
+            fetches, attempts = attempt(level)
+        except pol.retryable:
+            raise  # transient retry budget exhausted: a real outage
+        except Exception as err:
+            resolved = level if level is not None else \
+                getattr(self.executor, "optimize_level", 1)
+            if not (pol.degrade_opt_level and int(resolved) != 0):
+                raise
+            restore_pre()  # the failed optimized attempt may have
+            try:            # consumed buffers or half-committed updates
+                fetches, attempts = attempt(0)
+            except Exception:
+                raise err  # level 0 fails too: the pipeline wasn't at fault
+            warnings.warn(
+                f"optimized program (optimize_level={resolved}) failed "
+                f"({type(err).__name__}: {err}) but optimize_level=0 "
+                "succeeds; degrading this GuardedExecutor to level 0 for "
+                "subsequent runs", RuntimeWarning)
+            self._degraded = True
+            self.stats.degraded += 1
+        self.stats.retries += attempts - 1
+
+        if len(fetch_list) > n_user_fetch:  # the appended found_inf var
+            # the on-device flag is authoritative: a False verdict must
+            # NOT be second-guessed by the host-side scan, or fetches
+            # that legitimately contain inf (masks, log-probs) would
+            # make every step read as faulty
+            found_inf = bool(np.asarray(
+                getattr(fetches[-1], "_data", fetches[-1])))
+            fetches = fetches[:n_user_fetch]
+        else:
+            found_inf = self.scan_fetches and _nonfinite_fetches(fetches)
+            if not found_inf and self.scan_state and guard_state:
+                found_inf = _nonfinite_state(scope, names)
+
+        if found_inf:
+            self.stats.nonfinite += 1
+            if pol.on_nonfinite == "raise":
+                raise NanInfError(
+                    "nonfinite value in fetched results or committed "
+                    "state (policy: raise); re-run under "
+                    "RecoveryPolicy(on_nonfinite='skip_step' or "
+                    "'rollback') to recover instead")
+            if pol.on_nonfinite == "skip_step":
+                self._restore(pre, scope, keep_amp=True)
+                self.stats.skipped += 1
+            else:
+                # no verified-good snapshot yet (first steps, or coarse
+                # cadence): this run's pre-state IS the last good state —
+                # it is the committed state of the previous run, which
+                # passed the scan
+                self._restore(self._last_good if self._last_good
+                              else pre, scope, keep_amp=True)
+                self.stats.rollbacks += 1
+            return None
+        if guard_state:  # an empty (startup) program is not a step
+            self.stats.steps += 1
+            if pol.on_nonfinite == "rollback" and \
+                    self.stats.steps % pol.snapshot_every == 0:
+                self._last_good = self._take_snapshot(names, scope)
+        return fetches

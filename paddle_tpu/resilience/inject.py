@@ -1,0 +1,432 @@
+"""Deterministic fault injection: named chaos points.
+
+The reference stack is hardened by operational practice (the fleet HA
+utilities, ``FLAGS_check_nan_inf``, checkpoint hygiene in
+``fluid/incubate/checkpoint``); none of it is *testable* without a way to
+make the faults happen on demand. This module is that way: a registry of
+seed-driven injectors, one per fault class the runtime can hit in a long
+pod job —
+
+- ``nan_op``        corrupt an eager op's output to NaN/Inf (dispatch hook)
+- ``nan_feed``      corrupt one element of a fed batch at step N
+- ``transient_compile`` / ``transient_execute``
+                    raise a retryable error from ``Executor._compile`` /
+                    the compiled step's invocation, N times then heal
+- ``opt_compile_fail``  non-transient failure only when ``optimize_level>0``
+                    (exercises graceful degradation to level 0)
+- ``ckpt_crash``    die between writing a checkpoint and publishing it
+                    (leaves an orphaned ``.tmp_ckpt_*`` dir)
+- ``ckpt_truncate`` / ``ckpt_bitflip``
+                    corrupt a published checkpoint file
+- ``loader_worker`` kill a DataLoader prefetch worker thread mid-batch
+
+Activation is explicit and scoped: the ``chaos("point", ...)`` context
+manager, or the ``PADDLE_TPU_CHAOS`` env var
+(``"point:key=val,key=val;point2"``) for whole-process runs such as
+``tools/chaos_run.py``. When nothing is active, ``ACTIVE`` is an empty
+dict and every production hook is a single ``if not ACTIVE`` — no device
+sync, no allocation, nothing on the hot path.
+
+Determinism: an injector fires on hit indices ``at .. at+times-1`` of its
+chaos point (hits are counted per activation, under a lock) and any
+randomness (which element / bit to flip) comes from
+``np.random.RandomState(seed + hit)``. The same (at, times, seed) config
+always breaks the same run the same way — a chaos test failure replays.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+import numpy as np
+
+__all__ = [
+    "ChaosError", "TransientChaosError", "WorkerCrashChaos",
+    "SimulatedCrashError", "Injector", "INJECTORS", "ACTIVE",
+    "register_injector", "chaos", "fire", "clear", "install_from_env",
+]
+
+
+class ChaosError(RuntimeError):
+    """Base class for every injected fault."""
+
+
+class TransientChaosError(ChaosError):
+    """Injected fault that models a *retryable* infrastructure error
+    (preempted compile RPC, flaky ICI link): recovery layers treat it
+    like ``resilience.policy.TransientError``."""
+
+
+class WorkerCrashChaos(ChaosError):
+    """Injected fault that kills a DataLoader worker thread (escapes the
+    per-batch error capture on purpose)."""
+
+
+class SimulatedCrashError(ChaosError):
+    """The process 'died' at the injection point (e.g. mid-checkpoint)."""
+
+
+INJECTORS: dict[str, type] = {}  # point name -> injector class
+ACTIVE: dict[str, "Injector"] = {}  # point name -> live injector
+
+
+def register_injector(name):
+    def deco(cls):
+        cls.point = name
+        INJECTORS[name] = cls
+        return cls
+    return deco
+
+
+class Injector:
+    """One configured fault. Fires on hit indices at..at+times-1."""
+
+    point = None
+
+    def __init__(self, at=1, times=1, seed=0, **cfg):
+        self.at = int(at)
+        self.times = int(times)
+        self.seed = int(seed)
+        self.cfg = cfg
+        self.hits = 0
+        self.fired = 0
+        self._lock = threading.Lock()
+
+    def should_fire(self):
+        with self._lock:
+            self.hits += 1
+            if self.hits >= self.at and self.fired < self.times:
+                self.fired += 1
+                return True
+            return False
+
+    def _eligible(self):
+        """Count the hit but DON'T consume firing budget yet — for
+        injectors whose fault may turn out inapplicable at this hit
+        (see ``_commit_fire``). The window stays open until ``times``
+        faults actually landed."""
+        with self._lock:
+            self.hits += 1
+            return self.hits >= self.at and self.fired < self.times
+
+    def _commit_fire(self):
+        with self._lock:
+            self.fired += 1
+
+    def _rng(self):
+        # per-firing stream: firing twice corrupts two different elements
+        return np.random.RandomState(self.seed + self.fired)
+
+    def fire(self, value=None, **ctx):  # pragma: no cover - overridden
+        return value
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(at={self.at}, times={self.times}, "
+                f"seed={self.seed}, hits={self.hits}, fired={self.fired})")
+
+
+def fire(point, value=None, **ctx):
+    """Production-side hook: pass ``value`` through the active injector
+    for ``point`` (which may corrupt it or raise), or return it untouched.
+    Callers guard with ``if ACTIVE:`` so the disabled path is one empty-
+    dict truthiness test."""
+    inj = ACTIVE.get(point)
+    if inj is None:
+        return value
+    return inj.fire(value, **ctx)
+
+
+# -- injectors ---------------------------------------------------------------
+
+
+def _bad_value(kind):
+    return np.inf if str(kind) == "inf" else np.nan
+
+
+@register_injector("nan_feed")
+class NanFeedInjector(Injector):
+    """Corrupt one element of one fed array (dict feed or batch list).
+
+    cfg: ``var`` — feed name (dict) or positional index (list); defaults
+    to the first sorted name / index 0. ``kind`` — "nan" (default) or
+    "inf". The corrupted container is a copy; the caller's arrays are
+    never mutated.
+    """
+
+    @staticmethod
+    def _corruptible(arr):
+        a = np.asarray(arr)
+        return a.dtype.kind == "f" and a.size > 0
+
+    def fire(self, value=None, **ctx):
+        if value is None or not self._eligible():
+            return value
+        # locate the target FIRST: a hit whose feed has no corruptible
+        # target (name typo, int-only feed, empty batch) must not consume
+        # the firing budget — otherwise a drill can 'recover' from a
+        # fault that was never injected
+        if isinstance(value, dict):
+            name = self.cfg.get("var")
+            if name is None:
+                # default target is a USER feed: '@'-prefixed names are
+                # executor internals ('@lr'), and '@' sorts first
+                users = sorted(n for n in value if not n.startswith("@"))
+                name = users[0] if users else None
+            if name not in value or not self._corruptible(value[name]):
+                return value
+            key = name
+            container = dict(value)
+        else:
+            idx = int(self.cfg.get("var", 0))
+            if not (0 <= idx < len(value)) or \
+                    not self._corruptible(value[idx]):
+                return list(value)
+            key = idx
+            container = list(value)
+        self._commit_fire()
+        kind = _bad_value(self.cfg.get("kind", "nan"))
+        a = np.asarray(container[key]).copy()
+        a.ravel()[int(self._rng().randint(a.size))] = kind
+        container[key] = a
+        return container
+
+
+@register_injector("nan_op")
+class NanOpInjector(Injector):
+    """Corrupt an eager op's first floating output (dispatch-level, the
+    chaos twin of ``FLAGS_check_nan_inf``'s detection point).
+
+    cfg: ``op`` — only count hits on this op type (default: every op);
+    ``kind`` — "nan"/"inf".
+    """
+
+    def fire(self, value=None, op_type=None, **ctx):
+        want = self.cfg.get("op")
+        if want is not None and op_type != want:
+            return value
+        if not self._eligible():
+            return value
+        outs = list(value)
+        target = next(
+            (i for i, o in enumerate(outs)
+             if hasattr(o, "dtype") and np.issubdtype(o.dtype, np.floating)
+             and getattr(o, "size", 0)), None)
+        if target is None:
+            return value  # no float output: budget not consumed
+        self._commit_fire()
+        import jax.numpy as jnp
+
+        o = outs[target]
+        flat = jnp.ravel(o)
+        idx = int(self._rng().randint(flat.shape[0]))
+        bad = flat.at[idx].set(_bad_value(self.cfg.get("kind", "nan")))
+        outs[target] = jnp.reshape(bad, o.shape)
+        return tuple(outs)
+
+
+@register_injector("transient_compile")
+class TransientCompileInjector(Injector):
+    """Executor._compile raises a retryable error on the firing hits."""
+
+    def fire(self, value=None, **ctx):
+        if self.should_fire():
+            raise TransientChaosError(
+                f"injected transient compile failure "
+                f"(hit {self.hits}, firing {self.fired}/{self.times})")
+        return value
+
+
+@register_injector("transient_execute")
+class TransientExecuteInjector(Injector):
+    """The compiled step's invocation raises a retryable error."""
+
+    def fire(self, value=None, **ctx):
+        if self.should_fire():
+            raise TransientChaosError(
+                f"injected transient execute failure "
+                f"(hit {self.hits}, firing {self.fired}/{self.times})")
+        return value
+
+
+@register_injector("opt_compile_fail")
+class OptCompileFailInjector(Injector):
+    """Non-transient compile failure ONLY under optimization
+    (optimize_level > 0) — the scenario where degrading to the
+    unoptimized program recovers the run."""
+
+    def fire(self, value=None, optimize_level=0, **ctx):
+        if int(optimize_level) <= 0:
+            return value
+        if self.should_fire():
+            raise ChaosError(
+                f"injected optimizer-pipeline failure at optimize_level="
+                f"{optimize_level}")
+        return value
+
+
+@register_injector("ckpt_crash")
+class CkptCrashInjector(Injector):
+    """Die after writing checkpoint files but BEFORE the atomic publish:
+    the orphaned ``.tmp_ckpt_*`` dir is exactly what a real mid-save
+    crash leaves behind."""
+
+    def fire(self, value=None, **ctx):
+        if self.should_fire():
+            raise SimulatedCrashError(
+                f"simulated crash before checkpoint publish (tmp={value})")
+        return value
+
+
+class _CkptFileCorruptor(Injector):
+    target_default = "model.pdparams"
+
+    def _target(self, ckpt_dir):
+        name = self.cfg.get("file", self.target_default)
+        path = os.path.join(ckpt_dir, name)
+        if not os.path.exists(path):
+            for fn in sorted(os.listdir(ckpt_dir)):
+                if fn != "manifest.json":
+                    return os.path.join(ckpt_dir, fn)
+        return path
+
+    def corrupt(self, path, rng):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def fire(self, value=None, **ctx):
+        if value is None or not self.should_fire():
+            return value
+        path = self._target(value)
+        if path and os.path.exists(path):
+            self.corrupt(path, self._rng())
+        return value
+
+
+@register_injector("ckpt_truncate")
+class CkptTruncateInjector(_CkptFileCorruptor):
+    """Truncate a published checkpoint file to ``fraction`` of its size
+    (default 0.5) — a torn write / out-of-quota artifact."""
+
+    def corrupt(self, path, rng):
+        size = os.path.getsize(path)
+        frac = float(self.cfg.get("fraction", 0.5))
+        with open(path, "r+b") as f:
+            f.truncate(max(0, int(size * frac)))
+
+
+@register_injector("ckpt_bitflip")
+class CkptBitflipInjector(_CkptFileCorruptor):
+    """Flip one seeded bit of a published checkpoint file — silent media
+    corruption that only a checksum can catch."""
+
+    def corrupt(self, path, rng):
+        size = os.path.getsize(path)
+        if size == 0:
+            return
+        off = int(rng.randint(size))
+        bit = 1 << int(rng.randint(8))
+        with open(path, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ bit]))
+
+
+@register_injector("loader_worker")
+class LoaderWorkerInjector(Injector):
+    """Kill a DataLoader prefetch worker thread (the exception escapes
+    the per-batch error capture; the prefetcher's restart budget is the
+    recovery under test)."""
+
+    def fire(self, value=None, **ctx):
+        if self.should_fire():
+            raise WorkerCrashChaos(
+                f"injected loader worker crash (hit {self.hits})")
+        return value
+
+
+# -- activation --------------------------------------------------------------
+
+
+def _sync_hooks():
+    """Propagate ACTIVE into runtimes that need a push-style hook (the
+    eager dispatcher can't afford a cross-module dict probe per op)."""
+    from ..core import dispatch
+
+    if "nan_op" in ACTIVE:
+        dispatch.set_chaos_op_hook(
+            lambda name, outs: fire("nan_op", outs, op_type=name))
+    else:
+        dispatch.set_chaos_op_hook(None)
+
+
+@contextlib.contextmanager
+def chaos(point, **cfg):
+    """Activate one chaos point for the duration of the block.
+
+    >>> with chaos("transient_compile", times=2):
+    ...     guarded.run(prog, feed=..., fetch_list=[loss])
+    """
+    if point not in INJECTORS:
+        raise KeyError(
+            f"unknown chaos point '{point}' (registered: "
+            f"{sorted(INJECTORS)})")
+    inj = INJECTORS[point](**cfg)
+    prev = ACTIVE.get(point)
+    ACTIVE[point] = inj
+    _sync_hooks()
+    try:
+        yield inj
+    finally:
+        if prev is None:
+            ACTIVE.pop(point, None)
+        else:
+            ACTIVE[point] = prev
+        _sync_hooks()
+
+
+def clear():
+    """Deactivate every chaos point."""
+    ACTIVE.clear()
+    _sync_hooks()
+
+
+def _parse_val(s):
+    try:
+        return int(s)
+    except ValueError:
+        try:
+            return float(s)
+        except ValueError:
+            return s
+
+
+def install_from_env(env=None):
+    """Activate chaos points from ``PADDLE_TPU_CHAOS``.
+
+    Format: ``"point:key=val,key=val;point2"`` — e.g.
+    ``PADDLE_TPU_CHAOS="transient_compile:times=2;nan_feed:at=3,seed=1"``.
+    Returns the list of activated points.
+    """
+    spec = env if env is not None else os.environ.get("PADDLE_TPU_CHAOS", "")
+    out = []
+    for entry in filter(None, (e.strip() for e in spec.split(";"))):
+        point, _, rest = entry.partition(":")
+        point = point.strip()
+        cfg = {}
+        for kv in filter(None, (p.strip() for p in rest.split(","))):
+            k, _, v = kv.partition("=")
+            cfg[k.strip()] = _parse_val(v.strip())
+        if point not in INJECTORS:
+            raise KeyError(
+                f"PADDLE_TPU_CHAOS names unknown point '{point}' "
+                f"(registered: {sorted(INJECTORS)})")
+        ACTIVE[point] = INJECTORS[point](**cfg)
+        out.append(point)
+    if out:
+        _sync_hooks()
+    return out
+
+
+if os.environ.get("PADDLE_TPU_CHAOS"):
+    install_from_env()

@@ -1,0 +1,91 @@
+"""Recovery policies: what a detected fault turns into.
+
+The reference reacts to faults operationally (fleet restarts the trainer,
+``FLAGS_check_nan_inf`` aborts, the PS heartbeat re-elects); here the
+policy is an object the guards (``resilience.guard``) interpret:
+
+- ``on_nonfinite`` — what a NaN/Inf training step becomes:
+  ``"raise"`` (abort, the reference flag's behavior), ``"skip_step"``
+  (discard this step's updates and continue — the step contributes
+  nothing, exactly as if its batch had been dropped), or ``"rollback"``
+  (restore the last-good in-memory snapshot, taken every
+  ``snapshot_every`` successful steps).
+- bounded retry-with-backoff for transient compile/execute errors
+  (``TransientError`` and injected ``TransientChaosError``): up to
+  ``max_retries`` retries, sleeping ``backoff * backoff_factor**i``
+  capped at ``max_backoff``.
+- ``degrade_opt_level`` — when an optimized program
+  (``optimize_level>0``) fails to compile/run but the unoptimized one
+  succeeds, fall back to level 0 for the rest of the run instead of
+  dying (a miscompiled pass must never kill a pod job).
+"""
+from __future__ import annotations
+
+import time
+
+from .inject import TransientChaosError
+
+__all__ = ["TransientError", "RecoveryPolicy", "retry_call",
+           "NONFINITE_ACTIONS"]
+
+
+class TransientError(RuntimeError):
+    """A retryable infrastructure error (preempted RPC, flaky link).
+    Raise (or subclass) this to opt an error into the retry path."""
+
+
+NONFINITE_ACTIONS = ("raise", "skip_step", "rollback")
+
+
+class RecoveryPolicy:
+    def __init__(self, on_nonfinite="raise", max_retries=3, backoff=0.05,
+                 backoff_factor=2.0, max_backoff=2.0, snapshot_every=1,
+                 degrade_opt_level=True,
+                 retryable=(TransientError, TransientChaosError),
+                 sleep=None):
+        if on_nonfinite not in NONFINITE_ACTIONS:
+            raise ValueError(
+                f"on_nonfinite must be one of {NONFINITE_ACTIONS}, got "
+                f"{on_nonfinite!r}")
+        self.on_nonfinite = on_nonfinite
+        self.max_retries = int(max_retries)
+        self.backoff = float(backoff)
+        self.backoff_factor = float(backoff_factor)
+        self.max_backoff = float(max_backoff)
+        self.snapshot_every = max(1, int(snapshot_every))
+        self.degrade_opt_level = bool(degrade_opt_level)
+        self.retryable = tuple(retryable)
+        self._sleep = sleep if sleep is not None else time.sleep
+
+    def backoff_for(self, attempt):
+        """Deterministic backoff for retry ``attempt`` (0-based)."""
+        return min(self.backoff * self.backoff_factor ** attempt,
+                   self.max_backoff)
+
+    def __repr__(self):
+        return (f"RecoveryPolicy(on_nonfinite={self.on_nonfinite!r}, "
+                f"max_retries={self.max_retries}, "
+                f"degrade_opt_level={self.degrade_opt_level})")
+
+
+def retry_call(fn, policy=None, describe="", before_retry=None):
+    """Call ``fn()`` with the policy's bounded retry-with-backoff.
+
+    Returns ``(result, attempts)`` where attempts >= 1. Non-retryable
+    exceptions propagate immediately; a retryable one propagates only
+    after the retry budget is exhausted. ``before_retry`` (if given)
+    runs before each re-attempt — the hook where a guard restores state
+    a failed attempt may have consumed (e.g. donated device buffers).
+    """
+    policy = policy or RecoveryPolicy()
+    attempt = 0
+    while True:
+        try:
+            return fn(), attempt + 1
+        except policy.retryable:
+            if attempt >= policy.max_retries:
+                raise
+            policy._sleep(policy.backoff_for(attempt))
+            if before_retry is not None:
+                before_retry()
+            attempt += 1

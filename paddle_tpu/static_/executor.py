@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..resilience import inject as _chaos
 from .program import (Program, default_main_program, global_scope)
 
 __all__ = ["Executor"]
@@ -93,6 +94,9 @@ class Executor:
 
         if optimize_level is None:
             optimize_level = self.optimize_level
+        if _chaos.ACTIVE:  # chaos points: transient / optimized-only failure
+            _chaos.fire("transient_compile")
+            _chaos.fire("opt_compile_fail", optimize_level=optimize_level)
         feed_names = tuple(sorted(feed))
         fetch_names, _ = normalize_fetch(fetch_list)
         shapes = tuple(
@@ -246,6 +250,9 @@ class Executor:
             program, feed, fetch_list, data_parallel=data_parallel,
             allow_replicated_fallback=allow_replicated_fallback,
             optimize_level=optimize_level)
+        if _chaos.ACTIVE:  # disabled => one empty-dict test, no host sync
+            _chaos.fire("transient_execute")
+            feed = _chaos.fire("nan_feed", feed)
         feeds = [jnp.asarray(np.asarray(feed[n])) for n in compiled.feed_names]
         updated = [scope.find_var(n) for n in compiled.updated]
         frozen = [scope.find_var(n) for n in compiled.frozen]

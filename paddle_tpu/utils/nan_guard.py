@@ -17,13 +17,49 @@ import numpy as np
 import jax.numpy as jnp
 
 __all__ = ["enable_check_nan", "disable_check_nan", "check_nan_enabled",
-           "check_numerics", "NanInfError"]
+           "check_numerics", "NanInfError", "nonfinite_summary"]
 
 _ENABLED = False
 
 
 class NanInfError(FloatingPointError):
-    pass
+    """Nonfinite value detected. ``summary`` (also attached as an
+    attribute) carries the bounded postmortem record built by
+    ``nonfinite_summary``: counts, first bad flat index, finite range —
+    the producing values are gone by the time the error unwinds, so this
+    is what makes the report actionable."""
+
+    def __init__(self, msg, summary=None):
+        super().__init__(msg)
+        self.summary = dict(summary or {})
+
+
+def nonfinite_summary(value):
+    """Bounded description of the nonfinite content of one array: counts,
+    the first bad flat index, and the finite min/max — O(n) scan, O(1)
+    output, so it is safe to compute on any tensor at fault time."""
+    a = np.asarray(value)  # ONE device->host transfer
+    dtype = str(a.dtype)
+    if a.dtype.kind != "f":
+        a = a.astype(np.float64)
+    bad = ~np.isfinite(a)
+    n_bad = int(bad.sum())
+    finite = a[~bad]
+    return {
+        "shape": tuple(a.shape),
+        "dtype": dtype,
+        "num_nan": int(np.isnan(a).sum()),
+        "num_inf": int(np.isinf(a).sum()),
+        "first_bad_index": int(np.argmax(bad.ravel())) if n_bad else -1,
+        "finite_min": float(finite.min()) if finite.size else None,
+        "finite_max": float(finite.max()) if finite.size else None,
+    }
+
+
+def _summary_text(s):
+    return (f"nan={s['num_nan']} inf={s['num_inf']} "
+            f"first_bad_flat_index={s['first_bad_index']} "
+            f"finite_range=[{s['finite_min']}, {s['finite_max']}]")
 
 
 def enable_check_nan():
@@ -64,21 +100,23 @@ def check_numerics(value, name="tensor"):
                 walk(x, f"{path}[{i}]")
         elif hasattr(v, "dtype"):
             if _bad(v):
-                n_nan = int(jnp.sum(jnp.isnan(v)))
-                n_inf = int(jnp.sum(jnp.isinf(v)))
+                s = nonfinite_summary(v)
                 raise NanInfError(
                     f"NaN/Inf found in {path}: shape={tuple(v.shape)} "
-                    f"nan={n_nan} inf={n_inf}")
+                    f"{_summary_text(s)}", summary=s)
 
     walk(value, name)
     return value
 
 
 def check_op_outputs(name, outs):
-    """Dispatcher hook: eager per-op check (debug flag on)."""
+    """Dispatcher hook: eager per-op check (debug flag on). Raises on the
+    FIRST nonfinite op with a bounded summary of the producing values —
+    they are freed once the error unwinds, so this is the postmortem."""
     for i, o in enumerate(outs):
         if hasattr(o, "dtype") and _bad(o):
+            s = nonfinite_summary(o)
             raise NanInfError(
                 f"op '{name}' produced NaN/Inf in output {i} "
-                f"(shape={tuple(o.shape)}) — reference analog: "
-                f"FLAGS_check_nan_inf")
+                f"(shape={tuple(o.shape)}) {_summary_text(s)} — "
+                f"reference analog: FLAGS_check_nan_inf", summary=s)

@@ -1,0 +1,639 @@
+"""Chaos suite for paddle_tpu.resilience (ISSUE 2 tentpole).
+
+One recovery test per injected fault class; where the policy promises
+equivalence, the recovered run is compared against an un-faulted
+reference BITWISE (skip_step == "that batch never happened" for RNG-free
+models; retry/degrade/checkpoint-fallback == identical results).
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optim as optim
+from paddle_tpu import resilience
+from paddle_tpu.framework.io import (CheckpointError, load_checkpoint,
+                                     save_checkpoint, verify_checkpoint)
+from paddle_tpu.io_.dataloader import DataLoader
+from paddle_tpu.io_.dataset import Dataset
+from paddle_tpu.resilience import (GuardedExecutor, GuardedStep,
+                                   RecoveryPolicy, inject)
+from paddle_tpu.utils import nan_guard
+
+pytestmark = pytest.mark.chaos
+
+NOSLEEP = dict(sleep=lambda s: None)
+
+
+# -- helpers -----------------------------------------------------------------
+
+
+def _eager_step(lr=0.1, **step_kw):
+    pt.seed(0)
+    m = nn.Linear(4, 1)
+    opt = optim.SGD(learning_rate=lr, parameters=m.parameters())
+
+    def loss_fn(model, x, y):
+        return F.mse_loss(model(x), y)
+
+    return m, pt.TrainStep(m, opt, loss_fn, check_nan=True, **step_kw)
+
+
+def _batches(steps, batch=8, dim=4):
+    rng = np.random.RandomState(0)
+    return [(rng.randn(batch, dim).astype(np.float32),
+             rng.randn(batch, 1).astype(np.float32)) for _ in range(steps)]
+
+
+def _weights_after(skip_index=None, steps=6):
+    """Un-faulted reference run, optionally omitting one batch."""
+    m, step = _eager_step()
+    for i, (x, y) in enumerate(_batches(steps)):
+        if i != skip_index:
+            step(x, y)
+    return np.asarray(m.weight._data)
+
+
+@pytest.fixture
+def static_mode():
+    pt.enable_static()
+    yield
+    pt.disable_static()
+
+
+def _build_static(batch=8):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[batch, 4])
+        y = fluid.data(name="y", shape=[batch, 1])
+        out = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(out, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return prog, startup, loss
+
+
+def _static_losses(gexe, steps=3, skip_index=None):
+    pt.seed(0)
+    prog, startup, loss = _build_static()
+    gexe.run(startup)
+    out = []
+    for i, (x, y) in enumerate(_batches(steps)):
+        if i == skip_index:
+            continue
+        r = gexe.run(prog, feed={"x": x, "y": y}, fetch_list=[loss])
+        out.append(None if r is None else float(np.asarray(r[0])))
+    return out
+
+
+# -- nonfinite step x each policy (fault class: nan_feed) --------------------
+
+
+class TestNanStepPolicies:
+    def test_policy_raise_aborts(self):
+        _, step = _eager_step()
+        guard = GuardedStep(step, RecoveryPolicy(on_nonfinite="raise",
+                                                 **NOSLEEP))
+        data = _batches(4)
+        with inject.chaos("nan_feed", at=2, seed=7):
+            guard(*data[0])
+            with pytest.raises(nan_guard.NanInfError):
+                guard(*data[1])
+
+    def test_policy_skip_matches_batch_omitted_run(self):
+        m, step = _eager_step()
+        guard = GuardedStep(step, RecoveryPolicy(on_nonfinite="skip_step",
+                                                 **NOSLEEP))
+        with inject.chaos("nan_feed", at=3, seed=7):
+            for x, y in _batches(6):
+                guard(x, y)
+        assert guard.stats.skipped == 1 and guard.stats.steps == 5
+        ref = _weights_after(skip_index=2)  # at=3 => 3rd step poisoned
+        assert np.array_equal(np.asarray(m.weight._data), ref), \
+            "skip_step must be bitwise 'that batch never happened'"
+
+    def test_policy_rollback_matches_with_unit_cadence(self):
+        m, step = _eager_step()
+        guard = GuardedStep(step, RecoveryPolicy(
+            on_nonfinite="rollback", snapshot_every=1, **NOSLEEP))
+        with inject.chaos("nan_feed", at=3, seed=7):
+            for x, y in _batches(6):
+                guard(x, y)
+        assert guard.stats.rollbacks == 1
+        ref = _weights_after(skip_index=2)
+        assert np.array_equal(np.asarray(m.weight._data), ref)
+
+    def test_rollback_on_first_step_falls_back_to_prestep_state(self):
+        """A NaN on the very first guarded step, before any verified-good
+        snapshot exists, must restore the pre-step state (not a missing/
+        empty last-good snapshot) and keep training."""
+        m, step = _eager_step()
+        guard = GuardedStep(step, RecoveryPolicy(
+            on_nonfinite="rollback", snapshot_every=3, **NOSLEEP))
+        with inject.chaos("nan_feed", at=1, seed=7):
+            for x, y in _batches(6):
+                guard(x, y)
+        assert guard.stats.rollbacks == 1
+        ref = _weights_after(skip_index=0)
+        assert np.array_equal(np.asarray(m.weight._data), ref)
+
+    def test_policy_rollback_coarse_cadence_loses_to_last_snapshot(self):
+        """snapshot_every=2: the rollback restores the older snapshot —
+        the run completes and ends finite (exact value is the cadence
+        trade-off, documented rather than promised)."""
+        m, step = _eager_step()
+        guard = GuardedStep(step, RecoveryPolicy(
+            on_nonfinite="rollback", snapshot_every=2, **NOSLEEP))
+        with inject.chaos("nan_feed", at=4, seed=7):
+            for x, y in _batches(6):
+                guard(x, y)
+        assert guard.stats.rollbacks == 1
+        assert np.isfinite(np.asarray(m.weight._data)).all()
+
+    def test_skipped_step_advances_gradscaler(self):
+        from paddle_tpu.amp import GradScaler
+
+        sc = GradScaler(init_loss_scaling=1024.0, decr_ratio=0.5,
+                        decr_every_n_nan_or_inf=1)
+        _, step = _eager_step()
+        guard = GuardedStep(step, RecoveryPolicy(on_nonfinite="skip_step",
+                                                 **NOSLEEP), scaler=sc)
+        with inject.chaos("nan_feed", at=1, seed=7):
+            assert guard(*_batches(1)[0]) is None
+        assert sc.loss_scaling == 512.0  # notify_skip shrank the scale
+        assert sc.state_dict()["bad_steps"] == 0  # decr reset after shrink
+
+    def test_guard_requires_nonfinite_flag(self):
+        m = nn.Linear(4, 1)
+        opt = optim.SGD(learning_rate=0.1, parameters=m.parameters())
+        step = pt.TrainStep(m, opt, lambda mm, x, y: F.mse_loss(mm(x), y))
+        with pytest.raises(ValueError, match="check_nan"):
+            GuardedStep(step, RecoveryPolicy(on_nonfinite="skip_step"))
+
+
+# -- eager per-op corruption (fault class: nan_op) ---------------------------
+
+
+class TestNanOpDetection:
+    def test_injected_op_corruption_detected_with_summary(self):
+        x = pt.to_tensor(np.full((3, 3), 2.0, np.float32))
+        nan_guard.enable_check_nan()
+        try:
+            with inject.chaos("nan_op", op="matmul", seed=1):
+                with pytest.raises(nan_guard.NanInfError) as ei:
+                    pt.matmul(x, x)
+        finally:
+            nan_guard.disable_check_nan()
+        s = ei.value.summary
+        assert s["num_nan"] == 1 and s["num_inf"] == 0
+        assert 0 <= s["first_bad_index"] < 9
+        assert s["finite_min"] == s["finite_max"] == 12.0
+
+    def test_nan_summary_fields(self):
+        a = np.array([1.0, np.nan, -np.inf, 4.0], np.float32)
+        s = nan_guard.nonfinite_summary(a)
+        assert s["num_nan"] == 1 and s["num_inf"] == 1
+        assert s["first_bad_index"] == 1
+        assert s["finite_min"] == 1.0 and s["finite_max"] == 4.0
+        with pytest.raises(nan_guard.NanInfError) as ei:
+            nan_guard.check_numerics(a, "grads")
+        assert ei.value.summary["num_nan"] == 1
+        assert "first_bad_flat_index=1" in str(ei.value)
+
+
+# -- transient compile/execute (retry) + optimized-compile degrade -----------
+
+
+class TestTransientRecovery:
+    def test_transient_compile_retry_matches_clean(self, static_mode):
+        clean = _static_losses(GuardedExecutor(
+            policy=RecoveryPolicy(**NOSLEEP)))
+        gexe = GuardedExecutor(policy=RecoveryPolicy(**NOSLEEP))
+        with inject.chaos("transient_compile", times=2):
+            faulted = _static_losses(gexe)
+        assert faulted == clean
+        assert gexe.stats.retries == 2
+
+    def test_transient_execute_retry_matches_clean(self, static_mode):
+        clean = _static_losses(GuardedExecutor(
+            policy=RecoveryPolicy(**NOSLEEP)))
+        gexe = GuardedExecutor(policy=RecoveryPolicy(**NOSLEEP))
+        with inject.chaos("transient_execute", times=2):
+            faulted = _static_losses(gexe)
+        assert faulted == clean
+        assert gexe.stats.retries == 2
+
+    def test_retry_budget_exhaustion_raises(self, static_mode):
+        gexe = GuardedExecutor(policy=RecoveryPolicy(max_retries=1,
+                                                     **NOSLEEP))
+        with inject.chaos("transient_compile", times=10):
+            with pytest.raises(inject.TransientChaosError):
+                _static_losses(gexe)
+
+    def test_opt_level_degradation(self, static_mode):
+        clean = _static_losses(GuardedExecutor(
+            policy=RecoveryPolicy(degrade_opt_level=False, **NOSLEEP)))
+        gexe = GuardedExecutor(policy=RecoveryPolicy(**NOSLEEP))
+        with inject.chaos("opt_compile_fail", times=100):
+            with pytest.warns(RuntimeWarning, match="optimize_level=0"):
+                faulted = _static_losses(gexe)
+        assert faulted == clean
+        assert gexe.stats.degraded == 1 and gexe._degraded
+
+    def test_retry_backoff_is_bounded_and_deterministic(self):
+        pol = RecoveryPolicy(backoff=0.1, backoff_factor=2.0,
+                             max_backoff=0.25)
+        assert [pol.backoff_for(i) for i in range(4)] == \
+            [0.1, 0.2, 0.25, 0.25]
+        slept = []
+        pol2 = RecoveryPolicy(max_retries=2, backoff=0.1,
+                              sleep=slept.append)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise resilience.TransientError("flap")
+            return "ok"
+
+        out, attempts = resilience.retry_call(flaky, pol2)
+        assert out == "ok" and attempts == 3 and len(slept) == 2
+
+
+# -- static-path nonfinite policy (GuardedExecutor) --------------------------
+
+
+class TestStaticNonfinitePolicy:
+    def test_skip_step_matches_batch_omitted_run(self, static_mode):
+        ref = _static_losses(GuardedExecutor(
+            policy=RecoveryPolicy(**NOSLEEP)), steps=4, skip_index=1)
+        gexe = GuardedExecutor(policy=RecoveryPolicy(
+            on_nonfinite="skip_step", **NOSLEEP))
+        with inject.chaos("nan_feed", at=2, seed=3, var="x"):
+            faulted = _static_losses(gexe, steps=4)
+        assert gexe.stats.skipped == 1
+        # drop the skipped-step None: remaining losses must be bitwise
+        # identical to the run that never saw that batch
+        assert [v for v in faulted if v is not None] == ref
+
+    def test_raise_policy_raises_on_nan_fetch(self, static_mode):
+        gexe = GuardedExecutor(policy=RecoveryPolicy(**NOSLEEP))
+        with inject.chaos("nan_feed", at=1, seed=3, var="x"):
+            with pytest.raises(nan_guard.NanInfError):
+                _static_losses(gexe, steps=1)
+
+    def test_default_nan_feed_target_skips_internal_lr_feed(self,
+                                                           static_mode):
+        """With no var= config the injector must poison a USER feed, not
+        the executor's internal '@lr' (which sorts first): the default
+        drill then behaves like test_skip_step_matches_batch_omitted_run."""
+        ref = _static_losses(GuardedExecutor(
+            policy=RecoveryPolicy(**NOSLEEP)), steps=4, skip_index=1)
+        gexe = GuardedExecutor(policy=RecoveryPolicy(
+            on_nonfinite="skip_step", **NOSLEEP))
+        with inject.chaos("nan_feed", at=2, seed=3) as inj:
+            faulted = _static_losses(gexe, steps=4)
+        assert inj.fired == 1
+        assert gexe.stats.skipped == 1
+        assert [v for v in faulted if v is not None] == ref
+
+    def test_fault_in_committed_state_detected_same_step(self,
+                                                         static_mode):
+        """A NaN learning rate poisons the committed weights while the
+        fetched loss (computed from PRE-update state) stays finite. The
+        state scan must catch it the SAME step — one step late, the
+        guard would snapshot the poisoned weights as 'good' and then
+        restore poison forever."""
+        ref = _static_losses(GuardedExecutor(
+            policy=RecoveryPolicy(**NOSLEEP)), steps=5, skip_index=1)
+        gexe = GuardedExecutor(policy=RecoveryPolicy(
+            on_nonfinite="skip_step", **NOSLEEP))
+        with inject.chaos("nan_feed", at=2, var="@lr"):
+            faulted = _static_losses(gexe, steps=5)
+        assert gexe.stats.skipped == 1, gexe.stats
+        # run recovered: later steps train normally and match the
+        # reference in which that (no-effect) step never happened
+        assert [v for v in faulted if v is not None] == ref
+
+    def test_static_rollback_before_first_refresh_uses_pre(self,
+                                                           static_mode):
+        """Executor-path twin of the first-step rollback fallback: with a
+        coarse cadence, a fault before any verified-good snapshot exists
+        restores this run's pre-state instead of livelocking on an
+        empty last-good."""
+        ref = _static_losses(GuardedExecutor(
+            policy=RecoveryPolicy(**NOSLEEP)), steps=4, skip_index=0)
+        gexe = GuardedExecutor(policy=RecoveryPolicy(
+            on_nonfinite="rollback", snapshot_every=10, **NOSLEEP))
+        with inject.chaos("nan_feed", at=1, seed=3, var="x"):
+            faulted = _static_losses(gexe, steps=4)
+        assert gexe.stats.rollbacks == 1 and gexe.stats.steps == 3
+        assert [v for v in faulted if v is not None] == ref
+
+    def test_scan_state_opt_out(self, static_mode):
+        """scan_state=False restores the documented fetch-only detection
+        for programs whose fetches legitimately contain inf."""
+        gexe = GuardedExecutor(policy=RecoveryPolicy(
+            on_nonfinite="skip_step", **NOSLEEP), scan_state=False)
+        with inject.chaos("nan_feed", at=2, var="@lr"):
+            faulted = _static_losses(gexe, steps=2)
+        # the NaN-lr step's finite fetch passes; only the NEXT step's
+        # NaN fetch trips detection — the documented trade-off
+        assert faulted[-1] is None or gexe.stats.skipped == 0
+
+
+# -- checkpoint integrity ----------------------------------------------------
+
+
+def _age_tmp(path, secs=3600):
+    """Backdate a tmp artifact past the orphan-cleanup grace period."""
+    t = time.time() - secs
+    for f in [path] + [os.path.join(path, f) for f in os.listdir(path)]:
+        os.utime(f, (t, t))
+
+
+def _ckpt_pair(tmp_path):
+    """Two checkpoints; returns (dir, weights at step 1, weights at 2)."""
+    pt.seed(0)
+    m = nn.Linear(4, 2)
+    opt = optim.SGD(learning_rate=0.1, parameters=m.parameters())
+    save_checkpoint(str(tmp_path), 1, model=m, optimizer=opt)
+    w1 = np.asarray(m.weight._data).copy()
+    m.weight._data = m.weight._data + 1.0
+    save_checkpoint(str(tmp_path), 2, model=m, optimizer=opt)
+    return str(tmp_path), w1, np.asarray(m.weight._data).copy()
+
+
+class TestCheckpointIntegrity:
+    def test_manifest_written_and_verifies(self, tmp_path):
+        d, _, _ = _ckpt_pair(tmp_path)
+        path = os.path.join(d, "ckpt_2")
+        assert os.path.exists(os.path.join(path, "manifest.json"))
+        ok, problems = verify_checkpoint(path)
+        assert ok and not problems
+
+    @pytest.mark.parametrize("point,cfg", [
+        ("ckpt_truncate", {}),
+        ("ckpt_bitflip", {"seed": 5}),
+    ])
+    def test_corrupt_newest_falls_back(self, tmp_path, point, cfg):
+        pt.seed(0)
+        m = nn.Linear(4, 2)
+        opt = optim.SGD(learning_rate=0.1, parameters=m.parameters())
+        save_checkpoint(str(tmp_path), 1, model=m, optimizer=opt)
+        w1 = np.asarray(m.weight._data).copy()
+        m.weight._data = m.weight._data + 1.0
+        with inject.chaos(point, **cfg):
+            save_checkpoint(str(tmp_path), 2, model=m, optimizer=opt)
+        ok, problems = verify_checkpoint(os.path.join(str(tmp_path),
+                                                      "ckpt_2"))
+        assert not ok and problems
+        m2 = nn.Linear(4, 2)
+        with pytest.warns(RuntimeWarning, match="falling back"):
+            step = load_checkpoint(str(tmp_path), model=m2)
+        assert step == 1
+        assert np.array_equal(np.asarray(m2.weight._data), w1)
+
+    def test_crashed_save_leaves_orphan_then_cleaned(self, tmp_path):
+        pt.seed(0)
+        m = nn.Linear(4, 2)
+        save_checkpoint(str(tmp_path), 1, model=m)
+        w1 = np.asarray(m.weight._data).copy()
+        with inject.chaos("ckpt_crash"):
+            with pytest.raises(resilience.SimulatedCrashError):
+                save_checkpoint(str(tmp_path), 2, model=m)
+        orphans = [f for f in os.listdir(str(tmp_path))
+                   if f.startswith(".tmp_ckpt_")]
+        assert orphans
+        # a FRESH tmp dir may belong to a live concurrent saver: the
+        # loader must leave it alone...
+        m2 = nn.Linear(4, 2)
+        step = load_checkpoint(str(tmp_path), model=m2)
+        assert step == 1
+        assert np.array_equal(np.asarray(m2.weight._data), w1)
+        assert any(f.startswith(".tmp_ckpt_")
+                   for f in os.listdir(str(tmp_path)))
+        # ...but once it has gone stale (no writes for the grace period)
+        # it is a crash artifact and gets cleaned
+        _age_tmp(os.path.join(str(tmp_path), orphans[0]))
+        with pytest.warns(RuntimeWarning, match="orphaned"):
+            assert load_checkpoint(str(tmp_path), model=m2) == 1
+        assert not any(f.startswith(".tmp_ckpt_")
+                       for f in os.listdir(str(tmp_path)))
+
+    def test_garbage_dirs_ignored(self, tmp_path):
+        d, w1, w2 = _ckpt_pair(tmp_path)
+        os.makedirs(os.path.join(d, "ckpt_latest"))  # non-numeric garbage
+        os.makedirs(os.path.join(d, "ckpt_1x2"))
+        m2 = nn.Linear(4, 2)
+        with pytest.warns(RuntimeWarning, match="non-checkpoint"):
+            step = load_checkpoint(d, model=m2)
+        assert step == 2
+        assert np.array_equal(np.asarray(m2.weight._data), w2)
+
+    def test_all_corrupt_raises_not_silent_restart(self, tmp_path):
+        d, _, _ = _ckpt_pair(tmp_path)
+        for name in ("ckpt_1", "ckpt_2"):
+            p = os.path.join(d, name, "model.pdparams")
+            with open(p, "r+b") as f:
+                f.truncate(10)
+        with pytest.warns(RuntimeWarning):
+            with pytest.raises(CheckpointError, match="every checkpoint"):
+                load_checkpoint(d, model=nn.Linear(4, 2))
+
+    def test_explicit_step_corrupt_raises(self, tmp_path):
+        d, _, _ = _ckpt_pair(tmp_path)
+        p = os.path.join(d, "ckpt_2", "model.pdparams")
+        with open(p, "r+b") as f:
+            f.truncate(10)
+        with pytest.raises(CheckpointError):
+            load_checkpoint(d, model=nn.Linear(4, 2), step=2)
+        with pytest.raises(CheckpointError, match="no checkpoint for step"):
+            load_checkpoint(d, step=99)
+
+    def test_malformed_but_valid_json_manifest_falls_back(self, tmp_path):
+        """A bit-flip can leave manifest.json parseable with a broken
+        shape: that must read as 'corrupt checkpoint' (fallback), not
+        crash the loader with KeyError."""
+        import json
+
+        d, w1, _ = _ckpt_pair(tmp_path)
+        mpath = os.path.join(d, "ckpt_2", "manifest.json")
+        with open(mpath, "w") as f:
+            json.dump({"files": {"model.pdparams": {"siz": 1}}}, f)
+        m2 = nn.Linear(4, 2)
+        with pytest.warns(RuntimeWarning, match="falling back"):
+            assert load_checkpoint(d, model=m2) == 1
+        assert np.array_equal(np.asarray(m2.weight._data), w1)
+
+    def test_deep_verify_catches_array_level_edit(self, tmp_path):
+        """verify_checkpoint's deep pass checks per-array crcs, so even a
+        file whose file-level digest was regenerated around an edited
+        array is caught and the culprit array is named."""
+        import binascii
+        import pickle
+
+        d, _, _ = _ckpt_pair(tmp_path)
+        p = os.path.join(d, "ckpt_2", "model.pdparams")
+        with open(p, "rb") as f:
+            state = pickle.load(f)
+        key = sorted(state)[0]
+        state[key] = state[key] + 1.0  # tampered array
+        blob = pickle.dumps(state, protocol=4)
+        with open(p, "wb") as f:
+            f.write(blob)
+        mpath = os.path.join(d, "ckpt_2", "manifest.json")
+        import json
+
+        with open(mpath) as f:
+            manifest = json.load(f)
+        manifest["files"]["model.pdparams"] = {  # regenerated file digest
+            "size": len(blob),
+            "crc32": binascii.crc32(blob) & 0xFFFFFFFF}
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+        ok, problems = verify_checkpoint(os.path.join(d, "ckpt_2"))
+        assert not ok and "per-array checksum mismatch" in problems[0]
+
+    def test_rotation_survives_garbage(self, tmp_path):
+        d = str(tmp_path)
+        os.makedirs(os.path.join(d, "ckpt_latest"))
+        m = nn.Linear(2, 2)
+        for s in range(1, 6):
+            save_checkpoint(d, s, model=m, keep_last=2)
+        kept = sorted(f for f in os.listdir(d) if f.startswith("ckpt_")
+                      and f[5:].isdigit())
+        assert kept == ["ckpt_4", "ckpt_5"]
+
+
+# -- DataLoader worker faults ------------------------------------------------
+
+
+class _Sq(Dataset):
+    def __init__(self, n=16):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.float32(i * i)
+
+
+class TestLoaderWorkerRecovery:
+    def _collect(self, **kw):
+        dl = DataLoader(_Sq(), batch_size=4, num_workers=2,
+                        return_list=False, **kw)
+        return [np.asarray(b) for b in dl]
+
+    def test_dead_worker_restarts_and_order_holds(self):
+        clean = self._collect()
+        with inject.chaos("loader_worker", at=2):
+            faulted = self._collect()
+        assert len(faulted) == len(clean) == 4
+        assert all(np.array_equal(a, b) for a, b in zip(clean, faulted))
+
+    def test_budget_exhausted_surfaces_error_no_hang(self):
+        t0 = time.monotonic()
+        with inject.chaos("loader_worker", at=1, times=100):
+            with pytest.raises(inject.WorkerCrashChaos):
+                self._collect(max_worker_restarts=1)
+        assert time.monotonic() - t0 < 30  # surfaced, did not hang
+
+    def test_deterministic_bad_sample_still_raises(self):
+        class Bad(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                if i == 2:
+                    raise ValueError("bad sample")
+                return np.float32(i)
+
+        dl = DataLoader(Bad(), batch_size=1, num_workers=2)
+        with pytest.raises(ValueError, match="bad sample"):
+            list(dl)
+
+    def test_shutdown_joins_workers(self):
+        before = threading.active_count()
+        for _ in range(3):
+            dl = DataLoader(_Sq(64), batch_size=4, num_workers=4)
+            it = iter(dl)
+            next(it)
+            it.close()  # abandon mid-epoch: generator finally -> shutdown
+        deadline = time.monotonic() + 10
+        while threading.active_count() > before and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before, \
+            "abandoned DataLoader iterators leaked worker threads"
+
+
+# -- activation plumbing -----------------------------------------------------
+
+
+class TestChaosPlumbing:
+    def test_context_manager_scopes_activation(self):
+        assert not inject.ACTIVE
+        with inject.chaos("transient_compile", times=1):
+            assert "transient_compile" in inject.ACTIVE
+        assert not inject.ACTIVE
+
+    def test_env_var_activation(self):
+        pts = inject.install_from_env(
+            "transient_compile:times=2; nan_feed:at=3,seed=1,var=x")
+        try:
+            assert sorted(pts) == ["nan_feed", "transient_compile"]
+            assert inject.ACTIVE["transient_compile"].times == 2
+            assert inject.ACTIVE["nan_feed"].cfg["var"] == "x"
+        finally:
+            inject.clear()
+        assert not inject.ACTIVE
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(KeyError, match="unknown chaos point"):
+            with inject.chaos("nonexistent"):
+                pass
+        with pytest.raises(KeyError):
+            inject.install_from_env("nonexistent:times=1")
+
+    def test_every_injector_is_deterministic_hit_counted(self):
+        with inject.chaos("transient_compile", at=2, times=1) as inj:
+            inj_fire = lambda: inject.fire("transient_compile")  # noqa: E731
+            inj_fire()  # hit 1: below `at`
+            with pytest.raises(inject.TransientChaosError):
+                inj_fire()  # hit 2: fires
+            inj_fire()  # hit 3: budget (times=1) spent
+            assert inj.hits == 3 and inj.fired == 1
+
+    def test_nan_feed_budget_survives_uncorruptible_hits(self):
+        """A hit whose feed has no corruptible target (name typo,
+        int-only feed) must NOT consume the firing budget — the fault
+        still lands on the next eligible feed."""
+        with inject.chaos("nan_feed", var="X_typo", times=1) as inj:
+            out = inject.fire("nan_feed", {"x": np.ones(3, np.float32)})
+            assert np.isfinite(out["x"]).all() and inj.fired == 0
+            out = inject.fire("nan_feed", {"i": np.arange(3)})  # int-only
+            assert inj.fired == 0
+        with inject.chaos("nan_feed", times=1) as inj:
+            out = inject.fire("nan_feed", {"i": np.arange(3)})  # int-only
+            assert inj.fired == 0
+            out = inject.fire("nan_feed", {"x": np.ones(3, np.float32)})
+            assert inj.fired == 1 and np.isnan(out["x"]).sum() == 1
+
+    def test_disabled_chaos_leaves_hot_path_alone(self):
+        """Injection fully disabled => the Executor hook is one empty-dict
+        test and the dispatcher hook is None (no per-step host sync)."""
+        assert not inject.ACTIVE
+        from paddle_tpu.core import dispatch
+
+        assert dispatch._chaos_op_hook is None
+        with inject.chaos("nan_op"):
+            assert dispatch._chaos_op_hook is not None
+        assert dispatch._chaos_op_hook is None

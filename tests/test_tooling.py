@@ -8,12 +8,16 @@ import os
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _load_lint_program():
+def _load_tool(name):
     spec = importlib.util.spec_from_file_location(
-        "lint_program", os.path.join(ROOT, "tools", "lint_program.py"))
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_lint_program():
+    return _load_tool("lint_program")
 
 
 def test_lint_program_self_test_passes():
@@ -37,6 +41,27 @@ def test_slow_marker_is_registered():
     assert any(line.strip().startswith("slow")
                for line in markers.splitlines()), \
         "the 'slow' marker must stay registered for the tier-1 filter"
+
+
+def test_chaos_run_self_test_passes():
+    """tools/chaos_run.py --self-test: every registered fault injector
+    must have a scenario that ends in a completed, verified-correct run
+    (and an injector without a scenario fails the gate). In-process so
+    it rides the tier-1 command path like the lint self-test."""
+    mod = _load_tool("chaos_run")
+    assert mod.main(["--self-test"]) == 0
+
+
+def test_chaos_marker_is_registered():
+    """tests/test_resilience.py marks itself `chaos`; an unregistered
+    marker would warn (or fail under --strict-markers). Pin it."""
+    ini = os.path.join(ROOT, "pytest.ini")
+    cp = configparser.ConfigParser()
+    cp.read(ini)
+    markers = cp.get("pytest", "markers", fallback="")
+    assert any(line.strip().startswith("chaos")
+               for line in markers.splitlines()), \
+        "the 'chaos' marker must stay registered"
 
 
 def test_lint_cli_reports_user_script(tmp_path):

@@ -1,0 +1,386 @@
+#!/usr/bin/env python
+"""chaos_run: run a small training loop under a named fault and report
+whether the resilience layer recovered it.
+
+The operational front door for ``paddle_tpu.resilience`` (the role the
+reference's fleet HA drills play): every registered injector in
+``resilience.inject.INJECTORS`` has a scenario here that (1) activates
+the fault, (2) runs a real train loop / checkpoint cycle / data pipeline
+through the matching guard, and (3) asserts the run COMPLETED and the
+recovery the policy promises actually happened.
+
+Usage:
+    python tools/chaos_run.py nan_feed                # one scenario
+    python tools/chaos_run.py nan_feed --policy rollback --steps 8
+    python tools/chaos_run.py --list                  # scenarios
+    python tools/chaos_run.py --self-test             # every injector
+
+``--self-test`` additionally fails if an injector is registered WITHOUT
+a scenario — you cannot add a chaos point without proving something
+recovers from it. Wired into tier-1 via tests/test_tooling.py.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SCENARIOS = {}
+
+
+def scenario(name):
+    def deco(fn):
+        SCENARIOS[name] = fn
+        return fn
+    return deco
+
+
+def _eager_parts(lr=0.1):
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optim as optim
+
+    pt.seed(0)
+    m = nn.Linear(4, 1)
+    opt = optim.SGD(learning_rate=lr, parameters=m.parameters())
+
+    def loss_fn(model, x, y):
+        return F.mse_loss(model(x), y)
+
+    return pt, m, opt, loss_fn
+
+
+def _batches(steps, batch=8, dim=4):
+    rng = np.random.RandomState(0)
+    return [(rng.randn(batch, dim).astype(np.float32),
+             rng.randn(batch, 1).astype(np.float32)) for _ in range(steps)]
+
+
+def _eager_guarded_run(policy_name, steps=6, chaos_point=None, chaos_cfg=None):
+    """Train under GuardedStep; returns (final weight, stats)."""
+    from paddle_tpu.resilience import GuardedStep, RecoveryPolicy, inject
+
+    pt, m, opt, loss_fn = _eager_parts()
+    step = pt.TrainStep(m, opt, loss_fn, check_nan=True)
+    guard = GuardedStep(step, RecoveryPolicy(
+        on_nonfinite=policy_name, sleep=lambda s: None))
+    data = _batches(steps)
+    if chaos_point is None:
+        for x, y in data:
+            guard(x, y)
+    else:
+        with inject.chaos(chaos_point, **(chaos_cfg or {})):
+            for x, y in data:
+                guard(x, y)
+    return np.asarray(m.weight._data), guard.stats
+
+
+@scenario("nan_feed")
+def run_nan_feed(policy="skip_step", steps=6):
+    """NaN batch at step 3; the guarded run completes and matches an
+    un-faulted run that never saw that batch."""
+    if policy == "raise":
+        from paddle_tpu.utils.nan_guard import NanInfError
+
+        try:
+            _eager_guarded_run(policy, steps, "nan_feed",
+                               {"at": 3, "seed": 7})
+        except NanInfError as e:
+            return f"aborted as requested by policy 'raise': {e}"
+        raise AssertionError("policy 'raise' did not abort on the NaN step")
+    w_f, stats = _eager_guarded_run(policy, steps,
+                                    "nan_feed", {"at": 3, "seed": 7})
+    assert stats.nonfinite == 1 and stats.steps == steps - 1, stats
+    # reference: same data minus the poisoned batch
+    from paddle_tpu.resilience import GuardedStep, RecoveryPolicy
+
+    pt, m, opt, loss_fn = _eager_parts()
+    step = pt.TrainStep(m, opt, loss_fn, check_nan=True)
+    data = _batches(steps)
+    for i, (x, y) in enumerate(data):
+        if i != 2:  # the batch chaos poisoned (at=3 => 3rd step)
+            step(x, y)
+    assert np.array_equal(w_f, np.asarray(m.weight._data)), \
+        "skip_step must be bitwise 'that batch never happened'"
+    return f"recovered: {stats}"
+
+
+@scenario("nan_op")
+def run_nan_op():
+    """Eager op output corrupted; the per-op guard detects it on the
+    FIRST bad op and the error carries an actionable summary."""
+    import paddle_tpu as pt
+    from paddle_tpu.resilience import inject
+    from paddle_tpu.utils import nan_guard
+
+    x = pt.to_tensor(np.ones((4, 4), np.float32))
+    nan_guard.enable_check_nan()
+    try:
+        with inject.chaos("nan_op", op="matmul", seed=3):
+            try:
+                pt.matmul(x, x)
+            except nan_guard.NanInfError as e:
+                assert e.summary["num_nan"] == 1, e.summary
+                assert e.summary["first_bad_index"] >= 0
+                return f"detected with summary: {e.summary}"
+        raise AssertionError("injected nan_op went undetected")
+    finally:
+        nan_guard.disable_check_nan()
+
+
+def _static_parts():
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+
+    pt.enable_static()
+    pt.seed(0)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[8, 4])
+        y = fluid.data(name="y", shape=[8, 1])
+        out = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(out, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return prog, startup, loss
+
+
+def _static_guarded_run(steps=3, chaos_point=None, chaos_cfg=None,
+                        policy_kw=None):
+    import paddle_tpu as pt
+    from paddle_tpu.resilience import GuardedExecutor, RecoveryPolicy, inject
+
+    prog, startup, loss = _static_parts()
+    try:
+        gexe = GuardedExecutor(policy=RecoveryPolicy(
+            sleep=lambda s: None, **(policy_kw or {})))
+        gexe.run(startup)
+        data = _batches(steps, batch=8)
+        losses = []
+
+        def drive():
+            for x, y in data:
+                out = gexe.run(prog, feed={"x": x, "y": y},
+                               fetch_list=[loss])
+                losses.append(None if out is None
+                              else float(np.asarray(out[0])))
+
+        if chaos_point is None:
+            drive()
+        else:
+            with inject.chaos(chaos_point, **(chaos_cfg or {})):
+                drive()
+        return losses, gexe.stats
+    finally:
+        pt.disable_static()
+
+
+@scenario("transient_compile")
+def run_transient_compile():
+    """First two compile attempts die transiently; bounded retry heals
+    them and the fetches match an un-faulted run bitwise."""
+    clean, _ = _static_guarded_run()
+    faulted, stats = _static_guarded_run(
+        chaos_point="transient_compile", chaos_cfg={"times": 2})
+    assert faulted == clean, (faulted, clean)
+    assert stats.retries == 2, stats
+    return f"recovered after {stats.retries} retries; losses identical"
+
+
+@scenario("transient_execute")
+def run_transient_execute():
+    """First two step executions die transiently; bounded retry heals
+    them and the fetches match an un-faulted run bitwise."""
+    clean, _ = _static_guarded_run()
+    faulted, stats = _static_guarded_run(
+        chaos_point="transient_execute", chaos_cfg={"times": 2})
+    assert faulted == clean, (faulted, clean)
+    assert stats.retries == 2, stats
+    return f"recovered after {stats.retries} retries; losses identical"
+
+
+@scenario("opt_compile_fail")
+def run_opt_compile_fail():
+    """Optimized compile fails outright; the guard degrades to
+    optimize_level=0 and the run completes with identical math."""
+    import warnings
+
+    clean, _ = _static_guarded_run(policy_kw={"degrade_opt_level": False})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        faulted, stats = _static_guarded_run(
+            chaos_point="opt_compile_fail", chaos_cfg={"times": 100})
+    assert faulted == clean, (faulted, clean)
+    assert stats.degraded == 1, stats
+    return "degraded to optimize_level=0; losses identical"
+
+
+def _ckpt_cycle(tmpdir, chaos_point=None, chaos_cfg=None):
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optim as optim
+    from paddle_tpu.framework.io import save_checkpoint, load_checkpoint
+    from paddle_tpu.resilience import SimulatedCrashError, inject
+
+    pt.seed(0)
+    m = nn.Linear(4, 2)
+    opt = optim.SGD(learning_rate=0.1, parameters=m.parameters())
+    save_checkpoint(tmpdir, 1, model=m, optimizer=opt)
+    w1 = np.asarray(m.weight._data).copy()
+    m.weight._data = m.weight._data + 1.0  # "train", then checkpoint again
+    if chaos_point is None:
+        save_checkpoint(tmpdir, 2, model=m, optimizer=opt)
+    else:
+        with inject.chaos(chaos_point, **(chaos_cfg or {})):
+            try:
+                save_checkpoint(tmpdir, 2, model=m, optimizer=opt)
+            except SimulatedCrashError:
+                pass  # the 'process died' mid-save
+    m2 = nn.Linear(4, 2)
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        step = load_checkpoint(tmpdir, model=m2)
+    return step, w1, np.asarray(m2.weight._data)
+
+
+@scenario("ckpt_truncate")
+def run_ckpt_truncate():
+    """Newest checkpoint truncated on disk; loader falls back to the
+    intact previous one with bit-identical params."""
+    with tempfile.TemporaryDirectory() as d:
+        step, w1, w_loaded = _ckpt_cycle(d, "ckpt_truncate")
+    assert step == 1 and np.array_equal(w1, w_loaded), step
+    return "fell back to intact step-1 checkpoint"
+
+
+@scenario("ckpt_bitflip")
+def run_ckpt_bitflip():
+    """One bit of the newest checkpoint flips on disk; the manifest
+    checksum catches it and the loader falls back to the intact one."""
+    with tempfile.TemporaryDirectory() as d:
+        step, w1, w_loaded = _ckpt_cycle(d, "ckpt_bitflip", {"seed": 5})
+    assert step == 1 and np.array_equal(w1, w_loaded), step
+    return "checksum caught the flipped bit; fell back to step 1"
+
+
+@scenario("ckpt_crash")
+def run_ckpt_crash():
+    """Save crashes before publish; once the orphan tmp dir goes stale
+    it is cleaned, and the previous checkpoint loads."""
+    import time
+
+    with tempfile.TemporaryDirectory() as d:
+        step, w1, w_loaded = _ckpt_cycle(d, "ckpt_crash")
+        # backdate the orphan past the concurrent-saver grace period
+        t = time.time() - 3600
+        for f in os.listdir(d):
+            if f.startswith(".tmp_ckpt_"):
+                p = os.path.join(d, f)
+                for sub in [p] + [os.path.join(p, s) for s in os.listdir(p)]:
+                    os.utime(sub, (t, t))
+        from paddle_tpu.framework.io import load_checkpoint
+        import paddle_tpu.nn as nn
+        import warnings
+
+        m3 = nn.Linear(4, 2)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            step2 = load_checkpoint(d, model=m3)
+        leftovers = [f for f in os.listdir(d) if f.startswith(".tmp_ckpt_")]
+    assert step == 1 and np.array_equal(w1, w_loaded), step
+    assert step2 == 1 and not leftovers, (step2, leftovers)
+    return "stale orphan tmp cleaned; resumed from step 1"
+
+
+@scenario("loader_worker")
+def run_loader_worker():
+    """A prefetch worker thread is killed mid-epoch; the restart budget
+    absorbs it and every batch still arrives, in order."""
+    from paddle_tpu.io_.dataloader import DataLoader
+    from paddle_tpu.io_.dataset import Dataset
+    from paddle_tpu.resilience import inject
+
+    class Sq(Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return np.float32(i * i)
+
+    def collect():
+        dl = DataLoader(Sq(), batch_size=4, num_workers=2,
+                        return_list=False)
+        return [np.asarray(b) for b in dl]
+
+    clean = collect()
+    with inject.chaos("loader_worker", at=2):
+        faulted = collect()
+    assert len(faulted) == len(clean) == 4
+    assert all(np.array_equal(a, b) for a, b in zip(clean, faulted))
+    return "worker crash absorbed; all 4 batches delivered in order"
+
+
+def self_test():
+    from paddle_tpu.resilience import INJECTORS
+
+    missing = sorted(set(INJECTORS) - set(SCENARIOS))
+    if missing:
+        print(f"self-test FAILED: injectors with no recovery scenario: "
+              f"{missing}")
+        return 1
+    failures = []
+    for name in sorted(SCENARIOS):
+        try:
+            msg = SCENARIOS[name]()
+            print(f"  {name:20s} ok — {msg}")
+        except Exception as e:
+            print(f"  {name:20s} FAILED — {type(e).__name__}: {e}")
+            failures.append(name)
+    if failures:
+        print(f"self-test FAILED: {failures}")
+        return 1
+    print("self-test passed: every registered injector's fault class ends "
+          "in a completed, verified-correct run")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("fault", nargs="?", help="scenario / injector name")
+    ap.add_argument("--policy", default="skip_step",
+                    choices=["raise", "skip_step", "rollback"],
+                    help="nonfinite policy for the nan_feed scenario")
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--list", action="store_true", help="list scenarios")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run every registered injector's scenario")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if args.list:
+        for name in sorted(SCENARIOS):
+            doc = (SCENARIOS[name].__doc__ or "").strip().splitlines()
+            print(f"{name:20s} {doc[0] if doc else ''}")
+        return 0
+    if not args.fault:
+        ap.error("a fault name is required (or --list / --self-test)")
+    if args.fault not in SCENARIOS:
+        ap.error(f"unknown fault {args.fault!r}; --list shows scenarios")
+    if args.fault == "nan_feed":
+        msg = SCENARIOS[args.fault](policy=args.policy, steps=args.steps)
+    else:
+        msg = SCENARIOS[args.fault]()
+    print(f"{args.fault}: {msg}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
