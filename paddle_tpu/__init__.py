@@ -89,6 +89,7 @@ from . import distribution
 from . import static_
 from . import framework
 from . import resilience
+from . import obs
 from . import runtime
 from . import inference
 from . import quant

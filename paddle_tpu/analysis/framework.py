@@ -22,7 +22,9 @@ logs the same thing per ir pass with VLOG).
 from __future__ import annotations
 
 import logging
+import time
 
+from ..obs import metrics as _obs_metrics
 from .diagnostics import DiagnosticReport
 
 _log = logging.getLogger("paddle_tpu.analysis")
@@ -148,5 +150,15 @@ class PassManager:
 
     def run_ctx(self, ctx):
         for p in self.passes:
+            t0 = time.perf_counter()
             p.run(ctx)
+            ms = (time.perf_counter() - t0) * 1e3
+            # per-pass compile-time attribution: obs.metrics aggregates
+            # every pass process-wide for tools/obs_report.py; the
+            # report's pass_stats stays rewrite-only (an always-on
+            # verifier entry would break its "no rewrites ran" == {}
+            # contract), so ms joins entries a rewrite already made
+            if p.name in ctx.report.pass_stats:
+                ctx.report.pass_stats[p.name]["ms"] = ms
+            _obs_metrics.histogram(f"analysis.pass.{p.name}.ms").observe(ms)
         return ctx

@@ -160,10 +160,20 @@ _amp_state = _cast_op_inputs = _nan_guard = None
 # no injector is active, so the disabled hot path pays one None check.
 _chaos_op_hook = None
 
+# Push-style telemetry hook (obs.enable_op_sampling): eager op counting
+# is off by default and the disabled hot path pays the same one None
+# check — the dispatcher cannot afford a registry probe per op.
+_op_metrics_hook = None
+
 
 def set_chaos_op_hook(fn):
     global _chaos_op_hook
     _chaos_op_hook = fn
+
+
+def set_op_metrics_hook(fn):
+    global _op_metrics_hook
+    _op_metrics_hook = fn
 
 
 def _lazy_hooks():
@@ -189,6 +199,9 @@ def apply(name, fn, *args, **attrs):
     tracer = current_tracer()
     if tracer is not None:
         return tracer.trace_op(name, fn, args, attrs)
+
+    if _op_metrics_hook is not None:  # eager executions only: a recorded
+        _op_metrics_hook(name)        # static op is not a dispatch
 
     arrays = [_unwrap(a) for a in args]
     need_grad = is_grad_enabled() and any(

@@ -1,13 +1,22 @@
 """fluid.profiler submodule (ref: python/paddle/fluid/profiler.py).
 
 The reference drives the C++ platform profiler (nvprof ranges, per-op
-timers); here every name forwards to ``paddle_tpu.utils.profiler``,
-whose backend is ``jax.profiler`` trace collection (XPlane traces for
-xprof/tensorboard — the TPU-native equivalent of the op timeline).
+timers); here every name forwards to ``paddle_tpu.utils.profiler``, whose
+backend is ``jax.profiler`` trace collection (XPlane traces for
+xprof/tensorboard — the TPU-native equivalent of the op timeline) plus
+the ``paddle_tpu.obs`` span tracer: a reference-style
+
+    with fluid.profiler.profiler('All', 'total'):
+        ...train loop...
+
+block now records real host-side spans (executor compiles/runs,
+dataloader waits) into the obs ring buffer — export them with
+``paddle_tpu.obs.export_chrome_trace(path)`` — instead of being a no-op.
+``span(name, **attrs)`` is the nvprof-range analog for custom blocks.
 """
 from ..utils.profiler import (profiler, start_profiler,  # noqa: F401
                               stop_profiler, reset_profiler, cuda_profiler,
-                              add_profiler_step, StepTimer)
+                              add_profiler_step, StepTimer, span)
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
-           "cuda_profiler", "add_profiler_step", "StepTimer"]
+           "cuda_profiler", "add_profiler_step", "StepTimer", "span"]

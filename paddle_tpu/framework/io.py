@@ -16,13 +16,23 @@ import json
 import os
 import pickle
 import shutil
+import time
 import warnings
 
 import numpy as np
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from ..resilience import inject as _chaos
+
+_M_SAVE_MS = _metrics.histogram("checkpoint.save_ms")
+_M_LOAD_MS = _metrics.histogram("checkpoint.load_ms")
+_M_VERIFY_MS = _metrics.histogram("checkpoint.verify_ms")
+_M_SAVES = _metrics.counter("checkpoint.saves")
+_M_LOADS = _metrics.counter("checkpoint.loads")
+_M_FALLBACKS = _metrics.counter("checkpoint.fallbacks")
 
 __all__ = [
     "save", "load", "save_inference_model", "load_inference_model",
@@ -258,6 +268,19 @@ def save_checkpoint(directory, step, model=None, optimizer=None,
     """Atomic checkpoint with keep-last-k rotation, resume metadata, and
     an integrity manifest (per-file and per-array crc32) that
     ``load_checkpoint`` verifies before trusting the data."""
+    t0 = time.perf_counter()
+    with _trace.span("checkpoint.save", step=int(step)):
+        out = _save_checkpoint(directory, step, model, optimizer, scheduler,
+                               keep_last, extra)
+    # a save that died (e.g. injected ckpt_crash) published nothing:
+    # checkpoint.saves counts only durable checkpoints
+    _M_SAVE_MS.observe((time.perf_counter() - t0) * 1e3)
+    _M_SAVES.inc()
+    return out
+
+
+def _save_checkpoint(directory, step, model, optimizer, scheduler,
+                     keep_last, extra):
     os.makedirs(directory, exist_ok=True)
     tmp = os.path.join(directory, f".tmp_ckpt_{step}")
     final = os.path.join(directory, f"ckpt_{step}")
@@ -378,11 +401,14 @@ def verify_checkpoint(path):
     """(ok, problems): integrity audit of one checkpoint dir without
     applying it to any model — includes the deep per-array checksum
     pass, so a mismatch names the specific corrupt array."""
+    t0 = time.perf_counter()
     try:
         _load_and_verify(path, deep=True)
         return True, []
     except CheckpointError as e:
         return False, [str(e)]
+    finally:
+        _M_VERIFY_MS.observe((time.perf_counter() - t0) * 1e3)
 
 
 def _tmp_age(path):
@@ -440,6 +466,17 @@ def load_checkpoint(directory, model=None, optimizer=None, scheduler=None,
     checkpoint fails verification — or an explicitly requested ``step``
     does — is ``CheckpointError`` raised.
     """
+    t0 = time.perf_counter()
+    with _trace.span("checkpoint.load"):
+        out = _load_checkpoint(directory, model, optimizer, scheduler, step)
+    if out is not None:  # an empty/missing directory loaded nothing:
+        # checkpoint.loads counts only actual resumes (mirroring saves)
+        _M_LOAD_MS.observe((time.perf_counter() - t0) * 1e3)
+        _M_LOADS.inc()
+    return out
+
+
+def _load_checkpoint(directory, model, optimizer, scheduler, step):
     if not os.path.isdir(directory):
         return None
     _clean_orphan_tmp(directory)
@@ -472,6 +509,7 @@ def load_checkpoint(directory, model=None, optimizer=None, scheduler=None,
                 break
             except CheckpointError as e:
                 failures.append(str(e))
+                _M_FALLBACKS.inc()
                 warnings.warn(
                     f"checkpoint {d} failed verification ({e}); falling "
                     "back to the next-newest", RuntimeWarning)

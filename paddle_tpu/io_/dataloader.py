@@ -13,14 +13,24 @@ from __future__ import annotations
 
 import pickle
 import threading
+import time
 
 import numpy as np
 
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from ..resilience import inject as _chaos
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
 __all__ = ["DataLoader", "default_collate_fn", "default_convert_fn"]
+
+# interned once; ticked per BATCH (not per sample), so the pipeline's
+# telemetry cost is noise against the numpy collate work it measures
+_M_QUEUE_DEPTH = _metrics.gauge("dataloader.queue_depth")
+_M_PRODUCER_WAIT = _metrics.histogram("dataloader.producer_wait_ms")
+_M_CONSUMER_WAIT = _metrics.histogram("dataloader.consumer_wait_ms")
+_M_RESTARTS = _metrics.counter("dataloader.worker_restarts")
 
 
 def default_convert_fn(batch):
@@ -113,8 +123,13 @@ class _Prefetcher:
                     # (the consumer raises it in order); the worker
                     # lives on and its restart budget is untouched
                     payload = pickle.dumps((i, e), protocol=5)
+                t0 = time.perf_counter()
                 if not self._ring.push(payload):
                     return  # ring closed by consumer shutdown
+                # blocked push = backpressure: the consumer (train loop)
+                # is the bottleneck, which is the healthy direction
+                _M_PRODUCER_WAIT.observe((time.perf_counter() - t0) * 1e3)
+                _M_QUEUE_DEPTH.set(len(self._ring))
                 i = None
         except BaseException as e:  # worker DEATH (chaos kill, pickling
             self._crashed(i, e)     # failure, machinery bug)
@@ -128,6 +143,7 @@ class _Prefetcher:
             if self._restarts_left > 0:
                 self._restarts_left -= 1
                 self.restarts += 1
+                _M_RESTARTS.inc()
                 if i is not None:
                     with self._cursor_lock:
                         self._retry.append(i)  # replacement re-fetches it
@@ -167,11 +183,16 @@ class _Prefetcher:
                 if isinstance(item, Exception):
                     raise item
                 return item
+            t0 = time.perf_counter()
             blob = self._ring.pop()
+            # a long pop = the train loop starved waiting on input — the
+            # number step-time attribution cares about most
+            _M_CONSUMER_WAIT.observe((time.perf_counter() - t0) * 1e3)
             if blob is None:
                 if self._next_out in self._stash:
                     continue
                 raise StopIteration
+            _M_QUEUE_DEPTH.set(len(self._ring))
             i, batch = pickle.loads(blob)
             self._stash[i] = batch  # restore deterministic batch order
 
@@ -253,14 +274,25 @@ class DataLoader:
             return
         if self.num_workers <= 0:
             for indices in self.batch_sampler:
-                yield to_tensors(self._fetch_batch(indices))
+                with _trace.span("dataloader.next", workers=0):
+                    b = self._fetch_batch(indices)
+                yield to_tensors(b)
             return
         pf = _Prefetcher(self.batch_sampler, self._fetch_batch,
                          self.num_workers,
                          capacity=self.num_workers * self.prefetch_factor,
                          max_restarts=self.max_worker_restarts)
         try:
-            for b in pf:
+            it = iter(pf)
+            while True:
+                # span covers only the wait for the prefetched batch,
+                # not the consumer's processing of it
+                with _trace.span("dataloader.next",
+                                 workers=self.num_workers):
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        break
                 yield to_tensors(b)
         finally:
             pf.shutdown()

@@ -1,0 +1,125 @@
+"""paddle_tpu.obs — unified telemetry: metrics registry + span tracer.
+
+The reference ships a first-class profiler (``fluid/profiler.py`` over
+the C++ platform profiler); this package is its TPU-native counterpart
+plus the production metrics layer the reference keeps in VLOG counters:
+
+- ``metrics``  — process-wide registry of named Counters / Gauges /
+  fixed-bucket Histograms; ``snapshot()`` / ``reset()``; thread-safe,
+  allocation-free on the tick path.
+- ``trace``    — ``span(name, **attrs)`` wall-time spans in a bounded
+  ring buffer, exported as Chrome ``chrome://tracing`` JSON; opt-in via
+  env ``PADDLE_TPU_TRACE=1`` or ``enable_tracing()``.
+- ``report``   — human-readable table / JSON dump of the registry
+  (``tools/obs_report.py`` is the CLI front door).
+
+Instrumented sites (all zero-overhead when idle — one flag/None check,
+no host sync, mirroring the ``resilience.inject`` ``if ACTIVE`` hooks):
+
+======================  ====================================================
+subsystem               instruments
+======================  ====================================================
+static_/executor.py     ``executor.jit_cache.hits|misses``,
+                        ``executor.compile_ms``, ``executor.run_ms``,
+                        ``executor.fetch_ms``; spans ``executor.compile``,
+                        ``executor.run``
+analysis (passes)       ``analysis.pass.<name>.ms`` per optimization pass
+core/dispatch.py        ``dispatch.ops_total``, ``dispatch.op.<type>``
+                        behind ``enable_op_sampling()`` /
+                        env ``PADDLE_TPU_OBS_SAMPLE`` (off by default:
+                        the eager hot path pays one None check)
+io_/dataloader.py       ``dataloader.queue_depth`` gauge,
+                        ``dataloader.producer_wait_ms``,
+                        ``dataloader.consumer_wait_ms``,
+                        ``dataloader.worker_restarts``; span
+                        ``dataloader.next``
+resilience              ``resilience.retries|steps|nonfinite|skipped|``
+                        ``rollbacks|degraded``
+framework/io.py         ``checkpoint.save_ms|load_ms|verify_ms``,
+                        ``checkpoint.saves|loads|fallbacks``; spans
+                        ``checkpoint.save|load``
+utils/profiler.py       ``step_timer.step_ms`` (StepTimer rebase)
+======================  ====================================================
+"""
+from __future__ import annotations
+
+import os as _os
+
+from . import metrics, trace, report  # noqa: F401
+from .metrics import (counter, gauge, histogram, snapshot, reset,  # noqa: F401
+                      Counter, Gauge, Histogram, Registry, REGISTRY)
+from .trace import (span, enable_tracing, disable_tracing,  # noqa: F401
+                    tracing_enabled, clear_trace, trace_events,
+                    export_chrome_trace)
+
+__all__ = [
+    "metrics", "trace", "report",
+    "counter", "gauge", "histogram", "snapshot", "reset",
+    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+    "span", "enable_tracing", "disable_tracing", "tracing_enabled",
+    "clear_trace", "trace_events", "export_chrome_trace",
+    "enable_op_sampling", "disable_op_sampling", "op_sampling_enabled",
+]
+
+# -- eager op sampling -------------------------------------------------------
+# The dispatcher cannot afford a registry lookup per op, so sampling is
+# push-style: enabling installs a closure over pre-interned counters into
+# core.dispatch (the exact pattern resilience.inject uses for nan_op).
+
+_op_sampling = False
+
+
+def enable_op_sampling(every=1):
+    """Count eager op dispatches into ``dispatch.ops_total`` and
+    ``dispatch.op.<type>``, sampling one in ``every`` calls. Off by
+    default; also enabled at import by env ``PADDLE_TPU_OBS_SAMPLE``
+    (its integer value is the sampling stride, ``1`` = every op)."""
+    global _op_sampling
+    from ..core import dispatch
+
+    every = max(1, int(every))
+    total = metrics.counter("dispatch.ops_total")
+    per_op: dict = {}  # op type -> Counter, interned outside the lock
+    if every == 1:
+        def hook(name):
+            total.inc()
+            c = per_op.get(name)
+            if c is None:
+                c = per_op[name] = metrics.counter("dispatch.op." + name)
+            c.inc()
+    else:
+        state = {"n": 0}
+
+        def hook(name):
+            # stride sampling: the +every correction keeps ops_total an
+            # unbiased estimate of the true dispatch count
+            state["n"] += 1
+            if state["n"] % every:
+                return
+            total.inc(every)
+            c = per_op.get(name)
+            if c is None:
+                c = per_op[name] = metrics.counter("dispatch.op." + name)
+            c.inc(every)
+    dispatch.set_op_metrics_hook(hook)
+    _op_sampling = True
+
+
+def disable_op_sampling():
+    global _op_sampling
+    from ..core import dispatch
+
+    dispatch.set_op_metrics_hook(None)
+    _op_sampling = False
+
+
+def op_sampling_enabled():
+    return _op_sampling
+
+
+_sample_env = _os.environ.get("PADDLE_TPU_OBS_SAMPLE", "")
+if _sample_env.lower() not in ("", "0", "false"):
+    try:
+        enable_op_sampling(int(_sample_env))
+    except ValueError:
+        enable_op_sampling(1)

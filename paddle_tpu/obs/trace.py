@@ -1,0 +1,128 @@
+"""Wall-time span tracer with Chrome-trace export.
+
+``span("executor.compile", uid=3)`` records one complete event into a
+bounded in-memory ring buffer; ``export_chrome_trace(path)`` dumps the
+buffer as ``chrome://tracing`` / Perfetto JSON. This is the host-side
+timeline complement to ``jax.profiler`` (which owns the device/XLA view,
+see ``utils/profiler.py``): compiles, runs, dataloader waits, checkpoint
+writes — the step-time attribution the MLPerf TPU scaling work builds
+its analysis on.
+
+Off by default. ``span()`` with tracing disabled returns one shared
+no-op context manager — no allocation, no clock read, one module-bool
+check (the same discipline as the ``resilience.inject`` ``if ACTIVE``
+hooks). Opt in per process with env ``PADDLE_TPU_TRACE=1`` or at runtime
+with ``enable_tracing()``.
+
+The ring buffer is bounded (default 65536 spans): a week-long serving
+process can leave tracing on and the newest spans win.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "span", "enable_tracing", "disable_tracing", "tracing_enabled",
+    "clear_trace", "trace_events", "export_chrome_trace",
+    "DEFAULT_CAPACITY",
+]
+
+DEFAULT_CAPACITY = 65536
+
+_enabled = False
+_events: collections.deque = collections.deque(maxlen=DEFAULT_CAPACITY)
+# one perf-counter epoch per process: every span's ts is an offset from
+# here, so spans from different threads land on one comparable timeline
+_EPOCH = time.perf_counter()
+
+_NULL = contextlib.nullcontext()  # stateless + reentrant: safe to share
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "_t0")
+
+    def __init__(self, name, attrs):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter()
+        # deque.append with maxlen is atomic under the GIL: no lock on
+        # the record path
+        _events.append((self.name,
+                        (self._t0 - _EPOCH) * 1e6,  # ts µs
+                        (t1 - self._t0) * 1e6,      # dur µs
+                        threading.get_ident(),
+                        self.attrs))
+        return False
+
+
+def span(name, **attrs):
+    """Context manager timing one named span. A no-op (shared null
+    context) unless tracing is enabled."""
+    if not _enabled:
+        return _NULL
+    return _Span(name, attrs)
+
+
+def enable_tracing(capacity=None):
+    """Turn span recording on; ``capacity`` resizes (and clears) the
+    ring buffer."""
+    global _enabled, _events
+    if capacity is not None and capacity != _events.maxlen:
+        _events = collections.deque(maxlen=int(capacity))
+    _enabled = True
+
+
+def disable_tracing():
+    """Stop recording; already-recorded spans stay exportable."""
+    global _enabled
+    _enabled = False
+
+
+def tracing_enabled():
+    return _enabled
+
+
+def clear_trace():
+    _events.clear()
+
+
+def trace_events():
+    """Snapshot of recorded spans as dicts (newest-capped by the ring)."""
+    return [{"name": n, "ts": ts, "dur": dur, "tid": tid, "args": attrs}
+            for n, ts, dur, tid, attrs in list(_events)]
+
+
+def export_chrome_trace(path):
+    """Write the span buffer as Chrome trace-event JSON (load in
+    chrome://tracing or https://ui.perfetto.dev). Returns the number of
+    spans exported."""
+    pid = os.getpid()
+    events = [{"ph": "X", "pid": pid, "tid": tid, "name": n,
+               "ts": ts, "dur": dur, "args": attrs}
+              for n, ts, dur, tid, attrs in list(_events)]
+    events.append({"ph": "M", "pid": pid, "name": "process_name",
+                   "args": {"name": "paddle_tpu"}})
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        # default=str: span attrs may carry shapes/dtypes/paths — never
+        # let an exotic attr make the whole export unserializable
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f,
+                  default=str)
+    return len(events) - 1
+
+
+if os.environ.get("PADDLE_TPU_TRACE", "").lower() not in ("", "0", "false"):
+    enable_tracing()

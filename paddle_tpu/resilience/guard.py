@@ -31,6 +31,7 @@ import warnings
 
 import numpy as np
 
+from ..obs import metrics as _metrics
 from ..utils.nan_guard import NanInfError
 from . import inject
 from .policy import RecoveryPolicy, retry_call
@@ -39,7 +40,12 @@ __all__ = ["GuardedStep", "GuardedExecutor", "GuardStats"]
 
 
 class GuardStats:
-    """Counters a guard accumulates (one instance per guard)."""
+    """Counters a guard accumulates (one instance per guard). ``inc``
+    mirrors into the process-wide ``obs.metrics`` registry under
+    ``resilience.<name>`` so fleet-level dashboards see every guard's
+    recoveries without holding guard references — EXCEPT ``retries``,
+    which ``policy.retry_call`` (the chokepoint every guard funnels
+    through) already ticks globally per actual retry."""
 
     def __init__(self):
         self.steps = 0          # committed (good) steps
@@ -48,6 +54,11 @@ class GuardStats:
         self.rollbacks = 0      # last-good restores
         self.retries = 0        # transient retries that happened
         self.degraded = 0       # optimize_level degradations
+
+    def inc(self, name, n=1):
+        setattr(self, name, getattr(self, name) + n)
+        if n and name != "retries":
+            _metrics.counter("resilience." + name).inc(n)
 
     def as_dict(self):
         return dict(self.__dict__)
@@ -177,21 +188,21 @@ class GuardedStep:
                                         before_retry=lambda:
                                         self._restore(pre))
         except NanInfError:
-            self.stats.nonfinite += 1
+            self.stats.inc("nonfinite")
             if pol.on_nonfinite == "raise":
                 raise
             if pol.on_nonfinite == "skip_step":
                 self._restore(pre)
-                self.stats.skipped += 1
+                self.stats.inc("skipped")
             else:
                 self._restore(self._last_good if self._last_good
                               else pre)
-                self.stats.rollbacks += 1
+                self.stats.inc("rollbacks")
             if self.scaler is not None:
                 self.scaler.notify_skip()
             return None
-        self.stats.retries += attempts - 1
-        self.stats.steps += 1
+        self.stats.inc("retries", attempts - 1)
+        self.stats.inc("steps")
         if pol.on_nonfinite == "rollback" and \
                 self.stats.steps % pol.snapshot_every == 0:
             self._last_good = self._take_snapshot()
@@ -331,8 +342,8 @@ class GuardedExecutor:
                 "succeeds; degrading this GuardedExecutor to level 0 for "
                 "subsequent runs", RuntimeWarning)
             self._degraded = True
-            self.stats.degraded += 1
-        self.stats.retries += attempts - 1
+            self.stats.inc("degraded")
+        self.stats.inc("retries", attempts - 1)
 
         if len(fetch_list) > n_user_fetch:  # the appended found_inf var
             # the on-device flag is authoritative: a False verdict must
@@ -348,7 +359,7 @@ class GuardedExecutor:
                 found_inf = _nonfinite_state(scope, names)
 
         if found_inf:
-            self.stats.nonfinite += 1
+            self.stats.inc("nonfinite")
             if pol.on_nonfinite == "raise":
                 raise NanInfError(
                     "nonfinite value in fetched results or committed "
@@ -357,7 +368,7 @@ class GuardedExecutor:
                     "'rollback') to recover instead")
             if pol.on_nonfinite == "skip_step":
                 self._restore(pre, scope, keep_amp=True)
-                self.stats.skipped += 1
+                self.stats.inc("skipped")
             else:
                 # no verified-good snapshot yet (first steps, or coarse
                 # cadence): this run's pre-state IS the last good state —
@@ -365,10 +376,10 @@ class GuardedExecutor:
                 # passed the scan
                 self._restore(self._last_good if self._last_good
                               else pre, scope, keep_amp=True)
-                self.stats.rollbacks += 1
+                self.stats.inc("rollbacks")
             return None
         if guard_state:  # an empty (startup) program is not a step
-            self.stats.steps += 1
+            self.stats.inc("steps")
             if pol.on_nonfinite == "rollback" and \
                     self.stats.steps % pol.snapshot_every == 0:
                 self._last_good = self._take_snapshot(names, scope)

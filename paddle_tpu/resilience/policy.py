@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import time
 
+from ..obs import metrics as _metrics
 from .inject import TransientChaosError
 
 __all__ = ["TransientError", "RecoveryPolicy", "retry_call",
@@ -85,6 +86,10 @@ def retry_call(fn, policy=None, describe="", before_retry=None):
         except policy.retryable:
             if attempt >= policy.max_retries:
                 raise
+            # the one chokepoint every guard's transient recovery passes
+            # through — the process-wide resilience.retries counter lives
+            # here (GuardStats keeps the per-guard view)
+            _metrics.counter("resilience.retries").inc()
             policy._sleep(policy.backoff_for(attempt))
             if before_retry is not None:
                 before_retry()

@@ -9,15 +9,28 @@ in-place in HBM.
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from ..resilience import inject as _chaos
 from .program import (Program, default_main_program, global_scope)
 
 __all__ = ["Executor"]
+
+# interned once: the run/compile paths tick these without touching the
+# registry dict (obs.metrics.reset() zeroes in place, so the references
+# stay live forever)
+_M_CACHE_HITS = _metrics.counter("executor.jit_cache.hits")
+_M_CACHE_MISSES = _metrics.counter("executor.jit_cache.misses")
+_M_COMPILE_MS = _metrics.histogram("executor.compile_ms")
+_M_RUN_MS = _metrics.histogram("executor.run_ms")
+_M_FETCH_MS = _metrics.histogram("executor.fetch_ms")
 
 
 class _Compiled:
@@ -42,6 +55,8 @@ class Executor:
             optimize_level = int(os.environ.get("PADDLE_TPU_OPT_LEVEL", "1"))
         self.optimize_level = int(optimize_level)
         self.last_diagnostics = None  # DiagnosticReport of the last compile
+        self._cache_hits = 0    # this executor's share of the global
+        self._cache_misses = 0  # executor.jit_cache.* counters
 
     def close(self):
         self._cache.clear()
@@ -90,7 +105,7 @@ class Executor:
 
     def _compile(self, program, feed, fetch_list, data_parallel=False,
                  allow_replicated_fallback=False, optimize_level=None):
-        from ..analysis import normalize_fetch, run_compile_passes
+        from ..analysis import normalize_fetch
 
         if optimize_level is None:
             optimize_level = self.optimize_level
@@ -117,7 +132,30 @@ class Executor:
                 "executor cache incoherent: Block.ops changed without " \
                 "Program.bump()"
             self.last_diagnostics = compiled.diagnostics
+            self._cache_hits += 1
+            _M_CACHE_HITS.inc()
             return compiled
+
+        self._cache_misses += 1
+        _M_CACHE_MISSES.inc()
+        t0 = time.perf_counter()
+        with _trace.span("executor.compile", uid=program._uid,
+                         version=program._version,
+                         optimize_level=int(optimize_level),
+                         data_parallel=bool(data_parallel)):
+            compiled = self._build(program, feed_names, fetch_names, shapes,
+                                   fetch_list, data_parallel,
+                                   allow_replicated_fallback, optimize_level)
+        # NOTE: jax.jit is lazy — this times trace-side work (analysis
+        # passes + jit wrapper construction); XLA's own compile lands in
+        # the first executor.run_ms sample for this key
+        _M_COMPILE_MS.observe((time.perf_counter() - t0) * 1e3)
+        self._cache[key] = compiled
+        return compiled
+
+    def _build(self, program, feed_names, fetch_names, shapes, fetch_list,
+               data_parallel, allow_replicated_fallback, optimize_level):
+        from ..analysis import run_compile_passes
 
         scope = global_scope()
         blk = program.global_block
@@ -202,8 +240,15 @@ class Executor:
         compiled.program_version = program._version
         compiled.op_count = len(blk.ops)  # pre-optimization: mirrors _version
         compiled.diagnostics = report
-        self._cache[key] = compiled
         return compiled
+
+    def cache_stats(self):
+        """Hit/miss/size of this executor's jit cache (the process-wide
+        view lives in ``obs.metrics`` under ``executor.jit_cache.*``).
+        Read-only: the cache-key layout is pinned by tests — never use
+        this to re-key or evict."""
+        return {"hits": self._cache_hits, "misses": self._cache_misses,
+                "size": len(self._cache)}
 
     # -- public API ---------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name=None,
@@ -246,22 +291,31 @@ class Executor:
             feed = dict(feed)
             feed["@lr"] = np.asarray(program._lr_getter(), np.float32)
 
-        compiled = self._compile(
-            program, feed, fetch_list, data_parallel=data_parallel,
-            allow_replicated_fallback=allow_replicated_fallback,
-            optimize_level=optimize_level)
-        if _chaos.ACTIVE:  # disabled => one empty-dict test, no host sync
-            _chaos.fire("transient_execute")
-            feed = _chaos.fire("nan_feed", feed)
-        feeds = [jnp.asarray(np.asarray(feed[n])) for n in compiled.feed_names]
-        updated = [scope.find_var(n) for n in compiled.updated]
-        frozen = [scope.find_var(n) for n in compiled.frozen]
-        fetches, new_persist = compiled.fn(feeds, updated, frozen)
-        for name, arr in zip(compiled.persist_out, new_persist):
-            scope.set(name, arr)
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return [Tensor(f, _internal=True) for f in fetches]
+        t0 = time.perf_counter()
+        with _trace.span("executor.run", uid=program._uid,
+                         n_fetch=len(fetch_list)):
+            compiled = self._compile(
+                program, feed, fetch_list, data_parallel=data_parallel,
+                allow_replicated_fallback=allow_replicated_fallback,
+                optimize_level=optimize_level)
+            if _chaos.ACTIVE:  # disabled => one empty-dict test, no host sync
+                _chaos.fire("transient_execute")
+                feed = _chaos.fire("nan_feed", feed)
+            feeds = [jnp.asarray(np.asarray(feed[n]))
+                     for n in compiled.feed_names]
+            updated = [scope.find_var(n) for n in compiled.updated]
+            frozen = [scope.find_var(n) for n in compiled.frozen]
+            fetches, new_persist = compiled.fn(feeds, updated, frozen)
+            for name, arr in zip(compiled.persist_out, new_persist):
+                scope.set(name, arr)
+            tf = time.perf_counter()
+            if return_numpy:  # np.asarray is the step's host sync point:
+                out = [np.asarray(f) for f in fetches]  # fetch latency
+            else:  # lazy Tensors: fetch_ms records only wrapper cost
+                out = [Tensor(f, _internal=True) for f in fetches]
+            _M_FETCH_MS.observe((time.perf_counter() - tf) * 1e3)
+        _M_RUN_MS.observe((time.perf_counter() - t0) * 1e3)
+        return out
 
     # -- dataset-driven loops (ref: executor.py:1436 train_from_dataset /
     # :1369 infer_from_dataset). The reference hands the dataset to the

@@ -1,13 +1,20 @@
 """Profiler (ref: python/paddle/fluid/profiler.py — profiler context,
 start/stop, per-op timing report).
 
-TPU-native: two layers.
+TPU-native: three layers.
 - ``profiler()`` / start_profiler / stop_profiler wrap ``jax.profiler``
   traces (view in TensorBoard / xprof — this is where XLA fusion and MXU
   utilization actually show up; the reference's per-CUDA-kernel timers
-  have no TPU analog because the whole step is one executable).
+  have no TPU analog because the whole step is one executable) AND turn
+  on ``paddle_tpu.obs`` span tracing for the window, so the host-side
+  timeline (compiles, runs, dataloader waits) records real spans —
+  exportable via ``obs.export_chrome_trace``.
+- ``span(...)`` re-exported from ``obs.trace`` for ad-hoc host ranges
+  (the role nvprof ranges play in the reference).
 - ``StepTimer`` / ``add_profiler_step`` give the host-side per-step
-  wall-clock stats the reference prints (min/max/mean, imgs-per-sec).
+  wall-clock stats the reference prints (min/max/mean, imgs-per-sec),
+  rebased on the ``obs.metrics`` registry: every step also lands in the
+  process-wide ``step_timer.step_ms`` histogram.
 """
 from __future__ import annotations
 
@@ -17,28 +24,47 @@ import time
 
 import numpy as np
 
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
+from ..obs.trace import span  # noqa: F401  (re-export)
+
 __all__ = ["profiler", "start_profiler", "stop_profiler",
-           "add_profiler_step", "StepTimer", "cuda_profiler"]
+           "add_profiler_step", "StepTimer", "cuda_profiler", "span"]
 
 _trace_dir = None
+_window = None  # (span-cm, tracing-was-enabled-before)
 
 
 def start_profiler(state=None, tracer_option=None, log_dir="/tmp/pt_profile"):
-    """ref: profiler.start_profiler. Starts a jax.profiler trace."""
-    global _trace_dir
+    """ref: profiler.start_profiler. Starts a jax.profiler trace and
+    enables obs span tracing for the window."""
+    global _trace_dir, _window
     import jax
 
     os.makedirs(log_dir, exist_ok=True)
     jax.profiler.start_trace(log_dir)
     _trace_dir = log_dir
+    was_on = _trace.tracing_enabled()
+    _trace.enable_tracing()
+    sp = _trace.span("profiler.window", log_dir=log_dir)
+    sp.__enter__()
+    _window = (sp, was_on)
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
-    """ref: profiler.stop_profiler. Ends the trace; returns the dir."""
-    global _trace_dir
+    """ref: profiler.stop_profiler. Ends the trace; returns the dir.
+    Span tracing reverts to its pre-window state (env ``PADDLE_TPU_TRACE``
+    keeps it on)."""
+    global _trace_dir, _window
     import jax
 
     jax.profiler.stop_trace()
+    if _window is not None:
+        sp, was_on = _window
+        sp.__exit__(None, None, None)
+        if not was_on:
+            _trace.disable_tracing()
+        _window = None
     d, _trace_dir = _trace_dir, None
     return d
 
@@ -63,6 +89,12 @@ def cuda_profiler(*a, **k):
 class StepTimer:
     """Host-side per-step timing (the reference's profiler report numbers).
 
+    Exact wall-times stay local (so ``summary()`` percentiles are exact,
+    not bucket-interpolated); each step is additionally observed into the
+    shared ``obs.metrics`` histogram named ``<name>.step_ms`` so the
+    process-wide report sees training cadence without a StepTimer
+    reference.
+
     >>> t = StepTimer()
     >>> for batch in loader:
     ...     with t.step():
@@ -70,10 +102,11 @@ class StepTimer:
     >>> t.summary()   # {'steps': N, 'mean_ms': ..., 'p50_ms': ...}
     """
 
-    def __init__(self, skip_first=1):
+    def __init__(self, skip_first=1, name="step_timer"):
         self.skip_first = skip_first
         self.times = []
         self._seen = 0
+        self._hist = _metrics.histogram(f"{name}.step_ms")
 
     @contextlib.contextmanager
     def step(self):
@@ -83,6 +116,7 @@ class StepTimer:
         self._seen += 1
         if self._seen > self.skip_first:
             self.times.append(dt)
+            self._hist.observe(dt * 1e3)
 
     def summary(self):
         if not self.times:
@@ -91,6 +125,7 @@ class StepTimer:
         return {"steps": len(a), "mean_ms": float(a.mean()),
                 "p50_ms": float(np.percentile(a, 50)),
                 "p90_ms": float(np.percentile(a, 90)),
+                "p99_ms": float(np.percentile(a, 99)),
                 "max_ms": float(a.max())}
 
     def reset(self):

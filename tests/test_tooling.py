@@ -52,6 +52,18 @@ def test_chaos_run_self_test_passes():
     assert mod.main(["--self-test"]) == 0
 
 
+def test_obs_report_self_test_passes():
+    """tools/obs_report.py --self-test: every instrumented site
+    (executor, analysis passes, dispatch sampling, dataloader,
+    resilience guards, checkpoint IO, StepTimer) must register AND tick
+    its instruments, and the exported Chrome trace must contain the
+    compile/run/dataloader spans. An instrumented site losing its
+    instruments fails the gate. In-process so it rides the tier-1
+    command path like the lint and chaos self-tests."""
+    mod = _load_tool("obs_report")
+    assert mod.main(["--self-test"]) == 0
+
+
 def test_chaos_marker_is_registered():
     """tests/test_resilience.py marks itself `chaos`; an unregistered
     marker would warn (or fail under --strict-markers). Pin it."""

@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""obs_report: dump, demo, and self-test paddle_tpu's telemetry.
+
+The operational front door for ``paddle_tpu.obs`` (the role the
+reference's profiler report plays): ``--demo`` drives a real train loop /
+data pipeline / checkpoint cycle with tracing on and prints the metrics
+table; ``--self-test`` exercises EVERY instrumented site — executor,
+analysis passes, eager dispatch sampling, dataloader, resilience guards,
+checkpoint IO, StepTimer — and fails if any site leaves its instruments
+unregistered or untouched, so instrumentation cannot silently rot out of
+a hot path.
+
+Usage:
+    python tools/obs_report.py                   # current-process metrics
+    python tools/obs_report.py --demo            # run workload, report
+    python tools/obs_report.py --demo --json
+    python tools/obs_report.py --demo --trace-out /tmp/pt_trace.json
+    python tools/obs_report.py --self-test       # every instrumented site
+
+Wired into tier-1 via tests/test_tooling.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# every instrumented site's instruments, with the activity check the
+# self-test holds them to after its workload: "count" = histogram with
+# samples, "pos" = counter/gauge > 0, "reg" = registered is enough
+# (gauges that may legitimately read 0 at quiesce)
+REQUIRED = {
+    "executor": [("executor.jit_cache.hits", "pos"),
+                 ("executor.jit_cache.misses", "pos"),
+                 ("executor.compile_ms", "count"),
+                 ("executor.run_ms", "count"),
+                 ("executor.fetch_ms", "count")],
+    "analysis": [("analysis.pass.verifier.ms", "count"),
+                 ("analysis.pass.lint.ms", "count")],
+    "dispatch": [("dispatch.ops_total", "pos")],
+    "dataloader": [("dataloader.producer_wait_ms", "count"),
+                   ("dataloader.consumer_wait_ms", "count"),
+                   ("dataloader.queue_depth", "reg"),
+                   ("dataloader.worker_restarts", "pos")],
+    "resilience": [("resilience.retries", "pos"),
+                   ("resilience.steps", "pos"),
+                   ("resilience.nonfinite", "pos"),
+                   ("resilience.skipped", "pos")],
+    "checkpoint": [("checkpoint.save_ms", "count"),
+                   ("checkpoint.load_ms", "count"),
+                   ("checkpoint.verify_ms", "count"),
+                   ("checkpoint.saves", "pos"),
+                   ("checkpoint.loads", "pos"),
+                   ("checkpoint.fallbacks", "pos")],
+    "step_timer": [("step_timer.step_ms", "count")],
+}
+
+# spans the demo/self-test trace must contain (the acceptance trace)
+REQUIRED_SPANS = ("executor.compile", "executor.run", "dataloader.next")
+
+
+def _static_loop(steps=3, feed_batches=None, guarded=False, policy_kw=None):
+    """Build + run the canonical tiny static train loop."""
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+
+    pt.enable_static()
+    try:
+        pt.seed(0)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.data(name="x", shape=[8, 4])
+            y = fluid.data(name="y", shape=[8, 1])
+            out = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(out, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        if guarded:
+            from paddle_tpu.resilience import GuardedExecutor, RecoveryPolicy
+
+            exe = GuardedExecutor(policy=RecoveryPolicy(
+                sleep=lambda s: None, **(policy_kw or {})))
+        else:
+            exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        batches = feed_batches or [
+            (rng.randn(8, 4).astype(np.float32),
+             rng.randn(8, 1).astype(np.float32)) for _ in range(steps)]
+        for bx, by in batches:
+            exe.run(prog, feed={"x": bx, "y": by}, fetch_list=[loss])
+        return exe
+    finally:
+        pt.disable_static()
+
+
+def _drain_loader(num_workers=2, chaos_cfg=None):
+    from paddle_tpu.io_.dataloader import DataLoader
+    from paddle_tpu.io_.dataset import Dataset
+    from paddle_tpu.resilience import inject
+
+    class Sq(Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return np.float32(i * i)
+
+    def drain():
+        dl = DataLoader(Sq(), batch_size=4, num_workers=num_workers,
+                        return_list=False)
+        return [np.asarray(b) for b in dl]
+
+    if chaos_cfg is None:
+        return drain()
+    with inject.chaos("loader_worker", **chaos_cfg):
+        return drain()
+
+
+def run_workload():
+    """Touch every instrumented site once (the self-test/demo body)."""
+    import warnings
+
+    import paddle_tpu as pt
+    from paddle_tpu import obs
+    from paddle_tpu.framework.io import (load_checkpoint, save_checkpoint,
+                                         verify_checkpoint)
+    from paddle_tpu.resilience import inject
+    from paddle_tpu.utils.profiler import StepTimer
+
+    # executor + analysis: compile once, hit the jit cache twice
+    _static_loop(steps=3)
+
+    # resilience: two transient execute faults retried away, then a NaN
+    # feed skipped under policy
+    with inject.chaos("transient_execute", times=2):
+        _static_loop(steps=3, guarded=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with inject.chaos("nan_feed", at=2, seed=7):
+            _static_loop(steps=3, guarded=True,
+                         policy_kw={"on_nonfinite": "skip_step"})
+
+    # dispatch: eager ops under sampling; restore the operator's OWN
+    # hook afterwards (a PADDLE_TPU_OBS_SAMPLE=N stride must survive
+    # this workload, not be clobbered with stride 1)
+    from paddle_tpu.core import dispatch as _dispatch
+
+    prev_hook = _dispatch._op_metrics_hook
+    obs.enable_op_sampling()
+    try:
+        a = pt.to_tensor(np.ones((4, 4), np.float32))
+        pt.matmul(a, a)
+        pt.add(a, a)
+    finally:
+        _dispatch.set_op_metrics_hook(prev_hook)
+        obs._op_sampling = prev_hook is not None
+
+    # dataloader: clean drain, then a worker crash absorbed by restart
+    _drain_loader()
+    _drain_loader(chaos_cfg={"at": 2})
+
+    # checkpoint: save twice, verify, corrupt the newest, fall back
+    import paddle_tpu.nn as nn
+
+    with tempfile.TemporaryDirectory() as d:
+        pt.seed(0)
+        m = nn.Linear(4, 2)
+        save_checkpoint(d, 1, model=m)
+        m.weight._data = m.weight._data + 1.0
+        save_checkpoint(d, 2, model=m)
+        ok, problems = verify_checkpoint(os.path.join(d, "ckpt_2"))
+        assert ok, problems
+        with open(os.path.join(d, "ckpt_2", "model.pdparams"), "r+b") as f:
+            f.truncate(8)  # torn write: manifest crc catches it
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("ignore", RuntimeWarning)
+            step = load_checkpoint(d, model=nn.Linear(4, 2))
+        assert step == 1, f"fallback loaded step {step}, wanted 1"
+
+    # step timer
+    t = StepTimer(skip_first=0)
+    for _ in range(3):
+        with t.step():
+            pass
+    assert t.summary()["steps"] == 3
+
+
+def _check_required(snap):
+    failures = []
+    for site, instruments in REQUIRED.items():
+        for name, kind in instruments:
+            val = snap.get(name)
+            if val is None:
+                failures.append(f"{site}: instrument {name!r} never "
+                                "registered (instrumentation removed?)")
+            elif kind == "count" and not (isinstance(val, dict)
+                                          and val.get("count", 0) > 0):
+                failures.append(f"{site}: histogram {name!r} recorded no "
+                                "samples")
+            elif kind == "pos" and not (isinstance(val, (int, float))
+                                        and val > 0):
+                failures.append(f"{site}: {name!r} never ticked "
+                                f"(value {val!r})")
+    return failures
+
+
+def self_test():
+    from paddle_tpu import obs
+
+    obs.metrics.reset()
+    tracing_was_on = obs.tracing_enabled()
+    obs.clear_trace()
+    obs.enable_tracing()
+    try:
+        run_workload()
+    finally:
+        if not tracing_was_on:
+            obs.disable_tracing()
+    snap = obs.snapshot()
+    failures = _check_required(snap)
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "trace.json")
+        n = obs.export_chrome_trace(path)
+        with open(path) as f:
+            events = json.load(f)["traceEvents"]
+        names = {e["name"] for e in events}
+        for want in REQUIRED_SPANS:
+            if want not in names:
+                failures.append(f"trace: no {want!r} span in the exported "
+                                f"Chrome trace ({n} spans)")
+
+    for line in sorted(failures):
+        print(f"  FAILED — {line}")
+    if failures:
+        print(f"self-test FAILED: {len(failures)} instrumented-site "
+              "check(s)")
+        return 1
+    total = len([i for site in REQUIRED.values() for i in site])
+    print(f"self-test passed: {total} instruments across "
+          f"{len(REQUIRED)} sites ticked; trace exported "
+          f"{sorted(REQUIRED_SPANS)} spans")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--demo", action="store_true",
+                    help="run a demo workload before reporting")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("--trace-out", metavar="PATH",
+                    help="export the span buffer as Chrome trace JSON")
+    ap.add_argument("--self-test", action="store_true",
+                    help="exercise every instrumented site and verify "
+                         "its instruments tick")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+
+    from paddle_tpu import obs
+
+    if args.demo:
+        obs.enable_tracing()
+        run_workload()
+    print(obs.report.render_json() if args.json else obs.report.render())
+    if args.trace_out:
+        n = obs.export_chrome_trace(args.trace_out)
+        print(f"\nwrote {n} spans to {args.trace_out} "
+              "(open in chrome://tracing or ui.perfetto.dev)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
