@@ -28,6 +28,7 @@ import time
 import numpy as np
 
 SMOKE = os.environ.get("PADDLE_TPU_BENCH_SMOKE") == "1"  # tiny-shape CPU run
+CPU_FALLBACK = False  # backend-init exhausted retries -> labeled CPU run
 
 
 class _Deadline(BaseException):
@@ -340,8 +341,10 @@ def _init_backend():
 
     Two rounds of BENCH gates died here (rc=1/hang, no JSON): the axon
     TPU tunnel can fail its first init OR block indefinitely. Retry with
-    backoff under a per-attempt timeout; after exhausting retries report
-    the failure (never bench full shapes on host CPU)."""
+    backoff under a per-attempt timeout; after exhausting retries,
+    degrade to a LABELED cpu smoke run (never bench full shapes on host
+    CPU)."""
+    global SMOKE, CPU_FALLBACK
     import jax
 
     # persistent executable cache: a re-run session (e.g. the recovery
@@ -376,11 +379,46 @@ def _init_backend():
             pass
         if attempt < 4:  # no pointless sleep after the final attempt
             time.sleep(min(15.0, 2.0 ** attempt))
-    # Do NOT fall back to benching full-size workloads on host CPU: that
-    # trades a fast failure for an hours-long stall reported under the
-    # per-chip TPU metric. Report the failure instead.
-    _log(f"backend init exhausted retries; giving up: {last}")
-    return None
+    # Retries exhausted (BENCH_r05: axon tunnel down for the whole
+    # window -> rounds of `bench_failed` zeros). A zero teaches the
+    # scoreboard nothing; a LABELED CPU number at least proves the
+    # workloads still build and run. Never bench full-size shapes on
+    # host CPU (hours-long stall under a per-chip TPU metric): degrade
+    # to the smoke shapes and mark the run, and journal the degradation
+    # so the flight record shows why this round's numbers are small.
+    _log(f"backend init exhausted retries ({last}); degrading to "
+         "JAX_PLATFORMS=cpu smoke shapes (metric labeled cpu_fallback)")
+    try:
+        from paddle_tpu.obs import journal as _journal
+
+        if _journal.ACTIVE is not None:
+            _journal.ACTIVE.event(
+                "bench.backend_degraded", to="cpu",
+                error=f"{type(last).__name__}: {last}")
+    except Exception:
+        pass
+    try:
+        try:
+            import jax.extend.backend as jeb
+
+            jeb.clear_backends()
+        except Exception:
+            pass
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as e:
+        _log(f"CPU fallback init failed too: {type(e).__name__}: {e}")
+        return None
+    # the timed-out tunnel thread may still hold jax's backend-init
+    # lock, and a direct jax.devices() here would block on it forever —
+    # the exact no-JSON death this function exists to prevent. Probe
+    # through the same worker-thread guard as the TPU attempts.
+    devs, err = _devices_blocking_guard(60.0)
+    if devs is None:
+        _log(f"CPU fallback init failed too: {type(err).__name__}: {err}")
+        return None
+    SMOKE = True
+    CPU_FALLBACK = True
+    return devs
 
 
 def _run_benches(results):
@@ -528,6 +566,11 @@ def main():
 
 def _score(results, headline, extras):
     extras.update(results.pop("_extras", {}))
+    if CPU_FALLBACK:
+        # the numbers below came from smoke shapes on host CPU after the
+        # TPU tunnel refused to init: label them so nobody reads them as
+        # per-chip figures (vs_baseline stays honest-but-tiny)
+        extras["backend"] = "cpu_fallback_smoke"
     if "bert" in results:
         headline = {
             "metric": "bert_base_pretrain_tokens_per_sec_per_chip",

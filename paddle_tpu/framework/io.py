@@ -23,6 +23,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..obs import journal as _journal
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..resilience import inject as _chaos
@@ -274,8 +275,12 @@ def save_checkpoint(directory, step, model=None, optimizer=None,
                                keep_last, extra)
     # a save that died (e.g. injected ckpt_crash) published nothing:
     # checkpoint.saves counts only durable checkpoints
-    _M_SAVE_MS.observe((time.perf_counter() - t0) * 1e3)
+    save_ms = (time.perf_counter() - t0) * 1e3
+    _M_SAVE_MS.observe(save_ms)
     _M_SAVES.inc()
+    if _journal.ACTIVE is not None:
+        _journal.ACTIVE.event("checkpoint.save", step=int(step),
+                              ms=save_ms, dir=str(directory))
     return out
 
 
@@ -471,8 +476,12 @@ def load_checkpoint(directory, model=None, optimizer=None, scheduler=None,
         out = _load_checkpoint(directory, model, optimizer, scheduler, step)
     if out is not None:  # an empty/missing directory loaded nothing:
         # checkpoint.loads counts only actual resumes (mirroring saves)
-        _M_LOAD_MS.observe((time.perf_counter() - t0) * 1e3)
+        load_ms = (time.perf_counter() - t0) * 1e3
+        _M_LOAD_MS.observe(load_ms)
         _M_LOADS.inc()
+        if _journal.ACTIVE is not None:
+            _journal.ACTIVE.event("checkpoint.load", step=int(out),
+                                  ms=load_ms, dir=str(directory))
     return out
 
 
@@ -510,6 +519,9 @@ def _load_checkpoint(directory, model, optimizer, scheduler, step):
             except CheckpointError as e:
                 failures.append(str(e))
                 _M_FALLBACKS.inc()
+                if _journal.ACTIVE is not None:
+                    _journal.ACTIVE.event("checkpoint.fallback",
+                                          ckpt=d, error=str(e))
                 warnings.warn(
                     f"checkpoint {d} failed verification ({e}); falling "
                     "back to the next-newest", RuntimeWarning)

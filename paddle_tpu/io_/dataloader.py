@@ -17,6 +17,7 @@ import time
 
 import numpy as np
 
+from ..obs import journal as _journal
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..resilience import inject as _chaos
@@ -144,6 +145,11 @@ class _Prefetcher:
                 self._restarts_left -= 1
                 self.restarts += 1
                 _M_RESTARTS.inc()
+                if _journal.ACTIVE is not None:
+                    _journal.ACTIVE.event(
+                        "dataloader.worker_restart", batch_index=i,
+                        error=f"{type(exc).__name__}: {exc}",
+                        restarts_left=self._restarts_left)
                 if i is not None:
                     with self._cursor_lock:
                         self._retry.append(i)  # replacement re-fetches it
@@ -151,6 +157,10 @@ class _Prefetcher:
                 self._threads.append(t)
                 t.start()  # replacement inherits this slot: _active unchanged
                 return
+        if _journal.ACTIVE is not None:
+            _journal.ACTIVE.event(
+                "dataloader.restart_budget_exhausted", batch_index=i,
+                error=f"{type(exc).__name__}: {exc}")
         if i is not None:
             if not isinstance(exc, Exception):
                 exc = RuntimeError(

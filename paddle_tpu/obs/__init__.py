@@ -12,6 +12,16 @@ plus the production metrics layer the reference keeps in VLOG counters:
   env ``PADDLE_TPU_TRACE=1`` or ``enable_tracing()``.
 - ``report``   — human-readable table / JSON dump of the registry
   (``tools/obs_report.py`` is the CLI front door).
+- ``journal``  — per-run JSONL flight recorder (``RunJournal``): run
+  header, per-step records, discrete events, anomaly firings, and an
+  MFU/goodput summary; env ``PADDLE_TPU_RUN_DIR`` auto-starts one
+  (``tools/run_report.py`` renders and diffs runs).
+- ``anomaly``  — stateful detectors (loss spike/plateau, nonfinite
+  streak, throughput drop, dataloader starvation) evaluated on each
+  journal step; thresholds via env ``PADDLE_TPU_ANOMALY``.
+- ``mfu``      — MFU/goodput accounting from XLA ``cost_analysis``
+  FLOPs per compiled executable + the configured peak
+  (``PADDLE_TPU_PEAK_FLOPS`` / ``mfu.set_peak_flops``).
 
 Instrumented sites (all zero-overhead when idle — one flag/None check,
 no host sync, mirroring the ``resilience.inject`` ``if ACTIVE`` hooks):
@@ -45,20 +55,22 @@ from __future__ import annotations
 
 import os as _os
 
-from . import metrics, trace, report  # noqa: F401
+from . import metrics, trace, report, anomaly, mfu, journal  # noqa: F401
 from .metrics import (counter, gauge, histogram, snapshot, reset,  # noqa: F401
                       Counter, Gauge, Histogram, Registry, REGISTRY)
 from .trace import (span, enable_tracing, disable_tracing,  # noqa: F401
                     tracing_enabled, clear_trace, trace_events,
                     export_chrome_trace)
+from .journal import RunJournal, start_run, end_run  # noqa: F401
 
 __all__ = [
-    "metrics", "trace", "report",
+    "metrics", "trace", "report", "anomaly", "mfu", "journal",
     "counter", "gauge", "histogram", "snapshot", "reset",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "span", "enable_tracing", "disable_tracing", "tracing_enabled",
     "clear_trace", "trace_events", "export_chrome_trace",
     "enable_op_sampling", "disable_op_sampling", "op_sampling_enabled",
+    "RunJournal", "start_run", "end_run",
 ]
 
 # -- eager op sampling -------------------------------------------------------

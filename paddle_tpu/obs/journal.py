@@ -1,0 +1,538 @@
+"""RunJournal: an append-only JSONL flight recorder for one training run.
+
+PR 3 gave the process instruments (``obs.metrics`` / ``obs.trace``);
+this ties them to *a run*: a durable `journal.jsonl` under a run
+directory (env ``PADDLE_TPU_RUN_DIR`` or an explicit path) holding
+
+- one ``run_start`` header (backend, device count, env knobs, argv),
+- a ``step`` record per training step (loss/fetches summary, step_ms,
+  examples/sec, dataloader queue depth + consumer-wait delta, jit-cache
+  hit/miss delta, FLOPs when known),
+- discrete ``event`` records (compile, checkpoint save/load/fallback,
+  resilience retry/skip/rollback/degrade, chaos activation,
+  dataloader worker restarts),
+- ``anomaly`` records from the detectors (``obs.anomaly``) evaluated
+  on every step, and
+- one ``run_end`` summary: MFU/goodput accounting (``obs.mfu``).
+
+Write path: records buffer in memory (bounded) and flush every
+``flush_every`` records or ``flush_interval_s`` seconds — a line is
+written whole, so a reader never sees a torn record from a clean
+writer. The file rotates at ``max_bytes`` (``journal.jsonl`` is always
+the live tail; rotated parts are ``journal.<n>.jsonl``). On interpreter
+exit (``atexit``) an unclosed journal flushes and writes its summary;
+an exception exiting the ``with`` block (or an explicit
+``postmortem()``) additionally dumps ``postmortem.json`` — the last-K
+step records, recent events, the exception, a metrics snapshot — and a
+Chrome trace when span tracing is on.
+
+Hook contract (the established chaos/obs pattern): every production
+hook is ``if _journal.ACTIVE is not None: ...`` — with no journal
+configured the step path performs a single None check, no call, no
+allocation, no host sync. With a journal active, summarizing an eager
+loss costs one scalar device->host read per step (standard logging
+cost; the static Executor path summarizes already-fetched host arrays).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+from . import metrics as _metrics
+from . import trace as _trace
+from .anomaly import AnomalyEngine, default_detectors
+from .mfu import MFUAccounting, peak_flops
+
+__all__ = ["RunJournal", "ACTIVE", "start_run", "end_run", "active",
+           "JOURNAL_FILE", "POSTMORTEM_FILE"]
+
+JOURNAL_FILE = "journal.jsonl"
+POSTMORTEM_FILE = "postmortem.json"
+
+# The active journal every hook checks (mirrors resilience.inject.ACTIVE:
+# None => hooks are a single None check and nothing else).
+ACTIVE = None
+
+
+def active():
+    return ACTIVE
+
+
+def _env_knobs():
+    return {k: v for k, v in sorted(os.environ.items())
+            if k.startswith(("PADDLE_TPU_", "JAX_", "XLA_"))}
+
+
+def _backend_info():
+    """Backend identity WITHOUT forcing backend creation: probing
+    ``jax.devices()`` before the user's own config/init would pin the
+    platform (and on a wedged TPU tunnel, block) — unacceptable as an
+    import/start side effect. An uninitialized backend reports None;
+    the journal re-probes lazily once a step has actually executed
+    (by which point the backend necessarily exists)."""
+    try:
+        import jax
+
+        try:
+            from jax._src import xla_bridge as _xb
+
+            if hasattr(_xb, "_backends") and not _xb._backends:
+                return {"backend": None, "ndev": None,
+                        "backend_note": "jax backend not initialized"}
+        except ImportError:
+            pass  # private layout moved: fall through to the probe
+        devs = jax.devices()
+        return {"backend": devs[0].platform, "ndev": len(devs),
+                "device_kind": devs[0].device_kind}
+    except Exception as e:  # journal must work before/without a backend
+        return {"backend": None, "ndev": None,
+                "backend_error": f"{type(e).__name__}: {e}"}
+
+
+def _summarize_value(v):
+    """Small, JSON-safe summary of one fetched value: size-1 numerics
+    inline as a float, everything else as shape/dtype metadata. Only a
+    SIZE-1 value is ever materialized (one scalar read); larger arrays
+    are summarized from metadata alone, so a lazy device fetch
+    (``return_numpy=False``) is never synced wholesale."""
+    import numpy as np
+
+    v = getattr(v, "_data", v)
+    shape, dtype = getattr(v, "shape", None), getattr(v, "dtype", None)
+    if shape is None or dtype is None:
+        if isinstance(v, (bool, int, float)):
+            return float(v)
+        return {"repr": repr(v)[:80]}
+    size = 1
+    for s in shape:
+        size *= int(s)
+    try:
+        if size == 1 and np.dtype(dtype).kind in "fiub":
+            return float(np.asarray(v).reshape(()))
+    except (TypeError, ValueError):
+        pass
+    return {"shape": [int(s) for s in shape], "dtype": str(dtype)}
+
+
+class RunJournal:
+    """One run's flight recorder. Usable three ways:
+
+    - process-wide via env: ``PADDLE_TPU_RUN_DIR=/runs/exp7`` auto-starts
+      a journal at import and every instrumented site feeds it;
+    - explicitly: ``j = obs.start_run("/runs/exp7")`` ... ``obs.end_run()``;
+    - scoped: ``with RunJournal("/runs/exp7") as j:`` — an exception
+      leaving the block writes the postmortem before closing.
+    """
+
+    def __init__(self, run_dir=None, *, flush_every=32,
+                 flush_interval_s=5.0, max_bytes=64 << 20,
+                 postmortem_steps=64, detectors=None,
+                 anomaly_callback=None, peak=None, compute_flops=True):
+        run_dir = run_dir or os.environ.get("PADDLE_TPU_RUN_DIR")
+        if not run_dir:
+            raise ValueError(
+                "RunJournal needs a run directory: pass run_dir or set "
+                "PADDLE_TPU_RUN_DIR")
+        self.run_dir = str(run_dir)
+        self.flush_every = max(1, int(flush_every))
+        self.flush_interval_s = float(flush_interval_s)
+        self.max_bytes = int(max_bytes)
+        self.compute_flops = bool(compute_flops)
+        self._lock = threading.RLock()
+        self._buf = []
+        self._file = None
+        self._bytes = 0
+        self._part = 0
+        self._last_flush = time.monotonic()
+        self._closed = True
+        self._step = 0
+        self._t_start = None
+        self._last_timer_ms = None
+        self._last_steps = deque(maxlen=int(postmortem_steps))
+        self._last_events = deque(maxlen=int(postmortem_steps))
+        self._postmortem_written = False
+        self._backend_written = False
+        self.accounting = MFUAccounting(peak=peak)
+        if detectors is None:
+            try:
+                detectors = default_detectors()
+            except Exception as e:
+                # a typo'd PADDLE_TPU_ANOMALY spec must cost the
+                # detectors, not the whole flight recorder
+                import warnings
+
+                warnings.warn(
+                    f"anomaly detectors disabled — bad PADDLE_TPU_ANOMALY "
+                    f"spec? ({type(e).__name__}: {e})", RuntimeWarning)
+                detectors = []
+        self.anomalies = AnomalyEngine(detectors,
+                                       callback=anomaly_callback)
+        # metrics baselines for per-step deltas (interned refs stay live
+        # across obs.metrics.reset())
+        self._m_hits = _metrics.counter("executor.jit_cache.hits")
+        self._m_misses = _metrics.counter("executor.jit_cache.misses")
+        self._m_queue = _metrics.gauge("dataloader.queue_depth")
+        self._m_wait = _metrics.histogram("dataloader.consumer_wait_ms")
+        self._hits0 = self._mis0 = 0
+        self._wait0 = 0.0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if not self._closed:
+                return self
+            os.makedirs(self.run_dir, exist_ok=True)
+            self._file = open(self._path(), "a", encoding="utf-8")
+            self._bytes = self._file.tell()
+            # resume-safe rotation: continue numbering after any parts a
+            # previous run into this dir already rotated out, or
+            # os.replace would silently clobber journal.1.jsonl
+            for fn in os.listdir(self.run_dir):
+                if fn.startswith("journal.") and fn.endswith(".jsonl") \
+                        and fn != JOURNAL_FILE:
+                    try:
+                        self._part = max(self._part,
+                                         int(fn.split(".")[1]))
+                    except ValueError:
+                        pass
+            self._closed = False
+            self._t_start = time.monotonic()
+            self._hits0 = self._m_hits.value
+            self._mis0 = self._m_misses.value
+            self._wait0 = self._m_wait.sum
+            atexit.register(self._atexit)
+        # NOTE: no backend info / peak-FLOPs probe here — start() runs at
+        # import when PADDLE_TPU_RUN_DIR is set, and touching
+        # jax.devices() would pin the platform before the user's own
+        # config (or block on a dead tunnel). A "backend" event is
+        # emitted lazily with the first step record instead.
+        self._write({
+            "t": "run_start", "ts": time.time(), "pid": os.getpid(),
+            "argv": list(sys.argv), "run_dir": self.run_dir,
+            "env": _env_knobs()})
+        return self
+
+    def close(self, exc=None):
+        """Write the run_end summary and release the file. ``exc`` (an
+        exception instance) additionally writes the postmortem first."""
+        with self._lock:
+            if self._closed:
+                return
+            if exc is not None:
+                self.postmortem(exc)
+            self._write({"t": "run_end", "ts": time.time(),
+                         "summary": self.summary()}, _locked=True)
+            self._flush_locked()
+            self._file.close()
+            self._file = None
+            self._closed = True
+            try:
+                atexit.unregister(self._atexit)
+            except Exception:
+                pass
+        global ACTIVE
+        if ACTIVE is self:
+            ACTIVE = None
+
+    def _atexit(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        """Scoped use installs the journal process-wide for the block —
+        the hooks all read ``journal.ACTIVE``, so a non-installed
+        journal would record nothing."""
+        global ACTIVE
+        self._prev_active = ACTIVE
+        self.start()
+        ACTIVE = self
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        global ACTIVE
+        self.close(exc=exc)
+        prev = getattr(self, "_prev_active", None)
+        if ACTIVE is None and prev is not None and not prev.closed:
+            ACTIVE = prev
+        return False
+
+    @property
+    def closed(self):
+        return self._closed
+
+    # -- write path ----------------------------------------------------------
+    def _path(self):
+        return os.path.join(self.run_dir, JOURNAL_FILE)
+
+    def _write(self, rec, _locked=False):
+        line = json.dumps(rec, default=str)
+        lock = self._lock
+        if _locked:
+            self._buf.append(line)
+            self._maybe_flush_locked(len(line))
+            return
+        with lock:
+            if self._closed:
+                return
+            self._buf.append(line)
+            self._maybe_flush_locked(len(line))
+
+    def _maybe_flush_locked(self, nbytes):
+        self._bytes += nbytes + 1
+        now = time.monotonic()
+        if len(self._buf) >= self.flush_every or \
+                now - self._last_flush >= self.flush_interval_s:
+            self._flush_locked()
+
+    def _flush_locked(self):
+        if self._buf and self._file is not None:
+            self._file.write("\n".join(self._buf) + "\n")
+            self._file.flush()
+            self._buf.clear()
+        self._last_flush = time.monotonic()
+        if self._bytes >= self.max_bytes and self._file is not None:
+            self._file.close()
+            self._part += 1
+            os.replace(self._path(), os.path.join(
+                self.run_dir, f"journal.{self._part}.jsonl"))
+            self._file = open(self._path(), "a", encoding="utf-8")
+            self._bytes = 0
+
+    def flush(self):
+        with self._lock:
+            if not self._closed:
+                self._flush_locked()
+
+    # -- recording -----------------------------------------------------------
+    def record_step(self, loss=None, fetches=None, step_ms=None,
+                    examples=None, flops=None, skipped=False,
+                    nonfinite=False, source=None, **extra):
+        """Append one per-step record. ``loss`` must already be a host
+        scalar (or None); ``fetches`` a list of host-side values."""
+        import math
+
+        # host-side value summarization stays OUTSIDE the lock (it may
+        # read a scalar off-device); all shared mutation — step counter,
+        # metric baselines, accounting, detectors, buffers — happens
+        # under ONE lock hold so concurrent steppers can't lose counts
+        # or mutate a detector window mid-iteration
+        if loss is not None:
+            try:
+                loss = float(loss)
+            except (TypeError, ValueError):
+                loss = None
+        if loss is not None and not math.isfinite(loss):
+            nonfinite = True
+        fetch_summary = extra.pop("_fetch_summary", None)
+        if fetch_summary is None and fetches:
+            fetch_summary = [_summarize_value(v) for v in fetches[:4]]
+        with self._lock:
+            if self._closed:
+                return None
+            if not self._backend_written:
+                # deferred from start(): by the first recorded step a
+                # real run has initialized its backend, so this probe is
+                # a metadata read, never a backend-creating side effect
+                self._backend_written = True
+                self.event("backend", peak_flops_per_s=peak_flops(),
+                           **_backend_info())
+            self._step += 1
+            step = self._step
+            hits, misses = self._m_hits.value, self._m_misses.value
+            dhits, dmis = hits - self._hits0, misses - self._mis0
+            self._hits0, self._mis0 = hits, misses
+            wait = self._m_wait.sum
+            dwait, self._wait0 = wait - self._wait0, wait
+            if step_ms is None:
+                step_ms, self._last_timer_ms = self._last_timer_ms, None
+            rec = {"t": "step", "step": step, "ts": time.time(),
+                   "loss": loss, "step_ms": step_ms}
+            if fetch_summary:
+                rec["fetches"] = fetch_summary
+            if examples:
+                rec["examples"] = int(examples)
+                if step_ms:
+                    rec["examples_per_s"] = examples / (step_ms / 1e3)
+            if flops:
+                rec["flops"] = float(flops)
+            if dhits or dmis:
+                rec["jit_cache"] = {"hits": dhits, "misses": dmis}
+            qd = self._m_queue.value
+            if qd:
+                rec["queue_depth"] = qd
+            if dwait > 0:
+                rec["dl_wait_ms"] = dwait
+            if skipped:
+                rec["skipped"] = True
+            if nonfinite:
+                rec["nonfinite"] = True
+            if source:
+                rec["source"] = source
+            rec.update(extra)
+            self.accounting.record(step_ms=step_ms, flops=flops,
+                                   examples=examples,
+                                   productive=not (skipped or nonfinite))
+            self._last_steps.append(rec)
+            self._write(rec, _locked=True)
+            for fired in self.anomalies.observe(rec):
+                self._write({"t": "anomaly", "ts": time.time(), **fired},
+                            _locked=True)
+        return rec
+
+    def event(self, kind, **fields):
+        """Append one discrete event record (compile, checkpoint,
+        resilience recovery, chaos activation, ...)."""
+        with self._lock:
+            if self._closed:
+                return None
+            if kind.startswith("resilience.retry"):
+                self.accounting.note_retry()
+            elif kind in ("resilience.skipped", "resilience.rollbacks") \
+                    and fields.get("source") == "guarded_executor":
+                # ONLY the static guard discards a step AFTER the
+                # executor hook recorded it as productive: reclassify
+                # that record. The eager GuardedStep records its own
+                # skipped steps (its event says source="guarded_step"),
+                # and without the source check it would misreclassify an
+                # unrelated earlier executor step (e.g. an eval pass).
+                # The step's JSONL line is already flushed, so the
+                # correction is carried ON THIS EVENT
+                # (reclassified_step) — readers (tools/run_report.py)
+                # apply it when loading.
+                last = self._last_steps[-1] if self._last_steps else None
+                if last is not None and last.get("source") == "executor" \
+                        and not (last.get("skipped")
+                                 or last.get("nonfinite")):
+                    last["skipped"] = True
+                    self.accounting.reclassify_skip()
+                    fields = dict(fields,
+                                  reclassified_step=last["step"])
+            rec = {"t": "event", "kind": kind, "ts": time.time(),
+                   "step": self._step, **fields}
+            self._last_events.append(rec)
+            self._write(rec, _locked=True)
+        return rec
+
+    def note_step_ms(self, ms):
+        """StepTimer feed: remember the latest timed step so the next
+        ``record_step`` without an explicit ``step_ms`` uses it."""
+        self._last_timer_ms = float(ms)
+
+    # called from the Executor run hook: everything here is host-side
+    # metadata — the FLOPs lookup is non-blocking (a background thread
+    # pays the entry's analysis compile; early steps carry flops=None)
+    def record_executor_run(self, compiled, fetches, run_ms):
+        flops = None
+        if self.compute_flops:
+            from .mfu import entry_flops_nowait
+
+            flops = entry_flops_nowait(compiled)
+        # summarize ONCE and reuse: with lazy fetches
+        # (return_numpy=False) each size-1 summary is a scalar device
+        # read, and doing it twice would double the step's logging sync
+        summary = [_summarize_value(v) for v in fetches[:4]] \
+            if fetches else None
+        loss = summary[0] if summary and isinstance(summary[0], float) \
+            else None
+        return self.record_step(
+            loss=loss, step_ms=run_ms,
+            examples=getattr(compiled, "examples_hint", None),
+            flops=flops, source="executor", _fetch_summary=summary)
+
+    # -- summaries -----------------------------------------------------------
+    def summary(self):
+        out = self.accounting.summary()
+        out["steps"] = self._step
+        if self._t_start is not None:
+            wall = time.monotonic() - self._t_start
+            out["wall_s"] = wall
+            if wall > 0 and self._step:
+                out["steps_per_s"] = self._step / wall
+        out["anomalies_fired"] = len(self.anomalies.fired)
+        return out
+
+    def postmortem(self, exc=None, note=None):
+        """Dump ``postmortem.json``: run header context, the last-K step
+        records and events, the exception (if any), a metrics snapshot,
+        and — when span tracing is on — a Chrome trace next to it."""
+        with self._lock:
+            dump = {
+                "ts": time.time(), "run_dir": self.run_dir,
+                "note": note, "summary": self.summary(),
+                "last_steps": list(self._last_steps),
+                "last_events": list(self._last_events),
+                "anomalies": list(self.anomalies.fired),
+                "metrics": _metrics.snapshot(),
+            }
+            if exc is not None:
+                import traceback
+
+                dump["exception"] = {
+                    "type": type(exc).__name__, "message": str(exc),
+                    "traceback": traceback.format_exception(
+                        type(exc), exc, exc.__traceback__),
+                }
+            path = os.path.join(self.run_dir, POSTMORTEM_FILE)
+            os.makedirs(self.run_dir, exist_ok=True)
+            if _trace.tracing_enabled():
+                # export BEFORE the dump is serialized, so the
+                # postmortem actually carries the trace pointer
+                try:
+                    trace_path = os.path.join(self.run_dir, "trace.json")
+                    _trace.export_chrome_trace(trace_path)
+                    dump["trace_file"] = trace_path
+                except Exception:
+                    pass
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(dump, f, default=str, indent=1)
+            self._postmortem_written = True
+            if not self._closed:
+                self.event("postmortem", path=path,
+                           error=(f"{type(exc).__name__}: {exc}"
+                                  if exc is not None else note))
+                self._flush_locked()
+        return path
+
+
+def start_run(run_dir=None, **kw):
+    """Create, start, and install the process-wide journal (replacing
+    any previous one after closing it). ``run_dir`` defaults to env
+    ``PADDLE_TPU_RUN_DIR``."""
+    global ACTIVE
+    if ACTIVE is not None:
+        ACTIVE.close()
+    j = RunJournal(run_dir, **kw).start()
+    ACTIVE = j
+    return j
+
+
+def end_run(exc=None):
+    """Close and uninstall the process-wide journal (no-op without
+    one). Returns the final summary dict, or None."""
+    global ACTIVE
+    j, ACTIVE = ACTIVE, None
+    if j is None:
+        return None
+    out = j.summary()
+    j.close(exc=exc)
+    return out
+
+
+if os.environ.get("PADDLE_TPU_RUN_DIR"):
+    try:
+        start_run()
+    except Exception as _e:  # an unwritable dir must not poison import —
+        ACTIVE = None        # but a silently-missing flight record is a
+        import warnings      # debugging trap, so say it happened
+
+        warnings.warn(
+            f"PADDLE_TPU_RUN_DIR is set but the run journal failed to "
+            f"start ({type(_e).__name__}: {_e}); no flight record will "
+            "be written", RuntimeWarning)

@@ -1,0 +1,239 @@
+"""MFU / goodput accounting: turn step timings into utilization numbers.
+
+The MLPerf TPU-pod scaling work and the Gemma-on-Cloud-TPU comparisons
+treat three numbers as table stakes for operating a training stack:
+step time, model-FLOPs-utilization (achieved FLOP/s over the chip's
+peak), and goodput (how much of the wall clock went into steps that
+actually advanced the model). The reference keeps these in scattered
+VLOG output; here they are a small accounting layer the run journal
+(``obs.journal``) feeds and summarizes.
+
+FLOPs come from XLA's own ``cost_analysis`` on the compiled executable
+(via ``utils.stats.compiled_stats``), cached per Executor cache entry —
+no analytical per-layer formula to drift out of date. Peak FLOP/s is
+configurable (``set_peak_flops`` / env ``PADDLE_TPU_PEAK_FLOPS``) with a
+built-in per-chip bf16 table; on backends with no known peak (host CPU)
+MFU is reported as ``None`` rather than a made-up number.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = [
+    "PEAK_FLOPS_BY_KIND", "peak_flops", "set_peak_flops",
+    "executable_flops", "entry_flops", "entry_flops_nowait",
+    "entry_analysis", "MFUAccounting", "goodput",
+]
+
+# per-chip peak bf16 FLOP/s (the denominators bench.py uses)
+PEAK_FLOPS_BY_KIND = {
+    "TPU v5e": 197e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6e": 918e12,
+}
+
+_peak_override = None
+
+
+def set_peak_flops(value):
+    """Pin the peak FLOP/s used for MFU (``None`` reverts to
+    autodetect). Env ``PADDLE_TPU_PEAK_FLOPS`` does the same per
+    process."""
+    global _peak_override
+    _peak_override = float(value) if value is not None else None
+
+
+def peak_flops():
+    """Peak FLOP/s for MFU: explicit ``set_peak_flops`` wins, then env
+    ``PADDLE_TPU_PEAK_FLOPS``, then the per-chip table keyed on the
+    backend's device kind. ``None`` when nothing is known (host CPU) —
+    the journal then reports achieved FLOP/s without an MFU ratio."""
+    if _peak_override is not None:
+        return _peak_override
+    env = os.environ.get("PADDLE_TPU_PEAK_FLOPS", "")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        try:
+            from jax._src import xla_bridge as _xb
+
+            if hasattr(_xb, "_backends") and not _xb._backends:
+                # never force backend creation for a ratio: this runs
+                # from RunJournal.close() at atexit, where probing
+                # jax.devices() could pin a platform (or block on a
+                # wedged TPU tunnel) as an exit side effect
+                return None
+        except ImportError:
+            pass
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return None
+    for k, v in PEAK_FLOPS_BY_KIND.items():
+        if k.lower() in kind.lower():
+            return v
+    return None
+
+
+def executable_flops(fn, *example_args):
+    """FLOPs of one invocation of ``fn`` per XLA's cost analysis, or
+    ``None`` when the backend doesn't report it."""
+    from ..utils.stats import compiled_stats
+
+    try:
+        cost = compiled_stats(fn, *example_args)["cost"]
+    except Exception:
+        return None
+    v = cost.get("flops")
+    return float(v) if v else None
+
+
+def entry_analysis(compiled):
+    """Lazy memory/cost attribution for one Executor cache entry
+    (``static_/executor.py`` ``_Compiled``). Lowers the entry's jitted
+    fn against the arg structs captured at build time and reads XLA's
+    ``memory_analysis`` / ``cost_analysis``; the result (possibly
+    ``{"memory": None, "cost": None}`` when the backend reports
+    nothing) is cached on the entry so the compile cost is paid once."""
+    cached = getattr(compiled, "_entry_analysis", None)
+    if cached is not None:
+        return cached
+    out = {"memory": None, "cost": None}
+    structs = getattr(compiled, "arg_structs", None)
+    if structs is not None:
+        from ..utils.stats import _analysis_dict, _cost_dict
+
+        try:
+            c = compiled.fn.lower(*structs).compile()
+        except Exception:
+            c = None
+        if c is not None:
+            try:
+                ma = c.memory_analysis()
+                if ma is not None:
+                    mem = _analysis_dict(ma, (
+                        "argument_size_in_bytes", "output_size_in_bytes",
+                        "temp_size_in_bytes", "alias_size_in_bytes",
+                        "generated_code_size_in_bytes"))
+                    out["memory"] = mem or None
+            except Exception:
+                pass
+            try:
+                cost = _cost_dict(c.cost_analysis())
+                out["cost"] = cost or None
+            except Exception:
+                pass
+    compiled._entry_analysis = out
+    return out
+
+
+def entry_flops(compiled):
+    """FLOPs per run of one Executor cache entry (lazy, cached), or
+    ``None``. BLOCKING: may pay the entry's analysis compile — fine for
+    ``cache_stats(per_entry=True)``, never call it on the step path."""
+    cost = entry_analysis(compiled)["cost"]
+    v = (cost or {}).get("flops")
+    return float(v) if v else None
+
+
+_pending_lock = threading.Lock()
+
+
+def entry_flops_nowait(compiled):
+    """Non-blocking FLOPs for the journal's step path: returns the
+    cached value when the analysis has landed, otherwise kicks the
+    lower+compile off ONCE in a daemon thread and returns None — the
+    step path must never stall behind a second XLA compilation (tens of
+    seconds on a real chip). Early steps of each entry simply carry no
+    flops; the MFU accounting already scopes achieved-FLOP/s to the
+    steps that do."""
+    cached = getattr(compiled, "_entry_analysis", None)
+    if cached is not None:
+        return float((cached["cost"] or {}).get("flops") or 0) or None
+    with _pending_lock:
+        if getattr(compiled, "_entry_analysis_pending", False):
+            return None
+        compiled._entry_analysis_pending = True
+    threading.Thread(target=entry_analysis, args=(compiled,),
+                     daemon=True).start()
+    return None
+
+
+def goodput(productive, skipped=0, retried=0):
+    """Fraction of attempted step work that advanced the model:
+    ``productive / (productive + skipped + retried)``. Skipped steps
+    (nonfinite discard/rollback) and transient retries both burned a
+    step's wall time without contributing. ``None`` with no steps."""
+    total = productive + skipped + retried
+    if total <= 0:
+        return None
+    return productive / float(total)
+
+
+class MFUAccounting:
+    """Accumulates per-step (step_ms, flops, examples) and renders the
+    run-level summary: achieved FLOP/s, MFU vs the configured peak, and
+    goodput from productive/skipped/retried counts."""
+
+    def __init__(self, peak=None):
+        self._peak = peak
+        self.productive = 0
+        self.skipped = 0
+        self.retried = 0
+        self._timed_ms = 0.0
+        self._timed_steps = 0
+        self._flop_ms = 0.0   # step_ms summed only where flops known
+        self._flops = 0.0
+        self._examples = 0
+
+    def record(self, step_ms=None, flops=None, examples=None,
+               productive=True):
+        if productive:
+            self.productive += 1
+        else:
+            self.skipped += 1
+        if step_ms is not None and step_ms > 0:
+            self._timed_ms += step_ms
+            self._timed_steps += 1
+            if flops:
+                self._flops += float(flops)
+                self._flop_ms += step_ms
+        if examples:
+            self._examples += int(examples)
+
+    def note_retry(self, n=1):
+        self.retried += n
+
+    def reclassify_skip(self):
+        """A step already recorded as productive turned out discarded
+        (the static guard detects nonfinite AFTER the executor's step
+        record): move one step from productive to skipped."""
+        if self.productive > 0:
+            self.productive -= 1
+            self.skipped += 1
+
+    def summary(self):
+        peak = self._peak if self._peak is not None else peak_flops()
+        achieved = (self._flops / (self._flop_ms / 1e3)
+                    if self._flop_ms > 0 else None)
+        out = {
+            "productive_steps": self.productive,
+            "skipped_steps": self.skipped,
+            "retries": self.retried,
+            "goodput": goodput(self.productive, self.skipped, self.retried),
+            "mean_step_ms": (self._timed_ms / self._timed_steps
+                             if self._timed_steps else None),
+            "achieved_flops_per_s": achieved,
+            "peak_flops_per_s": peak,
+            "mfu": (achieved / peak if achieved and peak else None),
+        }
+        if self._examples and self._timed_ms > 0:
+            out["examples_per_s"] = self._examples / (self._timed_ms / 1e3)
+        return out

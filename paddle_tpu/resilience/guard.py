@@ -27,10 +27,12 @@ same overflow repeats forever); for eager steps, pass the
 """
 from __future__ import annotations
 
+import time
 import warnings
 
 import numpy as np
 
+from ..obs import journal as _journal
 from ..obs import metrics as _metrics
 from ..utils.nan_guard import NanInfError
 from . import inject
@@ -47,7 +49,11 @@ class GuardStats:
     which ``policy.retry_call`` (the chokepoint every guard funnels
     through) already ticks globally per actual retry."""
 
-    def __init__(self):
+    _COUNTERS = ("steps", "nonfinite", "skipped", "rollbacks", "retries",
+                 "degraded")
+
+    def __init__(self, owner=None):
+        self.owner = owner      # which guard kind journal events cite
         self.steps = 0          # committed (good) steps
         self.nonfinite = 0      # nonfinite detections
         self.skipped = 0        # steps discarded by skip_step
@@ -59,12 +65,19 @@ class GuardStats:
         setattr(self, name, getattr(self, name) + n)
         if n and name != "retries":
             _metrics.counter("resilience." + name).inc(n)
+            # flight recorder: recoveries are journal events (committed
+            # steps are step records, not events — they'd drown the
+            # log). `source` tells the journal WHICH guard recovered:
+            # only the static guard's skips reclassify an executor step
+            if name != "steps" and _journal.ACTIVE is not None:
+                _journal.ACTIVE.event("resilience." + name,
+                                      source=self.owner)
 
     def as_dict(self):
-        return dict(self.__dict__)
+        return {k: getattr(self, k) for k in self._COUNTERS}
 
     def __repr__(self):
-        body = ", ".join(f"{k}={v}" for k, v in self.__dict__.items())
+        body = ", ".join(f"{k}={getattr(self, k)}" for k in self._COUNTERS)
         return f"GuardStats({body})"
 
 
@@ -133,7 +146,7 @@ class GuardedStep:
         self.step = step
         self.policy = policy or RecoveryPolicy()
         self.scaler = scaler
-        self.stats = GuardStats()
+        self.stats = GuardStats(owner="guarded_step")
         self._last_good = None
         if self.policy.on_nonfinite != "raise" and not step.check_nan \
                 and step.scaler is None:
@@ -172,6 +185,7 @@ class GuardedStep:
         pol = self.policy
         if inject.ACTIVE:
             batch = inject.fire("nan_feed", list(batch))
+        t0 = time.perf_counter()
         # snapshot EVERY call: the fused step donates its param/buffer/
         # opt-state buffers, so a failed execution that a user opted
         # into retry (policy.retryable) leaves deleted buffers behind —
@@ -181,7 +195,9 @@ class GuardedStep:
         pre = self._take_snapshot()
 
         def attempt():
-            return self.step(*batch)
+            if inject.ACTIVE:  # same transient-infrastructure chaos
+                inject.fire("transient_execute")  # point the static
+            return self.step(*batch)  # Executor.run exposes
 
         try:
             loss, attempts = retry_call(attempt, pol,
@@ -200,12 +216,27 @@ class GuardedStep:
                 self.stats.inc("rollbacks")
             if self.scaler is not None:
                 self.scaler.notify_skip()
+            if _journal.ACTIVE is not None:
+                _journal.ACTIVE.record_step(
+                    loss=None, step_ms=(time.perf_counter() - t0) * 1e3,
+                    skipped=True, nonfinite=True, source="guarded_step")
             return None
         self.stats.inc("retries", attempts - 1)
         self.stats.inc("steps")
         if pol.on_nonfinite == "rollback" and \
                 self.stats.steps % pol.snapshot_every == 0:
             self._last_good = self._take_snapshot()
+        if _journal.ACTIVE is not None:
+            # journaling an eager step reads the scalar loss to the host
+            # (one scalar sync — the standard cost of logging a loss;
+            # inactive journal = the single None check above)
+            try:
+                lv = float(np.asarray(getattr(loss, "_data", loss)))
+            except (TypeError, ValueError):
+                lv = None
+            _journal.ACTIVE.record_step(
+                loss=lv, step_ms=(time.perf_counter() - t0) * 1e3,
+                source="guarded_step")
         return loss
 
 
@@ -253,7 +284,7 @@ class GuardedExecutor:
         self.found_inf_var = found_inf_var
         self.scan_fetches = bool(scan_fetches)
         self.scan_state = bool(scan_state)
-        self.stats = GuardStats()
+        self.stats = GuardStats(owner="guarded_executor")
         self._last_good = None
         self._degraded = False
 

@@ -348,6 +348,18 @@ class LoaderWorkerInjector(Injector):
 # -- activation --------------------------------------------------------------
 
 
+def _journal_event(kind, **fields):
+    """Chaos (de)activation lands in the run journal — a drill must be
+    distinguishable from a real fault in the flight record. Imported
+    lazily: inject loads very early and must not pull obs eagerly."""
+    try:
+        from ..obs import journal as _journal
+    except Exception:
+        return
+    if _journal.ACTIVE is not None:
+        _journal.ACTIVE.event(kind, **fields)
+
+
 def _sync_hooks():
     """Propagate ACTIVE into runtimes that need a push-style hook (the
     eager dispatcher can't afford a cross-module dict probe per op)."""
@@ -375,6 +387,8 @@ def chaos(point, **cfg):
     prev = ACTIVE.get(point)
     ACTIVE[point] = inj
     _sync_hooks()
+    _journal_event("chaos.activate", point=point, cfg=dict(
+        at=inj.at, times=inj.times, seed=inj.seed, **inj.cfg))
     try:
         yield inj
     finally:
@@ -383,6 +397,7 @@ def chaos(point, **cfg):
         else:
             ACTIVE[point] = prev
         _sync_hooks()
+        _journal_event("chaos.deactivate", point=point, fired=inj.fired)
 
 
 def clear():
@@ -391,32 +406,19 @@ def clear():
     _sync_hooks()
 
 
-def _parse_val(s):
-    try:
-        return int(s)
-    except ValueError:
-        try:
-            return float(s)
-        except ValueError:
-            return s
-
-
 def install_from_env(env=None):
     """Activate chaos points from ``PADDLE_TPU_CHAOS``.
 
-    Format: ``"point:key=val,key=val;point2"`` — e.g.
+    Format (shared ``utils.envspec`` grammar):
+    ``"point:key=val,key=val;point2"`` — e.g.
     ``PADDLE_TPU_CHAOS="transient_compile:times=2;nan_feed:at=3,seed=1"``.
     Returns the list of activated points.
     """
+    from ..utils.envspec import parse_spec
+
     spec = env if env is not None else os.environ.get("PADDLE_TPU_CHAOS", "")
     out = []
-    for entry in filter(None, (e.strip() for e in spec.split(";"))):
-        point, _, rest = entry.partition(":")
-        point = point.strip()
-        cfg = {}
-        for kv in filter(None, (p.strip() for p in rest.split(","))):
-            k, _, v = kv.partition("=")
-            cfg[k.strip()] = _parse_val(v.strip())
+    for point, cfg in parse_spec(spec):
         if point not in INJECTORS:
             raise KeyError(
                 f"PADDLE_TPU_CHAOS names unknown point '{point}' "
@@ -425,6 +427,8 @@ def install_from_env(env=None):
         out.append(point)
     if out:
         _sync_hooks()
+        for point in out:
+            _journal_event("chaos.activate", point=point, source="env")
     return out
 
 

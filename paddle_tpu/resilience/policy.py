@@ -83,13 +83,19 @@ def retry_call(fn, policy=None, describe="", before_retry=None):
     while True:
         try:
             return fn(), attempt + 1
-        except policy.retryable:
+        except policy.retryable as err:
             if attempt >= policy.max_retries:
                 raise
             # the one chokepoint every guard's transient recovery passes
             # through — the process-wide resilience.retries counter lives
             # here (GuardStats keeps the per-guard view)
             _metrics.counter("resilience.retries").inc()
+            from ..obs import journal as _journal
+
+            if _journal.ACTIVE is not None:
+                _journal.ACTIVE.event(
+                    "resilience.retry", attempt=attempt + 1,
+                    error=f"{type(err).__name__}: {err}")
             policy._sleep(policy.backoff_for(attempt))
             if before_retry is not None:
                 before_retry()

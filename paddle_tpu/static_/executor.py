@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..obs import journal as _journal
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..resilience import inject as _chaos
@@ -149,7 +150,12 @@ class Executor:
         # NOTE: jax.jit is lazy — this times trace-side work (analysis
         # passes + jit wrapper construction); XLA's own compile lands in
         # the first executor.run_ms sample for this key
-        _M_COMPILE_MS.observe((time.perf_counter() - t0) * 1e3)
+        compile_ms = (time.perf_counter() - t0) * 1e3
+        _M_COMPILE_MS.observe(compile_ms)
+        if _journal.ACTIVE is not None:
+            _journal.ACTIVE.event(
+                "compile", uid=program._uid, version=program._version,
+                optimize_level=int(optimize_level), ms=compile_ms)
         self._cache[key] = compiled
         return compiled
 
@@ -240,15 +246,61 @@ class Executor:
         compiled.program_version = program._version
         compiled.op_count = len(blk.ops)  # pre-optimization: mirrors _version
         compiled.diagnostics = report
+        compiled.optimize_level = int(optimize_level)
+        # shape/dtype-only arg structs (no device data): what the lazy
+        # per-entry memory/FLOP attribution (obs.mfu.entry_analysis) and
+        # the journal's MFU accounting re-lower against on demand
+        def _struct(name):
+            a = scope.find_var(name)  # .shape/.dtype are metadata reads:
+            return jax.ShapeDtypeStruct(  # no host transfer of the array
+                tuple(a.shape), np.dtype(a.dtype))
+
+        compiled.arg_structs = (
+            [jax.ShapeDtypeStruct(tuple(s), np.dtype(dt))
+             for s, dt in shapes],
+            [_struct(n) for n in updated],
+            [_struct(n) for n in frozen])
+        # examples/step hint for throughput accounting: the largest
+        # leading feed dim (the batch axis in every workload here)
+        lead = [s[0] for s, _ in shapes if len(s) >= 1 and s[0] > 0]
+        compiled.examples_hint = max(lead) if lead else None
         return compiled
 
-    def cache_stats(self):
+    def cache_stats(self, per_entry=False):
         """Hit/miss/size of this executor's jit cache (the process-wide
         view lives in ``obs.metrics`` under ``executor.jit_cache.*``).
         Read-only: the cache-key layout is pinned by tests — never use
-        this to re-key or evict."""
-        return {"hits": self._cache_hits, "misses": self._cache_misses,
-                "size": len(self._cache)}
+        this to re-key or evict.
+
+        ``per_entry=True`` adds an ``entries`` list attributing cache
+        growth: program uid/version/optimize_level plus bytes and FLOPs
+        from the compiled executable's ``memory_analysis`` /
+        ``cost_analysis`` — lazily computed on first request (one
+        re-lower+compile per entry, cached), ``None`` where the backend
+        doesn't report."""
+        out = {"hits": self._cache_hits, "misses": self._cache_misses,
+               "size": len(self._cache)}
+        if per_entry:
+            from ..obs.mfu import entry_analysis
+
+            entries = []
+            for compiled in self._cache.values():
+                a = entry_analysis(compiled)
+                mem = a["memory"]
+                entries.append({
+                    "program_uid": compiled.program_uid,
+                    "program_version": compiled.program_version,
+                    "optimize_level": getattr(compiled, "optimize_level",
+                                              None),
+                    "feed_names": list(compiled.feed_names),
+                    "memory_bytes": (sum(v for k, v in mem.items()
+                                         if k != "generated_code_size")
+                                     if mem else None),
+                    "memory": mem,
+                    "flops": (a["cost"] or {}).get("flops"),
+                })
+            out["entries"] = entries
+        return out
 
     # -- public API ---------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name=None,
@@ -314,7 +366,10 @@ class Executor:
             else:  # lazy Tensors: fetch_ms records only wrapper cost
                 out = [Tensor(f, _internal=True) for f in fetches]
             _M_FETCH_MS.observe((time.perf_counter() - tf) * 1e3)
-        _M_RUN_MS.observe((time.perf_counter() - t0) * 1e3)
+        run_ms = (time.perf_counter() - t0) * 1e3
+        _M_RUN_MS.observe(run_ms)
+        if _journal.ACTIVE is not None:  # flight recorder: one None check
+            _journal.ACTIVE.record_executor_run(compiled, out, run_ms)
         return out
 
     # -- dataset-driven loops (ref: executor.py:1436 train_from_dataset /

@@ -24,6 +24,7 @@ import time
 
 import numpy as np
 
+from ..obs import journal as _journal
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..obs.trace import span  # noqa: F401  (re-export)
@@ -117,6 +118,8 @@ class StepTimer:
         if self._seen > self.skip_first:
             self.times.append(dt)
             self._hist.observe(dt * 1e3)
+            if _journal.ACTIVE is not None:  # feeds the next step record
+                _journal.ACTIVE.note_step_ms(dt * 1e3)
 
     def summary(self):
         if not self.times:

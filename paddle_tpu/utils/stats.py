@@ -30,7 +30,57 @@ def _analysis_dict(obj, keys):
     for k in keys:
         v = getattr(obj, k, None)
         if v is not None:
-            out[k.replace("_in_bytes", "")] = int(v)
+            try:
+                out[k.replace("_in_bytes", "")] = int(v)
+            except (TypeError, ValueError):
+                pass  # backend reported a non-integral curiosity
+    return out
+
+
+def _scalar_value(v):
+    """Best-effort float from one cost_analysis value. Backends are
+    inconsistent here: TPU returns plain floats, CPU has been seen
+    returning numpy scalars, 0-d arrays, and LIST-valued entries (one
+    element per computation) — sum those, since per-computation costs
+    add. Returns None for anything non-numeric."""
+    if isinstance(v, (list, tuple)):
+        parts = [f for f in (_scalar_value(x) for x in v) if f is not None]
+        return sum(parts) if parts else None
+    if isinstance(v, bool):
+        return None
+    try:
+        if np.isscalar(v) or (hasattr(v, "shape") and np.asarray(v).size == 1):
+            return float(np.asarray(v).reshape(()))
+    except (TypeError, ValueError):
+        return None
+    return None
+
+
+def _cost_dict(ca):
+    """Normalize a ``compiled.cost_analysis()`` result to
+    {str: float}. Tolerates None, a dict, a dict-like, a LIST of dicts
+    (per-computation: summed key-wise), and junk values inside."""
+    if ca is None:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        out = {}
+        for d in ca:
+            if not hasattr(d, "items"):
+                continue
+            for k, v in d.items():
+                f = _scalar_value(v)
+                if f is not None:
+                    out[k] = out.get(k, 0.0) + f
+        return out
+    try:
+        items = dict(ca).items()
+    except (TypeError, ValueError):
+        return {}
+    out = {}
+    for k, v in items:
+        f = _scalar_value(v)
+        if f is not None:
+            out[k] = f
     return out
 
 
@@ -58,12 +108,7 @@ def compiled_stats(fn, *example_args):
     except Exception:
         pass
     try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        if ca:
-            out["cost"] = {k: float(v) for k, v in dict(ca).items()
-                           if np.isscalar(v)}
+        out["cost"] = _cost_dict(compiled.cost_analysis())
     except Exception:
         pass
     return out

@@ -64,6 +64,19 @@ def test_obs_report_self_test_passes():
     assert mod.main(["--self-test"]) == 0
 
 
+def test_run_report_self_test_passes():
+    """tools/run_report.py --self-test: a synthetic healthy/regressed
+    run pair written through the real RunJournal API must round-trip the
+    loader, fire the loss_spike + nonfinite_streak detectors on the
+    injected faults (and stay silent on the healthy run), carry an
+    MFU/goodput summary, and the diff gate must flag the injected
+    step-time AND loss regressions — with no false positive on A-vs-A.
+    In-process so it rides the tier-1 command path like the other
+    self-tests."""
+    mod = _load_tool("run_report")
+    assert mod.main(["--self-test"]) == 0
+
+
 def test_chaos_marker_is_registered():
     """tests/test_resilience.py marks itself `chaos`; an unregistered
     marker would warn (or fail under --strict-markers). Pin it."""

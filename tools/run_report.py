@@ -1,0 +1,349 @@
+#!/usr/bin/env python
+"""run_report: render, diff, and self-test paddle_tpu run journals.
+
+The operational front door for ``paddle_tpu.obs.journal`` (the role the
+MLPerf-era run dashboards play): render one run's flight record as a
+table or JSON, or diff two runs as a regression gate — step-time and
+loss-curve deltas against thresholds, exit code 1 when either regresses
+(usable directly as a bench gate in CI).
+
+Usage:
+    python tools/run_report.py RUN_DIR                 # table
+    python tools/run_report.py RUN_DIR --json
+    python tools/run_report.py --diff BASE_DIR NEW_DIR \\
+        [--step-time-threshold 0.25] [--loss-threshold 0.05]
+    python tools/run_report.py --self-test             # synthetic 2-run
+        # pair: asserts the diff flags the injected regression and the
+        # anomaly detectors fire
+
+Wired into tier-1 via tests/test_tooling.py (obs_report/chaos_run
+pattern).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+DEFAULT_STEP_TIME_THRESHOLD = 0.25   # mean step_ms may grow 25%
+DEFAULT_LOSS_THRESHOLD = 0.05        # final loss may grow 5% (relative)
+
+
+# -- loading -----------------------------------------------------------------
+
+
+def _journal_files(path):
+    """The journal file(s) for a run: a file path as-is; a directory
+    yields rotated parts (journal.<n>.jsonl, oldest first) then the
+    live journal.jsonl tail."""
+    if os.path.isfile(path):
+        return [path]
+    parts = []
+    for fn in os.listdir(path):
+        if fn.startswith("journal.") and fn.endswith(".jsonl") \
+                and fn != "journal.jsonl":
+            try:
+                parts.append((int(fn.split(".")[1]), fn))
+            except ValueError:
+                pass
+    out = [os.path.join(path, fn) for _, fn in sorted(parts)]
+    live = os.path.join(path, "journal.jsonl")
+    if os.path.exists(live):
+        out.append(live)
+    return out
+
+
+def load_run(path):
+    """Parse a run's journal into {header, steps, events, anomalies,
+    summary, parse_errors}. Tolerates a torn final line (a crashed
+    writer) — it lands in parse_errors, everything before it loads."""
+    files = _journal_files(path)
+    if not files:
+        raise FileNotFoundError(f"no journal.jsonl under {path!r}")
+    run = {"header": None, "steps": [], "events": [], "anomalies": [],
+           "summary": None, "parse_errors": []}
+    for fp in files:
+        with open(fp, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError as e:
+                    run["parse_errors"].append(
+                        f"{os.path.basename(fp)}:{lineno}: {e}")
+                    continue
+                t = rec.get("t")
+                if t == "run_start":
+                    run["header"] = rec
+                elif t == "step":
+                    run["steps"].append(rec)
+                elif t == "anomaly":
+                    run["anomalies"].append(rec)
+                elif t == "run_end":
+                    run["summary"] = rec.get("summary")
+                elif t == "event":
+                    run["events"].append(rec)
+    by_step = {s.get("step"): s for s in run["steps"]}
+    for e in run["events"]:
+        if e.get("kind") == "backend" and run["header"] is not None:
+            # backend identity is journaled lazily (first step) so the
+            # run header never forces backend init; fold it back in
+            for k in ("backend", "ndev", "device_kind",
+                      "peak_flops_per_s"):
+                if k in e:
+                    run["header"].setdefault(k, e[k])
+        step = e.get("reclassified_step")
+        if step is not None and step in by_step:
+            # the step's line was already durable when the guard
+            # discarded it; the correction rides the event
+            by_step[step]["skipped"] = True
+    return run
+
+
+def _finite_losses(run):
+    return [s["loss"] for s in run["steps"]
+            if isinstance(s.get("loss"), (int, float))
+            and math.isfinite(s["loss"]) and not s.get("skipped")]
+
+
+def _step_times(run):
+    return [s["step_ms"] for s in run["steps"]
+            if isinstance(s.get("step_ms"), (int, float))
+            and s["step_ms"] > 0]
+
+
+def _mean(xs):
+    return sum(xs) / len(xs) if xs else None
+
+
+def _final_loss(run, k=5):
+    """Median of the last k finite losses — robust to one noisy tail
+    step."""
+    tail = sorted(_finite_losses(run)[-k:])
+    return tail[len(tail) // 2] if tail else None
+
+
+# -- render ------------------------------------------------------------------
+
+
+def render_run(run, as_json=False):
+    if as_json:
+        return json.dumps(run, indent=1, default=str, sort_keys=True)
+    hdr = run["header"] or {}
+    times = _step_times(run)
+    losses = _finite_losses(run)
+    lines = [
+        f"run_dir      {hdr.get('run_dir', '?')}",
+        f"backend      {hdr.get('backend')} x{hdr.get('ndev')} "
+        f"({hdr.get('device_kind', '?')})",
+        f"steps        {len(run['steps'])} "
+        f"({sum(1 for s in run['steps'] if s.get('skipped'))} skipped)",
+    ]
+    if losses:
+        lines.append(f"loss         first={losses[0]:.6g} "
+                     f"last={losses[-1]:.6g} min={min(losses):.6g}")
+    if times:
+        st = sorted(times)
+        lines.append(
+            f"step_ms      mean={_mean(times):.3f} "
+            f"p50={st[len(st) // 2]:.3f} max={st[-1]:.3f}")
+    summ = run["summary"]
+    if summ:
+        for k in ("goodput", "mfu", "achieved_flops_per_s",
+                  "examples_per_s", "steps_per_s"):
+            if summ.get(k) is not None:
+                v = summ[k]
+                lines.append(f"{k:<12} "
+                             f"{v:.4g}" if isinstance(v, float) else
+                             f"{k:<12} {v}")
+    kinds = {}
+    for e in run["events"]:
+        kinds[e.get("kind")] = kinds.get(e.get("kind"), 0) + 1
+    if kinds:
+        lines.append("events       " + ", ".join(
+            f"{k}={n}" for k, n in sorted(kinds.items())))
+    if run["anomalies"]:
+        lines.append("anomalies    " + ", ".join(
+            f"{a['name']}@step{a.get('step')}" for a in run["anomalies"]))
+    if run["parse_errors"]:
+        lines.append(f"parse_errors {len(run['parse_errors'])} "
+                     "(torn tail line from a crashed writer?)")
+    return "\n".join(lines)
+
+
+# -- diff (the regression gate) ----------------------------------------------
+
+
+def diff_runs(base, new,
+              step_time_threshold=DEFAULT_STEP_TIME_THRESHOLD,
+              loss_threshold=DEFAULT_LOSS_THRESHOLD):
+    """Compare two loaded runs; regression flags flip when NEW is worse
+    than BASE beyond the thresholds. Returns a plain-data report."""
+    bt, nt = _mean(_step_times(base)), _mean(_step_times(new))
+    bl, nl = _final_loss(base), _final_loss(new)
+    out = {
+        "base_mean_step_ms": bt, "new_mean_step_ms": nt,
+        "step_time_ratio": (nt / bt if bt and nt else None),
+        "step_time_regression": bool(
+            bt and nt and nt > bt * (1.0 + step_time_threshold)),
+        "base_final_loss": bl, "new_final_loss": nl,
+        "loss_regression": False,
+        "base_anomalies": len(base["anomalies"]),
+        "new_anomalies": len(new["anomalies"]),
+    }
+    if bl is not None and nl is not None:
+        margin = loss_threshold * max(abs(bl), 1e-12)
+        out["loss_delta"] = nl - bl
+        out["loss_regression"] = bool(nl - bl > margin)
+    out["regression"] = out["step_time_regression"] or \
+        out["loss_regression"]
+    return out
+
+
+def render_diff(rep, as_json=False):
+    if as_json:
+        return json.dumps(rep, indent=1, default=str, sort_keys=True)
+
+    def fmt(v):
+        return f"{v:.6g}" if isinstance(v, float) else str(v)
+
+    lines = []
+    for k in ("base_mean_step_ms", "new_mean_step_ms", "step_time_ratio",
+              "step_time_regression", "base_final_loss", "new_final_loss",
+              "loss_delta", "loss_regression", "base_anomalies",
+              "new_anomalies", "regression"):
+        if k in rep:
+            lines.append(f"{k:<22} {fmt(rep[k])}")
+    return "\n".join(lines)
+
+
+# -- self-test ---------------------------------------------------------------
+
+
+def _write_run(run_dir, losses, step_ms, flops=1e9, nonfinite_at=()):
+    """Drive the REAL RunJournal API to produce one synthetic run."""
+    from paddle_tpu.obs import journal as J
+
+    j = J.RunJournal(run_dir, flush_every=4, compute_flops=False)
+    j.start()
+    for i, loss in enumerate(losses):
+        if i in nonfinite_at:
+            j.record_step(loss=float("nan"), step_ms=step_ms,
+                          skipped=True, source="self_test")
+        else:
+            j.record_step(loss=loss, step_ms=step_ms, flops=flops,
+                          examples=32, source="self_test")
+    j.close()
+    return j
+
+
+def self_test():
+    from paddle_tpu.obs import mfu
+
+    failures = []
+    mfu.set_peak_flops(2e11)  # synthetic peak so MFU is computable
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            a_dir, b_dir = os.path.join(d, "a"), os.path.join(d, "b")
+            # run A: healthy — loss decays 1.0 -> ~0.1, 10ms steps
+            _write_run(a_dir, [1.0 * (0.93 ** i) for i in range(30)],
+                       step_ms=10.0)
+            # run B: regressed — 3x slower steps, a loss spike after
+            # which the loss never recovers, and a 3-step nonfinite
+            # streak
+            losses = [1.0 * (0.93 ** i) for i in range(30)]
+            losses[20] = 50.0  # spike...
+            for i in range(21, 30):
+                losses[i] = 0.5  # ...then stuck well above run A's tail
+            _write_run(b_dir, losses, step_ms=30.0,
+                       nonfinite_at=(12, 13, 14))
+
+            a, b = load_run(a_dir), load_run(b_dir)
+            if a["parse_errors"] or b["parse_errors"]:
+                failures.append(f"synthetic journals failed to parse: "
+                                f"{a['parse_errors'] + b['parse_errors']}")
+            if a["summary"] is None or not a["summary"].get("mfu"):
+                failures.append("run A summary missing MFU (accounting "
+                                "broke)")
+            if a["summary"] and a["summary"].get("goodput") != 1.0:
+                failures.append("healthy run A must have goodput 1.0, "
+                                f"got {a['summary'].get('goodput')}")
+            bsum = b["summary"] or {}
+            if not (bsum.get("goodput") or 1.0) < 1.0:
+                failures.append("run B's skipped steps must lower "
+                                f"goodput, got {bsum.get('goodput')}")
+
+            fired = {x["name"] for x in b["anomalies"]}
+            for want in ("loss_spike", "nonfinite_streak"):
+                if want not in fired:
+                    failures.append(f"detector {want!r} did not fire on "
+                                    f"the injected run-B fault (fired: "
+                                    f"{sorted(fired)})")
+            if {x["name"] for x in a["anomalies"]}:
+                failures.append("healthy run A fired anomalies: "
+                                f"{a['anomalies']}")
+
+            rep = diff_runs(a, b)
+            if not rep["step_time_regression"]:
+                failures.append("diff missed the 3x step-time regression")
+            if not rep["loss_regression"]:
+                failures.append("diff missed the loss regression")
+            self_rep = diff_runs(a, a)
+            if self_rep["regression"]:
+                failures.append(f"A-vs-A diff false-positived: {self_rep}")
+    finally:
+        mfu.set_peak_flops(None)
+
+    for line in failures:
+        print(f"  FAILED — {line}")
+    if failures:
+        print(f"self-test FAILED: {len(failures)} check(s)")
+        return 1
+    print("self-test passed: journal round-trip, MFU/goodput summary, "
+          "loss_spike + nonfinite_streak detectors, and the diff gate "
+          "flagged the injected regression (and only it)")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="run dir (render) or two run dirs with --diff")
+    ap.add_argument("--diff", action="store_true",
+                    help="diff two runs; exit 1 on regression")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("--step-time-threshold", type=float,
+                    default=DEFAULT_STEP_TIME_THRESHOLD,
+                    help="allowed relative mean-step-time growth")
+    ap.add_argument("--loss-threshold", type=float,
+                    default=DEFAULT_LOSS_THRESHOLD,
+                    help="allowed relative final-loss growth")
+    ap.add_argument("--self-test", action="store_true",
+                    help="synthetic 2-run pair: diff must flag the "
+                         "injected regression, detectors must fire")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if args.diff:
+        if len(args.paths) != 2:
+            ap.error("--diff needs exactly two run dirs")
+        rep = diff_runs(load_run(args.paths[0]), load_run(args.paths[1]),
+                        step_time_threshold=args.step_time_threshold,
+                        loss_threshold=args.loss_threshold)
+        print(render_diff(rep, as_json=args.json))
+        return 1 if rep["regression"] else 0
+    if len(args.paths) != 1:
+        ap.error("need one run dir (or --diff A B / --self-test)")
+    print(render_run(load_run(args.paths[0]), as_json=args.json))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
