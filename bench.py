@@ -107,6 +107,25 @@ def _time_step(step, batch, warmup=3, iters=10):
     return (time.perf_counter() - t0) / iters, float(np.asarray(loss._data))
 
 
+def _step_collectives(step, leg):
+    """CollectiveProfile of a timed train step (obs.spmd), as one
+    structured stderr JSON line + a compact dict for the bench extras.
+    Single-chip legs honestly report zero collectives; never lets a
+    profiling failure cost the leg its numbers."""
+    try:
+        prof = step.collective_profile()
+    except Exception as e:
+        _log(f"{leg}: collective profile failed: {type(e).__name__}: {e}")
+        return None
+    if prof is None:
+        return None
+    _log("COLLECTIVE_PROFILE " + json.dumps(
+        {"leg": leg, **prof}, sort_keys=True))
+    return {"n_ops": prof["n_ops"], "counts": prof["counts"],
+            "total_bytes": prof["total_bytes"],
+            "wire_bytes": prof["wire_bytes"]}
+
+
 def bench_bert(B=64, L=128):
     import paddle_tpu as pt
     from paddle_tpu import optim
@@ -132,7 +151,8 @@ def bench_bert(B=64, L=128):
     tokens_s = B * L / dt
     mfu = _mfu(n_params, cfg.layers, cfg.hidden, B, L, dt)
     return {"tokens_per_sec": tokens_s, "step_ms": dt * 1e3, "mfu": mfu,
-            "loss": loss, "params": n_params}
+            "loss": loss, "params": n_params,
+            "collectives": _step_collectives(step, "bert")}
 
 
 def bench_resnet50(B=128, size=224):
@@ -160,7 +180,8 @@ def bench_resnet50(B=128, size=224):
     flops_img = RESNET50_TRAIN_FLOPS_PER_IMG * (size / 224.0) ** 2
     mfu = flops_img * B / dt / _peak_flops()
     return {"imgs_per_sec": B / dt, "step_ms": dt * 1e3, "mfu": mfu,
-            "loss": loss}
+            "loss": loss,
+            "collectives": _step_collectives(step, "resnet50")}
 
 
 def bench_gpt(B=16, L=1024):
@@ -185,7 +206,8 @@ def bench_gpt(B=16, L=1024):
     tokens_s = B * L / dt
     mfu = _mfu(n_params, cfg.layers, cfg.hidden, B, L, dt)
     return {"tokens_per_sec": tokens_s, "step_ms": dt * 1e3, "mfu": mfu,
-            "loss": loss, "params": n_params}
+            "loss": loss, "params": n_params,
+            "collectives": _step_collectives(step, "gpt")}
 
 
 def bench_wmt_beam(B=16, L_src=32, beam=4, max_len=32):
@@ -566,6 +588,13 @@ def main():
 
 def _score(results, headline, extras):
     extras.update(results.pop("_extras", {}))
+    # structured collective accounting per train leg (obs.spmd): rides
+    # the one-line JSON so BENCH records carry comm volumes, not prose
+    coll = {leg: results[leg]["collectives"]
+            for leg in ("bert", "resnet50", "gpt")
+            if leg in results and results[leg].get("collectives")}
+    if coll:
+        extras["collectives"] = coll
     if CPU_FALLBACK:
         # the numbers below came from smoke shapes on host CPU after the
         # TPU tunnel refused to init: label them so nobody reads them as

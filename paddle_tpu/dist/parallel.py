@@ -91,6 +91,12 @@ class DistributedTrainStep(TrainStep):
         with self.mesh:
             return super().__call__(*placed)
 
+    def collective_profile(self, mesh=None):
+        """Collective accounting of the compiled SPMD step, attributed
+        to this step's mesh axes (see ``TrainStep.collective_profile``/
+        ``obs.spmd``)."""
+        return super().collective_profile(mesh=mesh or self.mesh)
+
 
 class DataParallel:
     """ref: paddle.DataParallel(layer). Under SPMD the wrapper is only an

@@ -42,6 +42,7 @@ __all__ = [
     "save_vars", "load_vars", "save_params", "load_params",
     "save_persistables", "load_persistables",
     "get_program_parameter", "get_program_persistable_vars",
+    "persistable_footprint",
     "load_program_state", "set_program_state", "batch",
 ]
 
@@ -561,6 +562,39 @@ def get_program_parameter(program):
 def get_program_persistable_vars(program):
     """ref: io.py get_program_persistable_vars."""
     return _program_vars(program, lambda v: v.persistable)
+
+
+def persistable_footprint(program, scope=None):
+    """Byte footprint of a Program's persistables as materialized in the
+    scope — what a checkpoint of this program writes and what every
+    device holds when the Executor replicates persistables under SPMD
+    (``obs.spmd.sharding_report`` reports the same totals per cache
+    entry). Returns ``{"vars": [{name, shape, dtype, bytes}],
+    "total_bytes": N}``; vars not yet in the scope report their
+    metadata with ``bytes=None``. Metadata reads only — never syncs an
+    array off-device."""
+    import numpy as _np
+
+    from ..static_.program import global_scope
+
+    scope = scope or global_scope()
+    rows = []
+    total = 0
+    for v in get_program_persistable_vars(program):
+        arr = scope.find_var(v.name)
+        if arr is not None:
+            shape = tuple(int(s) for s in arr.shape)
+            dtype = str(_np.dtype(arr.dtype))
+            nbytes = int(_np.prod(shape)) * _np.dtype(arr.dtype).itemsize \
+                if shape else _np.dtype(arr.dtype).itemsize
+            total += nbytes
+        else:
+            shape = tuple(v.shape) if v.shape is not None else None
+            dtype = str(getattr(v, "dtype", None))
+            nbytes = None
+        rows.append({"name": v.name, "shape": shape, "dtype": dtype,
+                     "bytes": nbytes})
+    return {"vars": rows, "total_bytes": total}
 
 
 def _var_values(program, vars_, scope=None):

@@ -84,6 +84,8 @@ class TrainStep:
                            if isinstance(p, Parameter) and p.trainable]
         self._donate = donate
         self._compiled = {}
+        self._arg_structs = {}   # sig -> shape/dtype/sharding structs
+        self._profiles = {}      # sig -> cached CollectiveProfile
         self.last_found_inf = None  # device bool after each call
         self._scaler_state = scaler.state() if scaler is not None else {}
         # materialize optimizer slots eagerly so they join the carried state
@@ -202,6 +204,40 @@ class TrainStep:
         buf_arrs = [b._data for b in self._buffers]
         lr = jnp.float32(opt.get_lr())
         key = prandom.next_key()
+        if sig not in self._arg_structs:
+            # once per compiled shape (NOT per step): shape/dtype/sharding
+            # structs of the call args, so obs.spmd can later re-lower the
+            # exact executable for its CollectiveProfile without holding
+            # the (donated) arrays alive. Only COMMITTED shardings are
+            # kept (a mesh-placed param next to an uncommitted lr scalar
+            # must not read as a device conflict); uncommitted args
+            # replicate over the committed arrays' mesh.
+            args = (param_arrs, buf_arrs, opt_state, lr, key, arrays,
+                    self._scaler_state)
+            mesh = None
+            for a in jax.tree_util.tree_leaves(args):
+                sh = getattr(a, "sharding", None)
+                if getattr(a, "committed", False) and \
+                        getattr(sh, "mesh", None) is not None:
+                    mesh = sh.mesh
+                    break
+            rep = None if mesh is None else \
+                jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec())
+
+            def _struct(a):
+                try:
+                    sh = a.sharding if getattr(a, "committed", False) \
+                        else rep
+                    if sh is None:
+                        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                sharding=sh)
+                except (AttributeError, TypeError):
+                    return jax.ShapeDtypeStruct(np.shape(a),
+                                                np.asarray(a).dtype)
+
+            self._arg_structs[sig] = jax.tree_util.tree_map(_struct, args)
         loss, new_params, new_bufs, new_state, new_scaler, found_bad = fn(
             param_arrs, buf_arrs, opt_state, lr, key, arrays,
             self._scaler_state)
@@ -229,6 +265,31 @@ class TrainStep:
                 f"(loss={float(np.asarray(loss))})",
                 summary=s if s["num_nan"] or s["num_inf"] else None)
         return Tensor(loss, _internal=True)
+
+    def collective_profile(self, mesh=None):
+        """CollectiveProfile of the most recently compiled step shape
+        (``obs.spmd``): per-kind collective op counts and byte volumes
+        parsed from the executable's HLO, attributed to ``mesh``'s axes
+        when given (``DistributedTrainStep`` passes its own mesh).
+        BLOCKING — re-lowers the step against the arg structs captured
+        at compile time (shardings preserved), so call it from reporting
+        code, never inside the training loop. None before the first
+        step or when lowering fails; cached per (compiled shape, mesh)
+        — a failed lowering is NOT cached, so a transient backend
+        hiccup doesn't poison later calls."""
+        if not self._arg_structs:
+            return None
+        sig = next(reversed(self._arg_structs))
+        key = (sig, None if mesh is None else tuple(mesh.shape.items()))
+        if key not in self._profiles:
+            from ..obs import spmd as _spmd
+
+            prof = _spmd.profile_jit_fn(
+                self._compiled[sig], self._arg_structs[sig], mesh=mesh)
+            if prof is None:
+                return None
+            self._profiles[key] = prof
+        return self._profiles[key]
 
 
 class StaticFunction:

@@ -22,6 +22,11 @@ plus the production metrics layer the reference keeps in VLOG counters:
 - ``mfu``      — MFU/goodput accounting from XLA ``cost_analysis``
   FLOPs per compiled executable + the configured peak
   (``PADDLE_TPU_PEAK_FLOPS`` / ``mfu.set_peak_flops``).
+- ``spmd``     — SPMD observability: CollectiveProfile (per-kind
+  collective counts/bytes parsed from the executable's HLO, attributed
+  to mesh axes), comm roofline vs ``PADDLE_TPU_ICI_BW``/chip table,
+  ShardingReport per Executor cache entry, per-device memory gauges +
+  Chrome-trace device lanes (``tools/shard_report.py`` is the CLI).
 
 Instrumented sites (all zero-overhead when idle — one flag/None check,
 no host sync, mirroring the ``resilience.inject`` ``if ACTIVE`` hooks):
@@ -55,7 +60,7 @@ from __future__ import annotations
 
 import os as _os
 
-from . import metrics, trace, report, anomaly, mfu, journal  # noqa: F401
+from . import metrics, trace, report, anomaly, mfu, journal, spmd  # noqa: F401,E501
 from .metrics import (counter, gauge, histogram, snapshot, reset,  # noqa: F401
                       Counter, Gauge, Histogram, Registry, REGISTRY)
 from .trace import (span, enable_tracing, disable_tracing,  # noqa: F401
@@ -64,7 +69,7 @@ from .trace import (span, enable_tracing, disable_tracing,  # noqa: F401
 from .journal import RunJournal, start_run, end_run  # noqa: F401
 
 __all__ = [
-    "metrics", "trace", "report", "anomaly", "mfu", "journal",
+    "metrics", "trace", "report", "anomaly", "mfu", "journal", "spmd",
     "counter", "gauge", "histogram", "snapshot", "reset",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "span", "enable_tracing", "disable_tracing", "tracing_enabled",

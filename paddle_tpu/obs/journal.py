@@ -87,8 +87,20 @@ def _backend_info():
         except ImportError:
             pass  # private layout moved: fall through to the probe
         devs = jax.devices()
-        return {"backend": devs[0].platform, "ndev": len(devs),
-                "device_kind": devs[0].device_kind}
+        kinds = {}
+        for d in devs:
+            kinds[d.device_kind] = kinds.get(d.device_kind, 0) + 1
+        out = {"backend": devs[0].platform,
+               "platform": devs[0].platform,
+               "ndev": len(devs), "device_count": len(devs),
+               "device_kind": devs[0].device_kind,
+               "device_kinds": kinds}
+        if len(devs) <= 64:  # keep the event record bounded on big pods
+            out["devices"] = [
+                {"id": int(d.id), "kind": d.device_kind,
+                 "process": int(getattr(d, "process_index", 0))}
+                for d in devs]
+        return out
     except Exception as e:  # journal must work before/without a backend
         return {"backend": None, "ndev": None,
                 "backend_error": f"{type(e).__name__}: {e}"}
@@ -313,7 +325,7 @@ class RunJournal:
     # -- recording -----------------------------------------------------------
     def record_step(self, loss=None, fetches=None, step_ms=None,
                     examples=None, flops=None, skipped=False,
-                    nonfinite=False, source=None, **extra):
+                    nonfinite=False, source=None, comm=None, **extra):
         """Append one per-step record. ``loss`` must already be a host
         scalar (or None); ``fetches`` a list of host-side values."""
         import math
@@ -369,6 +381,8 @@ class RunJournal:
                 rec["queue_depth"] = qd
             if dwait > 0:
                 rec["dl_wait_ms"] = dwait
+            if comm:
+                rec["comm"] = comm
             if skipped:
                 rec["skipped"] = True
             if nonfinite:
@@ -376,9 +390,11 @@ class RunJournal:
             if source:
                 rec["source"] = source
             rec.update(extra)
-            self.accounting.record(step_ms=step_ms, flops=flops,
-                                   examples=examples,
-                                   productive=not (skipped or nonfinite))
+            self.accounting.record(
+                step_ms=step_ms, flops=flops, examples=examples,
+                productive=not (skipped or nonfinite),
+                comm_bytes=(comm or {}).get("total_bytes"),
+                wire_bytes=(comm or {}).get("wire_bytes"))
             self._last_steps.append(rec)
             self._write(rec, _locked=True)
             for fired in self.anomalies.observe(rec):
@@ -426,14 +442,29 @@ class RunJournal:
         self._last_timer_ms = float(ms)
 
     # called from the Executor run hook: everything here is host-side
-    # metadata — the FLOPs lookup is non-blocking (a background thread
-    # pays the entry's analysis compile; early steps carry flops=None)
+    # metadata — the FLOPs/comm lookup is non-blocking (a background
+    # thread pays the entry's analysis compile; early steps carry
+    # flops=None and no comm attribution)
     def record_executor_run(self, compiled, fetches, run_ms):
-        flops = None
+        flops = comm = None
         if self.compute_flops:
-            from .mfu import entry_flops_nowait
+            from .mfu import entry_analysis_nowait
 
-            flops = entry_flops_nowait(compiled)
+            analysis = entry_analysis_nowait(compiled)
+            if analysis is not None:
+                flops = float((analysis["cost"] or {}).get("flops")
+                              or 0) or None
+                prof = analysis.get("collectives")
+                if prof and prof.get("n_ops"):
+                    # the entry's per-execution collective volume IS the
+                    # step's comm delta (one executable run per step)
+                    comm = {
+                        "total_bytes": prof["total_bytes"],
+                        "wire_bytes": prof["wire_bytes"],
+                        "all_reduce_bytes":
+                            prof["bytes"].get("all-reduce", 0),
+                        "n_ops": prof["n_ops"],
+                    }
         # summarize ONCE and reuse: with lazy fetches
         # (return_numpy=False) each size-1 summary is a scalar device
         # read, and doing it twice would double the step's logging sync
@@ -444,7 +475,8 @@ class RunJournal:
         return self.record_step(
             loss=loss, step_ms=run_ms,
             examples=getattr(compiled, "examples_hint", None),
-            flops=flops, source="executor", _fetch_summary=summary)
+            flops=flops, comm=comm, source="executor",
+            _fetch_summary=summary)
 
     # -- summaries -----------------------------------------------------------
     def summary(self):

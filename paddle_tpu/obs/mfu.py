@@ -23,7 +23,7 @@ import threading
 __all__ = [
     "PEAK_FLOPS_BY_KIND", "peak_flops", "set_peak_flops",
     "executable_flops", "entry_flops", "entry_flops_nowait",
-    "entry_analysis", "MFUAccounting", "goodput",
+    "entry_analysis", "entry_analysis_nowait", "MFUAccounting", "goodput",
 ]
 
 # per-chip peak bf16 FLOP/s (the denominators bench.py uses)
@@ -96,16 +96,17 @@ def executable_flops(fn, *example_args):
 
 
 def entry_analysis(compiled):
-    """Lazy memory/cost attribution for one Executor cache entry
-    (``static_/executor.py`` ``_Compiled``). Lowers the entry's jitted
-    fn against the arg structs captured at build time and reads XLA's
-    ``memory_analysis`` / ``cost_analysis``; the result (possibly
-    ``{"memory": None, "cost": None}`` when the backend reports
-    nothing) is cached on the entry so the compile cost is paid once."""
+    """Lazy memory/cost/collective attribution for one Executor cache
+    entry (``static_/executor.py`` ``_Compiled``). Lowers the entry's
+    jitted fn against the arg structs captured at build time and reads
+    XLA's ``memory_analysis`` / ``cost_analysis`` plus the executable's
+    HLO text for the CollectiveProfile (``obs.spmd``); the result
+    (fields possibly None when the backend reports nothing) is cached
+    on the entry so the compile cost is paid once."""
     cached = getattr(compiled, "_entry_analysis", None)
     if cached is not None:
         return cached
-    out = {"memory": None, "cost": None}
+    out = {"memory": None, "cost": None, "collectives": None}
     structs = getattr(compiled, "arg_structs", None)
     if structs is not None:
         from ..utils.stats import _analysis_dict, _cost_dict
@@ -130,6 +131,18 @@ def entry_analysis(compiled):
                 out["cost"] = cost or None
             except Exception:
                 pass
+            try:
+                from . import spmd as _spmd
+
+                mesh = None
+                axes = getattr(compiled, "mesh_axes", None)
+                if axes is not None:
+                    mesh = (axes, getattr(compiled, "mesh_device_ids",
+                                          None))
+                out["collectives"] = _spmd.collective_profile(
+                    c.as_text(), mesh=mesh)
+            except Exception:
+                pass
     compiled._entry_analysis = out
     return out
 
@@ -146,17 +159,17 @@ def entry_flops(compiled):
 _pending_lock = threading.Lock()
 
 
-def entry_flops_nowait(compiled):
-    """Non-blocking FLOPs for the journal's step path: returns the
-    cached value when the analysis has landed, otherwise kicks the
-    lower+compile off ONCE in a daemon thread and returns None — the
-    step path must never stall behind a second XLA compilation (tens of
-    seconds on a real chip). Early steps of each entry simply carry no
-    flops; the MFU accounting already scopes achieved-FLOP/s to the
-    steps that do."""
+def entry_analysis_nowait(compiled):
+    """Non-blocking ``entry_analysis`` for the journal's step path:
+    returns the cached analysis dict when it has landed, otherwise
+    kicks the lower+compile off ONCE in a daemon thread and returns
+    None — the step path must never stall behind a second XLA
+    compilation (tens of seconds on a real chip). Early steps of each
+    entry simply carry no flops/comm attribution; the MFU accounting
+    already scopes achieved-FLOP/s to the steps that do."""
     cached = getattr(compiled, "_entry_analysis", None)
     if cached is not None:
-        return float((cached["cost"] or {}).get("flops") or 0) or None
+        return cached
     with _pending_lock:
         if getattr(compiled, "_entry_analysis_pending", False):
             return None
@@ -164,6 +177,15 @@ def entry_flops_nowait(compiled):
     threading.Thread(target=entry_analysis, args=(compiled,),
                      daemon=True).start()
     return None
+
+
+def entry_flops_nowait(compiled):
+    """Non-blocking FLOPs for one entry (see
+    ``entry_analysis_nowait``); None until the analysis lands."""
+    cached = entry_analysis_nowait(compiled)
+    if cached is None:
+        return None
+    return float((cached["cost"] or {}).get("flops") or 0) or None
 
 
 def goodput(productive, skipped=0, retried=0):
@@ -192,9 +214,13 @@ class MFUAccounting:
         self._flop_ms = 0.0   # step_ms summed only where flops known
         self._flops = 0.0
         self._examples = 0
+        self._comm_bytes = 0.0  # collective payload, steps where known
+        self._wire_bytes = 0.0
+        self._comm_steps = 0
+        self._comm_flops = 0.0  # flops summed on comm-attributed steps
 
     def record(self, step_ms=None, flops=None, examples=None,
-               productive=True):
+               productive=True, comm_bytes=None, wire_bytes=None):
         if productive:
             self.productive += 1
         else:
@@ -205,6 +231,12 @@ class MFUAccounting:
             if flops:
                 self._flops += float(flops)
                 self._flop_ms += step_ms
+        if comm_bytes:
+            self._comm_bytes += float(comm_bytes)
+            self._wire_bytes += float(wire_bytes or comm_bytes)
+            self._comm_steps += 1
+            if flops:
+                self._comm_flops += float(flops)
         if examples:
             self._examples += int(examples)
 
@@ -236,4 +268,18 @@ class MFUAccounting:
         }
         if self._examples and self._timed_ms > 0:
             out["examples_per_s"] = self._examples / (self._timed_ms / 1e3)
+        if self._comm_steps:
+            # compute-vs-comm roofline over the comm-attributed steps
+            # (obs.spmd): None fields when no ICI bandwidth is known
+            from .spmd import comm_roofline
+
+            out["comm_bytes_per_step"] = self._comm_bytes / self._comm_steps
+            rl = comm_roofline(
+                {"total_bytes": self._comm_bytes / self._comm_steps,
+                 "wire_bytes": self._wire_bytes / self._comm_steps},
+                flops=(self._comm_flops / self._comm_steps
+                       if self._comm_flops else None),
+                peak=peak)
+            out["comm_share"] = rl["comm_share"]
+            out["comm_bound"] = rl["bound"]
         return out

@@ -29,13 +29,21 @@ import time
 __all__ = [
     "span", "enable_tracing", "disable_tracing", "tracing_enabled",
     "clear_trace", "trace_events", "export_chrome_trace",
-    "DEFAULT_CAPACITY",
+    "device_counter", "DEFAULT_CAPACITY", "DEVICE_PID_BASE",
 ]
 
 DEFAULT_CAPACITY = 65536
+# per-device lanes render as separate Chrome-trace processes; their pids
+# are offset far above any real host pid so they never collide with the
+# host lane
+DEVICE_PID_BASE = 1 << 20
 
 _enabled = False
 _events: collections.deque = collections.deque(maxlen=DEFAULT_CAPACITY)
+# per-device counter samples (obs.spmd.update_device_gauges feeds this):
+# (device_id, name, ts µs, value); bounded like the span ring
+_device_samples: collections.deque = collections.deque(maxlen=16384)
+_device_labels: dict = {}  # device_id -> lane label for the trace meta
 # one perf-counter epoch per process: every span's ts is an offset from
 # here, so spans from different threads land on one comparable timeline
 _EPOCH = time.perf_counter()
@@ -95,6 +103,23 @@ def tracing_enabled():
 
 def clear_trace():
     _events.clear()
+    _device_samples.clear()
+    _device_labels.clear()
+
+
+def device_counter(device_id, name, value, label=None):
+    """Record one per-device counter sample (e.g. HBM bytes in use) for
+    the Chrome trace's per-device pid lanes. A no-op when tracing is
+    disabled — callers on a hot path should gate on
+    ``tracing_enabled()`` themselves (``obs.spmd.update_device_gauges``
+    does)."""
+    if not _enabled:
+        return
+    if label is not None:
+        _device_labels[int(device_id)] = label
+    _device_samples.append((int(device_id), name,
+                            (time.perf_counter() - _EPOCH) * 1e6,
+                            float(value)))
 
 
 def trace_events():
@@ -113,6 +138,18 @@ def export_chrome_trace(path):
               for n, ts, dur, tid, attrs in list(_events)]
     events.append({"ph": "M", "pid": pid, "name": "process_name",
                    "args": {"name": "paddle_tpu"}})
+    # per-device pid lanes: counter samples (HBM gauges) render as one
+    # Chrome-trace "process" per device, below the host span lane
+    lanes = set()
+    for dev_id, name, ts, value in list(_device_samples):
+        lane = DEVICE_PID_BASE + dev_id
+        lanes.add((lane, dev_id))
+        events.append({"ph": "C", "pid": lane, "name": name, "ts": ts,
+                       "args": {"value": value}})
+    for lane, dev_id in sorted(lanes):
+        events.append({"ph": "M", "pid": lane, "name": "process_name",
+                       "args": {"name": _device_labels.get(
+                           dev_id, f"device {dev_id}")}})
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -121,7 +158,7 @@ def export_chrome_trace(path):
         # let an exotic attr make the whole export unserializable
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f,
                   default=str)
-    return len(events) - 1
+    return sum(1 for e in events if e["ph"] == "X")
 
 
 if os.environ.get("PADDLE_TPU_TRACE", "").lower() not in ("", "0", "false"):

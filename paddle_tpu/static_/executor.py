@@ -156,6 +156,13 @@ class Executor:
             _journal.ACTIVE.event(
                 "compile", uid=program._uid, version=program._version,
                 optimize_level=int(optimize_level), ms=compile_ms)
+            # one sharding event per compiled entry: feed/persistable
+            # placement + footprints (metadata only — obs.spmd reads the
+            # structs captured above, no device or XLA work)
+            from ..obs import spmd as _spmd
+
+            _journal.ACTIVE.event("sharding",
+                                  **_spmd.sharding_summary(compiled))
         self._cache[key] = compiled
         return compiled
 
@@ -240,6 +247,16 @@ class Executor:
         compiled = _Compiled(jit_fn, feed_names, updated + frozen, updated,
                              fetch_names)
         compiled.feed_shardings = in_sh[0] if data_parallel else None
+        if data_parallel:
+            # mesh identity for collective attribution + sharding
+            # reports (obs.spmd): axis sizes and the device-id layout
+            # the HLO replica groups refer to
+            compiled.mesh_axes = dict(mesh.shape)
+            compiled.mesh_device_ids = np.vectorize(
+                lambda d: int(d.id))(mesh.devices)
+        else:
+            compiled.mesh_axes = None
+            compiled.mesh_device_ids = None
         compiled.updated = updated
         compiled.frozen = frozen
         compiled.program_uid = program._uid
@@ -273,9 +290,10 @@ class Executor:
         this to re-key or evict.
 
         ``per_entry=True`` adds an ``entries`` list attributing cache
-        growth: program uid/version/optimize_level plus bytes and FLOPs
-        from the compiled executable's ``memory_analysis`` /
-        ``cost_analysis`` — lazily computed on first request (one
+        growth: program uid/version/optimize_level plus bytes, FLOPs,
+        and the ``collectives`` CollectiveProfile (per-kind counts/byte
+        volumes, mesh-axis attribution — ``obs.spmd``) from the
+        compiled executable — lazily computed on first request (one
         re-lower+compile per entry, cached), ``None`` where the backend
         doesn't report."""
         out = {"hits": self._cache_hits, "misses": self._cache_misses,
@@ -298,6 +316,8 @@ class Executor:
                                      if mem else None),
                     "memory": mem,
                     "flops": (a["cost"] or {}).get("flops"),
+                    "collectives": a.get("collectives"),
+                    "mesh": getattr(compiled, "mesh_axes", None),
                 })
             out["entries"] = entries
         return out

@@ -265,9 +265,10 @@ class TestInactiveHooksDoNothing:
     def test_step_paths_never_touch_a_journal_when_inactive(
             self, tmp_path, monkeypatch):
         """With ACTIVE None, the hooks must be a single None check: every
-        RunJournal entry point is poisoned to raise, and the executor,
-        guarded step, StepTimer, dataloader, and checkpoint paths must
-        still run clean."""
+        RunJournal entry point is poisoned to raise — and so are the
+        PR-5 SPMD observability entry points (sharding summaries, device
+        gauges) — and the executor, guarded step, StepTimer, dataloader,
+        and checkpoint paths must still run clean."""
         assert journal.ACTIVE is None
 
         def boom(*a, **k):
@@ -276,6 +277,12 @@ class TestInactiveHooksDoNothing:
         for name in ("record_step", "record_executor_run", "event",
                      "note_step_ms", "postmortem"):
             monkeypatch.setattr(journal.RunJournal, name, boom)
+        # the per-compile sharding event and device telemetry must also
+        # stay behind the ACTIVE/tracing gates
+        from paddle_tpu.obs import spmd
+
+        monkeypatch.setattr(spmd, "sharding_summary", boom)
+        monkeypatch.setattr(spmd, "update_device_gauges", boom)
 
         pt.enable_static()
         try:

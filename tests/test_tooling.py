@@ -77,6 +77,18 @@ def test_run_report_self_test_passes():
     assert mod.main(["--self-test"]) == 0
 
 
+def test_shard_report_self_test_passes():
+    """tools/shard_report.py --self-test: canned-HLO collective parsing
+    must match hand-computed byte volumes (async pairs, iota replica
+    groups, mesh-axis attribution), and an 8-fake-device
+    with_data_parallel entry must report nonzero all-reduce bytes with
+    feeds sharded on 'data' and correct per-device footprints. In-
+    process so it rides the tier-1 command path like the other
+    self-tests."""
+    mod = _load_tool("shard_report")
+    assert mod.main(["--self-test"]) == 0
+
+
 def test_chaos_marker_is_registered():
     """tests/test_resilience.py marks itself `chaos`; an unregistered
     marker would warn (or fail under --strict-markers). Pin it."""

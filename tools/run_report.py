@@ -3,9 +3,10 @@
 
 The operational front door for ``paddle_tpu.obs.journal`` (the role the
 MLPerf-era run dashboards play): render one run's flight record as a
-table or JSON, or diff two runs as a regression gate — step-time and
-loss-curve deltas against thresholds, exit code 1 when either regresses
-(usable directly as a bench gate in CI).
+table or JSON, or diff two runs as a regression gate — step-time,
+loss-curve, and collective-traffic (all-reduce bytes/step) deltas
+against thresholds, exit code 1 when any regresses (usable directly as
+a bench gate in CI).
 
 Usage:
     python tools/run_report.py RUN_DIR                 # table
@@ -32,6 +33,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 DEFAULT_STEP_TIME_THRESHOLD = 0.25   # mean step_ms may grow 25%
 DEFAULT_LOSS_THRESHOLD = 0.05        # final loss may grow 5% (relative)
+DEFAULT_COMM_THRESHOLD = 0.10        # all-reduce bytes/step may grow 10%
 
 
 # -- loading -----------------------------------------------------------------
@@ -123,6 +125,15 @@ def _mean(xs):
     return sum(xs) / len(xs) if xs else None
 
 
+def _comm_bytes_per_step(run, key="all_reduce_bytes"):
+    """Mean collective bytes over the steps that carry a comm record
+    (the journal attributes comm once the entry's lazy analysis lands);
+    None when no step was attributed."""
+    vals = [s["comm"].get(key, 0) for s in run["steps"]
+            if isinstance(s.get("comm"), dict)]
+    return _mean(vals)
+
+
 def _final_loss(run, k=5):
     """Median of the last k finite losses — robust to one noisy tail
     step."""
@@ -154,10 +165,15 @@ def render_run(run, as_json=False):
         lines.append(
             f"step_ms      mean={_mean(times):.3f} "
             f"p50={st[len(st) // 2]:.3f} max={st[-1]:.3f}")
+    comm = _comm_bytes_per_step(run)
+    if comm is not None:
+        total = _comm_bytes_per_step(run, "total_bytes")
+        lines.append(f"comm/step    all-reduce={comm:.4g}B "
+                     f"total={total:.4g}B")
     summ = run["summary"]
     if summ:
         for k in ("goodput", "mfu", "achieved_flops_per_s",
-                  "examples_per_s", "steps_per_s"):
+                  "examples_per_s", "steps_per_s", "comm_share"):
             if summ.get(k) is not None:
                 v = summ[k]
                 lines.append(f"{k:<12} "
@@ -183,11 +199,13 @@ def render_run(run, as_json=False):
 
 def diff_runs(base, new,
               step_time_threshold=DEFAULT_STEP_TIME_THRESHOLD,
-              loss_threshold=DEFAULT_LOSS_THRESHOLD):
+              loss_threshold=DEFAULT_LOSS_THRESHOLD,
+              comm_threshold=DEFAULT_COMM_THRESHOLD):
     """Compare two loaded runs; regression flags flip when NEW is worse
     than BASE beyond the thresholds. Returns a plain-data report."""
     bt, nt = _mean(_step_times(base)), _mean(_step_times(new))
     bl, nl = _final_loss(base), _final_loss(new)
+    bc, nc = _comm_bytes_per_step(base), _comm_bytes_per_step(new)
     out = {
         "base_mean_step_ms": bt, "new_mean_step_ms": nt,
         "step_time_ratio": (nt / bt if bt and nt else None),
@@ -195,6 +213,19 @@ def diff_runs(base, new,
             bt and nt and nt > bt * (1.0 + step_time_threshold)),
         "base_final_loss": bl, "new_final_loss": nl,
         "loss_regression": False,
+        "base_ar_bytes_per_step": bc, "new_ar_bytes_per_step": nc,
+        "comm_ratio": (nc / bc if bc and nc else None),
+        # a step suddenly moving >10% more all-reduce bytes is a
+        # sharding/partitioner regression even when wall time hides it
+        # (e.g. a bigger overlap window) — gate it like throughput.
+        # A zero-all-reduce base (e.g. all-gather/reduce-scatter-only
+        # TP) regressing to ANY all-reduce is the starkest case, so 0
+        # is a valid baseline here, unlike step time
+        "comm_regression": bool(
+            bc is not None and nc is not None and
+            (nc > bc * (1.0 + comm_threshold) if bc else nc > 0)),
+        "base_comm_share": (base["summary"] or {}).get("comm_share"),
+        "new_comm_share": (new["summary"] or {}).get("comm_share"),
         "base_anomalies": len(base["anomalies"]),
         "new_anomalies": len(new["anomalies"]),
     }
@@ -203,7 +234,7 @@ def diff_runs(base, new,
         out["loss_delta"] = nl - bl
         out["loss_regression"] = bool(nl - bl > margin)
     out["regression"] = out["step_time_regression"] or \
-        out["loss_regression"]
+        out["loss_regression"] or out["comm_regression"]
     return out
 
 
@@ -217,9 +248,11 @@ def render_diff(rep, as_json=False):
     lines = []
     for k in ("base_mean_step_ms", "new_mean_step_ms", "step_time_ratio",
               "step_time_regression", "base_final_loss", "new_final_loss",
-              "loss_delta", "loss_regression", "base_anomalies",
+              "loss_delta", "loss_regression", "base_ar_bytes_per_step",
+              "new_ar_bytes_per_step", "comm_ratio", "comm_regression",
+              "base_comm_share", "new_comm_share", "base_anomalies",
               "new_anomalies", "regression"):
-        if k in rep:
+        if rep.get(k) is not None:
             lines.append(f"{k:<22} {fmt(rep[k])}")
     return "\n".join(lines)
 
@@ -227,10 +260,16 @@ def render_diff(rep, as_json=False):
 # -- self-test ---------------------------------------------------------------
 
 
-def _write_run(run_dir, losses, step_ms, flops=1e9, nonfinite_at=()):
+def _write_run(run_dir, losses, step_ms, flops=1e9, nonfinite_at=(),
+               comm_bytes=None):
     """Drive the REAL RunJournal API to produce one synthetic run."""
     from paddle_tpu.obs import journal as J
 
+    comm = None
+    if comm_bytes:
+        comm = {"all_reduce_bytes": comm_bytes,
+                "total_bytes": comm_bytes,
+                "wire_bytes": int(comm_bytes * 1.75)}
     j = J.RunJournal(run_dir, flush_every=4, compute_flops=False)
     j.start()
     for i, loss in enumerate(losses):
@@ -239,7 +278,7 @@ def _write_run(run_dir, losses, step_ms, flops=1e9, nonfinite_at=()):
                           skipped=True, source="self_test")
         else:
             j.record_step(loss=loss, step_ms=step_ms, flops=flops,
-                          examples=32, source="self_test")
+                          examples=32, comm=comm, source="self_test")
     j.close()
     return j
 
@@ -252,18 +291,20 @@ def self_test():
     try:
         with tempfile.TemporaryDirectory() as d:
             a_dir, b_dir = os.path.join(d, "a"), os.path.join(d, "b")
-            # run A: healthy — loss decays 1.0 -> ~0.1, 10ms steps
+            # run A: healthy — loss decays 1.0 -> ~0.1, 10ms steps,
+            # 1 MiB of all-reduce per step
             _write_run(a_dir, [1.0 * (0.93 ** i) for i in range(30)],
-                       step_ms=10.0)
+                       step_ms=10.0, comm_bytes=1 << 20)
             # run B: regressed — 3x slower steps, a loss spike after
-            # which the loss never recovers, and a 3-step nonfinite
-            # streak
+            # which the loss never recovers, a 3-step nonfinite
+            # streak, and 2x the all-reduce traffic (a partitioner
+            # regression the comm gate must flag)
             losses = [1.0 * (0.93 ** i) for i in range(30)]
             losses[20] = 50.0  # spike...
             for i in range(21, 30):
                 losses[i] = 0.5  # ...then stuck well above run A's tail
             _write_run(b_dir, losses, step_ms=30.0,
-                       nonfinite_at=(12, 13, 14))
+                       nonfinite_at=(12, 13, 14), comm_bytes=2 << 20)
 
             a, b = load_run(a_dir), load_run(b_dir)
             if a["parse_errors"] or b["parse_errors"]:
@@ -295,6 +336,12 @@ def self_test():
                 failures.append("diff missed the 3x step-time regression")
             if not rep["loss_regression"]:
                 failures.append("diff missed the loss regression")
+            if not rep["comm_regression"]:
+                failures.append("diff missed the 2x all-reduce-bytes "
+                                "regression")
+            if rep["comm_ratio"] is None or \
+                    abs(rep["comm_ratio"] - 2.0) > 1e-9:
+                failures.append(f"comm_ratio {rep['comm_ratio']} != 2.0")
             self_rep = diff_runs(a, a)
             if self_rep["regression"]:
                 failures.append(f"A-vs-A diff false-positived: {self_rep}")
@@ -308,7 +355,8 @@ def self_test():
         return 1
     print("self-test passed: journal round-trip, MFU/goodput summary, "
           "loss_spike + nonfinite_streak detectors, and the diff gate "
-          "flagged the injected regression (and only it)")
+          "flagged the injected step-time, loss, AND all-reduce-bytes "
+          "regressions (and only them)")
     return 0
 
 
@@ -325,6 +373,9 @@ def main(argv=None):
     ap.add_argument("--loss-threshold", type=float,
                     default=DEFAULT_LOSS_THRESHOLD,
                     help="allowed relative final-loss growth")
+    ap.add_argument("--comm-threshold", type=float,
+                    default=DEFAULT_COMM_THRESHOLD,
+                    help="allowed relative all-reduce-bytes/step growth")
     ap.add_argument("--self-test", action="store_true",
                     help="synthetic 2-run pair: diff must flag the "
                          "injected regression, detectors must fire")
@@ -336,7 +387,8 @@ def main(argv=None):
             ap.error("--diff needs exactly two run dirs")
         rep = diff_runs(load_run(args.paths[0]), load_run(args.paths[1]),
                         step_time_threshold=args.step_time_threshold,
-                        loss_threshold=args.loss_threshold)
+                        loss_threshold=args.loss_threshold,
+                        comm_threshold=args.comm_threshold)
         print(render_diff(rep, as_json=args.json))
         return 1 if rep["regression"] else 0
     if len(args.paths) != 1:
