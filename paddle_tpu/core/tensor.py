@@ -19,6 +19,25 @@ from .dtype import convert_dtype
 _tensor_id = [0]
 
 
+def as_device_array(v):
+    """Canonical value -> jax-array coercion for step-path inputs.
+
+    Tensors unwrap to their backing array; arrays that are ALREADY on
+    device (e.g. placed by the DevicePrefetcher, possibly committed to
+    a sharding and still in flight) pass through UNTOUCHED — routing
+    them via ``np.asarray`` would block on a device->host gather and
+    re-upload with default placement, losing both the transfer overlap
+    and the layout. Every feed/batch ingestion site (Executor.run /
+    run_steps, TrainStep.__call__ / run_fused) must use this one
+    helper so the pass-through invariant can't silently regress in a
+    single copy."""
+    if isinstance(v, Tensor):
+        v = v._data
+    if isinstance(v, jax.Array):
+        return v
+    return jnp.asarray(np.asarray(v))
+
+
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "name", "persistable", "_id")
 

@@ -31,6 +31,11 @@ from ..core.tensor import Tensor, Parameter
 __all__ = ["jit", "to_static", "TrainStep", "no_jit"]
 
 
+# canonical Tensor-unwrap / device-array pass-through (core.tensor):
+# batch items that are already on device must NOT round-trip host numpy
+from ..core.tensor import as_device_array as _as_array  # noqa: E402
+
+
 @contextlib.contextmanager
 def _rebind(tensors, arrays):
     old = [t._data for t in tensors]
@@ -189,9 +194,41 @@ class TrainStep:
 
         return pure
 
+    def _capture_arg_structs(self, sig, args):
+        """Once per compiled shape (NOT per step): shape/dtype/sharding
+        structs of the call args, so obs.spmd can later re-lower the
+        exact executable for its CollectiveProfile without holding
+        the (donated) arrays alive. Only COMMITTED shardings are
+        kept (a mesh-placed param next to an uncommitted lr scalar
+        must not read as a device conflict); uncommitted args
+        replicate over the committed arrays' mesh."""
+        mesh = None
+        for a in jax.tree_util.tree_leaves(args):
+            sh = getattr(a, "sharding", None)
+            if getattr(a, "committed", False) and \
+                    getattr(sh, "mesh", None) is not None:
+                mesh = sh.mesh
+                break
+        rep = None if mesh is None else \
+            jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec())
+
+        def _struct(a):
+            try:
+                sh = a.sharding if getattr(a, "committed", False) \
+                    else rep
+                if sh is None:
+                    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+                return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                            sharding=sh)
+            except (AttributeError, TypeError):
+                return jax.ShapeDtypeStruct(np.shape(a),
+                                            np.asarray(a).dtype)
+
+        self._arg_structs[sig] = jax.tree_util.tree_map(_struct, args)
+
     def __call__(self, *batch):
-        arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(np.asarray(b))
-                  for b in batch]
+        arrays = [_as_array(b) for b in batch]
         sig = tuple((a.shape, str(a.dtype)) for a in arrays)
         if sig not in self._compiled:
             pure = self._make_pure()
@@ -205,39 +242,9 @@ class TrainStep:
         lr = jnp.float32(opt.get_lr())
         key = prandom.next_key()
         if sig not in self._arg_structs:
-            # once per compiled shape (NOT per step): shape/dtype/sharding
-            # structs of the call args, so obs.spmd can later re-lower the
-            # exact executable for its CollectiveProfile without holding
-            # the (donated) arrays alive. Only COMMITTED shardings are
-            # kept (a mesh-placed param next to an uncommitted lr scalar
-            # must not read as a device conflict); uncommitted args
-            # replicate over the committed arrays' mesh.
-            args = (param_arrs, buf_arrs, opt_state, lr, key, arrays,
-                    self._scaler_state)
-            mesh = None
-            for a in jax.tree_util.tree_leaves(args):
-                sh = getattr(a, "sharding", None)
-                if getattr(a, "committed", False) and \
-                        getattr(sh, "mesh", None) is not None:
-                    mesh = sh.mesh
-                    break
-            rep = None if mesh is None else \
-                jax.sharding.NamedSharding(
-                    mesh, jax.sharding.PartitionSpec())
-
-            def _struct(a):
-                try:
-                    sh = a.sharding if getattr(a, "committed", False) \
-                        else rep
-                    if sh is None:
-                        return jax.ShapeDtypeStruct(a.shape, a.dtype)
-                    return jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                                sharding=sh)
-                except (AttributeError, TypeError):
-                    return jax.ShapeDtypeStruct(np.shape(a),
-                                                np.asarray(a).dtype)
-
-            self._arg_structs[sig] = jax.tree_util.tree_map(_struct, args)
+            self._capture_arg_structs(
+                sig, (param_arrs, buf_arrs, opt_state, lr, key, arrays,
+                      self._scaler_state))
         loss, new_params, new_bufs, new_state, new_scaler, found_bad = fn(
             param_arrs, buf_arrs, opt_state, lr, key, arrays,
             self._scaler_state)
@@ -265,6 +272,131 @@ class TrainStep:
                 f"(loss={float(np.asarray(loss))})",
                 summary=s if s["num_nan"] or s["num_inf"] else None)
         return Tensor(loss, _internal=True)
+
+    def run_fused(self, batches, steps=None):
+        """Run K microbatches through ONE fused ``lax.scan`` executable.
+
+        ``batches`` is a sequence of K per-step batch tuples (uniform
+        shapes/dtypes — the same tuples K ``step(*batch)`` calls would
+        take), or a single pre-stacked tuple of arrays with a leading K
+        axis (then ``steps=K`` is required). The whole training state —
+        params, buffers, optimizer slots, scaler state — rides the scan
+        as a DONATED carry; per-step PRNG keys are pre-drawn from the
+        host RNG stream (the same draws K sequential calls would make),
+        so the K-step loss trajectory matches K sequential
+        ``step(*batch)`` calls step for step — same ops, same keys, same
+        LR; XLA may fuse the scan body marginally differently than the
+        standalone step (last-ulp float drift after a few steps), so
+        equality is to float tolerance here. (The static
+        ``Executor.run_steps`` path IS pinned bitwise.) Cost: one
+        compile + one dispatch per window instead of K.
+
+        Host-side per-step work necessarily happens at WINDOW
+        granularity: the learning rate is sampled once for all K
+        microbatches, ``optimizer._global_step`` advances by K at the
+        end, and with ``check_nan`` a nonfinite ANY microbatch raises
+        after the window. ``last_found_inf`` becomes the any-step flag;
+        ``last_found_inf_per_step`` keeps the per-step (K,) vector.
+
+        Returns the (K,) per-microbatch loss trajectory as a Tensor.
+        """
+        if steps is None:
+            try:
+                steps = len(batches)
+            except TypeError:
+                raise ValueError(
+                    "run_fused needs steps=K when batches is not a "
+                    "sized sequence of per-step batch tuples")
+        K = int(steps)
+        if K <= 0:
+            raise ValueError(f"steps must be >= 1, got {K}")
+
+        seq = list(batches)
+        if seq and isinstance(seq[0], (list, tuple)):
+            # K per-step batch tuples (the same tuples __call__ takes;
+            # a single-input loss still passes [(x0,), (x1,), ...])
+            if len(seq) != K:
+                raise ValueError(
+                    f"steps={K} but {len(seq)} microbatches were given")
+            rows = [tuple(_as_array(b) for b in row) for row in seq]
+            sig0 = tuple((a.shape, str(a.dtype)) for a in rows[0])
+            for i, row in enumerate(rows[1:], 1):
+                if tuple((a.shape, str(a.dtype)) for a in row) != sig0:
+                    raise ValueError(
+                        f"microbatch {i} signature "
+                        f"{[(a.shape, str(a.dtype)) for a in row]} != "
+                        f"microbatch 0 {list(sig0)}: fused steps need "
+                        "uniform shapes")
+            stacked = [jnp.stack([row[i] for row in rows])
+                       for i in range(len(rows[0]))]
+        else:  # pre-stacked tuple of (K, ...) arrays
+            stacked = [_as_array(b) for b in seq]
+            for a in stacked:
+                if a.ndim < 1 or a.shape[0] != K:
+                    raise ValueError(
+                        f"pre-stacked batch array has shape {a.shape}; "
+                        f"expected a leading microbatch axis of {K}")
+            sig0 = tuple((a.shape[1:], str(a.dtype)) for a in stacked)
+        fsig = ("fused", K) + sig0
+        if fsig not in self._compiled:
+            pure = self._make_pure()
+
+            def fused(param_arrs, buf_arrs, opt_state, lrs, keys,
+                      stacked_batch, scaler_state):
+                def body(carry, xs):
+                    params, bufs, state, sstate = carry
+                    lr, key, batch = xs
+                    loss, np_, nb_, ns_, nss_, finf = pure(
+                        params, bufs, state, lr, key, list(batch), sstate)
+                    return (np_, nb_, ns_, nss_), (loss, finf)
+
+                (np_, nb_, ns_, nss_), (losses, finfs) = jax.lax.scan(
+                    body,
+                    (list(param_arrs), list(buf_arrs), dict(opt_state),
+                     scaler_state),
+                    (lrs, keys, list(stacked_batch)), length=K)
+                return losses, np_, nb_, ns_, nss_, finfs
+
+            donate = (0, 1, 2) if self._donate else ()
+            self._compiled[fsig] = jax.jit(fused, donate_argnums=donate)
+        fn = self._compiled[fsig]
+        opt = self.optimizer
+        opt_state = {p.name: opt._accumulators[p.name]
+                     for p in self._trainable}
+        param_arrs = [p._data for p in self._trainable]
+        buf_arrs = [b._data for b in self._buffers]
+        # one LR sample per window; per-step keys are PRE-DRAWN from the
+        # host stream — bitwise the draws K sequential calls would make
+        lrs = jnp.full((K,), jnp.float32(opt.get_lr()))
+        keys = jnp.stack([prandom.next_key() for _ in range(K)])
+        if fsig not in self._arg_structs:
+            self._capture_arg_structs(
+                fsig, (param_arrs, buf_arrs, opt_state, lrs, keys,
+                       stacked, self._scaler_state))
+        losses, new_params, new_bufs, new_state, new_scaler, finfs = fn(
+            param_arrs, buf_arrs, opt_state, lrs, keys, stacked,
+            self._scaler_state)
+        for p, a in zip(self._trainable, new_params):
+            p._data = a
+        for b, a in zip(self._buffers, new_bufs):
+            b._data = a
+        for n, s in new_state.items():
+            opt._accumulators[n] = s
+        self._scaler_state = new_scaler
+        opt._global_step += K
+        # raw device flags, no sync (same contract as __call__)
+        self.last_found_inf = jnp.any(finfs)
+        self.last_found_inf_per_step = finfs
+        if self.check_nan and self.scaler is None and \
+                bool(np.asarray(self.last_found_inf)):
+            from ..utils.nan_guard import NanInfError
+
+            bad = np.flatnonzero(np.asarray(finfs))
+            raise NanInfError(
+                f"NaN/Inf in loss or gradients in fused window ending at "
+                f"step {opt._global_step} (microbatch index(es) "
+                f"{bad.tolist()} of {K})")
+        return Tensor(losses, _internal=True)
 
     def collective_profile(self, mesh=None):
         """CollectiveProfile of the most recently compiled step shape

@@ -11,6 +11,9 @@ from .sampler import (  # noqa: F401
     Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
     BatchSampler, DistributedBatchSampler,
 )
-from .dataloader import DataLoader, default_collate_fn, default_convert_fn  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader, default_collate_fn, default_convert_fn, DevicePrefetcher,
+    prefetch_to_device, executor_feed_shardings,
+)
 from . import reader  # noqa: F401
 from .reader import DataFeeder  # noqa: F401

@@ -24,7 +24,9 @@ from ..resilience import inject as _chaos
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
-__all__ = ["DataLoader", "default_collate_fn", "default_convert_fn"]
+__all__ = ["DataLoader", "default_collate_fn", "default_convert_fn",
+           "DevicePrefetcher", "prefetch_to_device",
+           "executor_feed_shardings"]
 
 # interned once; ticked per BATCH (not per sample), so the pipeline's
 # telemetry cost is noise against the numpy collate work it measures
@@ -32,6 +34,7 @@ _M_QUEUE_DEPTH = _metrics.gauge("dataloader.queue_depth")
 _M_PRODUCER_WAIT = _metrics.histogram("dataloader.producer_wait_ms")
 _M_CONSUMER_WAIT = _metrics.histogram("dataloader.consumer_wait_ms")
 _M_RESTARTS = _metrics.counter("dataloader.worker_restarts")
+_M_DEVICE_PUTS = _metrics.counter("dataloader.device_put_batches")
 
 
 def default_convert_fn(batch):
@@ -216,6 +219,211 @@ class _Prefetcher:
         deadline = time.monotonic() + timeout
         for t in list(self._threads):  # snapshot: restarts may append
             t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+
+class DevicePrefetcher:
+    """Double-buffered async DEVICE feed: overlap host->device transfer
+    with device compute.
+
+    The host-side pipeline (``DataLoader`` workers, reader decorators)
+    overlaps batch ASSEMBLY with compute; the last per-step serial cost
+    is the feed ``device_put`` itself. This stage issues
+    ``jax.device_put`` for batch N+1 on a feeder thread while the step
+    consuming batch N runs — jax transfers are asynchronous, so by the
+    time the train loop asks for N+1 the bytes are (usually) already in
+    HBM. ``shardings`` places each transfer directly onto its committed
+    device layout (see ``executor_feed_shardings``): a DP-sharded feed
+    lands pre-sharded instead of being re-laid-out at dispatch.
+
+    Fault contract (mirrors ``_Prefetcher``): an error ANYWHERE in the
+    stage — the upstream iterator raising mid-prefetch, or the
+    ``device_put`` itself failing — surfaces to the consumer in batch
+    order (everything prefetched before it still arrives first), and
+    ``shutdown()`` never hangs: the feeder thread is unblocked and
+    joined even when the consumer abandons the iterator mid-epoch.
+
+    ``depth`` is the lookahead (2 = classic double buffering). Keep it
+    small: each in-flight batch holds device memory.
+    """
+
+    def __init__(self, source, shardings=None, depth=2):
+        import queue
+
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._shardings = shardings
+        self._stop = threading.Event()
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._feeder, args=(iter(source),), daemon=True)
+        self._thread.start()
+
+    # -- feeder thread -------------------------------------------------------
+    def _transfer(self, batch):
+        import jax
+
+        sh = self._shardings
+
+        def put(x, s=None):
+            x = getattr(x, "_data", x)  # Tensor -> array
+            return jax.device_put(x) if s is None else jax.device_put(x, s)
+
+        def mismatch():
+            # a shardings spec that cannot be matched to the batch shape
+            # must FAIL, not quietly fall back to default placement —
+            # the user asked for a layout and would otherwise never
+            # learn they didn't get it
+            return TypeError(
+                f"DevicePrefetcher shardings of type "
+                f"{type(sh).__name__} cannot be matched to a batch of "
+                f"type {type(batch).__name__}: use a dict of "
+                f"name->sharding for dict batches (executor_feed_"
+                f"shardings), a sequence for tuple/list batches, or a "
+                f"callable(batch)")
+
+        if callable(sh):
+            out = sh(batch)
+        elif isinstance(batch, dict):
+            if sh is not None and not isinstance(sh, dict):
+                raise mismatch()
+            m = sh or {}
+            if m and not any(k in m for k in batch):
+                # a shardings dict sharing NO key with the batch is a
+                # naming mismatch (feed-name vs collate-key), not a
+                # partial spec: every batch would silently take default
+                # placement. (A superset spec — e.g. '@lr' from
+                # executor_feed_shardings next to a {'x','y'} batch —
+                # stays legal.)
+                raise TypeError(
+                    f"DevicePrefetcher shardings keys {sorted(m)} share "
+                    f"no key with batch keys {sorted(batch)}: the "
+                    "requested layout would be silently ignored")
+            out = {k: put(v, m.get(k)) for k, v in batch.items()}
+        elif isinstance(batch, (list, tuple)):
+            if sh is not None and not isinstance(sh, (list, tuple)):
+                raise mismatch()
+            seq = list(sh) if sh is not None else []
+            if len(seq) > len(batch):
+                raise TypeError(
+                    f"DevicePrefetcher got {len(seq)} shardings for a "
+                    f"batch of {len(batch)} items: the extra entries "
+                    "would be silently dropped")
+            seq += [None] * (len(batch) - len(seq))
+            out = [put(v, s) for v, s in zip(batch, seq)]
+            out = tuple(out) if isinstance(batch, tuple) else out
+        else:
+            if isinstance(sh, (dict, list, tuple)):
+                raise mismatch()
+            out = put(batch, sh)
+        _M_DEVICE_PUTS.inc()
+        return out
+
+    def _put(self, item):
+        import queue
+
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue  # consumer stalled; re-check for shutdown
+        return False
+
+    def _feeder(self, it):
+        try:
+            while not self._stop.is_set():
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                # device_put here ENQUEUES the transfer and returns;
+                # the copy proceeds while the consumer's step computes
+                if not self._put(("ok", self._transfer(batch))):
+                    return
+        except BaseException as e:  # upstream raise OR device_put failure:
+            self._put(("err", e))   # surfaces in batch order
+            return
+        self._put(("end", None))
+
+    # -- consumer side -------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        t0 = time.perf_counter()
+        tag, val = self._q.get()
+        _M_CONSUMER_WAIT.observe((time.perf_counter() - t0) * 1e3)
+        if tag == "ok":
+            return val
+        self._done = True
+        if tag == "err":
+            if isinstance(val, Exception):
+                raise val
+            raise RuntimeError(f"device prefetch feeder died: {val!r}")
+        raise StopIteration
+
+    def shutdown(self, timeout=5.0):
+        """Stop the feeder and join it. Safe to call repeatedly, from
+        ``finally`` blocks, and mid-stream: the stop flag unblocks a
+        feeder stuck on a full queue, and draining the queue unblocks
+        one stuck in ``put``."""
+        self._stop.set()
+        self._done = True
+        import queue
+
+        try:  # drain so a feeder blocked in _put can observe _stop
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=timeout)
+
+
+def prefetch_to_device(source, shardings=None, depth=2):
+    """Generator wrapper over ``DevicePrefetcher`` with guaranteed
+    cleanup: ``for batch in prefetch_to_device(loader): ...`` — the
+    feeder thread is shut down when the loop ends, breaks, or raises."""
+    pf = DevicePrefetcher(source, shardings=shardings, depth=depth)
+    try:
+        for batch in pf:
+            yield batch
+    finally:
+        pf.shutdown()
+
+
+def executor_feed_shardings(compiled):
+    """Committed per-feed shardings of a compiled Executor entry, as a
+    ``{feed_name: sharding_or_None}`` dict ready for
+    ``DevicePrefetcher(shardings=...)`` — batches device_put through it
+    land directly on the layout the executable expects (a DP entry's
+    batch feeds arrive pre-sharded over the data mesh). None shardings
+    mean default placement. Returns None when the entry is unknown.
+
+    Fused entries (``run_steps``, ``compiled.steps=K``) carry shardings
+    for the STACKED ``(K, batch, ...)`` arguments; the leading scan
+    axis is stripped here so the returned dict applies to the
+    individual per-step batches a loader yields (the batch axis is dim
+    0 again). Prefetched per-step batches then enter via
+    ``run_steps(feeds=[...])``, which stacks device arrays
+    device-side."""
+    names = getattr(compiled, "feed_names", None)
+    if not names:
+        return None
+    sh = getattr(compiled, "feed_shardings", None)
+    if sh is None:
+        return {n: None for n in names}
+    if getattr(compiled, "steps", None):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def per_step(s):
+            spec = getattr(s, "spec", None)
+            if s is None or not spec or len(tuple(spec)) == 0:
+                return s  # replicated (or unknown): unchanged
+            return NamedSharding(s.mesh, PartitionSpec(*tuple(spec)[1:]))
+
+        return {n: per_step(s) for n, s in zip(names, sh)}
+    return dict(zip(names, sh))
 
 
 class DataLoader:

@@ -13,7 +13,7 @@ import numpy as np
 
 __all__ = [
     "batch", "shuffle", "shuffle_stream", "buffered", "map_readers", "xmap_readers", "chain",
-    "compose", "firstn", "cache", "DataFeeder",
+    "compose", "firstn", "cache", "DataFeeder", "prefetch_to_device",
 ]
 
 
@@ -185,6 +185,23 @@ class DataFeeder:
         for name, col in zip(self.feed_names, cols):
             out[name] = np.stack([np.asarray(c) for c in col])
         return out
+
+
+def prefetch_to_device(reader, shardings=None, depth=2):
+    """Reader decorator over the double-buffered device feed
+    (``io_.dataloader.DevicePrefetcher``): ``jax.device_put`` for batch
+    N+1 is issued while the consumer computes on batch N, onto the
+    committed shardings when given (``executor_feed_shardings`` of a
+    compiled entry). The feeder thread is shut down when the consumer
+    finishes, breaks, or raises — reader errors surface in batch
+    order."""
+
+    def impl():
+        from .dataloader import prefetch_to_device as _stage
+
+        return _stage(reader(), shardings=shardings, depth=depth)
+
+    return impl
 
 
 def shuffle_stream(reader, buf_size=1024, seed=0):

@@ -31,7 +31,11 @@ hook is ``if _journal.ACTIVE is not None: ...`` — with no journal
 configured the step path performs a single None check, no call, no
 allocation, no host sync. With a journal active, summarizing an eager
 loss costs one scalar device->host read per step (standard logging
-cost; the static Executor path summarizes already-fetched host arrays).
+cost; the static Executor path summarizes already-fetched host arrays,
+and its lazy/async fetch paths — ``return_numpy=False`` /
+``fetch_async=True`` — journal metadata-only summaries so logging
+never re-introduces the host sync the caller opted out of). A fused
+``run_steps`` window journals as ONE record with ``steps_fused=K``.
 """
 from __future__ import annotations
 
@@ -106,12 +110,17 @@ def _backend_info():
                 "backend_error": f"{type(e).__name__}: {e}"}
 
 
-def _summarize_value(v):
+def _summarize_value(v, sync=True):
     """Small, JSON-safe summary of one fetched value: size-1 numerics
     inline as a float, everything else as shape/dtype metadata. Only a
     SIZE-1 value is ever materialized (one scalar read); larger arrays
     are summarized from metadata alone, so a lazy device fetch
-    (``return_numpy=False``) is never synced wholesale."""
+    (``return_numpy=False``) is never synced wholesale.
+
+    ``sync=False`` forbids even that scalar read for DEVICE values
+    (host numpy stays readable — it costs nothing): the async fetch
+    path (``Executor.run(fetch_async=True)`` / lazy Tensors) must not
+    pay a hidden per-step device->host block just for logging."""
     import numpy as np
 
     v = getattr(v, "_data", v)
@@ -123,8 +132,9 @@ def _summarize_value(v):
     size = 1
     for s in shape:
         size *= int(s)
+    readable = sync or isinstance(v, (np.ndarray, np.generic))
     try:
-        if size == 1 and np.dtype(dtype).kind in "fiub":
+        if size == 1 and readable and np.dtype(dtype).kind in "fiub":
             return float(np.asarray(v).reshape(()))
     except (TypeError, ValueError):
         pass
@@ -394,7 +404,10 @@ class RunJournal:
                 step_ms=step_ms, flops=flops, examples=examples,
                 productive=not (skipped or nonfinite),
                 comm_bytes=(comm or {}).get("total_bytes"),
-                wire_bytes=(comm or {}).get("wire_bytes"))
+                wire_bytes=(comm or {}).get("wire_bytes"),
+                # a fused window is ONE record but K optimizer steps:
+                # goodput / productive-step counts weight by it
+                weight=rec.get("steps_fused") or 1)
             self._last_steps.append(rec)
             self._write(rec, _locked=True)
             for fired in self.anomalies.observe(rec):
@@ -441,11 +454,10 @@ class RunJournal:
         ``record_step`` without an explicit ``step_ms`` uses it."""
         self._last_timer_ms = float(ms)
 
-    # called from the Executor run hook: everything here is host-side
-    # metadata — the FLOPs/comm lookup is non-blocking (a background
-    # thread pays the entry's analysis compile; early steps carry
-    # flops=None and no comm attribution)
-    def record_executor_run(self, compiled, fetches, run_ms):
+    def _entry_flops_comm(self, compiled):
+        """Non-blocking per-entry FLOPs + collective attribution (a
+        background thread pays the analysis compile; early steps carry
+        None)."""
         flops = comm = None
         if self.compute_flops:
             from .mfu import entry_analysis_nowait
@@ -465,11 +477,20 @@ class RunJournal:
                             prof["bytes"].get("all-reduce", 0),
                         "n_ops": prof["n_ops"],
                     }
+        return flops, comm
+
+    # called from the Executor run hook: everything here is host-side
+    # metadata — the FLOPs/comm lookup is non-blocking (a background
+    # thread pays the entry's analysis compile; early steps carry
+    # flops=None and no comm attribution). ``synced=False`` (lazy /
+    # async fetches) keeps even the size-1 loss summary off the device.
+    def record_executor_run(self, compiled, fetches, run_ms, synced=True):
+        flops, comm = self._entry_flops_comm(compiled)
         # summarize ONCE and reuse: with lazy fetches
         # (return_numpy=False) each size-1 summary is a scalar device
         # read, and doing it twice would double the step's logging sync
-        summary = [_summarize_value(v) for v in fetches[:4]] \
-            if fetches else None
+        summary = [_summarize_value(v, sync=synced)
+                   for v in fetches[:4]] if fetches else None
         loss = summary[0] if summary and isinstance(summary[0], float) \
             else None
         return self.record_step(
@@ -478,15 +499,52 @@ class RunJournal:
             flops=flops, comm=comm, source="executor",
             _fetch_summary=summary)
 
+    def record_fused_run(self, compiled, fetches, run_ms, steps,
+                         synced=True):
+        """One fused ``Executor.run_steps`` dispatch = ONE step record
+        carrying ``steps_fused=K`` (not K records: the flight recorder
+        mirrors dispatches, and fan-out would fabricate K identical
+        timings from one measurement). ``loss`` is the LAST microbatch's
+        (the trajectory endpoint the anomaly detectors should track);
+        ``examples`` covers all K microbatches, and the entry's FLOPs /
+        collective volumes already describe the whole K-step executable,
+        so MFU and comm accounting stay exact."""
+        import numpy as np
+
+        steps = int(steps)
+        flops, comm = self._entry_flops_comm(compiled)
+        summary = [_summarize_value(v, sync=synced)
+                   for v in fetches[:4]] if fetches else None
+        loss = None
+        if fetches and synced:
+            try:  # stacked (K,) trajectory -> endpoint scalar
+                arr = np.asarray(getattr(fetches[0], "_data", fetches[0]))
+                if arr.shape == (steps,) and arr.dtype.kind in "fiub":
+                    loss = float(arr[-1])
+            except (TypeError, ValueError):
+                pass
+        hint = getattr(compiled, "examples_hint", None)
+        return self.record_step(
+            loss=loss, step_ms=run_ms,
+            examples=hint * steps if hint else None,
+            flops=flops, comm=comm, source="executor",
+            steps_fused=steps, _fetch_summary=summary)
+
     # -- summaries -----------------------------------------------------------
     def summary(self):
         out = self.accounting.summary()
-        out["steps"] = self._step
+        out["steps"] = self._step  # records (= dispatches), unchanged
+        # optimizer steps weight fused windows by K (steps_fused): the
+        # number a sequential run of the same training is comparable to
+        opt_steps = self.accounting.productive + self.accounting.skipped
+        out["optimizer_steps"] = opt_steps
         if self._t_start is not None:
             wall = time.monotonic() - self._t_start
             out["wall_s"] = wall
             if wall > 0 and self._step:
                 out["steps_per_s"] = self._step / wall
+            if wall > 0 and opt_steps:
+                out["optimizer_steps_per_s"] = opt_steps / wall
         out["anomalies_fired"] = len(self.anomalies.fired)
         return out
 

@@ -220,11 +220,17 @@ class MFUAccounting:
         self._comm_flops = 0.0  # flops summed on comm-attributed steps
 
     def record(self, step_ms=None, flops=None, examples=None,
-               productive=True, comm_bytes=None, wire_bytes=None):
+               productive=True, comm_bytes=None, wire_bytes=None,
+               weight=1):
+        """``weight`` is the number of optimizer steps this record
+        covers — 1 normally, K for a fused ``run_steps`` window (whose
+        step_ms/flops/examples/comm already describe the whole window,
+        so only the step COUNTS need the weight)."""
+        weight = max(1, int(weight))
         if productive:
-            self.productive += 1
+            self.productive += weight
         else:
-            self.skipped += 1
+            self.skipped += weight
         if step_ms is not None and step_ms > 0:
             self._timed_ms += step_ms
             self._timed_steps += 1

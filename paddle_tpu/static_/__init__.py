@@ -12,7 +12,7 @@ from .program import (  # noqa: F401
     name_scope, ProgramTracer,
 )
 from .backward import append_backward, gradients  # noqa: F401
-from .executor import Executor, build_optimize_ops  # noqa: F401
+from .executor import CacheKey, Executor, build_optimize_ops  # noqa: F401
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
 
 import contextlib as _ctx

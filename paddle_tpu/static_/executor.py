@@ -9,6 +9,7 @@ in-place in HBM.
 """
 from __future__ import annotations
 
+import dataclasses
 import time
 
 import numpy as np
@@ -22,16 +23,44 @@ from ..obs import trace as _trace
 from ..resilience import inject as _chaos
 from .program import (Program, default_main_program, global_scope)
 
-__all__ = ["Executor"]
+__all__ = ["Executor", "CacheKey"]
 
 # interned once: the run/compile paths tick these without touching the
 # registry dict (obs.metrics.reset() zeroes in place, so the references
 # stay live forever)
 _M_CACHE_HITS = _metrics.counter("executor.jit_cache.hits")
 _M_CACHE_MISSES = _metrics.counter("executor.jit_cache.misses")
+_M_DISPATCHES = _metrics.counter("executor.dispatches")
 _M_COMPILE_MS = _metrics.histogram("executor.compile_ms")
 _M_RUN_MS = _metrics.histogram("executor.run_ms")
 _M_FETCH_MS = _metrics.histogram("executor.fetch_ms")
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheKey:
+    """Named executor jit-cache key.
+
+    Replaces the old positional tuple, whose layout was an append-order
+    trap: every new axis (optimize level, data parallelism, now fused
+    step count) had to slot in at exactly the right position or silently
+    alias unrelated entries — and tests pinned magic indices like
+    ``k[-2]``. Fields are named; add new axes as new fields.
+
+    ``steps`` is ``None`` for the single-step path and the microbatch
+    count K for fused ``lax.scan`` entries (``Executor.run_steps``) —
+    the same program at the same feed shapes compiles to a different
+    executable per K, so K is a genuine cache axis.
+    """
+
+    program_uid: int
+    program_version: int
+    feed_names: tuple
+    feed_shapes: tuple
+    fetch_names: tuple
+    optimize_level: int
+    steps: int | None
+    data_parallel: bool
+    allow_replicated_fallback: bool
 
 
 class _Compiled:
@@ -58,9 +87,23 @@ class Executor:
         self.last_diagnostics = None  # DiagnosticReport of the last compile
         self._cache_hits = 0    # this executor's share of the global
         self._cache_misses = 0  # executor.jit_cache.* counters
+        self._dispatches = 0    # compiled-fn calls (run + run_steps);
+        # process-wide mirror: obs.metrics executor.dispatches. The
+        # perf gates (tools/perf_gate.py) read this to assert "1 compile
+        # + 1 dispatch per K fused steps".
 
     def close(self):
         self._cache.clear()
+
+    @property
+    def dispatches(self):
+        """Compiled-fn invocations so far (run + run_steps) — the cheap
+        public read for compiled-call-count gates; pairs with
+        ``cache_stats()['misses']`` (= compiles). Kept OUT of the
+        default ``cache_stats()`` dict (its {hits,misses,size} shape is
+        a pinned contract) and cheap unlike ``per_entry=True`` (which
+        pays the lazy per-entry analysis)."""
+        return self._dispatches
 
     # -- program -> pure function ------------------------------------------
     @staticmethod
@@ -105,7 +148,8 @@ class Executor:
         return Mesh(np.asarray(jax.local_devices()), ("data",))
 
     def _compile(self, program, feed, fetch_list, data_parallel=False,
-                 allow_replicated_fallback=False, optimize_level=None):
+                 allow_replicated_fallback=False, optimize_level=None,
+                 steps=None):
         from ..analysis import normalize_fetch
 
         if optimize_level is None:
@@ -115,15 +159,22 @@ class Executor:
             _chaos.fire("opt_compile_fail", optimize_level=optimize_level)
         feed_names = tuple(sorted(feed))
         fetch_names, _ = normalize_fetch(fetch_list)
-        shapes = tuple(
-            (np.asarray(feed[n]).shape, str(np.asarray(feed[n]).dtype))
-            for n in feed_names)
+        # per-STEP shapes even on the fused path (run_steps hands the
+        # first microbatch here): the key describes the step body, and
+        # `steps` carries the fusion axis. Metadata-only reads: a feed
+        # value that is already a (possibly sharded, still-computing)
+        # jax array must not be gathered to host just to learn its shape
+        shapes = tuple(self._feed_shape_dtype(feed[n]) for n in feed_names)
         # program._uid is monotonic and never recycled (unlike id(program),
         # which the allocator can hand to a NEW Program after the old one
         # is GC'd — a stale-cache hit that replays the wrong executable)
-        key = (program._uid, program._version, feed_names, shapes,
-               fetch_names, int(optimize_level), bool(data_parallel),
-               bool(allow_replicated_fallback))
+        key = CacheKey(
+            program_uid=program._uid, program_version=program._version,
+            feed_names=feed_names, feed_shapes=shapes,
+            fetch_names=fetch_names, optimize_level=int(optimize_level),
+            steps=None if steps is None else int(steps),
+            data_parallel=bool(data_parallel),
+            allow_replicated_fallback=bool(allow_replicated_fallback))
         if key in self._cache:
             compiled = self._cache[key]
             # coherence: uid+version are in the key, so a hit is the right
@@ -143,10 +194,12 @@ class Executor:
         with _trace.span("executor.compile", uid=program._uid,
                          version=program._version,
                          optimize_level=int(optimize_level),
-                         data_parallel=bool(data_parallel)):
+                         data_parallel=bool(data_parallel),
+                         steps=steps):
             compiled = self._build(program, feed_names, fetch_names, shapes,
                                    fetch_list, data_parallel,
-                                   allow_replicated_fallback, optimize_level)
+                                   allow_replicated_fallback, optimize_level,
+                                   steps=steps)
         # NOTE: jax.jit is lazy — this times trace-side work (analysis
         # passes + jit wrapper construction); XLA's own compile lands in
         # the first executor.run_ms sample for this key
@@ -155,7 +208,8 @@ class Executor:
         if _journal.ACTIVE is not None:
             _journal.ACTIVE.event(
                 "compile", uid=program._uid, version=program._version,
-                optimize_level=int(optimize_level), ms=compile_ms)
+                optimize_level=int(optimize_level), ms=compile_ms,
+                **({"steps_fused": int(steps)} if steps else {}))
             # one sharding event per compiled entry: feed/persistable
             # placement + footprints (metadata only — obs.spmd reads the
             # structs captured above, no device or XLA work)
@@ -167,7 +221,8 @@ class Executor:
         return compiled
 
     def _build(self, program, feed_names, fetch_names, shapes, fetch_list,
-               data_parallel, allow_replicated_fallback, optimize_level):
+               data_parallel, allow_replicated_fallback, optimize_level,
+               steps=None):
         from ..analysis import run_compile_passes
 
         scope = global_scope()
@@ -195,6 +250,26 @@ class Executor:
 
         raw = self._replay_fn(program, ops, feed_names, updated, frozen,
                               fetch_names)
+        if steps:
+            # fused multi-step path: drive K microbatches through ONE
+            # lax.scan — the step body lowers once, the persistables ride
+            # as the (donated) carry, stacked feeds are the scan xs, and
+            # per-step fetches come back stacked as ys. One compile and
+            # one dispatch per K steps instead of K Python dispatches —
+            # the ParallelExecutor-era per-op dispatch amortization,
+            # rebuilt on XLA's loop fusion.
+            raw_step, K = raw, int(steps)
+
+            def raw(stacked_feeds, updated_arrs, frozen_arrs):
+                def body(carry, feeds_k):
+                    fetches, new_updated = raw_step(list(feeds_k), carry,
+                                                    frozen_arrs)
+                    return new_updated, fetches
+
+                new_updated, ys = jax.lax.scan(
+                    body, list(updated_arrs), list(stacked_feeds), length=K)
+                return ys, new_updated
+
         if data_parallel:
             # Shard the feed batch axis over the data mesh; persistables
             # stay replicated. XLA partitions the one program and inserts
@@ -207,8 +282,13 @@ class Executor:
             rep = NamedSharding(mesh, P())
 
             def feed_sharding(shape):
+                # `shape` is always the per-STEP shape; on the fused path
+                # the actual jit argument carries a leading scan axis of
+                # K microbatches, which must stay unsharded (every device
+                # walks the same K steps) — the batch axis moves to dim 1
                 if len(shape) >= 1 and shape[0] > 0 and shape[0] % ndev == 0:
-                    return NamedSharding(mesh, P("data"))
+                    return NamedSharding(
+                        mesh, P(None, "data") if steps else P("data"))
                 return rep  # non-batched / indivisible feeds replicate
 
             feed_sh = [feed_sharding(s) for s, _ in shapes]
@@ -264,17 +344,23 @@ class Executor:
         compiled.op_count = len(blk.ops)  # pre-optimization: mirrors _version
         compiled.diagnostics = report
         compiled.optimize_level = int(optimize_level)
+        compiled.steps = None if steps is None else int(steps)
         # shape/dtype-only arg structs (no device data): what the lazy
         # per-entry memory/FLOP attribution (obs.mfu.entry_analysis) and
-        # the journal's MFU accounting re-lower against on demand
+        # the journal's MFU accounting re-lower against on demand. Fused
+        # entries record the STACKED feed shapes — the shapes the
+        # executable actually takes — so a re-lower reproduces the scan.
         def _struct(name):
             a = scope.find_var(name)  # .shape/.dtype are metadata reads:
             return jax.ShapeDtypeStruct(  # no host transfer of the array
                 tuple(a.shape), np.dtype(a.dtype))
 
+        def _feed_struct(s, dt):
+            s = (int(steps),) + tuple(s) if steps else tuple(s)
+            return jax.ShapeDtypeStruct(s, np.dtype(dt))
+
         compiled.arg_structs = (
-            [jax.ShapeDtypeStruct(tuple(s), np.dtype(dt))
-             for s, dt in shapes],
+            [_feed_struct(s, dt) for s, dt in shapes],
             [_struct(n) for n in updated],
             [_struct(n) for n in frozen])
         # examples/step hint for throughput accounting: the largest
@@ -301,6 +387,9 @@ class Executor:
         if per_entry:
             from ..obs.mfu import entry_analysis
 
+            # dispatches only rides the opt-in shape: the default dict
+            # {hits,misses,size} is pinned by tests
+            out["dispatches"] = self._dispatches
             entries = []
             for compiled in self._cache.values():
                 a = entry_analysis(compiled)
@@ -318,23 +407,37 @@ class Executor:
                     "flops": (a["cost"] or {}).get("flops"),
                     "collectives": a.get("collectives"),
                     "mesh": getattr(compiled, "mesh_axes", None),
+                    "steps_fused": getattr(compiled, "steps", None),
                 })
             out["entries"] = entries
         return out
 
-    # -- public API ---------------------------------------------------------
-    def run(self, program=None, feed=None, fetch_list=None, feed_var_name=None,
-            fetch_var_name=None, scope=None, return_numpy=True,
-            use_program_cache=True, optimize_level=None):
-        """Run ``program`` (ref: executor.py Executor.run). New vs the
-        reference: ``optimize_level`` selects the ``paddle_tpu.analysis``
-        pass pipeline applied before compilation — 0 verify-only,
-        1 (default) identity-forwarding + dead-op elimination,
-        2 additionally CSE. The verifier always runs; a malformed Program
-        raises ``analysis.ProgramVerificationError`` with coded
-        diagnostics. ``None`` inherits the Executor-level default
-        (``Executor(optimize_level=...)`` / env ``PADDLE_TPU_OPT_LEVEL``).
-        """
+    @staticmethod
+    def _feed_shape_dtype(v):
+        """(shape, dtype-str) of one feed value WITHOUT materializing
+        it: jax arrays / Tensors / numpy answer from metadata (no
+        device->host gather); only raw Python containers pay an
+        np.asarray."""
+        v = getattr(v, "_data", v)
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            return tuple(v.shape), str(np.dtype(v.dtype))
+        a = np.asarray(v)
+        return a.shape, str(a.dtype)
+
+    @staticmethod
+    def _as_device(v):
+        """Feed value -> jax array via the canonical
+        ``core.tensor.as_device_array`` (already-device arrays pass
+        through untouched — see its docstring)."""
+        from ..core.tensor import as_device_array
+
+        return as_device_array(v)
+
+    @staticmethod
+    def _unwrap_program(program):
+        """CompiledProgram / transpiled-DP normalization shared by run
+        and run_steps: returns (program, data_parallel,
+        allow_replicated_fallback)."""
         from .compiler import CompiledProgram
 
         if program is None:
@@ -351,6 +454,47 @@ class Executor:
             # program: run it data-parallel (same SPMD path as
             # CompiledProgram.with_data_parallel)
             data_parallel = True
+        return program, data_parallel, allow_replicated_fallback
+
+    @staticmethod
+    def _materialize_fetches(fetches, return_numpy, fetch_async):
+        """The step's host-sync policy, in one place. ``return_numpy``
+        blocks on every fetch (np.asarray is the sync point);
+        ``fetch_async`` hands back the raw jax arrays — the device may
+        still be computing, and the caller syncs when (if) it reads
+        them; the lazy-Tensor default in between wraps without forcing
+        numpy."""
+        tf = time.perf_counter()
+        if fetch_async:  # no wrapper, no sync: overlap-friendly fetches
+            out = list(fetches)
+        elif return_numpy:  # np.asarray is the step's host sync point:
+            out = [np.asarray(f) for f in fetches]  # fetch latency
+        else:  # lazy Tensors: fetch_ms records only wrapper cost
+            out = [Tensor(f, _internal=True) for f in fetches]
+        _M_FETCH_MS.observe((time.perf_counter() - tf) * 1e3)
+        return out
+
+    # -- public API ---------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, feed_var_name=None,
+            fetch_var_name=None, scope=None, return_numpy=True,
+            use_program_cache=True, optimize_level=None, fetch_async=False):
+        """Run ``program`` (ref: executor.py Executor.run). New vs the
+        reference: ``optimize_level`` selects the ``paddle_tpu.analysis``
+        pass pipeline applied before compilation — 0 verify-only,
+        1 (default) identity-forwarding + dead-op elimination,
+        2 additionally CSE. The verifier always runs; a malformed Program
+        raises ``analysis.ProgramVerificationError`` with coded
+        diagnostics. ``None`` inherits the Executor-level default
+        (``Executor(optimize_level=...)`` / env ``PADDLE_TPU_OPT_LEVEL``).
+
+        ``fetch_async=True`` returns the raw jax arrays with NO host
+        sync: the dispatch is asynchronous, so the Python loop can feed
+        the next batch while the device still computes this one. The
+        caller pays the sync when it first reads a value (or via
+        ``jax.block_until_ready``). Overrides ``return_numpy``.
+        """
+        program, data_parallel, allow_replicated_fallback = \
+            self._unwrap_program(program)
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope or global_scope()
@@ -373,23 +517,147 @@ class Executor:
             if _chaos.ACTIVE:  # disabled => one empty-dict test, no host sync
                 _chaos.fire("transient_execute")
                 feed = _chaos.fire("nan_feed", feed)
-            feeds = [jnp.asarray(np.asarray(feed[n]))
-                     for n in compiled.feed_names]
+            feeds = [self._as_device(feed[n]) for n in compiled.feed_names]
             updated = [scope.find_var(n) for n in compiled.updated]
             frozen = [scope.find_var(n) for n in compiled.frozen]
+            self._dispatches += 1
+            _M_DISPATCHES.inc()
             fetches, new_persist = compiled.fn(feeds, updated, frozen)
             for name, arr in zip(compiled.persist_out, new_persist):
                 scope.set(name, arr)
-            tf = time.perf_counter()
-            if return_numpy:  # np.asarray is the step's host sync point:
-                out = [np.asarray(f) for f in fetches]  # fetch latency
-            else:  # lazy Tensors: fetch_ms records only wrapper cost
-                out = [Tensor(f, _internal=True) for f in fetches]
-            _M_FETCH_MS.observe((time.perf_counter() - tf) * 1e3)
+            out = self._materialize_fetches(fetches, return_numpy,
+                                            fetch_async)
         run_ms = (time.perf_counter() - t0) * 1e3
         _M_RUN_MS.observe(run_ms)
         if _journal.ACTIVE is not None:  # flight recorder: one None check
-            _journal.ACTIVE.record_executor_run(compiled, out, run_ms)
+            # synced=False keeps the flight recorder off the device: a
+            # lazy/async fetch must not pay a hidden per-step host sync
+            # just to log a scalar
+            _journal.ACTIVE.record_executor_run(
+                compiled, out, run_ms,
+                synced=bool(return_numpy) and not fetch_async)
+        return out
+
+    def run_steps(self, program=None, feeds=None, fetch_list=None,
+                  steps=None, scope=None, return_numpy=True,
+                  fetch_async=False, optimize_level=None):
+        """Run K microbatches through ONE fused ``lax.scan`` executable.
+
+        ``feeds`` is either a sequence of K per-step feed dicts (uniform
+        shapes/dtypes) or a single dict of pre-stacked arrays with a
+        leading axis of length ``steps``. The step body is lowered once,
+        persistable buffers ride the scan as a DONATED carry (parameter
+        updates stay in HBM across all K steps), and each fetch comes
+        back stacked with a leading K axis — element ``[k]`` is bitwise
+        what the k-th sequential ``run()`` call would have fetched.
+
+        vs K ``run()`` calls: one compile + one dispatch per window
+        instead of K Python dispatches, K feed transfers issued as one
+        stacked transfer, and zero intermediate host syncs. Host-side
+        per-step work (LR scheduler reads, chaos hooks) necessarily
+        happens once per WINDOW, not once per step: the learning rate is
+        sampled once and applied to all K microbatches.
+
+        Returns a list parallel to ``fetch_list`` of stacked values
+        (numpy by default; lazy/async under ``return_numpy=False`` /
+        ``fetch_async=True`` as in ``run``).
+        """
+        program, data_parallel, allow_replicated_fallback = \
+            self._unwrap_program(program)
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+
+        # normalize to {name: stacked (K, ...) array}. Device arrays
+        # stay device-side (jnp.stack), host values stack in numpy —
+        # prefetched batches must not be gathered back to host here
+        def _stackable(v):
+            # host values stay numpy (np.stack below); device values
+            # keep the canonical pass-through (same invariant as
+            # core.tensor.as_device_array, minus the host->device move,
+            # which is deferred to the single stacked transfer)
+            v = getattr(v, "_data", v)
+            return v if isinstance(v, jax.Array) else np.asarray(v)
+
+        if isinstance(feeds, dict):
+            if not steps:
+                raise ValueError(
+                    "run_steps with a pre-stacked feed dict needs an "
+                    "explicit steps=K (the leading axis length)")
+            K = int(steps)
+            stacked = {n: _stackable(v) for n, v in feeds.items()}
+            for n, v in stacked.items():
+                if v.ndim < 1 or v.shape[0] != K:
+                    raise ValueError(
+                        f"pre-stacked feed {n!r} has shape {v.shape}; "
+                        f"expected a leading microbatch axis of {K}")
+        else:
+            feeds = list(feeds or ())
+            if not feeds:
+                raise ValueError("run_steps needs at least one feed dict")
+            K = int(steps) if steps else len(feeds)
+            if K != len(feeds):
+                raise ValueError(
+                    f"steps={K} but {len(feeds)} feed dicts were given")
+            names = sorted(feeds[0])
+            for f in feeds[1:]:
+                if sorted(f) != names:
+                    raise ValueError(
+                        "every microbatch must feed the same variables; "
+                        f"got {sorted(f)} vs {names}")
+
+            def _stack(vals):
+                vals = [_stackable(v) for v in vals]
+                if any(isinstance(v, jax.Array) for v in vals):
+                    return jnp.stack([jnp.asarray(v) for v in vals])
+                return np.stack(vals)
+
+            stacked = {n: _stack([f[n] for f in feeds]) for n in names}
+        if K <= 0:
+            raise ValueError(f"steps must be >= 1, got {K}")
+
+        if not program.global_block.ops:
+            return []
+
+        # LR schedulers are host-side state: fused windows sample once
+        # per dispatch (documented above), exactly like the compiled
+        # multi-step loops the scheduler API was designed around
+        if program._lr_getter is not None:
+            lr = np.asarray(program._lr_getter(), np.float32)
+            stacked = dict(stacked)
+            stacked["@lr"] = np.broadcast_to(lr, (K,) + lr.shape).copy()
+
+        # shape/dtype probes for the cache key — structs, not slices, so
+        # no device work happens before the dispatch
+        per_step = {n: jax.ShapeDtypeStruct(tuple(v.shape[1:]),
+                                            np.dtype(v.dtype))
+                    for n, v in stacked.items()}
+        t0 = time.perf_counter()
+        with _trace.span("executor.run_steps", uid=program._uid,
+                         steps=K, n_fetch=len(fetch_list)):
+            compiled = self._compile(
+                program, per_step, fetch_list, data_parallel=data_parallel,
+                allow_replicated_fallback=allow_replicated_fallback,
+                optimize_level=optimize_level, steps=K)
+            if _chaos.ACTIVE:  # window-granularity chaos (one fused step)
+                _chaos.fire("transient_execute")
+                stacked = _chaos.fire("nan_feed", stacked)
+            feed_arrs = [self._as_device(stacked[n])
+                         for n in compiled.feed_names]
+            updated = [scope.find_var(n) for n in compiled.updated]
+            frozen = [scope.find_var(n) for n in compiled.frozen]
+            self._dispatches += 1
+            _M_DISPATCHES.inc()
+            fetches, new_persist = compiled.fn(feed_arrs, updated, frozen)
+            for name, arr in zip(compiled.persist_out, new_persist):
+                scope.set(name, arr)
+            out = self._materialize_fetches(fetches, return_numpy,
+                                            fetch_async)
+        run_ms = (time.perf_counter() - t0) * 1e3
+        _M_RUN_MS.observe(run_ms)
+        if _journal.ACTIVE is not None:
+            _journal.ACTIVE.record_fused_run(
+                compiled, out, run_ms, steps=K,
+                synced=bool(return_numpy) and not fetch_async)
         return out
 
     # -- dataset-driven loops (ref: executor.py:1436 train_from_dataset /

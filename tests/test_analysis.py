@@ -226,8 +226,8 @@ def test_program_uid_monotonic_and_cache_keyed_on_uid():
         exe = fluid.Executor()
         exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
                 fetch_list=[out])
-        assert any(k[0] == main._uid for k in exe._cache)
-        assert not any(k[0] == id(main) for k in exe._cache)
+        assert any(k.program_uid == main._uid for k in exe._cache)
+        assert not any(k.program_uid == id(main) for k in exe._cache)
     finally:
         pt.disable_static()
 

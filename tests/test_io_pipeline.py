@@ -149,6 +149,18 @@ class TestReaders:
         r = lambda: iter(range(50))
         assert list(R.buffered(r, 8)()) == list(range(50))
 
+    def test_prefetch_to_device_decorator(self):
+        """Reader-creator form of the double-buffered device feed:
+        batches come back as jax arrays, in order."""
+        import jax
+
+        r = lambda: iter(np.full((3, 2), i, np.float32) for i in range(5))
+        got = list(R.prefetch_to_device(r, depth=2)())
+        assert len(got) == 5
+        assert all(isinstance(b, jax.Array) for b in got)
+        assert [float(np.asarray(b)[0, 0]) for b in got] == \
+            [0.0, 1.0, 2.0, 3.0, 4.0]
+
     def test_data_feeder(self):
         f = R.DataFeeder(feed_list=["x", "y"])
         feed = f.feed([(np.ones(3), 0), (np.zeros(3), 1)])

@@ -1,0 +1,232 @@
+"""CPU-runnable perf gates (ISSUE 6 tentpole #3): HLO invariants —
+donated-buffer counts, op-shape counts, collective bytes — plus
+compiled-call-count gates over the fused lax.scan step path. These are
+the tier-1 stand-in for the dark real-TPU bench: a perf regression that
+changes WHAT gets compiled (donation lost, scan unrolled, extra
+dispatches, comm blow-up) fails here without a single timing."""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _perf_gate():
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate_for_tests", os.path.join(ROOT, "tools", "perf_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def pg():
+    return _perf_gate()
+
+
+@pytest.fixture
+def static_mode():
+    pt.enable_static()
+    yield
+    pt.disable_static()
+
+
+def _build_mlp(batch=16, lr=0.05):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[batch, 8])
+        y = fluid.data(name="y", shape=[batch, 1])
+        h = fluid.layers.fc(x, size=16, act="relu")
+        out = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(out, y))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    return prog, startup, loss
+
+
+def _feeds(K, batch=16):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.randn(batch, 8).astype(np.float32),
+             "y": rng.randn(batch, 1).astype(np.float32)}
+            for _ in range(K)]
+
+
+# -- HLO parsing units (canned, no backend work) -----------------------------
+
+
+def test_donation_parse_canned(pg):
+    hlo = ("HloModule m, input_output_alias={ {1}: (2, {}, may-alias), "
+           "{3}: (4, {}) }, entry_computation_layout={()->()}")
+    d = pg.donation_stats(hlo)
+    assert d["count"] == 2
+    assert d["aliases"] == [((1,), 2, "may-alias"), ((3,), 4, "must-alias")]
+    assert pg.donation_stats("HloModule m\n%x = f32[] add(...)") == \
+        {"count": 0, "aliases": []}
+
+
+def test_op_counts_canned(pg):
+    hlo = ("%a = f32[8]{0} fusion(f32[8]{0} %p), kind=kLoop\n"
+           "%b = (s32[], f32[8]{0}) while((s32[], f32[8]{0}) %i), "
+           "body=%body\n"
+           "%c = f32[8,8]{1,0} dot(f32[8,8]{1,0} %x, f32[8,8]{1,0} %y)\n"
+           "%d = f32[8]{0} all-reduce(f32[8]{0} %z), replica_groups={}")
+    counts = pg.op_counts(hlo, kinds=("fusion", "while", "dot",
+                                      "all-reduce", "convolution"))
+    assert counts == {"fusion": 1, "while": 1, "dot": 1, "all-reduce": 1,
+                      "convolution": 0}
+
+
+def test_check_hlo_flags_regressions(pg):
+    hlo = ("HloModule m, input_output_alias={ {1}: (1, {}, may-alias) }, "
+           "entry_computation_layout={()->()}\n"
+           "%f = f32[8]{0} fusion(f32[8]{0} %p), kind=kLoop")
+    assert pg.check_hlo(hlo, min_donated=1, min_fusion=1, max_while=0) == []
+    assert pg.check_hlo(hlo, min_donated=2)  # donation regression
+    assert pg.check_hlo(hlo, min_while=1)    # scan disappeared
+    assert pg.check_hlo(hlo, min_fusion=2)   # fusion regression
+
+
+# -- the acceptance gate: K=8 fused scan vs 8 sequential runs ----------------
+
+
+def test_k8_fused_scan_bitwise_one_compile_one_dispatch(pg, static_mode):
+    """ISSUE 6 acceptance: K=8 microbatches through run_steps produce a
+    BITWISE-identical loss trajectory to 8 sequential Executor.run
+    calls, with exactly 1 compile + 1 dispatch (vs 8 dispatches), the
+    persistable carry donated, and exactly one while loop (the scan) in
+    the fused executable."""
+    K = 8
+    feeds = _feeds(K)
+
+    pt.seed(0)
+    prog, startup, loss = _build_mlp()
+    exe = fluid.Executor()
+    exe.run(startup)
+    seq = [exe.run(prog, feed=f, fetch_list=[loss])[0] for f in feeds]
+    calls = pg.executor_call_counts(exe)
+    assert calls["compiles"] == 1 and calls["dispatches"] == K, calls
+
+    pt.seed(0)
+    prog2, startup2, loss2 = _build_mlp()
+    exe2 = fluid.Executor()
+    exe2.run(startup2)
+    (traj,) = exe2.run_steps(prog2, feeds=feeds, fetch_list=[loss2])
+    calls2 = pg.executor_call_counts(exe2)
+    assert calls2["compiles"] == 1 and calls2["dispatches"] == 1, calls2
+    assert traj.shape == (K,)
+    for k, s in enumerate(seq):
+        assert np.asarray(s).tobytes() == np.asarray(traj[k]).tobytes(), \
+            (k, float(np.asarray(s)), float(traj[k]))
+
+    entry = next(iter(exe2._cache.values()))
+    n_persist = len(entry.updated)
+    assert n_persist >= 4  # 2 fc layers: w + b each
+    assert pg.check_entry(entry, min_donated=n_persist,
+                          min_while=1, max_while=1) == []
+    # rerunning the same window is a cache hit, one more dispatch
+    exe2.run_steps(prog2, feeds=feeds, fetch_list=[loss2])
+    calls2 = pg.executor_call_counts(exe2)
+    assert calls2["compiles"] == 1 and calls2["dispatches"] == 2
+    assert calls2["cache_hits"] == 1
+
+
+def test_sequential_entry_donates_and_has_no_loop(pg, static_mode):
+    """The single-step executable keeps its donation invariant (params
+    update in place) and must NOT contain a while loop."""
+    pt.seed(0)
+    prog, startup, loss = _build_mlp()
+    exe = fluid.Executor()
+    exe.run(startup)
+    exe.run(prog, feed=_feeds(1)[0], fetch_list=[loss])
+    entry = next(iter(exe._cache.values()))
+    assert pg.check_entry(entry, min_donated=len(entry.updated),
+                          max_while=0) == []
+
+
+def test_dp_fused_entry_keeps_collectives_in_loop(pg, static_mode):
+    """Fused + data-parallel: the grad all-reduce must survive inside
+    the scan body (one all-reduce instruction in the while body — it
+    executes once per microbatch), and the fused DP trajectory must
+    match sequential DP runs bitwise."""
+    import jax
+
+    if jax.local_device_count() < 2:
+        pytest.skip("needs the 8-fake-device mesh")
+    K = 4
+    feeds = _feeds(K)
+
+    pt.seed(0)
+    prog, startup, loss = _build_mlp()
+    cp = fluid.CompiledProgram(prog).with_data_parallel(loss_name=loss.name)
+    exe = fluid.Executor()
+    exe.run(startup)
+    seq = [exe.run(cp, feed=f, fetch_list=[loss])[0] for f in feeds]
+
+    pt.seed(0)
+    prog2, startup2, loss2 = _build_mlp()
+    cp2 = fluid.CompiledProgram(prog2).with_data_parallel(
+        loss_name=loss2.name)
+    exe2 = fluid.Executor()
+    exe2.run(startup2)
+    (traj,) = exe2.run_steps(cp2, feeds=feeds, fetch_list=[loss2])
+    for k, s in enumerate(seq):
+        assert np.asarray(s).tobytes() == np.asarray(traj[k]).tobytes(), \
+            (k, float(np.asarray(s)), float(traj[k]))
+
+    entry = next(iter(exe2._cache.values()))
+    hlo = pg.entry_hlo(entry)
+    from paddle_tpu.obs import spmd
+
+    prof = spmd.collective_profile(
+        hlo, mesh=(entry.mesh_axes, entry.mesh_device_ids))
+    assert prof["counts"].get("all-reduce", 0) >= 1, prof
+    assert prof["bytes"].get("all-reduce", 0) > 0, prof
+    # and the fused key is a distinct, named cache axis
+    keys = list(exe2._cache)
+    assert all(k.data_parallel for k in keys)
+    assert any(k.steps == K for k in keys)
+
+
+def test_cache_key_named_fields(static_mode):
+    """CacheKey replaces the positional tuple: new axes are named, and
+    distinct K values are distinct entries of the same program."""
+    from paddle_tpu.static_.executor import CacheKey
+
+    pt.seed(0)
+    prog, startup, loss = _build_mlp()
+    exe = fluid.Executor()
+    exe.run(startup)
+    feeds = _feeds(4)
+    exe.run(prog, feed=feeds[0], fetch_list=[loss])
+    exe.run_steps(prog, feeds=feeds[:2], fetch_list=[loss])
+    exe.run_steps(prog, feeds=feeds, fetch_list=[loss])
+    keys = list(exe._cache)
+    assert all(isinstance(k, CacheKey) for k in keys)
+    assert {k.steps for k in keys} == {None, 2, 4}
+    assert all(k.program_uid == prog._uid for k in keys)
+    assert all(k.data_parallel is False for k in keys)
+
+
+def test_fetch_async_returns_jax_arrays_no_numpy(static_mode):
+    """fetch_async=True hands back raw jax arrays (no numpy conversion,
+    no Tensor wrapper) whose values still match the synced fetch."""
+    import jax
+
+    pt.seed(0)
+    prog, startup, loss = _build_mlp()
+    exe = fluid.Executor()
+    exe.run(startup)
+    f = _feeds(1)[0]
+    (lazy,) = exe.run(prog, feed=f, fetch_list=[loss], fetch_async=True)
+    assert isinstance(lazy, jax.Array)
+    pt.seed(0)
+    prog2, startup2, loss2 = _build_mlp()
+    exe2 = fluid.Executor()
+    exe2.run(startup2)
+    (synced,) = exe2.run(prog2, feed=f, fetch_list=[loss2])
+    assert np.asarray(lazy).tobytes() == np.asarray(synced).tobytes()

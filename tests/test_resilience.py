@@ -575,6 +575,98 @@ class TestLoaderWorkerRecovery:
             "abandoned DataLoader iterators leaked worker threads"
 
 
+class TestDevicePrefetcherRecovery:
+    """Fault paths of the PR-6 double-buffered device feed
+    (io_.dataloader.DevicePrefetcher), extending the PR-2 worker-fault
+    contract to the device_put stage: errors surface IN BATCH ORDER,
+    and shutdown never hangs or leaks the feeder thread."""
+
+    def test_upstream_raise_mid_prefetch_surfaces_in_order(self):
+        from paddle_tpu.io_.dataloader import prefetch_to_device
+
+        def src():
+            yield {"x": np.zeros(2, np.float32)}
+            yield {"x": np.ones(2, np.float32)}
+            raise RuntimeError("decoder blew up mid-prefetch")
+
+        it = prefetch_to_device(src(), depth=2)
+        assert float(np.asarray(next(it)["x"])[0]) == 0.0
+        assert float(np.asarray(next(it)["x"])[0]) == 1.0
+        with pytest.raises(RuntimeError, match="mid-prefetch"):
+            next(it)
+        with pytest.raises(StopIteration):  # dead stage stays dead
+            next(it)
+
+    def test_device_put_failure_surfaces_in_order(self):
+        """A transfer-stage failure (here: a sharding callable that
+        rejects batch 1) arrives at batch 1's position — batch 0, which
+        was prefetched before it, still arrives first."""
+        from paddle_tpu.io_.dataloader import prefetch_to_device
+
+        calls = []
+
+        def bad_sharding(batch):
+            import jax
+
+            calls.append(1)
+            if len(calls) == 2:
+                raise ValueError("device_put rejected layout")
+            return jax.device_put(batch)
+
+        src = [{"x": np.full(2, i, np.float32)} for i in range(4)]
+        it = prefetch_to_device(src, shardings=bad_sharding, depth=2)
+        first = next(it)
+        assert float(np.asarray(first["x"])[0]) == 0.0
+        with pytest.raises(ValueError, match="rejected layout"):
+            for _ in range(3):
+                next(it)
+
+    def test_shutdown_mid_stream_no_hang_no_leak(self):
+        from paddle_tpu.io_.dataloader import DevicePrefetcher
+
+        before = threading.active_count()
+        t0 = time.monotonic()
+        for _ in range(3):
+            # unbounded source + tiny queue: the feeder is guaranteed
+            # to be BLOCKED on a full queue when shutdown fires
+            def src():
+                i = 0
+                while True:
+                    yield {"x": np.full(2, i, np.float32)}
+                    i += 1
+
+            pf = DevicePrefetcher(src(), depth=1)
+            next(pf)
+            pf.shutdown()
+            pf.shutdown()  # idempotent
+        assert time.monotonic() - t0 < 10, "shutdown hung"
+        deadline = time.monotonic() + 10
+        while threading.active_count() > before and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before, \
+            "DevicePrefetcher leaked feeder threads"
+
+    def test_generator_wrapper_cleans_up_on_consumer_raise(self):
+        from paddle_tpu.io_.dataloader import prefetch_to_device
+
+        before = threading.active_count()
+        src = [{"x": np.zeros(2, np.float32)}] * 100
+        with pytest.raises(KeyError):
+            for batch in prefetch_to_device(src, depth=2):
+                raise KeyError("consumer failed")  # finally -> shutdown
+        deadline = time.monotonic() + 10
+        while threading.active_count() > before and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before
+
+    def test_empty_source_terminates(self):
+        from paddle_tpu.io_.dataloader import prefetch_to_device
+
+        assert list(prefetch_to_device([], depth=2)) == []
+
+
 # -- activation plumbing -----------------------------------------------------
 
 

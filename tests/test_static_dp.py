@@ -80,7 +80,7 @@ def test_dp_shards_batch_axis(static_mode):
     # the executor compiled under the DP cache key, and the jit carries
     # batch-axis shardings: the traced executable's input sharding for
     # the feed spans all devices
-    assert any(k[-2] is True for k in exe._cache)  # data_parallel slot
+    assert any(k.data_parallel for k in exe._cache)  # named CacheKey field
     (compiled_entry,) = exe._cache.values()
     feed_shardings = compiled_entry.feed_shardings
     ndev = jax.local_device_count()

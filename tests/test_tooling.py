@@ -89,6 +89,20 @@ def test_shard_report_self_test_passes():
     assert mod.main(["--self-test"]) == 0
 
 
+def test_perf_gate_self_test_passes():
+    """tools/perf_gate.py --self-test: canned-HLO donation/fusion/while
+    accounting must match hand-computed counts (and the bound checker
+    must flag seeded regressions), and the live 8-fake-device check must
+    hold the ISSUE-6 acceptance gate — K=8 microbatches through the
+    fused lax.scan path produce a bitwise-identical loss trajectory to
+    8 sequential Executor.run calls with exactly 1 compile + 1 dispatch,
+    the persistable carry donated, and exactly one while loop in the
+    executable. In-process so it rides the tier-1 command path like the
+    other self-tests."""
+    mod = _load_tool("perf_gate")
+    assert mod.main(["--self-test"]) == 0
+
+
 def test_chaos_marker_is_registered():
     """tests/test_resilience.py marks itself `chaos`; an unregistered
     marker would warn (or fail under --strict-markers). Pin it."""

@@ -1,0 +1,442 @@
+#!/usr/bin/env python
+"""perf_gate: CPU-runnable performance gates over compiled HLO.
+
+The real-TPU bench has been dark since r02, so perf claims need a
+signal that runs in tier-1 CI: instead of timing (noisy, host-bound on
+CPU), gate on the INVARIANTS that make the step fast and that XLA's own
+compiled HLO proves —
+
+- **donation**: how many input buffers the executable aliases to
+  outputs (``input_output_alias``) — a donated persistable updates
+  in-place in HBM; a regression here doubles parameter memory traffic.
+- **op shape**: per-kind instruction counts from the optimized HLO
+  (``fusion``, ``while``, ``dot``, collectives, ...) — a fused
+  multi-step entry must contain exactly one ``while`` loop (the scan),
+  not K unrolled bodies.
+- **collective bytes**: per-step communication volume via
+  ``obs.spmd.collective_profile`` — the PR-5 comm accounting, now
+  assertable as a ceiling.
+- **compiled-call counts**: executor compiles (jit-cache misses) and
+  dispatches — the fused ``run_steps`` path must compile once and
+  dispatch once per K-step window where the sequential path dispatches
+  K times.
+
+Usage:
+    python tools/perf_gate.py --self-test   # canned-HLO fixtures with
+        # hand-computed donation/fusion counts + a live 8-fake-device
+        # scan-vs-loop compiled-call-count check
+    python tools/perf_gate.py --entry-report   # live MLP demo: build,
+        # run fused, print the invariant report
+
+In-process (the way tests/test_perf_gates.py uses it):
+    from tools.perf_gate import (entry_hlo, donation_stats, op_counts,
+                                 check_entry, executor_call_counts)
+    failures = check_entry(compiled, min_donated=2, max_while=1)
+
+Wired into tier-1 via tests/test_tooling.py (lint/chaos/obs/run/shard
+_report pattern).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _ensure_fake_devices(n=8):
+    """Standalone runs need the fake-device CPU platform configured
+    BEFORE jax initializes; under pytest the conftest already did."""
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+    import jax
+
+    return len(jax.devices())
+
+
+# -- HLO parsing --------------------------------------------------------------
+
+# one alias entry inside the input_output_alias header attribute:
+#   {1}: (1, {}, may-alias)   /   {0, 2}: (3, {0})
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([0-9,\s]*)\}:\s*\(([0-9]+),\s*\{[0-9,\s]*\}"
+    r"(?:,\s*(may-alias|must-alias))?\)")
+
+# one HLO instruction: "%name = TYPE opkind(" where TYPE is a shape or a
+# tuple; group(2) is the op mnemonic (fusion, while, dot, all-reduce...)
+_INSTR_RE = re.compile(
+    r"=\s*(\([^)]*\)|[a-z][a-z0-9]*\[[0-9,]*\](?:\{[^}]*\})?)\s*"
+    r"([a-z][a-z0-9-]*)\(")
+
+
+def _alias_attr(hlo_text):
+    """The raw ``input_output_alias={...}`` attribute body of the entry
+    module header, or None. Brace-balanced scan: the body nests braces
+    ({output index} / {param path})."""
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return None
+    i = start + len("input_output_alias={")
+    depth = 1
+    while i < len(hlo_text) and depth:
+        if hlo_text[i] == "{":
+            depth += 1
+        elif hlo_text[i] == "}":
+            depth -= 1
+        i += 1
+    return hlo_text[start + len("input_output_alias={"):i - 1]
+
+
+def donation_stats(hlo_text):
+    """Donated-buffer accounting from the module header's
+    ``input_output_alias`` attribute: ``count`` aliased (donated)
+    buffers and the ``aliases`` list of
+    ``(output_index, param_number, kind)``. An executable that donates
+    nothing returns count 0 (and that IS a meaningful gate failure for
+    a training step: its parameter updates round-trip HBM)."""
+    attr = _alias_attr(hlo_text)
+    if attr is None:
+        return {"count": 0, "aliases": []}
+    aliases = [
+        (tuple(int(x) for x in out.split(",") if x.strip()), int(param),
+         kind or "must-alias")
+        for out, param, kind in _ALIAS_ENTRY_RE.findall(attr)]
+    return {"count": len(aliases), "aliases": aliases}
+
+
+def op_counts(hlo_text, kinds=None):
+    """Instruction counts per op mnemonic over the optimized HLO text
+    (entry + nested computations). ``kinds`` filters to the named ops,
+    reporting explicit zeros for absent ones — a gate asserting
+    ``while == 1`` needs the 0, not a missing key."""
+    counts = {}
+    for m in _INSTR_RE.finditer(hlo_text):
+        k = m.group(2)
+        counts[k] = counts.get(k, 0) + 1
+    if kinds is None:
+        return counts
+    return {k: counts.get(k, 0) for k in kinds}
+
+
+def entry_hlo(compiled):
+    """Optimized HLO text of one Executor cache entry, lowered from the
+    arg structs captured at build time. BLOCKING (pays one XLA compile)
+    on first call per entry; cached on the entry thereafter. None when
+    lowering fails."""
+    cached = getattr(compiled, "_perf_gate_hlo", None)
+    if cached is not None:
+        return cached
+    structs = getattr(compiled, "arg_structs", None)
+    if structs is None:
+        return None
+    try:
+        text = compiled.fn.lower(*structs).compile().as_text()
+    except Exception:
+        return None
+    compiled._perf_gate_hlo = text
+    return text
+
+
+# -- gates --------------------------------------------------------------------
+
+
+def check_hlo(hlo_text, *, min_donated=None, max_donated=None,
+              min_fusion=None, max_while=None, min_while=None,
+              max_collective_bytes=None, mesh=None):
+    """Check one HLO module against invariant bounds; returns the list
+    of failure strings (empty = gate passes). Only the bounds given are
+    checked — a gate file states exactly what it pins."""
+    failures = []
+    don = donation_stats(hlo_text)["count"]
+    ops = op_counts(hlo_text)
+    if min_donated is not None and don < min_donated:
+        failures.append(f"donated buffers {don} < required {min_donated}")
+    if max_donated is not None and don > max_donated:
+        failures.append(f"donated buffers {don} > allowed {max_donated}")
+    if min_fusion is not None and ops.get("fusion", 0) < min_fusion:
+        failures.append(
+            f"fusion ops {ops.get('fusion', 0)} < required {min_fusion}")
+    n_while = ops.get("while", 0)
+    if max_while is not None and n_while > max_while:
+        failures.append(f"while loops {n_while} > allowed {max_while} "
+                        "(scan body unrolled or duplicated?)")
+    if min_while is not None and n_while < min_while:
+        failures.append(f"while loops {n_while} < required {min_while} "
+                        "(fused path did not lower to a scan)")
+    if max_collective_bytes is not None:
+        from paddle_tpu.obs import spmd
+
+        prof = spmd.collective_profile(hlo_text, mesh=mesh)
+        if prof["total_bytes"] > max_collective_bytes:
+            failures.append(
+                f"collective bytes {prof['total_bytes']} > allowed "
+                f"{max_collective_bytes} ({prof['counts']})")
+    return failures
+
+
+def check_entry(compiled, **bounds):
+    """``check_hlo`` over one Executor cache entry (lowering it on
+    demand); the entry's own mesh feeds collective attribution."""
+    hlo = entry_hlo(compiled)
+    if hlo is None:
+        return ["entry HLO unavailable (lowering failed)"]
+    axes = getattr(compiled, "mesh_axes", None)
+    mesh = None
+    if axes is not None:
+        mesh = (axes, getattr(compiled, "mesh_device_ids", None))
+    return check_hlo(hlo, mesh=mesh, **bounds)
+
+
+def executor_call_counts(exe):
+    """Compiled-call accounting for one Executor: ``compiles`` (jit
+    cache misses — one per distinct executable built) and
+    ``dispatches`` (compiled-fn invocations across run/run_steps). The
+    fused-path gate: K steps through ``run_steps`` must show
+    compiles == 1 and dispatches == 1 where the sequential loop shows
+    dispatches == K."""
+    stats = exe.cache_stats()
+    return {"compiles": stats["misses"], "dispatches": exe.dispatches,
+            "cache_hits": stats["hits"], "entries": stats["size"]}
+
+
+# -- self-test ----------------------------------------------------------------
+
+# canned HLO fixtures with HAND-COMPUTED expectations (no backend needed)
+CANNED_HLO = [
+    {
+        "name": "training step: 2 donated params, 3 fusions, no loop",
+        "hlo": "HloModule jit_step, is_scheduled=true, "
+               "input_output_alias={ {1}: (1, {}, may-alias), "
+               "{2}: (2, {}, may-alias) }, "
+               "entry_computation_layout={(f32[16,8]{1,0}, f32[8,8]{1,0}, "
+               "f32[8]{0})->(f32[], f32[8,8]{1,0}, f32[8]{0})}\n"
+               "%f1 = f32[8,8]{1,0} fusion(f32[8,8]{1,0} %p1), kind=kLoop\n"
+               "%f2 = f32[8]{0} fusion(f32[8]{0} %p2), kind=kLoop\n"
+               "%f3 = f32[] fusion(f32[16,8]{1,0} %p0), kind=kOutput\n"
+               "%d = f32[16,8]{1,0} dot(f32[16,8]{1,0} %p0, "
+               "f32[8,8]{1,0} %f1)",
+        "donated": 2, "fusion": 3, "while": 0, "dot": 1,
+        "aliases": [((1,), 1, "may-alias"), ((2,), 2, "may-alias")],
+    },
+    {
+        "name": "fused scan entry: 1 while, donated carry",
+        "hlo": "HloModule jit_fused, is_scheduled=true, "
+               "input_output_alias={ {1}: (1, {}, may-alias) }, "
+               "entry_computation_layout={(f32[4,16,8]{2,1,0}, "
+               "f32[8,8]{1,0})->(f32[4]{0}, f32[8,8]{1,0})}\n"
+               "%w = (s32[], f32[8,8]{1,0}, f32[4]{0}) while("
+               "(s32[], f32[8,8]{1,0}, f32[4]{0}) %init), "
+               "condition=%cond, body=%body\n"
+               "%f1 = f32[8,8]{1,0} fusion(f32[8,8]{1,0} %x), kind=kLoop",
+        "donated": 1, "fusion": 1, "while": 1, "dot": 0,
+        "aliases": [((1,), 1, "may-alias")],
+    },
+    {
+        "name": "inference executable: nothing donated, no loop",
+        "hlo": "HloModule jit_fwd, is_scheduled=true, "
+               "entry_computation_layout={(f32[16,8]{1,0})->(f32[16])}\n"
+               "%d = f32[16]{0} dot(f32[16,8]{1,0} %p0, f32[8]{0} %c)",
+        "donated": 0, "fusion": 0, "while": 0, "dot": 1,
+        "aliases": [],
+    },
+]
+
+
+def _check(failures, cond, msg):
+    if not cond:
+        failures.append(msg)
+
+
+def _build_mlp(batch=16):
+    import paddle_tpu.fluid as fluid
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[batch, 8])
+        y = fluid.data(name="y", shape=[batch, 1])
+        h = fluid.layers.fc(x, size=16, act="relu")
+        out = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(out, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return prog, startup, loss
+
+
+def _live_scan_vs_loop(ndev):
+    """The acceptance gate, live: K=8 microbatches through run_steps
+    must (a) produce a BITWISE-identical loss trajectory to 8
+    sequential run() calls, (b) compile once and dispatch once where
+    the loop dispatches 8 times, (c) donate the persistable carry, and
+    (d) lower to exactly one while loop."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+
+    failures = []
+    K = 8
+    pt.enable_static()
+    try:
+        rng = np.random.RandomState(0)
+        feeds = [{"x": rng.randn(16, 8).astype(np.float32),
+                  "y": rng.randn(16, 1).astype(np.float32)}
+                 for _ in range(K)]
+
+        pt.seed(0)
+        prog, startup, loss = _build_mlp()
+        exe = fluid.Executor()
+        exe.run(startup)
+        seq = [exe.run(prog, feed=f, fetch_list=[loss])[0] for f in feeds]
+        calls = executor_call_counts(exe)
+        _check(failures, calls["compiles"] == 1 and calls["dispatches"] == K,
+               f"sequential loop: expected 1 compile / {K} dispatches, "
+               f"got {calls}")
+
+        pt.seed(0)
+        prog2, startup2, loss2 = _build_mlp()
+        exe2 = fluid.Executor()
+        exe2.run(startup2)
+        (traj,) = exe2.run_steps(prog2, feeds=feeds, fetch_list=[loss2])
+        calls2 = executor_call_counts(exe2)
+        _check(failures,
+               calls2["compiles"] == 1 and calls2["dispatches"] == 1,
+               f"fused run_steps: expected 1 compile / 1 dispatch for "
+               f"{K} steps, got {calls2}")
+        _check(failures, traj.shape == (K,),
+               f"fused trajectory shape {traj.shape} != ({K},)")
+        bitwise = all(
+            np.asarray(s).tobytes() == np.asarray(traj[k]).tobytes()
+            for k, s in enumerate(seq))
+        _check(failures, bitwise,
+               f"fused loss trajectory is not bitwise-identical to the "
+               f"sequential one: {[float(np.asarray(s)) for s in seq]} vs "
+               f"{[float(v) for v in traj]}")
+
+        entry = next(iter(exe2._cache.values()))
+        n_persist = len(entry.updated)
+        _check(failures, n_persist > 0,
+               "MLP entry has no updated persistables?")
+        failures += [f"fused entry: {f}" for f in check_entry(
+            entry, min_donated=n_persist, min_while=1, max_while=1)]
+        # the sequential entry must donate too, and contain NO loop
+        entry1 = next(iter(exe._cache.values()))
+        failures += [f"step entry: {f}" for f in check_entry(
+            entry1, min_donated=n_persist, max_while=0)]
+    finally:
+        pt.disable_static()
+    return failures
+
+
+def self_test():
+    ndev = _ensure_fake_devices(8)
+    failures = []
+    for case in CANNED_HLO:
+        don = donation_stats(case["hlo"])
+        _check(failures, don["count"] == case["donated"],
+               f"{case['name']}: donated {don['count']} != "
+               f"{case['donated']}")
+        _check(failures, don["aliases"] == case["aliases"],
+               f"{case['name']}: aliases {don['aliases']} != "
+               f"{case['aliases']}")
+        ops = op_counts(case["hlo"], kinds=("fusion", "while", "dot"))
+        for k in ("fusion", "while", "dot"):
+            _check(failures, ops[k] == case[k],
+                   f"{case['name']}: {k} count {ops[k]} != {case[k]}")
+        # the bound-checker must agree with the raw counts
+        _check(failures,
+               check_hlo(case["hlo"], min_donated=case["donated"],
+                         max_donated=case["donated"],
+                         min_fusion=case["fusion"],
+                         min_while=case["while"],
+                         max_while=case["while"]) == [],
+               f"{case['name']}: check_hlo rejects its own ground truth")
+        _check(failures,
+               check_hlo(case["hlo"],
+                         min_donated=case["donated"] + 1) != [],
+               f"{case['name']}: check_hlo missed a donation regression")
+
+    if ndev < 2:
+        failures.append(f"need >=2 fake devices, have {ndev}")
+    else:
+        failures += _live_scan_vs_loop(ndev)
+
+    for line in failures:
+        print(f"  FAILED — {line}")
+    if failures:
+        print(f"self-test FAILED: {len(failures)} check(s)")
+        return 1
+    print("self-test passed: canned-HLO donation/fusion/while counts "
+          "match hand-computed values, bound checks catch seeded "
+          "regressions, and the live 8-fake-device K=8 scan-vs-loop "
+          "check holds (bitwise loss trajectory, 1 compile + 1 dispatch "
+          "vs 8, persistable carry donated, exactly one while loop)")
+    return 0
+
+
+def entry_report(exe=None):
+    """Human-readable invariant report over an Executor's cache (the
+    --entry-report demo builds a fused MLP run first)."""
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+
+    if exe is None:
+        import numpy as np
+
+        pt.enable_static()
+        try:
+            pt.seed(0)
+            prog, startup, loss = _build_mlp()
+            exe = fluid.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            feeds = [{"x": rng.randn(16, 8).astype(np.float32),
+                      "y": rng.randn(16, 1).astype(np.float32)}
+                     for _ in range(4)]
+            exe.run_steps(prog, feeds=feeds, fetch_list=[loss])
+        finally:
+            pt.disable_static()
+    lines = [f"calls        {json.dumps(executor_call_counts(exe))}"]
+    for key, compiled in exe._cache.items():
+        hlo = entry_hlo(compiled)
+        if hlo is None:
+            lines.append(f"entry uid={compiled.program_uid}: "
+                         "HLO unavailable")
+            continue
+        don = donation_stats(hlo)
+        ops = op_counts(hlo, kinds=("fusion", "while", "dot",
+                                    "all-reduce"))
+        lines.append(
+            f"entry uid={compiled.program_uid} "
+            f"steps_fused={getattr(compiled, 'steps', None)}  "
+            f"donated={don['count']}  ops={json.dumps(ops)}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--self-test", action="store_true",
+                    help="canned-HLO donation/fusion accounting + live "
+                         "scan-vs-loop compiled-call-count gate")
+    ap.add_argument("--entry-report", action="store_true",
+                    help="build + fuse a demo MLP and print its "
+                         "invariant report")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if args.entry_report:
+        _ensure_fake_devices(8)
+        print(entry_report())
+        return 0
+    ap.error("pass --self-test or --entry-report")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -157,6 +157,11 @@ def render_run(run, as_json=False):
         f"steps        {len(run['steps'])} "
         f"({sum(1 for s in run['steps'] if s.get('skipped'))} skipped)",
     ]
+    # fused windows (steps_fused=K) journal as one record per dispatch;
+    # show the optimizer-step total so a fused run reads comparably
+    opt_steps = sum(int(s.get("steps_fused") or 1) for s in run["steps"])
+    if opt_steps != len(run["steps"]):
+        lines[-1] += f", {opt_steps} optimizer steps (fused windows)"
     if losses:
         lines.append(f"loss         first={losses[0]:.6g} "
                      f"last={losses[-1]:.6g} min={min(losses):.6g}")
