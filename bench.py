@@ -293,6 +293,36 @@ def bench_int8_predictor(B=256):
                 "max_prob_diff": float(np.abs(o32 - o8).max())}
 
 
+def bench_serve(requests=48, rate=100.0, pages=256, page_size=16):
+    """Continuous-batching serving (paddle_tpu.serving): a Poisson
+    trace of mixed-length prompts through ServeEngine's paged-KV
+    decode path, reporting tokens/s and p50/p99 TTFT/TPOT — the
+    serving-latency axis the train legs can't see. The TinyLM is
+    dispatch-bound by design: this measures the scheduler + paged
+    decode step overhead, which is exactly what continuous batching
+    amortizes."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench_leg",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tools", "serve_bench.py"))
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    rep = sb.run_bench(n_requests=requests, rate=rate, pages=pages,
+                       page_size=page_size)
+    return {
+        "tokens_per_sec": rep["tokens_per_sec"],
+        "ttft_p50_ms": rep["ttft_p50_ms"],
+        "ttft_p99_ms": rep["ttft_p99_ms"],
+        "tpot_p50_ms": rep["tpot_p50_ms"],
+        "tpot_p99_ms": rep["tpot_p99_ms"],
+        "requests": rep["requests"], "finished": rep["finished"],
+        "preemptions": rep["preemptions"],
+        "kv_fragmentation": rep["kv_fragmentation"],
+    }
+
+
 def bench_lenet_exec(B=256, K=8):
     """MNIST LeNet through the static Program/Executor feed/fetch loop
     (BASELINE config 1) — measures compiled-program dispatch + host
@@ -473,7 +503,7 @@ def _run_benches(results):
     """Mutates `results` in place so legs finished before a watchdog
     deadline still reach the JSON line."""
     global bench_bert, bench_resnet50, bench_gpt, bench_wmt_beam, \
-        bench_lenet_exec, bench_int8_predictor
+        bench_lenet_exec, bench_int8_predictor, bench_serve
     if SMOKE:
         import functools
 
@@ -484,10 +514,13 @@ def _run_benches(results):
                                            beam=2, max_len=8)
         bench_lenet_exec = functools.partial(bench_lenet_exec, B=8)
         bench_int8_predictor = functools.partial(bench_int8_predictor, B=8)
+        bench_serve = functools.partial(bench_serve, requests=8,
+                                        rate=50.0, pages=64, page_size=8)
     for name, fn in (("bert", bench_bert), ("resnet50", bench_resnet50),
                      ("gpt", bench_gpt), ("wmt_beam", bench_wmt_beam),
                      ("lenet_exec", bench_lenet_exec),
-                     ("int8_predictor", bench_int8_predictor)):
+                     ("int8_predictor", bench_int8_predictor),
+                     ("serve", bench_serve)):
         pallas_env0 = os.environ.get("PADDLE_TPU_PALLAS")
         for attempt in (1, 2, 3):
             try:
@@ -712,6 +745,20 @@ def _score(results, headline, extras):
             results["int8_predictor"]["int8_vs_fp32"], 3)
         extras["int8_max_prob_diff"] = round(
             results["int8_predictor"]["max_prob_diff"], 5)
+    if "serve" in results:
+        # serving latency + throughput extras on EVERY round (the
+        # cpu_fallback_smoke rounds included) so the first real-TPU
+        # round lands with comparable p50/p99 fields
+        sv = results["serve"]
+        extras["serve_tokens_per_sec"] = round(
+            sv["tokens_per_sec"] or 0.0, 1)
+        if sv.get("ttft_p99_ms") is not None:
+            extras["serve_ttft_p50_ms"] = round(sv["ttft_p50_ms"], 2)
+            extras["serve_ttft_p99_ms"] = round(sv["ttft_p99_ms"], 2)
+        if sv.get("tpot_p99_ms") is not None:
+            extras["serve_tpot_p50_ms"] = round(sv["tpot_p50_ms"], 2)
+            extras["serve_tpot_p99_ms"] = round(sv["tpot_p99_ms"], 2)
+        extras["serve_preemptions"] = sv["preemptions"]
     return {**headline, **extras}
 
 

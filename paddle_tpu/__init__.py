@@ -92,6 +92,7 @@ from . import resilience
 from . import obs
 from . import runtime
 from . import inference
+from . import serving
 from . import quant
 from . import slim
 from . import hapi

@@ -14,13 +14,49 @@ reference's shape-optimized subgraphs.
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..obs import journal as _journal
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 
 __all__ = ["Config", "Predictor", "create_predictor"]
+
+# process-wide mirrors of the per-instance cache stats, the
+# executor.jit_cache.* pattern — serving runs get the same accounting
+_M_HITS = _metrics.counter("predictor.jit_cache.hits")
+_M_MISSES = _metrics.counter("predictor.jit_cache.misses")
+_M_DISPATCHES = _metrics.counter("predictor.dispatches")
+_M_RUN_MS = _metrics.histogram("predictor.run_ms")
+
+
+class _PredictorEntry:
+    """One compiled shape-signature entry, shaped like the Executor's
+    ``_Compiled`` (``fn`` + 3-part ``arg_structs`` + name/role
+    metadata) so the whole entry toolchain — ``obs.mfu.entry_analysis``,
+    ``obs.spmd.sharding_summary``, ``tools/perf_gate.entry_hlo`` /
+    ``check_entry`` — reads serving entries exactly like training
+    ones. Weights ride the ``frozen`` role: a Predictor never updates
+    (or donates) them, many Predictor calls share one device copy."""
+
+    def __init__(self, fn, feed_structs, weight_structs, feed_names,
+                 weight_names, fetch_names, program):
+        self.fn = fn
+        self.arg_structs = (list(feed_structs), [], list(weight_structs))
+        self.feed_names = tuple(feed_names)
+        self.updated = ()
+        self.frozen = tuple(weight_names)
+        self.fetch_names = tuple(fetch_names)
+        self.program_uid = program._uid
+        self.program_version = program._version
+        self.optimize_level = 0
+        lead = [s.shape[0] for s in feed_structs if len(s.shape) >= 1]
+        self.examples_hint = max(lead) if lead else None
 
 
 class Config:
@@ -70,6 +106,9 @@ class Predictor:
         self._weights = [jnp.asarray(scope.find_var(n))
                          for n in self._weight_names]
         self._compiled = {}
+        self._cache_hits = 0
+        self._cache_misses = 0
+        self._dispatches = 0
 
     # -- introspection (ref: PaddlePredictor::GetInputNames) ----------------
     def get_input_names(self):
@@ -86,7 +125,10 @@ class Predictor:
         weight_names = self._weight_names
         fetch_names = tuple(self._fetch_names)
 
-        def fn(feeds, weights):
+        def fn(feeds, _updated, weights):
+            # (feeds, updated, frozen) — the Executor entry signature,
+            # so entry_analysis/perf_gate lower both the same way;
+            # a predictor has no updated persistables (_updated = [])
             env = dict(consts)
             env.update(zip(feed_names, feeds))
             env.update(zip(weight_names, weights))
@@ -112,11 +154,11 @@ class Predictor:
         """``feed``: dict name->array, or list in get_input_names() order."""
         if not isinstance(feed, dict):
             feed = dict(zip(self._feed_names, feed))
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:  # before indexing, or a bare KeyError beats us to it
+            raise KeyError(f"missing feeds {missing}")
         arrays = [np.asarray(feed[n]._data if isinstance(feed[n], Tensor)
                              else feed[n]) for n in self._feed_names]
-        missing = [n for n in self._feed_names if n not in feed]
-        if missing:
-            raise KeyError(f"missing feeds {missing}")
 
         B = arrays[0].shape[0] if arrays and arrays[0].ndim else None
         pad_to = None
@@ -130,10 +172,55 @@ class Predictor:
                     for a in arrays]
 
         sig = tuple((a.shape, str(a.dtype)) for a in arrays)
-        if sig not in self._compiled:
-            self._compiled[sig] = jax.jit(self._replay())
-        outs = self._compiled[sig]([jnp.asarray(a) for a in arrays],
-                                   self._weights)
+        entry = self._compiled.get(sig)
+        if entry is None:
+            self._cache_misses += 1
+            _M_MISSES.inc()
+            t0 = time.perf_counter()
+            with _trace.span("predictor.compile", uid=self._program._uid,
+                             signature=len(self._compiled)):
+                entry = _PredictorEntry(
+                    jax.jit(self._replay()),
+                    [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                     for a in arrays],
+                    [jax.ShapeDtypeStruct(w.shape, w.dtype)
+                     for w in self._weights],
+                    self._feed_names, self._weight_names,
+                    self._fetch_names, self._program)
+            # NOTE: jax.jit is lazy — like the Executor's compile
+            # event, ms times entry construction; XLA's own compile
+            # lands in this signature's first predictor.run_ms sample
+            compile_ms = (time.perf_counter() - t0) * 1e3
+            if _journal.ACTIVE is not None:
+                # the Executor's per-compile events, serving flavor —
+                # run_report/shard_report see predictor entries too
+                _journal.ACTIVE.event(
+                    "compile", source="predictor",
+                    uid=self._program._uid,
+                    version=self._program._version, ms=compile_ms)
+                from ..obs import spmd as _spmd
+
+                _journal.ACTIVE.event("sharding",
+                                      **_spmd.sharding_summary(entry))
+            self._compiled[sig] = entry
+        else:
+            self._cache_hits += 1
+            _M_HITS.inc()
+        t0 = time.perf_counter()
+        with _trace.span("predictor.run", uid=self._program._uid):
+            outs = entry.fn([jnp.asarray(a) for a in arrays], [],
+                            self._weights)
+        self._dispatches += 1
+        _M_DISPATCHES.inc()
+        run_ms = (time.perf_counter() - t0) * 1e3
+        _M_RUN_MS.observe(run_ms)
+        if _journal.ACTIVE is not None:
+            _journal.ACTIVE.record_executor_run(
+                entry, outs, run_ms, synced=return_numpy,
+                source="predictor",
+                # B is the caller's batch BEFORE bucket padding — the
+                # entry's struct-derived hint would overcount padding
+                examples=B)
         if pad_to is not None:
             # slice padding back off any fetch that kept the batch dim
             outs = [o[:B] if hasattr(o, "ndim") and o.ndim
@@ -143,6 +230,46 @@ class Predictor:
         return [Tensor(o, _internal=True) for o in outs]
 
     __call__ = run
+
+    @property
+    def dispatches(self):
+        """Compiled-fn invocations across ``run`` calls (the Executor's
+        ``dispatches`` contract — perf_gate call-count gates read it)."""
+        return self._dispatches
+
+    def cache_stats(self, per_entry=False):
+        """Hit/miss/size of this predictor's shape-signature cache —
+        the same dict shape ``Executor.cache_stats`` pins, so
+        ``run_report``/``shard_report`` tooling reads serving runs with
+        no special casing. ``per_entry=True`` adds ``dispatches`` and
+        an ``entries`` list with the Executor fields (bytes / FLOPs /
+        collectives via the same lazy ``obs.mfu.entry_analysis``)."""
+        out = {"hits": self._cache_hits, "misses": self._cache_misses,
+               "size": len(self._compiled)}
+        if per_entry:
+            from ..obs.mfu import entry_analysis
+
+            out["dispatches"] = self._dispatches
+            entries = []
+            for entry in self._compiled.values():
+                a = entry_analysis(entry)
+                mem = a["memory"]
+                entries.append({
+                    "program_uid": entry.program_uid,
+                    "program_version": entry.program_version,
+                    "optimize_level": entry.optimize_level,
+                    "feed_names": list(entry.feed_names),
+                    "memory_bytes": (sum(v for k, v in mem.items()
+                                         if k != "generated_code_size")
+                                     if mem else None),
+                    "memory": mem,
+                    "flops": (a["cost"] or {}).get("flops"),
+                    "collectives": a.get("collectives"),
+                    "mesh": None,
+                    "steps_fused": None,
+                })
+            out["entries"] = entries
+        return out
 
 
 def create_predictor(config):

@@ -415,6 +415,47 @@ class RunJournal:
                             _locked=True)
         return rec
 
+    def record_request(self, rid, state=None, arrival_t=None,
+                       admit_t=None, first_token_t=None, finish_t=None,
+                       prompt_tokens=None, output_tokens=None,
+                       pages_peak=None, preemptions=0, **extra):
+        """Append one per-request serving record (the decode analog of
+        a training step record): the request's lifecycle timestamps in
+        the SERVING clock (the engine's injectable clock, so tests are
+        exact), derived TTFT/TPOT/e2e latencies in ms, and the KV-page
+        + preemption footprint. ``tools/run_report.py`` summarizes
+        these into p50/p99 columns."""
+        rec = {"t": "request", "rid": rid, "ts": time.time()}
+        if state is not None:
+            rec["state"] = state
+        for k, v in (("arrival_t", arrival_t), ("admit_t", admit_t),
+                     ("first_token_t", first_token_t),
+                     ("finish_t", finish_t)):
+            if v is not None:
+                rec[k] = float(v)
+        if prompt_tokens is not None:
+            rec["prompt_tokens"] = int(prompt_tokens)
+        if output_tokens is not None:
+            rec["output_tokens"] = int(output_tokens)
+        if pages_peak is not None:
+            rec["pages_peak"] = int(pages_peak)
+        if preemptions:
+            rec["preemptions"] = int(preemptions)
+        if arrival_t is not None and first_token_t is not None:
+            rec["ttft_ms"] = (first_token_t - arrival_t) * 1e3
+        if arrival_t is not None and finish_t is not None:
+            rec["e2e_ms"] = (finish_t - arrival_t) * 1e3
+        if first_token_t is not None and finish_t is not None and \
+                output_tokens and output_tokens > 1:
+            rec["tpot_ms"] = (finish_t - first_token_t) * 1e3 / \
+                (output_tokens - 1)
+        rec.update(extra)
+        with self._lock:
+            if self._closed:
+                return None
+            self._write(rec, _locked=True)
+        return rec
+
     def event(self, kind, **fields):
         """Append one discrete event record (compile, checkpoint,
         resilience recovery, chaos activation, ...)."""
@@ -484,7 +525,8 @@ class RunJournal:
     # thread pays the entry's analysis compile; early steps carry
     # flops=None and no comm attribution). ``synced=False`` (lazy /
     # async fetches) keeps even the size-1 loss summary off the device.
-    def record_executor_run(self, compiled, fetches, run_ms, synced=True):
+    def record_executor_run(self, compiled, fetches, run_ms, synced=True,
+                            source="executor", examples=None):
         flops, comm = self._entry_flops_comm(compiled)
         # summarize ONCE and reuse: with lazy fetches
         # (return_numpy=False) each size-1 summary is a scalar device
@@ -493,10 +535,14 @@ class RunJournal:
                    for v in fetches[:4]] if fetches else None
         loss = summary[0] if summary and isinstance(summary[0], float) \
             else None
+        if examples is None:
+            # entry-shape fallback; a batch-bucketed caller (the
+            # Predictor pads to its bucket) passes the TRUE count so
+            # examples/s never counts padding
+            examples = getattr(compiled, "examples_hint", None)
         return self.record_step(
-            loss=loss, step_ms=run_ms,
-            examples=getattr(compiled, "examples_hint", None),
-            flops=flops, comm=comm, source="executor",
+            loss=loss, step_ms=run_ms, examples=examples,
+            flops=flops, comm=comm, source=source,
             _fetch_summary=summary)
 
     def record_fused_run(self, compiled, fetches, run_ms, steps,

@@ -29,8 +29,21 @@ import threading
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "counter", "gauge", "histogram", "snapshot", "reset",
-    "DEFAULT_MS_BUCKETS",
+    "exact_percentile", "DEFAULT_MS_BUCKETS",
 ]
+
+
+def exact_percentile(xs, q):
+    """Exact q-th percentile by nearest rank over raw samples (the
+    complement of Histogram's bounded-bucket interpolation, for readers
+    that kept every sample — per-request journal records, bench traces).
+    One definition shared by tools/run_report.py and
+    tools/serve_bench.py so their p50/p99 columns stay comparable."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    i = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[i]
 
 # upper bounds (ms) covering µs-scale op dispatch through multi-second
 # XLA compiles; +inf is implicit as the overflow bucket

@@ -7,6 +7,9 @@ pallas kernels cover the rest — the memory-bound fusions XLA can't do:
 - flash_attention: O(L)-memory blocked attention (fwd + custom_vjp bwd)
 - fused_layer_norm: one-pass moments+normalize (+ fused bwd)
 - softmax_cross_entropy: LM-head CE without materializing softmax
+- paged_decode_attention: ragged paged decode attention for the
+  serving path (K/V gathered through per-sequence page tables via
+  scalar prefetch — see paddle_tpu.serving)
 
 ``enabled()`` gates use: on by default on TPU backends, off elsewhere
 (the dense jnp paths remain the reference implementations and the CPU
@@ -22,8 +25,10 @@ import jax
 from .flash_attention import flash_attention
 from .layernorm import fused_layer_norm
 from .softmax_ce import softmax_cross_entropy
+from .paged_attention import dense_decode_reference, paged_decode_attention
 
 __all__ = ["flash_attention", "fused_layer_norm", "softmax_cross_entropy",
+           "paged_decode_attention", "dense_decode_reference",
            "enabled", "set_enabled"]
 
 _FORCED = None  # None: auto (TPU only); True/False: explicit override

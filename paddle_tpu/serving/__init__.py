@@ -1,0 +1,38 @@
+"""paddle_tpu.serving: production serving — paged KV cache, continuous
+batching, per-request observability.
+
+The TPU-native serving layer the reference covers with
+``paddle/fluid/inference`` + the decode operators: where
+``inference.Predictor`` replays one saved program per call, this
+subsystem serves *many concurrent generation requests* through shared
+compiled steps —
+
+- ``kv_cache.PagedKVCache``: fixed-size pages from one preallocated,
+  donation-recycled device pool; host-side free list; per-sequence
+  page tables; strict alloc==free accounting.
+- ``ops/pallas/paged_attention.paged_decode_attention``: the ragged
+  paged decode kernel (one kernel for the whole mixed batch, K/V
+  gathered through page tables via scalar prefetch).
+- ``scheduler.Scheduler``: continuous batching — token-budget
+  admission, prefill/decode interleaving, preemption by page pressure
+  with arrival-order requeue, deterministic under an injectable clock.
+- ``engine.ServeEngine``: the serve loop tying them together, with
+  ``serving.*`` metrics (queue depth, TTFT/TPOT/e2e histograms),
+  lifecycle trace spans, and journal ``request`` records.
+
+``tools/serve_bench.py`` drives a synthetic Poisson trace through the
+engine and reports p50/p99 TTFT/TPOT and tokens/s.
+"""
+from .kv_cache import (CachePressureError, PageAllocationError,
+                       PagedKVCache, write_tokens)
+from .scheduler import (Batch, ManualClock, Request, Scheduler,
+                        QUEUED, RUNNING, PREEMPTED, FINISHED, CANCELLED)
+from .engine import ServeEngine, TinyLM
+
+__all__ = [
+    "PagedKVCache", "PageAllocationError", "CachePressureError",
+    "write_tokens",
+    "Scheduler", "Request", "Batch", "ManualClock",
+    "QUEUED", "RUNNING", "PREEMPTED", "FINISHED", "CANCELLED",
+    "ServeEngine", "TinyLM",
+]

@@ -1,0 +1,303 @@
+"""Paged KV-cache: fixed-size pages from one preallocated device pool.
+
+The serving memory model (vLLM's PagedAttention, arXiv 2604.15464's
+TPU shape): instead of one contiguous ``(B, max_len, H, D)`` cache —
+which reserves worst-case length for every request and fragments the
+batch — the pool is ``num_pages`` fixed-size pages, and each sequence
+holds an ordered *page table* of the pages its tokens live in. Free
+pages are a **host-side free list**: allocation and release are pure
+Python bookkeeping (no device traffic), and the device pools change
+only through the compiled decode/prefill steps, which **donate** the
+pool buffers — the executable updates pages in place in HBM, one
+resident copy across the run (``tools/perf_gate.py`` asserts the
+``input_output_alias`` on the compiled HLO).
+
+Page 0 is the **null page**: never allocated, it absorbs the K/V
+writes of padded batch lanes (a bucketed decode step always writes B
+lanes; parking dead lanes on a real page would corrupt a live
+sequence) and backs the clamped tail entries of padded page tables.
+
+Accounting is strict: every page is either in the free list or in
+exactly one sequence's table (``verify()``), so ``alloc == free``
+balance after any request teardown — including a chaos-killed one —
+is a testable invariant, not a hope.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+from ..resilience.policy import TransientError
+
+__all__ = ["PagedKVCache", "PageAllocationError", "CachePressureError",
+           "write_tokens"]
+
+_M_USED = _metrics.gauge("serving.kv.used_pages")
+_M_FREE = _metrics.gauge("serving.kv.free_pages")
+_M_ALLOCS = _metrics.counter("serving.kv.page_allocs")
+_M_FREES = _metrics.counter("serving.kv.page_frees")
+
+
+class PageAllocationError(RuntimeError):
+    """The pool cannot satisfy an allocation (free list exhausted)."""
+
+
+class CachePressureError(TransientError):
+    """Page pressure the scheduler may relieve by preempting a victim —
+    a ``TransientError`` so the engine's ``RecoveryPolicy`` retry path
+    (``resilience.policy.retry_call``) drives relief with the same
+    bounded-retry machinery every other recoverable fault uses."""
+
+
+class PagedKVCache:
+    """Host-side allocator + device-side pools for paged K/V.
+
+    >>> cache = PagedKVCache(num_pages=64, page_size=16, num_heads=4,
+    ...                      head_dim=32)
+    >>> cache.alloc("req1", 40)        # 3 pages for a 40-token prompt
+    >>> cache.extend("req1")           # decode: page 3 only at 49->...
+    >>> cache.free("req1")
+
+    Device pools ``k_pages``/``v_pages`` are ``(num_layers, num_pages,
+    page_size, num_heads, head_dim)`` jax arrays, created lazily on
+    first touch so constructing an allocator never forces backend init.
+    The pools are *replaced* (not mutated) by the engine after each
+    donated step — the allocator only hands out page ids.
+    """
+
+    NULL_PAGE = 0
+
+    def __init__(self, num_pages, page_size, num_heads, head_dim,
+                 num_layers=1, dtype="float32", max_seq_len=None):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is the null page)")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.num_layers = int(num_layers)
+        self.dtype = dtype
+        capacity = (self.num_pages - 1) * self.page_size
+        self.max_seq_len = int(max_seq_len) if max_seq_len else capacity
+        if self.max_seq_len > capacity:
+            # advertising more than the pool holds would defeat the
+            # engine's at-the-door oversize rejection: an accepted
+            # request could still never be admitted (permanent FIFO-
+            # head stall for everything queued behind it)
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} exceeds pool capacity "
+                f"{capacity} tokens ({self.num_pages - 1} usable pages "
+                f"x {self.page_size})")
+        # lowest-id-first allocation keeps traces deterministic
+        self._free = sorted(range(1, self.num_pages))
+        self._tables = {}    # seq_id -> [page ids, in order]
+        self._lengths = {}   # seq_id -> tokens stored
+        self._lock = threading.Lock()
+        self._k = None
+        self._v = None
+        self._update_gauges()
+
+    # -- device pools --------------------------------------------------------
+    @property
+    def k_pages(self):
+        self._ensure_pools()
+        return self._k
+
+    @property
+    def v_pages(self):
+        self._ensure_pools()
+        return self._v
+
+    def _ensure_pools(self):
+        if self._k is None:
+            import jax.numpy as jnp
+
+            shape = (self.num_layers, self.num_pages, self.page_size,
+                     self.num_heads, self.head_dim)
+            self._k = jnp.zeros(shape, dtype=self.dtype)
+            self._v = jnp.zeros(shape, dtype=self.dtype)
+
+    def set_pools(self, k_pages, v_pages):
+        """Install the pools a donated step returned. The old buffers
+        were consumed by donation — holding them would be a
+        use-after-free; this is the only sanctioned replacement path."""
+        self._k, self._v = k_pages, v_pages
+
+    # -- allocation ----------------------------------------------------------
+    def pages_needed(self, n_tokens):
+        return -(-int(n_tokens) // self.page_size)
+
+    def can_alloc(self, n_tokens):
+        with self._lock:
+            return self.pages_needed(n_tokens) <= len(self._free)
+
+    def alloc(self, seq_id, n_tokens):
+        """Allocate pages for ``n_tokens`` (a prompt). All-or-nothing:
+        on pressure nothing is held. Returns the page ids granted."""
+        n_tokens = int(n_tokens)
+        if n_tokens > self.max_seq_len:
+            raise ValueError(
+                f"sequence of {n_tokens} tokens exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        need = self.pages_needed(n_tokens)
+        with self._lock:
+            if seq_id in self._tables:
+                raise KeyError(f"sequence {seq_id!r} already allocated")
+            if need > len(self._free):
+                raise PageAllocationError(
+                    f"need {need} pages for {n_tokens} tokens, "
+                    f"{len(self._free)} free")
+            pages = [self._free.pop(0) for _ in range(need)]
+            self._tables[seq_id] = pages
+            self._lengths[seq_id] = n_tokens
+            _M_ALLOCS.inc(need)
+            self._update_gauges_locked()
+            return list(pages)
+
+    def extend(self, seq_id, n_tokens=1):
+        """Grow a sequence by ``n_tokens`` (decode appends). Allocates
+        a new page only when the last page fills; all-or-nothing under
+        pressure (the sequence keeps its old length). Returns the list
+        of newly granted pages (usually empty)."""
+        n_tokens = int(n_tokens)
+        with self._lock:
+            if seq_id not in self._tables:
+                raise KeyError(f"unknown sequence {seq_id!r}")
+            cur = self._lengths[seq_id]
+            if cur + n_tokens > self.max_seq_len:
+                raise ValueError(
+                    f"sequence {seq_id!r} would exceed max_seq_len "
+                    f"{self.max_seq_len}")
+            need = self.pages_needed(cur + n_tokens) - \
+                self.pages_needed(cur)
+            if need > len(self._free):
+                raise PageAllocationError(
+                    f"extend({seq_id!r}) needs {need} page(s), "
+                    f"{len(self._free)} free")
+            new = [self._free.pop(0) for _ in range(need)]
+            self._tables[seq_id].extend(new)
+            self._lengths[seq_id] = cur + n_tokens
+            if new:
+                _M_ALLOCS.inc(len(new))
+            self._update_gauges_locked()
+            return new
+
+    def free(self, seq_id):
+        """Release every page a sequence holds (finish, preemption, or
+        a chaos-killed request — the teardown path is the same).
+        Returns the number of pages released; unknown ids are a no-op
+        (teardown must be idempotent under crash-retry)."""
+        with self._lock:
+            pages = self._tables.pop(seq_id, None)
+            self._lengths.pop(seq_id, None)
+            if not pages:
+                return 0
+            self._free.extend(pages)
+            self._free.sort()
+            _M_FREES.inc(len(pages))
+            self._update_gauges_locked()
+            return len(pages)
+
+    # -- introspection (locked like the mutators: the engine loop is
+    # single-threaded, but submit/cancel may come from other threads
+    # and a torn read here would KeyError the whole serve step) -------------
+    def page_table(self, seq_id):
+        with self._lock:
+            return list(self._tables[seq_id])
+
+    def length(self, seq_id):
+        with self._lock:
+            return self._lengths[seq_id]
+
+    def sequences(self):
+        with self._lock:
+            return sorted(self._tables)
+
+    def padded_page_tables(self, seq_ids, width=None):
+        """``(len(seq_ids), width)`` int32 table for the kernel, tail
+        entries parked on the null page. ``width`` defaults to the
+        pool-wide maximum (``max_seq_len`` pages); callers batching
+        short contexts pass the batch's own bucket so the kernel grid
+        stays O(context)."""
+        width = width or self.table_width
+        out = np.full((len(seq_ids), width), self.NULL_PAGE, np.int32)
+        with self._lock:
+            for i, sid in enumerate(seq_ids):
+                pages = self._tables[sid]
+                if len(pages) > width:
+                    raise ValueError(
+                        f"sequence {sid!r} holds {len(pages)} pages > "
+                        f"table width {width}")
+                out[i, :len(pages)] = pages
+        return out
+
+    @property
+    def table_width(self):
+        return self.pages_needed(self.max_seq_len)
+
+    def write_slots(self, seq_ids):
+        """``(page_id, offset)`` arrays addressing each sequence's NEXT
+        token slot (position == current length). The caller must have
+        ``extend``-ed first so the page exists."""
+        pages = np.empty(len(seq_ids), np.int32)
+        offs = np.empty(len(seq_ids), np.int32)
+        with self._lock:
+            for i, sid in enumerate(seq_ids):
+                pos = self._lengths[sid] - 1
+                pages[i] = self._tables[sid][pos // self.page_size]
+                offs[i] = pos % self.page_size
+        return pages, offs
+
+    def stats(self):
+        """Pool occupancy + fragmentation: ``utilization`` is live
+        tokens over the capacity of the pages holding them (1.0 = no
+        internal fragmentation); ``fragmentation`` its complement."""
+        with self._lock:
+            used = self.num_pages - 1 - len(self._free)
+            tokens = sum(self._lengths.values())
+            cap = used * self.page_size
+            return {
+                "num_pages": self.num_pages,
+                "page_size": self.page_size,
+                "used_pages": used,
+                "free_pages": len(self._free),
+                "sequences": len(self._tables),
+                "tokens": tokens,
+                "utilization": (tokens / cap) if cap else 1.0,
+                "fragmentation": (1.0 - tokens / cap) if cap else 0.0,
+            }
+
+    def verify(self):
+        """Every page (except null) is free or owned exactly once."""
+        with self._lock:
+            owned = [p for t in self._tables.values() for p in t]
+            seen = set(owned)
+            assert len(owned) == len(seen), "page owned twice"
+            assert not (seen & set(self._free)), "page both free+owned"
+            assert self.NULL_PAGE not in seen, "null page allocated"
+            total = 1 + len(self._free) + len(owned)
+            assert total == self.num_pages, \
+                f"page leak: {self.num_pages - total} unaccounted"
+        return True
+
+    def _update_gauges_locked(self):
+        _M_USED.set(self.num_pages - 1 - len(self._free))
+        _M_FREE.set(len(self._free))
+
+    def _update_gauges(self):
+        with self._lock:
+            self._update_gauges_locked()
+
+
+def write_tokens(k_pages, v_pages, k_new, v_new, page_ids, offsets,
+                 layer=0):
+    """Functional scatter of one new token per lane into the pools:
+    ``k_new``/``v_new`` are ``(B, H, D)``, ``page_ids``/``offsets``
+    ``(B,)``. Pure (jit-able); the engine's compiled decode step calls
+    this with the pools donated, so XLA aliases the update in place —
+    padded lanes target the null page by construction."""
+    k_pages = k_pages.at[layer, page_ids, offsets].set(k_new)
+    v_pages = v_pages.at[layer, page_ids, offsets].set(v_new)
+    return k_pages, v_pages

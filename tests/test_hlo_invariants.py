@@ -208,9 +208,10 @@ class TestInt8PredictorHLO:
         pred = Predictor(prefix + "_int8")
         xs = np.zeros((8, 1, 28, 28), np.float32)
         pred.run({"x": xs})  # compile
-        (fn,) = pred._compiled.values()
-        txt = fn.lower([jnp.asarray(xs)], pred._weights) \
-                .compile().as_text()
+        # entries are _PredictorEntry since PR 7 (fn + captured
+        # arg_structs, the perf-gate/mfu contract) — lower from those
+        (entry,) = pred._compiled.values()
+        txt = entry.fn.lower(*entry.arg_structs).compile().as_text()
         assert re.search(r"s8\[\d", txt), "no int8 parameter in HLO"
         assert "convert" in txt, "dequant not inside the executable"
 

@@ -274,8 +274,9 @@ class TestInactiveHooksDoNothing:
         def boom(*a, **k):
             raise AssertionError("journal work performed while inactive")
 
-        for name in ("record_step", "record_executor_run", "event",
-                     "note_step_ms", "postmortem"):
+        for name in ("record_step", "record_executor_run",
+                     "record_request", "event", "note_step_ms",
+                     "postmortem"):
             monkeypatch.setattr(journal.RunJournal, name, boom)
         # the per-compile sharding event and device telemetry must also
         # stay behind the ACTIVE/tracing gates
@@ -307,6 +308,39 @@ class TestInactiveHooksDoNothing:
         m = nn.Linear(4, 2)
         save_checkpoint(d, 1, model=m)
         assert load_checkpoint(d, model=nn.Linear(4, 2)) == 1
+
+        # serving hooks (PR 7): a full engine lifecycle — compile,
+        # prefill, decode, preemption-free finish — and a Predictor
+        # run must also perform zero journal work when inactive
+        from paddle_tpu.serving import PagedKVCache, ServeEngine, TinyLM
+
+        eng = ServeEngine(TinyLM(num_heads=2, head_dim=8),
+                          PagedKVCache(16, 4, 2, 8))
+        req = eng.submit([1, 2, 3], max_new_tokens=2)
+        eng.run(max_steps=20)
+        assert req.state == "FINISHED" and len(req.generated) == 2
+        eng.cancel(eng.submit([1], max_new_tokens=1))
+
+        import tempfile
+
+        from paddle_tpu.framework.io import save_inference_model
+        from paddle_tpu.inference import Predictor
+
+        pt.enable_static()
+        try:
+            prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(prog, startup):
+                xi = fluid.data(name="x", shape=[2, 4])
+                oi = fluid.layers.fc(xi, size=2)
+            exe = fluid.Executor()
+            exe.run(startup)
+            with tempfile.TemporaryDirectory() as td:
+                prefix = os.path.join(td, "m")
+                save_inference_model(prefix, ["x"], [oi], program=prog)
+                Predictor(prefix).run(
+                    {"x": np.zeros((2, 4), np.float32)})
+        finally:
+            pt.disable_static()
 
 
 # -- concurrency + crash safety ----------------------------------------------

@@ -1,0 +1,541 @@
+"""paddle_tpu.serving (PR 7): paged KV cache, ragged paged decode
+attention, continuous-batching scheduler, ServeEngine.
+
+Covers the PR's acceptance contract:
+- paged decode attention matches the dense reference within fp32
+  tolerance on ragged batches (varying lengths, page-boundary
+  crossings), in interpret mode under JAX_PLATFORMS=cpu;
+- scheduler tests are deterministic (injectable clock): admission
+  under a token budget, preemption/requeue under page pressure, and
+  no-starvation are asserted exactly;
+- the KV pool buffer is donated across decode steps and the allocator
+  never leaks pages — alloc==free after a chaos-killed request;
+- serving.* histograms report sane p50/p99;
+- journal request records carry the full lifecycle.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu import obs
+from paddle_tpu.obs import journal, metrics
+from paddle_tpu.ops.pallas.paged_attention import (dense_decode_reference,
+                                                   paged_decode_attention)
+from paddle_tpu.serving import (CANCELLED, FINISHED, ManualClock,
+                                PagedKVCache, PageAllocationError,
+                                Request, Scheduler, ServeEngine, TinyLM)
+from paddle_tpu.serving.kv_cache import CachePressureError
+
+
+@pytest.fixture(autouse=True)
+def _no_global_journal():
+    yield
+    if journal.ACTIVE is not None:
+        journal.ACTIVE.close()
+    journal.ACTIVE = None
+
+
+# -- kv cache ----------------------------------------------------------------
+
+
+class TestPagedKVCache:
+    def test_alloc_extend_free_accounting(self):
+        c = PagedKVCache(9, 4, 2, 8)
+        assert c.alloc("a", 5) == [1, 2]          # lowest-id-first
+        assert c.alloc("b", 4) == [3]
+        assert c.extend("a", 1) == []             # 6th token: page 2
+        assert c.extend("a", 3) == [4]            # 9th token: new page
+        st = c.stats()
+        assert st["used_pages"] == 4 and st["free_pages"] == 4
+        assert st["tokens"] == 13
+        assert c.free("a") == 3 and c.free("b") == 1
+        assert c.free("ghost") == 0               # idempotent teardown
+        st = c.stats()
+        assert st["used_pages"] == 0 and st["free_pages"] == 8
+        assert c.verify()
+
+    def test_fragmentation_stats(self):
+        c = PagedKVCache(9, 8, 1, 1)
+        c.alloc("a", 9)                           # 2 pages, 9/16 used
+        st = c.stats()
+        assert st["utilization"] == pytest.approx(9 / 16)
+        assert st["fragmentation"] == pytest.approx(7 / 16)
+
+    def test_exhaustion_is_all_or_nothing(self):
+        c = PagedKVCache(6, 4, 1, 1)              # 5 usable pages
+        c.alloc("a", 8)                           # 2 pages
+        c.alloc("b", 12)                          # 3 pages -> 0 free
+        with pytest.raises(PageAllocationError):
+            c.alloc("c", 4)
+        # the failed alloc held NOTHING
+        assert c.stats()["free_pages"] == 0 and "c" not in c.sequences()
+        with pytest.raises(PageAllocationError):
+            c.extend("a", 1)                      # page 2 full at 8
+        assert c.length("a") == 8                 # length unchanged
+
+    def test_null_page_reserved_and_tables_padded(self):
+        c = PagedKVCache(4, 4, 1, 1)
+        pages = c.alloc("a", 4)
+        assert c.NULL_PAGE == 0 and 0 not in pages
+        t = c.padded_page_tables(["a"], width=3)
+        assert t.tolist() == [[pages[0], 0, 0]]
+        assert t.dtype == np.int32
+
+    def test_write_slots_address_the_newest_token(self):
+        c = PagedKVCache(8, 4, 1, 1)
+        c.alloc("a", 4)
+        c.extend("a", 1)                          # token 5 -> page[1], off 0
+        pages, offs = c.write_slots(["a"])
+        assert offs[0] == 0 and pages[0] == c.page_table("a")[1]
+
+    def test_max_seq_len_enforced(self):
+        c = PagedKVCache(4, 4, 1, 1, max_seq_len=8)
+        with pytest.raises(ValueError):
+            c.alloc("a", 9)
+        c.alloc("a", 8)
+        with pytest.raises(ValueError):
+            c.extend("a", 1)
+
+    def test_max_seq_len_cannot_exceed_pool_capacity(self):
+        # advertising more than the pool holds would defeat the
+        # engine's submit-time oversize rejection (permanent FIFO stall)
+        with pytest.raises(ValueError):
+            PagedKVCache(4, 4, 1, 1, max_seq_len=64)
+
+    def test_engine_rejects_mismatched_scheduler_cache(self):
+        model = TinyLM(num_heads=2, head_dim=8)
+        a = PagedKVCache(8, 4, 2, 8)
+        b = PagedKVCache(8, 4, 2, 8)
+        with pytest.raises(ValueError):
+            ServeEngine(model, a, scheduler=Scheduler(b))
+
+
+# -- paged decode attention kernel -------------------------------------------
+
+
+class TestPagedDecodeAttention:
+    @pytest.mark.parametrize("lengths", [
+        [1, 7, 8, 23],     # ragged: single token, page-1 edge, crossing
+        [16, 16, 16, 16],  # uniform, exact page multiples
+        [3, 40, 9, 1],     # long vs short mix
+    ])
+    def test_matches_dense_reference_on_ragged_batches(self, lengths):
+        rng = np.random.RandomState(0)
+        B, H, D, page, P = len(lengths), 2, 16, 8, 32
+        maxp = 5
+        lengths = np.asarray(lengths, np.int32)
+        L = maxp * page
+        k_dense = rng.randn(B, L, H, D).astype(np.float32)
+        v_dense = rng.randn(B, L, H, D).astype(np.float32)
+        q = rng.randn(B, H, D).astype(np.float32)
+        k_pages = np.zeros((P, page, H, D), np.float32)
+        v_pages = np.zeros((P, page, H, D), np.float32)
+        table = np.zeros((B, maxp), np.int32)
+        free = list(rng.permutation(np.arange(1, P)))  # shuffled pages
+        for b in range(B):
+            for p in range(-(-int(lengths[b]) // page)):
+                pid = free.pop()
+                table[b, p] = pid
+                lo = p * page
+                hi = min(lo + page, int(lengths[b]))
+                k_pages[pid, :hi - lo] = k_dense[b, lo:hi]
+                v_pages[pid, :hi - lo] = v_dense[b, lo:hi]
+        out = paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(table), jnp.asarray(lengths), interpret=True)
+        ref = dense_decode_reference(
+            jnp.asarray(q), jnp.asarray(k_dense), jnp.asarray(v_dense),
+            jnp.asarray(lengths))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_zero_length_lane_yields_zeros(self):
+        # padded batch lanes (length 0, null-page table) must not NaN
+        q = jnp.ones((1, 2, 8), jnp.float32)
+        kp = jnp.ones((4, 4, 2, 8), jnp.float32)
+        out = paged_decode_attention(
+            q, kp, kp, jnp.zeros((1, 2), jnp.int32),
+            jnp.zeros((1,), jnp.int32), interpret=True)
+        assert np.all(np.asarray(out) == 0.0)
+
+
+# -- scheduler (deterministic under ManualClock) -----------------------------
+
+
+class TestScheduler:
+    def _mk(self, pages=4, page_size=4, budget=8):
+        clock = ManualClock()
+        cache = PagedKVCache(pages, page_size, 1, 1)
+        return clock, cache, Scheduler(cache, token_budget=budget,
+                                       clock=clock)
+
+    def test_admission_under_token_budget_is_exact(self):
+        clock, cache, s = self._mk(pages=16, budget=10)
+        reqs = [s.submit(Request(prompt=[1] * 4, rid=f"r{i}"))
+                for i in range(4)]
+        clock.advance(5.0)
+        b = s.schedule()
+        # 10-token budget: two 4-token prefills fit, the third blocks
+        assert [r.rid for r in b.prefills] == ["r0", "r1"]
+        assert s.queue_depth == 2
+        assert all(r.admit_t == 5.0 for r in b.prefills)
+        assert reqs[2].admit_t is None
+        # next step: 2 decodes (2 tokens) + r2's prefill (4) fit in 10
+        b2 = s.schedule()
+        assert [r.rid for r in b2.decodes] == ["r0", "r1"]
+        assert [r.rid for r in b2.prefills] == ["r2", "r3"]
+
+    def test_fifo_head_never_skipped(self):
+        clock, cache, s = self._mk(pages=16, budget=6)
+        s.submit(Request(prompt=[1] * 8, rid="big"))
+        s.submit(Request(prompt=[1] * 2, rid="small"))
+        b = s.schedule()
+        # strict FIFO: the 8-token head exceeds budget 6, and the
+        # 2-token request must NOT jump the line (starvation guarantee)
+        assert not b.prefills and s.queue_depth == 2
+
+    def test_preemption_requeues_by_arrival_and_balances_pool(self):
+        clock, cache, s = self._mk(pages=4, budget=8)
+        r1 = s.submit(Request(prompt=[1] * 4, rid="r1"))
+        clock.advance(1.0)
+        r2 = s.submit(Request(prompt=[1] * 4, rid="r2"))
+        clock.advance(1.0)
+        r3 = s.submit(Request(prompt=[1] * 4, rid="r3"))
+        s.schedule()                              # admits r1, r2
+        s.extend(r1, 1)                           # takes the last page
+        with pytest.raises(CachePressureError):
+            s.extend(r2, 1)
+        assert s.preempt_for(r2) is None          # r1 (oldest) protected
+        s.preempt(r2)
+        assert r2.state == "PREEMPTED" and r2.preemptions == 1
+        assert [r.rid for r in s._queue] == ["r2", "r3"]
+        s.finish(r1)
+        assert [r.rid for r in s.schedule().prefills] == ["r2", "r3"]
+        s.finish(r2)
+        s.finish(r3)
+        assert cache.stats()["used_pages"] == 0 and cache.verify()
+
+    def test_preempt_for_picks_youngest_not_oldest(self):
+        clock, cache, s = self._mk(pages=16, budget=64)
+        reqs = [s.submit(Request(prompt=[1] * 4, rid=f"r{i}"))
+                for i in range(3)]
+        s.schedule()
+        victim = s.preempt_for(reqs[0])
+        assert victim is reqs[2]                  # youngest admitted
+        assert reqs[2].state == "PREEMPTED"
+        assert s.running == [reqs[0], reqs[1]]
+
+    def test_queue_depth_gauge_tracks(self):
+        clock, cache, s = self._mk(pages=16)
+        g = metrics.gauge("serving.queue_depth")
+        s.submit(Request(prompt=[1, 2]))
+        s.submit(Request(prompt=[1, 2]))
+        assert g.value == 2
+        s.schedule()
+        assert g.value == 0
+
+
+# -- engine ------------------------------------------------------------------
+
+
+def _pressured_engine(seed=0, pages=6, page_size=4, max_seq_len=16,
+                      budget=64):
+    model = TinyLM(vocab_size=32, num_heads=2, head_dim=8, seed=seed)
+    cache = PagedKVCache(pages, page_size, 2, 8, max_seq_len=max_seq_len)
+    clock = ManualClock()
+    eng = ServeEngine(model, cache, scheduler=Scheduler(
+        cache, token_budget=budget, clock=clock))
+    return model, cache, clock, eng
+
+
+class TestServeEngine:
+    def test_matches_dense_oracle_token_for_token(self):
+        model, cache, clock, eng = _pressured_engine(pages=64,
+                                                     max_seq_len=64)
+        rng = np.random.RandomState(1)
+        pairs = []
+        for _ in range(5):
+            prompt = list(rng.randint(0, 32, rng.randint(3, 20)))
+            pairs.append((eng.submit(prompt, max_new_tokens=10), prompt))
+            clock.advance(0.01)
+        eng.run()
+        assert len(eng.finished) == 5
+        for r, prompt in pairs:
+            assert r.generated == model.reference_generate(prompt, 10)
+
+    def test_correct_under_preemption_and_pool_balances(self):
+        model, cache, clock, eng = _pressured_engine()
+        rng = np.random.RandomState(2)
+        pairs = []
+        for _ in range(3):
+            prompt = list(rng.randint(0, 32, 5))
+            pairs.append((eng.submit(prompt, max_new_tokens=8), prompt))
+            clock.advance(0.01)
+        eng.run(max_steps=300)
+        assert eng.scheduler.preemptions >= 1
+        for r, prompt in pairs:
+            assert r.generated == model.reference_generate(prompt, 8)
+        # FIFO no-starvation: completion follows arrival
+        assert [r.rid for r in eng.finished] == [r.rid for r, _ in pairs]
+        assert cache.stats()["used_pages"] == 0 and cache.verify()
+
+    def test_chaos_killed_request_leaks_nothing(self):
+        model, cache, clock, eng = _pressured_engine(pages=16)
+        victim = eng.submit([1, 2, 3, 4, 5], max_new_tokens=8)
+        other = eng.submit([6, 7, 8], max_new_tokens=4)
+        eng.step()                                # both prefilled
+        assert cache.stats()["used_pages"] > 0
+        eng.cancel(victim)                        # killed mid-flight
+        assert victim.state == CANCELLED
+        eng.run(max_steps=50)
+        assert other.state == FINISHED
+        st = cache.stats()
+        assert st["used_pages"] == 0 and st["sequences"] == 0
+        assert cache.verify()
+
+    def test_eos_stops_decode(self):
+        model, cache, clock, eng = _pressured_engine(pages=64,
+                                                     max_seq_len=64)
+        ref = model.reference_generate([3, 1, 4], 10)
+        eos = ref[3]                              # force an early stop
+        stop = ref.index(eos)                     # first occurrence wins
+        r = eng.submit([3, 1, 4], max_new_tokens=10, eos_id=eos)
+        eng.run()
+        assert r.generated == ref[:stop + 1]
+        assert r.generated[-1] == eos and len(r.generated) < 10
+
+    def test_latency_histograms_sane_p50_p99(self):
+        metrics.reset()
+        model, cache, clock, eng = _pressured_engine(pages=64,
+                                                     max_seq_len=64)
+        for i in range(4):
+            eng.submit([1 + i, 2, 3], max_new_tokens=6)
+            clock.advance(0.05)
+        while not eng.scheduler.idle:
+            eng.step()
+            clock.advance(0.01)                   # 10ms per step
+        snap = metrics.snapshot()
+        for name in ("serving.ttft_ms", "serving.tpot_ms",
+                     "serving.e2e_ms"):
+            h = snap[name]
+            assert h["count"] > 0, name
+            assert 0 <= h["p50"] <= h["p99"] <= h["max"], (name, h)
+        # every decode step advanced the clock 10ms: TPOT p50 == 10ms
+        assert snap["serving.tpot_ms"]["p50"] == pytest.approx(10.0,
+                                                               rel=0.01)
+        assert snap["serving.ttft_ms"]["count"] == 4
+        assert snap["serving.e2e_ms"]["count"] == 4
+
+    def test_oversize_request_rejected_at_submit(self):
+        # prompt + max_new - 1 > max_seq_len can NEVER fit: refuse at
+        # the door instead of ValueError-ing mid-decode (which would
+        # kill the loop for every other in-flight request)
+        _, _, _, eng = _pressured_engine(pages=16, max_seq_len=8)
+        with pytest.raises(ValueError):
+            eng.submit([1, 2, 3, 4, 5], max_new_tokens=8)
+
+    def test_scheduler_direct_oversize_truncates_not_crashes(self):
+        # submitted straight to the scheduler (bypassing engine
+        # validation): the decode loop finishes it truncated and the
+        # pool balances — no mid-loop ValueError, no page leak
+        model, cache, clock, eng = _pressured_engine(pages=16,
+                                                     max_seq_len=8)
+        req = eng.scheduler.submit(Request(prompt=[1, 2, 3, 4, 5],
+                                           max_new_tokens=8))
+        eng.run(max_steps=50)
+        assert req.state == FINISHED and 0 < len(req.generated) < 8
+        assert cache.stats()["used_pages"] == 0 and cache.verify()
+
+    def test_cancel_clears_last_emit_bookkeeping(self):
+        _, _, clock, eng = _pressured_engine(pages=16)
+        req = eng.submit([1, 2, 3], max_new_tokens=8)
+        eng.step()           # prefill emits a token -> _last_emit entry
+        eng.step()
+        assert req.rid in eng._last_emit
+        eng.cancel(req)
+        assert req.rid not in eng._last_emit
+
+    def test_budget_unschedulable_request_rejected_at_submit(self):
+        # a context the token budget can never admit would block the
+        # FIFO head forever (silent starvation of everything behind it)
+        model = TinyLM(vocab_size=32, num_heads=2, head_dim=8)
+        cache = PagedKVCache(64, 4, 2, 8)
+        eng = ServeEngine(model, cache, scheduler=Scheduler(
+            cache, token_budget=16, clock=ManualClock()))
+        with pytest.raises(ValueError):
+            eng.submit([1] * 12, max_new_tokens=8)    # worst 19 > 16
+        eng.submit([1] * 12, max_new_tokens=5)        # worst 16 fits
+        eng.run()
+        assert len(eng.finished) == 1
+
+    def test_capacity_boundary_request_readmits_after_preemption(self):
+        # a preemption-resumed context already at its deepest
+        # (prompt + max_new - 1 == max_seq_len) needs NO +1 headroom:
+        # demanding it would refuse re-admission forever
+        from paddle_tpu.serving import PREEMPTED
+
+        clock = ManualClock()
+        cache = PagedKVCache(4, 4, 1, 1)              # 3 usable pages
+        s = Scheduler(cache, token_budget=16, clock=clock)
+        r = s.submit(Request(prompt=[1] * 9, max_new_tokens=4))
+        s.schedule()
+        r.generated = [1, 1, 1]                       # context now 12
+        s.preempt(r)
+        assert r.state == PREEMPTED
+        b = s.schedule()
+        # cost 12 == worst 12 == max_seq_len: 3 pages, admissible
+        assert b.prefills == [r]
+        s.finish(r)
+        assert cache.stats()["used_pages"] == 0
+
+    def test_scheduler_direct_unservable_prompt_rejected_in_schedule(
+            self):
+        # a prompt longer than max_seq_len submitted scheduler-direct
+        # must be rejected terminally by schedule(), not ValueError out
+        # of the serve loop (stranding the popped request stateless)
+        model, cache, clock, eng = _pressured_engine(pages=16,
+                                                     max_seq_len=16)
+        healthy = eng.submit([1, 2, 3], max_new_tokens=4)
+        doomed = eng.scheduler.submit(Request(prompt=[1] * 17,
+                                              max_new_tokens=2))
+        eng.run(max_steps=50)
+        assert healthy.state == FINISHED
+        assert doomed.state == CANCELLED
+        assert doomed.finish_t is not None
+        assert cache.stats()["used_pages"] == 0 and cache.verify()
+
+    def test_prefill_length_buckets_are_geometric(self):
+        from paddle_tpu.serving.engine import _len_bucket
+
+        assert _len_bucket(3, 8) == 8        # floor = page_size
+        assert _len_bucket(129, 8) == 256
+        assert _len_bucket(256, 8) == 256
+        # lengths 129..256 share ONE compiled prefill, not 128 of them
+        assert len({_len_bucket(n, 8) for n in range(129, 257)}) == 1
+
+    def test_cancel_after_finish_is_a_noop(self):
+        _, cache, clock, eng = _pressured_engine(pages=16)
+        req = eng.submit([1, 2, 3], max_new_tokens=3)
+        eng.run()
+        assert req.state == FINISHED
+        finish_t = req.finish_t
+        n_finished = len(eng.finished)
+        eng.cancel(req)                               # the async race
+        assert req.state == FINISHED                  # not rewritten
+        assert req.finish_t == finish_t
+        assert len(eng.finished) == n_finished
+
+    def test_geometry_mismatch_rejected(self):
+        with pytest.raises(ValueError):
+            ServeEngine(TinyLM(num_heads=2, head_dim=8),
+                        PagedKVCache(8, 4, 4, 8))
+        # the engine drives layer 0 only: a multi-layer pool would
+        # silently waste HBM — reject it
+        with pytest.raises(ValueError):
+            ServeEngine(TinyLM(num_heads=2, head_dim=8),
+                        PagedKVCache(8, 4, 2, 8, num_layers=2))
+
+    def test_zero_max_new_tokens_rejected(self):
+        with pytest.raises(ValueError):
+            Request(prompt=[1, 2], max_new_tokens=0)
+        _, _, _, eng = _pressured_engine(pages=16)
+        with pytest.raises(ValueError):
+            eng.submit([1, 2, 3], max_new_tokens=0)
+
+    def test_decode_table_width_tracks_context_not_pool(self):
+        # a big pool must NOT widen every decode step's page table:
+        # the kernel grid is (B, width), so width rides the batch's
+        # actual max context pages (bucketed), keeping per-token K/V
+        # traffic O(context)
+        model = TinyLM(vocab_size=32, num_heads=2, head_dim=8)
+        cache = PagedKVCache(256, 4, 2, 8)        # table_width 255
+        eng = ServeEngine(model, cache, scheduler=Scheduler(
+            cache, token_budget=64, clock=ManualClock()))
+        eng.submit([1, 2, 3], max_new_tokens=4)
+        eng.run()
+        widths = {e.table_width for e in eng._decode_fns.values()}
+        assert widths and max(widths) <= 4, widths
+
+    def test_decode_entry_exposes_perf_gate_shape(self):
+        _, _, _, eng = _pressured_engine(pages=8)
+        entry = eng.decode_entry(2)
+        assert callable(entry.fn) and len(entry.arg_structs) == 7
+        assert entry.arg_structs[0].shape[0] == eng.cache.num_layers
+
+
+# -- journal request records -------------------------------------------------
+
+
+class TestServingJournal:
+    def test_request_records_carry_full_lifecycle(self, tmp_path):
+        run_dir = str(tmp_path / "run")
+        obs.start_run(run_dir, flush_every=1)
+        model, cache, clock, eng = _pressured_engine()
+        rng = np.random.RandomState(3)
+        for _ in range(3):
+            eng.submit(list(rng.randint(0, 32, 5)), max_new_tokens=8)
+            clock.advance(0.5)
+        killed = eng.submit([1, 2], max_new_tokens=4)
+        eng.step()
+        eng.cancel(killed)
+        while not eng.scheduler.idle:
+            eng.step()
+            clock.advance(0.001)
+        obs.end_run()
+        recs = [json.loads(l) for l in
+                open(os.path.join(run_dir, "journal.jsonl"))
+                if l.strip()]
+        reqs = [r for r in recs if r["t"] == "request"]
+        assert len(reqs) == 4
+        by_state = {}
+        for r in reqs:
+            by_state.setdefault(r["state"], []).append(r)
+        assert len(by_state["FINISHED"]) == 3
+        assert len(by_state["CANCELLED"]) == 1
+        for r in by_state["FINISHED"]:
+            assert r["arrival_t"] <= r["admit_t"] <= r["first_token_t"] \
+                <= r["finish_t"]
+            assert r["output_tokens"] == 8 and r["pages_peak"] >= 1
+            assert r["ttft_ms"] >= 0 and r["e2e_ms"] >= r["ttft_ms"]
+            assert "tpot_ms" in r
+        total_preempt = sum(r.get("preemptions", 0) for r in reqs)
+        assert total_preempt == eng.scheduler.preemptions >= 1
+        # serving compile events rode along
+        compiles = [r for r in recs if r["t"] == "event"
+                    and r.get("kind") == "compile"
+                    and r.get("source") == "serving"]
+        assert {c["entry"] for c in compiles} >= {"prefill", "decode"}
+
+    def test_run_report_serving_columns(self, tmp_path):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "serve_run_report", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(
+                    __file__))), "tools", "run_report.py"))
+        rr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rr)
+
+        run_dir = str(tmp_path / "run")
+        obs.start_run(run_dir, flush_every=1)
+        model, cache, clock, eng = _pressured_engine(pages=64,
+                                                     max_seq_len=64)
+        eng.submit([1, 2, 3], max_new_tokens=4)
+        clock.advance(0.25)
+        while not eng.scheduler.idle:
+            eng.step()
+            clock.advance(0.01)
+        obs.end_run()
+        run = rr.load_run(run_dir)
+        rs = rr.request_summary(run)
+        assert rs["requests"] == rs["finished"] == 1
+        assert rs["output_tokens"] == 4
+        # admission + first token happen at t=0.25: TTFT exactly 250ms
+        assert rs["ttft_ms_p50"] == pytest.approx(250.0)
+        assert rs["tpot_ms_p50"] == pytest.approx(10.0)
+        rendered = rr.render_run(run)
+        assert "requests" in rendered and "ttft_ms" in rendered

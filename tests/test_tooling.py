@@ -103,6 +103,19 @@ def test_perf_gate_self_test_passes():
     assert mod.main(["--self-test"]) == 0
 
 
+def test_serve_bench_self_test_passes():
+    """tools/serve_bench.py --self-test: the ragged paged decode kernel
+    must match the dense reference on page-crossing ragged batches, the
+    hand-checked continuous-batching scheduler trace must hold exactly
+    under a deterministic clock (token-budget admission order,
+    oldest-protected preemption, arrival-order requeue, zero-leak
+    teardown), and the pressured engine must reproduce the dense
+    oracle's greedy tokens with manual-clock-exact TTFT. In-process so
+    it rides the tier-1 command path like the other self-tests."""
+    mod = _load_tool("serve_bench")
+    assert mod.main(["--self-test"]) == 0
+
+
 def test_chaos_marker_is_registered():
     """tests/test_resilience.py marks itself `chaos`; an unregistered
     marker would warn (or fail under --strict-markers). Pin it."""

@@ -68,7 +68,7 @@ def load_run(path):
     if not files:
         raise FileNotFoundError(f"no journal.jsonl under {path!r}")
     run = {"header": None, "steps": [], "events": [], "anomalies": [],
-           "summary": None, "parse_errors": []}
+           "requests": [], "summary": None, "parse_errors": []}
     for fp in files:
         with open(fp, encoding="utf-8") as f:
             for lineno, line in enumerate(f, 1):
@@ -92,6 +92,8 @@ def load_run(path):
                     run["summary"] = rec.get("summary")
                 elif t == "event":
                     run["events"].append(rec)
+                elif t == "request":
+                    run["requests"].append(rec)
     by_step = {s.get("step"): s for s in run["steps"]}
     for e in run["events"]:
         if e.get("kind") == "backend" and run["header"] is not None:
@@ -132,6 +134,40 @@ def _comm_bytes_per_step(run, key="all_reduce_bytes"):
     vals = [s["comm"].get(key, 0) for s in run["steps"]
             if isinstance(s.get("comm"), dict)]
     return _mean(vals)
+
+
+def _pctl(xs, q):
+    """Exact percentile over the raw per-request values (the journal
+    keeps every request record, unlike the bounded-bucket serving
+    histograms) — ONE shared definition with tools/serve_bench.py."""
+    from paddle_tpu.obs.metrics import exact_percentile
+
+    return exact_percentile(xs, q)
+
+
+def request_summary(run):
+    """Serving columns over the run's ``request`` records: counts by
+    state, total preemptions, and exact p50/p99 TTFT/TPOT/e2e (ms).
+    None when the run served nothing."""
+    reqs = run.get("requests") or []
+    if not reqs:
+        return None
+    out = {"requests": len(reqs),
+           "finished": sum(1 for r in reqs
+                           if r.get("state") == "FINISHED"),
+           "cancelled": sum(1 for r in reqs
+                            if r.get("state") == "CANCELLED"),
+           "preemptions": sum(int(r.get("preemptions") or 0)
+                              for r in reqs),
+           "output_tokens": sum(int(r.get("output_tokens") or 0)
+                                for r in reqs)}
+    for key in ("ttft_ms", "tpot_ms", "e2e_ms"):
+        vals = [r[key] for r in reqs
+                if isinstance(r.get(key), (int, float))]
+        if vals:
+            out[f"{key}_p50"] = _pctl(vals, 50)
+            out[f"{key}_p99"] = _pctl(vals, 99)
+    return out
 
 
 def _final_loss(run, k=5):
@@ -184,6 +220,19 @@ def render_run(run, as_json=False):
                 lines.append(f"{k:<12} "
                              f"{v:.4g}" if isinstance(v, float) else
                              f"{k:<12} {v}")
+    rsum = request_summary(run)
+    if rsum:
+        lines.append(
+            f"requests     {rsum['requests']} "
+            f"({rsum['finished']} finished, {rsum['cancelled']} "
+            f"cancelled, {rsum['preemptions']} preemptions, "
+            f"{rsum['output_tokens']} tokens)")
+        for key, label in (("ttft_ms", "ttft_ms"), ("tpot_ms", "tpot_ms"),
+                           ("e2e_ms", "e2e_ms")):
+            if rsum.get(f"{key}_p50") is not None:
+                lines.append(
+                    f"{label:<12} p50={rsum[f'{key}_p50']:.3f} "
+                    f"p99={rsum[f'{key}_p99']:.3f}")
     kinds = {}
     for e in run["events"]:
         kinds[e.get("kind")] = kinds.get(e.get("kind"), 0) + 1
@@ -350,6 +399,45 @@ def self_test():
             self_rep = diff_runs(a, a)
             if self_rep["regression"]:
                 failures.append(f"A-vs-A diff false-positived: {self_rep}")
+
+        # serving request records round-trip with EXACT percentile
+        # columns (hand-computed: TTFT = 100*(i+1) ms for i in 0..9,
+        # so p50 = 500 ms, p99 = 1000 ms)
+        from paddle_tpu.obs import journal as J
+
+        with tempfile.TemporaryDirectory() as d:
+            j = J.RunJournal(d, compute_flops=False)
+            j.start()
+            for i in range(10):
+                j.record_request(
+                    rid=f"r{i}", state="FINISHED", arrival_t=0.0,
+                    admit_t=0.01, first_token_t=0.1 * (i + 1),
+                    finish_t=2.0, prompt_tokens=5, output_tokens=5,
+                    pages_peak=2, preemptions=1 if i == 0 else 0)
+            j.close()
+            rs = request_summary(load_run(d))
+            if rs is None:
+                failures.append("request records did not round-trip")
+            else:
+                if rs["requests"] != 10 or rs["finished"] != 10:
+                    failures.append(f"request counts wrong: {rs}")
+                if rs["preemptions"] != 1:
+                    failures.append(
+                        f"preemptions {rs['preemptions']} != 1")
+                if abs(rs["ttft_ms_p50"] - 500.0) > 1e-9 or \
+                        abs(rs["ttft_ms_p99"] - 1000.0) > 1e-9:
+                    failures.append(
+                        f"ttft percentiles off hand-computed values: "
+                        f"p50={rs['ttft_ms_p50']} p99={rs['ttft_ms_p99']}")
+                # journal-derived TPOT: (finish - first_token)/(n-1);
+                # request 0 = (2.0 - 0.1)/4 s = 475 ms exactly
+                tpots = [r["tpot_ms"] for r in load_run(d)["requests"]]
+                if abs(min(tpots) - 250.0) > 1e-6 or \
+                        abs(max(tpots) - 475.0) > 1e-6:
+                    failures.append(
+                        f"tpot_ms derivation off: min={min(tpots)} "
+                        f"(want 250: req 9 = (2.0-1.0)/4 s) "
+                        f"max={max(tpots)} (want 475)")
     finally:
         mfu.set_peak_flops(None)
 
@@ -359,9 +447,10 @@ def self_test():
         print(f"self-test FAILED: {len(failures)} check(s)")
         return 1
     print("self-test passed: journal round-trip, MFU/goodput summary, "
-          "loss_spike + nonfinite_streak detectors, and the diff gate "
+          "loss_spike + nonfinite_streak detectors, the diff gate "
           "flagged the injected step-time, loss, AND all-reduce-bytes "
-          "regressions (and only them)")
+          "regressions (and only them), and serving request records "
+          "round-trip with hand-computed TTFT/TPOT percentile columns")
     return 0
 
 
