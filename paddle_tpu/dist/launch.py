@@ -11,6 +11,16 @@ CPU-simulation runs (each child gets JAX_PLATFORMS=cpu +
 xla_force_host_platform_device_count). Multi-host pods launch one
 process per host with ``--ips`` listing the hosts; jax.distributed
 wires the DCN side in dist/env.py.
+
+Failure semantics: when any worker exits nonzero, the survivors are
+TERMINATED (no orphaned gang) and the first failure's exact code is
+propagated — a signal death becomes the shell's 128+signum. With
+``--elastic`` the gang instead runs under
+``resilience.elastic.GangSupervisor``: hung workers are detected via
+heartbeat files and killed, preemptions (exit 75 from
+``resilience.graceful_shutdown``) relaunch budget-free, and crashes
+relaunch from the newest intact checkpoint under ``--max_restarts``
+with jittered backoff.
 """
 from __future__ import annotations
 
@@ -18,6 +28,7 @@ import argparse
 import os
 import subprocess
 import sys
+import time
 
 __all__ = ["launch", "get_cluster_endpoints", "get_gpus",
            "get_cluster_from_args"]
@@ -34,6 +45,20 @@ def _parse_args(argv=None):
                    default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
     p.add_argument("--started_port", type=int, default=6170)
     p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--elastic", action="store_true",
+                   help="supervise the gang elastically: watchdog-kill "
+                        "hung workers, relaunch the whole gang from the "
+                        "newest intact checkpoint on failure, treat "
+                        "preemption exits (75) as budget-free restarts")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="crash/hang restart budget in --elastic mode")
+    p.add_argument("--hang_timeout", type=float, default=300.0,
+                   help="seconds without a worker heartbeat before the "
+                        "watchdog kills it (--elastic; workers opt in "
+                        "by beating resilience.Heartbeat.from_env())")
+    p.add_argument("--ckpt_dir", type=str, default=None,
+                   help="checkpoint dir the supervisor inspects to "
+                        "journal each restart's resume step (--elastic)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -48,31 +73,85 @@ def get_cluster_endpoints(ips, nproc_per_node, started_port):
     return eps
 
 
+def _trainer_env(args, eps, world, local):
+    """The PADDLE_TRAINER_* (+ CPU-simulation) env UPDATE for one local
+    worker — shared by the plain and elastic paths."""
+    rank = args.node_rank * args.nproc_per_node + local
+    env = {
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
+        "PADDLE_CURRENT_ENDPOINT": eps[rank],
+    }
+    if args.nproc_per_node > 1:
+        # multiple processes cannot share the TPU client: children
+        # run on the virtual-device CPU backend (test/sim mode)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            # APPEND: the user's other XLA flags must survive
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+    return env
+
+
+def _wait_gang(procs):
+    """Wait for all workers; on the FIRST nonzero exit, terminate the
+    survivors (no orphaned gang) and return that worker's exact exit
+    code — a signal death becomes the shell's 128+signum, instead of
+    the old OR-style collapse that garbled both."""
+    from ..resilience.elastic import normalize_exit_code
+    from .utils import terminate_local_procs
+
+    try:
+        while True:
+            for p, _ in procs:
+                rc = p.poll()
+                if rc is not None and rc != 0:
+                    terminate_local_procs([q for q, _ in procs
+                                           if q is not p])
+                    return normalize_exit_code(rc)
+            if all(p.poll() is not None for p, _ in procs):
+                return 0
+            time.sleep(0.05)
+    finally:
+        for _, out in procs:
+            if out:
+                out.close()
+
+
 def launch(args=None):
     args = args or _parse_args()
     eps = get_cluster_endpoints(args.ips, args.nproc_per_node,
                                 args.started_port)
     world = len(eps)
+    cmd = [sys.executable, args.training_script] + \
+        args.training_script_args
+
+    if getattr(args, "elastic", False):
+        from ..resilience.elastic import ElasticBudgetError, GangSupervisor
+
+        sup = GangSupervisor(
+            cmd, nprocs=args.nproc_per_node,
+            env_for_rank=lambda rank, attempt: _trainer_env(
+                args, eps, world, rank),
+            log_dir=args.log_dir, ckpt_dir=args.ckpt_dir,
+            max_restarts=args.max_restarts,
+            hang_timeout_s=args.hang_timeout)
+        try:
+            return sup.run()
+        except ElasticBudgetError as e:
+            print(f"paddle_tpu.dist.launch: {e}", file=sys.stderr)
+            return sup.state.get("exit_code") or 1
+
     procs = []
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
     for local in range(args.nproc_per_node):
         rank = args.node_rank * args.nproc_per_node + local
         env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(world),
-            "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
-            "PADDLE_CURRENT_ENDPOINT": eps[rank],
-        })
-        if args.nproc_per_node > 1:
-            # multiple processes cannot share the TPU client: children
-            # run on the virtual-device CPU backend (test/sim mode)
-            env["JAX_PLATFORMS"] = "cpu"
-            env.setdefault(
-                "XLA_FLAGS", "--xla_force_host_platform_device_count=2")
-        cmd = [sys.executable, args.training_script] + \
-            args.training_script_args
+        env.update(_trainer_env(args, eps, world, local))
         out = None
         if args.log_dir:
             out = open(os.path.join(args.log_dir,
@@ -80,14 +159,7 @@ def launch(args=None):
         procs.append((subprocess.Popen(cmd, env=env, stdout=out,
                                        stderr=subprocess.STDOUT
                                        if out else None), out))
-    rc = 0
-    for p, out in procs:
-        code = p.wait()
-        if code != 0:  # collapse: OR-ing codes garbles signals/values
-            rc = 1
-        if out:
-            out.close()
-    return rc
+    return _wait_gang(procs)
 
 
 def get_gpus(selected_gpus):

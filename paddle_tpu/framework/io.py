@@ -16,6 +16,7 @@ import json
 import os
 import pickle
 import shutil
+import threading
 import time
 import warnings
 
@@ -29,15 +30,18 @@ from ..obs import trace as _trace
 from ..resilience import inject as _chaos
 
 _M_SAVE_MS = _metrics.histogram("checkpoint.save_ms")
+_M_SNAPSHOT_MS = _metrics.histogram("checkpoint.snapshot_ms")
 _M_LOAD_MS = _metrics.histogram("checkpoint.load_ms")
 _M_VERIFY_MS = _metrics.histogram("checkpoint.verify_ms")
 _M_SAVES = _metrics.counter("checkpoint.saves")
+_M_SAVE_FAILURES = _metrics.counter("checkpoint.save_failures")
 _M_LOADS = _metrics.counter("checkpoint.loads")
 _M_FALLBACKS = _metrics.counter("checkpoint.fallbacks")
 
 __all__ = [
     "save", "load", "save_inference_model", "load_inference_model",
     "save_checkpoint", "load_checkpoint", "verify_checkpoint",
+    "AsyncCheckpoint", "wait_checkpoints",
     "CheckpointError",
     "save_vars", "load_vars", "save_params", "load_params",
     "save_persistables", "load_persistables",
@@ -265,52 +269,203 @@ def _dump_with_digest(obj, path):
     return {"size": w.size, "crc32": w.crc & 0xFFFFFFFF}
 
 
+class AsyncCheckpoint:
+    """Handle for one in-flight ``save_checkpoint(..., async_=True)``.
+
+    The step-path cost (host snapshot of every array) was already paid
+    when the handle was returned; the serialized pickle+crc write,
+    manifest, and atomic publish run on a background writer thread.
+    ``done()`` polls; ``result()`` joins, re-raises any writer failure,
+    and returns the published path. A writer that dies mid-save never
+    published anything — only a ``.tmp_ckpt_*`` orphan remains, so
+    ``load_checkpoint``'s newest-intact fallback stays sound."""
+
+    __slots__ = ("directory", "step", "path", "error", "_done", "_thread")
+
+    def __init__(self, directory, step):
+        self.directory = str(directory)
+        self.step = int(step)
+        self.path = None
+        self.error = None
+        self._done = threading.Event()
+        self._thread = None
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"checkpoint step {self.step} still writing after "
+                f"{timeout}s")
+        # this save is settled: release the module barrier slot so an
+        # already-observed failure is raised once, not at every
+        # subsequent save
+        global _ASYNC_PENDING
+        with _ASYNC_LOCK:
+            if _ASYNC_PENDING is self:
+                _ASYNC_PENDING = None
+        if self.error is not None:
+            raise self.error
+        return self.path
+
+
+_ASYNC_LOCK = threading.Lock()
+_ASYNC_PENDING = None  # at most ONE async save is ever in flight
+
+
+def wait_checkpoints(timeout=None):
+    """Barrier on the in-flight async checkpoint save: returns its
+    published path (or None when nothing is pending) and re-raises a
+    writer failure. Call before a clean exit — e.g. the graceful-
+    preemption path — so the last snapshot is durable."""
+    with _ASYNC_LOCK:
+        handle = _ASYNC_PENDING
+    if handle is None:
+        return None
+    return handle.result(timeout)
+
+
+def _host_copy_tree(obj):
+    """Numpy-materialize AND copy a state tree: the async writer must
+    own its bytes outright — ``np.asarray`` on a CPU-backend jax array
+    can alias the device buffer, which the next (donating) train step
+    is free to invalidate while the writer is still serializing."""
+    out = _to_numpy_tree(obj)
+
+    def walk(o):
+        if isinstance(o, dict):
+            return {k: walk(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return type(o)(walk(v) for v in o)
+        if isinstance(o, np.ndarray):
+            return np.array(o, copy=True)
+        return o
+
+    return walk(out)
+
+
+def _snapshot_checkpoint(step, model, optimizer, scheduler, extra, copy):
+    """Host-side materialization of everything the writer needs. This is
+    the ONLY part of a save that reads live model state — it runs on the
+    caller's thread, so by the time an async writer starts, the step
+    loop may mutate/donate freely."""
+    tree = _host_copy_tree if copy else _to_numpy_tree
+    state = {"step": int(step), "extra": extra or {}}
+    snap = {"model": None, "opt": None}
+    if model is not None:
+        snap["model"] = tree({k: v for k, v in model.state_dict().items()})
+    if optimizer is not None:
+        snap["opt"] = tree(optimizer.state_dict())
+    if scheduler is not None:
+        state["scheduler"] = scheduler.state_dict()
+    snap["state"] = tree(state)
+    return snap
+
+
 def save_checkpoint(directory, step, model=None, optimizer=None,
-                    scheduler=None, keep_last=3, extra=None):
+                    scheduler=None, keep_last=3, extra=None, async_=False):
     """Atomic checkpoint with keep-last-k rotation, resume metadata, and
     an integrity manifest (per-file and per-array crc32) that
-    ``load_checkpoint`` verifies before trusting the data."""
+    ``load_checkpoint`` verifies before trusting the data.
+
+    ``async_=True`` keeps the serialized write off the step loop:
+    the state is snapshotted to host arrays on the calling thread (the
+    only step-path cost), and the pickle+crc write, manifest, and
+    atomic publish happen on a background writer thread; the call
+    returns an :class:`AsyncCheckpoint` handle. Exactly one save is in
+    flight at a time — any save (sync or async) first barriers on the
+    previous in-flight one and re-raises its failure (once). The
+    ``ckpt_<step>`` dir only appears when the writer COMPLETED, so a
+    writer that dies mid-save leaves nothing the newest-intact fallback
+    could mistake for a checkpoint."""
+    # barrier: the previous writer owns the directory (rotation!) until
+    # it finishes; its failure must surface, not vanish
+    wait_checkpoints()
     t0 = time.perf_counter()
-    with _trace.span("checkpoint.save", step=int(step)):
-        out = _save_checkpoint(directory, step, model, optimizer, scheduler,
-                               keep_last, extra)
-    # a save that died (e.g. injected ckpt_crash) published nothing:
-    # checkpoint.saves counts only durable checkpoints
-    save_ms = (time.perf_counter() - t0) * 1e3
-    _M_SAVE_MS.observe(save_ms)
-    _M_SAVES.inc()
-    if _journal.ACTIVE is not None:
-        _journal.ACTIVE.event("checkpoint.save", step=int(step),
-                              ms=save_ms, dir=str(directory))
-    return out
+    if not async_:
+        with _trace.span("checkpoint.save", step=int(step)):
+            snap = _snapshot_checkpoint(step, model, optimizer, scheduler,
+                                        extra, copy=False)
+            out = _write_checkpoint(directory, step, snap, keep_last)
+        # a save that died (e.g. injected ckpt_crash) published nothing:
+        # checkpoint.saves counts only durable checkpoints
+        save_ms = (time.perf_counter() - t0) * 1e3
+        _M_SAVE_MS.observe(save_ms)
+        _M_SAVES.inc()
+        if _journal.ACTIVE is not None:
+            _journal.ACTIVE.event("checkpoint.save", step=int(step),
+                                  ms=save_ms, dir=str(directory))
+        return out
+
+    with _trace.span("checkpoint.snapshot", step=int(step)):
+        snap = _snapshot_checkpoint(step, model, optimizer, scheduler,
+                                    extra, copy=True)
+    _M_SNAPSHOT_MS.observe((time.perf_counter() - t0) * 1e3)
+    handle = AsyncCheckpoint(directory, step)
+
+    def _writer():
+        try:
+            with _trace.span("checkpoint.save", step=int(step), async_=1):
+                handle.path = _write_checkpoint(directory, step, snap,
+                                                keep_last)
+            save_ms = (time.perf_counter() - t0) * 1e3
+            _M_SAVE_MS.observe(save_ms)
+            _M_SAVES.inc()  # published: NOW it counts
+            if _journal.ACTIVE is not None:
+                _journal.ACTIVE.event("checkpoint.save", step=int(step),
+                                      ms=save_ms, dir=str(directory),
+                                      async_=True)
+        except BaseException as e:  # surfaced by the next barrier
+            handle.error = e
+            _M_SAVE_FAILURES.inc()
+            if _journal.ACTIVE is not None:
+                _journal.ACTIVE.event(
+                    "checkpoint.save_failed", step=int(step),
+                    dir=str(directory),
+                    error=f"{type(e).__name__}: {e}")
+        finally:
+            handle._done.set()
+
+    # non-daemon: a CLEAN interpreter exit joins the writer (free
+    # durability); a crash/SIGKILL still orphans only the tmp dir
+    t = threading.Thread(target=_writer, name=f"ckpt-writer-{step}",
+                         daemon=False)
+    handle._thread = t
+    global _ASYNC_PENDING
+    with _ASYNC_LOCK:
+        _ASYNC_PENDING = handle
+    t.start()
+    return handle
 
 
-def _save_checkpoint(directory, step, model, optimizer, scheduler,
-                     keep_last, extra):
+def _write_checkpoint(directory, step, snap, keep_last):
+    """Serialize an already-snapshotted state tree to
+    ``.tmp_ckpt_<step>`` and atomically publish it as ``ckpt_<step>``.
+    Runs on the caller thread (sync save) or the writer thread (async
+    save); touches only the snapshot, never live model state."""
     os.makedirs(directory, exist_ok=True)
     tmp = os.path.join(directory, f".tmp_ckpt_{step}")
     final = os.path.join(directory, f"ckpt_{step}")
     os.makedirs(tmp, exist_ok=True)
-    state = {"step": int(step), "extra": extra or {}}
     manifest = {"format": 1, "step": int(step), "files": {}, "arrays": {}}
-    if model is not None:
-        mstate = _to_numpy_tree({k: v for k, v in model.state_dict().items()})
+    if snap["model"] is not None:
         manifest["files"]["model.pdparams"] = _dump_with_digest(
-            mstate, os.path.join(tmp, "model.pdparams"))
-        manifest["arrays"]["model.pdparams"] = _array_checksums(mstate)
-    if optimizer is not None:
-        ostate = _to_numpy_tree(optimizer.state_dict())
+            snap["model"], os.path.join(tmp, "model.pdparams"))
+        manifest["arrays"]["model.pdparams"] = _array_checksums(
+            snap["model"])
+    if snap["opt"] is not None:
         manifest["files"]["opt.pdopt"] = _dump_with_digest(
-            ostate, os.path.join(tmp, "opt.pdopt"))
-        manifest["arrays"]["opt.pdopt"] = _array_checksums(ostate)
-    if scheduler is not None:
-        state["scheduler"] = scheduler.state_dict()
+            snap["opt"], os.path.join(tmp, "opt.pdopt"))
+        manifest["arrays"]["opt.pdopt"] = _array_checksums(snap["opt"])
     manifest["files"]["meta.pkl"] = _dump_with_digest(
-        _to_numpy_tree(state), os.path.join(tmp, "meta.pkl"))
+        snap["state"], os.path.join(tmp, "meta.pkl"))
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=1, sort_keys=True)
     if _chaos.ACTIVE:
-        _chaos.fire("ckpt_crash", tmp)  # simulated death: tmp left orphaned
+        _chaos.fire("ckpt_slow", tmp)  # stall window: a writer killed
+        # here leaves only the tmp orphan — publish never ran
+        _chaos.fire("ckpt_crash", tmp)  # simulated death: tmp orphaned
     if os.path.exists(final):
         shutil.rmtree(final)
     os.replace(tmp, final)  # atomic publish: readers never see partial state
@@ -489,7 +644,13 @@ def load_checkpoint(directory, model=None, optimizer=None, scheduler=None,
 def _load_checkpoint(directory, model, optimizer, scheduler, step):
     if not os.path.isdir(directory):
         return None
-    _clean_orphan_tmp(directory)
+    with _ASYNC_LOCK:
+        pending = _ASYNC_PENDING
+    if pending is None or pending.done():
+        # never sweep while OUR writer thread is mid-save: its live
+        # .tmp_ckpt_* is not an orphan (cross-process savers are
+        # already covered by the mtime grace period)
+        _clean_orphan_tmp(directory)
     entries = []
     for d in os.listdir(directory):
         if not d.startswith("ckpt_"):

@@ -29,7 +29,7 @@ import threading
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "counter", "gauge", "histogram", "snapshot", "reset",
-    "exact_percentile", "DEFAULT_MS_BUCKETS",
+    "exact_percentile", "DEFAULT_MS_BUCKETS", "WIDE_MS_BUCKETS",
 ]
 
 
@@ -50,6 +50,15 @@ def exact_percentile(xs, q):
 DEFAULT_MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
                       50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
                       10000.0, 30000.0)
+
+# the default set tops out at 30s — fine for steps and compiles, but
+# whole-gang events (elastic resume = failure detection -> every worker
+# beating again, which includes process spawn + backend init + a
+# checkpoint load) live in the seconds-to-minutes band; this extension
+# keeps percentile resolution out to 10 minutes instead of clamping
+# everything past 30s into the overflow bucket
+WIDE_MS_BUCKETS = DEFAULT_MS_BUCKETS + (60000.0, 120000.0, 300000.0,
+                                        600000.0)
 
 
 class Counter:

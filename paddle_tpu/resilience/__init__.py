@@ -20,6 +20,11 @@ from .policy import (  # noqa: F401
     NONFINITE_ACTIONS, RecoveryPolicy, TransientError, retry_call,
 )
 from .guard import GuardedExecutor, GuardedStep, GuardStats  # noqa: F401
+from .elastic import (  # noqa: F401
+    PREEMPTED_EXIT_CODE, ElasticBudgetError, GangSupervisor,
+    GracefulShutdown, Heartbeat, ProgramStateAdapter, fire_step_chaos,
+    graceful_shutdown, newest_intact_step, normalize_exit_code,
+)
 
 __all__ = [
     "chaos", "install_from_env", "ACTIVE", "INJECTORS",
@@ -27,4 +32,8 @@ __all__ = [
     "SimulatedCrashError", "TransientError",
     "RecoveryPolicy", "NONFINITE_ACTIONS", "retry_call",
     "GuardedStep", "GuardedExecutor", "GuardStats",
+    "PREEMPTED_EXIT_CODE", "ElasticBudgetError", "GangSupervisor",
+    "GracefulShutdown", "Heartbeat", "ProgramStateAdapter",
+    "fire_step_chaos", "graceful_shutdown", "newest_intact_step",
+    "normalize_exit_code",
 ]

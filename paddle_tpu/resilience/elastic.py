@@ -1,0 +1,587 @@
+"""Elastic gang supervision: preemption-aware multi-process training.
+
+Everything below PR 2 recovers faults *inside* one process; at pod
+scale (PAPERS.md, arXiv 1909.09756) the routine fault is a whole
+machine: a worker crashes, hangs, or gets a preemption notice, and the
+old ``dist/launch.py`` spawn-and-wait either orphaned the survivors or
+garbled the exit code. This module promotes the resilience layer to
+whole-gang elasticity (ROADMAP item 4; the reference's
+``incubate/fleet`` elastic + HA utilities):
+
+- workers write heartbeat files (:class:`Heartbeat`, path handed down
+  via ``PADDLE_TPU_HEARTBEAT_FILE``) from their TRAINING LOOP — not a
+  background thread, so a deadlocked step stops the beacon and becomes
+  visible;
+- workers install :func:`graceful_shutdown`, which turns SIGTERM/SIGINT
+  into "checkpoint at the next step boundary, then exit
+  ``PREEMPTED_EXIT_CODE``" — the supervisor treats that code as
+  restart-eligible WITHOUT consuming the crash budget;
+- the :class:`GangSupervisor` spawns the gang, watches exits AND
+  heartbeat staleness (a hung worker is SIGKILLed, never waited on
+  forever), tears the WHOLE gang down on any failure (no orphans), and
+  relaunches it — workers resume themselves from the newest intact
+  checkpoint via ``framework.io.load_checkpoint``'s manifest fallback —
+  under a bounded restart budget with seeded, jittered exponential
+  backoff;
+- :func:`fire_step_chaos` is the worker-side hook the ``worker_kill`` /
+  ``worker_hang`` / ``preempt_signal`` injectors fire from, so every
+  path above is drillable deterministically on CPU
+  (``tools/elastic_run.py``).
+
+Restarts/preemptions/watchdog kills land as ``resilience.*`` metrics
+and ``elastic.*`` journal events (``tools/run_report.py`` renders them
+as an elastic summary next to goodput).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+from . import inject as _inject
+from .policy import RecoveryPolicy as _RecoveryPolicy
+
+__all__ = [
+    "PREEMPTED_EXIT_CODE", "HEARTBEAT_ENV", "ATTEMPT_ENV",
+    "ElasticBudgetError", "Heartbeat", "GracefulShutdown",
+    "graceful_shutdown", "ProgramStateAdapter", "GangSupervisor",
+    "fire_step_chaos", "newest_intact_step", "normalize_exit_code",
+]
+
+# EX_TEMPFAIL: "transient failure, retry" — distinct from every code a
+# crash produces, and stable across restarts of this module
+PREEMPTED_EXIT_CODE = 75
+HEARTBEAT_ENV = "PADDLE_TPU_HEARTBEAT_FILE"
+ATTEMPT_ENV = "PADDLE_TPU_ELASTIC_ATTEMPT"
+
+_M_RESTARTS = _metrics.counter("resilience.restarts")
+_M_PREEMPTIONS = _metrics.counter("resilience.preemptions")
+_M_WATCHDOG = _metrics.counter("resilience.watchdog_kills")
+_M_PREEMPT_SIGNALS = _metrics.counter("resilience.preempt_signals")
+_M_RESUME_MS = _metrics.histogram("resilience.resume_ms",
+                                  buckets=_metrics.WIDE_MS_BUCKETS)
+
+
+def normalize_exit_code(code):
+    """``Popen.returncode`` -> shell convention: a signal death (-N)
+    becomes 128+N, so SIGKILL reads as 137 everywhere instead of -9
+    here and 1 there."""
+    if code is not None and code < 0:
+        return 128 - code
+    return code
+
+
+class ElasticBudgetError(RuntimeError):
+    """The gang kept failing until the restart budget ran out. Carries
+    the full attempt ``history`` so the operator sees every failure, not
+    just the last one."""
+
+    def __init__(self, msg, history=None):
+        super().__init__(msg)
+        self.history = list(history or [])
+
+
+def _journal_event(kind, **fields):
+    """Supervisor/worker events into the flight recorder when one is
+    active (lazy import: elastic must stay importable before obs)."""
+    try:
+        from ..obs import journal as _journal
+    except Exception:
+        return
+    if _journal.ACTIVE is not None:
+        _journal.ACTIVE.event(kind, **fields)
+
+
+# -- worker side -------------------------------------------------------------
+
+
+class Heartbeat:
+    """Worker-side liveness beacon: an atomically-replaced JSON file
+    whose MTIME is the signal (content — ts/pid/step — is diagnostics).
+    ``beat()`` belongs in the training loop, once per step: a hang that
+    stops the loop must stop the beacon, which is exactly what the
+    supervisor's watchdog keys on. With no path configured every call
+    is a no-op, so loops can beat unconditionally."""
+
+    def __init__(self, path=None):
+        self.path = path
+        self.beats = 0
+
+    @classmethod
+    def from_env(cls, env=None):
+        """The beacon the supervisor configured for this worker (via
+        ``PADDLE_TPU_HEARTBEAT_FILE``), or an inert one outside a
+        supervised gang."""
+        return cls((env or os.environ).get(HEARTBEAT_ENV))
+
+    def beat(self, step=None):
+        if self.path is None:
+            return
+        payload = {"ts": time.time(), "pid": os.getpid()}
+        if step is not None:
+            payload["step"] = int(step)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self.path)  # watchdog never reads a torn file
+        self.beats += 1
+
+
+class GracefulShutdown:
+    """SIGTERM/SIGINT -> ``.requested``: the preemption notice.
+
+    The handler only sets a flag — the TRAINING LOOP decides when the
+    model state is consistent (a step boundary), checkpoints there, and
+    calls :meth:`exit_preempted`. Usable as a context manager; install/
+    uninstall must run on the main thread (CPython signal rule)."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self.requested = False
+        self.signum = None
+        self._prev = {}
+        self._installed = False
+
+    def install(self):
+        for s in self.signals:
+            self._prev[s] = signal.signal(s, self._handler)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        if self._installed:
+            for s, prev in self._prev.items():
+                signal.signal(s, prev)
+            self._prev.clear()
+            self._installed = False
+
+    def _handler(self, signum, frame):
+        self.requested = True
+        self.signum = signum
+        _M_PREEMPT_SIGNALS.inc()
+        _journal_event("elastic.preempt_signal", signum=int(signum))
+
+    def exit_preempted(self):
+        """Exit with the code the supervisor treats as a preemption
+        (restart-eligible, crash-budget-free). Call AFTER the
+        checkpoint is durable (``io.wait_checkpoints()``)."""
+        sys.exit(PREEMPTED_EXIT_CODE)
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+
+def graceful_shutdown(signals=(signal.SIGTERM, signal.SIGINT)):
+    """Install and return the worker's preemption handler:
+    ``shutdown = resilience.graceful_shutdown()``, then once per step
+    boundary ``if shutdown.requested: save_checkpoint(...);
+    shutdown.exit_preempted()``."""
+    return GracefulShutdown(signals).install()
+
+
+class ProgramStateAdapter:
+    """``state_dict``/``set_state_dict`` protocol over a static
+    Program's persistables, so ``save_checkpoint``/``load_checkpoint``
+    (manifest, crc, newest-intact fallback, async writer) checkpoint
+    the static path exactly like an nn model: pass it as ``model=``."""
+
+    def __init__(self, program, scope=None):
+        self.program = program
+        self.scope = scope
+
+    def _scope(self):
+        from ..static_.program import global_scope
+
+        return self.scope if self.scope is not None else global_scope()
+
+    def state_dict(self):
+        from ..framework.io import get_program_persistable_vars
+
+        scope = self._scope()
+        out = {}
+        for v in get_program_persistable_vars(self.program):
+            arr = scope.find_var(v.name)
+            if arr is None:  # a silent partial save only fails at resume
+                raise ValueError(
+                    f"persistable {v.name!r} has no value in scope — run "
+                    "the startup program before checkpointing")
+            out[v.name] = np.asarray(arr)
+        return out
+
+    def set_state_dict(self, state):
+        from ..framework.io import set_program_state
+
+        set_program_state(self.program, state)
+
+
+def fire_step_chaos(step=None, rank=None):
+    """Worker-side chaos hook, called once per step boundary: lets the
+    ``worker_kill`` / ``worker_hang`` / ``preempt_signal`` injectors
+    fire with global-step + rank context. One empty-dict truthiness
+    test when chaos is inactive."""
+    if not _inject.ACTIVE:
+        return
+    for point in ("worker_kill", "worker_hang", "preempt_signal"):
+        if point in _inject.ACTIVE:
+            _inject.fire(point, step=step, rank=rank)
+
+
+# -- supervisor side ---------------------------------------------------------
+
+
+def newest_intact_step(directory):
+    """Step of the newest checkpoint passing FULL verification, or None
+    — what a relaunched worker's ``load_checkpoint`` will resume from.
+    The supervisor journals it on every restart, so the flight record
+    names each resume point."""
+    from ..framework import io as _io
+
+    if not directory or not os.path.isdir(directory):
+        return None
+    entries = []
+    for d in os.listdir(directory):
+        if d.startswith("ckpt_"):
+            s = _io._ckpt_step(d)
+            if s is not None:
+                entries.append((s, d))
+    for s, d in sorted(entries, reverse=True):
+        ok, _ = _io.verify_checkpoint(os.path.join(directory, d))
+        if ok:
+            return s
+    return None
+
+
+class _Worker:
+    __slots__ = ("rank", "proc", "log_fn", "hb_path", "spawned_at",
+                 "done", "exit_code")
+
+    def __init__(self, rank, proc, log_fn, hb_path):
+        self.rank = rank
+        self.proc = proc
+        self.log_fn = log_fn
+        self.hb_path = hb_path
+        self.spawned_at = time.monotonic()
+        self.done = False
+        self.exit_code = None
+
+
+class GangSupervisor:
+    """Elastic supervisor for one gang of worker processes.
+
+    ``cmd`` is the worker command (list of argv strings) or a callable
+    ``(rank, attempt) -> argv``. Each worker inherits the parent env
+    plus ``env`` plus ``env_for_rank(rank, attempt)``, a heartbeat path
+    in ``PADDLE_TPU_HEARTBEAT_FILE``, and the attempt index in
+    ``PADDLE_TPU_ELASTIC_ATTEMPT``.
+
+    Per attempt, the first of these decides the outcome:
+
+    - every worker exits 0                    -> ``ok`` (done, return 0)
+    - a worker exits ``PREEMPTED_EXIT_CODE``  -> ``preempt`` (relaunch,
+      budget-free — bounded only by ``max_preempt_restarts``)
+    - a worker exits any other nonzero code   -> ``crash``
+    - a worker's heartbeat goes stale past ``hang_timeout_s`` (or never
+      appears within ``startup_timeout_s``, when that is set; the
+      default None keeps non-beating scripts supervisable for plain
+      crash/preempt handling) -> SIGKILL it, ``hang``
+
+    On ``crash``/``hang``, one unit of the ``max_restarts`` budget is
+    consumed and the relaunch waits a seeded jittered exponential
+    backoff; budget exhaustion raises :class:`ElasticBudgetError` with
+    the attempt history. Every failure tears down the WHOLE gang
+    (SIGTERM, shared grace, SIGKILL — survivors get the chance to
+    checkpoint gracefully) before relaunching: workers re-resume from
+    the newest intact checkpoint, which keeps the gang's state
+    consistent without any cross-worker protocol.
+    """
+
+    def __init__(self, cmd, nprocs=1, *, env=None, env_for_rank=None,
+                 cwd=None, heartbeat_dir=None, log_dir=None, ckpt_dir=None,
+                 max_restarts=3, max_preempt_restarts=64,
+                 hang_timeout_s=300.0, startup_timeout_s=None,
+                 poll_interval_s=0.05, term_grace_s=10.0,
+                 backoff_s=0.5, backoff_factor=2.0, max_backoff_s=30.0,
+                 jitter=0.25, seed=0, sleep=None):
+        self.cmd = cmd
+        self.nprocs = int(nprocs)
+        self.env = dict(env or {})
+        self.env_for_rank = env_for_rank
+        self.cwd = cwd
+        self._own_hb_dir = heartbeat_dir is None
+        self.heartbeat_dir = heartbeat_dir or tempfile.mkdtemp(
+            prefix="pt_elastic_hb_")
+        self.log_dir = log_dir
+        self.ckpt_dir = ckpt_dir
+        self.max_restarts = int(max_restarts)
+        self.max_preempt_restarts = int(max_preempt_restarts)
+        self.hang_timeout_s = float(hang_timeout_s)
+        self.startup_timeout_s = (None if startup_timeout_s is None
+                                  else float(startup_timeout_s))
+        self.poll_interval_s = float(poll_interval_s)
+        self.term_grace_s = float(term_grace_s)
+        self.backoff_s = float(backoff_s)
+        self.backoff_factor = float(backoff_factor)
+        self.max_backoff_s = float(max_backoff_s)
+        self.jitter = float(jitter)
+        self.seed = int(seed)
+        # ONE backoff formula in this package: RecoveryPolicy owns the
+        # capped-exponential + seeded post-cap jitter schedule
+        self._backoff_policy = _RecoveryPolicy(
+            backoff=self.backoff_s, backoff_factor=self.backoff_factor,
+            max_backoff=self.max_backoff_s, jitter=self.jitter,
+            jitter_seed=self.seed)
+        self._sleep = sleep if sleep is not None else time.sleep
+        self.state = {"attempts": [], "restarts": 0, "preemptions": 0,
+                      "watchdog_kills": 0, "exit_code": None}
+
+    # -- spawning / teardown -------------------------------------------------
+
+    def _hb_path(self, rank):
+        return os.path.join(self.heartbeat_dir, f"hb_{rank}.json")
+
+    def _spawn(self, attempt):
+        os.makedirs(self.heartbeat_dir, exist_ok=True)
+        workers = []
+        try:
+            self._spawn_ranks(workers, attempt)
+        except BaseException:
+            # a mid-loop Popen/open failure (fork EAGAIN under the very
+            # memory pressure that just crashed the gang) must not
+            # orphan the ranks already spawned this attempt
+            self._teardown(workers)
+            raise
+        _journal_event("elastic.spawn", attempt=attempt,
+                       pids=[w.proc.pid for w in workers])
+        return workers
+
+    def _spawn_ranks(self, workers, attempt):
+        for rank in range(self.nprocs):
+            hb = self._hb_path(rank)
+            try:  # a stale beacon from the previous incarnation must
+                os.remove(hb)  # not count as liveness (or staleness)
+            except OSError:
+                pass
+            env = dict(os.environ)
+            env.update(self.env)
+            env[HEARTBEAT_ENV] = hb
+            env[ATTEMPT_ENV] = str(attempt)
+            env.setdefault("PADDLE_TRAINER_ID", str(rank))
+            env.setdefault("PADDLE_TRAINERS_NUM", str(self.nprocs))
+            if self.env_for_rank is not None:
+                env.update(self.env_for_rank(rank, attempt) or {})
+            argv = self.cmd(rank, attempt) if callable(self.cmd) \
+                else list(self.cmd)
+            out = None
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                out = open(os.path.join(
+                    self.log_dir, f"worker.{rank}.{attempt}.log"), "w")
+            try:
+                proc = subprocess.Popen(
+                    argv, env=env, cwd=self.cwd, stdout=out,
+                    stderr=subprocess.STDOUT if out else None)
+            except BaseException:
+                if out is not None:
+                    out.close()
+                raise
+            workers.append(_Worker(rank, proc, out, hb))
+
+    def _teardown(self, workers):
+        """Terminate every survivor: SIGTERM (the graceful-shutdown
+        path — survivors may checkpoint), one SHARED grace deadline,
+        then SIGKILL; reap all and close logs. No orphaned gang,
+        ever."""
+        deadline = time.monotonic() + self.term_grace_s
+        for w in workers:
+            if w.proc.poll() is None:
+                try:
+                    w.proc.terminate()
+                except OSError:
+                    pass
+        for w in workers:
+            try:
+                w.proc.wait(timeout=max(
+                    0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+                w.proc.wait()
+            if w.exit_code is None:
+                w.exit_code = normalize_exit_code(w.proc.returncode)
+            if w.log_fn is not None:
+                w.log_fn.close()
+
+    # -- watchdog ------------------------------------------------------------
+
+    def _check_watchdog(self, workers):
+        """Returns the first hung worker, else None. A worker is hung
+        when its heartbeat file is stale past ``hang_timeout_s``, or —
+        with ``startup_timeout_s`` set — when it never produced one in
+        time."""
+        now_wall = time.time()
+        for w in workers:
+            if w.done:
+                continue
+            try:
+                age = now_wall - os.path.getmtime(w.hb_path)
+            except OSError:
+                if self.startup_timeout_s is not None and \
+                        time.monotonic() - w.spawned_at > \
+                        self.startup_timeout_s:
+                    return w, None
+                continue
+            if age > self.hang_timeout_s:
+                return w, age
+        return None
+
+    # -- the supervise loop --------------------------------------------------
+
+    def _supervise(self, workers, resume_t0=None):
+        """Wait for the gang; returns the attempt outcome dict. Each
+        poll: reap exits (0 -> done; PREEMPTED -> preempt; other ->
+        crash), then the heartbeat watchdog (-> SIGKILL + hang), then —
+        once after a relaunch — the resume-latency sample (failure
+        detection to every worker beating again)."""
+        resume_pending = resume_t0 is not None
+        while True:
+            for w in workers:
+                if w.done:
+                    continue
+                rc = w.proc.poll()
+                if rc is None:
+                    continue
+                w.done = True
+                w.exit_code = normalize_exit_code(rc)
+                if w.exit_code == 0:
+                    continue
+                kind = ("preempt" if w.exit_code == PREEMPTED_EXIT_CODE
+                        else "crash")
+                return {"kind": kind, "rank": w.rank,
+                        "code": w.exit_code,
+                        "detected_at": time.monotonic()}
+            if all(w.done for w in workers):
+                return {"kind": "ok", "detected_at": time.monotonic()}
+            hung = self._check_watchdog(workers)
+            if hung is not None:
+                w, stale_s = hung
+                try:
+                    w.proc.kill()  # SIGTERM can't help a wedged loop
+                except OSError:
+                    pass
+                w.done = True
+                w.exit_code = normalize_exit_code(w.proc.wait())
+                _M_WATCHDOG.inc()
+                self.state["watchdog_kills"] += 1
+                _journal_event(
+                    "elastic.watchdog_kill", rank=w.rank,
+                    stale_s=(None if stale_s is None
+                             else round(stale_s, 3)),
+                    startup=stale_s is None)
+                return {"kind": "hang", "rank": w.rank,
+                        "code": w.exit_code,
+                        "detected_at": time.monotonic()}
+            if resume_pending and all(
+                    os.path.exists(w.hb_path) for w in workers):
+                # beacons were cleared at spawn: existence == the new
+                # incarnation made its first step. That closes the
+                # failure->productive-again window MFU/goodput loses.
+                ms = (time.monotonic() - resume_t0) * 1e3
+                _M_RESUME_MS.observe(ms)
+                _journal_event("elastic.resumed", resume_ms=ms)
+                resume_pending = False
+            self._sleep(self.poll_interval_s)
+
+    def _backoff(self, n):
+        """Backoff before restart ``n`` (0-based): exponential, capped,
+        then spread ±``jitter`` via ``RandomState(seed + n)`` — many
+        supervisors recovering from one outage must not relaunch in
+        lockstep, and the same seed must replay the same drill. Delegates
+        to :meth:`RecoveryPolicy.backoff_for` (the one formula)."""
+        return self._backoff_policy.backoff_for(n)
+
+    def run(self):
+        """Supervise until the gang completes (returns 0), or the
+        restart budget is exhausted (raises
+        :class:`ElasticBudgetError`)."""
+        attempt = 0
+        restarts_used = 0
+        preempts_used = 0
+        resume_t0 = None
+        _journal_event("elastic.start", nprocs=self.nprocs,
+                       max_restarts=self.max_restarts,
+                       hang_timeout_s=self.hang_timeout_s)
+        try:
+            while True:
+                workers = self._spawn(attempt)
+                try:
+                    outcome = self._supervise(workers,
+                                              resume_t0=resume_t0)
+                finally:
+                    self._teardown(workers)
+                self.state["attempts"].append(
+                    {k: v for k, v in outcome.items()
+                     if k != "detected_at"})
+                if outcome["kind"] == "ok":
+                    self.state["exit_code"] = 0
+                    _journal_event("elastic.done", attempts=attempt + 1,
+                                   restarts=restarts_used,
+                                   preemptions=preempts_used)
+                    return 0
+                resume_t0 = outcome["detected_at"]
+                resume_step = newest_intact_step(self.ckpt_dir)
+                if outcome["kind"] == "preempt":
+                    preempts_used += 1
+                    _M_PREEMPTIONS.inc()
+                    self.state["preemptions"] += 1
+                    _journal_event("elastic.preempt",
+                                   rank=outcome["rank"], attempt=attempt,
+                                   resume_step=resume_step)
+                    if preempts_used > self.max_preempt_restarts:
+                        raise ElasticBudgetError(
+                            f"gang preempted {preempts_used} times "
+                            f"(max_preempt_restarts="
+                            f"{self.max_preempt_restarts})",
+                            self.state["attempts"])
+                else:  # crash / hang: consumes the restart budget
+                    restarts_used += 1
+                    if restarts_used > self.max_restarts:
+                        self.state["exit_code"] = outcome.get("code")
+                        _journal_event(
+                            "elastic.budget_exhausted",
+                            restarts=restarts_used - 1,
+                            last_kind=outcome["kind"],
+                            last_rank=outcome["rank"],
+                            last_code=outcome.get("code"))
+                        raise ElasticBudgetError(
+                            f"gang failed {restarts_used} times, restart "
+                            f"budget is {self.max_restarts}: last "
+                            f"failure rank {outcome['rank']} "
+                            f"{outcome['kind']} "
+                            f"(exit {outcome.get('code')})",
+                            self.state["attempts"])
+                    _M_RESTARTS.inc()
+                    self.state["restarts"] += 1
+                    delay = self._backoff(restarts_used - 1)
+                    _journal_event(
+                        "elastic.restart", failure=outcome["kind"],
+                        rank=outcome["rank"], code=outcome.get("code"),
+                        attempt=attempt, restarts_used=restarts_used,
+                        backoff_s=round(delay, 4),
+                        resume_step=resume_step)
+                    self._sleep(delay)
+                attempt += 1
+        finally:
+            if self._own_hb_dir:
+                import shutil
+
+                shutil.rmtree(self.heartbeat_dir, ignore_errors=True)

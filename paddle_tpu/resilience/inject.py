@@ -18,7 +18,21 @@ pod job —
                     (leaves an orphaned ``.tmp_ckpt_*`` dir)
 - ``ckpt_truncate`` / ``ckpt_bitflip``
                     corrupt a published checkpoint file
+- ``ckpt_slow``     stall the checkpoint writer between writing files and
+                    the atomic publish (the window a killed writer leaves
+                    only a tmp orphan, and the window an async save must
+                    keep off the step path)
 - ``loader_worker`` kill a DataLoader prefetch worker thread mid-batch
+- ``worker_kill`` / ``worker_hang`` / ``preempt_signal``
+                    gang-level faults fired from the worker's
+                    step-boundary hook (``resilience.elastic
+                    .fire_step_chaos``): hard process death, silent
+                    no-progress hang (heartbeats stop; the supervisor's
+                    watchdog must catch it), and a SIGTERM preemption
+                    notice. These support global-step keyed firing
+                    (``at_step=N``) so a relaunched worker that resumed
+                    PAST the fault step does not re-fire, and ``rank=R``
+                    gating so one env spec can target one gang member.
 
 Activation is explicit and scoped: the ``chaos("point", ...)`` context
 manager, or the ``PADDLE_TPU_CHAOS`` env var
@@ -37,7 +51,9 @@ from __future__ import annotations
 
 import contextlib
 import os
+import signal as _signal
 import threading
+import time
 
 import numpy as np
 
@@ -330,6 +346,103 @@ class CkptBitflipInjector(_CkptFileCorruptor):
             b = f.read(1)
             f.seek(off)
             f.write(bytes([b[0] ^ bit]))
+
+
+@register_injector("ckpt_slow")
+class CkptSlowInjector(Injector):
+    """Stall the checkpoint writer for ``seconds`` (default 0.5) between
+    writing the checkpoint files and the atomic publish — a slow/remote
+    filesystem. Under ``save_checkpoint(async_=True)`` the stall runs on
+    the background writer thread, which is exactly what the
+    never-blocks-the-step-loop tests assert; a process killed inside the
+    stall leaves only the ``.tmp_ckpt_*`` orphan (publish never ran)."""
+
+    def fire(self, value=None, **ctx):
+        if self.should_fire():
+            time.sleep(float(self.cfg.get("seconds", 0.5)))
+        return value
+
+
+class _WorkerFaultInjector(Injector):
+    """Base for gang-level faults fired from the worker training loop's
+    step boundary (``resilience.elastic.fire_step_chaos``).
+
+    Two firing modes:
+
+    - ``at_step=N`` — fire when the GLOBAL step equals N. Because a
+      relaunched worker resumes from a checkpoint at/after the fault
+      step, the same ``PADDLE_TPU_CHAOS`` spec inherited across
+      restarts fires exactly once per drill instead of re-killing every
+      incarnation.
+    - default hit-based ``at``/``times`` — hits are counted per process
+      activation, so EVERY incarnation re-fires: the restart-budget-
+      exhaustion drill.
+
+    ``rank=R`` additionally gates either mode to one gang member."""
+
+    def _worker_applies(self, step=None, rank=None):
+        want_rank = self.cfg.get("rank")
+        if want_rank is not None and rank is not None and \
+                int(want_rank) != int(rank):
+            return False
+        at_step = self.cfg.get("at_step")
+        if at_step is not None:
+            if step is None or int(step) != int(at_step):
+                return False
+            with self._lock:
+                self.hits += 1
+                if self.fired >= self.times:
+                    return False
+                self.fired += 1
+                return True
+        return self.should_fire()
+
+
+@register_injector("worker_kill")
+class WorkerKillInjector(_WorkerFaultInjector):
+    """Hard-kill the calling worker process via ``os._exit`` — no
+    cleanup, no journal flush, no atexit: exactly what machine loss
+    looks like to the gang supervisor. cfg: ``code`` (exit code,
+    default 1), plus ``at_step``/``rank`` gating."""
+
+    def fire(self, value=None, step=None, rank=None, **ctx):
+        if self._worker_applies(step, rank):
+            os._exit(int(self.cfg.get("code", 1)))
+        return value
+
+
+@register_injector("worker_hang")
+class WorkerHangInjector(_WorkerFaultInjector):
+    """Stop making progress WITHOUT dying: the main thread spins in
+    sleep, so heartbeats stop but the process stays alive — only the
+    supervisor's heartbeat watchdog can detect and kill it (a plain
+    ``wait()`` never returns). cfg: ``seconds`` bounds the hang for
+    in-process unit tests; unset hangs until killed."""
+
+    def fire(self, value=None, step=None, rank=None, **ctx):
+        if self._worker_applies(step, rank):
+            seconds = self.cfg.get("seconds")
+            if seconds is not None:
+                time.sleep(float(seconds))
+            else:  # hang until the watchdog kills us; SIGTERM only sets
+                while True:  # the graceful flag, which we never check
+                    time.sleep(1.0)
+        return value
+
+
+@register_injector("preempt_signal")
+class PreemptSignalInjector(_WorkerFaultInjector):
+    """Deliver SIGTERM to the calling process — the maintenance/
+    preemption notice a TPU VM gets. With
+    ``resilience.graceful_shutdown()`` installed the worker checkpoints
+    at the next step boundary and exits ``PREEMPTED_EXIT_CODE``
+    (restart-eligible, budget-free); without a handler the default
+    disposition kills the process (128+15)."""
+
+    def fire(self, value=None, step=None, rank=None, **ctx):
+        if self._worker_applies(step, rank):
+            os.kill(os.getpid(), _signal.SIGTERM)
+        return value
 
 
 @register_injector("loader_worker")

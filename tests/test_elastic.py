@@ -1,0 +1,578 @@
+"""Elastic gang supervision + async checkpointing (ISSUE 8 tentpole).
+
+In-process units for the worker-side primitives (heartbeats, graceful
+shutdown, jittered/deadlined retries, async saves) plus small REAL
+subprocess gangs under :class:`GangSupervisor` — crash propagation,
+budget-free preemption, the heartbeat watchdog, and budget exhaustion
+are all exercised with live processes, not mocks. The full 3-fault
+training drill (bitwise trajectory vs an unfaulted run) rides tier-1
+separately via ``tools/elastic_run.py --self-test`` in test_tooling.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.io import (load_checkpoint, save_checkpoint,
+                                     wait_checkpoints)
+from paddle_tpu.resilience import (ElasticBudgetError, GangSupervisor,
+                                   GracefulShutdown, Heartbeat,
+                                   ProgramStateAdapter, RecoveryPolicy,
+                                   SimulatedCrashError, TransientError,
+                                   inject, normalize_exit_code, retry_call)
+from paddle_tpu.resilience.elastic import PREEMPTED_EXIT_CODE
+
+pytestmark = pytest.mark.chaos
+
+FAST = dict(poll_interval_s=0.01, term_grace_s=1.0, backoff_s=0.0,
+            jitter=0.0)
+
+
+def _py(code):
+    return [sys.executable, "-c", code]
+
+
+# -- exit codes --------------------------------------------------------------
+
+
+def test_normalize_exit_code():
+    assert normalize_exit_code(0) == 0
+    assert normalize_exit_code(7) == 7
+    assert normalize_exit_code(-9) == 137   # SIGKILL
+    assert normalize_exit_code(-15) == 143  # SIGTERM
+    assert normalize_exit_code(None) is None
+
+
+# -- policy: jitter + deadline (satellite) -----------------------------------
+
+
+def test_backoff_jitter_is_seeded_and_bounded():
+    p = RecoveryPolicy(backoff=1.0, backoff_factor=1.0, max_backoff=10.0,
+                       jitter=0.5, jitter_seed=42)
+    u = np.random.RandomState(42).uniform(-1.0, 1.0)
+    assert p.backoff_for(0) == pytest.approx(1.0 * (1.0 + 0.5 * u))
+    # deterministic: same (seed, attempt) -> same delay, and a replay
+    # of the whole schedule is identical
+    assert [p.backoff_for(i) for i in range(4)] == \
+        [p.backoff_for(i) for i in range(4)]
+    for i in range(4):
+        assert 0.5 <= p.backoff_for(i) <= 1.5
+    # different seeds de-synchronize (ranks seeded differently must not
+    # stampede in lockstep)
+    q = RecoveryPolicy(backoff=1.0, backoff_factor=1.0, max_backoff=10.0,
+                       jitter=0.5, jitter_seed=43)
+    assert q.backoff_for(0) != p.backoff_for(0)
+
+
+def test_backoff_jitter_applies_after_the_cap():
+    # clamping jittered delays back under max_backoff would re-sync
+    # exactly the long (capped) retries; the spread must survive the cap
+    p = RecoveryPolicy(backoff=100.0, max_backoff=1.0, jitter=0.5,
+                       jitter_seed=0)
+    u = np.random.RandomState(0).uniform(-1.0, 1.0)
+    assert p.backoff_for(0) == pytest.approx(1.0 * (1.0 + 0.5 * u))
+
+
+def test_zero_jitter_keeps_exact_backoff():
+    p = RecoveryPolicy(backoff=0.5, backoff_factor=2.0, max_backoff=2.0)
+    assert [p.backoff_for(i) for i in range(4)] == [0.5, 1.0, 2.0, 2.0]
+
+
+def test_jitter_fraction_validated():
+    with pytest.raises(ValueError, match="jitter"):
+        RecoveryPolicy(jitter=1.5)
+
+
+def test_retry_call_deadline_stops_with_budget_left():
+    clock = [0.0]
+    p = RecoveryPolicy(max_retries=5, backoff=1.0, backoff_factor=1.0,
+                       max_backoff=1.0,
+                       sleep=lambda s: clock.__setitem__(0, clock[0] + s))
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        raise TransientError("still down")
+
+    with pytest.raises(TransientError):
+        retry_call(fn, p, deadline_s=2.5, clock=lambda: clock[0])
+    # attempts 1..2 retried (elapsed+delay <= 2.5); the 3rd attempt's
+    # next sleep would land at 3.0 > 2.5 -> raise with 3 retries of
+    # budget still unspent
+    assert calls[0] == 3
+
+
+def test_retry_call_without_deadline_spends_full_budget():
+    p = RecoveryPolicy(max_retries=2, backoff=0.0, sleep=lambda s: None)
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        raise TransientError("down")
+
+    with pytest.raises(TransientError):
+        retry_call(fn, p)
+    assert calls[0] == 3
+
+
+# -- worker-side primitives --------------------------------------------------
+
+
+def test_heartbeat_noop_without_path_and_beats_with_one(tmp_path):
+    hb = Heartbeat(None)
+    hb.beat(step=1)  # must be safe to call unconditionally
+    assert hb.beats == 0
+
+    path = str(tmp_path / "hb.json")
+    hb = Heartbeat(path)
+    hb.beat(step=7)
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["pid"] == os.getpid() and rec["step"] == 7
+    assert hb.beats == 1
+    before = os.path.getmtime(path)
+    time.sleep(0.02)
+    hb.beat(step=8)
+    assert os.path.getmtime(path) >= before  # mtime is the signal
+
+
+def test_heartbeat_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_HEARTBEAT_FILE", raising=False)
+    assert Heartbeat.from_env().path is None
+    monkeypatch.setenv("PADDLE_TPU_HEARTBEAT_FILE",
+                       str(tmp_path / "hb.json"))
+    hb = Heartbeat.from_env()
+    hb.beat()
+    assert os.path.exists(hb.path)
+
+
+def test_graceful_shutdown_catches_sigterm_and_restores():
+    prev = signal.getsignal(signal.SIGTERM)
+    with GracefulShutdown(signals=(signal.SIGTERM,)) as sh:
+        assert not sh.requested
+        os.kill(os.getpid(), signal.SIGTERM)  # the preemption notice
+        assert sh.requested and sh.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is prev
+    with pytest.raises(SystemExit) as ei:
+        sh.exit_preempted()
+    assert ei.value.code == PREEMPTED_EXIT_CODE == 75
+
+
+# -- async checkpointing -----------------------------------------------------
+
+
+def _linear(seed=0):
+    pt.seed(seed)
+    return nn.Linear(4, 2)
+
+
+def test_async_save_matches_sync_bitwise(tmp_path):
+    m = _linear()
+    d_sync, d_async = str(tmp_path / "s"), str(tmp_path / "a")
+    save_checkpoint(d_sync, 3, model=m)
+    h = save_checkpoint(d_async, 3, model=m, async_=True)
+    assert h.result(timeout=30.0) == os.path.join(d_async, "ckpt_3")
+    assert h.done()
+    ms, ma = _linear(1), _linear(2)
+    assert load_checkpoint(d_sync, model=ms) == 3
+    assert load_checkpoint(d_async, model=ma) == 3
+    assert np.array_equal(np.asarray(ms.weight._data),
+                          np.asarray(ma.weight._data))
+
+
+def test_async_save_never_blocks_the_step_loop(tmp_path):
+    """THE acceptance assertion: with the serialized write stalled 0.6s
+    (ckpt_slow), ``save_checkpoint(async_=True)`` must return in a
+    fraction of that — the write happens on the writer thread — and the
+    checkpoint must only be published once the writer completed."""
+    m = _linear()
+    d = str(tmp_path / "ck")
+    with inject.chaos("ckpt_slow", seconds=0.6):
+        t0 = time.perf_counter()
+        h = save_checkpoint(d, 1, model=m, async_=True)
+        step_path_s = time.perf_counter() - t0
+        assert not os.path.exists(os.path.join(d, "ckpt_1"))
+        # the step loop keeps running while the writer stalls; a load
+        # issued NOW must neither sweep the live writer's tmp dir nor
+        # see a half-written checkpoint
+        assert load_checkpoint(d, model=_linear(1)) is None
+        assert any(f.startswith(".tmp_ckpt_1") for f in os.listdir(d))
+        h.result(timeout=30.0)
+    assert step_path_s < 0.3, \
+        f"async save held the step path {step_path_s:.3f}s of a 0.6s write"
+    assert os.path.isdir(os.path.join(d, "ckpt_1"))
+    assert load_checkpoint(d, model=_linear(1)) == 1
+
+
+def test_save_barriers_on_previous_inflight_save(tmp_path):
+    m = _linear()
+    d = str(tmp_path / "ck")
+    with inject.chaos("ckpt_slow", seconds=0.4):
+        h1 = save_checkpoint(d, 1, model=m, async_=True)
+        # the next save (sync or async) first barriers on h1: rotation
+        # and publish stay strictly ordered
+        save_checkpoint(d, 2, model=m)
+    assert h1.done() and h1.error is None
+    names = sorted(f for f in os.listdir(d) if f.startswith("ckpt_"))
+    assert names == ["ckpt_1", "ckpt_2"]
+
+
+def test_async_writer_failure_surfaces_once_then_clears(tmp_path):
+    m = _linear()
+    d = str(tmp_path / "ck")
+    save_checkpoint(d, 1, model=m)  # the intact fallback target
+    with inject.chaos("ckpt_crash"):
+        h = save_checkpoint(d, 2, model=m, async_=True)
+        with pytest.raises(SimulatedCrashError):
+            wait_checkpoints(timeout=30.0)
+    assert h.error is not None
+    assert wait_checkpoints() is None  # settled: raised once, cleared
+    # the dead writer published nothing (not even a corrupt dir the
+    # loader would have to skip): step 1 IS the newest intact checkpoint
+    m2 = _linear(1)
+    assert load_checkpoint(d, model=m2) == 1
+    assert np.array_equal(np.asarray(m.weight._data),
+                          np.asarray(m2.weight._data))
+
+
+def test_wait_checkpoints_idle_returns_none():
+    assert wait_checkpoints() is None
+
+
+def test_writer_killed_mid_save_leaves_only_tmp_orphan(tmp_path):
+    """A process that dies WHILE the async writer is serializing (the
+    ckpt_slow stall window) must leave only a ``.tmp_ckpt_*`` orphan:
+    publish never ran, the previous checkpoint stays the newest intact
+    one, and the stale orphan is swept on the next load."""
+    d = str(tmp_path / "ck")
+    script = f"""
+import os, sys, time
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.io import save_checkpoint
+from paddle_tpu.resilience import inject
+
+pt.seed(0)
+m = nn.Linear(4, 2)
+save_checkpoint({d!r}, 1, model=m)
+inject.install_from_env("ckpt_slow:seconds=120")
+h = save_checkpoint({d!r}, 2, model=m, async_=True)
+tmp = os.path.join({d!r}, ".tmp_ckpt_2")
+deadline = time.monotonic() + 30
+while not os.path.exists(os.path.join(tmp, "manifest.json")):
+    if time.monotonic() > deadline:
+        sys.exit(99)
+    time.sleep(0.01)
+os._exit(17)  # machine loss: writer dies inside the stall, pre-publish
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PADDLE_TPU_RUN_DIR="")
+    r = subprocess.run(_py(script), env=env, capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 17, (r.returncode, r.stdout, r.stderr)
+    names = sorted(os.listdir(d))
+    assert "ckpt_1" in names and "ckpt_2" not in names, names
+    assert ".tmp_ckpt_2" in names, names
+    # age the orphan past the concurrent-saver grace period: the next
+    # load sweeps it and resumes from the newest INTACT checkpoint
+    t = time.time() - 3600
+    orphan = os.path.join(d, ".tmp_ckpt_2")
+    for p in [orphan] + [os.path.join(orphan, f)
+                         for f in os.listdir(orphan)]:
+        os.utime(p, (t, t))
+    m2 = _linear(1)
+    assert load_checkpoint(d, model=m2) == 1
+    assert not any(f.startswith(".tmp_ckpt_")
+                   for f in os.listdir(d))
+
+
+# -- ProgramStateAdapter (static path) ---------------------------------------
+
+
+@pytest.fixture
+def static_mode():
+    pt.enable_static()
+    yield
+    pt.disable_static()
+
+
+def test_program_state_adapter_roundtrip(static_mode, tmp_path):
+    from paddle_tpu.static_.program import global_scope
+
+    pt.seed(0)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[4, 4])
+        fluid.layers.fc(x, size=2)
+    exe = fluid.Executor()
+    exe.run(startup)
+    adapter = ProgramStateAdapter(prog)
+    state = adapter.state_dict()
+    assert state and all(isinstance(v, np.ndarray)
+                         for v in state.values())
+    d = str(tmp_path / "ck")
+    save_checkpoint(d, 5, model=adapter)
+    for k, v in state.items():  # "the machine died": zero everything
+        global_scope().set(k, np.zeros_like(v))
+    assert load_checkpoint(d, model=adapter) == 5
+    state2 = adapter.state_dict()
+    assert set(state2) == set(state)
+    for k in state:
+        assert np.array_equal(state[k], state2[k]), k
+
+
+def test_program_state_adapter_rejects_unrun_startup(static_mode):
+    pt.seed(0)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[4, 4])
+        fluid.layers.fc(x, size=2)
+    from paddle_tpu.static_.program import Scope
+
+    adapter = ProgramStateAdapter(prog, scope=Scope())  # never ran startup
+    with pytest.raises(ValueError, match="startup"):
+        adapter.state_dict()
+
+
+# -- the gang supervisor (real subprocesses) ---------------------------------
+
+
+def test_supervisor_clean_gang_returns_zero():
+    sup = GangSupervisor(_py("import sys; sys.exit(0)"), nprocs=2, **FAST)
+    assert sup.run() == 0
+    assert sup.state["attempts"] == [{"kind": "ok"}]
+    assert sup.state["restarts"] == 0 and sup.state["preemptions"] == 0
+    assert not os.path.exists(sup.heartbeat_dir)  # own tmp dir cleaned
+
+
+def test_supervisor_budget_exhaustion_is_a_clean_error():
+    sup = GangSupervisor(_py("import sys; sys.exit(7)"), nprocs=1,
+                         max_restarts=1, **FAST)
+    with pytest.raises(ElasticBudgetError) as ei:
+        sup.run()
+    hist = ei.value.history
+    assert [a["kind"] for a in hist] == ["crash", "crash"]
+    assert all(a["code"] == 7 for a in hist)
+    assert sup.state["exit_code"] == 7  # the worker's EXACT code
+    assert sup.state["restarts"] == 1   # one relaunch was granted
+
+
+def test_supervisor_normalizes_signal_deaths():
+    sup = GangSupervisor(
+        _py("import os, signal; os.kill(os.getpid(), signal.SIGABRT)"),
+        nprocs=1, max_restarts=0, **FAST)
+    with pytest.raises(ElasticBudgetError):
+        sup.run()
+    assert sup.state["attempts"][0]["code"] == 134  # 128 + SIGABRT
+
+
+def test_supervisor_relaunches_crash_then_succeeds():
+    def cmd(rank, attempt):
+        return _py(f"import sys; sys.exit({9 if attempt == 0 else 0})")
+
+    sup = GangSupervisor(cmd, nprocs=2, max_restarts=3, **FAST)
+    assert sup.run() == 0
+    assert [a["kind"] for a in sup.state["attempts"]] == ["crash", "ok"]
+    assert sup.state["attempts"][0]["code"] == 9
+    assert sup.state["restarts"] == 1
+
+
+def test_supervisor_preemption_is_budget_free():
+    def cmd(rank, attempt):
+        code = PREEMPTED_EXIT_CODE if attempt == 0 else 0
+        return _py(f"import sys; sys.exit({code})")
+
+    # max_restarts=0: any budget-consuming failure would raise
+    sup = GangSupervisor(cmd, nprocs=2, max_restarts=0, **FAST)
+    assert sup.run() == 0
+    assert [a["kind"] for a in sup.state["attempts"]] == ["preempt", "ok"]
+    assert sup.state["preemptions"] == 1 and sup.state["restarts"] == 0
+
+
+def test_supervisor_watchdog_kills_hung_worker():
+    hang = ("import os, time\n"
+            "open(os.environ['PADDLE_TPU_HEARTBEAT_FILE'], 'w')"
+            ".write('{}')\n"
+            "time.sleep(120)\n")
+
+    def cmd(rank, attempt):
+        return _py(hang if attempt == 0 else "import sys; sys.exit(0)")
+
+    sup = GangSupervisor(cmd, nprocs=1, max_restarts=1,
+                         hang_timeout_s=0.3, **FAST)
+    t0 = time.monotonic()
+    assert sup.run() == 0
+    assert time.monotonic() - t0 < 30  # detected, never waited out 120s
+    assert [a["kind"] for a in sup.state["attempts"]] == ["hang", "ok"]
+    assert sup.state["attempts"][0]["code"] == 137  # SIGKILLed
+    assert sup.state["watchdog_kills"] == 1
+    assert sup.state["restarts"] == 1  # a hang consumes the budget
+
+
+def test_supervisor_startup_timeout_catches_never_beating_worker():
+    def cmd(rank, attempt):
+        return _py("import time; time.sleep(120)" if attempt == 0
+                   else "import sys; sys.exit(0)")
+
+    sup = GangSupervisor(cmd, nprocs=1, max_restarts=1,
+                         hang_timeout_s=60.0, startup_timeout_s=0.3,
+                         **FAST)
+    assert sup.run() == 0
+    assert [a["kind"] for a in sup.state["attempts"]] == ["hang", "ok"]
+
+
+def test_supervisor_teardown_leaves_no_orphans():
+    marker_dir = tempfile.mkdtemp(prefix="pt_orphan_")
+    pid_file = os.path.join(marker_dir, "pid_{rank}")
+    survivor = (f"import os, time\n"
+                f"open({pid_file!r}.format("
+                f"rank=os.environ['PADDLE_TRAINER_ID']), 'w')"
+                f".write(str(os.getpid()))\n"
+                f"time.sleep(120)\n")
+
+    def cmd(rank, attempt):
+        if attempt > 0:
+            return _py("import sys; sys.exit(0)")
+        if rank == 0:
+            return _py("import sys, time; time.sleep(0.3); sys.exit(5)")
+        return _py(survivor)
+
+    sup = GangSupervisor(cmd, nprocs=2, max_restarts=1, **FAST)
+    assert sup.run() == 0
+    # the crash of rank 0 must have torn rank 1 down, not orphaned it
+    with open(pid_file.format(rank=1)) as f:
+        pid = int(f.read())
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.05)
+    else:
+        os.kill(pid, signal.SIGKILL)
+        raise AssertionError(f"survivor pid {pid} was orphaned")
+    import shutil
+
+    shutil.rmtree(marker_dir, ignore_errors=True)
+
+
+def test_supervisor_backoff_is_seeded_and_jittered(tmp_path):
+    sup = GangSupervisor(["true"], seed=3, backoff_s=1.0,
+                         backoff_factor=2.0, max_backoff_s=8.0,
+                         jitter=0.25, heartbeat_dir=str(tmp_path / "a"))
+    a = [sup._backoff(i) for i in range(4)]
+    b = [sup._backoff(i) for i in range(4)]
+    assert a == b  # same seed -> same drill, replayable
+    for i, v in enumerate(a):
+        base = min(1.0 * 2.0 ** i, 8.0)
+        assert base * 0.75 <= v <= base * 1.25
+    other = GangSupervisor(["true"], seed=4, backoff_s=1.0,
+                           backoff_factor=2.0, max_backoff_s=8.0,
+                           jitter=0.25, heartbeat_dir=str(tmp_path / "b"))
+    assert [other._backoff(i) for i in range(4)] != a
+
+
+# -- dist.launch failure handling (satellite) --------------------------------
+
+
+def test_wait_gang_terminates_survivors_and_keeps_exact_code():
+    from paddle_tpu.dist.launch import _wait_gang
+
+    bad = subprocess.Popen(_py("import sys, time; time.sleep(0.2); "
+                               "sys.exit(3)"))
+    survivor = subprocess.Popen(_py("import time; time.sleep(120)"))
+    t0 = time.monotonic()
+    rc = _wait_gang([(bad, None), (survivor, None)])
+    assert rc == 3  # the first failure's EXACT code, not an OR-collapse
+    assert time.monotonic() - t0 < 30
+    assert survivor.wait(timeout=10) is not None  # terminated, reaped
+
+
+def test_wait_gang_normalizes_signal_death():
+    from paddle_tpu.dist.launch import _wait_gang
+
+    p = subprocess.Popen(_py("import os, signal; "
+                             "os.kill(os.getpid(), signal.SIGKILL)"))
+    assert _wait_gang([(p, None)]) == 137
+
+
+def test_wait_gang_all_zero():
+    from paddle_tpu.dist.launch import _wait_gang
+
+    procs = [(subprocess.Popen(_py("import sys; sys.exit(0)")), None)
+             for _ in range(2)]
+    assert _wait_gang(procs) == 0
+
+
+def test_launch_elastic_smoke(tmp_path):
+    """--elastic end-to-end through dist.launch: a worker that preempts
+    itself once (exit 75) then completes; the supervisor absorbs it
+    budget-free."""
+    from paddle_tpu.dist import launch as L
+
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os, sys\n"
+        "m = os.path.join(os.path.dirname(__file__), "
+        "'seen_' + os.environ.get('PADDLE_TPU_ELASTIC_ATTEMPT', '0'))\n"
+        "open(m, 'w').close()\n"
+        "sys.exit(75 if os.environ['PADDLE_TPU_ELASTIC_ATTEMPT'] == '0' "
+        "else 0)\n")
+    args = L._parse_args(["--nproc_per_node", "1", "--elastic",
+                          "--max_restarts", "0", str(script)])
+    assert L.launch(args) == 0
+    assert (tmp_path / "seen_0").exists()
+    assert (tmp_path / "seen_1").exists()
+
+
+# -- worker-side chaos hook --------------------------------------------------
+
+
+def test_fire_step_chaos_rank_and_step_gating():
+    from paddle_tpu.resilience.elastic import fire_step_chaos
+
+    # inactive chaos: the hook is a no-op (one truthiness test)
+    fire_step_chaos(step=1, rank=0)
+    # rank-gated preempt_signal must only hit the targeted rank, and
+    # only at its step
+    with inject.chaos("preempt_signal", at_step=5, rank=1):
+        with GracefulShutdown(signals=(signal.SIGTERM,)) as sh:
+            fire_step_chaos(step=5, rank=0)   # wrong rank
+            fire_step_chaos(step=4, rank=1)   # wrong step
+            assert not sh.requested
+            fire_step_chaos(step=5, rank=1)   # exact hit
+            assert sh.requested
+            sh.requested = False
+            fire_step_chaos(step=5, rank=1)   # times=1: never re-fires
+            assert not sh.requested
+
+
+def test_resume_latency_histogram_covers_minutes():
+    """Gang resumes live in the seconds-to-minutes band; the histogram
+    must resolve there instead of clamping past 30s into overflow."""
+    from paddle_tpu.obs import metrics as m
+
+    h = m.histogram("resilience.resume_ms")
+    assert h.buckets == m.WIDE_MS_BUCKETS
+    assert h.buckets[-1] == 600000.0
+    assert m.WIDE_MS_BUCKETS[:len(m.DEFAULT_MS_BUCKETS)] == \
+        m.DEFAULT_MS_BUCKETS
+
+
+def test_worker_hang_injector_bounded_seconds():
+    from paddle_tpu.resilience.elastic import fire_step_chaos
+
+    with inject.chaos("worker_hang", seconds=0.2):
+        t0 = time.perf_counter()
+        fire_step_chaos(step=1, rank=0)
+        assert time.perf_counter() - t0 >= 0.2

@@ -116,6 +116,23 @@ def test_serve_bench_self_test_passes():
     assert mod.main(["--self-test"]) == 0
 
 
+def test_elastic_run_self_test_passes():
+    """tools/elastic_run.py --self-test: the ISSUE-8 acceptance drill —
+    a real 2-worker CPU gang under GangSupervisor survives, in ONE run,
+    a worker_kill (hard os._exit), a worker_hang (only the heartbeat
+    watchdog can catch it) and a preempt_signal (SIGTERM -> graceful
+    checkpoint-and-exit 75, relaunched budget-free), resuming each time
+    from the newest intact checkpoint with a final loss trajectory
+    BITWISE identical to an unfaulted reference run; restart-budget
+    exhaustion surfaces a clean ElasticBudgetError with the attempt
+    history; and the supervisor's journal events roll up into
+    run_report's elastic summary (restarts/preemptions/watchdog kills/
+    resume latency). In-process so it rides the tier-1 command path
+    like the other self-tests."""
+    mod = _load_tool("elastic_run")
+    assert mod.main(["--self-test"]) == 0
+
+
 def test_chaos_marker_is_registered():
     """tests/test_resilience.py marks itself `chaos`; an unregistered
     marker would warn (or fail under --strict-markers). Pin it."""

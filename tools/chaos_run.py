@@ -328,6 +328,114 @@ def run_loader_worker():
     return "worker crash absorbed; all 4 batches delivered in order"
 
 
+@scenario("ckpt_slow")
+def run_ckpt_slow():
+    """The checkpoint writer stalls pre-publish (slow/remote fs); under
+    ``async_=True`` the stall runs on the background writer thread so
+    the step path never blocks, and ``ckpt_<step>`` only appears once
+    the writer COMPLETED (publish-on-complete)."""
+    import time
+
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    from paddle_tpu.framework.io import load_checkpoint, save_checkpoint
+    from paddle_tpu.resilience import inject
+
+    pt.seed(0)
+    m = nn.Linear(4, 2)
+    with tempfile.TemporaryDirectory() as d:
+        with inject.chaos("ckpt_slow", seconds=0.5):
+            t0 = time.perf_counter()
+            h = save_checkpoint(d, 1, model=m, async_=True)
+            step_path_s = time.perf_counter() - t0
+            published_early = os.path.exists(os.path.join(d, "ckpt_1"))
+            path = h.result(timeout=30.0)
+        assert step_path_s < 0.25, \
+            f"async save held the step path {step_path_s:.3f}s"
+        assert not published_early, "published before the writer finished"
+        assert os.path.isdir(path), path
+        m2 = nn.Linear(4, 2)
+        step = load_checkpoint(d, model=m2)
+        assert step == 1, step
+        assert np.array_equal(np.asarray(m.weight._data),
+                              np.asarray(m2.weight._data))
+    return "0.5s writer stall stayed off the step path; publish-on-complete"
+
+
+_ELASTIC_RUN = None
+_DRILL_ROOTS_CLEANED = set()
+
+
+def _elastic_drill():
+    """Load tools/elastic_run.py (sibling tool, importlib spec — tools/
+    is not a package) once and return its cached 3-fault gang drill:
+    the worker_kill / worker_hang / preempt_signal scenarios each
+    assert their own facet of ONE supervised run instead of paying for
+    three."""
+    global _ELASTIC_RUN
+    if _ELASTIC_RUN is None:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "elastic_run",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "elastic_run.py"))
+        _ELASTIC_RUN = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_ELASTIC_RUN)
+    res = _ELASTIC_RUN.drill_result()
+    root = res.get("root")
+    if root and root not in _DRILL_ROOTS_CLEANED:
+        # register cleanup only AFTER a successful drill, with the path
+        # captured — a lazy drill_result() call at interpreter shutdown
+        # could re-run the whole multi-process drill
+        import atexit
+        import shutil
+
+        _DRILL_ROOTS_CLEANED.add(root)
+        atexit.register(shutil.rmtree, root, ignore_errors=True)
+    assert not res["failures"], res["failures"]
+    return res
+
+
+@scenario("worker_kill")
+def run_worker_kill():
+    """A gang worker hard-dies (``os._exit``, no cleanup) mid-run; the
+    supervisor tears the whole gang down (no orphans), consumes one
+    restart, and the relaunch resumes from the newest intact checkpoint
+    with a bitwise-identical loss trajectory."""
+    res = _elastic_drill()
+    crash = res["state"]["attempts"][0]
+    assert crash == {"kind": "crash", "rank": 1, "code": 9}, crash
+    assert res["bitwise_match"]
+    return "rank-1 kill (exit 9) relaunched; trajectory bitwise intact"
+
+
+@scenario("worker_hang")
+def run_worker_hang():
+    """A worker stops making progress WITHOUT dying: only the heartbeat
+    watchdog can see it. It SIGKILLs the wedged process and the gang
+    relaunches from the newest intact checkpoint."""
+    res = _elastic_drill()
+    hang = res["state"]["attempts"][1]
+    assert hang["kind"] == "hang" and hang["code"] == 137, hang
+    assert res["state"]["watchdog_kills"] == 1, res["state"]
+    return "silent hang caught by the watchdog (SIGKILL, exit 137)"
+
+
+@scenario("preempt_signal")
+def run_preempt_signal():
+    """SIGTERM lands on a worker with ``resilience.graceful_shutdown``
+    installed: it checkpoints at the next step boundary, exits 75, and
+    the supervisor relaunches WITHOUT consuming the crash budget."""
+    res = _elastic_drill()
+    pre = res["state"]["attempts"][2]
+    assert pre["kind"] == "preempt" and pre["code"] == 75, pre
+    assert res["state"]["preemptions"] == 1, res["state"]
+    assert res["state"]["restarts"] == 2, \
+        f"preemption consumed the crash budget: {res['state']}"
+    return "graceful checkpoint-and-exit 75; relaunch was budget-free"
+
+
 def self_test():
     from paddle_tpu.resilience import INJECTORS
 

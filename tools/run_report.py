@@ -170,6 +170,41 @@ def request_summary(run):
     return out
 
 
+def elastic_summary(run):
+    """Elasticity columns over the run's ``elastic.*`` events (written
+    by ``resilience.elastic.GangSupervisor``): restarts (budget-
+    consuming crash/hang relaunches), budget-free preemptions, watchdog
+    kills, resume-latency p50/max (failure detection -> every worker
+    beating again), the resume steps, and whether the restart budget
+    was exhausted. None when the run was never supervised — the common
+    case costs one event scan."""
+    events = [e for e in run.get("events") or []
+              if str(e.get("kind", "")).startswith("elastic.")]
+    if not events:
+        return None
+    kinds = {}
+    for e in events:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    resume_ms = [e["resume_ms"] for e in events
+                 if e.get("kind") == "elastic.resumed"
+                 and isinstance(e.get("resume_ms"), (int, float))]
+    out = {
+        "restarts": kinds.get("elastic.restart", 0),
+        "preemptions": kinds.get("elastic.preempt", 0),
+        "watchdog_kills": kinds.get("elastic.watchdog_kill", 0),
+        "preempt_signals": kinds.get("elastic.preempt_signal", 0),
+        "budget_exhausted": bool(kinds.get("elastic.budget_exhausted")),
+        "completed": bool(kinds.get("elastic.done")),
+        "resume_steps": [e.get("resume_step") for e in events
+                         if e.get("kind") in ("elastic.restart",
+                                              "elastic.preempt")],
+    }
+    if resume_ms:
+        out["resume_ms_p50"] = _pctl(resume_ms, 50)
+        out["resume_ms_max"] = max(resume_ms)
+    return out
+
+
 def _final_loss(run, k=5):
     """Median of the last k finite losses — robust to one noisy tail
     step."""
@@ -233,6 +268,17 @@ def render_run(run, as_json=False):
                 lines.append(
                     f"{label:<12} p50={rsum[f'{key}_p50']:.3f} "
                     f"p99={rsum[f'{key}_p99']:.3f}")
+    esum = elastic_summary(run)
+    if esum:
+        line = (f"elastic      restarts={esum['restarts']} "
+                f"preemptions={esum['preemptions']} "
+                f"watchdog_kills={esum['watchdog_kills']}")
+        if esum.get("resume_ms_p50") is not None:
+            line += (f" resume_ms p50={esum['resume_ms_p50']:.0f} "
+                     f"max={esum['resume_ms_max']:.0f}")
+        if esum["budget_exhausted"]:
+            line += " BUDGET-EXHAUSTED"
+        lines.append(line)
     kinds = {}
     for e in run["events"]:
         kinds[e.get("kind")] = kinds.get(e.get("kind"), 0) + 1
