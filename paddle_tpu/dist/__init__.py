@@ -18,6 +18,7 @@ from .tp_layers import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy, mark_sharding,
 )
+from .gradcomm import CommOptions, plan_buckets  # noqa: F401
 from .ring_attention import ring_attention, ring_attention_inner  # noqa: F401
 from .ulysses import all_to_all_attention, all_to_all_attention_inner  # noqa: F401
 from .moe import MoEMLP, top2_gating, moe_dispatch_combine  # noqa: F401

@@ -40,12 +40,22 @@ def shard_tensor(t, mesh=None, spec=P()):
 
 class DistributedTrainStep(TrainStep):
     """TrainStep over a Mesh: batch sharded on ``batch_axis``, params laid
-    out by their ``sharding_spec`` (set by TP layers / fleet strategies)."""
+    out by their ``sharding_spec`` (set by TP layers / fleet strategies).
+
+    ``comm_options`` (a ``gradcomm.CommOptions``) — or wrapping the
+    model in ``DataParallel(layer, comm_buffer_size=...)`` — switches
+    the gradient synchronization from GSPMD's implicit one-all-reduce-
+    per-parameter placement onto the explicit comm-efficient exchange:
+    size-bounded flat buckets, optional per-N-microbatch accumulation
+    (``run_fused``), optional int8 quantization with error feedback
+    carried in optimizer state. Requires a pure data-parallel layout
+    (single mesh axis, replicated parameters) and a batch-averaged
+    loss; see ``dist.gradcomm``."""
 
     def __init__(self, model, optimizer, loss_fn, mesh=None,
                  batch_axis="data", batch_specs=None, models=None,
                  donate=True, shard_opt_state=False, scaler=None,
-                 check_nan=False):
+                 check_nan=False, comm_options=None):
         super().__init__(model, optimizer, loss_fn, models=models,
                          donate=donate, scaler=scaler, check_nan=check_nan)
         self.mesh = mesh or get_mesh()
@@ -53,6 +63,12 @@ class DistributedTrainStep(TrainStep):
             raise ValueError("no mesh: call dist.init_mesh(...) first")
         self.batch_axis = batch_axis
         self.batch_specs = batch_specs
+        comm_inherited = False
+        if comm_options is None:
+            # the DataParallel wrapper's comm knobs apply to the step
+            # that actually owns gradient synchronization — this one
+            comm_options = getattr(model, "comm_options", None)
+            comm_inherited = comm_options is not None
         # place parameters/buffers/opt-state once; jit then infers layouts
         # from its (donated) arguments, so placement is sticky across steps
         for p in self._params:
@@ -73,6 +89,80 @@ class DistributedTrainStep(TrainStep):
                     # over the dp axis (ref: fleet sharding strategy)
                     s = P(batch_axis)
                 st[k] = jax.device_put(v, NamedSharding(self.mesh, s))
+        if comm_options is not None:
+            try:
+                self._setup_comm(comm_options)
+            except ValueError:
+                if not comm_inherited:
+                    raise
+                # source compat: reference code passes comm_buffer_size
+                # on DataParallel for layouts (TP meshes, sharded
+                # params, scaler) the explicit exchange can't serve —
+                # there the wrapper stays the inert shim it always was
+                import warnings
+
+                warnings.warn(
+                    "DataParallel comm_buffer_size ignored: this layout "
+                    "is not pure data parallelism (or composes with a "
+                    "GradScaler); gradient sync falls back to the "
+                    "implicit GSPMD placement. Pass comm_options= to "
+                    "DistributedTrainStep explicitly to make this an "
+                    "error", RuntimeWarning)
+
+    def _setup_comm(self, options):
+        """Enable the explicit bucketed/quantized gradient exchange
+        (``dist.gradcomm``): build the bucket plan over the trainable
+        parameters in reverse order (the order the backward produces
+        their gradients) and materialize the error-feedback state under
+        reserved optimizer-accumulator keys so it is donated, carried
+        across fused windows, and checkpointed with
+        ``optimizer.state_dict()``."""
+        from . import gradcomm as gc
+
+        if options.quantize and self.scaler is not None:
+            raise ValueError(
+                "quantize='int8' cannot compose with a GradScaler: the "
+                "exchange runs on SCALED gradients, so error-feedback "
+                "residuals would be stored in loss-scale units (stale "
+                "after every scale change) and an overflow step would "
+                "quantize inf into the persistent residual. Use int8 "
+                "without dynamic loss scaling (or fp32 bucketing with "
+                "it)")
+        axes = dict(self.mesh.shape)
+        ndev = axes.get(self.batch_axis, 1)
+        if set(axes) != {self.batch_axis} or ndev < 2 or \
+                self.batch_axis != "data":
+            raise ValueError(
+                "comm-efficient gradient exchange needs a pure data-"
+                "parallel mesh over a single 'data' axis with >= 2 "
+                f"devices, got mesh axes {axes} "
+                f"(batch_axis={self.batch_axis!r})")
+        for p in self._trainable:
+            if param_spec(p) != P():
+                raise ValueError(
+                    f"comm-efficient exchange needs replicated params "
+                    f"(pure DP); {p.name} is sharded {param_spec(p)}")
+        # reverse parameter order = gradient production order in the
+        # backward: the first bucket closes over the LAST layers, whose
+        # all-reduce can overlap the rest of the backward
+        entries = [(p.name, tuple(p._data.shape), np.dtype(p._data.dtype))
+                   for p in reversed(self._trainable)]
+        self._comm = gc.plan_buckets(entries, options, ndev)
+        self._comm_mesh = self.mesh
+        keys = []
+        if options.quantize:
+            opt = self.optimizer
+            for i, b in enumerate(self._comm.buckets):
+                name = gc.EF_PREFIX + str(i)
+                if name not in opt._accumulators:
+                    opt._accumulators[name] = {"residual": jax.device_put(
+                        jnp.zeros((ndev, b.padded), jnp.float32),
+                        NamedSharding(self.mesh, P(self.batch_axis, None)))}
+                keys.append(name)
+            if gc.STEP_VAR not in opt._accumulators:
+                opt._accumulators[gc.STEP_VAR] = {"count": jnp.int32(0)}
+            keys.append(gc.STEP_VAR)
+        self._comm_state_keys = tuple(keys)
 
     def _place_batch(self, arrays):
         out = []
@@ -99,13 +189,27 @@ class DistributedTrainStep(TrainStep):
 
 
 class DataParallel:
-    """ref: paddle.DataParallel(layer). Under SPMD the wrapper is only an
-    API shim: gradient synchronization is compiled into the step, so the
-    wrapped layer behaves exactly like the original."""
+    """ref: paddle.DataParallel(layer). Under SPMD the wrapper is an API
+    shim for the forward — gradient synchronization is compiled into the
+    step — but the reference's comm knobs are now LIVE: passing
+    ``comm_buffer_size`` (MB, like the reference) attaches a
+    ``gradcomm.CommOptions`` that ``DistributedTrainStep`` picks up,
+    coalescing the per-parameter grad all-reduces into flat buckets of
+    that size (``last_comm_buffer_size`` caps the first-to-fire bucket).
+    Left at the default ``None``, behavior is exactly as before: GSPMD
+    places the all-reduces implicitly."""
 
-    def __init__(self, layers, strategy=None, comm_buffer_size=25,
-                 last_comm_buffer_size=1, find_unused_parameters=False):
+    def __init__(self, layers, strategy=None, comm_buffer_size=None,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 comm_options=None):
         self._layers = layers
+        if comm_options is None and comm_buffer_size is not None:
+            from .gradcomm import MB, CommOptions
+
+            comm_options = CommOptions(
+                bucket_bytes=int(comm_buffer_size * MB),
+                last_bucket_bytes=int(last_comm_buffer_size * MB))
+        self.comm_options = comm_options
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_layers"], name)

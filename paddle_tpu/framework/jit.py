@@ -93,20 +93,26 @@ class TrainStep:
         self._profiles = {}      # sig -> cached CollectiveProfile
         self.last_found_inf = None  # device bool after each call
         self._scaler_state = scaler.state() if scaler is not None else {}
+        # comm-efficient gradient exchange (dist.gradcomm), configured
+        # by DistributedTrainStep: a BucketPlan + its mesh, plus the
+        # reserved optimizer-state keys carrying error-feedback state
+        self._comm = None
+        self._comm_mesh = None
+        self._comm_state_keys = ()
         # materialize optimizer slots eagerly so they join the carried state
         for p in self._trainable:
             optimizer._state_for(p)
 
     # -- the pure function --------------------------------------------------
-    def _make_pure(self):
-        opt = self.optimizer
+    def _make_tape(self):
+        """The forward+backward closure: ``(param_arrs, buf_arrs, key,
+        batch, scale) -> (loss_val, grads dict, new_buf_arrs)``. Shared
+        by the plain pure step (full batch) and the comm-efficient step
+        (vmapped over the device-major batch axis)."""
         buffers = self._buffers
         trainable = self._trainable
-        t_names = [p.name for p in trainable]
-        scaler = self.scaler
 
-        def pure(param_arrs, buf_arrs, opt_state, lr, key, batch,
-                 scaler_state):
+        def tape(param_arrs, buf_arrs, key, batch, scale):
             # only TRAINABLE params are threaded as jit arguments; frozen
             # params stay bound to their concrete arrays and become XLA
             # constants in the compiled step
@@ -122,16 +128,145 @@ class TrainStep:
                     # own earlier TrainStep trace) must not be
                     # accumulated into by this backward
                     p.grad = None
-                if scaler is not None:
-                    scale = scaler_state["scale"]
+                if scale is not None:
                     (loss * Tensor(scale, _internal=True)).backward()
                 else:
                     loss.backward()
-                grads = {p.name: (p.grad._data if p.grad is not None else None)
+                grads = {p.name: (p.grad._data if p.grad is not None
+                                  else None)
                          for p in trainable}
                 new_bufs = [b._data for b in buffers]
                 loss_val = loss._data
+            return loss_val, grads, new_bufs
 
+        return tape
+
+    def _comm_local(self, tape):
+        """Comm-efficient forward+backward: reshape batch items
+        device-major, vmap the tape over the device axis (zero
+        collectives), and return per-device local grads as bucket
+        flats: ``(param_arrs, buf_arrs, key, batch, scale) ->
+        (loss_val, flats, new_bufs)``. Buffers and the loss aggregate
+        across shards (mean — rank-local BN semantics); gradients stay
+        local for the explicit exchange."""
+        plan, mesh = self._comm, self._comm_mesh
+        ndev = plan.ndev
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..dist.gradcomm import device_major
+
+        def local(param_arrs, buf_arrs, key, batch, scale):
+            batched, axes = device_major(batch, ndev, mesh)
+            if not any(ax == 0 for ax in axes):
+                shapes = [tuple(a.shape) for a in batch]
+                raise ValueError(
+                    "comm-efficient gradient exchange needs a batch arg "
+                    f"whose leading dim divides the {ndev}-device data "
+                    f"mesh (batch shapes: {shapes}); a fully replicated "
+                    "step would run the whole batch on every device")
+            # per-shard subkeys: shards must draw INDEPENDENT noise
+            # (dropout etc.), not ndev copies of one mask
+            keys = jax.lax.with_sharding_constraint(
+                jax.random.split(key, ndev),
+                NamedSharding(mesh, P("data", None)))
+            losses, grads_sh, bufs_sh = jax.vmap(
+                lambda b, k: tape(param_arrs, buf_arrs, k, list(b), scale),
+                in_axes=(axes, 0))(batched, keys)
+            denom = ndev if plan.options.gradient_scale == "mean" else 1
+            loss_val = losses.sum(0) / denom
+            locals_ = {}
+            unreached = set()
+            for p in self._trainable:
+                g = grads_sh.get(p.name)
+                if g is None:
+                    # unreached param: exchange zeros to keep the bucket
+                    # layout static, but record it (trace-time constant)
+                    # so the update is SKIPPED like the non-comm path
+                    unreached.add(p.name)
+                    g = jnp.zeros((ndev,) + tuple(p._data.shape),
+                                  jnp.float32)
+                locals_[p.name] = g.astype(jnp.float32)
+            self._comm_unreached = unreached
+            flats = plan.flatten_local(locals_)
+            new_bufs = [
+                (b.sum(0) / ndev).astype(old.dtype)
+                if jnp.issubdtype(old.dtype, jnp.floating)
+                else b[0]
+                for b, old in zip(bufs_sh, buf_arrs)]
+            return loss_val, flats, new_bufs
+
+        return local
+
+    def _comm_exchange(self, flats, opt_state, denom=None):
+        """Run the bucketed (possibly quantized) exchange over local
+        bucket flats, pulling/advancing the error-feedback state from
+        the reserved optimizer-state keys. Returns
+        ``(grads dict, comm_updates dict)``."""
+        from ..dist import gradcomm as gc
+
+        comm = self._comm
+        residuals = salt = None
+        if comm.options.quantize:
+            residuals = [opt_state[gc.EF_PREFIX + str(i)]["residual"]
+                         for i in range(comm.n_buckets)]
+            salt = opt_state[gc.STEP_VAR]["count"]
+        reduced, new_resid = gc.exchange_bucketed(
+            comm, flats, self._comm_mesh, residuals=residuals, salt=salt,
+            denom=denom)
+        grads = comm.unflatten(
+            reduced,
+            dtypes={p.name: p._data.dtype for p in self._trainable})
+        for n in getattr(self, "_comm_unreached", ()):
+            # params the backward never reached exchanged zeros (static
+            # bucket layout) but must SKIP the update, exactly like the
+            # non-comm path — a zero grad would still decay Adam moments
+            grads[n] = None
+        comm_updates = {}
+        if comm.options.quantize:
+            for i, r in enumerate(new_resid):
+                comm_updates[gc.EF_PREFIX + str(i)] = {"residual": r}
+            comm_updates[gc.STEP_VAR] = {"count": salt + 1}
+        return grads, comm_updates
+
+    def _make_pure(self):
+        opt = self.optimizer
+        buffers = self._buffers
+        trainable = self._trainable
+        t_names = [p.name for p in trainable]
+        scaler = self.scaler
+        tape = self._make_tape()
+        comm = self._comm
+        local = self._comm_local(tape) if comm is not None else None
+        apply = self._make_apply()
+
+        def pure(param_arrs, buf_arrs, opt_state, lr, key, batch,
+                 scaler_state):
+            scale = scaler_state["scale"] if scaler is not None else None
+            comm_updates = {}
+            if comm is None:
+                loss_val, grads, new_bufs = tape(param_arrs, buf_arrs,
+                                                 key, batch, scale)
+            else:
+                loss_val, flats, new_bufs = local(param_arrs, buf_arrs,
+                                                  key, batch, scale)
+                grads, comm_updates = self._comm_exchange(flats, opt_state)
+            return apply(grads, loss_val, new_bufs, param_arrs, buf_arrs,
+                         opt_state, lr, scaler_state, comm_updates)
+
+        return pure
+
+    def _make_apply(self):
+        """The post-backward half of the step — unscale/finite-check,
+        clip, optimizer update, scaler advance — as a closure over
+        *global* gradients, shared by the plain pure step and the
+        comm-efficient exchange paths."""
+        opt = self.optimizer
+        trainable = self._trainable
+        t_names = [p.name for p in trainable]
+        scaler = self.scaler
+
+        def apply(grads, loss_val, new_bufs, param_arrs, buf_arrs,
+                  opt_state, lr, scaler_state, comm_updates):
             found_inf = jnp.bool_(False)
             if scaler is not None:
                 # unscale + single fused finite-check over every grad
@@ -188,11 +323,12 @@ class TrainStep:
                             for old, new in zip(buf_arrs, new_bufs)]
             new_scaler_state = scaler.update_state(scaler_state, found_inf) \
                 if scaler is not None else scaler_state
+            out_state = {n: new_state[n] for n in t_names}
+            out_state.update(comm_updates)  # EF residuals + salt counter
             return loss_val, [new_params[n] for n in t_names], new_bufs, \
-                {n: new_state[n] for n in t_names}, new_scaler_state, \
-                found_inf
+                out_state, new_scaler_state, found_inf
 
-        return pure
+        return apply
 
     def _capture_arg_structs(self, sig, args):
         """Once per compiled shape (NOT per step): shape/dtype/sharding
@@ -228,6 +364,12 @@ class TrainStep:
         self._arg_structs[sig] = jax.tree_util.tree_map(_struct, args)
 
     def __call__(self, *batch):
+        if self._comm is not None and \
+                self._comm.options.accumulate_steps > 1:
+            raise ValueError(
+                "accumulate_steps > 1 exchanges gradients once per N "
+                "microbatches and therefore needs the fused path: call "
+                "run_fused(batches, steps=K) with K a multiple of N")
         arrays = [_as_array(b) for b in batch]
         sig = tuple((a.shape, str(a.dtype)) for a in arrays)
         if sig not in self._compiled:
@@ -237,6 +379,8 @@ class TrainStep:
         fn = self._compiled[sig]
         opt = self.optimizer
         opt_state = {p.name: opt._accumulators[p.name] for p in self._trainable}
+        for k in self._comm_state_keys:
+            opt_state[k] = opt._accumulators[k]
         param_arrs = [p._data for p in self._trainable]
         buf_arrs = [b._data for b in self._buffers]
         lr = jnp.float32(opt.get_lr())
@@ -273,6 +417,69 @@ class TrainStep:
                 summary=s if s["num_nan"] or s["num_inf"] else None)
         return Tensor(loss, _internal=True)
 
+    def _make_fused_accum(self, K, N):
+        """Fused window with gradient accumulation (comm-efficient path
+        only): a nested scan over (K/N windows, N microbatches). The
+        inner scan runs the vmapped tape and ADDS the per-device local
+        bucket flats — zero communication; the exchange + optimizer
+        update run once per window, so the all-reduce fires once per N
+        microbatches. Buffers (BN stats) evolve per microbatch through
+        the inner carry; the scaler's found-inf freeze applies to the
+        whole window (its skip decision is made on the accumulated
+        gradient)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        plan, mesh = self._comm, self._comm_mesh
+        scaler = self.scaler
+        tape = self._make_tape()
+        local = self._comm_local(tape)
+        apply = self._make_apply()
+        W = K // N
+        sh_acc = NamedSharding(mesh, P("data", None))
+
+        def fused(param_arrs, buf_arrs, opt_state, lrs, keys,
+                  stacked_batch, scaler_state):
+            def resh(x):
+                return jnp.reshape(x, (W, N) + tuple(x.shape[1:]))
+
+            def outer(carry, xs):
+                params, bufs, state, sstate = carry
+                lr_w, key_w, batch_w = xs
+                scale = sstate["scale"] if scaler is not None else None
+
+                def inner(ic, xk):
+                    accs, ibufs = ic
+                    key_k, batch_k = xk
+                    loss_k, flats, nb = local(params, ibufs, key_k,
+                                              list(batch_k), scale)
+                    return ([a + f for a, f in zip(accs, flats)], nb), \
+                        loss_k
+
+                accs0 = [jax.lax.with_sharding_constraint(
+                    jnp.zeros((plan.ndev, b.padded), jnp.float32), sh_acc)
+                    for b in plan.buckets]
+                (accs, nbufs), losses_w = jax.lax.scan(
+                    inner, (accs0, list(bufs)), (key_w, list(batch_w)))
+                # denom defaults to ndev * N: the exchanged gradient is
+                # the mean over the whole N x B effective batch
+                grads, comm_updates = self._comm_exchange(accs, state)
+                _, np_, nb_, ns_, nss_, finf = apply(
+                    grads, losses_w[-1], nbufs, params, bufs, state,
+                    lr_w[-1], sstate, comm_updates)
+                return (np_, nb_, ns_, nss_), (losses_w, finf)
+
+            (np_, nb_, ns_, nss_), (losses, finfs) = jax.lax.scan(
+                outer,
+                (list(param_arrs), list(buf_arrs), dict(opt_state),
+                 scaler_state),
+                (resh(lrs), resh(keys), [resh(b) for b in stacked_batch]))
+            # (W, N) microbatch losses -> the (K,) trajectory; the
+            # per-window found-inf flag covers each of its N microbatches
+            return (jnp.reshape(losses, (K,)), np_, nb_, ns_, nss_,
+                    jnp.repeat(finfs, N))
+
+        return fused
+
     def run_fused(self, batches, steps=None):
         """Run K microbatches through ONE fused ``lax.scan`` executable.
 
@@ -294,8 +501,12 @@ class TrainStep:
         Host-side per-step work necessarily happens at WINDOW
         granularity: the learning rate is sampled once for all K
         microbatches, ``optimizer._global_step`` advances by K at the
-        end, and with ``check_nan`` a nonfinite ANY microbatch raises
-        after the window. ``last_found_inf`` becomes the any-step flag;
+        end (it counts MICROBATCHES, matching the per-call path and the
+        journal's ``steps``, even when ``accumulate_steps=N`` means only
+        K/N optimizer updates ran — LR schedulers here key on their own
+        explicit ``scheduler.step()`` calls, not this counter), and with
+        ``check_nan`` a nonfinite ANY microbatch raises after the
+        window. ``last_found_inf`` becomes the any-step flag;
         ``last_found_inf_per_step`` keeps the per-step (K,) vector.
 
         Returns the (K,) per-microbatch loss trajectory as a Tensor.
@@ -337,25 +548,36 @@ class TrainStep:
                         f"pre-stacked batch array has shape {a.shape}; "
                         f"expected a leading microbatch axis of {K}")
             sig0 = tuple((a.shape[1:], str(a.dtype)) for a in stacked)
+        N = (self._comm.options.accumulate_steps
+             if self._comm is not None else 1)
+        if N > 1 and K % N:
+            raise ValueError(
+                f"accumulate_steps={N} must divide the fused window "
+                f"(steps={K}): partial accumulation windows would "
+                "silently change the effective batch")
         fsig = ("fused", K) + sig0
         if fsig not in self._compiled:
-            pure = self._make_pure()
+            if N == 1:
+                pure = self._make_pure()
 
-            def fused(param_arrs, buf_arrs, opt_state, lrs, keys,
-                      stacked_batch, scaler_state):
-                def body(carry, xs):
-                    params, bufs, state, sstate = carry
-                    lr, key, batch = xs
-                    loss, np_, nb_, ns_, nss_, finf = pure(
-                        params, bufs, state, lr, key, list(batch), sstate)
-                    return (np_, nb_, ns_, nss_), (loss, finf)
+                def fused(param_arrs, buf_arrs, opt_state, lrs, keys,
+                          stacked_batch, scaler_state):
+                    def body(carry, xs):
+                        params, bufs, state, sstate = carry
+                        lr, key, batch = xs
+                        loss, np_, nb_, ns_, nss_, finf = pure(
+                            params, bufs, state, lr, key, list(batch),
+                            sstate)
+                        return (np_, nb_, ns_, nss_), (loss, finf)
 
-                (np_, nb_, ns_, nss_), (losses, finfs) = jax.lax.scan(
-                    body,
-                    (list(param_arrs), list(buf_arrs), dict(opt_state),
-                     scaler_state),
-                    (lrs, keys, list(stacked_batch)), length=K)
-                return losses, np_, nb_, ns_, nss_, finfs
+                    (np_, nb_, ns_, nss_), (losses, finfs) = jax.lax.scan(
+                        body,
+                        (list(param_arrs), list(buf_arrs), dict(opt_state),
+                         scaler_state),
+                        (lrs, keys, list(stacked_batch)), length=K)
+                    return losses, np_, nb_, ns_, nss_, finfs
+            else:
+                fused = self._make_fused_accum(K, N)
 
             donate = (0, 1, 2) if self._donate else ()
             self._compiled[fsig] = jax.jit(fused, donate_argnums=donate)
@@ -363,6 +585,8 @@ class TrainStep:
         opt = self.optimizer
         opt_state = {p.name: opt._accumulators[p.name]
                      for p in self._trainable}
+        for k in self._comm_state_keys:
+            opt_state[k] = opt._accumulators[k]
         param_arrs = [p._data for p in self._trainable]
         buf_arrs = [b._data for b in self._buffers]
         # one LR sample per window; per-step keys are PRE-DRAWN from the

@@ -514,6 +514,8 @@ class RunJournal:
                     comm = {
                         "total_bytes": prof["total_bytes"],
                         "wire_bytes": prof["wire_bytes"],
+                        "quant_wire_bytes":
+                            prof.get("quant_wire_bytes", 0),
                         "all_reduce_bytes":
                             prof["bytes"].get("all-reduce", 0),
                         "n_ops": prof["n_ops"],

@@ -77,6 +77,11 @@ _OP_RE = re.compile(
     r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
     r"(-start|-done)?\(")
 _SHAPE_RE = re.compile(r"(\w+)\[([0-9,]*)\]")
+
+# sub-byte/byte integer payloads = a quantized exchange is on the wire
+# (dist.gradcomm int8 all-reduce, int4 weight gathers); bf16/f16 are
+# reduced-precision but not "quantized" in this accounting
+_QUANT_DTYPES = frozenset(("s8", "u8", "s4", "u4"))
 _GROUPS_RE = re.compile(r"replica_groups=(\{\{[^}]*(?:\},\{[^}]*)*\}\}|"
                         r"\[[0-9,]+\]<=\[[0-9,]+\](?:T\([0-9,]+\))?)")
 _PAIRS_RE = re.compile(r"source_target_pairs=\{([^}]*(?:\},\{[^}]*)*)\}")
@@ -120,6 +125,15 @@ def _shape_bytes(type_str, kind=None, is_async=False):
             return min(tensors)
         return max(sizes)
     return sum(sizes)
+
+
+def _is_quantized(type_str):
+    """Whether the op's tensor payload is integer-quantized (s8/u8/
+    s4/u4): every non-scalar element of the result type is a quantized
+    dtype. Scalar elements (async context tokens) are ignored; an op
+    with no non-scalar payload is not quantized."""
+    dts = [dt for dt, dims in _SHAPE_RE.findall(type_str) if dims]
+    return bool(dts) and all(dt in _QUANT_DTYPES for dt in dts)
 
 
 def _iota_groups(spec):
@@ -236,6 +250,7 @@ def parse_hlo_collectives(hlo_text, mesh=None):
             "group_size": gsize,
             "n_groups": len(groups) if groups else None,
             "axes": _attribute_axes(groups, axes, ids),
+            "quant": _is_quantized(type_str),
         })
     return ops
 
@@ -247,12 +262,16 @@ def collective_profile(hlo_text, mesh=None):
     executable (one training step for an Executor entry)."""
     ops = parse_hlo_collectives(hlo_text, mesh=mesh)
     counts, bytes_, by_axis = {}, {}, {}
-    wire = 0.0
+    wire = quant = quant_wire = 0.0
     for op in ops:
         k = op["kind"]
         counts[k] = counts.get(k, 0) + 1
         bytes_[k] = bytes_.get(k, 0) + op["bytes"]
-        wire += op["bytes"] * _WIRE_FACTOR[k](op["group_size"])
+        w = op["bytes"] * _WIRE_FACTOR[k](op["group_size"])
+        wire += w
+        if op.get("quant"):
+            quant += op["bytes"]
+            quant_wire += w
         ax = op["axes"] or "?"
         by_axis[ax] = by_axis.get(ax, 0) + op["bytes"]
     return {
@@ -261,6 +280,11 @@ def collective_profile(hlo_text, mesh=None):
         "bytes": bytes_,
         "total_bytes": sum(bytes_.values()),
         "wire_bytes": int(round(wire)),
+        # the integer-payload (s8/u8/s4/u4) share of the above — the
+        # dist.gradcomm int8 exchange's wire footprint, rendered as the
+        # shard_report roofline's "quantized wire bytes" column
+        "quant_bytes": int(round(quant)),
+        "quant_wire_bytes": int(round(quant_wire)),
         "by_axis": by_axis,
     }
 
@@ -272,11 +296,14 @@ def merge_profiles(profiles):
     if not profiles:
         return None
     out = {"n_ops": 0, "counts": {}, "bytes": {}, "total_bytes": 0,
-           "wire_bytes": 0, "by_axis": {}}
+           "wire_bytes": 0, "quant_bytes": 0, "quant_wire_bytes": 0,
+           "by_axis": {}}
     for p in profiles:
         out["n_ops"] += p.get("n_ops", 0)
         out["total_bytes"] += p.get("total_bytes", 0)
         out["wire_bytes"] += p.get("wire_bytes", 0)
+        out["quant_bytes"] += p.get("quant_bytes", 0)
+        out["quant_wire_bytes"] += p.get("quant_wire_bytes", 0)
         for field in ("counts", "bytes", "by_axis"):
             for k, v in (p.get(field) or {}).items():
                 out[field][k] = out[field].get(k, 0) + v

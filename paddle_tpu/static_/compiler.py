@@ -32,6 +32,13 @@ class BuildStrategy:
         self.sync_batch_norm = False  # inert: BN stats ride the program
         self.num_trainers = 1
         self.trainer_id = 0
+        # LIVE (the one exception to the inert rule above): a
+        # dist.gradcomm.CommOptions here switches the executor onto the
+        # explicit comm-efficient gradient exchange — bucketed /
+        # accumulated / quantized all-reduce instead of GSPMD's
+        # one-all-reduce-per-parameter placement. None keeps the
+        # implicit path.
+        self.comm_options = None
 
 
 class ExecutionStrategy:
@@ -55,18 +62,29 @@ class CompiledProgram:
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
-                           places=None):
+                           places=None, comm_options=None):
         """Mark this program for SPMD data parallelism: the Executor will
         shard the feed batch axis over all local devices and keep
         persistables replicated; since it is ONE logical program over the
         global batch, the loss/grads match a single-device run of the same
-        global batch (no explicit grad averaging needed)."""
+        global batch (no explicit grad averaging needed).
+
+        ``comm_options`` (a ``dist.gradcomm.CommOptions``, or set on
+        ``build_strategy.comm_options``) opts into the comm-efficient
+        gradient exchange: per-parameter grad all-reduces coalesced into
+        size-bounded flat buckets, optional once-per-N-microbatches
+        accumulation inside fused ``run_steps`` windows, and an optional
+        int8-quantized exchange with error feedback. The fp32 bucketed
+        path is bitwise-stable vs the implicit GSPMD placement on
+        power-of-two meshes (see dist/gradcomm.py)."""
         self._data_parallel = True
         self._loss_name = loss_name
         if build_strategy is not None:
             self._build_strategy = build_strategy
         if exec_strategy is not None:
             self._exec_strategy = exec_strategy
+        if comm_options is not None:
+            self._build_strategy.comm_options = comm_options
         # a DP-transformed program compiles as ONE SPMD executable; verify
         # its structure now so graph bugs surface at with_data_parallel
         # (where the reference's SSA-graph build would have failed) rather

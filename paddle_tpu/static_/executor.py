@@ -50,6 +50,12 @@ class CacheKey:
     count K for fused ``lax.scan`` entries (``Executor.run_steps``) —
     the same program at the same feed shapes compiles to a different
     executable per K, so K is a genuine cache axis.
+
+    ``comm`` is ``None`` for the implicit-GSPMD data-parallel path and
+    ``CommOptions.cache_axis()`` for comm-efficient entries
+    (``dist.gradcomm``): bucket layout / accumulation / quantization
+    each change the compiled exchange, so they key distinct
+    executables.
     """
 
     program_uid: int
@@ -61,6 +67,7 @@ class CacheKey:
     steps: int | None
     data_parallel: bool
     allow_replicated_fallback: bool
+    comm: tuple | None = None
 
 
 class _Compiled:
@@ -107,6 +114,24 @@ class Executor:
 
     # -- program -> pure function ------------------------------------------
     @staticmethod
+    def _run_ops(env, ops, amp_cast):
+        """Replay one op list over a name->array environment (the core
+        interpreter loop, shared by the whole-program replay and the
+        comm-efficient split replay)."""
+        for op in ops:
+            args = [env[n] if n is not None else None
+                    for n in op.input_names]
+            if amp_cast is not None:
+                args = amp_cast(op.type, args)
+            out = op.fn(*args, **op.attrs)
+            if isinstance(out, tuple):
+                for name, o in zip(op.output_names, out):
+                    env[name] = o
+            else:
+                env[op.output_names[0]] = out
+        return env
+
+    @staticmethod
     def _replay_fn(program, ops, feed_names, updated_names, frozen_names,
                    fetch_names):
         ops = list(ops)
@@ -118,21 +143,269 @@ class Executor:
             env.update(zip(feed_names, feeds))
             env.update(zip(updated_names, updated))
             env.update(zip(frozen_names, frozen))
-            for op in ops:
-                args = [env[n] if n is not None else None
-                        for n in op.input_names]
-                if amp_cast is not None:
-                    args = amp_cast(op.type, args)
-                out = op.fn(*args, **op.attrs)
-                if isinstance(out, tuple):
-                    for name, o in zip(op.output_names, out):
-                        env[name] = o
-                else:
-                    env[op.output_names[0]] = out
+            Executor._run_ops(env, ops, amp_cast)
             return ([env[n] for n in fetch_names],
                     [env[n] for n in updated_names])
 
         return fn
+
+    def _comm_raw(self, program, ops, feed_names, fetch_names, shapes,
+                  updated, frozen, steps, comm, mesh, scope, blk):
+        """Comm-efficient data-parallel replay (``dist.gradcomm``).
+
+        Instead of replaying the whole program under implicit GSPMD
+        (one all-reduce per parameter gradient, placed by the
+        partitioner), the op list is split at the backward/update
+        boundary: the forward+backward segment runs under ``jax.vmap``
+        over an explicit device-major batch axis — embarrassingly
+        parallel, zero collectives — producing every gradient as an
+        ``(ndev, ...)`` tensor of per-device partial sums; the exchange
+        (bucketed / accumulated / int8-quantized all-reduce) is then
+        explicit jax code; the update segment runs once on the reduced
+        global gradients. Returns ``(raw_fn, state_var_names, plan,
+        handles_steps)`` — ``handles_steps`` means the fn already
+        consumes the whole stacked ``(K, ...)`` window (the
+        accumulate_steps > 1 nested-scan form) and must not be wrapped
+        in the generic single-level scan.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..dist import gradcomm as gc
+
+        ndev = int(np.prod(mesh.devices.shape))
+        N = int(comm.accumulate_steps)
+        if N > 1:
+            if not steps:
+                raise ValueError(
+                    f"accumulate_steps={N} needs the fused path: drive "
+                    "the program through Executor.run_steps(steps=K) so "
+                    "accumulation lives inside the scan body")
+            if int(steps) % N:
+                raise ValueError(
+                    f"accumulate_steps={N} must divide the fused window "
+                    f"(steps={steps}): partial accumulation windows "
+                    "would silently change the effective batch")
+        persist_set = set(updated) | set(frozen)
+        comp_ops, update_ops, cross = gc.split_update_segment(ops)
+        if comm.quantize and any(op.type.startswith("amp_")
+                                 for op in update_ops):
+            raise ValueError(
+                "quantize='int8' cannot compose with AMP dynamic loss "
+                "scaling: the exchange runs on SCALED gradients, so "
+                "error-feedback residuals would live in loss-scale "
+                "units and an overflow step would quantize inf into "
+                "the persistent residual")
+        cross = [n for n in cross if n not in persist_set
+                 and n not in program._constants]
+        if not cross:
+            raise ValueError(
+                "comm-efficient DP found no gradients crossing the "
+                "backward/update boundary — nothing to exchange")
+        grad_dtypes = {n: blk.var(n)._data.dtype for n in cross}
+        plan = gc.plan_buckets(
+            [(n, tuple(blk.var(n)._data.shape), np.dtype(grad_dtypes[n]))
+             for n in cross], comm, ndev)
+
+        # which feeds carry the batch axis (shapes are per-step even on
+        # the fused path — same rule as feed_sharding below)
+        vmap_feed = [len(s) >= 1 and s[0] > 0 and s[0] % ndev == 0
+                     for s, _ in shapes]
+        if shapes and not any(vmap_feed):
+            dims = {n: s for (s, _), n in zip(shapes, feed_names)}
+            raise ValueError(
+                f"comm-efficient DP needs a feed whose leading dim "
+                f"divides the {ndev}-device data mesh (feed shapes: "
+                f"{dims}); there is no gradient exchange to optimize on "
+                "a fully replicated step")
+
+        comp_written = set()
+        for op in comp_ops:
+            comp_written.update(op.output_names)
+        comp_persist = [n for n in updated if n in comp_written]
+        comp_fetches = [n for n in fetch_names if n in comp_written]
+        if N > 1:
+            bad = [n for n in fetch_names if n not in comp_written]
+            if bad:
+                raise ValueError(
+                    f"accumulate_steps={N} needs per-microbatch fetches, "
+                    f"but {bad} come from the once-per-window update "
+                    "segment (fetch forward/backward values instead)")
+
+        consts = dict(program._constants)
+        amp_cast = _amp_cast_fn(getattr(program, "_amp_cfg", None))
+        need = list(dict.fromkeys(cross + comp_fetches + comp_persist))
+
+        # -- exchange state (quantized path): per-bucket error-feedback
+        # residuals + the stochastic-rounding counter, as @comm@*
+        # persistables so they ride the donated carry, checkpoints, and
+        # the elastic ProgramStateAdapter like any other training state
+        state_names = []
+        if comm.quantize:
+            for i, b in enumerate(plan.buckets):
+                name = gc.EF_PREFIX + str(i)
+                ex = blk.vars.get(name)
+                if ex is None or tuple(ex._data.shape) != (ndev, b.padded):
+                    blk.vars.pop(name, None)
+                    blk.create_var(name=name, shape=(ndev, b.padded),
+                                   dtype="float32", persistable=True)
+                    scope.set(name, jax.device_put(
+                        jnp.zeros((ndev, b.padded), jnp.float32),
+                        NamedSharding(mesh, P("data", None))))
+                elif scope.find_var(name) is None:
+                    scope.set(name, jax.device_put(
+                        jnp.zeros((ndev, b.padded), jnp.float32),
+                        NamedSharding(mesh, P("data", None))))
+                state_names.append(name)
+            # drop leftovers from a previously different bucket layout
+            j = plan.n_buckets
+            while blk.vars.pop(gc.EF_PREFIX + str(j), None) is not None:
+                j += 1
+            if not blk.has_var(gc.STEP_VAR):
+                blk.create_var(name=gc.STEP_VAR, shape=(), dtype="int32",
+                               persistable=True)
+            if scope.find_var(gc.STEP_VAR) is None:
+                scope.set(gc.STEP_VAR, jnp.int32(0))
+            state_names.append(gc.STEP_VAR)
+        n_base = len(updated)
+
+        def comp_shard(feed_vals, upd_vals, frz_vals):
+            env = dict(consts)
+            env.update(zip(feed_names, feed_vals))
+            env.update(zip(updated, upd_vals))
+            env.update(zip(frozen, frz_vals))
+            Executor._run_ops(env, comp_ops, amp_cast)
+            return [env[n] for n in need]
+
+        def vm_comp(feed_vals, upd_vals, frz_vals):
+            """Reshape batch feeds device-major and vmap the
+            forward+backward over the device axis."""
+            batched, axes = gc.device_major(feed_vals, ndev, mesh,
+                                            batch_flags=vmap_feed)
+            outs = jax.vmap(
+                lambda fv: comp_shard(fv, upd_vals, frz_vals),
+                in_axes=(axes,))(batched)
+            return dict(zip(need, outs))
+
+        def aggregate(name, val):
+            """Per-shard (ndev, ...) value -> global value: batch-shaped
+            vars concatenate back to the full batch (exact); batch-
+            reduced floats average across shards (the loss under a
+            mean-type loss; rank-local-BN-style stats), integers sum."""
+            lshape = tuple(blk.var(name)._data.shape)
+            if val.ndim >= 2 and \
+                    (val.shape[1] * ndev,) + tuple(val.shape[2:]) == lshape:
+                return jnp.reshape(
+                    val, (val.shape[1] * ndev,) + tuple(val.shape[2:]))
+            red = val.sum(0)
+            if jnp.issubdtype(val.dtype, jnp.floating) and \
+                    comm.gradient_scale == "mean":
+                red = red / ndev
+            return red
+
+        def flatten_cross(pershard):
+            return plan.flatten_local(
+                {n: pershard[n].astype(jnp.float32) for n in cross})
+
+        def run_update(env, reduced, state, pershard_persist,
+                       pershard_fetches):
+            """The once-per-exchange tail: install aggregated comp
+            values + reduced global grads, replay the update segment,
+            advance the exchange state."""
+            globals_ = plan.unflatten(reduced, dtypes=grad_dtypes)
+            env.update(pershard_persist)
+            env.update(pershard_fetches)
+            env.update(globals_)
+            Executor._run_ops(env, update_ops, amp_cast)
+            if comm.quantize:
+                new_resid, step_ctr = state
+                new_state = list(new_resid) + [step_ctr + 1]
+            else:
+                new_state = []
+            return env, new_state
+
+        if N == 1:
+            def raw(feeds, upd_all, frz_vals):
+                upd_vals = list(upd_all[:n_base])
+                state = list(upd_all[n_base:])
+                residuals = state[:-1] if comm.quantize else None
+                salt = state[-1] if comm.quantize else None
+                pershard = vm_comp(feeds, upd_vals, frz_vals)
+                reduced, new_resid = gc.exchange_bucketed(
+                    plan, flatten_cross(pershard), mesh,
+                    residuals=residuals, salt=salt)
+                env = dict(consts)
+                env.update(zip(feed_names, feeds))
+                env.update(zip(updated, upd_vals))
+                env.update(zip(frozen, frz_vals))
+                env, new_state = run_update(
+                    env, reduced, (new_resid, salt),
+                    {n: aggregate(n, pershard[n]) for n in comp_persist},
+                    {n: aggregate(n, pershard[n]) for n in comp_fetches})
+                return ([env[n] for n in fetch_names],
+                        [env[n] for n in updated] + new_state)
+
+            return raw, tuple(state_names), plan, False
+
+        # -- accumulate_steps > 1: nested scan over (K/N, N) windows.
+        # The inner scan accumulates LOCAL per-device bucket partials
+        # (zero communication); the exchange + update segment run once
+        # per window, so the all-reduce fires once per N microbatches.
+        K, W = int(steps), int(steps) // N
+
+        def raw(stacked_feeds, upd_all, frz_vals):
+            resh = [jnp.reshape(f, (W, N) + tuple(f.shape[1:]))
+                    for f in stacked_feeds]
+
+            def outer(carry, feeds_w):
+                base, state = carry
+                residuals = state[:-1] if comm.quantize else None
+                salt = state[-1] if comm.quantize else None
+
+                def inner(ic, feeds_k):
+                    accs, pvals = ic
+                    upd_cur = list(base)
+                    for idx, n in enumerate(updated):
+                        if n in comp_persist:
+                            upd_cur[idx] = pvals[comp_persist.index(n)]
+                    pershard = vm_comp(list(feeds_k), upd_cur, frz_vals)
+                    accs = [a + f for a, f in
+                            zip(accs, flatten_cross(pershard))]
+                    new_pvals = [aggregate(n, pershard[n])
+                                 for n in comp_persist]
+                    fvals = [aggregate(n, pershard[n])
+                             for n in fetch_names]
+                    return (accs, new_pvals), fvals
+
+                accs0 = [jax.lax.with_sharding_constraint(
+                    jnp.zeros((ndev, b.padded), jnp.float32),
+                    NamedSharding(mesh, P("data", None)))
+                    for b in plan.buckets]
+                pvals0 = [base[list(updated).index(n)]
+                          for n in comp_persist]
+                (accs, pvalsN), fetch_ys = jax.lax.scan(
+                    inner, (accs0, pvals0), list(feeds_w))
+                reduced, new_resid = gc.exchange_bucketed(
+                    plan, accs, mesh, residuals=residuals, salt=salt)
+                env = dict(consts)
+                # update-segment feeds (e.g. @lr) take the window's last
+                # microbatch row — the executor broadcast them over K
+                env.update(zip(feed_names, [f[-1] for f in feeds_w]))
+                env.update(zip(updated, base))
+                env.update(zip(frozen, frz_vals))
+                env, new_state = run_update(
+                    env, reduced, (new_resid, salt),
+                    dict(zip(comp_persist, pvalsN)), {})
+                return ([env[n] for n in updated], new_state), fetch_ys
+
+            upd_vals = list(upd_all[:n_base])
+            state0 = list(upd_all[n_base:])
+            (base_f, state_f), ys = jax.lax.scan(
+                outer, (upd_vals, state0), resh)
+            fetches = [jnp.reshape(y, (K,) + tuple(y.shape[2:]))
+                       for y in ys]
+            return fetches, list(base_f) + list(state_f)
+
+        return raw, tuple(state_names), plan, True
 
     @staticmethod
     def _data_mesh():
@@ -149,7 +422,7 @@ class Executor:
 
     def _compile(self, program, feed, fetch_list, data_parallel=False,
                  allow_replicated_fallback=False, optimize_level=None,
-                 steps=None):
+                 steps=None, comm_options=None):
         from ..analysis import normalize_fetch
 
         if optimize_level is None:
@@ -174,7 +447,8 @@ class Executor:
             fetch_names=fetch_names, optimize_level=int(optimize_level),
             steps=None if steps is None else int(steps),
             data_parallel=bool(data_parallel),
-            allow_replicated_fallback=bool(allow_replicated_fallback))
+            allow_replicated_fallback=bool(allow_replicated_fallback),
+            comm=None if comm_options is None else comm_options.cache_axis())
         if key in self._cache:
             compiled = self._cache[key]
             # coherence: uid+version are in the key, so a hit is the right
@@ -199,7 +473,7 @@ class Executor:
             compiled = self._build(program, feed_names, fetch_names, shapes,
                                    fetch_list, data_parallel,
                                    allow_replicated_fallback, optimize_level,
-                                   steps=steps)
+                                   steps=steps, comm_options=comm_options)
         # NOTE: jax.jit is lazy — this times trace-side work (analysis
         # passes + jit wrapper construction); XLA's own compile lands in
         # the first executor.run_ms sample for this key
@@ -222,14 +496,19 @@ class Executor:
 
     def _build(self, program, feed_names, fetch_names, shapes, fetch_list,
                data_parallel, allow_replicated_fallback, optimize_level,
-               steps=None):
+               steps=None, comm_options=None):
         from ..analysis import run_compile_passes
 
         scope = global_scope()
         blk = program.global_block
         persist_in = tuple(
             v.name for v in blk.vars.values()
-            if v.persistable and scope.find_var(v.name) is not None)
+            if v.persistable and scope.find_var(v.name) is not None
+            and not v.name.startswith("@comm@"))
+        # @comm@* exchange state (dist.gradcomm error-feedback residuals
+        # + rounding counter) is managed below: it must never ride the
+        # generic persistable lists (a second compile would list it as
+        # frozen AND updated)
 
         # -- analysis: verify always, optimize behind optimize_level --------
         # (raises ProgramVerificationError with coded, op-anchored
@@ -248,9 +527,21 @@ class Executor:
         updated = tuple(n for n in persist_in if n in written)
         frozen = tuple(n for n in persist_in if n not in written)
 
-        raw = self._replay_fn(program, ops, feed_names, updated, frozen,
-                              fetch_names)
-        if steps:
+        comm_state = ()
+        comm_handles_steps = False
+        if comm_options is not None:
+            if not data_parallel:
+                raise ValueError(
+                    "comm_options requires a data-parallel program "
+                    "(CompiledProgram.with_data_parallel)")
+            raw, comm_state, comm_plan, comm_handles_steps = self._comm_raw(
+                program, ops, feed_names, fetch_names, shapes, updated,
+                frozen, steps, comm_options, self._data_mesh(), scope, blk)
+            updated = updated + comm_state
+        else:
+            raw = self._replay_fn(program, ops, feed_names, updated,
+                                  frozen, fetch_names)
+        if steps and not comm_handles_steps:
             # fused multi-step path: drive K microbatches through ONE
             # lax.scan — the step body lowers once, the persistables ride
             # as the (donated) carry, stacked feeds are the scan xs, and
@@ -317,9 +608,19 @@ class Executor:
                     f"divisible by {ndev} devices: running fully "
                     "replicated (no DP speedup)", RuntimeWarning)
 
-            in_sh = (feed_sh,
-                     [rep] * len(updated), [rep] * len(frozen))
-            out_sh = ([rep] * len(fetch_names), [rep] * len(updated))
+            def persist_sharding(name):
+                # comm-exchange residuals are PER-DEVICE state: row d is
+                # device d's error feedback — replicating them would
+                # both waste HBM and gather what is semantically local
+                from ..dist.gradcomm import EF_PREFIX
+
+                if name.startswith(EF_PREFIX):
+                    return NamedSharding(mesh, P("data", None))
+                return rep
+
+            upd_sh = [persist_sharding(n) for n in updated]
+            in_sh = (feed_sh, upd_sh, [rep] * len(frozen))
+            out_sh = ([rep] * len(fetch_names), upd_sh)
             jit_fn = jax.jit(raw, donate_argnums=(1,), in_shardings=in_sh,
                              out_shardings=out_sh)
         else:
@@ -345,6 +646,8 @@ class Executor:
         compiled.diagnostics = report
         compiled.optimize_level = int(optimize_level)
         compiled.steps = None if steps is None else int(steps)
+        compiled.comm_options = comm_options
+        compiled.comm_plan = comm_plan if comm_options is not None else None
         # shape/dtype-only arg structs (no device data): what the lazy
         # per-entry memory/FLOP attribution (obs.mfu.entry_analysis) and
         # the journal's MFU accounting re-lower against on demand. Fused
@@ -437,24 +740,28 @@ class Executor:
     def _unwrap_program(program):
         """CompiledProgram / transpiled-DP normalization shared by run
         and run_steps: returns (program, data_parallel,
-        allow_replicated_fallback)."""
+        allow_replicated_fallback, comm_options)."""
         from .compiler import CompiledProgram
 
         if program is None:
             program = default_main_program()
         data_parallel = False
         allow_replicated_fallback = False
+        comm_options = None
         if isinstance(program, CompiledProgram):
             data_parallel = program._data_parallel
             allow_replicated_fallback = getattr(
                 program._exec_strategy, "allow_replicated_fallback", False)
+            comm_options = getattr(program._build_strategy, "comm_options",
+                                   None)
             program = program._program
         if getattr(program, "_transpiled_dp", False):
             # fluid.transpiler.collective.GradAllReduce marked this
             # program: run it data-parallel (same SPMD path as
             # CompiledProgram.with_data_parallel)
             data_parallel = True
-        return program, data_parallel, allow_replicated_fallback
+        return program, data_parallel, allow_replicated_fallback, \
+            comm_options
 
     @staticmethod
     def _materialize_fetches(fetches, return_numpy, fetch_async):
@@ -493,7 +800,7 @@ class Executor:
         caller pays the sync when it first reads a value (or via
         ``jax.block_until_ready``). Overrides ``return_numpy``.
         """
-        program, data_parallel, allow_replicated_fallback = \
+        program, data_parallel, allow_replicated_fallback, comm_options = \
             self._unwrap_program(program)
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -513,7 +820,7 @@ class Executor:
             compiled = self._compile(
                 program, feed, fetch_list, data_parallel=data_parallel,
                 allow_replicated_fallback=allow_replicated_fallback,
-                optimize_level=optimize_level)
+                optimize_level=optimize_level, comm_options=comm_options)
             if _chaos.ACTIVE:  # disabled => one empty-dict test, no host sync
                 _chaos.fire("transient_execute")
                 feed = _chaos.fire("nan_feed", feed)
@@ -562,7 +869,7 @@ class Executor:
         (numpy by default; lazy/async under ``return_numpy=False`` /
         ``fetch_async=True`` as in ``run``).
         """
-        program, data_parallel, allow_replicated_fallback = \
+        program, data_parallel, allow_replicated_fallback, comm_options = \
             self._unwrap_program(program)
         fetch_list = fetch_list or []
         scope = scope or global_scope()
@@ -637,7 +944,8 @@ class Executor:
             compiled = self._compile(
                 program, per_step, fetch_list, data_parallel=data_parallel,
                 allow_replicated_fallback=allow_replicated_fallback,
-                optimize_level=optimize_level, steps=K)
+                optimize_level=optimize_level, steps=K,
+                comm_options=comm_options)
             if _chaos.ACTIVE:  # window-granularity chaos (one fused step)
                 _chaos.fire("transient_execute")
                 stacked = _chaos.fire("nan_feed", stacked)
@@ -666,7 +974,8 @@ class Executor:
     # the program's exact feed shapes and ONE compiled executable
     # consumes them (thread/debug accepted for source compat).
     def _run_from_dataset(self, program, dataset, scope, fetch_list,
-                          fetch_info, print_period, fetch_handler):
+                          fetch_info, print_period, fetch_handler,
+                          steps_per_dispatch=None):
         if dataset is None:
             raise ValueError("dataset is required (build one with "
                              "fluid.DatasetFactory().create_dataset())")
@@ -678,6 +987,11 @@ class Executor:
                 "asserts equal lengths)")
         names = list(fetch_info) if fetch_info else [
             getattr(v, "name", str(v)) for v in fetch_list]
+        K = int(steps_per_dispatch or 0)
+        if K > 1:
+            return self._run_from_dataset_fused(
+                program, dataset, scope, fetch_list, names, K,
+                print_period, fetch_handler)
         last = None
         for step, feed in enumerate(dataset.iter_batches()):
             last = self.run(program, feed=feed, fetch_list=fetch_list,
@@ -689,6 +1003,11 @@ class Executor:
                 print(f"[step {step + 1}] {msg}")
             if fetch_handler is not None and last is not None:
                 fetch_handler.handler(dict(zip(names, last)))
+        self._warn_dropped(dataset)
+        return last
+
+    @staticmethod
+    def _warn_dropped(dataset):
         dropped = getattr(dataset, "last_dropped", 0)
         if dropped:
             import warnings
@@ -699,20 +1018,125 @@ class Executor:
                 f"concrete feed shapes. Pad the data to a multiple of "
                 f"batch_size={dataset.batch_size} to consume every "
                 "sample", RuntimeWarning)
+
+    def _run_from_dataset_fused(self, program, dataset, scope, fetch_list,
+                                names, K, print_period, fetch_handler):
+        """``steps_per_dispatch=K``: drive fused ``run_steps`` windows
+        straight from the data pipeline — the reachable-from-the-loader
+        form of the fused path (no hand-stacked feeds). The FIRST window
+        runs from host batches and compiles the fused entry; every later
+        batch then streams through a ``DevicePrefetcher`` seeded with
+        that entry's committed feed shardings
+        (``executor_feed_shardings``), so host->device transfers overlap
+        the previous window's compute and DP batches land pre-sharded. A
+        tail of fewer than K batches falls back to per-step ``run()``
+        (one extra compile, every sample consumed). ``fetch_handler``
+        and the ``print_period`` log fire once per WINDOW on the stacked
+        fetches (last microbatch shown), matching run_steps' fetch
+        shape; returns the last window's stacked fetches."""
+        import itertools
+
+        from ..io_.dataloader import (DevicePrefetcher,
+                                      executor_feed_shardings)
+
+        prog, _, _, comm_options = self._unwrap_program(program)
+        accum = int(getattr(comm_options, "accumulate_steps", 1) or 1)
+        it = iter(dataset.iter_batches())
+        last = None
+        step = 0
+
+        def run_window(window):
+            nonlocal last, step
+            last = self.run_steps(program, feeds=window,
+                                  fetch_list=fetch_list, scope=scope)
+            step += len(window)
+            if fetch_list and print_period and \
+                    step // print_period > (step - len(window)) \
+                    // print_period:
+                msg = ", ".join(
+                    f"{n}={np.asarray(v)[-1].ravel()[:4]}"
+                    for n, v in zip(names, last))
+                print(f"[step {step}] {msg}")
+            if fetch_handler is not None and last is not None:
+                fetch_handler.handler(dict(zip(names, last)))
+
+        def run_tail(feeds):
+            nonlocal last, step
+            if accum > 1:
+                # the per-step run() rejects accumulation by design, so
+                # a ragged tail runs as one SMALLER fused window (extra
+                # compile) covering the whole accumulation multiples;
+                # the remainder is dropped with a warning — exchanging
+                # a partial window would silently change the effective
+                # batch
+                usable = len(feeds) - len(feeds) % accum
+                if usable:
+                    last = self.run_steps(program, feeds=feeds[:usable],
+                                          fetch_list=fetch_list,
+                                          scope=scope)
+                    step += usable
+                if len(feeds) - usable:
+                    import warnings
+
+                    warnings.warn(
+                        f"train_from_dataset dropped {len(feeds) - usable}"
+                        f" tail batch(es): accumulate_steps={accum} "
+                        "exchanges whole N-microbatch windows only",
+                        RuntimeWarning)
+                return
+            for feed in feeds:
+                last = self.run(program, feed=feed,
+                                fetch_list=fetch_list, scope=scope)
+                step += 1
+
+        first = list(itertools.islice(it, K))
+        if len(first) < K:
+            run_tail(first)
+            self._warn_dropped(dataset)
+            return last
+        run_window(first)
+        entry = None
+        for key, compiled in self._cache.items():
+            if key.program_uid == prog._uid and key.steps == K:
+                entry = compiled  # newest matching fused entry wins
+        pf = DevicePrefetcher(
+            it, shardings=(executor_feed_shardings(entry)
+                           if entry is not None else None),
+            depth=K + 1)
+        try:
+            while True:
+                window = list(itertools.islice(pf, K))
+                if len(window) < K:
+                    run_tail(window)
+                    break
+                run_window(window)
+        finally:
+            pf.shutdown()
+        self._warn_dropped(dataset)
         return last
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           fetch_handler=None):
+                           fetch_handler=None, steps_per_dispatch=None):
         """Run ``dataset`` through ``program`` batch by batch
         (ref executor.py:1436); a ragged final batch is dropped WITH a
         RuntimeWarning (static feed shapes are concrete). Returns the
         last fetch values (the reference returns None; returning the
-        fetches is strictly more useful and costs nothing)."""
+        fetches is strictly more useful and costs nothing).
+
+        ``steps_per_dispatch=K`` (no reference analog) switches the loop
+        onto the fused multi-step path: K dataset batches per compiled
+        ``lax.scan`` dispatch (``run_steps``), with batches prefetched
+        to the device — pre-sharded for DP programs — while the previous
+        window computes. With a comm-efficient DP program
+        (``with_data_parallel(comm_options=...)``), an
+        ``accumulate_steps=N`` exchange fires once per N microbatches
+        INSIDE these windows (K must be a multiple of N)."""
         return self._run_from_dataset(program, dataset, scope, fetch_list,
                                       fetch_info, print_period,
-                                      fetch_handler)
+                                      fetch_handler,
+                                      steps_per_dispatch=steps_per_dispatch)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

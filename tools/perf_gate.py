@@ -76,6 +76,23 @@ _INSTR_RE = re.compile(
     r"=\s*(\([^)]*\)|[a-z][a-z0-9]*\[[0-9,]*\](?:\{[^}]*\})?)\s*"
     r"([a-z][a-z0-9-]*)\(")
 
+# a NAMED instruction inside a computation body — the schedule-order
+# parse for overlap checks needs the %name to pair -start with -done
+_NAMED_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*"
+    r"(\([^)]*\)|[a-z][a-z0-9]*\[[0-9,]*\](?:\{[^}]*\})?)\s*"
+    r"([a-z][a-z0-9-]*)\((.*)$")
+
+# ops that represent real device compute for overlap purposes (an
+# all-reduce separated from its -done only by bitcasts/copies hides
+# nothing)
+COMPUTE_OPS = frozenset(("fusion", "dot", "convolution", "reduce",
+                         "while", "scatter", "sort"))
+
+_SYNC_COLLECTIVES = frozenset(("all-reduce", "all-gather",
+                               "reduce-scatter", "all-to-all",
+                               "collective-permute"))
+
 
 def _alias_attr(hlo_text):
     """The raw ``input_output_alias={...}`` attribute body of the entry
@@ -126,6 +143,80 @@ def op_counts(hlo_text, kinds=None):
     return {k: counts.get(k, 0) for k in kinds}
 
 
+def schedule_ops(hlo_text):
+    """The ENTRY computation's instruction sequence as ordered
+    ``(name, kind, args)`` tuples. Optimized HLO is emitted
+    ``is_scheduled=true``, so textual order IS the execution schedule —
+    the property the overlap gate reasons over. Falls back to the whole
+    text when no ENTRY block is present (canned single-computation
+    fixtures)."""
+    lines = hlo_text.splitlines()
+    start = next((i for i, ln in enumerate(lines)
+                  if ln.lstrip().startswith("ENTRY ")), None)
+    if start is not None:
+        block = []
+        for ln in lines[start + 1:]:
+            if ln.strip() == "}":
+                break
+            block.append(ln)
+        lines = block
+    out = []
+    for ln in lines:
+        m = _NAMED_INSTR_RE.match(ln)
+        if m is not None:
+            out.append((m.group(1), m.group(3), m.group(4)))
+    return out
+
+
+def overlap_stats(hlo_text):
+    """Comm/compute overlap structure of one scheduled HLO module —
+    the CPU-runnable proof that a gradient exchange can hide behind
+    compute (dist.gradcomm's reverse-topological bucket ordering):
+
+    - ``async_pairs`` / ``async_overlapped``: ``<kind>-start`` /
+      ``-done`` collective pairs, and how many have at least one real
+      compute op (COMPUTE_OPS) scheduled BETWEEN start and done — the
+      async backend's explicit overlap window. (XLA's CPU backend
+      lowers collectives synchronously, so live CPU entries usually
+      show 0 pairs; the canned fixtures pin the parse.)
+    - ``interleaved``: collectives (sync or -start) with at least one
+      compute op scheduled AFTER them — the overlap-enabling placement
+      a sync schedule still proves: the exchange is not pushed to the
+      tail where nothing could ever hide it.
+    - ``collectives`` / ``compute_ops``: totals for context.
+    """
+    sched = schedule_ops(hlo_text)
+    compute_at = [i for i, (_, kind, _) in enumerate(sched)
+                  if kind in COMPUTE_OPS]
+    colls = []   # (index, name, kind, is_start)
+    for i, (name, kind, _) in enumerate(sched):
+        if kind in _SYNC_COLLECTIVES:
+            colls.append((i, name, kind, False))
+        elif kind.endswith("-start") and \
+                kind[:-6] in _SYNC_COLLECTIVES:
+            colls.append((i, name, kind[:-6], True))
+    pairs = overlapped = 0
+    for i, name, kind, is_start in colls:
+        if not is_start:
+            continue
+        # exact operand match: "%ar-start.1" must not bind to
+        # "%ar-start.10"'s done
+        name_re = re.compile("%" + re.escape(name) + r"(?![\w.\-])")
+        done = next(
+            (j for j, (_, k, args) in enumerate(sched[i + 1:], i + 1)
+             if k == kind + "-done" and name_re.search(args)), None)
+        if done is None:
+            continue
+        pairs += 1
+        if any(i < c < done for c in compute_at):
+            overlapped += 1
+    last_compute = compute_at[-1] if compute_at else -1
+    interleaved = sum(1 for i, _, _, _ in colls if i < last_compute)
+    return {"collectives": len(colls), "compute_ops": len(compute_at),
+            "async_pairs": pairs, "async_overlapped": overlapped,
+            "interleaved": interleaved}
+
+
 def entry_hlo(compiled):
     """Optimized HLO text of one Executor cache entry, lowered from the
     arg structs captured at build time. BLOCKING (pays one XLA compile)
@@ -150,7 +241,9 @@ def entry_hlo(compiled):
 
 def check_hlo(hlo_text, *, min_donated=None, max_donated=None,
               min_fusion=None, max_while=None, min_while=None,
-              max_collective_bytes=None, mesh=None):
+              max_collective_bytes=None, mesh=None,
+              max_all_reduce=None, min_async_overlapped=None,
+              min_interleaved=None):
     """Check one HLO module against invariant bounds; returns the list
     of failure strings (empty = gate passes). Only the bounds given are
     checked — a gate file states exactly what it pins."""
@@ -171,6 +264,27 @@ def check_hlo(hlo_text, *, min_donated=None, max_donated=None,
     if min_while is not None and n_while < min_while:
         failures.append(f"while loops {n_while} < required {min_while} "
                         "(fused path did not lower to a scan)")
+    if max_all_reduce is not None:
+        n_ar = ops.get("all-reduce", 0) + ops.get("all-reduce-start", 0)
+        if n_ar > max_all_reduce:
+            failures.append(
+                f"all-reduce ops {n_ar} > allowed {max_all_reduce} "
+                "(bucketing regressed to per-parameter exchanges?)")
+    if min_async_overlapped is not None or min_interleaved is not None:
+        ov = overlap_stats(hlo_text)
+        if min_async_overlapped is not None and \
+                ov["async_overlapped"] < min_async_overlapped:
+            failures.append(
+                f"async-overlapped collectives {ov['async_overlapped']} "
+                f"< required {min_async_overlapped} "
+                f"(pairs={ov['async_pairs']}: comm not hidden behind "
+                "compute)")
+        if min_interleaved is not None and \
+                ov["interleaved"] < min_interleaved:
+            failures.append(
+                f"interleaved collectives {ov['interleaved']} < required "
+                f"{min_interleaved} (every exchange scheduled after the "
+                "last compute op — nothing can hide it)")
     if max_collective_bytes is not None:
         from paddle_tpu.obs import spmd
 
@@ -205,6 +319,151 @@ def executor_call_counts(exe):
     stats = exe.cache_stats()
     return {"compiles": stats["misses"], "dispatches": exe.dispatches,
             "cache_hits": stats["hits"], "entries": stats["size"]}
+
+
+def journal_gates(exe, **bounds):
+    """Gate every compiled entry of ``exe`` and record the verdicts in
+    the active run journal (one ``perf_gate`` event per entry, with the
+    failure strings and the donation/while/call-count evidence), so
+    ``tools/run_report.py --diff`` can surface a gate regression as a
+    run regression. Inactive journal = pure check (no side effects).
+    Returns the combined failure list."""
+    from paddle_tpu.obs import journal as J
+
+    all_failures = []
+    calls = executor_call_counts(exe)
+    for compiled in exe._cache.values():
+        failures = check_entry(compiled, **bounds)
+        all_failures += failures
+        if J.ACTIVE is not None:
+            hlo = entry_hlo(compiled)
+            don = donation_stats(hlo)["count"] if hlo else None
+            ops = op_counts(hlo, kinds=("while", "fusion")) if hlo else {}
+            J.ACTIVE.event(
+                "perf_gate", entry_uid=compiled.program_uid,
+                steps_fused=getattr(compiled, "steps", None),
+                donated=don, while_ops=ops.get("while"),
+                fusion_ops=ops.get("fusion"),
+                failures=failures, passed=not failures,
+                compiles=calls["compiles"], dispatches=calls["dispatches"])
+    return all_failures
+
+
+# -- donation-coverage sweep --------------------------------------------------
+
+# model-zoo legs for the coverage sweep: (name, builder) where builder
+# returns (program, startup, loss) — small shapes so the sweep runs in
+# tier-1 CI. Every leg trains through run_steps and must donate its
+# persistable carry on the fused entry.
+
+
+def _sweep_mlp():
+    return _build_mlp(batch=8)
+
+
+def _sweep_lenet():
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models.vision import LeNet
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[8, 1, 28, 28])
+        y = pt.static.data("y", [8], "int64")
+        loss = F.cross_entropy(LeNet()(x), y)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return prog, startup, loss
+
+
+def _sweep_ngram():
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models.nlp.word2vec import NGramLM
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        w = pt.static.data("w", [8, 4], "int64")
+        y = pt.static.data("y", [8], "int64")
+        loss = F.cross_entropy(
+            NGramLM(vocab_size=64, embed_dim=8, hidden=16)(w), y)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return prog, startup, loss
+
+
+SWEEP_MODELS = (("mlp", _sweep_mlp), ("lenet", _sweep_lenet),
+                ("ngram_lm", _sweep_ngram))
+
+
+def _sweep_feed(prog, rng):
+    """One synthetic feed matching the program's data vars."""
+    feed = {}
+    for v in prog.global_block.vars.values():
+        if not v.is_data or v.name.startswith("@"):
+            continue
+        shape = tuple(int(d) for d in v._data.shape)
+        if not shape:
+            continue
+        if "int" in str(v._data.dtype):
+            feed[v.name] = rng.randint(0, 10, shape).astype(
+                str(v._data.dtype))
+        else:
+            feed[v.name] = rng.randn(*shape).astype("float32")
+    return feed
+
+
+def donation_sweep(models=SWEEP_MODELS, steps=2):
+    """Donation-coverage sweep over the model zoo: every model trains a
+    fused ``run_steps`` window and its compiled entry must (a) donate
+    EVERY updated persistable (the scan carry stays in HBM) and (b)
+    lower to exactly one while loop. Returns
+    ``(coverage_rows, failures)`` — one row per model with the counts a
+    CI log can table."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+
+    rows, failures = [], []
+    pt.enable_static()
+    try:
+        for name, build in models:
+            pt.seed(0)
+            prog, startup, loss = build()
+            exe = fluid.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            feeds = [_sweep_feed(prog, rng) for _ in range(steps)]
+            exe.run_steps(prog, feeds=feeds, fetch_list=[loss])
+            entry = next(iter(exe._cache.values()))
+            n_persist = len(entry.updated)
+            hlo = entry_hlo(entry)
+            donated = donation_stats(hlo)["count"] if hlo else 0
+            # min_while only: conv/embedding models legally carry extra
+            # while loops inside the step body on this CPU lowering —
+            # the sweep pins donation coverage and the scan's existence
+            entry_fails = check_entry(entry, min_donated=n_persist,
+                                      min_while=1)
+            rows.append({"model": name, "persistables": n_persist,
+                         "donated": donated,
+                         "coverage": (donated / n_persist
+                                      if n_persist else None),
+                         "ok": not entry_fails})
+            failures += [f"{name}: {f}" for f in entry_fails]
+    finally:
+        pt.disable_static()
+    return rows, failures
+
+
+def render_sweep(rows):
+    lines = [f"{'model':<12} {'persistables':>12} {'donated':>8} "
+             f"{'coverage':>9}  ok"]
+    for r in rows:
+        cov = "?" if r["coverage"] is None else f"{r['coverage']:.0%}"
+        lines.append(f"{r['model']:<12} {r['persistables']:>12} "
+                     f"{r['donated']:>8} {cov:>9}  {r['ok']}")
+    return "\n".join(lines)
 
 
 # -- self-test ----------------------------------------------------------------
@@ -246,6 +505,92 @@ CANNED_HLO = [
                "%d = f32[16]{0} dot(f32[16,8]{1,0} %p0, f32[8]{0} %c)",
         "donated": 0, "fusion": 0, "while": 0, "dot": 1,
         "aliases": [],
+    },
+]
+
+
+# hand-computed overlap structure fixtures: the schedule-order parse +
+# start/done pairing the comm-overlap gate rests on (XLA CPU lowers
+# collectives synchronously, so the async form is pinned here)
+CANNED_OVERLAP = [
+    {
+        "name": "async all-reduce hidden behind fusion+dot",
+        "hlo": "HloModule jit_step, is_scheduled=true\n"
+               "ENTRY %main {\n"
+               "  %p0 = f32[64]{0} parameter(0)\n"
+               "  %ar-start.1 = (f32[64]{0}, f32[64]{0}) "
+               "all-reduce-start(f32[64]{0} %p0), "
+               "replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add\n"
+               "  %f1 = f32[64]{0} fusion(f32[64]{0} %p0), kind=kLoop\n"
+               "  %d1 = f32[8,8]{1,0} dot(f32[8,8]{1,0} %f1, "
+               "f32[8,8]{1,0} %f1)\n"
+               "  %ar-done.1 = f32[64]{0} all-reduce-done("
+               "(f32[64]{0}, f32[64]{0}) %ar-start.1)\n"
+               "  %f2 = f32[64]{0} fusion(f32[64]{0} %ar-done.1), "
+               "kind=kLoop\n"
+               "  ROOT %t = (f32[64]{0}) tuple(f32[64]{0} %f2)\n"
+               "}",
+        # fusion+dot between start/done -> overlapped; f2 after the
+        # start -> interleaved
+        "stats": {"collectives": 1, "compute_ops": 3, "async_pairs": 1,
+                  "async_overlapped": 1, "interleaved": 1},
+    },
+    {
+        "name": "back-to-back start/done pair hides nothing",
+        "hlo": "HloModule jit_step, is_scheduled=true\n"
+               "ENTRY %main {\n"
+               "  %p0 = f32[64]{0} parameter(0)\n"
+               "  %f1 = f32[64]{0} fusion(f32[64]{0} %p0), kind=kLoop\n"
+               "  %ar-start.2 = (f32[64]{0}, f32[64]{0}) "
+               "all-reduce-start(f32[64]{0} %f1), "
+               "replica_groups={{0,1}}, to_apply=%add\n"
+               "  %ar-done.2 = f32[64]{0} all-reduce-done("
+               "(f32[64]{0}, f32[64]{0}) %ar-start.2)\n"
+               "  ROOT %t = (f32[64]{0}) tuple(f32[64]{0} %ar-done.2)\n"
+               "}",
+        "stats": {"collectives": 1, "compute_ops": 1, "async_pairs": 1,
+                  "async_overlapped": 0, "interleaved": 0},
+    },
+    {
+        "name": "sync bucketed exchange interleaved with backward",
+        "hlo": "HloModule jit_raw, is_scheduled=true\n"
+               "ENTRY %main {\n"
+               "  %f1 = f32[64]{0} fusion(f32[64]{0} %p0), kind=kLoop\n"
+               "  %ar.1 = f32[64]{0} all-reduce(f32[64]{0} %f1), "
+               "replica_groups=[1,8]<=[8], to_apply=%add\n"
+               "  %f2 = f32[32]{0} fusion(f32[64]{0} %f1), kind=kLoop\n"
+               "  %ar.2 = f32[32]{0} all-reduce(f32[32]{0} %f2), "
+               "replica_groups=[1,8]<=[8], to_apply=%add\n"
+               "  %f3 = f32[32]{0} fusion(f32[32]{0} %ar.2), kind=kLoop\n"
+               "  ROOT %t = (f32[32]{0}) tuple(f32[32]{0} %f3)\n"
+               "}",
+        # both sync all-reduces precede the last compute op (f3)
+        "stats": {"collectives": 2, "compute_ops": 3, "async_pairs": 0,
+                  "async_overlapped": 0, "interleaved": 2},
+    },
+    {
+        # ".1" must pair with %ar-done.1, not %ar-start.10's done (a
+        # substring match binds .1 -> done.10 and loses the overlap)
+        "name": "start/done pairing is exact-name, not prefix",
+        "hlo": "HloModule jit_step, is_scheduled=true\n"
+               "ENTRY %main {\n"
+               "  %p0 = f32[64]{0} parameter(0)\n"
+               "  %ar-start.1 = (f32[64]{0}, f32[64]{0}) "
+               "all-reduce-start(f32[64]{0} %p0), "
+               "replica_groups={{0,1}}, to_apply=%add\n"
+               "  %ar-start.10 = (f32[64]{0}, f32[64]{0}) "
+               "all-reduce-start(f32[64]{0} %p0), "
+               "replica_groups={{0,1}}, to_apply=%add\n"
+               "  %ar-done.10 = f32[64]{0} all-reduce-done("
+               "(f32[64]{0}, f32[64]{0}) %ar-start.10)\n"
+               "  %f1 = f32[64]{0} fusion(f32[64]{0} %p0), kind=kLoop\n"
+               "  %ar-done.1 = f32[64]{0} all-reduce-done("
+               "(f32[64]{0}, f32[64]{0}) %ar-start.1)\n"
+               "  ROOT %t = (f32[64]{0}) tuple(f32[64]{0} %ar-done.1)\n"
+               "}",
+        # only .1's window spans f1; .10's closes before it
+        "stats": {"collectives": 2, "compute_ops": 1, "async_pairs": 2,
+                  "async_overlapped": 1, "interleaved": 2},
     },
 ]
 
@@ -428,6 +773,22 @@ def self_test():
                          min_donated=case["donated"] + 1) != [],
                f"{case['name']}: check_hlo missed a donation regression")
 
+    for case in CANNED_OVERLAP:
+        got = overlap_stats(case["hlo"])
+        _check(failures, got == case["stats"],
+               f"{case['name']}: overlap stats {got} != {case['stats']}")
+    # the bound checks must accept ground truth and catch regressions
+    ok = CANNED_OVERLAP[0]["hlo"]
+    _check(failures,
+           check_hlo(ok, min_async_overlapped=1, min_interleaved=1) == [],
+           "overlap check_hlo rejects the overlapped fixture")
+    _check(failures, check_hlo(CANNED_OVERLAP[1]["hlo"],
+                               min_async_overlapped=1) != [],
+           "overlap check_hlo missed the back-to-back pair")
+    _check(failures,
+           check_hlo(CANNED_OVERLAP[2]["hlo"], max_all_reduce=1) != [],
+           "max_all_reduce missed the 2-all-reduce fixture")
+
     if ndev < 2:
         failures.append(f"need >=2 fake devices, have {ndev}")
     else:
@@ -441,12 +802,13 @@ def self_test():
         return 1
     print("self-test passed: canned-HLO donation/fusion/while counts "
           "match hand-computed values, bound checks catch seeded "
-          "regressions, the live 8-fake-device K=8 scan-vs-loop "
-          "check holds (bitwise loss trajectory, 1 compile + 1 dispatch "
-          "vs 8, persistable carry donated, exactly one while loop), "
-          "and the inference gates hold (predictor entries loop-free "
-          "with nothing donated, serving decode step donates both KV "
-          "pool buffers)")
+          "regressions, the overlap parse pins hand-computed async-"
+          "pair/interleave structure, the live 8-fake-device K=8 "
+          "scan-vs-loop check holds (bitwise loss trajectory, 1 compile "
+          "+ 1 dispatch vs 8, persistable carry donated, exactly one "
+          "while loop), and the inference gates hold (predictor entries "
+          "loop-free with nothing donated, serving decode step donates "
+          "both KV pool buffers)")
     return 0
 
 
@@ -497,6 +859,11 @@ def main(argv=None):
     ap.add_argument("--entry-report", action="store_true",
                     help="build + fuse a demo MLP and print its "
                          "invariant report")
+    ap.add_argument("--donation-sweep", action="store_true",
+                    help="train every model-zoo sweep leg through a "
+                         "fused run_steps window and report per-model "
+                         "donation coverage; exit 1 when any carry is "
+                         "not donated")
     args = ap.parse_args(argv)
     if args.self_test:
         return self_test()
@@ -504,7 +871,14 @@ def main(argv=None):
         _ensure_fake_devices(8)
         print(entry_report())
         return 0
-    ap.error("pass --self-test or --entry-report")
+    if args.donation_sweep:
+        _ensure_fake_devices(8)
+        rows, failures = donation_sweep()
+        print(render_sweep(rows))
+        for line in failures:
+            print(f"  FAILED — {line}")
+        return 1 if failures else 0
+    ap.error("pass --self-test, --entry-report, or --donation-sweep")
 
 
 if __name__ == "__main__":

@@ -205,6 +205,25 @@ def elastic_summary(run):
     return out
 
 
+def gate_summary(run):
+    """Perf-gate columns over the run's ``perf_gate`` events (written by
+    ``tools/perf_gate.journal_gates``): entries gated, failure count,
+    and the failure strings — so a donation/fusion/call-count gate
+    regression rides the journal into the --diff regression gate. None
+    when no gates were recorded."""
+    events = [e for e in run.get("events") or []
+              if e.get("kind") == "perf_gate"]
+    if not events:
+        return None
+    failures = []
+    for e in events:
+        failures += list(e.get("failures") or [])
+    return {"entries": len(events),
+            "failed_entries": sum(1 for e in events if not e.get("passed",
+                                                                 True)),
+            "failures": failures}
+
+
 def _final_loss(run, k=5):
     """Median of the last k finite losses — robust to one noisy tail
     step."""
@@ -268,6 +287,12 @@ def render_run(run, as_json=False):
                 lines.append(
                     f"{label:<12} p50={rsum[f'{key}_p50']:.3f} "
                     f"p99={rsum[f'{key}_p99']:.3f}")
+    gsum = gate_summary(run)
+    if gsum:
+        lines.append(f"perf_gates   {gsum['entries']} entries, "
+                     f"{gsum['failed_entries']} failed"
+                     + (f": {'; '.join(gsum['failures'][:3])}"
+                        if gsum["failures"] else ""))
     esum = elastic_summary(run)
     if esum:
         line = (f"elastic      restarts={esum['restarts']} "
@@ -329,12 +354,24 @@ def diff_runs(base, new,
         "base_anomalies": len(base["anomalies"]),
         "new_anomalies": len(new["anomalies"]),
     }
+    # perf-gate fold (tools/perf_gate.journal_gates events): NEW failing
+    # more structural gates than BASE — donation lost, scan unrolled,
+    # call counts blown — is a regression even when wall time hides it
+    bg, ng = gate_summary(base), gate_summary(new)
+    bfail = (bg or {}).get("failed_entries", 0)
+    nfail = (ng or {}).get("failed_entries", 0)
+    out["base_gate_failures"] = bfail if bg else None
+    out["new_gate_failures"] = nfail if ng else None
+    out["gate_regression"] = bool(ng and nfail > bfail)
+    if out["gate_regression"]:
+        out["gate_failure_detail"] = (ng or {}).get("failures")
     if bl is not None and nl is not None:
         margin = loss_threshold * max(abs(bl), 1e-12)
         out["loss_delta"] = nl - bl
         out["loss_regression"] = bool(nl - bl > margin)
     out["regression"] = out["step_time_regression"] or \
-        out["loss_regression"] or out["comm_regression"]
+        out["loss_regression"] or out["comm_regression"] or \
+        out["gate_regression"]
     return out
 
 
@@ -350,8 +387,10 @@ def render_diff(rep, as_json=False):
               "step_time_regression", "base_final_loss", "new_final_loss",
               "loss_delta", "loss_regression", "base_ar_bytes_per_step",
               "new_ar_bytes_per_step", "comm_ratio", "comm_regression",
-              "base_comm_share", "new_comm_share", "base_anomalies",
-              "new_anomalies", "regression"):
+              "base_comm_share", "new_comm_share",
+              "base_gate_failures", "new_gate_failures",
+              "gate_regression", "gate_failure_detail",
+              "base_anomalies", "new_anomalies", "regression"):
         if rep.get(k) is not None:
             lines.append(f"{k:<22} {fmt(rep[k])}")
     return "\n".join(lines)
@@ -361,7 +400,7 @@ def render_diff(rep, as_json=False):
 
 
 def _write_run(run_dir, losses, step_ms, flops=1e9, nonfinite_at=(),
-               comm_bytes=None):
+               comm_bytes=None, gate_failures=()):
     """Drive the REAL RunJournal API to produce one synthetic run."""
     from paddle_tpu.obs import journal as J
 
@@ -372,6 +411,11 @@ def _write_run(run_dir, losses, step_ms, flops=1e9, nonfinite_at=(),
                 "wire_bytes": int(comm_bytes * 1.75)}
     j = J.RunJournal(run_dir, flush_every=4, compute_flops=False)
     j.start()
+    # one perf_gate event per run (the shape journal_gates writes);
+    # gate_failures injects a structural regression for the diff to flag
+    j.event("perf_gate", entry_uid=1, steps_fused=None, donated=4,
+            while_ops=0, fusion_ops=3, failures=list(gate_failures),
+            passed=not gate_failures, compiles=1, dispatches=30)
     for i, loss in enumerate(losses):
         if i in nonfinite_at:
             j.record_step(loss=float("nan"), step_ms=step_ms,
@@ -404,7 +448,8 @@ def self_test():
             for i in range(21, 30):
                 losses[i] = 0.5  # ...then stuck well above run A's tail
             _write_run(b_dir, losses, step_ms=30.0,
-                       nonfinite_at=(12, 13, 14), comm_bytes=2 << 20)
+                       nonfinite_at=(12, 13, 14), comm_bytes=2 << 20,
+                       gate_failures=("donated buffers 0 < required 4",))
 
             a, b = load_run(a_dir), load_run(b_dir)
             if a["parse_errors"] or b["parse_errors"]:
@@ -442,6 +487,13 @@ def self_test():
             if rep["comm_ratio"] is None or \
                     abs(rep["comm_ratio"] - 2.0) > 1e-9:
                 failures.append(f"comm_ratio {rep['comm_ratio']} != 2.0")
+            if not rep["gate_regression"]:
+                failures.append("diff missed the injected perf-gate "
+                                "(donation) failure")
+            if "donated buffers" not in " ".join(
+                    rep.get("gate_failure_detail") or ()):
+                failures.append("gate_failure_detail lost the failure "
+                                f"string: {rep.get('gate_failure_detail')}")
             self_rep = diff_runs(a, a)
             if self_rep["regression"]:
                 failures.append(f"A-vs-A diff false-positived: {self_rep}")
@@ -494,9 +546,10 @@ def self_test():
         return 1
     print("self-test passed: journal round-trip, MFU/goodput summary, "
           "loss_spike + nonfinite_streak detectors, the diff gate "
-          "flagged the injected step-time, loss, AND all-reduce-bytes "
-          "regressions (and only them), and serving request records "
-          "round-trip with hand-computed TTFT/TPOT percentile columns")
+          "flagged the injected step-time, loss, all-reduce-bytes, AND "
+          "perf-gate (lost donation) regressions (and only them), and "
+          "serving request records round-trip with hand-computed "
+          "TTFT/TPOT percentile columns")
     return 0
 
 

@@ -103,8 +103,14 @@ def render_collectives(profile):
     if ax:
         lines.append("by mesh axis: " + ", ".join(
             f"{a}={_fmt_bytes(b)}" for a, b in sorted(ax.items())))
-    lines.append(f"total {_fmt_bytes(profile.get('total_bytes'))} "
-                 f"(wire {_fmt_bytes(profile.get('wire_bytes'))})")
+    total = (f"total {_fmt_bytes(profile.get('total_bytes'))} "
+             f"(wire {_fmt_bytes(profile.get('wire_bytes'))}")
+    # the int8-payload share of the wire (dist.gradcomm quantized
+    # exchange): how much of the traffic already rides compressed
+    if profile.get("quant_wire_bytes"):
+        total += (f", quantized wire "
+                  f"{_fmt_bytes(profile['quant_wire_bytes'])}")
+    lines.append(total + ")")
     return "\n".join(lines)
 
 
@@ -181,6 +187,8 @@ def journal_report(run_dir, as_json=False):
             s["comm"].get("total_bytes", 0) for s in comm_steps) / n
         agg["wire_bytes_per_step"] = sum(
             s["comm"].get("wire_bytes", 0) for s in comm_steps) / n
+        agg["quant_wire_bytes_per_step"] = sum(
+            s["comm"].get("quant_wire_bytes", 0) for s in comm_steps) / n
     summ = run.get("summary") or {}
     if as_json:
         return json.dumps({"shardings": shardings, "comm": agg,
@@ -190,12 +198,16 @@ def journal_report(run_dir, as_json=False):
     for e in shardings:
         lines += [render_sharding(e), ""]
     if comm_steps:
-        lines.append(
+        line = (
             f"comm/step    all-reduce "
             f"{_fmt_bytes(agg['all_reduce_bytes_per_step'])}  total "
             f"{_fmt_bytes(agg['total_bytes_per_step'])}  wire "
-            f"{_fmt_bytes(agg['wire_bytes_per_step'])}  "
-            f"({len(comm_steps)}/{len(run['steps'])} steps attributed)")
+            f"{_fmt_bytes(agg['wire_bytes_per_step'])}")
+        if agg.get("quant_wire_bytes_per_step"):
+            line += (f"  quantized wire "
+                     f"{_fmt_bytes(agg['quant_wire_bytes_per_step'])}")
+        lines.append(line + f"  ({len(comm_steps)}/{len(run['steps'])} "
+                            "steps attributed)")
     else:
         lines.append("comm/step    no comm-attributed steps (analysis "
                      "may not have landed before the run ended)")
@@ -267,6 +279,42 @@ CANNED_HLO = [
 ]
 
 
+# the comm-efficient DP story as canned partitioned-HLO fixtures with
+# hand-computed totals (dist.gradcomm): the same 4096-element f32
+# gradient payload exchanged three ways on an 8-device ring. Shapes are
+# per-partition (what entry_hlo of an SPMD module shows).
+COMM_FIXTURES = {
+    # 3 per-parameter all-reduces: 2048+1536+512 f32 = 16384 B,
+    # ring wire 2(n-1)/n = 1.75x -> 28672 B
+    "unbucketed": (
+        "%ar.1 = f32[2048]{0} all-reduce(f32[2048]{0} %g0), "
+        "replica_groups=[1,8]<=[8], to_apply=%add\n"
+        "%ar.2 = f32[1536]{0} all-reduce(f32[1536]{0} %g1), "
+        "replica_groups=[1,8]<=[8], to_apply=%add\n"
+        "%ar.3 = f32[512]{0} all-reduce(f32[512]{0} %g2), "
+        "replica_groups=[1,8]<=[8], to_apply=%add"),
+    # ONE flat-bucket all-reduce: same 16384 B / 28672 B wire, 1 op
+    "bucketed": (
+        "%ar.1 = f32[4096]{0} all-reduce(f32[4096]{0} %bucket), "
+        "replica_groups=[1,8]<=[8], to_apply=%add"),
+    # int8 two-phase exchange (EQuARX shape): phase-1 s8 all-to-all of
+    # the 8x512 chunk grid (4096 B), phase-2 s8 all-gather of the
+    # reduced chunks (4096 B), plus two f32[8,1] scale all-gathers
+    # (32 B each). totals 8256 B; wire (n-1)/n = 7/8 per op ->
+    # 3584+3584+28+28 = 7224 B; quantized (s8) share 8192 B / 7168 B
+    # wire. vs fp32 bucketed wire: 28672/7224 = 3.97x less traffic
+    "quantized": (
+        "%a2a = s8[8,512]{1,0} all-to-all(s8[8,512]{1,0} %q1), "
+        "replica_groups=[1,8]<=[8]\n"
+        "%ags1 = f32[8,1]{1,0} all-gather(f32[1,1]{1,0} %s1), "
+        "replica_groups=[1,8]<=[8], dimensions={0}\n"
+        "%ag = s8[4096]{0} all-gather(s8[512]{0} %q2), "
+        "replica_groups=[1,8]<=[8], dimensions={0}\n"
+        "%ags2 = f32[8,1]{1,0} all-gather(f32[1,1]{1,0} %s2), "
+        "replica_groups=[1,8]<=[8], dimensions={0}"),
+}
+
+
 def _check(failures, cond, msg):
     if not cond:
         failures.append(msg)
@@ -302,6 +350,35 @@ def self_test():
                    f"{case['name']}: by_axis {prof['by_axis']} != "
                    f"{case['axes']}")
 
+    # 1b) bucketed / unbucketed / int8-quantized exchange fixtures with
+    # hand-computed totals (the dist.gradcomm wire-byte story)
+    unb = spmd.collective_profile(COMM_FIXTURES["unbucketed"])
+    buc = spmd.collective_profile(COMM_FIXTURES["bucketed"])
+    qnt = spmd.collective_profile(COMM_FIXTURES["quantized"])
+    _check(failures, unb["counts"] == {"all-reduce": 3} and
+           unb["total_bytes"] == 16384 and unb["wire_bytes"] == 28672,
+           f"unbucketed fixture off hand-computed totals: {unb}")
+    _check(failures, buc["counts"] == {"all-reduce": 1} and
+           buc["total_bytes"] == 16384 and buc["wire_bytes"] == 28672,
+           f"bucketed fixture off hand-computed totals: {buc}")
+    _check(failures, buc["n_ops"] < unb["n_ops"],
+           "bucketing must strictly reduce collective op count")
+    _check(failures, qnt["total_bytes"] == 8256 and
+           qnt["wire_bytes"] == 7224,
+           f"quantized fixture off hand-computed totals: {qnt}")
+    _check(failures, qnt["quant_bytes"] == 8192 and
+           qnt["quant_wire_bytes"] == 7168,
+           f"quantized-share accounting off: {qnt}")
+    _check(failures, unb["quant_wire_bytes"] == 0 and
+           buc["quant_wire_bytes"] == 0,
+           "fp32 fixtures must report zero quantized wire bytes")
+    ratio = buc["wire_bytes"] / qnt["wire_bytes"]
+    _check(failures, 3.8 < ratio < 4.2,
+           f"int8 exchange wire ratio {ratio:.2f} not ~4x")
+    _check(failures, "quantized wire" in render_collectives(qnt) and
+           "quantized wire" not in render_collectives(buc),
+           "render_collectives quantized-wire column wrong")
+
     # 2) real 8-fake-device with_data_parallel run: nonzero all-reduce
     # bytes, feeds sharded on 'data', per-device footprint = 1/ndev
     if ndev < 2:
@@ -330,9 +407,12 @@ def self_test():
         return 1
     print("self-test passed: canned-HLO collective parsing matches "
           "hand-computed byte volumes (incl. async pairs, iota replica "
-          "groups, axis attribution), the 8-device data-parallel entry "
-          "reports nonzero all-reduce bytes with feeds sharded on "
-          "'data', and the comm roofline math checks out")
+          "groups, axis attribution), the bucketed/unbucketed/int8 "
+          "exchange fixtures hold hand-computed totals (1 vs 3 ops, "
+          "~4x wire reduction, exact quantized-share bytes), the "
+          "8-device data-parallel entry reports nonzero all-reduce "
+          "bytes with feeds sharded on 'data', and the comm roofline "
+          "math checks out")
     return 0
 
 
