@@ -215,8 +215,11 @@ def _unwrap_index(idx):
 class Parameter(Tensor):
     """Trainable tensor (ref: framework::Parameter / ParamBase)."""
 
+    # _declared_sharding_spec stays UNSET until fleet.auto_parallel_step
+    # stashes the layer-declared spec there before installing a plan's
+    # placement (hasattr == "already stashed")
     __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
-                 "sharding_spec")
+                 "sharding_spec", "_declared_sharding_spec")
 
     def __init__(self, data, name=None, trainable=True):
         super().__init__(data, stop_gradient=not trainable, name=name, _internal=isinstance(data, jax.Array))

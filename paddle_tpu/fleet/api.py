@@ -1,0 +1,120 @@
+"""fleet user API: one call from Program (or Layer) + mesh shape to a
+running auto-parallel step.
+
+Static path (the reference's ``CompiledProgram.with_data_parallel``
+idiom, plan-aware)::
+
+    compiled = fleet.auto_parallel(main_prog, mesh_shape=(2, 4))
+    exe.run(compiled, feed=..., fetch_list=[loss])
+
+``auto_parallel`` plans (``fleet.planner``), optionally verifies the
+winner's predicted wire bytes against the compiled HLO's
+CollectiveProfile, and returns a CompiledProgram the Executor compiles
+under a plan-keyed cache entry (``CacheKey.plan``) with the plan's
+shardings. A pure-DP plan composes with the ``dist.gradcomm``
+comm-efficient exchange via ``comm_options`` exactly like
+``with_data_parallel(comm_options=...)``.
+
+Eager path (the reference's ``fleet.distributed_optimizer`` idiom)::
+
+    step = fleet.auto_parallel_step(model, opt, loss_fn,
+                                    mesh_shape=(2, 2))
+    loss = step(x, y)
+
+plans from the Layer's declared ``sharding_spec``s and builds a
+``DistributedTrainStep`` over the plan's mesh.
+
+The pre-plan fleet surface (``fleet.init`` / ``DistributedStrategy`` /
+worker queries) is re-exported unchanged from ``dist.fleet`` — old
+fleet code keeps working, MIGRATING.md documents the mapping.
+"""
+from __future__ import annotations
+
+from ..obs import journal as _journal
+from ..static_.compiler import CompiledProgram
+from .planner import plan_layer, plan_program, verify_plan
+
+__all__ = ["AutoParallelProgram", "auto_parallel", "auto_parallel_step"]
+
+
+class AutoParallelProgram(CompiledProgram):
+    """A CompiledProgram carrying the planner's ShardingPlan as
+    ``._plan``: the Executor compiles it under a plan-keyed cache entry
+    (``CacheKey.plan``) with the plan's shardings instead of the
+    one-axis ``with_data_parallel`` default."""
+
+    def __init__(self, program, plan, comm_options=None):
+        super().__init__(program)
+        self._data_parallel = True
+        self._plan = plan
+        if comm_options is not None:
+            self._build_strategy.comm_options = comm_options
+
+
+def auto_parallel(program, mesh_shape, roles=None, comm_options=None,
+                  verify=True, fetch_list=None, executor=None,
+                  peak=None, bw=None):
+    """Plan ``program`` onto ``mesh_shape`` and return a data-parallel
+    CompiledProgram the Executor runs under the plan's shardings.
+
+    ``roles`` pins per-axis roles (e.g. ``("data", "model")``); left
+    None the planner scores every canonical assignment. ``verify=True``
+    (default) compiles once through the real Executor path and fills
+    ``plan.measured`` from the executable's CollectiveProfile — call it
+    AFTER the startup program has materialized the parameters. The
+    probe compile is paid once per plan; pass ``executor=`` (your run
+    executor) and ``fetch_list=`` (your run's fetches) to turn it into
+    a warm cache entry the first real ``exe.run`` hits, or
+    ``verify=False`` to skip it entirely.
+    ``comm_options`` (dist.gradcomm) requires the plan to be pure DP.
+    The returned object exposes the plan as ``._plan``.
+    """
+    plan = plan_program(program, mesh_shape, roles=roles, peak=peak,
+                        bw=bw)
+    if comm_options is not None and not plan.is_pure_dp:
+        raise ValueError(
+            "comm_options (dist.gradcomm) composes only with a pure "
+            f"data-parallel plan; the planner chose {plan.axes}. Pin "
+            "roles=('data',)*len(mesh_shape) to force pure DP")
+    if verify:
+        verify_plan(plan, program, executor=executor,
+                    fetch_list=fetch_list)
+    return AutoParallelProgram(program, plan,
+                               comm_options=comm_options)
+
+
+def auto_parallel_step(model, optimizer, loss_fn, mesh_shape,
+                       roles=None, batch_example=None, devices=None,
+                       **step_kw):
+    """Plan an eager Layer onto ``mesh_shape`` and return a
+    ``DistributedTrainStep`` over the plan's mesh with the plan's
+    parameter placements installed (declared TP/MoE ``sharding_spec``s
+    the mesh affords are kept; the rest replicate). Extra keyword args
+    pass through to DistributedTrainStep. The step exposes the plan as
+    ``.plan``; its measured collective mix comes from
+    ``step.collective_profile()`` after the first call."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..dist.parallel import DistributedTrainStep
+
+    plan = plan_layer(model, mesh_shape, roles=roles,
+                      batch_example=batch_example)
+    mesh = plan.build_mesh(devices=devices)
+    for name, p in model.named_parameters():
+        # stash the model's DECLARED spec once (plan_layer plans from
+        # it) so replanning the same model onto another mesh — or a
+        # plan that replicates this param — never erases the layer's
+        # TP/MoE declaration
+        if not hasattr(p, "_declared_sharding_spec"):
+            p._declared_sharding_spec = getattr(p, "sharding_spec", None)
+        p.sharding_spec = P(*plan.param_specs.get(name, ()))
+    # a pure-TP/EP plan has no data axis: the batch replicates (every
+    # device computes the full batch; the model axes shard the math)
+    step_kw.setdefault("batch_axis",
+                       "data" if "data" in plan.axes else None)
+    step = DistributedTrainStep(model, optimizer, loss_fn, mesh=mesh,
+                                **step_kw)
+    step.plan = plan
+    if _journal.ACTIVE is not None:
+        _journal.ACTIVE.record_plan(plan)
+    return step

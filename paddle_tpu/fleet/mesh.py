@@ -1,0 +1,134 @@
+"""Mesh-shape description, validation, and axis-role assignment.
+
+The planner's physical vocabulary. A **mesh shape** is what the operator
+knows — "I have a 2x4 slice" / "a 2x2x2 cube" — and says nothing about
+*what each axis does*. A **role assignment** gives every axis one of the
+four parallelism roles the reference's fleet hybrid_configs spelled as
+degrees (dp/mp/pp/ep):
+
+- ``data``   — batch sharding; gradients all-reduce over it,
+- ``model``  — tensor parallelism; weights shard, activations all-reduce,
+- ``expert`` — expert parallelism; MoE expert weights shard, tokens a2a,
+- ``pipe``   — pipeline stages; activations collective-permute.
+
+Axes sharing a role merge (a 2x2x2 cube with roles (data, data, model)
+IS a 4x2 dp x tp mesh — the factorization the MLPerf pod-scaling
+playbook, arXiv 1909.09756, treats as the tunable), size-1 axes vanish,
+and the canonical mesh orders axes ``data, model, expert, pipe`` so two
+role assignments that mean the same layout build the same jax Mesh.
+
+``candidate_assignments`` enumerates the distinct canonical layouts one
+shape can express — the planner's search space. Note the shape genuinely
+constrains it: 1x8 can express dp8 or tp8 but NOT dp2 x tp4.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+__all__ = [
+    "ROLES", "parse_mesh_shape", "validate_mesh_shape",
+    "canonical_axes", "candidate_assignments", "build_mesh",
+]
+
+# canonical role order: every mesh built here lists its axes this way,
+# so identical axes dicts build identical meshes regardless of which
+# raw role assignment produced them
+ROLES = ("data", "model", "expert", "pipe")
+
+
+def parse_mesh_shape(shape):
+    """Normalize a mesh shape to a tuple of positive ints. Accepts a
+    tuple/list, a single int (a 1-D mesh), or the CLI spelling
+    ``"2x4"`` / ``"2,4"``."""
+    if isinstance(shape, str):
+        parts = shape.replace("x", ",").replace("X", ",").split(",")
+        shape = [p for p in (s.strip() for s in parts) if p]
+    if isinstance(shape, (int, np.integer)):
+        shape = (int(shape),)
+    try:
+        out = tuple(int(s) for s in shape)
+    except (TypeError, ValueError):
+        raise ValueError(f"unparseable mesh shape {shape!r}: want e.g. "
+                         "(2, 4), 8, or '2x4'") from None
+    if not out or any(s < 1 for s in out):
+        raise ValueError(f"mesh shape {out} must be non-empty with every "
+                         "axis >= 1")
+    return out
+
+
+def validate_mesh_shape(shape, n_devices=None):
+    """Parse + check the shape covers exactly ``n_devices`` (default:
+    the process's visible devices). Returns the parsed tuple."""
+    shape = parse_mesh_shape(shape)
+    if n_devices is None:
+        import jax
+
+        n_devices = len(jax.devices())
+    total = int(np.prod(shape))
+    if total != n_devices:
+        raise ValueError(
+            f"mesh shape {'x'.join(map(str, shape))} covers {total} "
+            f"devices but {n_devices} are available: the shape must "
+            "factor the device count exactly")
+    return shape
+
+
+def canonical_axes(shape, roles):
+    """Merge a (shape, per-axis roles) assignment into the canonical
+    ``{role: size}`` dict (sizes multiplied per role, size-1 axes
+    dropped, keys in ROLES order). An all-1 mesh canonicalizes to
+    ``{"data": 1}`` so there is always at least one axis."""
+    shape = parse_mesh_shape(shape)
+    roles = tuple(roles)
+    if len(roles) != len(shape):
+        raise ValueError(f"{len(roles)} roles for {len(shape)} mesh axes")
+    for r in roles:
+        if r not in ROLES:
+            raise ValueError(f"unknown axis role {r!r}: want one of "
+                             f"{ROLES}")
+    sizes = {}
+    for s, r in zip(shape, roles):
+        sizes[r] = sizes.get(r, 1) * int(s)
+    out = {r: sizes[r] for r in ROLES if sizes.get(r, 1) > 1}
+    return out or {"data": 1}
+
+
+def candidate_assignments(shape, roles=("data", "model")):
+    """All distinct canonical layouts the shape can express with the
+    given role alphabet: a list of ``(roles_tuple, axes_dict)`` pairs,
+    deduplicated by canonical axes (the first — most-data-major — role
+    tuple wins for each layout). ``data`` is always in the alphabet:
+    a planner that cannot fall back to pure DP cannot plan."""
+    shape = parse_mesh_shape(shape)
+    roles = tuple(dict.fromkeys(("data",) + tuple(roles)))
+    seen = {}
+    for combo in itertools.product(roles, repeat=len(shape)):
+        axes = canonical_axes(shape, combo)
+        key = tuple(sorted(axes.items()))
+        if key not in seen:
+            seen[key] = (combo, axes)
+    return list(seen.values())
+
+
+def build_mesh(axes, devices=None):
+    """Build the jax Mesh for a canonical axes dict. ``devices`` defaults
+    to ``jax.devices()`` truncated to the axes' product — candidates over
+    a sub-mesh (e.g. dp2 x tp2 on an 8-device host) take the first
+    devices, matching the hand-built dryrun recipes."""
+    import jax
+    from jax.sharding import Mesh
+
+    if not axes:
+        axes = {"data": 1}
+    names = [n for n in ROLES if n in axes] or list(axes)
+    sizes = [int(axes[n]) for n in names]
+    n = int(np.prod(sizes))
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices).reshape(-1)
+    if devices.size < n:
+        raise ValueError(f"mesh axes {axes} need {n} devices, have "
+                         f"{devices.size}")
+    return Mesh(devices[:n].reshape(sizes), tuple(names))

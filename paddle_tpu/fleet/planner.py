@@ -1,0 +1,712 @@
+"""The sharding planner: Program (or Layer) + mesh shape -> ShardingPlan.
+
+The multichip dryrun composes dp x tp x pp by hand; the reference's
+``incubate/fleet`` let users say ``fleet.distributed_optimizer`` and had
+the framework pick. This module is that picker, built the way the
+MLPerf-on-TPU-pods playbook (arXiv 1909.09756) describes scaling: as a
+*planning* problem over which mesh axes shard what, decided by a cost
+model and checked against reality.
+
+Pipeline:
+
+1. ``analyze_program`` — one pass over the static Program: persistable
+   (parameter) shapes/bytes, feed shapes, matmul sites (``linear`` /
+   ``matmul`` / ``mul`` ops with a persistable weight), per-op FLOPs and
+   activation bytes, and which gradient each ``optimize_*`` op consumes.
+2. For every candidate role assignment of the mesh shape
+   (``fleet.mesh.candidate_assignments``): assign PartitionSpecs —
+   batch feeds shard over ``data``; matmul weights shard over ``model``
+   in Megatron (column -> row) pairs found by a taint walk over the
+   forward ops, with the column bias following its weight — and
+   **predict the collective wire bytes** the compiled step will move:
+   per-gradient all-reduces over ``data`` (shrunk by ``model`` sharding)
+   and per-row-site activation all-reduces over ``model``, using the
+   same per-participant ring-factor convention ``obs.spmd`` measures by
+   (so predicted and measured are directly comparable).
+3. Score candidates: predicted comm seconds (wire bytes / ICI bandwidth,
+   with pure-DP grad exchanges discounted for backward overlap) plus
+   compute seconds (FLOPs / (peak x devices the layout actually uses)) —
+   infeasible layouts (indivisible batch / weight dims, unsharded-feed
+   "data parallelism") are discarded. Lowest cost wins.
+4. ``verify_plan`` — compile the winner through the REAL Executor path
+   and diff prediction against the ``CollectiveProfile`` parsed from the
+   executable's HLO (``obs.spmd``); the plan carries both numbers and
+   the journal's ``plan`` event reports the mismatch.
+
+The eager path (``plan_layer``) plans from the Layer's declared
+``sharding_spec``s (TP layers mark their own weights): specs whose axes
+a candidate lacks fall back to replicated, grads price like the static
+path, and activation traffic is estimated from a ``batch_example``.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from . import mesh as _mesh
+
+__all__ = [
+    "ShardingPlan", "PlanCandidate", "analyze_program", "plan_program",
+    "plan_layer", "verify_plan", "COMM_OVERLAP_DISCOUNT",
+]
+
+# ops that preserve the (data, model)-sharded layout of the activation
+# flowing between a column- and a row-parallel matmul: elementwise /
+# activation / dropout. Anything else consuming a tensor-sharded
+# activation voids the pairing (GSPMD would insert gathers we did not
+# price).
+_ELEMENTWISE_CHAIN = frozenset((
+    "relu", "gelu", "tanh", "sigmoid", "silu", "swish", "leaky_relu",
+    "elu", "softplus", "hardswish", "hardsigmoid", "dropout",
+    "dropout_axes", "alpha_dropout", "scale", "cast", "abs", "square",
+    "exp", "elementwise_add", "elementwise_mul", "elementwise_sub",
+    "add", "subtract", "multiply",
+))
+
+_MATMUL_OPS = frozenset(("linear", "matmul", "mul", "matmul_v2"))
+
+# grad all-reduces over the data axis overlap the rest of the backward
+# (the dist.gradcomm bucketing exists to exploit exactly that), while
+# model-axis activation all-reduces sit on the layer's critical path;
+# the cost model discounts overlappable traffic accordingly
+COMM_OVERLAP_DISCOUNT = 0.5
+
+# normalizing constants for the score: a v5e-class chip. Absolute
+# seconds are meaningless on the CPU test rig — only the RATIO between
+# compute and comm terms matters, and these keep it realistic.
+_DEFAULT_PEAK = 197e12
+_DEFAULT_BW = 200e9
+
+
+def _numel(shape):
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _dtype_bytes(dt):
+    try:
+        return int(np.dtype(dt).itemsize)
+    except TypeError:
+        return 4
+
+
+@dataclasses.dataclass
+class ProgramFacts:
+    """What one analysis pass learned about a Program."""
+
+    params: dict          # name -> (shape, dtype)
+    feeds: dict           # name -> (shape, dtype)
+    grads: list           # (grad_name, param_name, shape, dtype) consumed
+    #                       by optimize_* ops — the DP exchange set
+    sites: list           # matmul sites, program order (dicts, see below)
+    flops: float          # rough fwd+bwd FLOPs per step
+    activation_bytes: int  # sum of forward op output bytes
+    forward_len: int      # ops before the first grad op
+
+
+def analyze_program(program):
+    """One pass over the global block (see module docstring, step 1)."""
+    blk = program.global_block
+    params, feeds = {}, {}
+    for name, v in blk.vars.items():
+        if v.persistable and not name.startswith(("@", "_")):
+            params[name] = (tuple(v._data.shape), v._data.dtype)
+        elif v.is_data and not name.startswith("@"):
+            feeds[name] = (tuple(v._data.shape), v._data.dtype)
+
+    ops = list(blk.ops)
+    forward_len = len(ops)
+    for i, op in enumerate(ops):
+        if op.type.endswith("@grad") or op.type == "fill_ones_like" or \
+                op.type.startswith("optimize_"):
+            forward_len = i
+            break
+
+    grads = []
+    for op in ops:
+        if not op.type.startswith("optimize_"):
+            continue
+        pname = op.input_names[0]
+        for n in op.input_names[1:]:
+            if n is not None and n.endswith("@GRAD") and blk.has_var(n):
+                g = blk.var(n)
+                grads.append((n, pname, tuple(g._data.shape),
+                              g._data.dtype))
+                break
+
+    sites, flops, act_bytes = [], 0.0, 0
+    for i, op in enumerate(ops[:forward_len]):
+        out_shapes = [tuple(blk.var(n)._data.shape)
+                      for n in op.output_names if blk.has_var(n)]
+        act_bytes += sum(_numel(s) * 4 for s in out_shapes)
+        if op.type in _MATMUL_OPS and len(op.input_names) >= 2:
+            xn, wn = op.input_names[0], op.input_names[1]
+            bn = op.input_names[2] if len(op.input_names) > 2 else None
+            if wn in params and blk.has_var(xn):
+                w_shape = params[wn][0]
+                x_shape = tuple(blk.var(xn)._data.shape)
+                if len(w_shape) == 2 and len(x_shape) >= 2:
+                    K, N = w_shape
+                    M = _numel(x_shape[:-1])
+                    xv = blk.var(xn)
+                    sites.append({
+                        "op_index": i, "x": xn, "w": wn,
+                        "b": bn if bn in params else None,
+                        "M": M, "K": int(K), "N": int(N),
+                        "out": op.output_names[0],
+                        "x_requires_grad": not (
+                            xv.is_data or xv.stop_gradient),
+                    })
+                    flops += 2.0 * M * K * N
+        else:
+            flops += float(sum(_numel(s) for s in out_shapes))
+    flops *= 3.0  # fwd + ~2x bwd, the usual accounting
+    return ProgramFacts(params=params, feeds=feeds, grads=grads,
+                        sites=sites, flops=flops,
+                        activation_bytes=act_bytes,
+                        forward_len=forward_len)
+
+
+def _pair_tp_sites(facts, ops, t):
+    """Find committable Megatron (column, row) matmul pairs for a model
+    axis of size ``t``: column site -> elementwise chain -> row site,
+    with NO other forward consumer of the sharded activation. Returns
+    (pairs, specs) where specs maps weight/bias names to spec tuples."""
+    pairs, specs = [], {}
+    used = set()
+    sites_by_index = {s["op_index"]: s for s in facts.sites}
+    for site in facts.sites:
+        if site["op_index"] in used or site["N"] % t:
+            continue
+        taint = {site["out"]}
+        row = None
+        ok = True
+        for j in range(site["op_index"] + 1, facts.forward_len):
+            op = ops[j]
+            reads = [n for n in op.input_names if n in taint]
+            if not reads:
+                continue
+            if row is not None:
+                # a consumer of the sharded activation AFTER the row
+                # matmul (residual/skip branch): GSPMD would gather it
+                # — unpriced traffic, so the pair cannot commit
+                ok = False
+                break
+            cand = sites_by_index.get(j)
+            if cand is not None and cand["x"] in taint and \
+                    cand["op_index"] not in used and \
+                    cand["K"] % t == 0:
+                row = cand
+                continue  # keep scanning: later consumers void the pair
+            if op.type in _ELEMENTWISE_CHAIN:
+                taint.update(op.output_names)
+                continue
+            ok = False  # sharded activation leaks to an unpriced op
+            break
+        if ok and row is not None:
+            used.add(site["op_index"])
+            used.add(row["op_index"])
+            pairs.append((site, row))
+            specs[site["w"]] = (None, "model")
+            if site["b"] is not None:
+                specs[site["b"]] = ("model",)
+            specs[row["w"]] = ("model", None)
+            # the row bias adds AFTER the partial-sum all-reduce:
+            # replicated, like its output
+    return pairs, specs
+
+
+def _shard_factor(spec, axes):
+    n = 1
+    for p in spec or ():
+        for name in (p if isinstance(p, tuple) else (p,)):
+            if name is not None:
+                n *= axes.get(name, 1)
+    return n
+
+
+def _spec_fits(spec, shape, axes):
+    """A spec is usable on a shape iff every named axis lands on a dim
+    that exists and divides."""
+    spec = tuple(spec or ())
+    if len(spec) > len(shape):
+        return False
+    for i, p in enumerate(spec):
+        for name in (p if isinstance(p, tuple) else (p,)):
+            if name is None:
+                continue
+            if name not in axes or shape[i] % axes[name]:
+                return False
+    return True
+
+
+@dataclasses.dataclass
+class PlanCandidate:
+    """One scored layout (see ShardingPlan for the chosen winner)."""
+
+    roles: tuple
+    axes: dict
+    feasible: bool
+    note: str = ""
+    param_specs: dict = dataclasses.field(default_factory=dict)
+    feed_specs: dict = dataclasses.field(default_factory=dict)
+    predicted: dict = dataclasses.field(default_factory=dict)
+    score: float = float("inf")
+    compute_s: float = 0.0
+    comm_s: float = 0.0
+    param_bytes_per_device: int = 0
+    activation_bytes_per_device: int = 0
+
+    def summary(self):
+        return {
+            "axes": dict(self.axes), "roles": list(self.roles),
+            "feasible": self.feasible, "note": self.note,
+            "score": self.score,
+            "predicted_wire_bytes":
+                (self.predicted or {}).get("wire_bytes"),
+            "by_axis": (self.predicted or {}).get("by_axis"),
+            "param_bytes_per_device": self.param_bytes_per_device,
+            "activation_bytes_per_device":
+                self.activation_bytes_per_device,
+        }
+
+
+@dataclasses.dataclass
+class ShardingPlan:
+    """The planner's output: a mesh layout plus per-variable
+    PartitionSpecs, with its predicted (and, after ``verify_plan``,
+    measured) collective traffic. The Executor consumes it via the
+    ``CacheKey.plan`` axis; ``obs.journal`` records it as a ``plan``
+    event per compile."""
+
+    mesh_shape: tuple
+    roles: tuple
+    axes: dict                 # canonical {role: size}
+    param_specs: dict          # name -> spec tuple (PartitionSpec args)
+    feed_specs: dict           # name -> spec tuple
+    predicted: dict            # {"wire_bytes", "by_axis", "bytes"}
+    candidates: list           # every candidate's summary() for reports
+    measured: dict | None = None
+    source: str = "program"    # "program" | "layer"
+    device_ids: tuple | None = None  # pinned placement (plan_program
+    #                                  devices=), else first-N default
+
+    @property
+    def is_pure_dp(self):
+        return set(self.axes) <= {"data"}
+
+    @property
+    def data_size(self):
+        return int(self.axes.get("data", 1))
+
+    @property
+    def predicted_wire_bytes(self):
+        return (self.predicted or {}).get("wire_bytes")
+
+    @property
+    def measured_wire_bytes(self):
+        return (self.measured or {}).get("wire_bytes")
+
+    @property
+    def mismatch(self):
+        """Relative |predicted - measured| / measured, None until
+        verified (or when the step measures zero traffic)."""
+        p, m = self.predicted_wire_bytes, self.measured_wire_bytes
+        if p is None or not m:
+            return None
+        return abs(p - m) / m
+
+    def spec_for(self, name, shape=None):
+        """PartitionSpec args for one persistable. Optimizer slots
+        (``<param>@OPT@<k>``) and gradcomm state follow their param
+        when shaped like it; anything unknown (or that no longer fits
+        its shape) replicates."""
+        spec = self.param_specs.get(name)
+        if spec is None and "@OPT@" in name:
+            spec = self.param_specs.get(name.split("@OPT@")[0])
+        spec = tuple(spec or ())
+        if shape is not None and not _spec_fits(spec, shape, self.axes):
+            return ()
+        return spec
+
+    def feed_spec_for(self, name, shape=None):
+        spec = self.feed_specs.get(name)
+        if spec is None:
+            return ()
+        spec = tuple(spec)
+        if shape is not None and not _spec_fits(spec, shape, self.axes):
+            return ()
+        return spec
+
+    def build_mesh(self, devices=None):
+        if devices is None and self.device_ids is not None:
+            import jax
+
+            by_id = {d.id: d for d in jax.devices()}
+            devices = [by_id[i] for i in self.device_ids]
+        return _mesh.build_mesh(self.axes, devices=devices)
+
+    def cache_axis(self):
+        """Hashable identity for the Executor CacheKey ``plan`` axis:
+        everything that changes the compiled executable."""
+        return (self.device_ids, tuple(self.mesh_shape),
+                tuple(self.roles),
+                tuple(sorted(self.axes.items())),
+                tuple(sorted((k, tuple(v))
+                             for k, v in self.param_specs.items())),
+                tuple(sorted((k, tuple(v))
+                             for k, v in self.feed_specs.items())))
+
+    def event_fields(self, **extra):
+        """The journal ``plan`` event payload (one shape, used by the
+        Executor compile hook and the eager path alike)."""
+        out = {
+            "mesh_shape": list(self.mesh_shape),
+            "roles": list(self.roles),
+            "axes": dict(self.axes),
+            "source": self.source,
+            "predicted_wire_bytes": self.predicted_wire_bytes,
+            "measured_wire_bytes": self.measured_wire_bytes,
+            "mismatch": self.mismatch,
+        }
+        out.update(extra)
+        return out
+
+
+def _wire(kind, n, payload):
+    """Per-participant wire bytes, obs.spmd's ring-factor convention."""
+    from ..obs.spmd import wire_factor
+
+    return payload * wire_factor(kind, n)
+
+
+def _score_candidate(cand, facts, ops, peak, bw):
+    """Fill specs + predicted traffic + score for one candidate over a
+    static Program's facts. Mutates and returns ``cand``."""
+    axes = cand.axes
+    d = int(axes.get("data", 1))
+    t = int(axes.get("model", 1))
+    for role in axes:
+        if role not in ("data", "model"):
+            cand.feasible = False
+            cand.note = (f"role {role!r} needs runtime structure "
+                         "(MoE/pipeline) the static planner does not "
+                         "shard")
+            return cand
+
+    # feeds: shard the leading (batch) dim over data
+    feed_specs = {}
+    sharded_feed = False
+    for name, (shape, _dt) in facts.feeds.items():
+        if d > 1 and len(shape) >= 1 and shape[0] > 0 and \
+                shape[0] % d == 0:
+            feed_specs[name] = ("data",)
+            sharded_feed = True
+        else:
+            feed_specs[name] = ()
+    if d > 1 and facts.feeds and not sharded_feed:
+        cand.feasible = False
+        cand.note = (f"no feed's leading dim divides the {d}-way data "
+                     "axis (the step would run replicated)")
+        return cand
+
+    # model axis: committable Megatron pairs
+    param_specs = {}
+    pairs = []
+    if t > 1:
+        pairs, param_specs = _pair_tp_sites(facts, ops, t)
+        if not pairs:
+            cand.feasible = False
+            cand.note = (f"model axis of {t} finds no committable "
+                         "column->row matmul pair (indivisible dims or "
+                         "leaky activation consumers)")
+            return cand
+
+    # -- predicted wire bytes (per-participant, obs.spmd convention) --
+    by_axis = {}
+    wire_overlappable = 0.0
+    wire_critical = 0.0
+    if d > 1:
+        g_bytes = 0.0
+        for _gname, pname, shape, dt in facts.grads:
+            f = _shard_factor(param_specs.get(pname), axes)
+            g_bytes += _numel(shape) * _dtype_bytes(dt) / f
+        w = _wire("all-reduce", d, g_bytes)
+        by_axis["data"] = by_axis.get("data", 0.0) + w
+        wire_overlappable += w
+    if t > 1:
+        a_bytes = 0.0
+        for col, row in pairs:
+            # forward: the row matmul's partial-sum all-reduce
+            a_bytes += (row["M"] // d if d > 1 else row["M"]) * \
+                row["N"] * 4
+            # backward: the column input's gradient all-reduce (absent
+            # when the input is a feed — XLA DCEs the unused dx)
+            if col["x_requires_grad"]:
+                a_bytes += (col["M"] // d if d > 1 else col["M"]) * \
+                    col["K"] * 4
+        w = _wire("all-reduce", t, a_bytes)
+        by_axis["model"] = by_axis.get("model", 0.0) + w
+        wire_critical += w
+
+    wire = wire_overlappable + wire_critical
+    cand.param_specs = param_specs
+    cand.feed_specs = feed_specs
+    cand.predicted = {
+        "wire_bytes": int(round(wire)),
+        "by_axis": {k: int(round(v)) for k, v in by_axis.items()},
+        "bytes": {"all-reduce": int(round(wire))},
+        "tp_pairs": len(pairs),
+    }
+
+    # -- score: comm (overlap-discounted) + compute over exploited axes
+    effective = d * (t if pairs else 1)
+    cand.compute_s = facts.flops / (peak * effective)
+    cand.comm_s = (wire_critical +
+                   COMM_OVERLAP_DISCOUNT * wire_overlappable) / bw
+    cand.score = cand.compute_s + cand.comm_s
+    cand.feasible = True
+    cand.note = f"{len(pairs)} tp pair(s)" if pairs else "pure dp"
+
+    # -- memory footprint (reported, not scored: CPU CI has no HBM cap)
+    pb = 0
+    for name, (shape, dt) in facts.params.items():
+        pb += _numel(shape) * _dtype_bytes(dt) // \
+            _shard_factor(param_specs.get(name), axes)
+    cand.param_bytes_per_device = pb
+    cand.activation_bytes_per_device = int(
+        facts.activation_bytes // (d if d > 1 else 1))
+    return cand
+
+
+def plan_program(program, mesh_shape, roles=None, devices=None,
+                 peak=None, bw=None):
+    """Plan a static Program onto ``mesh_shape``. ``roles`` pins the
+    per-axis role assignment (the operator knows the topology); left
+    None, every canonical assignment over {data, model} is scored and
+    the cheapest feasible one wins. Raises when nothing is feasible."""
+    n_devices = device_ids = None
+    if devices is not None:
+        devs = np.asarray(devices).reshape(-1)
+        n_devices = int(devs.size)
+        # pin the placement: build_mesh (and the Executor compiling
+        # under this plan) lays out over THESE devices, not the
+        # first-N default
+        device_ids = tuple(int(d.id) for d in devs)
+    shape = _mesh.validate_mesh_shape(mesh_shape, n_devices=n_devices)
+    facts = analyze_program(program)
+    ops = list(program.global_block.ops)
+    peak = peak or _DEFAULT_PEAK
+    bw = bw or _ici_bw_or_default()
+
+    if roles is not None:
+        assignments = [(tuple(roles),
+                        _mesh.canonical_axes(shape, roles))]
+    else:
+        assignments = _mesh.candidate_assignments(shape)
+    cands = [_score_candidate(
+        PlanCandidate(roles=r, axes=a, feasible=False), facts, ops,
+        peak, bw) for r, a in assignments]
+    feasible = [c for c in cands if c.feasible]
+    if not feasible:
+        detail = "; ".join(f"{c.axes}: {c.note}" for c in cands)
+        raise ValueError(
+            f"no feasible layout for mesh {shape} on this program "
+            f"({detail})")
+    best = min(feasible, key=lambda c: c.score)
+    return ShardingPlan(
+        mesh_shape=shape, roles=best.roles, axes=dict(best.axes),
+        param_specs=dict(best.param_specs),
+        feed_specs=dict(best.feed_specs),
+        predicted=dict(best.predicted),
+        candidates=[c.summary() for c in cands], source="program",
+        device_ids=device_ids)
+
+
+def _ici_bw_or_default():
+    from ..obs.spmd import ici_bandwidth
+
+    return ici_bandwidth() or _DEFAULT_BW
+
+
+# -- eager path ---------------------------------------------------------------
+
+
+def plan_layer(model, mesh_shape, roles=None, batch_example=None,
+               peak=None, bw=None):
+    """Plan an eager Layer onto ``mesh_shape`` from its parameters'
+    declared ``sharding_spec``s (TP/MoE layers mark their own weights —
+    the planner decides which declared axes the mesh affords). Gradient
+    traffic prices like the static path; activation traffic for the
+    model axis is estimated from ``batch_example`` (arrays or shapes)
+    as one partial-sum all-reduce per row-sharded weight."""
+    shape = _mesh.parse_mesh_shape(mesh_shape)
+    params = []
+    for name, p in model.named_parameters():
+        # the DECLARED spec: auto_parallel_step stashes the original
+        # under _declared_sharding_spec before installing the plan's
+        # placements, so replanning reads the layer's declaration, not
+        # a previous plan's output
+        spec = getattr(p, "_declared_sharding_spec",
+                       getattr(p, "sharding_spec", None))
+        params.append((name, p, tuple(p._data.shape), spec))
+    declared_axes = set()
+    for _n, _p, _shape, spec in params:
+        for part in tuple(spec or ()):
+            for ax in (part if isinstance(part, tuple) else (part,)):
+                if ax is not None:
+                    declared_axes.add(ax)
+    alphabet = tuple(r for r in ("data", "model", "expert", "pipe")
+                     if r == "data" or r in declared_axes)
+    m_tokens = batch_dim = None
+    if batch_example is not None:
+        first = batch_example[0] if isinstance(
+            batch_example, (tuple, list)) else batch_example
+        bshape = tuple(getattr(first, "shape", first))
+        m_tokens = _numel(bshape[:2]) if len(bshape) >= 2 else \
+            _numel(bshape)
+        batch_dim = int(bshape[0]) if bshape else None
+    peak = peak or _DEFAULT_PEAK
+    bw = bw or _ici_bw_or_default()
+
+    if roles is not None:
+        assignments = [(tuple(roles),
+                        _mesh.canonical_axes(shape, roles))]
+    else:
+        assignments = _mesh.candidate_assignments(shape, roles=alphabet)
+
+    cands = []
+    for r, axes in assignments:
+        cand = PlanCandidate(roles=r, axes=axes, feasible=True)
+        d = int(axes.get("data", 1))
+        if d > 1 and batch_dim is not None and batch_dim % d:
+            # the step would fail at device_put — infeasible at plan
+            # time, like the static path's feed-divisibility guard
+            cand.feasible = False
+            cand.note = (f"batch dim {batch_dim} does not divide the "
+                         f"{d}-way data axis")
+            cands.append(cand)
+            continue
+        specs = {}
+        used_axes = set()
+        for name, _p, pshape, spec in params:
+            spec = tuple(spec or ())
+            if spec and _spec_fits(spec, pshape, axes):
+                specs[name] = spec
+                for part in spec:
+                    for ax in (part if isinstance(part, tuple)
+                               else (part,)):
+                        if ax is not None:
+                            used_axes.add(ax)
+            else:
+                specs[name] = ()
+        idle = [a for a in axes if a != "data" and a not in used_axes]
+        if idle:
+            cand.feasible = False
+            cand.note = f"axes {idle} shard no parameter"
+            cands.append(cand)
+            continue
+        g_bytes = sum(_numel(pshape) * 4 / _shard_factor(specs[n], axes)
+                      for n, _p, pshape, _s in params)
+        wire_ov = _wire("all-reduce", d, g_bytes) if d > 1 else 0.0
+        wire_cr = 0.0
+        by_axis = {}
+        if wire_ov:
+            by_axis["data"] = int(round(wire_ov))
+        for ax in axes:
+            if ax in ("data",):
+                continue
+            n_ax = axes[ax]
+            if m_tokens:
+                # one partial-sum AR per row-sharded (dim-0) 2D weight
+                a_bytes = 0.0
+                for n, _p, pshape, _s in params:
+                    sp = specs[n]
+                    if len(pshape) >= 2 and sp and sp[0] is not None \
+                            and ax in (sp[0] if isinstance(sp[0], tuple)
+                                       else (sp[0],)):
+                        a_bytes += (m_tokens // d) * pshape[-1] * 4
+                w = _wire("all-reduce", n_ax, a_bytes)
+                by_axis[ax] = int(round(w))
+                wire_cr += w
+        cand.param_specs = specs
+        cand.predicted = {
+            "wire_bytes": int(round(wire_ov + wire_cr)),
+            "by_axis": by_axis, "bytes": {},
+        }
+        eff = d
+        for ax, n_ax in axes.items():
+            if ax != "data" and ax in used_axes:
+                eff *= int(n_ax)
+        # 6ND transformer accounting over the TOTAL (unsharded) param
+        # count — the per-device speedup lives in eff alone, never in
+        # the numerator (g_bytes is already sharded; reusing it here
+        # would double-count the model-axis split)
+        total_numel = sum(_numel(pshape) for _n, _p, pshape, _s in params)
+        flops = 6.0 * total_numel * (m_tokens or 1)
+        cand.compute_s = flops / (peak * max(eff, 1))
+        cand.comm_s = (wire_cr + COMM_OVERLAP_DISCOUNT * wire_ov) / bw
+        cand.score = cand.compute_s + cand.comm_s
+        cand.param_bytes_per_device = int(g_bytes)
+        cand.note = "declared specs" if used_axes else "pure dp"
+        cands.append(cand)
+
+    feasible = [c for c in cands if c.feasible]
+    if not feasible:
+        detail = "; ".join(f"{c.axes}: {c.note}" for c in cands)
+        raise ValueError(f"no feasible layout for mesh {shape} on this "
+                         f"model ({detail})")
+    best = min(feasible, key=lambda c: c.score)
+    return ShardingPlan(
+        mesh_shape=shape, roles=best.roles, axes=dict(best.axes),
+        param_specs=dict(best.param_specs), feed_specs={},
+        predicted=dict(best.predicted),
+        candidates=[c.summary() for c in cands], source="layer")
+
+
+# -- verification -------------------------------------------------------------
+
+
+def verify_plan(plan, program, executor=None, fetch_list=None):
+    """Compile ``program`` under ``plan`` through the real Executor path
+    and fill ``plan.measured`` from the executable's CollectiveProfile
+    (``obs.spmd``). BLOCKING — pays one XLA compile; call it from
+    planning/reporting code, never the step path. Requires the startup
+    program to have run (persistables materialized in the scope).
+    Returns the measured profile (or None when analysis fails)."""
+    import jax
+
+    from ..obs.mfu import entry_analysis
+    from ..static_.executor import Executor
+
+    exe = executor or Executor()
+    feeds = {name: jax.ShapeDtypeStruct(shape, np.dtype(dt))
+             for name, (shape, dt) in
+             analyze_program(program).feeds.items()}
+    if program._lr_getter is not None:
+        # Executor.run injects the scheduler lr each step; the probe
+        # compile must present the same feed surface
+        feeds["@lr"] = jax.ShapeDtypeStruct((), np.float32)
+    compiled = exe._compile(program, feeds, fetch_list or [],
+                            data_parallel=True, plan=plan)
+    prof = (entry_analysis(compiled) or {}).get("collectives")
+    if prof:
+        plan.measured = {
+            "wire_bytes": prof.get("wire_bytes"),
+            "by_axis": prof.get("by_axis"),
+            "counts": prof.get("counts"),
+            "bytes": prof.get("bytes"),
+        }
+        from ..obs import journal as _journal
+
+        if _journal.ACTIVE is not None:
+            # the probe compile's plan event above predated the
+            # measurement; journal the verified record (predicted AND
+            # measured) so reports don't read the plan as unverified
+            _journal.ACTIVE.record_plan(plan, uid=program._uid,
+                                        version=program._version,
+                                        verified=True)
+    return prof

@@ -38,7 +38,10 @@ _ALIASES = {
     "optimizer": ".optim",
     "regularizer": ".optim.regularizer",
     "distributed": ".dist",           # ref: python/paddle/distributed/launch.py
-    "fleet": ".dist.fleet",
+    # paddle.fleet -> the auto-parallel package (PR 10), which re-exports
+    # the whole pre-plan dist.fleet surface and PEP-562-forwards the
+    # singleton, so old fleet.* call sites resolve unchanged
+    "fleet": ".fleet",
     "imperative": ".fluid.dygraph",   # ref: python/paddle/imperative (dygraph alias)
     "static": ".static_",
     "device": ".core.device",

@@ -490,6 +490,17 @@ class RunJournal:
             self._write(rec, _locked=True)
         return rec
 
+    def record_plan(self, plan, **fields):
+        """One ``plan`` event per auto-parallel compile
+        (``fleet.auto_parallel`` / ``auto_parallel_step``): the mesh
+        shape, per-axis roles, canonical axes, and the planner's
+        predicted vs HLO-measured collective wire bytes (mismatch is
+        their relative delta; None until ``fleet.verify_plan`` ran).
+        One payload shape for both the static and eager paths —
+        ``tools/run_report.py`` renders it and gates on the mismatch
+        in ``--diff``."""
+        return self.event("plan", **plan.event_fields(), **fields)
+
     def note_step_ms(self, ms):
         """StepTimer feed: remember the latest timed step so the next
         ``record_step`` without an explicit ``step_ms`` uses it."""

@@ -54,7 +54,7 @@ __all__ = [
     "COLLECTIVE_KINDS", "parse_hlo_collectives", "collective_profile",
     "merge_profiles", "ICI_BW_BY_KIND", "ici_bandwidth", "comm_roofline",
     "sharding_report", "sharding_summary", "device_memory_stats",
-    "update_device_gauges", "profile_jit_fn", "mesh_info",
+    "update_device_gauges", "profile_jit_fn", "mesh_info", "wire_factor",
 ]
 
 # canonical collective kinds (HLO op mnemonics); async forms appear as
@@ -99,6 +99,15 @@ _WIRE_FACTOR = {
     "all-to-all": lambda n: (n - 1) / n if n > 1 else 0.0,
     "collective-permute": lambda n: 1.0,
 }
+
+
+def wire_factor(kind, group_size):
+    """Public read of the ring-algorithm wire-traffic factor for one
+    collective kind at one group size — the SAME convention
+    ``collective_profile`` measures by, so a predictor (fleet.planner)
+    that prices with this factor is directly comparable to the
+    HLO-measured profile."""
+    return _WIRE_FACTOR[kind](int(group_size))
 
 
 def _shape_bytes(type_str, kind=None, is_async=False):

@@ -56,6 +56,12 @@ class CacheKey:
     (``dist.gradcomm``): bucket layout / accumulation / quantization
     each change the compiled exchange, so they key distinct
     executables.
+
+    ``plan`` is ``None`` for hand-specified parallelism and
+    ``ShardingPlan.cache_axis()`` for ``fleet.auto_parallel`` entries:
+    the plan's mesh layout and per-variable PartitionSpecs are baked
+    into the executable's shardings, so two different plans over the
+    same program/feeds are genuinely different executables.
     """
 
     program_uid: int
@@ -68,6 +74,7 @@ class CacheKey:
     data_parallel: bool
     allow_replicated_fallback: bool
     comm: tuple | None = None
+    plan: tuple | None = None
 
 
 class _Compiled:
@@ -422,11 +429,15 @@ class Executor:
 
     def _compile(self, program, feed, fetch_list, data_parallel=False,
                  allow_replicated_fallback=False, optimize_level=None,
-                 steps=None, comm_options=None):
+                 steps=None, comm_options=None, plan=None):
         from ..analysis import normalize_fetch
 
         if optimize_level is None:
             optimize_level = self.optimize_level
+        if plan is not None:
+            # an auto-parallel plan IS a data-parallel layout (its data
+            # axis may be the whole mesh); the plan decides shardings
+            data_parallel = True
         if _chaos.ACTIVE:  # chaos points: transient / optimized-only failure
             _chaos.fire("transient_compile")
             _chaos.fire("opt_compile_fail", optimize_level=optimize_level)
@@ -448,7 +459,8 @@ class Executor:
             steps=None if steps is None else int(steps),
             data_parallel=bool(data_parallel),
             allow_replicated_fallback=bool(allow_replicated_fallback),
-            comm=None if comm_options is None else comm_options.cache_axis())
+            comm=None if comm_options is None else comm_options.cache_axis(),
+            plan=None if plan is None else plan.cache_axis())
         if key in self._cache:
             compiled = self._cache[key]
             # coherence: uid+version are in the key, so a hit is the right
@@ -473,7 +485,8 @@ class Executor:
             compiled = self._build(program, feed_names, fetch_names, shapes,
                                    fetch_list, data_parallel,
                                    allow_replicated_fallback, optimize_level,
-                                   steps=steps, comm_options=comm_options)
+                                   steps=steps, comm_options=comm_options,
+                                   plan=plan)
         # NOTE: jax.jit is lazy — this times trace-side work (analysis
         # passes + jit wrapper construction); XLA's own compile lands in
         # the first executor.run_ms sample for this key
@@ -491,13 +504,27 @@ class Executor:
 
             _journal.ACTIVE.event("sharding",
                                   **_spmd.sharding_summary(compiled))
+            if plan is not None:
+                # one plan event per auto-parallel compile: the layout
+                # the planner chose and its predicted-vs-measured wire
+                # bytes (measured filled by fleet.verify_plan)
+                _journal.ACTIVE.record_plan(plan, uid=program._uid,
+                                            version=program._version)
         self._cache[key] = compiled
         return compiled
 
     def _build(self, program, feed_names, fetch_names, shapes, fetch_list,
                data_parallel, allow_replicated_fallback, optimize_level,
-               steps=None, comm_options=None):
+               steps=None, comm_options=None, plan=None):
         from ..analysis import run_compile_passes
+
+        if plan is not None and comm_options is not None and \
+                not plan.is_pure_dp:
+            raise ValueError(
+                "comm_options (dist.gradcomm) composes only with a "
+                "pure data-parallel plan: the explicit exchange vmaps "
+                f"over a single 'data' axis, but the plan spans "
+                f"{plan.axes}")
 
         scope = global_scope()
         blk = program.global_block
@@ -561,7 +588,44 @@ class Executor:
                     body, list(updated_arrs), list(stacked_feeds), length=K)
                 return ys, new_updated
 
-        if data_parallel:
+        if data_parallel and plan is not None and not plan.is_pure_dp:
+            # fleet.auto_parallel: the plan owns the layout — a multi-
+            # axis mesh with per-variable PartitionSpecs (batch feeds
+            # over the data axes, TP-paired weights over the model axis)
+            # instead of the one-axis shard-the-batch default below.
+            # GSPMD still inserts every collective; the plan just sets
+            # the shardings it partitions around.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = plan.build_mesh()
+            rep = NamedSharding(mesh, P())
+
+            def feed_sharding(name, shape):
+                spec = plan.feed_spec_for(name, shape)
+                if not spec:
+                    return rep
+                # fused entries carry a leading K scan axis every device
+                # walks identically — the plan's specs shift right
+                return NamedSharding(
+                    mesh, P(*(((None,) + tuple(spec)) if steps
+                              else spec)))
+
+            feed_sh = [feed_sharding(n, s)
+                       for n, (s, _) in zip(feed_names, shapes)]
+
+            def persist_sharding(name):
+                a = scope.find_var(name)
+                shape = tuple(a.shape) if a is not None else None
+                spec = plan.spec_for(name, shape)
+                return NamedSharding(mesh, P(*spec)) if spec else rep
+
+            upd_sh = [persist_sharding(n) for n in updated]
+            frz_sh = [persist_sharding(n) for n in frozen]
+            in_sh = (feed_sh, upd_sh, frz_sh)
+            out_sh = ([rep] * len(fetch_names), upd_sh)
+            jit_fn = jax.jit(raw, donate_argnums=(1,), in_shardings=in_sh,
+                             out_shardings=out_sh)
+        elif data_parallel:
             # Shard the feed batch axis over the data mesh; persistables
             # stay replicated. XLA partitions the one program and inserts
             # the grad all-reduce itself (GSPMD) — the TPU analog of the
@@ -628,6 +692,12 @@ class Executor:
         compiled = _Compiled(jit_fn, feed_names, updated + frozen, updated,
                              fetch_names)
         compiled.feed_shardings = in_sh[0] if data_parallel else None
+        # persistable in_shardings, kept so the run path can re-place a
+        # scope array a DIFFERENT entry committed to another mesh (two
+        # plans over one program, or plan vs plain-DP): pjit refuses to
+        # silently reshard committed args across meshes
+        compiled.persist_shardings = (in_sh[1], in_sh[2]) \
+            if data_parallel else None
         if data_parallel:
             # mesh identity for collective attribution + sharding
             # reports (obs.spmd): axis sizes and the device-id layout
@@ -648,6 +718,7 @@ class Executor:
         compiled.steps = None if steps is None else int(steps)
         compiled.comm_options = comm_options
         compiled.comm_plan = comm_plan if comm_options is not None else None
+        compiled.plan = plan  # fleet.auto_parallel ShardingPlan (or None)
         # shape/dtype-only arg structs (no device data): what the lazy
         # per-entry memory/FLOP attribution (obs.mfu.entry_analysis) and
         # the journal's MFU accounting re-lower against on demand. Fused
@@ -728,6 +799,31 @@ class Executor:
         return a.shape, str(a.dtype)
 
     @staticmethod
+    def _align_persistables(compiled, updated, frozen):
+        """Re-place scope persistables whose COMMITTED sharding no
+        longer matches this entry's in_shardings (the array was last
+        touched by an entry over a different mesh — e.g. two
+        auto-parallel plans over one program). pjit would reject the
+        mismatch instead of resharding; an explicit device_put is the
+        sanctioned cross-mesh move. Metadata-only when nothing moved:
+        one sharding equality check per persistable."""
+        shs = getattr(compiled, "persist_shardings", None)
+        if shs is None:
+            return updated, frozen
+
+        def fix(vals, shardings):
+            out = []
+            for v, sh in zip(vals, shardings):
+                if isinstance(v, jax.Array) and \
+                        getattr(v, "committed", False) and \
+                        v.sharding != sh:
+                    v = jax.device_put(v, sh)
+                out.append(v)
+            return out
+
+        return fix(updated, shs[0]), fix(frozen, shs[1])
+
+    @staticmethod
     def _as_device(v):
         """Feed value -> jax array via the canonical
         ``core.tensor.as_device_array`` (already-device arrays pass
@@ -740,7 +836,7 @@ class Executor:
     def _unwrap_program(program):
         """CompiledProgram / transpiled-DP normalization shared by run
         and run_steps: returns (program, data_parallel,
-        allow_replicated_fallback, comm_options)."""
+        allow_replicated_fallback, comm_options, plan)."""
         from .compiler import CompiledProgram
 
         if program is None:
@@ -748,12 +844,16 @@ class Executor:
         data_parallel = False
         allow_replicated_fallback = False
         comm_options = None
+        plan = None
         if isinstance(program, CompiledProgram):
             data_parallel = program._data_parallel
             allow_replicated_fallback = getattr(
                 program._exec_strategy, "allow_replicated_fallback", False)
             comm_options = getattr(program._build_strategy, "comm_options",
                                    None)
+            # fleet.auto_parallel attaches its ShardingPlan here; the
+            # plan then rides _compile as a genuine CacheKey axis
+            plan = getattr(program, "_plan", None)
             program = program._program
         if getattr(program, "_transpiled_dp", False):
             # fluid.transpiler.collective.GradAllReduce marked this
@@ -761,7 +861,7 @@ class Executor:
             # CompiledProgram.with_data_parallel)
             data_parallel = True
         return program, data_parallel, allow_replicated_fallback, \
-            comm_options
+            comm_options, plan
 
     @staticmethod
     def _materialize_fetches(fetches, return_numpy, fetch_async):
@@ -800,8 +900,8 @@ class Executor:
         caller pays the sync when it first reads a value (or via
         ``jax.block_until_ready``). Overrides ``return_numpy``.
         """
-        program, data_parallel, allow_replicated_fallback, comm_options = \
-            self._unwrap_program(program)
+        program, data_parallel, allow_replicated_fallback, comm_options, \
+            plan = self._unwrap_program(program)
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope or global_scope()
@@ -820,13 +920,16 @@ class Executor:
             compiled = self._compile(
                 program, feed, fetch_list, data_parallel=data_parallel,
                 allow_replicated_fallback=allow_replicated_fallback,
-                optimize_level=optimize_level, comm_options=comm_options)
+                optimize_level=optimize_level, comm_options=comm_options,
+                plan=plan)
             if _chaos.ACTIVE:  # disabled => one empty-dict test, no host sync
                 _chaos.fire("transient_execute")
                 feed = _chaos.fire("nan_feed", feed)
             feeds = [self._as_device(feed[n]) for n in compiled.feed_names]
             updated = [scope.find_var(n) for n in compiled.updated]
             frozen = [scope.find_var(n) for n in compiled.frozen]
+            updated, frozen = self._align_persistables(compiled, updated,
+                                                       frozen)
             self._dispatches += 1
             _M_DISPATCHES.inc()
             fetches, new_persist = compiled.fn(feeds, updated, frozen)
@@ -869,8 +972,8 @@ class Executor:
         (numpy by default; lazy/async under ``return_numpy=False`` /
         ``fetch_async=True`` as in ``run``).
         """
-        program, data_parallel, allow_replicated_fallback, comm_options = \
-            self._unwrap_program(program)
+        program, data_parallel, allow_replicated_fallback, comm_options, \
+            plan = self._unwrap_program(program)
         fetch_list = fetch_list or []
         scope = scope or global_scope()
 
@@ -945,7 +1048,7 @@ class Executor:
                 program, per_step, fetch_list, data_parallel=data_parallel,
                 allow_replicated_fallback=allow_replicated_fallback,
                 optimize_level=optimize_level, steps=K,
-                comm_options=comm_options)
+                comm_options=comm_options, plan=plan)
             if _chaos.ACTIVE:  # window-granularity chaos (one fused step)
                 _chaos.fire("transient_execute")
                 stacked = _chaos.fire("nan_feed", stacked)
@@ -953,6 +1056,8 @@ class Executor:
                          for n in compiled.feed_names]
             updated = [scope.find_var(n) for n in compiled.updated]
             frozen = [scope.find_var(n) for n in compiled.frozen]
+            updated, frozen = self._align_persistables(compiled, updated,
+                                                       frozen)
             self._dispatches += 1
             _M_DISPATCHES.inc()
             fetches, new_persist = compiled.fn(feed_arrs, updated, frozen)
@@ -1039,7 +1144,7 @@ class Executor:
         from ..io_.dataloader import (DevicePrefetcher,
                                       executor_feed_shardings)
 
-        prog, _, _, comm_options = self._unwrap_program(program)
+        prog, _, _, comm_options, _plan = self._unwrap_program(program)
         accum = int(getattr(comm_options, "accumulate_steps", 1) or 1)
         it = iter(dataset.iter_batches())
         last = None

@@ -290,9 +290,10 @@ def test_alias_submodules_share_identity():
 
 
 def test_fleet_module_superset_of_singleton():
-    """Importing the fleet submodule clobbers the parent's ``fleet``
-    attribute with the module (import-system setattr); the module must
-    therefore expose the full singleton API via PEP 562 forwarding."""
+    """Both fleet spellings — the old ``distributed.fleet`` module and
+    the ``paddle_tpu.fleet`` auto-parallel package that now owns the
+    top-level alias — must expose the full singleton API via PEP 562
+    forwarding (old fleet.* call sites resolve unchanged)."""
     import importlib
 
     m = importlib.import_module("paddle_tpu.distributed.fleet")
@@ -300,6 +301,16 @@ def test_fleet_module_superset_of_singleton():
     m.stop_worker()
     assert m.worker_num() >= 1
     assert callable(m.build_train_step)
-    assert pt.fleet is m
     with pytest.raises(AttributeError):
         m.definitely_not_an_attr
+
+    pkg = importlib.import_module("paddle_tpu.fleet")
+    assert pt.fleet is pkg
+    pkg.init_worker()
+    pkg.stop_worker()
+    assert pkg.worker_num() >= 1
+    assert callable(pkg.build_train_step)
+    assert pkg.DistributedStrategy is m.DistributedStrategy
+    assert callable(pkg.auto_parallel)  # the new surface rides the alias
+    with pytest.raises(AttributeError):
+        pkg.definitely_not_an_attr

@@ -133,6 +133,19 @@ def test_elastic_run_self_test_passes():
     assert mod.main(["--self-test"]) == 0
 
 
+def test_fleet_plan_self_test_passes():
+    """tools/fleet_plan.py --self-test: mesh canonicalization/validation
+    fixtures, the hand-computed 412 B cost fixture (Megatron pairing +
+    ring-factor wire accounting must be EXACT), a live 8-fake-device
+    fleet.auto_parallel run whose predicted wire bytes match the
+    compiled HLO's CollectiveProfile within 10% (plan-keyed cache
+    entry, finite losses), and the tp-heavy model preferring
+    dp2 x model4 over pure DP with a visible cost delta. In-process so
+    it rides the tier-1 command path like the other self-tests."""
+    mod = _load_tool("fleet_plan")
+    assert mod.main(["--self-test"]) == 0
+
+
 def test_chaos_marker_is_registered():
     """tests/test_resilience.py marks itself `chaos`; an unregistered
     marker would warn (or fail under --strict-markers). Pin it."""

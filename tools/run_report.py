@@ -34,6 +34,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 DEFAULT_STEP_TIME_THRESHOLD = 0.25   # mean step_ms may grow 25%
 DEFAULT_LOSS_THRESHOLD = 0.05        # final loss may grow 5% (relative)
 DEFAULT_COMM_THRESHOLD = 0.10        # all-reduce bytes/step may grow 10%
+DEFAULT_PLAN_MISMATCH_THRESHOLD = 0.10  # planner predicted-vs-measured
 
 
 # -- loading -----------------------------------------------------------------
@@ -205,6 +206,34 @@ def elastic_summary(run):
     return out
 
 
+def plan_summary(run):
+    """Auto-parallel columns over the run's ``plan`` events (one per
+    ``fleet.auto_parallel`` compile): plan count, the meshes chosen,
+    and the worst predicted-vs-measured wire-byte mismatch — the number
+    the planner's cost model is accountable to. None when the run never
+    auto-parallelized."""
+    events = [e for e in run.get("events") or []
+              if e.get("kind") == "plan"]
+    if not events:
+        return None
+    mismatches = [e["mismatch"] for e in events
+                  if isinstance(e.get("mismatch"), (int, float))]
+    axes = []
+    for e in events:
+        a = e.get("axes")
+        if a and a not in axes:
+            axes.append(a)
+    return {
+        "plans": len(events),
+        "axes": axes,
+        "predicted_wire_bytes": [e.get("predicted_wire_bytes")
+                                 for e in events],
+        "measured_wire_bytes": [e.get("measured_wire_bytes")
+                                for e in events],
+        "max_mismatch": max(mismatches) if mismatches else None,
+    }
+
+
 def gate_summary(run):
     """Perf-gate columns over the run's ``perf_gate`` events (written by
     ``tools/perf_gate.journal_gates``): entries gated, failure count,
@@ -287,6 +316,14 @@ def render_run(run, as_json=False):
                 lines.append(
                     f"{label:<12} p50={rsum[f'{key}_p50']:.3f} "
                     f"p99={rsum[f'{key}_p99']:.3f}")
+    psum = plan_summary(run)
+    if psum:
+        mism = psum["max_mismatch"]
+        lines.append(
+            f"plan         {psum['plans']} auto-parallel compile(s), "
+            f"axes={psum['axes']}"
+            + (f", predicted-vs-measured mismatch max={mism:.1%}"
+               if mism is not None else ", unverified"))
     gsum = gate_summary(run)
     if gsum:
         lines.append(f"perf_gates   {gsum['entries']} entries, "
@@ -365,13 +402,26 @@ def diff_runs(base, new,
     out["gate_regression"] = bool(ng and nfail > bfail)
     if out["gate_regression"]:
         out["gate_failure_detail"] = (ng or {}).get("failures")
+    # auto-parallel plan-mismatch column (fleet planner accountability):
+    # NEW's cost model drifting >threshold off the HLO-measured bytes —
+    # and off whatever BASE achieved — means the planner is choosing
+    # layouts on wrong numbers, a regression even when this run's wall
+    # time looks fine
+    bp, np_ = plan_summary(base), plan_summary(new)
+    bmis = (bp or {}).get("max_mismatch")
+    nmis = (np_ or {}).get("max_mismatch")
+    out["base_plan_mismatch"] = bmis
+    out["new_plan_mismatch"] = nmis
+    out["plan_regression"] = bool(
+        nmis is not None and nmis > DEFAULT_PLAN_MISMATCH_THRESHOLD and
+        (bmis is None or nmis > bmis))
     if bl is not None and nl is not None:
         margin = loss_threshold * max(abs(bl), 1e-12)
         out["loss_delta"] = nl - bl
         out["loss_regression"] = bool(nl - bl > margin)
     out["regression"] = out["step_time_regression"] or \
         out["loss_regression"] or out["comm_regression"] or \
-        out["gate_regression"]
+        out["gate_regression"] or out["plan_regression"]
     return out
 
 
@@ -390,6 +440,8 @@ def render_diff(rep, as_json=False):
               "base_comm_share", "new_comm_share",
               "base_gate_failures", "new_gate_failures",
               "gate_regression", "gate_failure_detail",
+              "base_plan_mismatch", "new_plan_mismatch",
+              "plan_regression",
               "base_anomalies", "new_anomalies", "regression"):
         if rep.get(k) is not None:
             lines.append(f"{k:<22} {fmt(rep[k])}")
@@ -400,7 +452,7 @@ def render_diff(rep, as_json=False):
 
 
 def _write_run(run_dir, losses, step_ms, flops=1e9, nonfinite_at=(),
-               comm_bytes=None, gate_failures=()):
+               comm_bytes=None, gate_failures=(), plan_bytes=None):
     """Drive the REAL RunJournal API to produce one synthetic run."""
     from paddle_tpu.obs import journal as J
 
@@ -416,6 +468,17 @@ def _write_run(run_dir, losses, step_ms, flops=1e9, nonfinite_at=(),
     j.event("perf_gate", entry_uid=1, steps_fused=None, donated=4,
             while_ops=0, fusion_ops=3, failures=list(gate_failures),
             passed=not gate_failures, compiles=1, dispatches=30)
+    if plan_bytes is not None:
+        # one auto-parallel plan event through the real record_plan
+        # path; (predicted, measured) inject the mismatch under test
+        from paddle_tpu.fleet.planner import ShardingPlan
+
+        pred, meas = plan_bytes
+        j.record_plan(ShardingPlan(
+            mesh_shape=(2, 4), roles=("data", "model"),
+            axes={"data": 2, "model": 4}, param_specs={}, feed_specs={},
+            predicted={"wire_bytes": pred}, candidates=[],
+            measured={"wire_bytes": meas}))
     for i, loss in enumerate(losses):
         if i in nonfinite_at:
             j.record_step(loss=float("nan"), step_ms=step_ms,
@@ -438,7 +501,8 @@ def self_test():
             # run A: healthy — loss decays 1.0 -> ~0.1, 10ms steps,
             # 1 MiB of all-reduce per step
             _write_run(a_dir, [1.0 * (0.93 ** i) for i in range(30)],
-                       step_ms=10.0, comm_bytes=1 << 20)
+                       step_ms=10.0, comm_bytes=1 << 20,
+                       plan_bytes=(100_000, 101_000))
             # run B: regressed — 3x slower steps, a loss spike after
             # which the loss never recovers, a 3-step nonfinite
             # streak, and 2x the all-reduce traffic (a partitioner
@@ -447,9 +511,12 @@ def self_test():
             losses[20] = 50.0  # spike...
             for i in range(21, 30):
                 losses[i] = 0.5  # ...then stuck well above run A's tail
+            # run B also carries a planner whose predicted bytes drifted
+            # 50% off the HLO-measured truth (plan-mismatch regression)
             _write_run(b_dir, losses, step_ms=30.0,
                        nonfinite_at=(12, 13, 14), comm_bytes=2 << 20,
-                       gate_failures=("donated buffers 0 < required 4",))
+                       gate_failures=("donated buffers 0 < required 4",),
+                       plan_bytes=(100_000, 200_000))
 
             a, b = load_run(a_dir), load_run(b_dir)
             if a["parse_errors"] or b["parse_errors"]:
@@ -490,6 +557,14 @@ def self_test():
             if not rep["gate_regression"]:
                 failures.append("diff missed the injected perf-gate "
                                 "(donation) failure")
+            if not rep["plan_regression"]:
+                failures.append("diff missed the 50% plan predicted-vs-"
+                                "measured mismatch")
+            if abs((rep["new_plan_mismatch"] or 0) - 0.5) > 1e-9:
+                failures.append(f"plan mismatch {rep['new_plan_mismatch']}"
+                                " != hand-computed 0.5")
+            if "plan" not in render_run(a):
+                failures.append("render_run lost the plan line")
             if "donated buffers" not in " ".join(
                     rep.get("gate_failure_detail") or ()):
                 failures.append("gate_failure_detail lost the failure "
@@ -546,10 +621,10 @@ def self_test():
         return 1
     print("self-test passed: journal round-trip, MFU/goodput summary, "
           "loss_spike + nonfinite_streak detectors, the diff gate "
-          "flagged the injected step-time, loss, all-reduce-bytes, AND "
-          "perf-gate (lost donation) regressions (and only them), and "
-          "serving request records round-trip with hand-computed "
-          "TTFT/TPOT percentile columns")
+          "flagged the injected step-time, loss, all-reduce-bytes, "
+          "perf-gate (lost donation) AND plan-mismatch regressions "
+          "(and only them), and serving request records round-trip "
+          "with hand-computed TTFT/TPOT percentile columns")
     return 0
 
 
