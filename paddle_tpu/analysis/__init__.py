@@ -14,6 +14,10 @@ Layers:
 - ``framework``    — Pass / AnalysisPass / RewritePass / PassManager
 - ``verifier``     — use-before-def, dangling inputs, WAW hazards,
                      eval_shape re-inference, donation safety
+- ``dataflow``     — def-use chains, versioned liveness intervals,
+                     Executor-side donation-race / plan-consistency checks
+- ``memory``       — per-op liveness walk: peak-HBM prediction, remat
+                     candidates (validated against ``memory_analysis()``)
 - ``passes``       — identity forwarding, dead-op elimination, CSE
 - ``lint``         — API-smell warnings (unused feeds, stale fetches,
                      unconsumed constants)
@@ -31,6 +35,10 @@ from .verifier import VerifierPass, verify_program
 from .passes import (CSEPass, DeadOpEliminationPass, ForwardIdentityPass,
                      default_optimize_passes)
 from .lint import LintPass, lint_program
+from . import dataflow
+from . import memory
+from .memory import (MemoryEstimate, estimate_entry, memory_report,
+                     remat_candidates)
 
 __all__ = [
     "Diagnostic", "DiagnosticReport", "ProgramVerificationError",
@@ -38,7 +46,8 @@ __all__ = [
     "normalize_fetch", "VerifierPass", "verify_program",
     "ForwardIdentityPass", "DeadOpEliminationPass", "CSEPass",
     "default_optimize_passes", "LintPass", "lint_program",
-    "run_compile_passes",
+    "run_compile_passes", "dataflow", "memory", "MemoryEstimate",
+    "estimate_entry", "memory_report", "remat_candidates",
 ]
 
 

@@ -1,0 +1,336 @@
+"""Static dataflow: def-use chains and versioned liveness over a Program.
+
+TPU-native analog of the reference's ``framework/ir`` memory-optimize
+prepasses (``memory_optimize_pass.cc`` builds exactly this — per-var
+def/use indices and live ranges over the op list — before it reuses
+buffers): the recorded ``Block.ops`` list is already in program order
+and name-linked, so dataflow here is a single forward walk, not graph
+surgery.
+
+Names are **versioned**: every write to a name opens a new ``VarLife``
+and closes the previous one (an ``assign_to`` clobber or a WAW pair is
+two distinct values that happen to share a name — their live ranges
+must not be merged, or the first value looks live across the clobber
+and every peak-memory number downstream inflates).
+
+The walk understands the executor's value classes:
+
+- **feeds / constants / scope-held persistables** exist before op 0
+  (version 0, ``def_idx == ENTRY``). ``@comm@*`` exchange state and
+  ``<param>@OPT@<k>`` optimizer slots are ordinary persistables here —
+  they ride the donated carry like any parameter.
+- **donated persistables** (the ones the program re-emits): donation
+  requires the last write to end the entry buffer's life, so the entry
+  version is flagged ``donated`` and the verifier's PTA007 enforces
+  that no read follows the last write.
+- **fetches and re-emitted persistables** are live-out: their final
+  version extends to ``n_ops`` (the executor reads fetches and
+  restores persistables into the Scope after the replay).
+- **fused ``run_steps`` windows** (``steps=K``): the op list is one
+  scan *body*; persistables are the donated carry (live across the
+  whole body and every iteration), while feed/fetch buffers stack K
+  copies — recorded as ``Liveness.steps`` for ``analysis.memory`` to
+  scale the entry/exit classes by.
+
+``check_donation_races`` and ``check_plan_consistency`` are the
+Executor-side verifier checks (they need the live Scope / the installed
+ShardingPlan, which the pure-Program passes never see); the Executor
+runs them per compile and folds their diagnostics into the same report
+``run_compile_passes`` produced.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .diagnostics import ERROR, WARNING
+from .framework import op_reads
+
+__all__ = ["ENTRY", "VarLife", "Liveness", "def_use", "analyze",
+           "check_donation_races", "check_plan_consistency"]
+
+ENTRY = -1  # def_idx of values that exist before op 0 executes
+
+
+@dataclasses.dataclass
+class VarLife:
+    """One version of one name: its defining write, last read, and the
+    executor value class it belongs to."""
+
+    name: str
+    version: int        # 0 = entry value, +1 per write to the name
+    def_idx: int        # ENTRY, or the index of the defining op
+    last_use: int       # last op index reading this version (== def_idx
+    #                     when never read; == n_ops when live at exit)
+    writer: str | None  # defining op type (None for entry values)
+    kind: str           # "feed" | "persistable" | "constant" | "temp"
+    nbytes: int
+    donated: bool = False   # entry buffer the executor donates
+    live_out: bool = False  # fetched / restored into the Scope
+
+    @property
+    def span(self):
+        """Ops this version stays live across (0 = consumed where
+        defined)."""
+        return max(0, self.last_use - max(self.def_idx, 0))
+
+
+class Liveness:
+    """All VarLife intervals of one op list, plus the walk's context."""
+
+    def __init__(self, lives, n_ops, fetch_names, donated, steps=None):
+        self.lives = list(lives)
+        self.n_ops = int(n_ops)
+        self.fetch_names = tuple(fetch_names)
+        self.donated = frozenset(donated)
+        self.steps = steps  # fused-window K (None = single step)
+
+    def intervals(self, name):
+        return [l for l in self.lives if l.name == name]
+
+    def temps(self):
+        """Intermediate values (not entry-resident, not live-out):
+        the buffers whose lifetime the memory walk can actually
+        overlap."""
+        return [l for l in self.lives
+                if l.kind == "temp" and not l.live_out]
+
+    def live_at(self, idx):
+        """Temp versions live during op ``idx`` (inclusive interval:
+        an op's inputs and outputs coexist while it executes — the
+        convention XLA's buffer assignment also charges)."""
+        return [l for l in self.temps()
+                if l.def_idx <= idx <= l.last_use]
+
+    def table(self):
+        """CLI rows: (name, version, kind, def, last_use, bytes,
+        flags)."""
+        rows = []
+        for l in sorted(self.lives,
+                        key=lambda x: (max(x.def_idx, -1), x.name)):
+            flags = "".join((
+                "D" if l.donated else "", "O" if l.live_out else ""))
+            rows.append((l.name, l.version, l.kind,
+                         "entry" if l.def_idx == ENTRY else l.def_idx,
+                         "exit" if l.last_use >= self.n_ops else l.last_use,
+                         l.nbytes, flags))
+        return rows
+
+
+def _var_nbytes(program, name, feed_shapes=None):
+    if feed_shapes and name in feed_shapes:
+        shape, dt = feed_shapes[name]
+        n = 1
+        for s in shape:
+            n *= int(s)
+        return n * int(np.dtype(dt).itemsize)
+    if name in program._constants:
+        c = program._constants[name]
+        n = 1
+        for s in c.shape:
+            n *= int(s)
+        return n * int(np.dtype(c.dtype).itemsize)
+    v = program.global_block.vars.get(name)
+    if v is None:
+        return 0
+    n = 1
+    for s in v._data.shape:
+        n *= int(s)
+    return n * int(np.dtype(v._data.dtype).itemsize)
+
+
+def def_use(ops):
+    """Def-use chains over an op list: ``(defs, uses)`` where
+    ``defs[name]`` is every op index writing the name (program order)
+    and ``uses[name]`` every op index reading it."""
+    defs, uses = {}, {}
+    for i, op in enumerate(ops):
+        for n in op_reads(op):
+            uses.setdefault(n, []).append(i)
+        for n in op.output_names:
+            defs.setdefault(n, []).append(i)
+    return defs, uses
+
+
+def analyze(program, ops=None, fetch_names=(), feed_shapes=None,
+            scope_names=None, donated=None, steps=None):
+    """Versioned liveness of ``program`` (see module docstring).
+
+    ``feed_shapes`` maps fed names to ``(shape, dtype)`` when the
+    actual feed surface is known (the Executor knows; a CLI previewing
+    a bare Program falls back to every declared data var). ``donated``
+    overrides the inferred donation set (default: scope-held
+    persistables the op list re-emits — exactly what
+    ``Executor._compile`` donates)."""
+    blk = program.global_block
+    ops = list(ops if ops is not None else blk.ops)
+    fetch_names = tuple(fetch_names)
+
+    written = set()
+    for op in ops:
+        written.update(op.output_names)
+
+    entry_kind = {}
+    for name in program._constants:
+        entry_kind[name] = "constant"
+    if feed_shapes is not None:
+        for name in feed_shapes:
+            entry_kind[name] = "feed"
+    for name, v in blk.vars.items():
+        if v.is_data and feed_shapes is None:
+            entry_kind[name] = "feed"
+        elif v.persistable and name not in entry_kind:
+            if scope_names is None or name in scope_names:
+                entry_kind[name] = "persistable"
+    if donated is None:
+        donated = [n for n, k in entry_kind.items()
+                   if k == "persistable" and n in written]
+    donated = frozenset(donated)
+
+    def nbytes(name):
+        return _var_nbytes(program, name, feed_shapes)
+
+    cur: dict[str, VarLife] = {}
+    finished: list[VarLife] = []
+    versions: dict[str, int] = {}
+    for name, kind in entry_kind.items():
+        cur[name] = VarLife(name, 0, ENTRY, ENTRY, None, kind,
+                            nbytes(name), donated=name in donated)
+
+    persist_names = {n for n, v in blk.vars.items() if v.persistable}
+    for i, op in enumerate(ops):
+        # reads first: an op reading and writing one name (optimizer
+        # updates) reads the OLD version
+        for n in op_reads(op):
+            life = cur.get(n)
+            if life is not None:
+                life.last_use = i
+        for n in op.output_names:
+            prev = cur.pop(n, None)
+            if prev is not None:
+                finished.append(prev)
+            versions[n] = versions.get(n, 0) + 1
+            kind = "persistable" if n in persist_names else "temp"
+            cur[n] = VarLife(n, versions[n], i, i, op.type, kind,
+                             nbytes(n))
+
+    n_ops = len(ops)
+    for n, life in cur.items():
+        if n in fetch_names or (life.kind == "persistable"
+                                and life.def_idx != ENTRY):
+            # fetches leave through the output tuple; re-emitted
+            # persistables are restored into the Scope after the replay
+            life.live_out = True
+            life.last_use = n_ops
+        finished.append(life)
+    return Liveness(finished, n_ops, fetch_names, donated, steps=steps)
+
+
+# -- Executor-side verifier checks -------------------------------------------
+
+_CHECK = "executor-verifier"
+
+
+def check_donation_races(report, scope, updated, frozen):
+    """PTA011: two persistable names bound to the SAME buffer in the
+    Scope while at least one is donated. The executor donates every
+    ``updated`` buffer to XLA — the dispatch invalidates it — so the
+    alias's reads are use-after-free. On the fused ``run_steps`` path
+    this is the cross-window race: the carry donates once per window
+    while every scan iteration re-reads the dead alias. Scope aliasing
+    only arises host-side (two ``scope.set`` calls sharing one array),
+    which is why this check lives at compile time WITH the Scope, not
+    in the pure-Program verifier."""
+    updated = tuple(updated)
+    donated = set(updated)
+    seen: dict[int, str] = {}
+    for name in tuple(updated) + tuple(frozen):
+        arr = scope.find_var(name)
+        if arr is None:
+            continue
+        other = seen.get(id(arr))
+        if other is None:
+            seen[id(arr)] = name
+            continue
+        if name in donated or other in donated:
+            report.add(
+                "PTA011", ERROR,
+                f"persistables '{other}' and '{name}' share one device "
+                f"buffer and "
+                f"'{other if other in donated else name}' is donated: "
+                "the first dispatch deletes the buffer and every later "
+                "read of the alias (each iteration of a fused "
+                "run_steps window) is use-after-donate. Install "
+                "distinct arrays in the Scope.",
+                var=name, pass_name=_CHECK)
+        seen[id(arr)] = name
+    return report
+
+
+def _spec_axes(spec):
+    for part in tuple(spec or ()):
+        for ax in (part if isinstance(part, tuple) else (part,)):
+            if ax is not None:
+                yield ax
+
+
+def check_plan_consistency(report, plan, feed_names, shapes, fetch_names,
+                           scope):
+    """PTA012: feed/fetch sharding specs inconsistent with the installed
+    ShardingPlan. All warnings (the Executor's documented fallback is to
+    replicate), but each one means the plan is not doing what the
+    planner chose:
+
+    - the plan shards a feed this entry does not feed (the plan was
+      built against a different feed surface);
+    - a declared feed spec does not fit the CONCRETE fed shape (the
+      axis no longer divides — the feed silently replicates, so the
+      'data-parallel' entry computes the full batch per device);
+    - a persistable's plan spec no longer fits the Scope array's shape
+      (silent replicated fallback — HBM per device is the plan's
+      number times the shard factor);
+    - a fetch targets a model-sharded persistable (the replicated
+      out_sharding gathers the full array every step).
+    """
+    feed_shapes = dict(zip(feed_names, [s for s, _ in shapes]))
+    for name, spec in (plan.feed_specs or {}).items():
+        if tuple(spec or ()) and name not in feed_shapes:
+            report.add(
+                "PTA012", WARNING,
+                f"plan shards feed '{name}' over {tuple(spec)} but this "
+                "entry does not feed it — was the plan built for a "
+                "different feed surface?",
+                var=name, pass_name=_CHECK)
+    for name, shape in feed_shapes.items():
+        declared = tuple((plan.feed_specs or {}).get(name) or ())
+        if declared and plan.feed_spec_for(name, shape) == ():
+            report.add(
+                "PTA012", WARNING,
+                f"plan spec {declared} for feed '{name}' does not fit "
+                f"the fed shape {tuple(shape)}: the feed silently "
+                "replicates and the data axis goes unused",
+                var=name, pass_name=_CHECK)
+    for name in (plan.param_specs or {}):
+        arr = scope.find_var(name)
+        if arr is None:
+            continue
+        declared = tuple(plan.param_specs.get(name) or ())
+        if declared and plan.spec_for(name, tuple(arr.shape)) == ():
+            report.add(
+                "PTA012", WARNING,
+                f"plan spec {declared} for persistable '{name}' does "
+                f"not fit its Scope shape {tuple(arr.shape)}: the "
+                "buffer silently replicates (per-device HBM is the "
+                "plan's estimate times the lost shard factor)",
+                var=name, pass_name=_CHECK)
+    for name in fetch_names:
+        spec = tuple((plan.param_specs or {}).get(name) or ())
+        if any(ax != "data" for ax in _spec_axes(spec)):
+            report.add(
+                "PTA012", WARNING,
+                f"fetch of model-sharded persistable '{name}' (spec "
+                f"{spec}): the replicated fetch gathers the full array "
+                "on every step",
+                var=name, pass_name=_CHECK)
+    return report

@@ -22,9 +22,17 @@ PTA007      error      donation hazard: donated persistable read after last writ
 PTA008      warning    shape re-inference failed for an op (cannot cross-check)
 PTA009      warning    fed shape mismatches a declared static (non -1) dim
 PTA010      warning    WAW clobber between ordinary (non-assign) ops
+PTA011      error      use-after-donate: two persistables share one Scope
+                       buffer and one is donated (fused windows re-read it)
+PTA012      warning    plan/spec mismatch: a feed/fetch/persistable sharding
+                       spec is inconsistent with the installed ShardingPlan
+PTA013      error      over-budget layout: a planner candidate's per-device
+                       peak HBM exceeds the budget (candidate is infeasible)
 PTL101      warning    feed/data var never read by any op and never fetched
 PTL102      warning    fetch of a stale Variable handle (other Program / _stale)
 PTL103      warning    captured constant never consumed
+PTL104      warning    remat candidate: a long-lived, cheap-to-recompute
+                       activation holds up the peak-HBM high-water mark
 ==========  =========  =====================================================
 """
 from __future__ import annotations

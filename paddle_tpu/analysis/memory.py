@@ -1,0 +1,338 @@
+"""Static peak-memory estimate: a per-op liveness walk over the Program.
+
+TPU-native analog of the reference's ``framework/ir`` memory-optimize
+passes (``memory_optimize_pass.cc`` / ``inplace_op_pass.cc`` reuse
+buffers from exactly this walk) with the accounting turned outward: the
+number a *planner* needs is the executable's high-water HBM mark, so the
+walk mirrors XLA buffer assignment's charging rules instead of rewriting
+the graph —
+
+- **entry buffers** (feeds, scope-held persistables, captured
+  constants) are resident for the whole call: XLA allocates arguments
+  up front. Donated persistables alias their outputs, so re-emitted
+  parameters count ONCE (the ``alias_size`` convention
+  ``memory_analysis()`` reports).
+- **outputs** (fetches) are distinct allocations.
+- **temps** (everything else) overlap by liveness: during op ``i`` its
+  inputs and outputs coexist, so the per-op charge is the sum of every
+  temp version whose ``[def, last_use]`` interval covers ``i``
+  (``analysis.dataflow`` provides the versioned intervals).
+- **convolution workspace**: conv ops lower through an im2col-style
+  patch matrix (CPU and TPU backends both materialize scratch of that
+  order), charged transiently during the conv op —
+  ``B * out_spatial * (Cin/groups * prod(k)) * itemsize``. Without it
+  the estimate undershoots conv nets by ~2x; with it the zoo models
+  land within the 15% acceptance band of ``memory_analysis()``.
+- **fused windows** (``steps=K``): feeds and fetches stack K copies
+  (the executable's real argument/output shapes); the temp peak is
+  per-iteration (the scan body reuses its buffers each step).
+
+``estimate_entry`` is what ``Executor._build`` attaches per compiled
+entry (validated against ``memory_analysis()`` by the journal's
+``memory`` event and gated in ``tools/run_report.py --diff``);
+``candidate_peak`` is the cheap per-candidate form ``fleet.planner``
+prices layouts with; ``remat_candidates`` scores long-lived,
+cheap-to-recompute activations for ROADMAP item 2's recompute
+decisions (PTL104 hints).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from . import dataflow as _dataflow
+from .diagnostics import DiagnosticReport, WARNING
+from .framework import normalize_fetch
+
+__all__ = [
+    "MemoryEstimate", "estimate_entry", "candidate_peak",
+    "remat_candidates", "memory_report", "measured_peak_bytes",
+    "CHEAP_RECOMPUTE",
+]
+
+# op types cheap enough to replay instead of keeping resident: one
+# pass over the operand, no contraction — the classic remat set (the
+# planner's recompute decisions start here)
+CHEAP_RECOMPUTE = frozenset((
+    "relu", "gelu", "tanh", "sigmoid", "silu", "swish", "leaky_relu",
+    "elu", "softplus", "hardswish", "hardsigmoid", "dropout",
+    "dropout_axes", "alpha_dropout", "scale", "cast", "abs", "square",
+    "exp", "add", "subtract", "multiply", "elementwise_add",
+    "elementwise_mul", "elementwise_sub", "reshape", "flatten",
+    "transpose", "concat", "split",
+))
+
+_CONV_OPS = ("conv2d", "depthwise_conv2d", "conv3d", "conv2d_transpose")
+
+
+def _conv_workspace(op, shape_of, itemsize=4):
+    """Transient im2col patch bytes for one conv(-grad) op; 0 for
+    everything else. The patch matrix is
+    ``B x out_spatial x (Cin/groups * prod(kernel))`` — the reference
+    shape both the XLA:CPU im2col lowering and the TPU's implicit
+    patch loads materialize. Layout-aware: the out-channel dim sits at
+    ``ref[1]`` (NCHW-family) or ``ref[-1]`` (channel-last), read from
+    the op's ``data_format`` attr; both counts derive from element
+    totals so the weight layout (OIHW vs HWIO) never matters. Grad ops
+    carry no attrs and default to channel-first — the recorded
+    convention of every model in the zoo."""
+    base = op.type[:-5] if op.type.endswith("@grad") else op.type
+    if base not in _CONV_OPS:
+        return 0
+    names = [n for n in op.input_names if n is not None]
+    if len(names) < 2:
+        return 0
+    w = shape_of(names[1])
+    if w is None or len(w) < 4:
+        return 0
+    if op.type.endswith("@grad"):
+        # dW's im2col runs over the FORWARD output spatial extent,
+        # which is the incoming grad's shape (input slot 2)
+        ref = shape_of(names[2]) if len(names) > 2 else None
+    else:
+        ref = shape_of(op.output_names[0])
+    if ref is None or len(ref) < 4:
+        return 0
+    channel_last = str(op.attrs.get("data_format", "NCHW"))\
+        .endswith("C")
+    cout = int(ref[-1] if channel_last else ref[1])
+    if cout <= 0:
+        return 0
+    batch, out_numel, w_numel = int(ref[0]), 1, 1
+    for s in ref[1:]:
+        out_numel *= int(s)
+    for s in w:
+        w_numel *= int(s)
+    spatial = out_numel // cout          # prod of the spatial dims
+    patch = w_numel // cout              # Cin/groups * prod(kernel)
+    return batch * spatial * patch * itemsize
+
+
+@dataclasses.dataclass
+class MemoryEstimate:
+    """Predicted high-water HBM for one compiled entry.
+
+    ``peak_bytes = arg_bytes + const_bytes + output_bytes +
+    temp_peak_bytes`` — directly comparable to ``memory_analysis()``'s
+    ``argument + output + temp - alias`` (see
+    ``measured_peak_bytes``). ``per_device_bytes`` divides each class
+    by its shard factor under the entry's plan / data mesh."""
+
+    peak_bytes: int
+    per_device_bytes: int
+    arg_bytes: int
+    const_bytes: int
+    output_bytes: int
+    temp_peak_bytes: int
+    peak_op: tuple | None        # (op index, op type) of the temp peak
+    steps: int | None            # fused-window K (None = single step)
+    timeline: list               # per-op temp+workspace bytes
+    liveness: _dataflow.Liveness
+
+    def as_event(self):
+        """JSON-safe payload for the journal's ``memory`` event."""
+        return {
+            "peak_bytes": int(self.peak_bytes),
+            "per_device_bytes": int(self.per_device_bytes),
+            "arg_bytes": int(self.arg_bytes),
+            "const_bytes": int(self.const_bytes),
+            "output_bytes": int(self.output_bytes),
+            "temp_peak_bytes": int(self.temp_peak_bytes),
+            "peak_op": (list(self.peak_op)
+                        if self.peak_op is not None else None),
+            "steps": self.steps,
+        }
+
+
+def _temp_walk(program, ops, liveness, feed_shapes=None):
+    """Per-op live temp bytes (+ conv workspace): the overlap part of
+    the estimate. Returns (peak, peak_op, timeline)."""
+    temps = liveness.temps()
+    n_ops = liveness.n_ops
+    add_at, drop_after = {}, {}
+    for l in temps:
+        i = max(l.def_idx, 0)
+        add_at[i] = add_at.get(i, 0) + l.nbytes
+        drop_after[min(l.last_use, n_ops - 1)] = \
+            drop_after.get(min(l.last_use, n_ops - 1), 0) + l.nbytes
+
+    def shape_of(name):
+        if feed_shapes and name in feed_shapes:
+            return tuple(feed_shapes[name][0])
+        if name in program._constants:
+            return tuple(program._constants[name].shape)
+        v = program.global_block.vars.get(name)
+        return tuple(v._data.shape) if v is not None else None
+
+    live = 0
+    peak, peak_op = 0, None
+    timeline = []
+    for i, op in enumerate(ops):
+        live += add_at.get(i, 0)
+        here = live + _conv_workspace(op, shape_of)
+        timeline.append(here)
+        if here > peak:
+            peak, peak_op = here, (i, op.type)
+        live -= drop_after.get(i, 0)
+    return peak, peak_op, timeline
+
+
+def _shard_factor(spec, axes):
+    n = 1
+    for part in tuple(spec or ()):
+        for ax in (part if isinstance(part, tuple) else (part,)):
+            if ax is not None:
+                n *= int(axes.get(ax, 1))
+    return max(1, n)
+
+
+def estimate_entry(program, ops=None, fetch_list=(), feed_shapes=None,
+                   scope_names=None, steps=None, plan=None,
+                   data_devices=1):
+    """Predict one compiled entry's peak HBM bytes (see module
+    docstring). ``feed_shapes`` is the Executor's ``{name: (shape,
+    dtype)}`` of the ACTUAL feeds; ``plan`` (a fleet ShardingPlan) or
+    ``data_devices`` (plain one-axis DP) select the per-device
+    division."""
+    fetch_names, _ = normalize_fetch(fetch_list)
+    blk = program.global_block
+    ops = list(ops if ops is not None else blk.ops)
+    liveness = _dataflow.analyze(
+        program, ops=ops, fetch_names=fetch_names,
+        feed_shapes=feed_shapes, scope_names=scope_names, steps=steps)
+    k = int(steps) if steps else 1
+
+    def nbytes(name):
+        return _dataflow._var_nbytes(program, name, feed_shapes)
+
+    entry = [l for l in liveness.lives if l.def_idx == _dataflow.ENTRY]
+    feed_b = sum(l.nbytes for l in entry if l.kind == "feed") * k
+    persist_b = sum(l.nbytes for l in entry if l.kind == "persistable")
+    const_b = sum(l.nbytes for l in entry if l.kind == "constant")
+    out_b = sum(nbytes(n) for n in fetch_names) * k
+    temp_peak, peak_op, timeline = _temp_walk(program, ops, liveness,
+                                              feed_shapes)
+
+    # per-device division: each class by its own shard factor
+    if plan is not None:
+        axes = dict(plan.axes)
+        d = int(axes.get("data", 1))
+        feed_pd = sum(
+            l.nbytes // _shard_factor(
+                plan.feed_spec_for(
+                    l.name, (feed_shapes or {}).get(l.name, (None,))[0]),
+                axes)
+            for l in entry if l.kind == "feed") * k
+        persist_pd = 0
+        for l in entry:
+            if l.kind != "persistable":
+                continue
+            v = blk.vars.get(l.name)
+            shape = tuple(v._data.shape) if v is not None else None
+            persist_pd += l.nbytes // _shard_factor(
+                plan.spec_for(l.name, shape), axes)
+    else:
+        d = max(1, int(data_devices))
+        feed_pd = 0
+        for l in entry:
+            if l.kind != "feed":
+                continue
+            shape = (feed_shapes or {}).get(l.name, ((),))[0] or ()
+            divisible = (d > 1 and len(shape) >= 1 and shape[0] > 0
+                         and shape[0] % d == 0)
+            feed_pd += (l.nbytes // d) if divisible else l.nbytes
+        feed_pd *= k
+        persist_pd = persist_b  # plain DP replicates persistables
+    per_device = persist_pd + feed_pd + const_b + out_b + temp_peak // d
+
+    return MemoryEstimate(
+        peak_bytes=persist_b + feed_b + const_b + out_b + temp_peak,
+        per_device_bytes=per_device,
+        arg_bytes=persist_b + feed_b, const_bytes=const_b,
+        output_bytes=out_b, temp_peak_bytes=temp_peak,
+        peak_op=peak_op, steps=steps, timeline=timeline,
+        liveness=liveness)
+
+
+def candidate_peak(program, ops=None):
+    """The planner's one-walk profile: ``(act_peak_bytes,
+    const_bytes)`` of a Program, candidate-independent. Per-candidate
+    per-device peaks combine these with the layout's own per-feed and
+    per-param shard factors (which need per-name granularity the
+    planner computes from its ProgramFacts)."""
+    est = estimate_entry(program, ops=ops)
+    return est.temp_peak_bytes, est.const_bytes
+
+
+def remat_candidates(program, ops=None, fetch_list=(), feed_shapes=None,
+                     min_bytes=4096, min_span=None, liveness=None):
+    """Rematerialization candidates: temp versions that are (a) big
+    (``>= min_bytes``), (b) long-lived (live across ``>= min_span``
+    ops — default an eighth of the program), and (c) produced by a
+    cheap op (``CHEAP_RECOMPUTE``): dropping the buffer and replaying
+    the producer trades one cheap op for ``nbytes`` of high-water HBM
+    across the span. Scored ``nbytes * span / n_ops`` (bytes weighted
+    by the fraction of the program they squat), best first.
+    ``liveness`` reuses an existing walk (``MemoryEstimate.liveness``)
+    instead of re-analyzing."""
+    if liveness is None:
+        fetch_names, _ = normalize_fetch(fetch_list)
+        ops = list(ops if ops is not None
+                   else program.global_block.ops)
+        liveness = _dataflow.analyze(program, ops=ops,
+                                     fetch_names=fetch_names,
+                                     feed_shapes=feed_shapes)
+    n_ops = max(1, liveness.n_ops)
+    if min_span is None:
+        min_span = max(4, n_ops // 8)
+    out = []
+    for l in liveness.temps():
+        if l.writer not in CHEAP_RECOMPUTE or l.nbytes < min_bytes \
+                or l.span < min_span:
+            continue
+        out.append({
+            "name": l.name, "writer": l.writer, "bytes": l.nbytes,
+            "def": l.def_idx, "last_use": l.last_use, "span": l.span,
+            "score": l.nbytes * l.span / n_ops,
+        })
+    out.sort(key=lambda c: -c["score"])
+    return out
+
+
+def memory_report(program, ops=None, fetch_list=(), feed_shapes=None,
+                  steps=None, plan=None, data_devices=1,
+                  min_bytes=4096, min_span=None):
+    """The memory analysis as a diagnosable unit: returns
+    ``(MemoryEstimate, DiagnosticReport)`` with one PTL104 hint per
+    remat candidate — what ``tools/lint_program.py --memory`` prints
+    and tests assert codes against."""
+    est = estimate_entry(program, ops=ops, fetch_list=fetch_list,
+                         feed_shapes=feed_shapes, steps=steps,
+                         plan=plan, data_devices=data_devices)
+    report = DiagnosticReport(program)
+    for c in remat_candidates(program, min_bytes=min_bytes,
+                              min_span=min_span,
+                              liveness=est.liveness):
+        report.add(
+            "PTL104", WARNING,
+            f"'{c['name']}' ({c['bytes']} B from cheap op "
+            f"'{c['writer']}') stays live across {c['span']} ops "
+            f"(op#{c['def']} -> op#{c['last_use']}): a "
+            "rematerialization candidate — recomputing it at last use "
+            "would cut the high-water mark",
+            op_idx=c["def"], var=c["name"], pass_name="memory")
+    return est, report
+
+
+def measured_peak_bytes(mem):
+    """The comparable number from ``memory_analysis()``'s dict (the
+    ``obs.mfu.entry_analysis`` ``memory`` field): ``argument + output
+    + temp - alias`` — donated buffers count once, matching
+    ``MemoryEstimate.peak_bytes``'s convention. None when the backend
+    reported nothing."""
+    if not mem:
+        return None
+    total = (mem.get("argument_size", 0) + mem.get("output_size", 0)
+             + mem.get("temp_size", 0) - mem.get("alias_size", 0))
+    return int(total) if total > 0 else None

@@ -53,7 +53,7 @@ class AutoParallelProgram(CompiledProgram):
 
 def auto_parallel(program, mesh_shape, roles=None, comm_options=None,
                   verify=True, fetch_list=None, executor=None,
-                  peak=None, bw=None):
+                  peak=None, bw=None, hbm_budget=None):
     """Plan ``program`` onto ``mesh_shape`` and return a data-parallel
     CompiledProgram the Executor runs under the plan's shardings.
 
@@ -67,10 +67,13 @@ def auto_parallel(program, mesh_shape, roles=None, comm_options=None,
     a warm cache entry the first real ``exe.run`` hits, or
     ``verify=False`` to skip it entirely.
     ``comm_options`` (dist.gradcomm) requires the plan to be pure DP.
-    The returned object exposes the plan as ``._plan``.
+    ``hbm_budget`` (bytes per device; env ``PADDLE_TPU_HBM_BUDGET``)
+    rejects layouts whose predicted per-device peak HBM exceeds it
+    (PTA013) before any compile. The returned object exposes the plan
+    as ``._plan``.
     """
     plan = plan_program(program, mesh_shape, roles=roles, peak=peak,
-                        bw=bw)
+                        bw=bw, hbm_budget=hbm_budget)
     if comm_options is not None and not plan.is_pure_dp:
         raise ValueError(
             "comm_options (dist.gradcomm) composes only with a pure "
@@ -85,7 +88,7 @@ def auto_parallel(program, mesh_shape, roles=None, comm_options=None,
 
 def auto_parallel_step(model, optimizer, loss_fn, mesh_shape,
                        roles=None, batch_example=None, devices=None,
-                       **step_kw):
+                       hbm_budget=None, **step_kw):
     """Plan an eager Layer onto ``mesh_shape`` and return a
     ``DistributedTrainStep`` over the plan's mesh with the plan's
     parameter placements installed (declared TP/MoE ``sharding_spec``s
@@ -98,7 +101,8 @@ def auto_parallel_step(model, optimizer, loss_fn, mesh_shape,
     from ..dist.parallel import DistributedTrainStep
 
     plan = plan_layer(model, mesh_shape, roles=roles,
-                      batch_example=batch_example)
+                      batch_example=batch_example,
+                      hbm_budget=hbm_budget)
     mesh = plan.build_mesh(devices=devices)
     for name, p in model.named_parameters():
         # stash the model's DECLARED spec once (plan_layer plans from

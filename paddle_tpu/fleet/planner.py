@@ -77,6 +77,11 @@ COMM_OVERLAP_DISCOUNT = 0.5
 # compute and comm terms matters, and these keep it realistic.
 _DEFAULT_PEAK = 197e12
 _DEFAULT_BW = 200e9
+# HBM bandwidth prices the memory term: time to touch the per-device
+# high-water bytes once. Deliberately a LIGHT term — it breaks ties
+# toward layouts that fit (and rejects ones that don't, see
+# hbm_budget/PTA013) without drowning the comm/compute signal.
+_DEFAULT_HBM_BW = 819e9
 
 
 def _numel(shape):
@@ -257,8 +262,12 @@ class PlanCandidate:
     score: float = float("inf")
     compute_s: float = 0.0
     comm_s: float = 0.0
+    mem_s: float = 0.0
     param_bytes_per_device: int = 0
     activation_bytes_per_device: int = 0
+    peak_bytes_per_device: int = 0
+    diagnostic: object = None  # analysis Diagnostic (PTA013) when
+    #                            rejected over budget
 
     def summary(self):
         return {
@@ -271,6 +280,7 @@ class PlanCandidate:
             "param_bytes_per_device": self.param_bytes_per_device,
             "activation_bytes_per_device":
                 self.activation_bytes_per_device,
+            "peak_bytes_per_device": self.peak_bytes_per_device,
         }
 
 
@@ -293,6 +303,8 @@ class ShardingPlan:
     source: str = "program"    # "program" | "layer"
     device_ids: tuple | None = None  # pinned placement (plan_program
     #                                  devices=), else first-N default
+    peak_bytes_per_device: int | None = None  # winner's predicted
+    #                                  per-device peak HBM (analysis.memory)
 
     @property
     def is_pure_dp(self):
@@ -371,6 +383,7 @@ class ShardingPlan:
             "predicted_wire_bytes": self.predicted_wire_bytes,
             "measured_wire_bytes": self.measured_wire_bytes,
             "mismatch": self.mismatch,
+            "peak_bytes_per_device": self.peak_bytes_per_device,
         }
         out.update(extra)
         return out
@@ -383,9 +396,33 @@ def _wire(kind, n, payload):
     return payload * wire_factor(kind, n)
 
 
-def _score_candidate(cand, facts, ops, peak, bw):
+def _over_budget(cand, peak_pd, hbm_budget):
+    """Reject one candidate whose per-device peak exceeds the HBM
+    budget: infeasible, PTA013-coded (the planner's analog of an OOM
+    at compile time, caught before any XLA work)."""
+    from ..analysis.diagnostics import Diagnostic, ERROR
+
+    cand.feasible = False
+    cand.note = (f"[PTA013] predicted peak {peak_pd} B/device exceeds "
+                 f"the HBM budget {int(hbm_budget)} B")
+    cand.diagnostic = Diagnostic(
+        "PTA013", ERROR,
+        f"layout {cand.axes} needs {peak_pd} B/device but the budget "
+        f"is {int(hbm_budget)} B: over-budget layout rejected as "
+        "infeasible", pass_name="planner")
+    return cand
+
+
+def _score_candidate(cand, facts, ops, peak, bw, mem_profile=None,
+                     hbm_budget=None, hbm_bw=_DEFAULT_HBM_BW):
     """Fill specs + predicted traffic + score for one candidate over a
-    static Program's facts. Mutates and returns ``cand``."""
+    static Program's facts. Mutates and returns ``cand``.
+
+    ``mem_profile`` is ``analysis.memory.candidate_peak``'s
+    ``(act_peak_bytes, const_bytes)`` — one liveness walk, shared by
+    every candidate; per-candidate division happens here (params by
+    their spec's shard factor, batch feeds and the activation peak by
+    the data axis)."""
     axes = cand.axes
     d = int(axes.get("data", 1))
     t = int(axes.get("model", 1))
@@ -471,7 +508,13 @@ def _score_candidate(cand, facts, ops, peak, bw):
     cand.feasible = True
     cand.note = f"{len(pairs)} tp pair(s)" if pairs else "pure dp"
 
-    # -- memory footprint (reported, not scored: CPU CI has no HBM cap)
+    # -- memory: per-device peak (analysis.memory liveness walk) is a
+    # PRICED cost term now — time to touch the high-water bytes once
+    # over HBM bandwidth — and a feasibility constraint under
+    # hbm_budget (PTA013). Params divide by their spec's shard factor,
+    # batch feeds and the activation peak by the data axis; the model
+    # axis's activation sharding is left unpriced (a conservative
+    # over-estimate).
     pb = 0
     for name, (shape, dt) in facts.params.items():
         pb += _numel(shape) * _dtype_bytes(dt) // \
@@ -479,15 +522,34 @@ def _score_candidate(cand, facts, ops, peak, bw):
     cand.param_bytes_per_device = pb
     cand.activation_bytes_per_device = int(
         facts.activation_bytes // (d if d > 1 else 1))
+    if mem_profile is not None:
+        act_peak, const_b = mem_profile
+        feed_pd = 0
+        for name, (shape, dt) in facts.feeds.items():
+            f = d if feed_specs.get(name) == ("data",) else 1
+            feed_pd += _numel(shape) * _dtype_bytes(dt) // f
+        peak_pd = int(pb + feed_pd + const_b + act_peak // (d or 1))
+        cand.peak_bytes_per_device = peak_pd
+        cand.mem_s = peak_pd / hbm_bw
+        cand.score += cand.mem_s
+        if hbm_budget and peak_pd > hbm_budget:
+            return _over_budget(cand, peak_pd, hbm_budget)
     return cand
 
 
 def plan_program(program, mesh_shape, roles=None, devices=None,
-                 peak=None, bw=None):
+                 peak=None, bw=None, hbm_budget=None):
     """Plan a static Program onto ``mesh_shape``. ``roles`` pins the
     per-axis role assignment (the operator knows the topology); left
     None, every canonical assignment over {data, model} is scored and
-    the cheapest feasible one wins. Raises when nothing is feasible."""
+    the cheapest feasible one wins. Raises when nothing is feasible.
+
+    ``hbm_budget`` (bytes per device; env ``PADDLE_TPU_HBM_BUDGET``
+    when None) rejects candidates whose predicted per-device peak HBM
+    (``analysis.memory`` liveness walk) exceeds it — each rejection
+    carries a PTA013 diagnostic, and a mesh where EVERY layout is over
+    budget raises with the PTA013 notes instead of compiling a layout
+    that OOMs."""
     n_devices = device_ids = None
     if devices is not None:
         devs = np.asarray(devices).reshape(-1)
@@ -501,6 +563,11 @@ def plan_program(program, mesh_shape, roles=None, devices=None,
     ops = list(program.global_block.ops)
     peak = peak or _DEFAULT_PEAK
     bw = bw or _ici_bw_or_default()
+    if hbm_budget is None:
+        hbm_budget = _hbm_budget_env()
+    from ..analysis.memory import candidate_peak
+
+    mem_profile = candidate_peak(program, ops=ops)
 
     if roles is not None:
         assignments = [(tuple(roles),
@@ -509,7 +576,8 @@ def plan_program(program, mesh_shape, roles=None, devices=None,
         assignments = _mesh.candidate_assignments(shape)
     cands = [_score_candidate(
         PlanCandidate(roles=r, axes=a, feasible=False), facts, ops,
-        peak, bw) for r, a in assignments]
+        peak, bw, mem_profile=mem_profile, hbm_budget=hbm_budget)
+        for r, a in assignments]
     feasible = [c for c in cands if c.feasible]
     if not feasible:
         detail = "; ".join(f"{c.axes}: {c.note}" for c in cands)
@@ -523,7 +591,28 @@ def plan_program(program, mesh_shape, roles=None, devices=None,
         feed_specs=dict(best.feed_specs),
         predicted=dict(best.predicted),
         candidates=[c.summary() for c in cands], source="program",
-        device_ids=device_ids)
+        device_ids=device_ids,
+        peak_bytes_per_device=best.peak_bytes_per_device or None)
+
+
+def _hbm_budget_env():
+    import os
+
+    env = os.environ.get("PADDLE_TPU_HBM_BUDGET", "")
+    if not env:
+        return None
+    try:
+        return float(env)
+    except ValueError:
+        # a typo'd budget must not SILENTLY disable the OOM guard the
+        # operator believes is active
+        import warnings
+
+        warnings.warn(
+            f"PADDLE_TPU_HBM_BUDGET={env!r} is not a number (bytes); "
+            "planning WITHOUT a per-device HBM budget — over-budget "
+            "layouts will not be rejected", RuntimeWarning)
+        return None
 
 
 def _ici_bw_or_default():
@@ -536,13 +625,16 @@ def _ici_bw_or_default():
 
 
 def plan_layer(model, mesh_shape, roles=None, batch_example=None,
-               peak=None, bw=None):
+               peak=None, bw=None, hbm_budget=None):
     """Plan an eager Layer onto ``mesh_shape`` from its parameters'
     declared ``sharding_spec``s (TP/MoE layers mark their own weights —
     the planner decides which declared axes the mesh affords). Gradient
     traffic prices like the static path; activation traffic for the
     model axis is estimated from ``batch_example`` (arrays or shapes)
-    as one partial-sum all-reduce per row-sharded weight."""
+    as one partial-sum all-reduce per row-sharded weight.
+    ``hbm_budget`` rejects candidates over the per-device byte budget
+    (PTA013) — the eager proxy is param bytes per device plus the
+    batch example (no recorded op list to walk)."""
     shape = _mesh.parse_mesh_shape(mesh_shape)
     params = []
     for name, p in model.named_parameters():
@@ -571,6 +663,8 @@ def plan_layer(model, mesh_shape, roles=None, batch_example=None,
         batch_dim = int(bshape[0]) if bshape else None
     peak = peak or _DEFAULT_PEAK
     bw = bw or _ici_bw_or_default()
+    if hbm_budget is None:
+        hbm_budget = _hbm_budget_env()
 
     if roles is not None:
         assignments = [(tuple(roles),
@@ -651,7 +745,16 @@ def plan_layer(model, mesh_shape, roles=None, batch_example=None,
         cand.comm_s = (wire_cr + COMM_OVERLAP_DISCOUNT * wire_ov) / bw
         cand.score = cand.compute_s + cand.comm_s
         cand.param_bytes_per_device = int(g_bytes)
+        # eager per-device peak proxy: sharded params + the batch
+        # shard (no recorded op list to liveness-walk)
+        batch_b = (m_tokens or 0) * 4
+        peak_pd = int(g_bytes + batch_b // d)
+        cand.peak_bytes_per_device = peak_pd
+        cand.mem_s = peak_pd / _DEFAULT_HBM_BW
+        cand.score += cand.mem_s
         cand.note = "declared specs" if used_axes else "pure dp"
+        if hbm_budget and peak_pd > hbm_budget:
+            _over_budget(cand, peak_pd, hbm_budget)
         cands.append(cand)
 
     feasible = [c for c in cands if c.feasible]
@@ -664,7 +767,8 @@ def plan_layer(model, mesh_shape, roles=None, batch_example=None,
         mesh_shape=shape, roles=best.roles, axes=dict(best.axes),
         param_specs=dict(best.param_specs), feed_specs={},
         predicted=dict(best.predicted),
-        candidates=[c.summary() for c in cands], source="layer")
+        candidates=[c.summary() for c in cands], source="layer",
+        peak_bytes_per_device=best.peak_bytes_per_device or None)
 
 
 # -- verification -------------------------------------------------------------

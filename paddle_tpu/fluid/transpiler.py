@@ -101,10 +101,33 @@ class DistributeTranspiler:
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,
                     level=0, skip_grads=True):
-    """ref: memory_optimization_transpiler.py memory_optimize — a no-op
-    here AND in late fluid (deprecated): XLA's buffer assignment already
-    performs liveness-based reuse on the whole fused program."""
-    return None
+    """ref: memory_optimization_transpiler.py memory_optimize.
+
+    The REWRITE half stays descoped (XLA's buffer assignment already
+    performs liveness-based buffer reuse on the whole fused program —
+    the reference pass's var-reuse rewrites would be dead weight), but
+    the ANALYSIS half is real now: the same versioned-liveness walk the
+    reference pass ran (``paddle_tpu.analysis.dataflow`` /
+    ``.memory``) returns the Program's predicted peak-HBM
+    ``MemoryEstimate``, and ``print_log=True`` prints the summary the
+    reference VLOG'd. ``None`` in, ``None`` out (source compat with
+    callers that pass no program)."""
+    if input_program is None:
+        return None
+    from ..analysis import memory as _memory
+
+    try:
+        est = _memory.estimate_entry(input_program)
+    except Exception:  # deprecated-API callers relied on the no-op
+        return None    # never failing; an analysis miss must not either
+    if print_log:
+        po = (f" at op#{est.peak_op[0]} ({est.peak_op[1]})"
+              if est.peak_op else "")
+        print(f"memory_optimize: predicted peak {est.peak_bytes} B "
+              f"(args {est.arg_bytes} + outputs {est.output_bytes} + "
+              f"temps {est.temp_peak_bytes}{po}); buffer reuse is "
+              "delegated to XLA buffer assignment")
+    return est
 
 
 def release_memory(input_program, skip_opt_set=None):

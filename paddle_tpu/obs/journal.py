@@ -501,6 +501,51 @@ class RunJournal:
         in ``--diff``."""
         return self.event("plan", **plan.event_fields(), **fields)
 
+    def record_memory(self, compiled=None, analysis=None,
+                      predicted_bytes=None, per_device_bytes=None,
+                      measured_bytes=None, **fields):
+        """One ``memory`` event per compiled entry: the static
+        peak-HBM prediction (``analysis.memory.estimate_entry``,
+        attached by ``Executor._build``) and — once the entry's lazy
+        analysis has landed — the executable's own
+        ``memory_analysis()`` total, with ``drift`` their relative
+        delta. Emitted twice per entry like ``plan`` events: once at
+        compile (predicted only) and once measured; readers
+        (``tools/run_report.py``) take the measured record. ``drift``
+        compares the per-device prediction on mesh entries (XLA
+        reports per-device allocations) and the total otherwise.
+        Synthetic callers (self-tests) pass the byte fields
+        directly."""
+        sharded = False
+        if compiled is not None:
+            pm = getattr(compiled, "predicted_memory", None) or {}
+            if predicted_bytes is None:
+                predicted_bytes = pm.get("peak_bytes")
+            if per_device_bytes is None:
+                per_device_bytes = pm.get("per_device_bytes")
+            sharded = bool(getattr(compiled, "mesh_axes", None))
+            fields.setdefault("entry_uid",
+                              getattr(compiled, "program_uid", None))
+            fields.setdefault("version",
+                              getattr(compiled, "program_version", None))
+            if getattr(compiled, "steps", None):
+                fields.setdefault("steps_fused", compiled.steps)
+            if analysis is not None and measured_bytes is None:
+                mem = analysis.get("memory") or None
+                if mem:
+                    from ..analysis.memory import measured_peak_bytes
+
+                    measured_bytes = measured_peak_bytes(mem)
+        drift = None
+        ref = per_device_bytes if (sharded and per_device_bytes) \
+            else predicted_bytes
+        if ref and measured_bytes:
+            drift = abs(ref - measured_bytes) / measured_bytes
+        return self.event(
+            "memory", predicted_peak_bytes=predicted_bytes,
+            per_device_bytes=per_device_bytes,
+            measured_peak_bytes=measured_bytes, drift=drift, **fields)
+
     def note_step_ms(self, ms):
         """StepTimer feed: remember the latest timed step so the next
         ``record_step`` without an explicit ``step_ms`` uses it."""
@@ -516,6 +561,15 @@ class RunJournal:
 
             analysis = entry_analysis_nowait(compiled)
             if analysis is not None:
+                if not getattr(compiled, "_memory_journaled", False):
+                    # the measured half of the per-entry memory event:
+                    # memory_analysis() landed with the lazy analysis,
+                    # so journal predicted-vs-measured ONCE per entry
+                    compiled._memory_journaled = True
+                    try:
+                        self.record_memory(compiled, analysis=analysis)
+                    except Exception:
+                        pass
                 flops = float((analysis["cost"] or {}).get("flops")
                               or 0) or None
                 prof = analysis.get("collectives")

@@ -504,6 +504,10 @@ class Executor:
 
             _journal.ACTIVE.event("sharding",
                                   **_spmd.sharding_summary(compiled))
+            # one memory event per compiled entry: the static peak-HBM
+            # prediction now; the measured memory_analysis() side is
+            # re-journaled when the entry's lazy analysis lands
+            _journal.ACTIVE.record_memory(compiled)
             if plan is not None:
                 # one plan event per auto-parallel compile: the layout
                 # the planner chose and its predicted-vs-measured wire
@@ -553,6 +557,18 @@ class Executor:
         # frozen (read-only) persistable would delete it from the scope
         updated = tuple(n for n in persist_in if n in written)
         frozen = tuple(n for n in persist_in if n not in written)
+
+        # -- Executor-side verifier checks (need the live Scope / the
+        # installed plan, which the pure-Program passes never see):
+        # PTA011 use-after-donate buffer aliasing, PTA012 feed/fetch
+        # specs inconsistent with the plan (analysis.dataflow)
+        from ..analysis import dataflow as _ana_dataflow
+
+        _ana_dataflow.check_donation_races(report, scope, updated, frozen)
+        if plan is not None:
+            _ana_dataflow.check_plan_consistency(
+                report, plan, feed_names, shapes, fetch_names, scope)
+        report.raise_if_errors()
 
         comm_state = ()
         comm_handles_steps = False
@@ -741,6 +757,24 @@ class Executor:
         # leading feed dim (the batch axis in every workload here)
         lead = [s[0] for s, _ in shapes if len(s) >= 1 and s[0] > 0]
         compiled.examples_hint = max(lead) if lead else None
+        # static peak-HBM prediction for this entry (analysis.memory
+        # liveness walk): journaled as a `memory` event and validated
+        # against the executable's memory_analysis() once the lazy
+        # entry analysis lands (obs.journal.record_memory)
+        from ..analysis import memory as _ana_memory
+
+        try:
+            est = _ana_memory.estimate_entry(
+                program, ops=ops, fetch_list=fetch_list,
+                feed_shapes=dict(zip(feed_names, shapes)),
+                scope_names=set(persist_in), steps=steps, plan=plan,
+                data_devices=(len(jax.local_devices())
+                              if data_parallel and plan is None else 1))
+            compiled.memory_estimate = est
+            compiled.predicted_memory = est.as_event()
+        except Exception:  # an estimate failure must never cost a run
+            compiled.memory_estimate = None
+            compiled.predicted_memory = None
         return compiled
 
     def cache_stats(self, per_entry=False):

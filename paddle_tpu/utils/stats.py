@@ -18,7 +18,20 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["compiled_stats", "memory_usage", "summary"]
+__all__ = ["compiled_stats", "memory_usage", "summary", "format_bytes"]
+
+
+def format_bytes(n):
+    """Human byte formatting shared by the CLI tools (``tools/`` is not
+    a package, so the one copy lives here): ``None -> '?'``, exact
+    integers under 1 KiB, one decimal above."""
+    if n is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{int(n)}B" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
 
 _DTYPE_BYTES = {"float64": 8, "int64": 8, "float32": 4, "int32": 4,
                 "float16": 2, "bfloat16": 2, "int16": 2, "uint16": 2,

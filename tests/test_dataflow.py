@@ -1,0 +1,478 @@
+"""Static dataflow & memory analysis (ISSUE 11): versioned liveness
+intervals on hand-computed fixtures (branchy reuse, assign_to clobber,
+donated persistables), fused steps=K carry liveness, predicted-vs-
+measured peak-HBM within 15% on the mlp/lenet zoo models, the new
+Executor verifier checks (PTA011 use-after-donate aliasing, PTA012
+plan/spec mismatch), the planner's hbm_budget/PTA013 rejection, PTL104
+remat hints, and the per-entry `memory` journal event.
+
+Runs on the 8-device virtual CPU mesh from conftest."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+import paddle_tpu.nn.functional as F
+from paddle_tpu import fleet
+from paddle_tpu.analysis import dataflow as DF
+from paddle_tpu.analysis import memory as M
+from paddle_tpu.analysis import ProgramVerificationError
+from paddle_tpu.static_.program import (Operator, Program, global_scope)
+
+
+@pytest.fixture
+def static_mode():
+    pt.enable_static()
+    yield
+    pt.disable_static()
+
+
+def _f32(shape):
+    n = 1
+    for s in shape:
+        n *= s
+    return n * 4
+
+
+def _base(shape=(2, 3)):
+    p = Program()
+    blk = p.global_block
+    blk.create_var(name="x", shape=shape, dtype="float32", is_data=True)
+    return p, blk
+
+
+def _op(blk, type_, fn, ins, outs, shape=(2, 3), dtype="float32"):
+    for n in outs:
+        if not blk.has_var(n):
+            blk.create_var(name=n, shape=shape, dtype=dtype)
+    blk.append_op(Operator(type_, fn, ins, outs, {}))
+
+
+# -- liveness fixtures --------------------------------------------------------
+
+
+class TestLiveness:
+    def test_def_use_chains(self):
+        p, blk = _base()
+        _op(blk, "scale", lambda a: a * 2.0, ["x"], ["t"])
+        _op(blk, "relu", lambda a: jnp.maximum(a, 0), ["t"], ["u"])
+        _op(blk, "multiply", lambda a, b: a * b, ["t", "u"], ["o"])
+        defs, uses = DF.def_use(blk.ops)
+        assert defs == {"t": [0], "u": [1], "o": [2]}
+        assert uses == {"x": [0], "t": [0, 1, 2][1:], "u": [2]}
+
+    def test_branchy_reuse_last_use_is_the_later_branch(self):
+        """One activation feeding two branches: its interval must
+        extend to the LATER consumer, not close at the first."""
+        p, blk = _base()
+        _op(blk, "scale", lambda a: a * 2.0, ["x"], ["t"])
+        _op(blk, "relu", lambda a: jnp.maximum(a, 0), ["t"], ["a"])
+        _op(blk, "tanh", jnp.tanh, ["t"], ["b"])
+        _op(blk, "multiply", lambda a, b: a * b, ["a", "b"], ["o"])
+        live = DF.analyze(p, fetch_names=("o",))
+        iv = {l.name: (l.def_idx, l.last_use) for l in live.temps()}
+        assert iv["t"] == (0, 2)   # branch at op1 AND op2
+        assert iv["a"] == (1, 3)
+        assert iv["b"] == (2, 3)
+        (o,) = live.intervals("o")
+        assert o.live_out and o.last_use == 4  # fetched: live at exit
+        # the walk's peak: op2 (t, a live, b defined) and op3 (a, b
+        # live) both hold 3 temps... op2: t+a+b = 72; op3: a+b = 48
+        est = M.estimate_entry(p, fetch_list=["o"])
+        assert est.temp_peak_bytes == 3 * _f32((2, 3))
+        assert est.peak_op == (2, "tanh")
+
+    def test_assign_to_clobber_opens_a_new_version(self):
+        """A clobbered name is TWO values: merging their ranges would
+        keep the first alive across the clobber and inflate the peak."""
+        p, blk = _base()
+        _op(blk, "scale", lambda a: a * 2.0, ["x"], ["t"])
+        _op(blk, "scale", lambda a: a * 3.0, ["x"], ["u"])
+        _op(blk, "relu", lambda a: jnp.maximum(a, 0), ["t"], ["r"])
+        _op(blk, "assign_to", lambda a: a, ["u"], ["t"])
+        _op(blk, "multiply", lambda a, b: a * b, ["t", "r"], ["o"])
+        live = DF.analyze(p, fetch_names=("o",))
+        t_versions = live.intervals("t")
+        assert [(l.version, l.def_idx, l.last_use) for l in t_versions] \
+            == [(1, 0, 2), (2, 3, 4)]
+        assert t_versions[0].writer == "scale"
+        assert t_versions[1].writer == "assign_to"
+
+    def test_donated_persistable_entry_version_flagged(self):
+        """A re-emitted scope-held persistable: entry version is the
+        donated buffer, the final write is live-out (restored into the
+        Scope)."""
+        p, blk = _base()
+        blk.create_var(name="w", shape=(2, 3), dtype="float32",
+                       persistable=True)
+        _op(blk, "axpy", lambda a, b: a + b, ["x", "w"], ["w"])
+        live = DF.analyze(p, fetch_names=(), scope_names={"w"})
+        entry, final = live.intervals("w")
+        assert entry.version == 0 and entry.donated
+        assert entry.kind == "persistable"
+        assert final.version == 1 and final.live_out
+        assert "w" in live.donated
+        # a persistable the scope does NOT hold is not donated
+        live2 = DF.analyze(p, fetch_names=(), scope_names=set())
+        assert "w" not in live2.donated
+
+    def test_opt_and_comm_persistables_are_entry_values(self):
+        """`@OPT@` slots and `@comm@*` state are ordinary persistables
+        to the walk — they ride the donated carry like parameters."""
+        p, blk = _base()
+        for name in ("w@OPT@m", "@comm@ef@0"):
+            blk.create_var(name=name, shape=(2, 3), dtype="float32",
+                           persistable=True)
+            _op(blk, "scale", lambda a: a * 0.9, [name], [name])
+        live = DF.analyze(p, fetch_names=(),
+                          scope_names={"w@OPT@m", "@comm@ef@0"})
+        assert live.donated == {"w@OPT@m", "@comm@ef@0"}
+        for name in ("w@OPT@m", "@comm@ef@0"):
+            entry = live.intervals(name)[0]
+            assert entry.kind == "persistable" and entry.donated
+
+
+class TestMemoryEstimate:
+    def test_three_op_hand_computed(self):
+        """x(24B feed) -> t=scale -> u=relu -> o=mul(t,u), fetch o:
+        args 24 + outputs 24 + temps 48 (t,u coexist at op2) = 96 B."""
+        p, blk = _base()
+        _op(blk, "scale", lambda a: a * 2.0, ["x"], ["t"])
+        _op(blk, "relu", lambda a: jnp.maximum(a, 0), ["t"], ["u"])
+        _op(blk, "multiply", lambda a, b: a * b, ["t", "u"], ["o"])
+        est = M.estimate_entry(p, fetch_list=["o"])
+        assert est.arg_bytes == 24
+        assert est.output_bytes == 24
+        assert est.temp_peak_bytes == 48
+        assert est.peak_bytes == 96
+        # t+u first coexist during op1 (relu's input and output)
+        assert est.peak_op == (1, "relu")
+
+    def test_fused_steps_scale_feeds_and_fetches_not_the_carry(self):
+        """steps=K: the executable takes K-stacked feeds and returns
+        K-stacked fetches, but the persistable carry and the
+        per-iteration temp peak count ONCE."""
+        p, blk = _base()
+        blk.create_var(name="w", shape=(2, 3), dtype="float32",
+                       persistable=True)
+        _op(blk, "axpy", lambda a, b: a + b, ["x", "w"], ["w"])
+        _op(blk, "scale", lambda a: a * 1.0, ["w"], ["loss"])
+        one = M.estimate_entry(p, fetch_list=["loss"],
+                               scope_names={"w"})
+        four = M.estimate_entry(p, fetch_list=["loss"],
+                                scope_names={"w"}, steps=4)
+        assert four.liveness.steps == 4
+        assert four.arg_bytes == one.arg_bytes + 3 * 24   # feeds x4
+        assert four.output_bytes == 4 * one.output_bytes  # fetches x4
+        assert four.temp_peak_bytes == one.temp_peak_bytes
+
+    def test_per_device_division_under_a_plan(self, static_mode):
+        prog, _startup, _loss = _mlp_program()
+        plan = fleet.plan_program(prog, (2, 4),
+                                  roles=("data", "model"))
+        est = M.estimate_entry(prog, fetch_list=[], plan=plan)
+        # params shard over model(4), batch feeds + temps over data(2)
+        assert est.per_device_bytes < est.peak_bytes
+        est_dp = M.estimate_entry(prog, fetch_list=[], data_devices=8)
+        assert est_dp.per_device_bytes < est_dp.peak_bytes
+
+    def test_remat_candidates_and_ptl104(self):
+        """A big, cheap activation living across the whole program is
+        the canonical remat candidate; PTL104 names it."""
+        p, blk = _base(shape=(64, 64))
+        _op(blk, "relu", lambda a: jnp.maximum(a, 0), ["x"], ["a"],
+            shape=(64, 64))
+        for i in range(5):  # a long chain NOT consuming `a`
+            _op(blk, "scale", lambda v: v * 1.1,
+                ["x" if i == 0 else f"c{i - 1}"], [f"c{i}"],
+                shape=(64, 64))
+        _op(blk, "multiply", lambda a, b: a * b, ["a", "c4"], ["o"],
+            shape=(64, 64))
+        cands = M.remat_candidates(p, fetch_list=["o"])
+        assert cands and cands[0]["name"] == "a"
+        assert cands[0]["writer"] == "relu"
+        assert cands[0]["bytes"] == _f32((64, 64))
+        assert cands[0]["span"] == 6
+        _est, rep = M.memory_report(p, fetch_list=["o"])
+        assert rep.has("PTL104")
+        assert any(d.var == "a" for d in rep.warnings())
+
+    def test_measured_peak_bytes_helper(self):
+        assert M.measured_peak_bytes(None) is None
+        assert M.measured_peak_bytes({}) is None
+        assert M.measured_peak_bytes(
+            {"argument_size": 100, "output_size": 50, "temp_size": 30,
+             "alias_size": 40, "generated_code_size": 999}) == 140
+
+
+# -- predicted vs measured (the acceptance gate) ------------------------------
+
+
+def _mlp_program(batch=16):
+    pt.seed(0)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[batch, 8])
+        y = fluid.data(name="y", shape=[batch, 1])
+        h = fluid.layers.fc(x, size=36, act="relu")
+        out = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(out, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return prog, startup, loss
+
+
+def _lenet_program(batch=8):
+    from paddle_tpu.models.vision import LeNet
+
+    pt.seed(0)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[batch, 1, 28, 28])
+        y = pt.static.data("y", [batch], "int64")
+        loss = F.cross_entropy(LeNet()(x), y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return prog, startup, loss
+
+
+def _feed_for(prog, rng):
+    feed = {}
+    for v in prog.global_block.vars.values():
+        if not v.is_data or v.name.startswith("@"):
+            continue
+        shape = tuple(int(d) for d in v._data.shape)
+        if "int" in str(v._data.dtype):
+            feed[v.name] = rng.randint(0, 10, shape).astype(
+                str(v._data.dtype))
+        else:
+            feed[v.name] = rng.randn(*shape).astype("float32")
+    return feed
+
+
+def _compile_and_measure(build):
+    from paddle_tpu.obs.mfu import entry_analysis
+
+    prog, startup, loss = build()
+    exe = fluid.Executor()
+    exe.run(startup)
+    exe.run(prog, feed=_feed_for(prog, np.random.RandomState(0)),
+            fetch_list=[loss])
+    (compiled,) = exe._cache.values()
+    measured = M.measured_peak_bytes(entry_analysis(compiled)["memory"])
+    return compiled, measured
+
+
+class TestPredictedVsMeasured:
+    """The ISSUE-11 acceptance gate: the static liveness walk's
+    peak-HBM prediction must land within 15% of the compiled
+    executable's own memory_analysis() on the zoo models."""
+
+    @pytest.mark.parametrize("build", [_mlp_program, _lenet_program],
+                             ids=["mlp", "lenet"])
+    def test_within_15_percent(self, static_mode, build):
+        compiled, measured = _compile_and_measure(build)
+        pred = compiled.predicted_memory
+        assert pred is not None and pred["peak_bytes"] > 0
+        if measured is None:
+            pytest.skip("backend reports no memory_analysis()")
+        drift = abs(pred["peak_bytes"] - measured) / measured
+        assert drift <= 0.15, (
+            f"predicted {pred['peak_bytes']} vs measured {measured}: "
+            f"drift {drift:.1%} > 15% (peak_op {pred['peak_op']})")
+
+    def test_estimate_rides_the_compiled_entry(self, static_mode):
+        compiled, _ = _compile_and_measure(_mlp_program)
+        est = compiled.memory_estimate
+        assert est is not None
+        assert est.peak_bytes == compiled.predicted_memory["peak_bytes"]
+        # the breakdown adds up
+        assert est.peak_bytes == est.arg_bytes + est.const_bytes + \
+            est.output_bytes + est.temp_peak_bytes
+
+
+# -- Executor verifier checks -------------------------------------------------
+
+
+class TestExecutorChecks:
+    def test_pta011_use_after_donate_alias(self, static_mode):
+        """Two persistables sharing ONE scope buffer while one is
+        donated: the compile must die with PTA011, not dispatch a
+        use-after-free."""
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            blk = prog.global_block
+            blk.create_var(name="x", shape=(2, 3), dtype="float32",
+                           is_data=True)
+            blk.create_var(name="w", shape=(2, 3), dtype="float32",
+                           persistable=True)
+            blk.create_var(name="v", shape=(2, 3), dtype="float32",
+                           persistable=True)
+            # v is read-only (frozen); w is re-emitted (donated) with
+            # its last write ending its range — the PROGRAM is clean
+            # (no PTA007); only the Scope aliasing is the hazard
+            _op(blk, "axpy", lambda a, b: a + b, ["x", "v"], ["t"])
+            _op(blk, "axpy2", lambda a, b: a + b, ["t", "w"], ["w"])
+        shared = jnp.zeros((2, 3), jnp.float32)
+        global_scope().set("w", shared)
+        global_scope().set("v", shared)  # the alias
+        exe = fluid.Executor()
+        feed = {"x": np.zeros((2, 3), np.float32)}
+        with pytest.raises(ProgramVerificationError) as ei:
+            exe.run(prog, feed=feed, fetch_list=["t"])
+        assert any(d.code == "PTA011" for d in ei.value.errors)
+        # distinct buffers: same program compiles clean
+        global_scope().set("v", jnp.zeros((2, 3), jnp.float32))
+        exe.run(prog, feed=feed, fetch_list=["t"])
+
+    def test_pta012_plan_spec_mismatch(self, static_mode):
+        """Feed specs inconsistent with the installed plan surface as
+        PTA012 diagnostics on the compile report (the run itself
+        proceeds on the documented replicated fallback)."""
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 virtual devices")
+        prog, startup, loss = _mlp_program()
+        exe = fluid.Executor()
+        exe.run(startup)
+        cp = fleet.auto_parallel(prog, (2, 4),
+                                 roles=("data", "model"), verify=False)
+        # tamper: a spec for a feed this entry never feeds, and a spec
+        # that cannot fit y's (16, 1) shape on the model axis
+        cp._plan.feed_specs["ghost"] = ("data",)
+        cp._plan.feed_specs["y"] = ("data", "model")
+        rng = np.random.RandomState(0)
+        exe.run(cp, feed={"x": rng.randn(16, 8).astype(np.float32),
+                          "y": rng.randn(16, 1).astype(np.float32)},
+                fetch_list=[loss])
+        rep = exe.last_diagnostics
+        pta012 = [d for d in rep if d.code == "PTA012"]
+        assert {d.var for d in pta012} >= {"ghost", "y"}
+        assert not rep.errors()  # warnings: the fallback is documented
+
+    def test_clean_plan_has_no_pta012(self, static_mode):
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 virtual devices")
+        prog, startup, loss = _mlp_program()
+        exe = fluid.Executor()
+        exe.run(startup)
+        cp = fleet.auto_parallel(prog, (2, 4),
+                                 roles=("data", "model"), verify=False)
+        rng = np.random.RandomState(0)
+        exe.run(cp, feed={"x": rng.randn(16, 8).astype(np.float32),
+                          "y": rng.randn(16, 1).astype(np.float32)},
+                fetch_list=[loss])
+        assert not exe.last_diagnostics.has("PTA012")
+
+
+# -- planner budget (PTA013) --------------------------------------------------
+
+
+class TestPlannerBudget:
+    def test_tiny_budget_rejects_everything_with_pta013(
+            self, static_mode):
+        prog, _startup, _loss = _mlp_program()
+        with pytest.raises(ValueError) as ei:
+            fleet.plan_program(prog, (2, 4), hbm_budget=1)
+        assert "PTA013" in str(ei.value)
+
+    def test_partial_budget_prunes_over_budget_candidates(
+            self, static_mode):
+        prog, _startup, _loss = _mlp_program()
+        base = fleet.plan_program(prog, (2, 4))
+        peaks = sorted(c["peak_bytes_per_device"]
+                       for c in base.candidates if c["feasible"])
+        assert peaks and all(p > 0 for p in peaks)
+        budget = peaks[0] + 1  # only the leanest layout fits
+        plan = fleet.plan_program(prog, (2, 4), hbm_budget=budget)
+        assert plan.peak_bytes_per_device <= budget
+        rejected = [c for c in plan.candidates
+                    if not c["feasible"] and "PTA013" in c["note"]]
+        assert rejected, plan.candidates
+        # the memory term is priced, not just gated: every feasible
+        # candidate carries a peak and the plan reports the winner's
+        assert base.peak_bytes_per_device == peaks[0] or \
+            base.peak_bytes_per_device in peaks
+
+    def test_budget_rides_auto_parallel_and_env(self, static_mode,
+                                                monkeypatch):
+        prog, _startup, _loss = _mlp_program()
+        with pytest.raises(ValueError):
+            fleet.auto_parallel(prog, (2, 4), hbm_budget=1,
+                                verify=False)
+        monkeypatch.setenv("PADDLE_TPU_HBM_BUDGET", "1")
+        with pytest.raises(ValueError):
+            fleet.plan_program(prog, (2, 4))
+
+    def test_candidate_diagnostic_object(self, static_mode):
+        from paddle_tpu.fleet.planner import (PlanCandidate,
+                                              _over_budget)
+
+        cand = _over_budget(
+            PlanCandidate(roles=("data",), axes={"data": 8},
+                          feasible=True), 1000, 10)
+        assert not cand.feasible
+        assert cand.diagnostic.code == "PTA013"
+        assert "PTA013" in cand.note
+
+
+# -- journal memory event -----------------------------------------------------
+
+
+class TestJournalMemoryEvent:
+    def test_per_entry_predicted_then_measured(self, static_mode,
+                                               tmp_path):
+        """One memory event at compile (predicted only), a second once
+        the entry's lazy analysis lands (measured + drift <= 15%);
+        run_report folds them into memory_summary."""
+        import importlib.util
+        import os
+
+        from paddle_tpu.obs import journal as J
+        from paddle_tpu.obs.mfu import entry_analysis
+
+        prog, startup, loss = _mlp_program()
+        run_dir = str(tmp_path / "run")
+        with J.RunJournal(run_dir, flush_every=1):
+            exe = fluid.Executor()
+            exe.run(startup)
+            feed = _feed_for(prog, np.random.RandomState(0))
+            exe.run(prog, feed=feed, fetch_list=[loss])
+            (compiled,) = exe._cache.values()
+            entry_analysis(compiled)  # blocking: the measured side
+            exe.run(prog, feed=feed, fetch_list=[loss])
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "run_report", os.path.join(root, "tools", "run_report.py"))
+        rr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rr)
+        run = rr.load_run(run_dir)
+        mem = [e for e in run["events"] if e.get("kind") == "memory"]
+        assert len(mem) == 2
+        predicted_only, measured = mem
+        assert predicted_only["predicted_peak_bytes"] > 0
+        assert predicted_only["measured_peak_bytes"] is None
+        assert measured["measured_peak_bytes"] is not None
+        assert measured["drift"] is not None
+        assert measured["drift"] <= 0.15
+        summ = rr.memory_summary(run)
+        assert summ["entries"] == 2 and summ["measured_entries"] == 1
+        assert summ["max_drift"] == measured["drift"]
+        assert "drift" in rr.render_run(run)
+
+
+# -- fluid.memory_optimize is real now ----------------------------------------
+
+
+class TestMemoryOptimize:
+    def test_none_in_none_out(self):
+        assert fluid.memory_optimize(None) is None
+
+    def test_returns_the_estimate(self, static_mode, capsys):
+        prog, _startup, _loss = _mlp_program()
+        est = fluid.memory_optimize(prog, print_log=True)
+        assert isinstance(est, M.MemoryEstimate)
+        assert est.peak_bytes > 0
+        assert "predicted peak" in capsys.readouterr().out

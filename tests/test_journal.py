@@ -275,8 +275,8 @@ class TestInactiveHooksDoNothing:
             raise AssertionError("journal work performed while inactive")
 
         for name in ("record_step", "record_executor_run",
-                     "record_request", "event", "note_step_ms",
-                     "postmortem"):
+                     "record_request", "record_memory", "event",
+                     "note_step_ms", "postmortem"):
             monkeypatch.setattr(journal.RunJournal, name, boom)
         # the per-compile sharding event and device telemetry must also
         # stay behind the ACTIVE/tracing gates

@@ -47,13 +47,9 @@ def _ensure_fake_devices(n=8):
 
 
 def _fmt_bytes(n):
-    if n is None:
-        return "?"
-    for unit in ("B", "KiB", "MiB", "GiB"):
-        if abs(n) < 1024 or unit == "GiB":
-            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
-        n /= 1024.0
-    return f"{n:.1f}GiB"
+    from paddle_tpu.utils.stats import format_bytes
+
+    return format_bytes(n)
 
 
 def _table(rows, headers):
@@ -133,10 +129,11 @@ def render_plan(plan, verified_candidates=None):
              else "-"),
             f"{c['score']:.3g}" if c["feasible"] else "-",
             _fmt_bytes(c.get("param_bytes_per_device")),
+            _fmt_bytes(c.get("peak_bytes_per_device")),
             c.get("note", "")))
     lines.append(_table(rows, ("layout", "ok", "predicted", "measured",
                                "mismatch", "score", "params/dev",
-                               "note")))
+                               "peak/dev", "note")))
     if plan.param_specs:
         lines.append("param specs  " + ", ".join(
             f"{k}={list(v)}" for k, v in sorted(plan.param_specs.items())))
@@ -271,8 +268,37 @@ def self_test():
         if m8 and m8["feasible"]:
             failures.append("model8 should be infeasible at hidden 500")
         txt = render_plan(plan2)
-        if "layout" not in txt or "predicted" not in txt:
+        if "layout" not in txt or "predicted" not in txt \
+                or "peak/dev" not in txt:
             failures.append("render_plan lost its table")
+
+        # -- hbm_budget (PTA013): every candidate carries a per-device
+        # peak; a budget below the cheapest layout rejects EVERYTHING
+        # with PTA013-coded notes, and a budget between layouts prunes
+        # only the over-budget ones
+        peaks = [c["peak_bytes_per_device"] for c in plan2.candidates
+                 if c["feasible"]]
+        if not peaks or any(not p for p in peaks):
+            failures.append("candidates lost peak_bytes_per_device: "
+                            f"{plan2.candidates}")
+        try:
+            fleet.plan_program(prog2, (2, 4), hbm_budget=1)
+            failures.append("hbm_budget=1 accepted a layout")
+        except ValueError as e:
+            if "PTA013" not in str(e):
+                failures.append(f"budget rejection lost its PTA013 "
+                                f"code: {e}")
+        mid = sorted(peaks)[0] + 1  # only the cheapest layout fits
+        plan3 = fleet.plan_program(prog2, (2, 4), hbm_budget=mid)
+        over = [c for c in plan3.candidates
+                if not c["feasible"] and "PTA013" in c.get("note", "")]
+        if not over:
+            failures.append(f"budget {mid} marked no candidate PTA013 "
+                            f"over-budget: {plan3.candidates}")
+        if plan3.peak_bytes_per_device is None or \
+                plan3.peak_bytes_per_device > mid:
+            failures.append("budgeted plan exceeds its own budget: "
+                            f"{plan3.peak_bytes_per_device} > {mid}")
     finally:
         pt.disable_static()
 
@@ -286,8 +312,10 @@ def self_test():
           "factors, exact), live 8-fake-device auto_parallel whose "
           "predicted wire bytes match the compiled HLO's "
           "CollectiveProfile within 10% (plan-keyed cache entry, "
-          "finite losses), and the tp-heavy model preferring "
-          "dp2 x model4 over pure DP with a >2x visible cost delta")
+          "finite losses), the tp-heavy model preferring "
+          "dp2 x model4 over pure DP with a >2x visible cost delta, "
+          "and hbm_budget rejecting over-budget layouts with PTA013 "
+          "(all-infeasible raises, partial budgets prune)")
     return 0
 
 
@@ -301,6 +329,9 @@ def main(argv=None):
     ap.add_argument("--verify", action="store_true",
                     help="compile every feasible candidate and print "
                          "predicted vs HLO-measured bytes")
+    ap.add_argument("--hbm-budget", type=float, default=None,
+                    help="per-device HBM budget in bytes; over-budget "
+                         "layouts are rejected with PTA013")
     ap.add_argument("--json", action="store_true", help="JSON output")
     ap.add_argument("--self-test", action="store_true",
                     help="hand-computed fixtures + live 8-fake-device "
@@ -323,10 +354,12 @@ def main(argv=None):
             exe.run(startup)
             plan, verified = verify_candidates(prog, args.mesh,
                                                executor=exe)
-            chosen = fleet.plan_program(prog, args.mesh)
+            chosen = fleet.plan_program(prog, args.mesh,
+                                        hbm_budget=args.hbm_budget)
             fleet.verify_plan(chosen, prog, executor=exe)
         else:
-            chosen = fleet.plan_program(prog, args.mesh)
+            chosen = fleet.plan_program(prog, args.mesh,
+                                        hbm_budget=args.hbm_budget)
         if args.json:
             print(json.dumps(
                 {"axes": chosen.axes, "roles": list(chosen.roles),

@@ -10,13 +10,22 @@ for each Program the script left behind.
 Usage:
     python tools/lint_program.py my_script.py            # lint its Programs
     python tools/lint_program.py --optimize-level 2 my_script.py
+    python tools/lint_program.py --memory my_script.py   # liveness + peak HBM
+    python tools/lint_program.py --memory --devices 8 my_script.py
     python tools/lint_program.py --self-test             # check the checker
+
+``--memory`` adds the static dataflow/memory analysis
+(``paddle_tpu.analysis.dataflow`` / ``.memory``) per Program: the
+versioned liveness table, the predicted peak-HBM high-water mark
+(per device with ``--devices N``), and the PTL104 rematerialization
+candidates.
 
 ``--self-test`` builds one known-broken Program per verifier class
 (dangling input, WAW clobber via record_assign, dtype drift, donated-
 then-read persistable) plus a DCE victim, asserts the exact diagnostic
-codes fire, and exits non-zero on any miss — wired into CI so a pass
-regression fails fast.
+codes fire, and additionally checks the liveness/memory analysis
+against a hand-computed 3-op fixture — exits non-zero on any miss,
+wired into CI so a pass regression fails fast.
 """
 from __future__ import annotations
 
@@ -46,7 +55,37 @@ def _report_for(program, optimize_level):
     return ctx
 
 
-def lint_script(path, optimize_level):
+def _memory_section(program, fetch_names, devices):
+    """The --memory report for one Program: liveness table, predicted
+    peak bytes (per device), remat candidates (PTL104)."""
+    from paddle_tpu.analysis import memory as M
+    from paddle_tpu.utils.stats import format_bytes as _fmt_bytes
+
+    est, rep = M.memory_report(program, fetch_list=list(fetch_names),
+                               data_devices=devices)
+    lines = ["   liveness (name/ver  kind  def->last_use  bytes  flags)"]
+    for name, ver, kind, d, u, nb, flags in est.liveness.table():
+        lines.append(f"     {name}@{ver:<3} {kind:<12} {d!s:>5} -> "
+                     f"{u!s:<5} {_fmt_bytes(nb):>10}  {flags}")
+    lines.append(
+        f"   peak HBM     {_fmt_bytes(est.peak_bytes)} total "
+        f"({_fmt_bytes(est.per_device_bytes)}/device over {devices}) = "
+        f"args {_fmt_bytes(est.arg_bytes)} + outputs "
+        f"{_fmt_bytes(est.output_bytes)} + temps "
+        f"{_fmt_bytes(est.temp_peak_bytes)}"
+        + (f" @ op#{est.peak_op[0]} {est.peak_op[1]}"
+           if est.peak_op else ""))
+    hints = [d for d in rep if d.code == "PTL104"]
+    if hints:
+        lines.append(f"   remat        {len(hints)} candidate(s):")
+        lines += [f"     {d!r}" for d in hints]
+    else:
+        lines.append("   remat        no candidates (nothing big, "
+                     "long-lived, and cheap to recompute)")
+    return "\n".join(lines)
+
+
+def lint_script(path, optimize_level, memory=False, devices=1):
     import paddle_tpu as pt
     from paddle_tpu.static_.program import Program, program_guard
 
@@ -82,6 +121,8 @@ def lint_script(path, optimize_level):
             print(f"   optimized op count: {len(ctx.ops)} "
                   f"({n_ops - len(ctx.ops)} removed at level "
                   f"{optimize_level})")
+        if memory:
+            print(_memory_section(prog, ctx.fetch_names, devices))
         if rep.errors():
             worst = 1
     return worst
@@ -181,11 +222,46 @@ def self_test():
     if len(ops) != 1:
         failures.append("dce")
 
+    # liveness/memory: a hand-computed 3-op fixture.
+    #   x (feed, 2x3 f32 = 24 B) -> t = scale(x); u = relu(t);
+    #   o = mul(t, u); fetch o.
+    # Intervals: t def@0 last_use@2, u def@1 last_use@2, o def@2
+    # live-out. Peak: args 24 (x) + outputs 24 (o) + temps 48 (t and u
+    # both live during op#2) = 96 B.
+    from paddle_tpu.analysis import memory as M
+    from paddle_tpu.analysis import dataflow as DF
+
+    p = Program()
+    blk = p.global_block
+    blk.create_var(name="x", shape=(2, 3), dtype="float32", is_data=True)
+    for n in ("t", "u", "o"):
+        blk.create_var(name=n, shape=(2, 3), dtype="float32")
+    blk.append_op(Operator("scale", lambda a: a * 2.0, ["x"], ["t"], {}))
+    blk.append_op(Operator("relu", lambda a: jnp.maximum(a, 0),
+                           ["t"], ["u"], {}))
+    blk.append_op(Operator("multiply", lambda a, b: a * b,
+                           ["t", "u"], ["o"], {}))
+    live = DF.analyze(p, fetch_names=("o",))
+    want = {"t": (0, 2), "u": (1, 2), "o": (2, 3)}
+    got = {l.name: (l.def_idx, l.last_use) for l in live.lives
+           if l.kind == "temp"}
+    status = "ok" if got == want else f"MISSING (got {got})"
+    print(f"  {'3-op liveness intervals':36s} expects {want}: {status}")
+    if got != want:
+        failures.append("liveness intervals")
+    est = M.estimate_entry(p, fetch_list=["o"])
+    status = "ok" if est.peak_bytes == 96 and est.temp_peak_bytes == 48 \
+        else f"MISSING (peak {est.peak_bytes}, temps {est.temp_peak_bytes})"
+    print(f"  {'3-op peak bytes':36s} expects 96 (temps 48): {status}")
+    if est.peak_bytes != 96 or est.temp_peak_bytes != 48:
+        failures.append("peak bytes")
+
     if failures:
         print(f"self-test FAILED: {failures}")
         return 1
     print("self-test passed: every seeded malformed-Program class is "
-          "rejected with its distinct diagnostic")
+          "rejected with its distinct diagnostic, and the 3-op "
+          "liveness/peak-bytes fixture matches the hand computation")
     return 0
 
 
@@ -194,6 +270,12 @@ def main(argv=None):
     ap.add_argument("script", nargs="?", help="program-building script")
     ap.add_argument("--optimize-level", type=int, default=1,
                     help="pass pipeline level to preview (0/1/2)")
+    ap.add_argument("--memory", action="store_true",
+                    help="add the liveness table, predicted peak HBM, "
+                         "and remat candidates per Program")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="data-parallel device count for the per-device "
+                         "peak (--memory)")
     ap.add_argument("--self-test", action="store_true",
                     help="verify the verifier against seeded broken programs")
     args = ap.parse_args(argv)
@@ -201,7 +283,8 @@ def main(argv=None):
         return self_test()
     if not args.script:
         ap.error("a script path is required unless --self-test is given")
-    return lint_script(args.script, args.optimize_level)
+    return lint_script(args.script, args.optimize_level,
+                       memory=args.memory, devices=args.devices)
 
 
 if __name__ == "__main__":

@@ -35,6 +35,8 @@ DEFAULT_STEP_TIME_THRESHOLD = 0.25   # mean step_ms may grow 25%
 DEFAULT_LOSS_THRESHOLD = 0.05        # final loss may grow 5% (relative)
 DEFAULT_COMM_THRESHOLD = 0.10        # all-reduce bytes/step may grow 10%
 DEFAULT_PLAN_MISMATCH_THRESHOLD = 0.10  # planner predicted-vs-measured
+DEFAULT_MEMORY_DRIFT_THRESHOLD = 0.15   # static peak-HBM prediction vs
+#                                         the executable's memory_analysis()
 
 
 # -- loading -----------------------------------------------------------------
@@ -234,6 +236,33 @@ def plan_summary(run):
     }
 
 
+def memory_summary(run):
+    """Static-memory columns over the run's ``memory`` events (one
+    predicted-only event per Executor compile, re-journaled with the
+    executable's ``memory_analysis()`` total once the lazy entry
+    analysis lands): entries measured, predicted/measured byte lists,
+    and the worst predicted-vs-measured drift — the number the
+    analysis.memory liveness walk is accountable to. None when the run
+    journaled no memory events."""
+    events = [e for e in run.get("events") or []
+              if e.get("kind") == "memory"]
+    if not events:
+        return None
+    measured = [e for e in events
+                if isinstance(e.get("measured_peak_bytes"), (int, float))]
+    drifts = [e["drift"] for e in measured
+              if isinstance(e.get("drift"), (int, float))]
+    return {
+        "entries": len(events),
+        "measured_entries": len(measured),
+        "predicted_peak_bytes": [e.get("predicted_peak_bytes")
+                                 for e in measured or events],
+        "measured_peak_bytes": [e.get("measured_peak_bytes")
+                                for e in measured],
+        "max_drift": max(drifts) if drifts else None,
+    }
+
+
 def gate_summary(run):
     """Perf-gate columns over the run's ``perf_gate`` events (written by
     ``tools/perf_gate.journal_gates``): entries gated, failure count,
@@ -324,6 +353,14 @@ def render_run(run, as_json=False):
             f"axes={psum['axes']}"
             + (f", predicted-vs-measured mismatch max={mism:.1%}"
                if mism is not None else ", unverified"))
+    msum = memory_summary(run)
+    if msum:
+        drift = msum["max_drift"]
+        lines.append(
+            f"memory       {msum['entries']} entries "
+            f"({msum['measured_entries']} measured)"
+            + (f", predicted-vs-measured drift max={drift:.1%}"
+               if drift is not None else ", unmeasured"))
     gsum = gate_summary(run)
     if gsum:
         lines.append(f"perf_gates   {gsum['entries']} entries, "
@@ -415,13 +452,27 @@ def diff_runs(base, new,
     out["plan_regression"] = bool(
         nmis is not None and nmis > DEFAULT_PLAN_MISMATCH_THRESHOLD and
         (bmis is None or nmis > bmis))
+    # static-memory drift (analysis.memory vs memory_analysis()): NEW's
+    # peak-HBM prediction drifting >15% off the executable's own number
+    # — and off whatever BASE achieved — means the planner's
+    # activation-memory term (and its hbm_budget rejections) run on
+    # wrong bytes, a regression even when this run's wall time is fine
+    bm, nm = memory_summary(base), memory_summary(new)
+    bmd = (bm or {}).get("max_drift")
+    nmd = (nm or {}).get("max_drift")
+    out["base_memory_drift"] = bmd
+    out["new_memory_drift"] = nmd
+    out["memory_regression"] = bool(
+        nmd is not None and nmd > DEFAULT_MEMORY_DRIFT_THRESHOLD and
+        (bmd is None or nmd > bmd))
     if bl is not None and nl is not None:
         margin = loss_threshold * max(abs(bl), 1e-12)
         out["loss_delta"] = nl - bl
         out["loss_regression"] = bool(nl - bl > margin)
     out["regression"] = out["step_time_regression"] or \
         out["loss_regression"] or out["comm_regression"] or \
-        out["gate_regression"] or out["plan_regression"]
+        out["gate_regression"] or out["plan_regression"] or \
+        out["memory_regression"]
     return out
 
 
@@ -442,6 +493,8 @@ def render_diff(rep, as_json=False):
               "gate_regression", "gate_failure_detail",
               "base_plan_mismatch", "new_plan_mismatch",
               "plan_regression",
+              "base_memory_drift", "new_memory_drift",
+              "memory_regression",
               "base_anomalies", "new_anomalies", "regression"):
         if rep.get(k) is not None:
             lines.append(f"{k:<22} {fmt(rep[k])}")
@@ -452,7 +505,8 @@ def render_diff(rep, as_json=False):
 
 
 def _write_run(run_dir, losses, step_ms, flops=1e9, nonfinite_at=(),
-               comm_bytes=None, gate_failures=(), plan_bytes=None):
+               comm_bytes=None, gate_failures=(), plan_bytes=None,
+               memory_bytes=None):
     """Drive the REAL RunJournal API to produce one synthetic run."""
     from paddle_tpu.obs import journal as J
 
@@ -463,6 +517,12 @@ def _write_run(run_dir, losses, step_ms, flops=1e9, nonfinite_at=(),
                 "wire_bytes": int(comm_bytes * 1.75)}
     j = J.RunJournal(run_dir, flush_every=4, compute_flops=False)
     j.start()
+    if memory_bytes is not None:
+        # one measured memory event through the real record_memory
+        # path; (predicted, measured) inject the drift under test
+        pred, meas = memory_bytes
+        j.record_memory(predicted_bytes=pred, measured_bytes=meas,
+                        entry_uid=1)
     # one perf_gate event per run (the shape journal_gates writes);
     # gate_failures injects a structural regression for the diff to flag
     j.event("perf_gate", entry_uid=1, steps_fused=None, donated=4,
@@ -502,7 +562,8 @@ def self_test():
             # 1 MiB of all-reduce per step
             _write_run(a_dir, [1.0 * (0.93 ** i) for i in range(30)],
                        step_ms=10.0, comm_bytes=1 << 20,
-                       plan_bytes=(100_000, 101_000))
+                       plan_bytes=(100_000, 101_000),
+                       memory_bytes=(1_000_000, 980_000))
             # run B: regressed — 3x slower steps, a loss spike after
             # which the loss never recovers, a 3-step nonfinite
             # streak, and 2x the all-reduce traffic (a partitioner
@@ -513,10 +574,13 @@ def self_test():
                 losses[i] = 0.5  # ...then stuck well above run A's tail
             # run B also carries a planner whose predicted bytes drifted
             # 50% off the HLO-measured truth (plan-mismatch regression)
+            # run B's static peak-HBM prediction also drifted 25% off
+            # the executable's measured bytes (memory regression)
             _write_run(b_dir, losses, step_ms=30.0,
                        nonfinite_at=(12, 13, 14), comm_bytes=2 << 20,
                        gate_failures=("donated buffers 0 < required 4",),
-                       plan_bytes=(100_000, 200_000))
+                       plan_bytes=(100_000, 200_000),
+                       memory_bytes=(1_000_000, 800_000))
 
             a, b = load_run(a_dir), load_run(b_dir)
             if a["parse_errors"] or b["parse_errors"]:
@@ -563,8 +627,17 @@ def self_test():
             if abs((rep["new_plan_mismatch"] or 0) - 0.5) > 1e-9:
                 failures.append(f"plan mismatch {rep['new_plan_mismatch']}"
                                 " != hand-computed 0.5")
+            if not rep["memory_regression"]:
+                failures.append("diff missed the 25% memory "
+                                "predicted-vs-measured drift")
+            if abs((rep["new_memory_drift"] or 0) - 0.25) > 1e-9:
+                failures.append(f"memory drift {rep['new_memory_drift']}"
+                                " != hand-computed 0.25 "
+                                "(|1e6 - 8e5| / 8e5)")
             if "plan" not in render_run(a):
                 failures.append("render_run lost the plan line")
+            if "drift" not in render_run(a):
+                failures.append("render_run lost the memory line")
             if "donated buffers" not in " ".join(
                     rep.get("gate_failure_detail") or ()):
                 failures.append("gate_failure_detail lost the failure "
@@ -622,9 +695,9 @@ def self_test():
     print("self-test passed: journal round-trip, MFU/goodput summary, "
           "loss_spike + nonfinite_streak detectors, the diff gate "
           "flagged the injected step-time, loss, all-reduce-bytes, "
-          "perf-gate (lost donation) AND plan-mismatch regressions "
-          "(and only them), and serving request records round-trip "
-          "with hand-computed TTFT/TPOT percentile columns")
+          "perf-gate (lost donation), plan-mismatch AND memory-drift "
+          "regressions (and only them), and serving request records "
+          "round-trip with hand-computed TTFT/TPOT percentile columns")
     return 0
 
 
