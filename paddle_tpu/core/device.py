@@ -97,15 +97,30 @@ def is_compiled_with_tpu() -> bool:
 
 def set_compilation_cache(directory, min_compile_time_secs=1.0):
     """Persist compiled XLA executables across processes (the TPU analog
-    of the reference's program/kernel caches): every jit/pjit whose
-    compile took >= ``min_compile_time_secs`` is stored under
-    ``directory`` and reloaded on the next run — first-step latency on a
-    tunnel-attached chip drops from tens of seconds to cache-read time.
-    Pass ``None`` to disable. Returns the directory."""
+    of the reference's program/kernel caches). Two layers share
+    ``directory``:
+
+    - jax's native persistent compilation cache (every jit/pjit whose
+      compile took >= ``min_compile_time_secs``) — but jax declines to
+      write it on some backends (notably host CPU), so
+    - the framework's own AOT executable cache
+      (``paddle_tpu.runtime.aot``) is activated on the SAME directory:
+      every Executor/TrainStep/Predictor/ServeEngine compile is then
+      serialized as a content-addressed envelope and hydrated by the
+      next process — first-step latency on a tunnel-attached chip (or
+      a fresh serving replica) drops from tens of seconds to
+      cache-read time, on every backend.
+
+    Pass ``None`` to disable both. Returns the directory."""
     import jax
+
+    from ..runtime import aot as _aot
 
     if directory is None:
         jax.config.update("jax_enable_compilation_cache", False)
+        # force-off, masking an env PADDLE_TPU_AOT_CACHE too — "pass
+        # None to disable both" must hold however the cache came on
+        _aot.disable()
         return None
     import os
 
@@ -115,4 +130,5 @@ def set_compilation_cache(directory, min_compile_time_secs=1.0):
     jax.config.update("jax_compilation_cache_dir", directory)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       float(min_compile_time_secs))
+    _aot.configure(directory)
     return directory

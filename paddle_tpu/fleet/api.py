@@ -65,7 +65,11 @@ def auto_parallel(program, mesh_shape, roles=None, comm_options=None,
     probe compile is paid once per plan; pass ``executor=`` (your run
     executor) and ``fetch_list=`` (your run's fetches) to turn it into
     a warm cache entry the first real ``exe.run`` hits, or
-    ``verify=False`` to skip it entirely.
+    ``verify=False`` to skip it entirely. With an AOT executable cache
+    active (``runtime.aot``: ``set_compilation_cache`` / env
+    ``PADDLE_TPU_AOT_CACHE``) the probe also PUBLISHES the
+    plan-carrying executable to disk, so every later process — each
+    replica of a fleet — hydrates it instead of recompiling.
     ``comm_options`` (dist.gradcomm) requires the plan to be pure DP.
     ``hbm_budget`` (bytes per device; env ``PADDLE_TPU_HBM_BUDGET``)
     rejects layouts whose predicted per-device peak HBM exceeds it

@@ -101,10 +101,21 @@ def _forward_slice(program, feed_names, fetch_names):
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         program=None, main_program=None, **kwargs):
+                         program=None, main_program=None, aot_warm=None,
+                         **kwargs):
     """ref: fluid.io.save_inference_model. Writes <prefix>.pdmodel (program
     pickle) + <prefix>.pdiparams (weights npz). ``main_program`` is the
-    fluid-era spelling of ``program``."""
+    fluid-era spelling of ``program``.
+
+    ``aot_warm``: with an AOT executable cache active
+    (``runtime.aot.configure`` / env ``PADDLE_TPU_AOT_CACHE`` /
+    ``set_compilation_cache``), the saved model also ships a WARM cache:
+    the model is reloaded through the real ``Predictor`` path and its
+    batch-1 entry compiled + published, so a serving replica's first
+    request hydrates instead of compiling. ``None`` (default) warms iff
+    a cache is active, ``False`` never, a directory string warms into
+    that cache. Warming is best-effort — a failure journals, it never
+    fails the save."""
     from ..static_.program import default_main_program, global_scope
 
     program = program or main_program or default_main_program()
@@ -139,6 +150,21 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     np.savez(path_prefix + ".pdiparams", __consts__=np.array(list(consts)),
              **{("c!" + k): v for k, v in consts.items()},
              **{("w!" + k): v for k, v in weights.items()})
+    if aot_warm is not False:
+        from ..runtime import aot as _aot
+
+        cache = _aot.resolve_cache(
+            aot_warm if isinstance(aot_warm, (str, bytes)) else None)
+        if cache is not None:
+            try:
+                _aot.warm_inference_model(path_prefix, cache=cache)
+            except Exception as e:
+                # best-effort: a failed warmup never fails the save,
+                # but it must leave a trace — replicas will cold-
+                # compile and the journal should say why
+                _aot._journal_event(action="warm_failed",
+                                    prefix=str(path_prefix),
+                                    reason=type(e).__name__)
     return feed_names
 
 

@@ -330,6 +330,42 @@ class TrainStep:
 
         return apply
 
+    def _maybe_aot(self, sig, call_args, kind):
+        """AOT executable cache (``runtime.aot``): with a cache active,
+        the first call per compiled signature hydrates the fused step
+        from disk (or compiles eagerly and publishes) instead of
+        letting ``jax.jit`` compile lazily — a warm replica pays
+        deserialize time, not XLA compile time. The cache entry
+        replaces the lazy wrapper in ``self._compiled`` (same calling
+        convention, donation baked in, outputs bitwise identical); no
+        cache, or any AOT failure, keeps the lazy jit untouched."""
+        fn = self._compiled[sig]
+        if not hasattr(fn, "lower"):
+            return fn  # already hydrated for this signature
+        from ..runtime import aot as _aot
+
+        cache = _aot.active_cache()
+        if cache is None:
+            return fn
+        import time
+
+        t0 = time.perf_counter()
+        exe, info = _aot.load_or_compile(
+            fn, call_args, kind=kind, cache=cache,
+            label=type(self.model).__name__)
+        if exe is None:
+            return fn
+        self._compiled[sig] = exe
+        from ..obs import journal as _journal
+
+        if _journal.ACTIVE is not None:
+            prov = _aot.provenance_fields(info)
+            _journal.ACTIVE.event(
+                "compile", source=prov.get("via", "xla"),
+                site="trainstep",
+                ms=(time.perf_counter() - t0) * 1e3, **prov)
+        return exe
+
     def _capture_arg_structs(self, sig, args):
         """Once per compiled shape (NOT per step): shape/dtype/sharding
         structs of the call args, so obs.spmd can later re-lower the
@@ -389,6 +425,9 @@ class TrainStep:
             self._capture_arg_structs(
                 sig, (param_arrs, buf_arrs, opt_state, lr, key, arrays,
                       self._scaler_state))
+        fn = self._maybe_aot(
+            sig, (param_arrs, buf_arrs, opt_state, lr, key, arrays,
+                  self._scaler_state), "trainstep")
         loss, new_params, new_bufs, new_state, new_scaler, found_bad = fn(
             param_arrs, buf_arrs, opt_state, lr, key, arrays,
             self._scaler_state)
@@ -597,6 +636,9 @@ class TrainStep:
             self._capture_arg_structs(
                 fsig, (param_arrs, buf_arrs, opt_state, lrs, keys,
                        stacked, self._scaler_state))
+        fn = self._maybe_aot(
+            fsig, (param_arrs, buf_arrs, opt_state, lrs, keys, stacked,
+                   self._scaler_state), "trainstep_fused")
         losses, new_params, new_bufs, new_state, new_scaler, finfs = fn(
             param_arrs, buf_arrs, opt_state, lrs, keys, stacked,
             self._scaler_state)

@@ -112,7 +112,11 @@ def entry_analysis(compiled):
         from ..utils.stats import _analysis_dict, _cost_dict
 
         try:
-            c = compiled.fn.lower(*structs).compile()
+            # an AOT-hydrated entry's fn IS already a jax.stages.
+            # Compiled (runtime.aot) — analyze the actual executable
+            # instead of paying a re-lower+compile
+            c = compiled.fn if not hasattr(compiled.fn, "lower") \
+                else compiled.fn.lower(*structs).compile()
         except Exception:
             c = None
         if c is not None:

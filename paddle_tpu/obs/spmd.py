@@ -575,7 +575,10 @@ def profile_jit_fn(jit_fn, arg_structs, mesh=None):
     the step path only — the Executor path goes through the cached
     ``obs.mfu.entry_analysis`` instead."""
     try:
-        c = jit_fn.lower(*arg_structs).compile()
+        # a hydrated/compiled fn (runtime.aot) has no .lower — profile
+        # the actual executable's HLO directly
+        c = jit_fn if not hasattr(jit_fn, "lower") \
+            else jit_fn.lower(*arg_structs).compile()
         return collective_profile(c.as_text(), mesh=mesh)
     except Exception:
         return None

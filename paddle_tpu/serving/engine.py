@@ -170,7 +170,8 @@ class ServeEngine:
     """
 
     def __init__(self, model, cache, scheduler=None, policy=None,
-                 sample_fn=None, interpret=None, clock=None):
+                 sample_fn=None, interpret=None, clock=None,
+                 aot_cache_dir=None):
         self.model = model
         self.cache = cache
         if cache.num_heads != model.num_heads or \
@@ -207,6 +208,11 @@ class ServeEngine:
         self._interpret = bool(interpret)
         self._decode_fns = {}    # bucket -> jitted step
         self._prefill_fns = {}   # length bucket -> jitted prefill
+        # AOT executable cache (runtime.aot): a replica constructed
+        # with aot_cache_dir= (or under PADDLE_TPU_AOT_CACHE /
+        # configure()) hydrates its prefill/decode buckets from disk
+        # instead of paying XLA compile per bucket on first traffic
+        self._aot_cache_dir = aot_cache_dir
         self._compiles = 0
         self._dispatches = 0
         self.finished = []       # completed Request objects, in order
@@ -292,9 +298,20 @@ class ServeEngine:
             return logits, k_pages, v_pages
 
         fn = jax.jit(prefill, donate_argnums=(0, 1))
+        struct = jax.ShapeDtypeStruct
+        i32 = np.dtype(np.int32)
+        pool_s = struct(
+            (self.cache.num_layers, self.cache.num_pages,
+             self.cache.page_size, self.cache.num_heads,
+             self.cache.head_dim), np.dtype(self.cache.dtype))
+        fn, aot_info = self._maybe_aot(
+            fn, (pool_s, pool_s, struct((bucket_len,), i32),
+                 struct((), i32), struct((n_page_slots,), i32)),
+            "serve_prefill")
         self._prefill_fns[bucket_len] = fn
         self._compiles += 1
-        self._journal_compile("prefill", bucket=bucket_len)
+        self._journal_compile("prefill", bucket=bucket_len,
+                              aot_info=aot_info)
         return fn
 
     def _get_decode_fn(self, bucket, width=None):
@@ -335,9 +352,12 @@ class ServeEngine:
             pool_s, pool_s, struct((bucket,), i32),
             struct((bucket, W), i32), struct((bucket,), i32),
             struct((bucket,), i32), struct((bucket,), i32)), bucket, W)
+        entry.fn, aot_info = self._maybe_aot(
+            entry.fn, entry.arg_structs, "serve_decode")
         self._decode_fns[key] = entry
         self._compiles += 1
-        self._journal_compile("decode", bucket=bucket, table_width=W)
+        self._journal_compile("decode", bucket=bucket, table_width=W,
+                              aot_info=aot_info)
         return entry
 
     def decode_entry(self, bucket=1):
@@ -506,11 +526,29 @@ class ServeEngine:
             pass
         self._journal_request(req)
 
+    def _maybe_aot(self, fn, structs, kind):
+        """Hydrate one jitted bucket step from the AOT executable cache
+        (or compile eagerly + publish). ``(fn, None)`` unchanged when
+        no cache is active or AOT failed — the lazy jit then compiles
+        on first dispatch exactly as before."""
+        from ..runtime import aot as _aot
+
+        cache = _aot.resolve_cache(self._aot_cache_dir)
+        if cache is None:
+            return fn, None
+        exe, info = _aot.load_or_compile(
+            fn, structs, kind=kind, cache=cache,
+            label=type(self.model).__name__)
+        return (exe, info) if exe is not None else (fn, None)
+
     # -- observability -------------------------------------------------------
-    def _journal_compile(self, kind, **fields):
+    def _journal_compile(self, kind, aot_info=None, **fields):
         if _journal.ACTIVE is not None:
+            from ..runtime import aot as _aot
+
             _journal.ACTIVE.event("compile", source="serving",
-                                  entry=kind, **fields)
+                                  entry=kind, **fields,
+                                  **_aot.provenance_fields(aot_info))
 
     def _journal_request(self, req):
         if _journal.ACTIVE is not None:

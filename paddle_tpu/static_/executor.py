@@ -493,10 +493,22 @@ class Executor:
         compile_ms = (time.perf_counter() - t0) * 1e3
         _M_COMPILE_MS.observe(compile_ms)
         if _journal.ACTIVE is not None:
+            # provenance: "xla" = compiled in this process (the lazy-jit
+            # default), "aot_disk" = hydrated from the AOT executable
+            # cache (runtime.aot) — zero XLA compile paid here. `via`
+            # carries the same value on every site's compile events
+            # (predictor/serving pin `source` to their site tag), so
+            # run_report's cold-start summary reads one field.
+            from ..runtime import aot as _aot
+
+            prov = _aot.provenance_fields(
+                getattr(compiled, "aot_info", None))
+            prov.setdefault("via", "xla")
+            extra = {"steps_fused": int(steps)} if steps else {}
             _journal.ACTIVE.event(
                 "compile", uid=program._uid, version=program._version,
                 optimize_level=int(optimize_level), ms=compile_ms,
-                **({"steps_fused": int(steps)} if steps else {}))
+                source=prov["via"], **prov, **extra)
             # one sharding event per compiled entry: feed/persistable
             # placement + footprints (metadata only — obs.spmd reads the
             # structs captured above, no device or XLA work)
@@ -775,6 +787,26 @@ class Executor:
         except Exception:  # an estimate failure must never cost a run
             compiled.memory_estimate = None
             compiled.predicted_memory = None
+        # -- AOT executable cache (runtime.aot): with a cache active the
+        # entry compiles EAGERLY — hydrated from disk when the content
+        # digest (fingerprint + lowered StableHLO) matches, else
+        # lowered.compile() + published — and compiled.fn becomes the
+        # jax.stages.Compiled (same calling convention, donation and
+        # shardings baked in, outputs bitwise what the lazy jit would
+        # produce). No cache -> lazy jit, exactly as before.
+        compiled.aot_info = None
+        from ..runtime import aot as _aot
+
+        cache = _aot.active_cache()
+        if cache is not None:
+            label = f"uid{program._uid}v{program._version}" + \
+                (f"/steps{steps}" if steps else "")
+            exe, info = _aot.load_or_compile(
+                jit_fn, compiled.arg_structs, kind="executor",
+                cache=cache, label=label)
+            if exe is not None:
+                compiled.fn = exe
+                compiled.aot_info = info
         return compiled
 
     def cache_stats(self, per_entry=False):

@@ -175,7 +175,10 @@ def memory_usage(program, batch_size=None, fetch_list=None):
     upd = [struct(n) for n in compiled.updated]
     frz = [struct(n) for n in compiled.frozen]
     try:
-        ma = compiled.fn.lower(feeds, upd, frz).compile().memory_analysis()
+        # AOT-hydrated entries (runtime.aot) hold the Compiled directly
+        c = compiled.fn if not hasattr(compiled.fn, "lower") \
+            else compiled.fn.lower(feeds, upd, frz).compile()
+        ma = c.memory_analysis()
     except Exception:
         ma = None
     if ma is not None:

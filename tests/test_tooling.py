@@ -146,6 +146,22 @@ def test_fleet_plan_self_test_passes():
     assert mod.main(["--self-test"]) == 0
 
 
+def test_aot_cache_self_test_passes():
+    """tools/aot_cache.py --self-test: the ISSUE-12 acceptance core —
+    a compiled entry round-trips through serialize/deserialize with
+    BITWISE-identical outputs and its input_output_alias donation
+    intact, a changed feed shape produces a clean content-key miss
+    (never a stale load), a poisoned-fingerprint envelope refuses to
+    load and falls back to a fresh compile, verify/evict classify the
+    stale entry exactly, and a fresh Executor over a fresh build of the
+    same program hydrates from disk with a bitwise-identical loss
+    trajectory whose donated carry still passes the perf gate. In-
+    process so it rides the tier-1 command path like the other
+    self-tests."""
+    mod = _load_tool("aot_cache")
+    assert mod.main(["--self-test"]) == 0
+
+
 def test_chaos_marker_is_registered():
     """tests/test_resilience.py marks itself `chaos`; an unregistered
     marker would warn (or fail under --strict-markers). Pin it."""

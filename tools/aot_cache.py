@@ -1,0 +1,333 @@
+#!/usr/bin/env python
+"""aot_cache: inspect, verify, evict, and warm the AOT executable cache.
+
+The operational front door for ``paddle_tpu.runtime.aot`` — the
+content-addressed on-disk cache that lets a fresh process (serving
+replica, elastic relaunch, fleet probe) hydrate compiled XLA
+executables instead of recompiling them.
+
+Usage:
+    python tools/aot_cache.py DIR                  # list entries
+    python tools/aot_cache.py DIR --verify         # live-fingerprint check
+    python tools/aot_cache.py DIR --evict --stale  # drop unloadable ones
+    python tools/aot_cache.py DIR --evict --older-than 86400
+    python tools/aot_cache.py DIR --evict --all
+    python tools/aot_cache.py DIR --warm PREFIX [--buckets 1,4]
+        # compile+publish executables for a saved inference model
+        # (framework.io.save_inference_model prefix) so a replica's
+        # first request hydrates instead of compiling
+    python tools/aot_cache.py --self-test
+        # round-trip a compiled entry through serialize/deserialize
+        # (bitwise outputs, donation survival), a poisoned-fingerprint
+        # envelope refusing to load, CacheKey-drift isolation, and the
+        # Executor-level hydrate path
+
+Wired into tier-1 via tests/test_tooling.py (chaos_run/obs_report/
+run_report pattern).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _ensure_fake_devices(n=8):
+    """Standalone runs need the fake-device CPU platform configured
+    BEFORE jax initializes; under pytest the conftest already did."""
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+
+
+# -- commands -----------------------------------------------------------------
+
+
+def list_entries(cache, as_json=False):
+    rows = cache.entries()
+    if as_json:
+        return json.dumps(rows, indent=1, default=str, sort_keys=True)
+    if not rows:
+        return f"(empty cache at {cache.dir})"
+    lines = [f"{'digest':<16} {'kind':<16} {'bytes':>10} {'age_s':>8} "
+             f"{'compile_ms':>10}  label"]
+    for r in rows:
+        if r.get("error"):
+            lines.append(f"{r['digest'][:16]:<16} UNREADABLE "
+                         f"({r['error']})")
+            continue
+        cm = r.get("compile_ms")
+        lines.append(
+            f"{r['digest'][:16]:<16} {str(r.get('kind')):<16} "
+            f"{r['bytes']:>10} {r['age_s']:>8.0f} "
+            f"{(f'{cm:.1f}' if cm is not None else '-'):>10}  "
+            f"{r.get('label') or ''}")
+    lines.append(f"{len(rows)} entries, "
+                 f"{sum(r['bytes'] for r in rows)} bytes total")
+    return "\n".join(lines)
+
+
+def verify(cache, as_json=False):
+    ok, stale = cache.verify()
+    if as_json:
+        return json.dumps({"ok": ok, "stale": stale})
+    lines = [f"{len(ok)} entries valid for the live fingerprint"]
+    for d in stale:
+        lines.append(f"STALE {d[:16]} (would refuse to load; "
+                     "--evict --stale clears it)")
+    return "\n".join(lines)
+
+
+def warm(cache, prefix, buckets):
+    from paddle_tpu.runtime import aot as _aot
+
+    before = cache.stats()["entries"]
+    warmed = _aot.warm_inference_model(prefix, buckets=buckets,
+                                       cache=cache)
+    after = cache.stats()["entries"]
+    return (f"warmed {warmed}/{len(buckets)} bucket(s) from {prefix}: "
+            f"{after - before} new entries ({after} total)")
+
+
+# -- self-test ----------------------------------------------------------------
+
+
+def self_test():
+    import tempfile
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.runtime import aot
+
+    failures = []
+    env_before = os.environ.pop(aot.ENV_DIR, None)
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            cache = aot.AOTCache(os.path.join(d, "cache"))
+
+            # 1. round-trip: a donated training-style step must come
+            # back from disk with bitwise outputs AND its
+            # input_output_alias intact
+            def step(w, x):
+                g = jax.grad(lambda w: ((x @ w) ** 2).sum())(w)
+                return w - 0.1 * g
+
+            fn = jax.jit(step, donate_argnums=(0,))
+            w = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+            x = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+            structs = (jax.ShapeDtypeStruct((8, 8), np.float32),
+                       jax.ShapeDtypeStruct((4, 8), np.float32))
+            exe1, info1 = aot.load_or_compile(fn, structs, "self_test",
+                                              cache=cache)
+            if info1["source"] != "xla" or not info1["stored"]:
+                failures.append(f"first compile not stored: {info1}")
+            exe2, info2 = aot.load_or_compile(fn, structs, "self_test",
+                                              cache=cache)
+            if info2["source"] != "aot_disk":
+                failures.append(f"second lookup did not hydrate: {info2}")
+            r1 = np.asarray(exe1(jnp.asarray(w), jnp.asarray(x)))
+            r2 = np.asarray(exe2(jnp.asarray(w), jnp.asarray(x)))
+            if not np.array_equal(r1, r2):
+                failures.append("hydrated executable outputs differ "
+                                "bitwise from the in-process compile")
+            if "input_output_alias" not in exe2.as_text():
+                failures.append("donation (input_output_alias) lost in "
+                                "the serialize round-trip")
+
+            # 2. content-key drift: a different shape must produce a
+            # DIFFERENT entry (miss + fresh compile), never a stale hit
+            structs_b = (jax.ShapeDtypeStruct((8, 8), np.float32),
+                         jax.ShapeDtypeStruct((16, 8), np.float32))
+            _, info3 = aot.load_or_compile(fn, structs_b, "self_test",
+                                           cache=cache)
+            if info3["source"] != "xla" or \
+                    info3["digest"] == info1["digest"]:
+                failures.append(f"shape drift did not miss: {info3}")
+            if cache.stats()["entries"] != 2:
+                failures.append(f"expected 2 entries, got "
+                                f"{cache.stats()}")
+
+            # 3. poisoned fingerprint: an envelope claiming another
+            # jax version must REFUSE to load — rejected on the JSON
+            # header, before ANY pickled bytes are read — and fall
+            # back to a fresh compile
+            def poison(digest):
+                path = cache._path(digest)
+                hdr, trees, payload = aot._read_entry(path)
+                hdr["fingerprint"] = dict(hdr["fingerprint"],
+                                          jax="0.0.poisoned")
+                aot._write_entry(path, hdr, trees, payload)
+
+            poison(info1["digest"])
+            loaded, reason = cache.load(info1["digest"])
+            if loaded is not None or "fingerprint" not in str(reason):
+                failures.append(f"poisoned fingerprint loaded anyway: "
+                                f"{reason}")
+            _, info4 = aot.load_or_compile(fn, structs, "self_test",
+                                           cache=cache)
+            if info4["source"] != "xla" or \
+                    "fingerprint" not in str(info4.get("miss_reason")):
+                failures.append(f"poisoned entry did not fall back to "
+                                f"compile: {info4}")
+
+            # 4. verify/evict: the (re-published) entries are valid;
+            # re-poison one and --stale eviction must remove ONLY it
+            poison(info1["digest"])
+            ok, stale = cache.verify()
+            if stale != [info1["digest"]] or len(ok) != 1:
+                failures.append(f"verify misclassified: ok={ok} "
+                                f"stale={stale}")
+            if cache.evict(stale_only=True) != 1 or \
+                    cache.stats()["entries"] != 1:
+                failures.append("stale eviction removed the wrong "
+                                f"entries: {cache.stats()}")
+            rows = cache.entries()
+            if not (len(rows) == 1 and rows[0]["kind"] == "self_test"
+                    and rows[0]["bytes"] > 0):
+                failures.append(f"entries() listing wrong: {rows}")
+
+            # 5. Executor-level hydrate: a FRESH Executor over the same
+            # program must fill its entry from disk — zero XLA compile
+            # — with bitwise-identical fetches, and the hydrated
+            # entry's donation must still pass the perf gate
+            import paddle_tpu as pt
+            import paddle_tpu.nn.functional as F
+            from paddle_tpu import optim
+
+            aot.configure(os.path.join(d, "exec_cache"))
+            try:
+                rng = np.random.RandomState(0)
+                bx = rng.randn(8, 4).astype("float32")
+                by = rng.randn(8, 1).astype("float32")
+
+                def run3():
+                    # a FULL fresh build per run — new Program, newly
+                    # initialized params, new Executor — exactly what a
+                    # second process does; only the content key links
+                    # the two builds to one disk entry
+                    pt.seed(0)
+                    pt.enable_static()
+                    try:
+                        main_p = pt.static.Program()
+                        startup = pt.static.Program()
+                        with pt.program_guard(main_p, startup):
+                            xv = pt.static.data("x", [8, 4], "float32")
+                            yv = pt.static.data("y", [8, 1], "float32")
+                            out = pt.static.nn.fc(xv, 4)
+                            loss = F.mse_loss(out, yv)
+                            optim.SGD(0.1).minimize(loss)
+                    finally:
+                        pt.disable_static()
+                    exe = pt.static.Executor()
+                    exe.run(startup)
+                    return [np.asarray(exe.run(main_p,
+                                               feed={"x": bx, "y": by},
+                                               fetch_list=[loss])[0])
+                            for _ in range(3)], \
+                        next(iter(exe._cache.values()))
+
+                la, ea = run3()
+                if (ea.aot_info or {}).get("source") != "xla":
+                    failures.append(f"first executor compile not "
+                                    f"published: {ea.aot_info}")
+                lb, eb = run3()
+                if (eb.aot_info or {}).get("source") != "aot_disk":
+                    failures.append(f"fresh executor did not hydrate: "
+                                    f"{eb.aot_info}")
+                if not all(np.array_equal(p, q)
+                           for p, q in zip(la, lb)):
+                    failures.append("hydrated executor loss trajectory "
+                                    "differs bitwise")
+                import importlib.util
+
+                spec = importlib.util.spec_from_file_location(
+                    "aot_perf_gate", os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "perf_gate.py"))
+                pg = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(pg)
+                hlo = pg.entry_hlo(eb)
+                don = pg.donation_stats(hlo) if hlo else None
+                if not don or don["count"] < 1:
+                    failures.append(f"hydrated entry lost donation "
+                                    f"through perf_gate: {don}")
+            finally:
+                aot.configure(None)
+    finally:
+        if env_before is not None:
+            os.environ[aot.ENV_DIR] = env_before
+
+    if failures:
+        print("SELF-TEST FAILURES:")
+        for f in failures:
+            print(" -", f)
+        return 1
+    print("self-test passed: serialize/deserialize round-trip is "
+          "bitwise with donation intact, content-key drift misses "
+          "cleanly, a poisoned-fingerprint envelope refuses to load "
+          "and falls back to a fresh compile, verify/evict classify "
+          "stale entries exactly, and a fresh Executor hydrates the "
+          "same program from disk with a bitwise-identical trajectory "
+          "and a perf-gate-verified donated carry")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dir", nargs="?", help="cache directory")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--verify", action="store_true")
+    ap.add_argument("--evict", action="store_true")
+    ap.add_argument("--stale", action="store_true",
+                    help="with --evict: only fingerprint-stale entries")
+    ap.add_argument("--older-than", type=float, default=None,
+                    metavar="S", help="with --evict: only entries older "
+                    "than S seconds")
+    ap.add_argument("--all", action="store_true",
+                    help="with --evict: everything")
+    ap.add_argument("--warm", metavar="PREFIX", default=None,
+                    help="compile+publish executables for a saved "
+                    "inference model prefix")
+    ap.add_argument("--buckets", default="1",
+                    help="comma-separated batch buckets for --warm")
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        _ensure_fake_devices()
+        return self_test()
+    if not args.dir:
+        ap.error("cache directory required (or --self-test)")
+    from paddle_tpu.runtime.aot import AOTCache
+
+    cache = AOTCache(args.dir)
+    if args.evict:
+        if not (args.stale or args.all or args.older_than is not None):
+            ap.error("--evict needs --stale, --older-than S, or --all")
+        n = cache.evict(older_than_s=args.older_than,
+                        stale_only=args.stale)
+        print(f"evicted {n} entries")
+        return 0
+    if args.warm is not None:
+        _ensure_fake_devices()
+        buckets = tuple(int(b) for b in args.buckets.split(",") if b)
+        print(warm(cache, args.warm, buckets))
+        return 0
+    if args.verify:
+        print(verify(cache, as_json=args.json))
+        return 0
+    print(list_entries(cache, as_json=args.json))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

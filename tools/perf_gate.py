@@ -229,7 +229,13 @@ def entry_hlo(compiled):
     if structs is None:
         return None
     try:
-        text = compiled.fn.lower(*structs).compile().as_text()
+        # an AOT-hydrated entry (paddle_tpu.runtime.aot) holds the
+        # jax.stages.Compiled directly — its as_text() IS the hydrated
+        # executable's HLO, which is exactly what the donation gate
+        # must verify survived the serialize round-trip
+        text = compiled.fn.as_text() \
+            if not hasattr(compiled.fn, "lower") \
+            else compiled.fn.lower(*structs).compile().as_text()
     except Exception:
         return None
     compiled._perf_gate_hlo = text

@@ -282,6 +282,41 @@ def gate_summary(run):
             "failures": failures}
 
 
+def aot_summary(run):
+    """Cold-start columns over the run's ``compile`` events' AOT
+    provenance (``via``: "xla" = compiled in-process, "aot_disk" =
+    hydrated from the executable cache, ``runtime.aot``): entries
+    hydrated vs compiled, total deserialize time, and the compile time
+    the cache avoided (each hydrated event carries the ORIGINAL
+    compile's wall ms from the envelope). ``engaged`` is True when an
+    AOT cache actually participated (something hydrated, or an eager
+    miss-compile was published) — plain lazy-jit runs also tag
+    ``via="xla"`` but stay ``engaged=False`` so the render line only
+    appears for AOT runs. None when no compile event carries
+    provenance."""
+    events = [e for e in run.get("events") or []
+              if e.get("kind") == "compile"
+              and e.get("via") in ("xla", "aot_disk")]
+    if not events:
+        return None
+    hydrated = [e for e in events if e["via"] == "aot_disk"]
+    compiled = [e for e in events if e["via"] == "xla"]
+    des = [e["deserialize_ms"] for e in hydrated
+           if isinstance(e.get("deserialize_ms"), (int, float))]
+    avoided = [e["compile_ms_avoided"] for e in hydrated
+               if isinstance(e.get("compile_ms_avoided"), (int, float))]
+    eager = [e for e in compiled
+             if isinstance(e.get("xla_compile_ms"), (int, float))]
+    return {
+        "entries": len(events),
+        "hydrated": len(hydrated),
+        "compiled": len(compiled),
+        "deserialize_ms": sum(des) if des else 0.0,
+        "compile_ms_avoided": sum(avoided) if avoided else None,
+        "engaged": bool(hydrated or eager),
+    }
+
+
 def _final_loss(run, k=5):
     """Median of the last k finite losses — robust to one noisy tail
     step."""
@@ -367,6 +402,15 @@ def render_run(run, as_json=False):
                      f"{gsum['failed_entries']} failed"
                      + (f": {'; '.join(gsum['failures'][:3])}"
                         if gsum["failures"] else ""))
+    asum = aot_summary(run)
+    if asum and asum["engaged"]:
+        line = (f"aot          {asum['hydrated']} hydrated / "
+                f"{asum['compiled']} compiled")
+        if asum["hydrated"]:
+            line += f", deserialize {asum['deserialize_ms']:.1f}ms"
+        if asum["compile_ms_avoided"]:
+            line += f", compile avoided {asum['compile_ms_avoided']:.1f}ms"
+        lines.append(line)
     esum = elastic_summary(run)
     if esum:
         line = (f"elastic      restarts={esum['restarts']} "
@@ -465,6 +509,21 @@ def diff_runs(base, new,
     out["memory_regression"] = bool(
         nmd is not None and nmd > DEFAULT_MEMORY_DRIFT_THRESHOLD and
         (bmd is None or nmd > bmd))
+    # AOT cold-start fold (runtime.aot provenance on compile events):
+    # BASE warm-started from the executable cache but NEW compiles
+    # more entries from scratch — a replica's cold start regressed
+    # (cache key drifted, serialization broke, warmup stopped shipping)
+    # even when this run's wall time hides it behind lazy compiles
+    ba, na = aot_summary(base), aot_summary(new)
+    out["base_aot_hydrated"] = (ba or {}).get("hydrated")
+    out["new_aot_hydrated"] = (na or {}).get("hydrated")
+    # NEW journaling no provenance at all reads as every base-hydrated
+    # entry gone cold (base is the older format only when it never
+    # hydrated, and then the gate is off anyway)
+    new_compiled = na["compiled"] if na else \
+        (ba["hydrated"] if ba else 0)
+    out["aot_regression"] = bool(
+        ba and ba["hydrated"] and new_compiled > ba["compiled"])
     if bl is not None and nl is not None:
         margin = loss_threshold * max(abs(bl), 1e-12)
         out["loss_delta"] = nl - bl
@@ -472,7 +531,7 @@ def diff_runs(base, new,
     out["regression"] = out["step_time_regression"] or \
         out["loss_regression"] or out["comm_regression"] or \
         out["gate_regression"] or out["plan_regression"] or \
-        out["memory_regression"]
+        out["memory_regression"] or out["aot_regression"]
     return out
 
 
@@ -495,6 +554,8 @@ def render_diff(rep, as_json=False):
               "plan_regression",
               "base_memory_drift", "new_memory_drift",
               "memory_regression",
+              "base_aot_hydrated", "new_aot_hydrated",
+              "aot_regression",
               "base_anomalies", "new_anomalies", "regression"):
         if rep.get(k) is not None:
             lines.append(f"{k:<22} {fmt(rep[k])}")
@@ -506,7 +567,7 @@ def render_diff(rep, as_json=False):
 
 def _write_run(run_dir, losses, step_ms, flops=1e9, nonfinite_at=(),
                comm_bytes=None, gate_failures=(), plan_bytes=None,
-               memory_bytes=None):
+               memory_bytes=None, aot=None):
     """Drive the REAL RunJournal API to produce one synthetic run."""
     from paddle_tpu.obs import journal as J
 
@@ -517,6 +578,17 @@ def _write_run(run_dir, losses, step_ms, flops=1e9, nonfinite_at=(),
                 "wire_bytes": int(comm_bytes * 1.75)}
     j = J.RunJournal(run_dir, flush_every=4, compute_flops=False)
     j.start()
+    if aot is not None:
+        # (hydrated, compiled) AOT-provenance compile events, the shape
+        # Executor._compile writes with an executable cache active
+        hyd, cmp_ = aot
+        for _ in range(hyd):
+            j.event("compile", uid=1, version=1, ms=2.0,
+                    source="aot_disk", via="aot_disk",
+                    deserialize_ms=2.0, compile_ms_avoided=40.0)
+        for _ in range(cmp_):
+            j.event("compile", uid=1, version=1, ms=45.0,
+                    source="xla", via="xla", xla_compile_ms=40.0)
     if memory_bytes is not None:
         # one measured memory event through the real record_memory
         # path; (predicted, measured) inject the drift under test
@@ -563,7 +635,8 @@ def self_test():
             _write_run(a_dir, [1.0 * (0.93 ** i) for i in range(30)],
                        step_ms=10.0, comm_bytes=1 << 20,
                        plan_bytes=(100_000, 101_000),
-                       memory_bytes=(1_000_000, 980_000))
+                       memory_bytes=(1_000_000, 980_000),
+                       aot=(2, 0))
             # run B: regressed — 3x slower steps, a loss spike after
             # which the loss never recovers, a 3-step nonfinite
             # streak, and 2x the all-reduce traffic (a partitioner
@@ -576,11 +649,14 @@ def self_test():
             # 50% off the HLO-measured truth (plan-mismatch regression)
             # run B's static peak-HBM prediction also drifted 25% off
             # the executable's measured bytes (memory regression)
+            # run B also COLD-compiles the entries run A hydrated from
+            # the AOT executable cache (warm-start regression)
             _write_run(b_dir, losses, step_ms=30.0,
                        nonfinite_at=(12, 13, 14), comm_bytes=2 << 20,
                        gate_failures=("donated buffers 0 < required 4",),
                        plan_bytes=(100_000, 200_000),
-                       memory_bytes=(1_000_000, 800_000))
+                       memory_bytes=(1_000_000, 800_000),
+                       aot=(0, 2))
 
             a, b = load_run(a_dir), load_run(b_dir)
             if a["parse_errors"] or b["parse_errors"]:
@@ -627,6 +703,17 @@ def self_test():
             if abs((rep["new_plan_mismatch"] or 0) - 0.5) > 1e-9:
                 failures.append(f"plan mismatch {rep['new_plan_mismatch']}"
                                 " != hand-computed 0.5")
+            if not rep["aot_regression"]:
+                failures.append("diff missed the AOT warm-start "
+                                "regression (base hydrated 2, new "
+                                "cold-compiled 2)")
+            asum = aot_summary(a)
+            if not (asum and asum["hydrated"] == 2
+                    and asum["compile_ms_avoided"] == 80.0):
+                failures.append(f"aot_summary lost the hydration "
+                                f"accounting: {asum}")
+            if "aot          2 hydrated" not in render_run(a):
+                failures.append("render_run lost the aot cold-start line")
             if not rep["memory_regression"]:
                 failures.append("diff missed the 25% memory "
                                 "predicted-vs-measured drift")
@@ -695,7 +782,8 @@ def self_test():
     print("self-test passed: journal round-trip, MFU/goodput summary, "
           "loss_spike + nonfinite_streak detectors, the diff gate "
           "flagged the injected step-time, loss, all-reduce-bytes, "
-          "perf-gate (lost donation), plan-mismatch AND memory-drift "
+          "perf-gate (lost donation), plan-mismatch, memory-drift AND "
+          "AOT warm-start "
           "regressions (and only them), and serving request records "
           "round-trip with hand-computed TTFT/TPOT percentile columns")
     return 0
