@@ -21,6 +21,12 @@ heartbeat files and killed, preemptions (exit 75 from
 ``resilience.graceful_shutdown``) relaunch budget-free, and crashes
 relaunch from the newest intact checkpoint under ``--max_restarts``
 with jittered backoff.
+
+Fleet observability: with ``--run_dir`` (default: the inherited
+``PADDLE_TPU_RUN_DIR``) every worker journals into its own
+``<run_dir>/rank_NN`` subdir with a ``PADDLE_TPU_RANK`` identity —
+``tools/fleet_report.py`` aggregates the per-rank records into one
+cross-rank skew/straggler view.
 """
 from __future__ import annotations
 
@@ -59,6 +65,14 @@ def _parse_args(argv=None):
     p.add_argument("--ckpt_dir", type=str, default=None,
                    help="checkpoint dir the supervisor inspects to "
                         "journal each restart's resume step (--elastic)")
+    p.add_argument("--run_dir", type=str,
+                   default=os.environ.get("PADDLE_TPU_RUN_DIR") or None,
+                   help="fleet flight-record root: each worker journals "
+                        "into <run_dir>/rank_NN (PADDLE_TPU_RUN_DIR + "
+                        "PADDLE_TPU_RANK per rank); defaults to "
+                        "PADDLE_TPU_RUN_DIR so a journaled launch is "
+                        "fleet-observable without extra flags "
+                        "(tools/fleet_report.py aggregates)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -73,9 +87,11 @@ def get_cluster_endpoints(ips, nproc_per_node, started_port):
     return eps
 
 
-def _trainer_env(args, eps, world, local):
+def _trainer_env(args, eps, world, local, run_dir=None):
     """The PADDLE_TRAINER_* (+ CPU-simulation) env UPDATE for one local
-    worker — shared by the plain and elastic paths."""
+    worker — shared by the plain and elastic paths. ``run_dir`` hands
+    the worker its per-rank journal subdir + rank identity (the
+    elastic path passes None: GangSupervisor owns that wiring)."""
     rank = args.node_rank * args.nproc_per_node + local
     env = {
         "PADDLE_TRAINER_ID": str(rank),
@@ -83,6 +99,12 @@ def _trainer_env(args, eps, world, local):
         "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
         "PADDLE_CURRENT_ENDPOINT": eps[rank],
     }
+    if run_dir:
+        from ..obs.journal import RANK_ENV, rank_subdir
+
+        env["PADDLE_TPU_RUN_DIR"] = os.path.join(run_dir,
+                                                 rank_subdir(rank))
+        env[RANK_ENV] = str(rank)
     if args.nproc_per_node > 1:
         # multiple processes cannot share the TPU client: children
         # run on the virtual-device CPU backend (test/sim mode)
@@ -137,6 +159,10 @@ def launch(args=None):
             env_for_rank=lambda rank, attempt: _trainer_env(
                 args, eps, world, rank),
             log_dir=args.log_dir, ckpt_dir=args.ckpt_dir,
+            run_dir=getattr(args, "run_dir", None),
+            # global rank identity: node 1's local rank 0 journals as
+            # rank_NN of node_rank*nproc, never over node 0's rank_00
+            rank_base=args.node_rank * args.nproc_per_node,
             max_restarts=args.max_restarts,
             hang_timeout_s=args.hang_timeout)
         try:
@@ -151,7 +177,8 @@ def launch(args=None):
     for local in range(args.nproc_per_node):
         rank = args.node_rank * args.nproc_per_node + local
         env = dict(os.environ)
-        env.update(_trainer_env(args, eps, world, local))
+        env.update(_trainer_env(args, eps, world, local,
+                                run_dir=getattr(args, "run_dir", None)))
         out = None
         if args.log_dir:
             out = open(os.path.join(args.log_dir,

@@ -27,6 +27,16 @@ plus the production metrics layer the reference keeps in VLOG counters:
   to mesh axes), comm roofline vs ``PADDLE_TPU_ICI_BW``/chip table,
   ShardingReport per Executor cache entry, per-device memory gauges +
   Chrome-trace device lanes (``tools/shard_report.py`` is the CLI).
+- ``fleet``    — cross-rank aggregation over per-rank journals
+  (``<run_dir>/rank_NN/``, written when gang launchers hand workers
+  ``PADDLE_TPU_RANK``): step alignment, cross-rank skew,
+  straggler/hang attribution, merged request percentiles, merged
+  Chrome traces with pid=rank lanes (``tools/fleet_report.py`` is the
+  CLI).
+- ``export``   — live SLO signal plane: the registry + per-replica
+  serving SLOs + per-rank heartbeat ages as Prometheus text over a
+  localhost HTTP endpoint (``MetricsExporter``) or an atomic
+  textfile.
 
 Instrumented sites (all zero-overhead when idle — one flag/None check,
 no host sync, mirroring the ``resilience.inject`` ``if ACTIVE`` hooks):
@@ -61,21 +71,24 @@ from __future__ import annotations
 import os as _os
 
 from . import metrics, trace, report, anomaly, mfu, journal, spmd  # noqa: F401,E501
+from . import fleet, export  # noqa: F401
 from .metrics import (counter, gauge, histogram, snapshot, reset,  # noqa: F401
                       Counter, Gauge, Histogram, Registry, REGISTRY)
 from .trace import (span, enable_tracing, disable_tracing,  # noqa: F401
                     tracing_enabled, clear_trace, trace_events,
                     export_chrome_trace)
 from .journal import RunJournal, start_run, end_run  # noqa: F401
+from .export import MetricsExporter  # noqa: F401
 
 __all__ = [
     "metrics", "trace", "report", "anomaly", "mfu", "journal", "spmd",
+    "fleet", "export",
     "counter", "gauge", "histogram", "snapshot", "reset",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "span", "enable_tracing", "disable_tracing", "tracing_enabled",
     "clear_trace", "trace_events", "export_chrome_trace",
     "enable_op_sampling", "disable_op_sampling", "op_sampling_enabled",
-    "RunJournal", "start_run", "end_run",
+    "RunJournal", "start_run", "end_run", "MetricsExporter",
 ]
 
 # -- eager op sampling -------------------------------------------------------

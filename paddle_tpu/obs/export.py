@@ -1,0 +1,302 @@
+"""Live SLO export: the metrics registry + serving/fleet SLO gauges as
+Prometheus text, over a localhost HTTP endpoint and/or an atomic
+textfile.
+
+The journal (``obs.journal``) and the fleet aggregator (``obs.fleet``)
+are post-hoc readers; a router or autoscaler needs the SAME signals
+LIVE — queue depth, running count, TTFT/TPOT percentiles, per-rank
+heartbeat age (ROADMAP item 5's scale-up/down inputs, and the
+TTFT/TPOT/throughput axes the Gemma TPU serving comparison, arXiv
+2605.25645, is framed in). This module is that signal plane:
+
+- :func:`prometheus_text` — one Prometheus text-format snapshot:
+  every ``obs.metrics`` instrument (counters/gauges/histograms with
+  cumulative ``_bucket`` series) plus derived SLO gauges.
+- SLO gauges per serve replica (``ServeEngine.stats()`` — the EXACT
+  per-instance percentiles, labelled ``replica="N"``) and per rank
+  (``paddle_tpu_rank_heartbeat_age_seconds`` from the rank journals'
+  last flush under a fleet run dir).
+- :class:`MetricsExporter` — ``GET /metrics`` on a localhost HTTP
+  endpoint (``port=0`` picks an ephemeral port), and
+  :func:`write_textfile` for node-exporter-style textfile collection
+  (tmp + atomic rename: a scraper never reads a torn file).
+
+Pull-only by design: nothing here runs on a step path, nothing ticks
+unless scraped — the zero-overhead hook contract holds trivially.
+Engines register themselves at construction (``serving.engine``'s
+process-wide weak registry), so ``MetricsExporter()`` with no
+arguments exports every live replica in the process.
+"""
+from __future__ import annotations
+
+import math
+import os
+import re
+import threading
+
+from . import metrics as _metrics
+from .metrics import Counter, Gauge, Histogram
+
+__all__ = [
+    "prometheus_text", "registry_lines", "slo_lines", "write_textfile",
+    "parse_prometheus_text", "MetricsExporter", "PREFIX",
+]
+
+PREFIX = "paddle_tpu_"
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _name(name):
+    return PREFIX + _NAME_RE.sub("_", str(name))
+
+
+def _fmt(v):
+    """Prometheus sample value. ``repr(float)`` is the shortest
+    round-trip form, so a scraped value parses back to EXACTLY the
+    source float — the property the exporter's acceptance gate
+    (scraped TTFT/TPOT == ``ServeEngine.stats()``) rests on."""
+    if v is None:
+        return "NaN"
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f)
+
+
+class _Lines:
+    """Ordered exposition lines with one ``# TYPE`` declaration per
+    metric family (Prometheus rejects duplicates)."""
+
+    def __init__(self):
+        self.lines = []
+        self._declared = set()
+
+    def add(self, family, typ, value, labels=None):
+        if family not in self._declared:
+            self._declared.add(family)
+            self.lines.append(f"# TYPE {family} {typ}")
+        lbl = ""
+        if labels:
+            lbl = "{" + ",".join(
+                f'{k}="{v}"' for k, v in labels.items()) + "}"
+        self.lines.append(f"{family}{lbl} {_fmt(value)}")
+
+    def raw(self, line):
+        self.lines.append(line)
+
+
+def registry_lines(registry=None):
+    """Every ``obs.metrics`` instrument as Prometheus lines: counters
+    and gauges verbatim, histograms as cumulative ``_bucket{le=...}``
+    series + ``_sum``/``_count`` (the native Prometheus histogram
+    shape, so server-side ``histogram_quantile`` works)."""
+    reg = registry if registry is not None else _metrics.REGISTRY
+    out = _Lines()
+    for name in reg.names():
+        inst = reg.get(name)
+        n = _name(name)
+        if isinstance(inst, Counter):
+            out.add(n, "counter", inst.value)
+        elif isinstance(inst, Histogram):
+            buckets, counts, count, total = inst.bucket_counts()
+            out.raw(f"# TYPE {n} histogram")
+            cum = 0
+            for b, c in zip(buckets, counts):
+                cum += c
+                out.raw(f'{n}_bucket{{le="{_fmt(b)}"}} {cum}')
+            out.raw(f'{n}_bucket{{le="+Inf"}} {count}')
+            out.raw(f"{n}_sum {_fmt(total)}")
+            out.raw(f"{n}_count {count}")
+        elif isinstance(inst, Gauge):
+            out.add(n, "gauge", inst.value)
+    return out.lines
+
+
+def slo_lines(engines=None, run_dir=None, now=None):
+    """Derived SLO gauges: per serve replica (queue depth, running,
+    finished, exact TTFT/TPOT/e2e p50/p99 from that engine's OWN
+    finished requests, KV-pool occupancy) and per rank (journal
+    heartbeat age under a fleet ``run_dir``). ``engines=None``
+    discovers every live ``ServeEngine`` in the process."""
+    if engines is None:
+        try:
+            from ..serving.engine import live_engines
+
+            engines = live_engines()
+        except Exception:
+            engines = []
+    out = _Lines()
+    s = PREFIX + "serving_slo_"
+    for i, eng in enumerate(engines):
+        rep = str(getattr(eng, "replica_id", i))
+        try:
+            st = eng.stats()
+        except Exception:
+            continue
+        lbl = {"replica": rep}
+        out.add(s + "queue_depth", "gauge", st.get("queue_depth"), lbl)
+        out.add(s + "running", "gauge", st.get("running"), lbl)
+        out.add(s + "finished", "gauge", st.get("finished"), lbl)
+        out.add(s + "preemptions", "gauge", st.get("preemptions"), lbl)
+        kv = st.get("kv") or {}
+        if kv:
+            out.add(s + "kv_used_pages", "gauge",
+                    kv.get("used_pages"), lbl)
+            out.add(s + "kv_utilization", "gauge",
+                    kv.get("utilization"), lbl)
+        for key in ("ttft_ms", "tpot_ms", "e2e_ms"):
+            d = st.get(key)
+            if not d:
+                continue
+            for q in ("p50", "p99"):
+                out.add(s + key, "gauge", d.get(q),
+                        {"replica": rep, "q": q})
+            out.add(s + key + "_count", "gauge", d.get("count"), lbl)
+    if run_dir:
+        from . import fleet as _fleet
+
+        for rank, age in _fleet.heartbeat_ages(run_dir,
+                                               now=now).items():
+            out.add(PREFIX + "rank_heartbeat_age_seconds", "gauge",
+                    age, {"rank": str(rank)})
+    return out.lines
+
+
+def prometheus_text(engines=None, run_dir=None, registry=None,
+                    now=None):
+    """The full exposition: registry + SLO gauges, newline-terminated
+    Prometheus text format."""
+    return "\n".join(registry_lines(registry) +
+                     slo_lines(engines, run_dir, now=now)) + "\n"
+
+
+def write_textfile(path, engines=None, run_dir=None, registry=None):
+    """Atomic textfile export (node_exporter textfile-collector
+    convention): write to a tmp sibling, fsync-free rename — a scraper
+    reading mid-write sees the previous complete snapshot, never a torn
+    one. Returns ``path``."""
+    body = prometheus_text(engines=engines, run_dir=run_dir,
+                           registry=registry)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(body)
+    os.replace(tmp, path)
+    return path
+
+
+def parse_prometheus_text(text):
+    """``{metric-with-labels: float}`` from exposition text — the test
+    and bench-side inverse of :func:`prometheus_text` (floats parse
+    back exactly: values are emitted in ``repr`` round-trip form)."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        try:
+            out[key] = float(val)
+        except ValueError:
+            pass
+    return out
+
+
+class MetricsExporter:
+    """Serve :func:`prometheus_text` on ``GET /metrics`` over localhost
+    HTTP (``port=0`` → ephemeral, read ``.port``/``.url`` after
+    :meth:`start`). The handler renders on each scrape — pull-based, so
+    an idle exporter costs nothing between scrapes. Also usable as a
+    context manager, and as a handle for periodic
+    :meth:`write_textfile` snapshots."""
+
+    def __init__(self, engines=None, run_dir=None, host="127.0.0.1",
+                 port=0, registry=None):
+        self.engines = None if engines is None else list(engines)
+        self.run_dir = run_dir
+        self.host = str(host)
+        self.port = int(port)
+        self.registry = registry
+        self._httpd = None
+        self._thread = None
+
+    def register_engine(self, engine):
+        """Pin an explicit engine set (otherwise every live engine in
+        the process is exported)."""
+        if self.engines is None:
+            self.engines = []
+        self.engines.append(engine)
+
+    def render(self):
+        return prometheus_text(engines=self.engines,
+                               run_dir=self.run_dir,
+                               registry=self.registry)
+
+    def write_textfile(self, path):
+        return write_textfile(path, engines=self.engines,
+                              run_dir=self.run_dir,
+                              registry=self.registry)
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def start(self):
+        """Bind + serve on a daemon thread; returns the bound port."""
+        if self._httpd is not None:
+            return self.port
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib handler contract)
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = exporter.render().encode("utf-8")
+                except Exception as e:  # surface, don't kill the server
+                    self.send_error(500, f"{type(e).__name__}: {e}")
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not stdout news
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          Handler)
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="pt-metrics-exporter", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

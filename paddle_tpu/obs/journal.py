@@ -53,10 +53,19 @@ from .anomaly import AnomalyEngine, default_detectors
 from .mfu import MFUAccounting, peak_flops
 
 __all__ = ["RunJournal", "ACTIVE", "start_run", "end_run", "active",
-           "JOURNAL_FILE", "POSTMORTEM_FILE"]
+           "JOURNAL_FILE", "POSTMORTEM_FILE", "TRACE_FILE",
+           "RANK_ENV", "SUPERVISOR_DIR", "rank_subdir", "env_rank"]
 
 JOURNAL_FILE = "journal.jsonl"
 POSTMORTEM_FILE = "postmortem.json"
+TRACE_FILE = "trace.json"
+# the rank identity a gang launcher (resilience.elastic.GangSupervisor,
+# dist.launch) hands each worker, alongside a per-rank run dir
+RANK_ENV = "PADDLE_TPU_RANK"
+# where a gang supervisor's own events land under the fleet run dir —
+# ONE constant shared by the writer (resilience.elastic) and the reader
+# (obs.fleet); a rename on either side would silently orphan the record
+SUPERVISOR_DIR = "supervisor"
 
 # The active journal every hook checks (mirrors resilience.inject.ACTIVE:
 # None => hooks are a single None check and nothing else).
@@ -65,6 +74,26 @@ ACTIVE = None
 
 def active():
     return ACTIVE
+
+
+def rank_subdir(rank):
+    """One naming convention for per-rank journal dirs
+    (``rank_00``, ``rank_01``, ...): the writer (RunJournal), the gang
+    launchers and the reader (``obs.fleet``) must all agree on it."""
+    return f"rank_{int(rank):02d}"
+
+
+def env_rank(env=None):
+    """This process's rank from ``PADDLE_TPU_RANK``, or None outside a
+    supervised gang (or on an unparseable value — identity must never
+    break journaling)."""
+    v = (env if env is not None else os.environ).get(RANK_ENV)
+    if v in (None, ""):
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
 
 
 def _env_knobs():
@@ -149,21 +178,43 @@ class RunJournal:
     - explicitly: ``j = obs.start_run("/runs/exp7")`` ... ``obs.end_run()``;
     - scoped: ``with RunJournal("/runs/exp7") as j:`` — an exception
       leaving the block writes the postmortem before closing.
+
+    Rank identity (multi-process gangs): with ``rank=`` (or env
+    ``PADDLE_TPU_RANK``, which GangSupervisor / ``dist.launch`` set per
+    worker) the journal writes under ``<run_dir>/rank_NN/`` — each rank
+    owns its file, so N workers journaling into one run dir can never
+    tear each other's lines. ``obs.fleet`` aggregates the rank subdirs
+    back into one cross-rank view.
     """
 
-    def __init__(self, run_dir=None, *, flush_every=32,
+    def __init__(self, run_dir=None, *, rank=None, flush_every=32,
                  flush_interval_s=5.0, max_bytes=64 << 20,
                  postmortem_steps=64, detectors=None,
-                 anomaly_callback=None, peak=None, compute_flops=True):
+                 anomaly_callback=None, peak=None, compute_flops=None):
         run_dir = run_dir or os.environ.get("PADDLE_TPU_RUN_DIR")
         if not run_dir:
             raise ValueError(
                 "RunJournal needs a run directory: pass run_dir or set "
                 "PADDLE_TPU_RUN_DIR")
+        self.rank = env_rank() if rank is None else int(rank)
+        if self.rank is not None and os.path.basename(
+                os.path.normpath(str(run_dir))) != rank_subdir(self.rank):
+            # a launcher that already handed us our per-rank subdir
+            # (basename matches) must not get a second nesting level
+            run_dir = os.path.join(str(run_dir), rank_subdir(self.rank))
         self.run_dir = str(run_dir)
         self.flush_every = max(1, int(flush_every))
         self.flush_interval_s = float(flush_interval_s)
         self.max_bytes = int(max_bytes)
+        if compute_flops is None:
+            # default on, env-defeatable: the lazy per-entry FLOPs
+            # attribution pays a BACKGROUND analysis compile per entry —
+            # free wall-clock normally, but real CPU contention inside a
+            # worker racing a heartbeat watchdog on a loaded host
+            # (PADDLE_TPU_JOURNAL_FLOPS=0 is how gang drills quiet it)
+            compute_flops = os.environ.get(
+                "PADDLE_TPU_JOURNAL_FLOPS", "").lower() not in \
+                ("0", "false", "off")
         self.compute_flops = bool(compute_flops)
         self._lock = threading.RLock()
         self._buf = []
@@ -233,10 +284,13 @@ class RunJournal:
         # jax.devices() would pin the platform before the user's own
         # config (or block on a dead tunnel). A "backend" event is
         # emitted lazily with the first step record instead.
-        self._write({
+        rec = {
             "t": "run_start", "ts": time.time(), "pid": os.getpid(),
             "argv": list(sys.argv), "run_dir": self.run_dir,
-            "env": _env_knobs()})
+            "env": _env_knobs()}
+        if self.rank is not None:
+            rec["rank"] = self.rank
+        self._write(rec)
         return self
 
     def close(self, exc=None):
@@ -247,6 +301,15 @@ class RunJournal:
                 return
             if exc is not None:
                 self.postmortem(exc)
+            elif _trace.tracing_enabled() and not self._postmortem_written:
+                # clean close with tracing on: leave the per-run Chrome
+                # trace next to the journal (per-rank exports are what
+                # obs.fleet.merge_chrome_traces fuses into fleet lanes)
+                try:
+                    _trace.export_chrome_trace(
+                        os.path.join(self.run_dir, TRACE_FILE))
+                except Exception:
+                    pass
             self._write({"t": "run_end", "ts": time.time(),
                          "summary": self.summary()}, _locked=True)
             self._flush_locked()
@@ -267,6 +330,15 @@ class RunJournal:
         except Exception:
             pass
 
+    def _adopt_trace_rank(self):
+        """Becoming the PROCESS-WIDE journal with a rank identity also
+        adopts that rank for trace exports (one process = one rank), so
+        per-rank Chrome traces fuse collision-free. A standalone
+        (non-installed) journal never mutates global trace state —
+        test fixtures build many ranks in one process."""
+        if self.rank is not None and _trace.current_rank() is None:
+            _trace.set_rank(self.rank)
+
     def __enter__(self):
         """Scoped use installs the journal process-wide for the block —
         the hooks all read ``journal.ACTIVE``, so a non-installed
@@ -275,6 +347,7 @@ class RunJournal:
         self._prev_active = ACTIVE
         self.start()
         ACTIVE = self
+        self._adopt_trace_rank()
         return self
 
     def __exit__(self, exc_type, exc, tb):
@@ -551,6 +624,17 @@ class RunJournal:
         ``record_step`` without an explicit ``step_ms`` uses it."""
         self._last_timer_ms = float(ms)
 
+    def sync_step(self, global_step):
+        """Align the journal's step numbering with the trainer's OWN
+        global step: the next recorded step gets number
+        ``global_step``. Elastic workers call this once per loop
+        iteration so a relaunched incarnation's records continue at
+        its resume step instead of restarting at 1 — which is what
+        lets ``obs.fleet.align_steps`` line records up across ranks
+        AND attempts by global step."""
+        with self._lock:
+            self._step = int(global_step) - 1
+
     def _entry_flops_comm(self, compiled):
         """Non-blocking per-entry FLOPs + collective attribution (a
         background thread pays the analysis compile; early steps carry
@@ -688,7 +772,7 @@ class RunJournal:
                 # export BEFORE the dump is serialized, so the
                 # postmortem actually carries the trace pointer
                 try:
-                    trace_path = os.path.join(self.run_dir, "trace.json")
+                    trace_path = os.path.join(self.run_dir, TRACE_FILE)
                     _trace.export_chrome_trace(trace_path)
                     dump["trace_file"] = trace_path
                 except Exception:
@@ -713,6 +797,7 @@ def start_run(run_dir=None, **kw):
         ACTIVE.close()
     j = RunJournal(run_dir, **kw).start()
     ACTIVE = j
+    j._adopt_trace_rank()
     return j
 
 

@@ -198,6 +198,16 @@ class Histogram:
                 seen += c
             return float(self._max)
 
+    def bucket_counts(self):
+        """Consistent ``(buckets, counts, count, sum)`` snapshot —
+        ``counts`` has one extra overflow slot past the last bound. The
+        raw-distribution accessor Prometheus exposition needs
+        (``obs.export`` turns it into cumulative ``_bucket`` series);
+        ``_snapshot()`` stays the human-facing percentile view."""
+        with self._lock:
+            return (self.buckets, tuple(self._counts), self._count,
+                    self._sum)
+
     def _reset(self):
         with self._lock:
             self._counts = [0] * (len(self.buckets) + 1)

@@ -161,6 +161,42 @@ def entry_flops(compiled):
 
 
 _pending_lock = threading.Lock()
+_pending_threads: list = []
+_shutting_down = False
+
+
+def _drain_analysis_threads(timeout_s=5.0):
+    """Interpreter-exit guard for the background analysis compiles: a
+    daemon thread still INSIDE an XLA compilation when Python
+    finalizes tears down the C++ compile thread pool under it —
+    ``terminate called without an active exception``, SIGABRT — which
+    turns a clean worker exit into a spurious crash (a supervised gang
+    would burn a restart on it). Refuse new analyses and give in-flight
+    ones a bounded window to land; short-lived journaled processes (CI
+    drills, preempted workers) exit clean, and a multi-second real-TPU
+    compile still can't stall a preemption exit past the budget."""
+    import time
+
+    global _shutting_down
+    _shutting_down = True
+    deadline = time.monotonic() + float(timeout_s)
+    with _pending_lock:
+        threads = list(_pending_threads)
+    for t in threads:
+        try:
+            t.join(max(0.0, deadline - time.monotonic()))
+        except RuntimeError:
+            pass  # never-started thread (start() itself failed)
+
+
+def _analysis_worker(compiled):
+    try:
+        if not _shutting_down:
+            entry_analysis(compiled)
+    finally:
+        with _pending_lock:
+            if threading.current_thread() in _pending_threads:
+                _pending_threads.remove(threading.current_thread())
 
 
 def entry_analysis_nowait(compiled):
@@ -170,17 +206,28 @@ def entry_analysis_nowait(compiled):
     None — the step path must never stall behind a second XLA
     compilation (tens of seconds on a real chip). Early steps of each
     entry simply carry no flops/comm attribution; the MFU accounting
-    already scopes achieved-FLOP/s to the steps that do."""
+    already scopes achieved-FLOP/s to the steps that do. In-flight
+    threads are drained at interpreter exit (see
+    :func:`_drain_analysis_threads`)."""
     cached = getattr(compiled, "_entry_analysis", None)
     if cached is not None:
         return cached
+    if _shutting_down:
+        return None
     with _pending_lock:
         if getattr(compiled, "_entry_analysis_pending", False):
             return None
         compiled._entry_analysis_pending = True
-    threading.Thread(target=entry_analysis, args=(compiled,),
-                     daemon=True).start()
+        t = threading.Thread(target=_analysis_worker, args=(compiled,),
+                             daemon=True)
+        _pending_threads.append(t)
+    t.start()
     return None
+
+
+import atexit  # noqa: E402  (registration belongs next to the hook)
+
+atexit.register(_drain_analysis_threads)
 
 
 def entry_flops_nowait(compiled):

@@ -29,7 +29,8 @@ import time
 __all__ = [
     "span", "enable_tracing", "disable_tracing", "tracing_enabled",
     "clear_trace", "trace_events", "export_chrome_trace",
-    "device_counter", "DEFAULT_CAPACITY", "DEVICE_PID_BASE",
+    "device_counter", "set_rank", "current_rank",
+    "DEFAULT_CAPACITY", "DEVICE_PID_BASE", "RANK_PID_STRIDE",
 ]
 
 DEFAULT_CAPACITY = 65536
@@ -37,6 +38,30 @@ DEFAULT_CAPACITY = 65536
 # are offset far above any real host pid so they never collide with the
 # host lane
 DEVICE_PID_BASE = 1 << 20
+# per-RANK namespace inside the device pid band: rank r's device d lane
+# is DEVICE_PID_BASE + r * RANK_PID_STRIDE + d, so a merged fleet trace
+# (obs.fleet.merge_chrome_traces) never interleaves two ranks' device
+# counter lanes under one pid. 4096 devices per process is far above
+# any real per-host device count
+RANK_PID_STRIDE = 1 << 12
+
+# this process's rank identity (multi-process gangs: the supervisor
+# hands each worker PADDLE_TPU_RANK). None = single-process, exports
+# keep the historical os.getpid()/DEVICE_PID_BASE+id lanes exactly
+_rank = None
+
+
+def set_rank(rank):
+    """Adopt a rank identity for trace exports: host spans land on
+    pid=rank (a stable lane a merged fleet trace can line up, unlike
+    OS pids that recycle across elastic relaunches) and device counter
+    lanes shift into the rank's namespace slice."""
+    global _rank
+    _rank = None if rank is None else int(rank)
+
+
+def current_rank():
+    return _rank
 
 _enabled = False
 _events: collections.deque = collections.deque(maxlen=DEFAULT_CAPACITY)
@@ -131,25 +156,34 @@ def trace_events():
 def export_chrome_trace(path):
     """Write the span buffer as Chrome trace-event JSON (load in
     chrome://tracing or https://ui.perfetto.dev). Returns the number of
-    spans exported."""
-    pid = os.getpid()
+    spans exported. With a rank identity set (:func:`set_rank` / env
+    ``PADDLE_TPU_RANK``) the host lane is pid=rank and device lanes are
+    rank-namespaced, so per-rank exports fuse collision-free."""
+    rank = _rank
+    pid = os.getpid() if rank is None else rank
+    host_name = "paddle_tpu" if rank is None \
+        else f"paddle_tpu rank {rank:02d}"
     events = [{"ph": "X", "pid": pid, "tid": tid, "name": n,
                "ts": ts, "dur": dur, "args": attrs}
               for n, ts, dur, tid, attrs in list(_events)]
     events.append({"ph": "M", "pid": pid, "name": "process_name",
-                   "args": {"name": "paddle_tpu"}})
+                   "args": {"name": host_name}})
     # per-device pid lanes: counter samples (HBM gauges) render as one
-    # Chrome-trace "process" per device, below the host span lane
+    # Chrome-trace "process" per device, below the host span lane —
+    # inside this rank's namespace slice of the device pid band
+    dev_base = DEVICE_PID_BASE + (rank or 0) * RANK_PID_STRIDE
     lanes = set()
     for dev_id, name, ts, value in list(_device_samples):
-        lane = DEVICE_PID_BASE + dev_id
+        lane = dev_base + dev_id
         lanes.add((lane, dev_id))
         events.append({"ph": "C", "pid": lane, "name": name, "ts": ts,
                        "args": {"value": value}})
     for lane, dev_id in sorted(lanes):
+        label = _device_labels.get(dev_id, f"device {dev_id}")
+        if rank is not None:
+            label = f"rank {rank:02d} {label}"
         events.append({"ph": "M", "pid": lane, "name": "process_name",
-                       "args": {"name": _device_labels.get(
-                           dev_id, f"device {dev_id}")}})
+                       "args": {"name": label}})
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -163,3 +197,10 @@ def export_chrome_trace(path):
 
 if os.environ.get("PADDLE_TPU_TRACE", "").lower() not in ("", "0", "false"):
     enable_tracing()
+
+# a supervised gang worker inherits its rank from the launcher
+# (GangSupervisor / dist.launch hand each worker PADDLE_TPU_RANK)
+try:
+    set_rank(int(os.environ["PADDLE_TPU_RANK"]))
+except (KeyError, ValueError):
+    pass

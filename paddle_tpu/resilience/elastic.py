@@ -283,7 +283,13 @@ class GangSupervisor:
     ``(rank, attempt) -> argv``. Each worker inherits the parent env
     plus ``env`` plus ``env_for_rank(rank, attempt)``, a heartbeat path
     in ``PADDLE_TPU_HEARTBEAT_FILE``, and the attempt index in
-    ``PADDLE_TPU_ELASTIC_ATTEMPT``.
+    ``PADDLE_TPU_ELASTIC_ATTEMPT``. With ``run_dir`` set (default: the
+    inherited ``PADDLE_TPU_RUN_DIR``) every worker additionally gets
+    ``PADDLE_TPU_RUN_DIR=<run_dir>/rank_NN`` + ``PADDLE_TPU_RANK`` —
+    per-rank flight records with one writer per file — and the
+    supervisor's own events journal into ``<run_dir>/supervisor``;
+    ``obs.fleet`` / ``tools/fleet_report.py`` aggregate the subdirs
+    back into one cross-rank view.
 
     Per attempt, the first of these decides the outcome:
 
@@ -308,6 +314,7 @@ class GangSupervisor:
 
     def __init__(self, cmd, nprocs=1, *, env=None, env_for_rank=None,
                  cwd=None, heartbeat_dir=None, log_dir=None, ckpt_dir=None,
+                 run_dir=None, rank_base=0,
                  max_restarts=3, max_preempt_restarts=64,
                  hang_timeout_s=300.0, startup_timeout_s=None,
                  poll_interval_s=0.05, term_grace_s=10.0,
@@ -318,6 +325,22 @@ class GangSupervisor:
         self.env = dict(env or {})
         self.env_for_rank = env_for_rank
         self.cwd = cwd
+        # fleet observability root: each worker journals into
+        # <run_dir>/rank_NN (PADDLE_TPU_RUN_DIR + PADDLE_TPU_RANK per
+        # rank — one writer per file, no torn lines by construction)
+        # and the supervisor's own events into <run_dir>/supervisor.
+        # Defaults to the inherited PADDLE_TPU_RUN_DIR unless the
+        # caller's env= explicitly overrides journaling itself.
+        if run_dir is None and "PADDLE_TPU_RUN_DIR" not in self.env:
+            run_dir = os.environ.get("PADDLE_TPU_RUN_DIR") or None
+        self.run_dir = run_dir
+        # multi-node gangs: this supervisor owns GLOBAL ranks
+        # rank_base..rank_base+nprocs-1 (dist.launch passes
+        # node_rank*nproc_per_node), so two nodes sharing one run_dir
+        # never journal into the same rank_NN subdir. A nonzero base
+        # also suffixes the supervisor's own journal dir — N node
+        # supervisors must not co-write one supervisor/journal.jsonl.
+        self.rank_base = int(rank_base)
         self._own_hb_dir = heartbeat_dir is None
         self.heartbeat_dir = heartbeat_dir or tempfile.mkdtemp(
             prefix="pt_elastic_hb_")
@@ -376,7 +399,18 @@ class GangSupervisor:
             env.update(self.env)
             env[HEARTBEAT_ENV] = hb
             env[ATTEMPT_ENV] = str(attempt)
-            env.setdefault("PADDLE_TRAINER_ID", str(rank))
+            if self.run_dir:
+                # per-rank flight record under the GLOBAL rank (rank
+                # relaunches append into the SAME subdir, so one drill
+                # reads as one record); obs.fleet aggregates the
+                # subdirs back into one run
+                from ..obs.journal import RANK_ENV, rank_subdir
+
+                env["PADDLE_TPU_RUN_DIR"] = os.path.join(
+                    self.run_dir, rank_subdir(self.rank_base + rank))
+                env[RANK_ENV] = str(self.rank_base + rank)
+            env.setdefault("PADDLE_TRAINER_ID",
+                           str(self.rank_base + rank))
             env.setdefault("PADDLE_TRAINERS_NUM", str(self.nprocs))
             if self.env_for_rank is not None:
                 env.update(self.env_for_rank(rank, attempt) or {})
@@ -509,6 +543,44 @@ class GangSupervisor:
         to :meth:`RecoveryPolicy.backoff_for` (the one formula)."""
         return self._backoff_policy.backoff_for(n)
 
+    def _open_supervisor_journal(self):
+        """With ``run_dir`` set, the supervisor's OWN events
+        (elastic.start/restart/watchdog_kill/...) get their own journal
+        at ``<run_dir>/supervisor`` — never a worker's file, so the
+        flight record is multi-process without a single multi-writer
+        line. Installed for the supervise loop and restored after;
+        returns ``(journal, previous_active)`` (``(None, None)`` when
+        run_dir is unset, journaling failed, or the caller already
+        journals there)."""
+        if not self.run_dir:
+            return None, None
+        try:
+            from ..obs import journal as _journal
+        except Exception:
+            return None, None
+        sup_name = _journal.SUPERVISOR_DIR if not self.rank_base \
+            else f"{_journal.SUPERVISOR_DIR}_{self.rank_base:02d}"
+        sup_dir = os.path.join(self.run_dir, sup_name)
+        prev = _journal.ACTIVE
+        if prev is not None and os.path.abspath(prev.run_dir) == \
+                os.path.abspath(sup_dir):
+            return None, None
+        # the supervisor is rank-less even when IT runs inside a ranked
+        # worker (nested gangs): mask the inherited rank for the
+        # construct-or the journal would nest a rank subdir under
+        # supervisor/
+        saved_rank = os.environ.pop(_journal.RANK_ENV, None)
+        try:
+            j = _journal.RunJournal(sup_dir)
+            j.start()
+        except Exception:
+            return None, None
+        finally:
+            if saved_rank is not None:
+                os.environ[_journal.RANK_ENV] = saved_rank
+        _journal.ACTIVE = j
+        return j, prev
+
     def run(self):
         """Supervise until the gang completes (returns 0), or the
         restart budget is exhausted (raises
@@ -517,6 +589,7 @@ class GangSupervisor:
         restarts_used = 0
         preempts_used = 0
         resume_t0 = None
+        sup_journal, prev_journal = self._open_supervisor_journal()
         _journal_event("elastic.start", nprocs=self.nprocs,
                        max_restarts=self.max_restarts,
                        hang_timeout_s=self.hang_timeout_s)
@@ -581,6 +654,18 @@ class GangSupervisor:
                     self._sleep(delay)
                 attempt += 1
         finally:
+            if sup_journal is not None:
+                from ..obs import journal as _journal
+
+                try:
+                    sup_journal.close()
+                except Exception:
+                    pass
+                # close() clears ACTIVE when it still points here;
+                # restore whatever journal the caller had installed
+                if _journal.ACTIVE is None and prev_journal is not None \
+                        and not prev_journal.closed:
+                    _journal.ACTIVE = prev_journal
             if self._own_hb_dir:
                 import shutil
 

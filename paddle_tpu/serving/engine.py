@@ -38,8 +38,10 @@ journal = one None check.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
+import weakref
 
 import numpy as np
 
@@ -51,7 +53,31 @@ from .kv_cache import (CachePressureError, PagedKVCache,
                        PageAllocationError, write_tokens)
 from .scheduler import CANCELLED, FINISHED, RUNNING, Request, Scheduler
 
-__all__ = ["ServeEngine", "TinyLM"]
+__all__ = ["ServeEngine", "TinyLM", "live_engines"]
+
+# process-wide replica registry: every ServeEngine registers a weakref
+# at construction, so the SLO exporter (obs.export.MetricsExporter with
+# no explicit engine list) discovers every live replica in the process
+# without any wiring. Weak by design — the registry must never keep a
+# replaced replica (and its donated KV pools) alive.
+_ENGINES_LOCK = threading.Lock()
+_ENGINES: list = []
+_REPLICA_IDS = itertools.count()
+
+
+def live_engines():
+    """Every ServeEngine constructed in this process and still alive,
+    oldest first — the default scrape set for ``obs.export``."""
+    out = []
+    with _ENGINES_LOCK:
+        keep = []
+        for ref in _ENGINES:
+            eng = ref()
+            if eng is not None:
+                keep.append(ref)
+                out.append(eng)
+        _ENGINES[:] = keep
+    return out
 
 # latency buckets: sub-ms CPU toy decode through multi-second cold
 # prefill-compiles; +inf overflow implicit
@@ -222,6 +248,11 @@ class ServeEngine:
         # its request is inside the current batch must wait for the
         # step boundary, or the freed rid KeyErrors the batch build
         self._step_lock = threading.RLock()
+        # SLO-export identity: stable per process, rides the exporter's
+        # replica="N" label so multi-replica scrapes stay attributable
+        self.replica_id = next(_REPLICA_IDS)
+        with _ENGINES_LOCK:
+            _ENGINES.append(weakref.ref(self))
 
     # -- intake --------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, rid=None, eos_id=None,

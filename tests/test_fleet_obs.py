@@ -1,0 +1,480 @@
+"""Fleet observability (obs.fleet) + live SLO export (obs.export):
+the cross-rank layer over the per-rank flight recorder.
+
+Covers the PR's acceptance contract:
+- rank-aware journals: explicit rank / env PADDLE_TPU_RANK both land
+  in <run_dir>/rank_NN without double-nesting;
+- hand-built 2-rank fixtures with a KNOWN 2x straggler: exact skew
+  numbers, slowest-rank attribution, persistent-straggler detection
+  (re-arm style), one preempted/resumed attempt aligning last-wins,
+  and merged p50/p99 request percentiles across replicas;
+- merged Chrome traces carry one distinct pid lane per rank (device
+  counter lanes rank-namespaced, never colliding);
+- the Prometheus exporter's scraped TTFT/TPOT values match
+  ServeEngine.stats() EXACTLY on a deterministic ManualClock trace,
+  over both render() and a real localhost HTTP scrape; textfile
+  export is atomic.
+"""
+import json
+import os
+import urllib.request
+
+import pytest
+
+from paddle_tpu import obs
+from paddle_tpu.obs import export as obs_export
+from paddle_tpu.obs import fleet, journal, trace
+
+
+@pytest.fixture(autouse=True)
+def _no_global_journal():
+    yield
+    if journal.ACTIVE is not None:
+        journal.ACTIVE.close()
+    journal.ACTIVE = None
+
+
+def _write_rank(run_dir, rank, step_ms, n_steps=10, start_step=1,
+                requests=(), **journal_kw):
+    j = journal.RunJournal(run_dir, rank=rank, flush_every=1,
+                           compute_flops=False, **journal_kw)
+    j.start()
+    for i in range(start_step, start_step + n_steps):
+        j.sync_step(i)
+        j.record_step(loss=1.0 / i, step_ms=step_ms, examples=8,
+                      source="fixture")
+    for i, ttft_ms in enumerate(requests):
+        j.record_request(rid=f"r{rank}_{i}", state="FINISHED",
+                         arrival_t=0.0, first_token_t=ttft_ms / 1e3,
+                         finish_t=2.0, prompt_tokens=4, output_tokens=5)
+    j.close()
+    return j
+
+
+# -- rank-aware journals ------------------------------------------------------
+
+
+class TestRankJournals:
+    def test_explicit_rank_lands_in_rank_subdir(self, tmp_path):
+        j = _write_rank(str(tmp_path), 3, 10.0, n_steps=2)
+        assert j.run_dir == str(tmp_path / "rank_03")
+        run = fleet.load_journal(str(tmp_path / "rank_03"))
+        assert run["header"]["rank"] == 3
+        assert len(run["steps"]) == 2
+
+    def test_env_rank_with_preassigned_subdir_does_not_nest(
+            self, tmp_path, monkeypatch):
+        """The GangSupervisor contract: PADDLE_TPU_RUN_DIR already IS
+        <run>/rank_01 and PADDLE_TPU_RANK=1 — no rank_01/rank_01."""
+        sub = tmp_path / "rank_01"
+        monkeypatch.setenv("PADDLE_TPU_RANK", "1")
+        j = journal.RunJournal(str(sub), compute_flops=False).start()
+        j.record_step(loss=1.0, step_ms=1.0)
+        j.close()
+        assert j.rank == 1
+        assert j.run_dir == str(sub)
+        assert not (sub / "rank_01").exists()
+        assert fleet.rank_dirs(str(tmp_path)) == {1: str(sub)}
+
+    def test_sync_step_numbers_records_by_global_step(self, tmp_path):
+        _write_rank(str(tmp_path), 0, 10.0, n_steps=3, start_step=5)
+        run = fleet.load_journal(str(tmp_path / "rank_00"))
+        assert [s["step"] for s in run["steps"]] == [5, 6, 7]
+
+
+# -- cross-rank aggregation ---------------------------------------------------
+
+
+class TestFleetAggregate:
+    def _skewed(self, tmp_path):
+        _write_rank(str(tmp_path), 0, 10.0,
+                    requests=[100.0, 200.0, 300.0, 400.0, 500.0])
+        _write_rank(str(tmp_path), 1, 20.0,
+                    requests=[600.0, 700.0, 800.0, 900.0, 1000.0])
+        return fleet.aggregate(str(tmp_path))
+
+    def test_exact_skew_numbers_and_attribution(self, tmp_path):
+        agg = self._skewed(tmp_path)
+        assert agg["nranks"] == 2 and agg["aligned_steps"] == 10
+        # skew = max/median over ranks = 20/15; straggler magnitude =
+        # slowest / median of the OTHERS = 20/10 = 2.0 exactly
+        assert agg["skew"]["max"] == pytest.approx(20.0 / 15.0,
+                                                   abs=1e-12)
+        assert agg["skew"]["worst_rank"] == 1
+        assert agg["skew"]["worst_rank_ratio"] == pytest.approx(
+            2.0, abs=1e-12)
+        assert agg["skew"]["slowest_counts"] == {1: 10}
+        slow = [s for s in agg["stragglers"] if s["kind"] == "slow"]
+        assert len(slow) == 1
+        assert slow[0]["rank"] == 1
+        assert slow[0]["ratio"] == pytest.approx(2.0, abs=1e-12)
+        assert slow[0]["first_step"] == 1
+
+    def test_merged_request_percentiles(self, tmp_path):
+        """TTFT 100..1000 ms across the two replicas: the merged pool's
+        nearest-rank p50 is 500 ms, p99 is 1000 ms — per-replica
+        percentiles would NOT produce these (rank 0 alone p50=300)."""
+        agg = self._skewed(tmp_path)
+        req = agg["requests"]
+        assert req["requests"] == 10 and req["finished"] == 10
+        assert req["ttft_ms_p50"] == pytest.approx(500.0, abs=1e-9)
+        assert req["ttft_ms_p99"] == pytest.approx(1000.0, abs=1e-9)
+
+    def test_balanced_gang_has_no_stragglers(self, tmp_path):
+        _write_rank(str(tmp_path), 0, 10.0)
+        _write_rank(str(tmp_path), 1, 10.0)
+        agg = fleet.aggregate(str(tmp_path))
+        assert agg["stragglers"] == []
+        assert agg["skew"]["max"] == pytest.approx(1.0)
+
+    def test_preempted_attempt_aligns_last_wins(self, tmp_path):
+        """One rank restarts (a preempted attempt) and re-executes
+        steps 3..5: alignment keeps the LAST record per (rank, step),
+        and the incarnation count survives in run_starts."""
+        _write_rank(str(tmp_path), 0, 10.0, n_steps=5)
+        _write_rank(str(tmp_path), 1, 10.0, n_steps=3)       # dies at 3
+        _write_rank(str(tmp_path), 1, 30.0, n_steps=3,       # resumes
+                    start_step=3)
+        flt = fleet.load_fleet(str(tmp_path))
+        run1 = flt["ranks"][1]
+        assert len(run1["run_starts"]) == 2
+        aligned = fleet.align_steps(flt)
+        assert [row["step"] for row in aligned] == [1, 2, 3, 4, 5]
+        # step 3 was re-executed by incarnation 2: last record wins
+        assert aligned[2]["by_rank"][1]["step_ms"] == 30.0
+        assert aligned[2]["by_rank"][1]["_incarnation"] == 2
+        per = fleet.aggregate(flt)["per_rank"][1]
+        assert per["run_starts"] == 2 and per["last_step"] == 5
+
+    def test_comm_rollup_sums_per_rank_means(self, tmp_path):
+        for rank in (0, 1):
+            j = journal.RunJournal(str(tmp_path), rank=rank,
+                                   flush_every=1, compute_flops=False)
+            j.start()
+            for i in range(1, 4):
+                j.sync_step(i)
+                j.record_step(loss=1.0, step_ms=10.0,
+                              comm={"total_bytes": 1000 * (rank + 1),
+                                    "wire_bytes": 1750,
+                                    "all_reduce_bytes": 500})
+            j.close()
+        agg = fleet.aggregate(str(tmp_path))
+        assert agg["per_rank"][0]["comm_bytes_per_step"] == 1000.0
+        assert agg["per_rank"][1]["comm_bytes_per_step"] == 2000.0
+        assert agg["comm_bytes_per_step_total"] == 3000.0
+
+    def test_reclassify_event_stays_in_its_incarnation(self, tmp_path):
+        """Incarnation 1 discards step 2 AFTER its line flushed (the
+        correction rides a resilience.skipped event), then crashes;
+        incarnation 2 re-runs step 2 cleanly into the same file. The
+        loader must flag incarnation 1's record, never the clean
+        re-run."""
+        run_dir = str(tmp_path / "rank_00")
+        j = journal.RunJournal(run_dir, rank=0, flush_every=1,
+                               compute_flops=False).start()
+        j.sync_step(1)
+        j.record_step(loss=1.0, step_ms=5.0, source="executor")
+        j.sync_step(2)
+        j.record_step(loss=0.9, step_ms=5.0, source="executor")
+        j.event("resilience.skipped", source="guarded_executor")
+        j.close()
+        j2 = journal.RunJournal(run_dir, rank=0, flush_every=1,
+                                compute_flops=False).start()
+        j2.sync_step(2)  # the resume re-executes step 2, cleanly
+        j2.record_step(loss=0.9, step_ms=5.0, source="executor")
+        j2.close()
+        run = fleet.load_journal(run_dir)
+        flags = [(s["_incarnation"], s["step"], bool(s.get("skipped")))
+                 for s in run["steps"]]
+        assert flags == [(1, 1, False), (1, 2, True), (2, 2, False)]
+        # alignment keeps the clean incarnation-2 record for step 2
+        aligned = fleet.align_steps({"ranks": {0: run}})
+        assert not aligned[1]["by_rank"][0].get("skipped")
+
+    def test_budget_exhausted_hang_is_attributed(self, tmp_path):
+        """A terminal hang (restart budget spent) emits
+        elastic.budget_exhausted instead of elastic.restart — the most
+        postmortem-relevant hang must still get journal-side rank
+        attribution."""
+        _write_rank(str(tmp_path), 0, 10.0, n_steps=4)
+        _write_rank(str(tmp_path), 1, 10.0, n_steps=3)  # stops first
+        sup = str(tmp_path / fleet.SUPERVISOR_DIR)
+        j = journal.RunJournal(sup, compute_flops=False).start()
+        j.event("elastic.start", nprocs=2)
+        j.event("elastic.budget_exhausted", restarts=0,
+                last_kind="hang", last_rank=0, last_code=137)
+        j.close()
+        hangs = [s for s in fleet.aggregate(str(tmp_path))["stragglers"]
+                 if s["kind"] == "hang"]
+        assert len(hangs) == 1
+        # journals say rank 1 (lowest last step), NOT the watchdog's
+        # poll-noisy rank 0
+        assert hangs[0]["rank"] == 1 and hangs[0]["watchdog_rank"] == 0
+        assert hangs[0]["last_step"] == 3
+
+    def test_rank_base_gives_global_identity(self, tmp_path):
+        """A node-1 supervisor (rank_base=nproc) must hand its workers
+        GLOBAL rank dirs/ids and keep its own journal out of node 0's
+        supervisor/ — two nodes sharing one run_dir never co-write."""
+        import subprocess  # noqa: F401 (spawned via GangSupervisor)
+        import sys
+
+        from paddle_tpu.resilience import GangSupervisor
+
+        run = str(tmp_path / "run")
+        probe = ("import os,json;"
+                 "open(os.environ['PT_PROBE_OUT']+'/'+"
+                 "os.environ['PADDLE_TPU_RANK'],'w')"
+                 ".write(json.dumps([os.environ['PADDLE_TPU_RUN_DIR'],"
+                 "os.environ['PADDLE_TRAINER_ID']]))")
+        out = tmp_path / "probe"
+        out.mkdir()
+        sup = GangSupervisor(
+            [sys.executable, "-c", probe], nprocs=2, rank_base=4,
+            run_dir=run, env={"PT_PROBE_OUT": str(out)},
+            poll_interval_s=0.01, term_grace_s=1.0)
+        assert sup.run() == 0
+        got = {fn: json.load(open(out / fn)) for fn in os.listdir(out)}
+        assert sorted(got) == ["4", "5"]
+        assert got["4"] == [os.path.join(run, "rank_04"), "4"]
+        assert got["5"] == [os.path.join(run, "rank_05"), "5"]
+        assert os.path.isfile(os.path.join(
+            run, "supervisor_04", "journal.jsonl"))
+        assert not os.path.exists(os.path.join(run, "supervisor"))
+        # the READERS see the node-1 supervisor too (a suffixed
+        # journal nobody loads would be a silently-orphaned record)
+        assert fleet.supervisor_dirs(run) == {
+            4: os.path.join(run, "supervisor_04")}
+        _write_rank(run, 4, 10.0, n_steps=2)
+        _write_rank(run, 5, 10.0, n_steps=2)
+        flt = fleet.load_fleet(run)
+        assert 4 in flt["supervisors"]
+        agg = fleet.aggregate(run)
+        assert agg["supervisor"] is not None  # node-1 events rolled up
+        assert agg["supervisor"]["completed"]
+
+    def test_multinode_hang_scoped_to_its_node(self, tmp_path):
+        """Two nodes share one run_dir, both with an attempt-1 hang
+        restart: each supervisor's attribution must only consider ITS
+        rank slice (attempt counters are per-supervisor)."""
+        run = str(tmp_path)
+        for rank, steps in ((0, 5), (1, 3), (4, 5), (5, 2)):
+            _write_rank(run, rank, 10.0, n_steps=steps)
+        for base in (0, 4):
+            name = "supervisor" if base == 0 else f"supervisor_{base:02d}"
+            j = journal.RunJournal(os.path.join(run, name),
+                                   compute_flops=False).start()
+            j.event("elastic.restart", failure="hang", rank=0,
+                    attempt=0, restarts_used=1)
+            j.close()
+        hangs = {(s["rank"], s["last_step"])
+                 for s in fleet.stall_attribution(fleet.load_fleet(run))}
+        # node 0 slice {0,1}: rank 1 stopped at 3; node 1 slice {4,5}:
+        # rank 5 stopped at 2 — never rank 1 vs rank 5 cross-matched
+        assert hangs == {(1, 3), (5, 2)}
+
+    def test_straggler_detector_rearms_per_episode(self):
+        rows = [{"step": i, "slowest": 1, "slowest_vs_others": r}
+                for i, r in enumerate(
+                    [2.0, 2.0, 2.0, 2.0,    # episode 1 (fires at 3rd)
+                     1.0,                   # recovery re-arms
+                     2.0, 2.0, 2.0])]       # episode 2 (fires again)
+        det = fleet.StragglerDetector(factor=1.5, patience=3)
+        fired = [det.update(r) for r in rows]
+        assert [bool(f) for f in fired] == [
+            False, False, True, False, False, False, False, True]
+
+    def test_rank_change_resets_the_streak(self):
+        rows = [{"step": 0, "slowest": 0, "slowest_vs_others": 2.0},
+                {"step": 1, "slowest": 1, "slowest_vs_others": 2.0},
+                {"step": 2, "slowest": 1, "slowest_vs_others": 2.0}]
+        det = fleet.StragglerDetector(factor=1.5, patience=2)
+        assert [bool(det.update(r)) for r in rows] == \
+            [False, False, True]
+
+
+# -- merged Chrome traces -----------------------------------------------------
+
+
+class TestMergedTraces:
+    def _export_rank_trace(self, run_dir, rank):
+        os.makedirs(os.path.join(run_dir, fleet.rank_subdir(rank)),
+                    exist_ok=True)
+        prev = trace.current_rank()
+        trace.enable_tracing()
+        trace.clear_trace()
+        try:
+            trace.set_rank(rank)
+            with trace.span("work", rank=rank):
+                pass
+            trace.device_counter(0, "bytes_in_use", 123.0)
+            trace.export_chrome_trace(os.path.join(
+                run_dir, fleet.rank_subdir(rank), fleet.TRACE_FILE))
+        finally:
+            trace.set_rank(prev)
+            trace.disable_tracing()
+            trace.clear_trace()
+
+    def test_rank_lanes_never_collide(self, tmp_path):
+        """Two ranks, each with a span and a device-0 counter: the
+        merged trace keeps one host lane per rank (pid=rank) and puts
+        each rank's device 0 in its own namespace slice."""
+        run_dir = str(tmp_path)
+        for rank in (0, 1):
+            # each rank needs a journal for rank_dirs discovery
+            _write_rank(run_dir, rank, 10.0, n_steps=1)
+            self._export_rank_trace(run_dir, rank)
+        out = str(tmp_path / "merged.json")
+        res = fleet.merge_chrome_traces(run_dir, out)
+        assert res["sources"] == 2
+        with open(out, encoding="utf-8") as f:
+            events = json.load(f)["traceEvents"]
+        span_pids = {e["pid"] for e in events if e["ph"] == "X"}
+        assert span_pids == {0, 1}
+        dev_pids = {e["pid"] for e in events if e["ph"] == "C"}
+        assert dev_pids == {
+            trace.DEVICE_PID_BASE,
+            trace.DEVICE_PID_BASE + trace.RANK_PID_STRIDE}
+        names = {e["pid"]: e["args"]["name"] for e in events
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert names[0] == "rank 00" and names[1] == "rank 01"
+
+    def test_merge_is_idempotent_on_unnamespaced_exports(self,
+                                                         tmp_path):
+        """A worker that exported WITHOUT a rank identity (pid =
+        os.getpid(), device lane = DEVICE_PID_BASE + id) still merges
+        into the correct rank lanes — the remap recovers the device
+        slot mod RANK_PID_STRIDE. Host spans are classified by the
+        source's counter pids, NOT pid magnitude: on hosts with
+        pid_max raised past DEVICE_PID_BASE an OS pid can exceed the
+        device band (the 2_000_000 span below) and must still land on
+        the rank lane."""
+        run_dir = str(tmp_path)
+        _write_rank(run_dir, 2, 10.0, n_steps=1)
+        raw = {"traceEvents": [
+            {"ph": "X", "pid": 2_000_000, "tid": 1, "name": "s",
+             "ts": 0, "dur": 1, "args": {}},
+            {"ph": "C", "pid": trace.DEVICE_PID_BASE + 7,
+             "name": "bytes_in_use", "ts": 0, "args": {"value": 1.0}},
+        ]}
+        with open(os.path.join(run_dir, "rank_02", fleet.TRACE_FILE),
+                  "w") as f:
+            json.dump(raw, f)
+        out = str(tmp_path / "merged.json")
+        fleet.merge_chrome_traces(run_dir, out)
+        with open(out, encoding="utf-8") as f:
+            events = json.load(f)["traceEvents"]
+        assert {e["pid"] for e in events if e["ph"] == "X"} == {2}
+        assert {e["pid"] for e in events if e["ph"] == "C"} == {
+            trace.DEVICE_PID_BASE + 2 * trace.RANK_PID_STRIDE + 7}
+
+
+# -- live SLO export ----------------------------------------------------------
+
+
+def _manual_clock_engine():
+    """A deterministic served trace: ManualClock timestamps, so
+    stats() percentiles are exact rationals the exporter must
+    reproduce bit-for-bit."""
+    from paddle_tpu.serving import PagedKVCache, ServeEngine, TinyLM
+    from paddle_tpu.serving.scheduler import ManualClock
+
+    clock = ManualClock()
+    eng = ServeEngine(TinyLM(num_heads=2, head_dim=8),
+                      PagedKVCache(32, 4, 2, 8, max_seq_len=32),
+                      clock=clock)
+    for prompt in ([1, 2, 3], [4, 5], [6]):
+        eng.submit(prompt, max_new_tokens=3, arrival_t=clock())
+    # advance the clock unevenly so ttft/tpot differ per request
+    for dt in (0.010, 0.007, 0.005, 0.003, 0.002, 0.001, 0.001):
+        clock.advance(dt)
+        if not eng.step():
+            break
+    eng.run()
+    assert eng.stats()["finished"] == 3
+    return eng
+
+
+class TestExporter:
+    def test_scrape_matches_engine_stats_exactly(self):
+        eng = _manual_clock_engine()
+        st = eng.stats()
+        text = obs_export.prometheus_text(engines=[eng])
+        vals = obs_export.parse_prometheus_text(text)
+        rep = eng.replica_id
+        for key in ("ttft_ms", "tpot_ms", "e2e_ms"):
+            for q in ("p50", "p99"):
+                name = (f'paddle_tpu_serving_slo_{key}'
+                        f'{{replica="{rep}",q="{q}"}}')
+                assert vals[name] == st[key][q], \
+                    f"{name}: scraped {vals[name]} != stats {st[key][q]}"
+        assert vals[f'paddle_tpu_serving_slo_queue_depth'
+                    f'{{replica="{rep}"}}'] == st["queue_depth"]
+        assert vals[f'paddle_tpu_serving_slo_finished'
+                    f'{{replica="{rep}"}}'] == 3.0
+
+    def test_http_endpoint_serves_the_same_snapshot(self):
+        eng = _manual_clock_engine()
+        st = eng.stats()
+        exp = obs_export.MetricsExporter(engines=[eng])
+        port = exp.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=10) as resp:
+                assert resp.status == 200
+                body = resp.read().decode("utf-8")
+        finally:
+            exp.stop()
+        vals = obs_export.parse_prometheus_text(body)
+        name = (f'paddle_tpu_serving_slo_ttft_ms'
+                f'{{replica="{eng.replica_id}",q="p99"}}')
+        assert vals[name] == st["ttft_ms"]["p99"]
+        # the registry rides along: serving counters are in the scrape
+        assert "paddle_tpu_serving_requests_finished" in vals
+
+    def test_live_engine_discovery(self):
+        from paddle_tpu.serving.engine import live_engines
+
+        eng = _manual_clock_engine()
+        assert eng in live_engines()
+        # no explicit engine list: the exporter finds it by itself
+        text = obs_export.prometheus_text()
+        assert (f'paddle_tpu_serving_slo_finished'
+                f'{{replica="{eng.replica_id}"}}') in text
+
+    def test_rank_heartbeat_age_gauges(self, tmp_path):
+        _write_rank(str(tmp_path), 0, 10.0, n_steps=1)
+        _write_rank(str(tmp_path), 1, 10.0, n_steps=1)
+        text = obs_export.prometheus_text(engines=[],
+                                          run_dir=str(tmp_path))
+        vals = obs_export.parse_prometheus_text(text)
+        for rank in (0, 1):
+            age = vals[f'paddle_tpu_rank_heartbeat_age_seconds'
+                       f'{{rank="{rank}"}}']
+            assert 0.0 <= age < 3600.0
+
+    def test_textfile_export_is_atomic(self, tmp_path):
+        path = str(tmp_path / "metrics.prom")
+        obs_export.write_textfile(path, engines=[])
+        body = open(path, encoding="utf-8").read()
+        assert body.endswith("\n")
+        assert "# TYPE" in body
+        assert not [fn for fn in os.listdir(str(tmp_path))
+                    if fn.startswith("metrics.prom.tmp")]
+
+    def test_histogram_exposition_shape(self):
+        reg = obs.metrics.Registry()
+        h = reg.histogram("unit.test_ms", buckets=(1.0, 10.0))
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v)
+        reg.counter("unit.hits").inc(7)
+        lines = obs_export.registry_lines(reg)
+        text = "\n".join(lines)
+        assert 'paddle_tpu_unit_test_ms_bucket{le="1.0"} 1' in text
+        assert 'paddle_tpu_unit_test_ms_bucket{le="10.0"} 2' in text
+        assert 'paddle_tpu_unit_test_ms_bucket{le="+Inf"} 3' in text
+        assert "paddle_tpu_unit_test_ms_count 3" in text
+        assert "paddle_tpu_unit_test_ms_sum 55.5" in text
+        assert "# TYPE paddle_tpu_unit_hits counter" in text
+        assert "paddle_tpu_unit_hits 7.0" in text

@@ -276,7 +276,7 @@ class TestInactiveHooksDoNothing:
 
         for name in ("record_step", "record_executor_run",
                      "record_request", "record_memory", "event",
-                     "note_step_ms", "postmortem"):
+                     "note_step_ms", "sync_step", "postmortem"):
             monkeypatch.setattr(journal.RunJournal, name, boom)
         # the per-compile sharding event and device telemetry must also
         # stay behind the ACTIVE/tracing gates
@@ -284,6 +284,18 @@ class TestInactiveHooksDoNothing:
 
         monkeypatch.setattr(spmd, "sharding_summary", boom)
         monkeypatch.setattr(spmd, "update_device_gauges", boom)
+        # the fleet aggregator and SLO exporter are PULL-only readers:
+        # nothing on a step/serve path may ever invoke them unprompted
+        from paddle_tpu.obs import export as obs_export
+        from paddle_tpu.obs import fleet as obs_fleet
+
+        monkeypatch.setattr(obs_fleet, "load_journal", boom)
+        monkeypatch.setattr(obs_fleet, "load_fleet", boom)
+        monkeypatch.setattr(obs_fleet, "aggregate", boom)
+        monkeypatch.setattr(obs_fleet, "merge_chrome_traces", boom)
+        monkeypatch.setattr(obs_export, "prometheus_text", boom)
+        monkeypatch.setattr(obs_export, "write_textfile", boom)
+        monkeypatch.setattr(obs_export.MetricsExporter, "render", boom)
 
         pt.enable_static()
         try:

@@ -133,6 +133,22 @@ def test_elastic_run_self_test_passes():
     assert mod.main(["--self-test"]) == 0
 
 
+def test_fleet_report_self_test_passes():
+    """tools/fleet_report.py --self-test: the ISSUE-13 acceptance core
+    — canned 2-rank journal fixtures must reproduce EXACT cross-rank
+    numbers (skew max = 20/15, rank-1-at-2.0x straggler attribution
+    with re-arm-per-episode detection, merged p50=500/p99=1000 request
+    percentiles, the skew-regression diff gate with no A-vs-A false
+    positive), and a REAL 2-worker GangSupervisor drill with one
+    injected worker_hang must produce per-rank journals whose
+    aggregate identifies the hung rank (from the journals, not the
+    poll-noisy watchdog rank) and fuse into a merged Perfetto trace
+    with one distinct lane per rank. In-process so it rides the tier-1
+    command path like the other self-tests."""
+    mod = _load_tool("fleet_report")
+    assert mod.main(["--self-test"]) == 0
+
+
 def test_fleet_plan_self_test_passes():
     """tools/fleet_plan.py --self-test: mesh canonicalization/validation
     fixtures, the hand-computed 412 B cost fixture (Megatron pairing +

@@ -132,10 +132,19 @@ def worker_main(args):
                 sys.exit(3)
             time.sleep(0.005)
 
+    from paddle_tpu.obs import journal as _journal
+
     for step in range(start + 1, args.steps + 1):
         hb.beat(step)
         if shutdown.requested:
             graceful_exit()
+        if _journal.ACTIVE is not None:
+            # per-rank flight record (the supervisor hands each worker
+            # PADDLE_TPU_RUN_DIR=<run>/rank_NN): number this record by
+            # the TRAINER's global step, so a resumed incarnation
+            # continues at its checkpoint step and obs.fleet aligns
+            # records across ranks and attempts
+            _journal.ACTIVE.sync_step(step)
         xb, yb = _batch(step)
         lv = float(np.asarray(
             exe.run(prog, feed={"x": xb, "y": yb},
@@ -143,6 +152,12 @@ def worker_main(args):
         if rank == 0:
             fio.save_checkpoint(args.ckpt_dir, step, model=adapter,
                                 async_=True)
+        if _journal.ACTIVE is not None:
+            # make the record durable at the step boundary: a
+            # worker_kill (os._exit, no atexit) must not cost this
+            # step's line — the fleet aggregate's stall/skew
+            # attribution reads exactly these lines
+            _journal.ACTIVE.flush()
         with open(out_path, "a", encoding="utf-8") as f:
             f.write(json.dumps({"step": step, "loss": lv,
                                 "hex": float(lv).hex()}) + "\n")
@@ -176,11 +191,14 @@ def _worker_cmd(steps, ckpt_dir, sync_dir, out_dir, barrier_timeout=60.0):
 
 
 _WORKER_ENV = {
-    # fresh worker processes must not grab a TPU or auto-start their own
-    # journal into the supervisor's run dir (multi-writer torn lines)
+    # fresh worker processes must not grab a TPU, or inherit a chaos
+    # spec meant for someone else; their journals skip the background
+    # entry-analysis compile — that CPU contention can push a loaded
+    # worker's step past the hang watchdog (the drill asserts records,
+    # not FLOPs attribution)
     "JAX_PLATFORMS": "cpu",
-    "PADDLE_TPU_RUN_DIR": "",
     "PADDLE_TPU_CHAOS": "",
+    "PADDLE_TPU_JOURNAL_FLOPS": "0",
 }
 
 
@@ -194,7 +212,10 @@ def _run_reference(root, steps):
         os.makedirs(d)
     env = dict(os.environ)
     env.update(_WORKER_ENV)
-    env.update({"PADDLE_TRAINER_ID": "0", "PADDLE_TRAINERS_NUM": "1"})
+    # the un-supervised oracle must not journal into any inherited run
+    # dir (the drill's supervised gang writes per-rank subdirs instead)
+    env.update({"PADDLE_TRAINER_ID": "0", "PADDLE_TRAINERS_NUM": "1",
+                "PADDLE_TPU_RUN_DIR": "", "PADDLE_TPU_RANK": ""})
     r = subprocess.run(
         _worker_cmd(steps, dirs["ckpt"], dirs["sync"], dirs["out"]),
         env=env, capture_output=True, text=True)
@@ -217,9 +238,15 @@ def run_drill(steps=12, kill_at=3, hang_at=6, preempt_at=9,
     - the final per-step loss trajectory is BITWISE identical to an
       unfaulted reference run;
     - restarts/preemptions/watchdog kills/resume latency land in
-      ``resilience.*`` counters and ``elastic.*`` journal events.
+      ``resilience.*`` counters and ``elastic.*`` journal events
+      (supervisor journal at ``<run>/supervisor``);
+    - EVERY rank journals its own flight record into
+      ``<run>/rank_NN`` (per-attempt run_start headers, step records
+      covering the whole trajectory) — the PR-8 worker-journal
+      suppression is gone, multi-writer torn lines are impossible by
+      construction.
     """
-    from paddle_tpu.obs import journal as _journal
+    from paddle_tpu.obs import fleet as _fleet
     from paddle_tpu.obs import metrics as _metrics
     from paddle_tpu.resilience import GangSupervisor
 
@@ -236,18 +263,28 @@ def run_drill(steps=12, kill_at=3, hang_at=6, preempt_at=9,
              f"preempt_signal:at_step={preempt_at},rank=0")
     env = dict(_WORKER_ENV)
     env["PADDLE_TPU_CHAOS"] = chaos
+    # span tracing on: each rank's journal close exports a per-rank
+    # Chrome trace next to its journal — fleet_report's self-test
+    # merges them into the pid=rank fleet view off this same drill
+    env["PADDLE_TPU_TRACE"] = "1"
     sup = GangSupervisor(
         _worker_cmd(steps, dirs["ckpt"], dirs["sync"], dirs["out"]),
         nprocs=2, env=env, heartbeat_dir=dirs["hb"],
         log_dir=dirs["logs"], ckpt_dir=dirs["ckpt"],
-        max_restarts=3, hang_timeout_s=2.5, term_grace_s=1.0,
+        run_dir=dirs["journal"],
+        # 10s watchdog: a worker's beat gap is max(gang step time) —
+        # on a small CI box two workers' first-step XLA compiles
+        # serialize to ~5s, and a spurious mid-compile "hang" inserts
+        # a whole extra attempt into the drill trace. The real
+        # worker_hang fires in steady state, so the only cost of the
+        # margin is a longer (deterministic) detection wait
+        max_restarts=3, hang_timeout_s=10.0, term_grace_s=1.0,
         poll_interval_s=0.02, backoff_s=0.05, max_backoff_s=0.1, seed=0)
     before = {k: _metrics.counter(k).value
               for k in ("resilience.restarts", "resilience.preemptions",
                         "resilience.watchdog_kills")}
     t0 = time.monotonic()
-    with _journal.RunJournal(dirs["journal"]):
-        rc = sup.run()
+    rc = sup.run()
     wall_s = time.monotonic() - t0
 
     faulted = _final_losses(os.path.join(dirs["out"],
@@ -260,7 +297,10 @@ def run_drill(steps=12, kill_at=3, hang_at=6, preempt_at=9,
         "reference": reference, "faulted": faulted,
         "bitwise_match": faulted == reference,
         "counter_deltas": counters,
-        "journal_dir": dirs["journal"], "root": root, "wall_s": wall_s,
+        "journal_dir": dirs["journal"],
+        "supervisor_dir": os.path.join(dirs["journal"],
+                                       _fleet.SUPERVISOR_DIR),
+        "root": root, "wall_s": wall_s,
     }
     failures = []
     if rc != 0:
@@ -294,6 +334,46 @@ def run_drill(steps=12, kill_at=3, hang_at=6, preempt_at=9,
                        ("resilience.watchdog_kills", 1)):
         if counters[name] != want:
             failures.append(f"{name} delta {counters[name]} != {want}")
+    # fleet contract: EVERY attempt's ranks journaled parseable
+    # per-rank flight records (no more PR-8 suppression), the union of
+    # their step records covers the whole trajectory, and the
+    # supervisor's elastic.* events landed in <run>/supervisor
+    try:
+        n_attempts = len(sup.state["attempts"])
+        ranks = _fleet.rank_dirs(dirs["journal"])
+        if sorted(ranks) != [0, 1]:
+            failures.append(
+                f"per-rank journals missing: found ranks "
+                f"{sorted(ranks)} under {dirs['journal']}")
+        covered = set()
+        for r, p in sorted(ranks.items()):
+            run = _fleet.load_journal(p)
+            if run["parse_errors"]:
+                failures.append(f"rank {r} journal has parse errors: "
+                                f"{run['parse_errors'][:2]}")
+            if len(run["run_starts"]) != n_attempts:
+                failures.append(
+                    f"rank {r} journaled {len(run['run_starts'])} "
+                    f"incarnations != {n_attempts} attempts")
+            hdr = run["header"] or {}
+            if hdr.get("rank") != r:
+                failures.append(f"rank {r} header carries rank "
+                                f"{hdr.get('rank')}")
+            covered |= {s["step"] for s in run["steps"]
+                        if isinstance(s.get("step"), int)}
+        if ranks and covered != set(range(1, steps + 1)):
+            failures.append(
+                f"rank journals cover steps {sorted(covered)}, want "
+                f"1..{steps}")
+        sup_run = _fleet.load_journal(result["supervisor_dir"])
+        es = _fleet.elastic_summary(sup_run)
+        if not es or es.get("restarts") != 2 or \
+                es.get("watchdog_kills") != 1:
+            failures.append(f"supervisor journal lost the elastic "
+                            f"story: {es}")
+    except Exception as e:
+        failures.append(f"per-rank journal check failed: "
+                        f"{type(e).__name__}: {e}")
     result["failures"] = failures
     if verbose:
         for a in sup.state["attempts"]:
@@ -311,12 +391,30 @@ _DRILL_CACHE = None
 
 
 def drill_result():
-    """Run :func:`run_drill` once per process and cache the result —
-    chaos_run's worker_kill/worker_hang/preempt_signal scenarios each
-    assert their own facet of the same drill."""
+    """Run :func:`run_drill` once per PROCESS and cache the result —
+    chaos_run's worker_kill/worker_hang/preempt_signal scenarios, this
+    tool's own self-test, and fleet_report's per-rank/merged-trace
+    checks each assert their own facet of the SAME drill. The cache
+    lives on the (shared) ``paddle_tpu.resilience.elastic`` module,
+    not here: test_tooling imports every tool as its own module
+    instance, and a per-instance global would re-run the whole
+    multi-process drill once per consumer. The kept scratch root is
+    removed at interpreter exit."""
     global _DRILL_CACHE
     if _DRILL_CACHE is None:
-        _DRILL_CACHE = run_drill(keep_root=True)
+        import paddle_tpu.resilience.elastic as _elastic
+
+        shared = getattr(_elastic, "_ELASTIC_RUN_DRILL_CACHE", None)
+        if shared is None:
+            shared = run_drill(keep_root=True)
+            _elastic._ELASTIC_RUN_DRILL_CACHE = shared
+            if shared.get("root"):
+                import atexit
+                import shutil
+
+                atexit.register(shutil.rmtree, shared["root"],
+                                ignore_errors=True)
+        _DRILL_CACHE = shared
     return _DRILL_CACHE
 
 
@@ -350,7 +448,7 @@ def self_test():
         print(f"  budget_drill   FAILED — {type(e).__name__}: {e}")
         failures.append("budget_drill")
 
-    res = run_drill(keep_root=True)
+    res = drill_result()  # shared with chaos_run / fleet_report
     if res["failures"]:
         for f in res["failures"]:
             print(f"  drill          FAILED — {f}")
@@ -358,12 +456,14 @@ def self_test():
     else:
         print(f"  drill          ok — kill+hang+preempt survived, "
               f"{len(res['reference'])} steps bitwise vs reference, "
-              f"{res['wall_s']:.1f}s")
+              f"per-rank journals parseable, {res['wall_s']:.1f}s")
 
     # the supervisor's flight record must tell the elasticity story:
     # run_report's elastic summary is how goodput loss gets attributed
+    # (the supervisor journals into <run>/supervisor since the per-rank
+    # journal split — workers own the rank_NN subdirs)
     rr = _load_sibling("run_report")
-    es = rr.elastic_summary(rr.load_run(res["journal_dir"]))
+    es = rr.elastic_summary(rr.load_run(res["supervisor_dir"]))
     for key, want in (("restarts", 2), ("preemptions", 1),
                       ("watchdog_kills", 1)):
         if not es or es.get(key) != want:
@@ -378,10 +478,9 @@ def self_test():
             failures.append("journal")
         else:
             print(f"  journal        ok — {es}")
-    if res["root"]:
-        import shutil
-
-        shutil.rmtree(res["root"], ignore_errors=True)
+    # the drill root is SHARED (fleet_report's self-test reads the
+    # same rank journals/traces later in one pytest process): cleanup
+    # belongs to drill_result's atexit hook, not here
     if failures:
         print(f"self-test FAILED: {failures}")
         return 1

@@ -43,75 +43,24 @@ DEFAULT_MEMORY_DRIFT_THRESHOLD = 0.15   # static peak-HBM prediction vs
 
 
 def _journal_files(path):
-    """The journal file(s) for a run: a file path as-is; a directory
-    yields rotated parts (journal.<n>.jsonl, oldest first) then the
-    live journal.jsonl tail."""
-    if os.path.isfile(path):
-        return [path]
-    parts = []
-    for fn in os.listdir(path):
-        if fn.startswith("journal.") and fn.endswith(".jsonl") \
-                and fn != "journal.jsonl":
-            try:
-                parts.append((int(fn.split(".")[1]), fn))
-            except ValueError:
-                pass
-    out = [os.path.join(path, fn) for _, fn in sorted(parts)]
-    live = os.path.join(path, "journal.jsonl")
-    if os.path.exists(live):
-        out.append(live)
-    return out
+    """The journal file(s) for a run (delegates to the canonical
+    ``obs.fleet`` parser — one loader for this CLI and the fleet
+    aggregator)."""
+    from paddle_tpu.obs import fleet as _fleet
+
+    return _fleet.journal_files(path)
 
 
 def load_run(path):
     """Parse a run's journal into {header, steps, events, anomalies,
     summary, parse_errors}. Tolerates a torn final line (a crashed
-    writer) — it lands in parse_errors, everything before it loads."""
-    files = _journal_files(path)
-    if not files:
-        raise FileNotFoundError(f"no journal.jsonl under {path!r}")
-    run = {"header": None, "steps": [], "events": [], "anomalies": [],
-           "requests": [], "summary": None, "parse_errors": []}
-    for fp in files:
-        with open(fp, encoding="utf-8") as f:
-            for lineno, line in enumerate(f, 1):
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError as e:
-                    run["parse_errors"].append(
-                        f"{os.path.basename(fp)}:{lineno}: {e}")
-                    continue
-                t = rec.get("t")
-                if t == "run_start":
-                    run["header"] = rec
-                elif t == "step":
-                    run["steps"].append(rec)
-                elif t == "anomaly":
-                    run["anomalies"].append(rec)
-                elif t == "run_end":
-                    run["summary"] = rec.get("summary")
-                elif t == "event":
-                    run["events"].append(rec)
-                elif t == "request":
-                    run["requests"].append(rec)
-    by_step = {s.get("step"): s for s in run["steps"]}
-    for e in run["events"]:
-        if e.get("kind") == "backend" and run["header"] is not None:
-            # backend identity is journaled lazily (first step) so the
-            # run header never forces backend init; fold it back in
-            for k in ("backend", "ndev", "device_kind",
-                      "peak_flops_per_s"):
-                if k in e:
-                    run["header"].setdefault(k, e[k])
-        step = e.get("reclassified_step")
-        if step is not None and step in by_step:
-            # the step's line was already durable when the guard
-            # discarded it; the correction rides the event
-            by_step[step]["skipped"] = True
-    return run
+    writer) — it lands in parse_errors, everything before it loads.
+    Delegates to ``obs.fleet.load_journal``, the one canonical journal
+    parser (the fleet aggregator reads rank subdirs through the same
+    code)."""
+    from paddle_tpu.obs import fleet as _fleet
+
+    return _fleet.load_journal(path)
 
 
 def _finite_losses(run):
@@ -149,63 +98,55 @@ def _pctl(xs, q):
 
 
 def request_summary(run):
-    """Serving columns over the run's ``request`` records: counts by
-    state, total preemptions, and exact p50/p99 TTFT/TPOT/e2e (ms).
-    None when the run served nothing."""
-    reqs = run.get("requests") or []
-    if not reqs:
-        return None
-    out = {"requests": len(reqs),
-           "finished": sum(1 for r in reqs
-                           if r.get("state") == "FINISHED"),
-           "cancelled": sum(1 for r in reqs
-                            if r.get("state") == "CANCELLED"),
-           "preemptions": sum(int(r.get("preemptions") or 0)
-                              for r in reqs),
-           "output_tokens": sum(int(r.get("output_tokens") or 0)
-                                for r in reqs)}
-    for key in ("ttft_ms", "tpot_ms", "e2e_ms"):
-        vals = [r[key] for r in reqs
-                if isinstance(r.get(key), (int, float))]
-        if vals:
-            out[f"{key}_p50"] = _pctl(vals, 50)
-            out[f"{key}_p99"] = _pctl(vals, 99)
-    return out
+    """Serving columns over the run's ``request`` records (canonical
+    implementation: ``obs.fleet.request_summary``, which also merges
+    them across replicas): counts by state, total preemptions, and
+    exact p50/p99 TTFT/TPOT/e2e (ms). None when the run served
+    nothing."""
+    from paddle_tpu.obs import fleet as _fleet
+
+    return _fleet.request_summary(run)
 
 
 def elastic_summary(run):
     """Elasticity columns over the run's ``elastic.*`` events (written
-    by ``resilience.elastic.GangSupervisor``): restarts (budget-
-    consuming crash/hang relaunches), budget-free preemptions, watchdog
-    kills, resume-latency p50/max (failure detection -> every worker
-    beating again), the resume steps, and whether the restart budget
-    was exhausted. None when the run was never supervised — the common
-    case costs one event scan."""
-    events = [e for e in run.get("events") or []
-              if str(e.get("kind", "")).startswith("elastic.")]
-    if not events:
+    by ``resilience.elastic.GangSupervisor``; canonical implementation
+    in ``obs.fleet``): restarts, budget-free preemptions, watchdog
+    kills, resume-latency p50/max, resume steps, budget exhaustion.
+    None when the run was never supervised."""
+    from paddle_tpu.obs import fleet as _fleet
+
+    return _fleet.elastic_summary(run)
+
+
+def fleet_summary(path):
+    """The cross-rank rollup when ``path`` holds per-rank journal
+    subdirs (``rank_NN/``, written by GangSupervisor / ``dist.launch``
+    workers): ``obs.fleet.aggregate`` — per-rank table, skew,
+    straggler/hang attribution, merged request percentiles. None for a
+    single-process run dir. ``tools/fleet_report.py`` renders the full
+    table; this feeds the one-line render below."""
+    from paddle_tpu.obs import fleet as _fleet
+
+    if not _fleet.rank_dirs(path):
         return None
-    kinds = {}
-    for e in events:
-        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
-    resume_ms = [e["resume_ms"] for e in events
-                 if e.get("kind") == "elastic.resumed"
-                 and isinstance(e.get("resume_ms"), (int, float))]
-    out = {
-        "restarts": kinds.get("elastic.restart", 0),
-        "preemptions": kinds.get("elastic.preempt", 0),
-        "watchdog_kills": kinds.get("elastic.watchdog_kill", 0),
-        "preempt_signals": kinds.get("elastic.preempt_signal", 0),
-        "budget_exhausted": bool(kinds.get("elastic.budget_exhausted")),
-        "completed": bool(kinds.get("elastic.done")),
-        "resume_steps": [e.get("resume_step") for e in events
-                         if e.get("kind") in ("elastic.restart",
-                                              "elastic.preempt")],
-    }
-    if resume_ms:
-        out["resume_ms_p50"] = _pctl(resume_ms, 50)
-        out["resume_ms_max"] = max(resume_ms)
-    return out
+    return _fleet.aggregate(path)
+
+
+def render_fleet_line(agg):
+    """One render line for a fleet run dir (the per-rank detail lives
+    in tools/fleet_report.py)."""
+    skew = agg["skew"]
+    line = (f"fleet        {agg['nranks']} ranks, "
+            f"{agg['aligned_steps']} aligned steps")
+    if skew["max"] is not None:
+        line += (f", skew max={skew['max']:.3g}x @step {skew['max_step']}"
+                 f" (slowest rank {skew['worst_rank']})")
+    stragglers = agg.get("stragglers") or []
+    if stragglers:
+        line += ", stragglers: " + ", ".join(
+            f"rank {s['rank']} ({s['kind']})" for s in stragglers[:4])
+    return line
 
 
 def plan_summary(run):
@@ -733,6 +674,29 @@ def self_test():
             if self_rep["regression"]:
                 failures.append(f"A-vs-A diff false-positived: {self_rep}")
 
+        # a fleet run dir (rank_NN subdirs, no top-level journal) gets
+        # the cross-rank rollup line instead of a FileNotFoundError
+        from paddle_tpu.obs import journal as J2
+
+        with tempfile.TemporaryDirectory() as d:
+            for rank, ms in ((0, 10.0), (1, 20.0)):
+                jj = J2.RunJournal(d, rank=rank, compute_flops=False)
+                jj.start()
+                for _ in range(4):
+                    jj.record_step(loss=1.0, step_ms=ms)
+                jj.close()
+            agg = fleet_summary(d)
+            if not agg or agg["nranks"] != 2:
+                failures.append(f"fleet_summary missed the rank "
+                                f"subdirs: {agg}")
+            elif not render_fleet_line(agg).startswith(
+                    "fleet        2 ranks"):
+                failures.append("render_fleet_line lost the fleet line: "
+                                f"{render_fleet_line(agg)}")
+            if fleet_summary(os.path.join(d, "rank_00")) is not None:
+                failures.append("fleet_summary false-positived on a "
+                                "plain single-rank dir")
+
         # serving request records round-trip with EXACT percentile
         # columns (hand-computed: TTFT = 100*(i+1) ms for i in 0..9,
         # so p50 = 500 ms, p99 = 1000 ms)
@@ -784,8 +748,9 @@ def self_test():
           "flagged the injected step-time, loss, all-reduce-bytes, "
           "perf-gate (lost donation), plan-mismatch, memory-drift AND "
           "AOT warm-start "
-          "regressions (and only them), and serving request records "
-          "round-trip with hand-computed TTFT/TPOT percentile columns")
+          "regressions (and only them), serving request records "
+          "round-trip with hand-computed TTFT/TPOT percentile columns, "
+          "and rank-subdir run dirs render the fleet rollup line")
     return 0
 
 
@@ -822,7 +787,33 @@ def main(argv=None):
         return 1 if rep["regression"] else 0
     if len(args.paths) != 1:
         ap.error("need one run dir (or --diff A B / --self-test)")
-    print(render_run(load_run(args.paths[0]), as_json=args.json))
+    path = args.paths[0]
+    try:
+        run = load_run(path)
+    except FileNotFoundError:
+        # a fleet run dir has no top-level journal: the supervisor's
+        # record (when present) is the closest single-run view, plus
+        # the cross-rank rollup line
+        agg = fleet_summary(path)
+        if agg is None:
+            raise
+        if args.json:
+            print(json.dumps(agg, indent=1, default=str,
+                             sort_keys=True))
+            return 0
+        from paddle_tpu.obs.fleet import SUPERVISOR_DIR
+        sup = os.path.join(path, SUPERVISOR_DIR)
+        try:
+            print(render_run(load_run(sup)))
+        except FileNotFoundError:
+            pass
+        print(render_fleet_line(agg))
+        return 0
+    print(render_run(run, as_json=args.json))
+    if not args.json:
+        agg = fleet_summary(path)
+        if agg is not None:
+            print(render_fleet_line(agg))
     return 0
 
 
