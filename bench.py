@@ -397,6 +397,23 @@ def bench_serve(requests=48, rate=100.0, pages=256, page_size=16):
                 and expected_running >= 1.0)})
     except Exception as e:
         _log(f"serve export scrape leg failed: {type(e).__name__}: {e}")
+    # multi-replica router leg: the same Poisson trace class through a
+    # 2-replica serving.fleet Router (in-process replicas) — the
+    # dispatch-layer tax (router_overhead_ms) and fleet-aggregate
+    # latency axes next to the single-engine numbers
+    try:
+        rep2 = sb.run_bench_fleet(
+            n_requests=min(requests, 24), rate=rate, replicas=2,
+            pages=pages, page_size=page_size)
+        out.update({
+            "replicas": rep2["replicas"],
+            "router_overhead_ms": rep2["router_overhead_ms"],
+            "fleet_tokens_per_sec": rep2["tokens_per_sec"],
+            "fleet_ttft_p99_ms": rep2["ttft_p99_ms"],
+            "fleet_requeued": rep2["requeued"],
+        })
+    except Exception as e:
+        _log(f"serve fleet leg failed: {type(e).__name__}: {e}")
     return out
 
 
@@ -902,6 +919,16 @@ def _score(results, headline, extras):
             extras["serve_warm_start_ms"] = round(sv["warm_start_ms"], 1)
             extras["aot_hits"] = extras.get("aot_hits", 0) + \
                 sv["aot_hits"]
+        if "replicas" in sv:
+            # 2-replica router evidence on EVERY round
+            # (cpu_fallback_smoke included): dispatch-layer overhead
+            # next to the single-engine latency fields
+            extras["serve_replicas"] = sv["replicas"]
+            extras["serve_router_overhead_ms"] = round(
+                sv["router_overhead_ms"], 2)
+            if sv.get("fleet_ttft_p99_ms") is not None:
+                extras["serve_fleet_ttft_p99_ms"] = round(
+                    sv["fleet_ttft_p99_ms"], 2)
     return {**headline, **extras}
 
 

@@ -20,6 +20,12 @@ TTFT/TPOT/throughput axes the Gemma TPU serving comparison, arXiv
   endpoint (``port=0`` picks an ephemeral port), and
   :func:`write_textfile` for node-exporter-style textfile collection
   (tmp + atomic rename: a scraper never reads a torn file).
+- The multi-process path: ``live_engines()`` only ever discovers THIS
+  process's replicas, so a fleet front-end composes
+  :func:`router_lines` (``serving.fleet.Router`` truth, bitwise) with
+  :func:`scrape` + :func:`merge_expositions` over each worker
+  replica's own exporter (URL or textfile) — one exposition covering
+  out-of-process replicas, which is what the autoscaler consumes.
 
 Pull-only by design: nothing here runs on a step path, nothing ticks
 unless scraped — the zero-overhead hook contract holds trivially.
@@ -38,8 +44,9 @@ from . import metrics as _metrics
 from .metrics import Counter, Gauge, Histogram
 
 __all__ = [
-    "prometheus_text", "registry_lines", "slo_lines", "write_textfile",
-    "parse_prometheus_text", "MetricsExporter", "PREFIX",
+    "prometheus_text", "registry_lines", "slo_lines", "router_lines",
+    "write_textfile", "parse_prometheus_text", "scrape",
+    "merge_expositions", "MetricsExporter", "PREFIX",
 ]
 
 PREFIX = "paddle_tpu_"
@@ -164,21 +171,148 @@ def slo_lines(engines=None, run_dir=None, now=None):
     return out.lines
 
 
+def router_lines(router):
+    """The serve-fleet router's truth (``serving.fleet.Router.stats()``)
+    as ``paddle_tpu_fleet_router_*`` gauges. Values are emitted in
+    ``repr`` round-trip form like everything else here, so a scraped
+    gauge parses back BITWISE equal to the stats dict — the router
+    acceptance gate."""
+    st = router.stats()
+    out = _Lines()
+    r = PREFIX + "fleet_router_"
+    for key in ("queue_depth", "inflight", "dispatched", "requeued",
+                "rejected", "completed", "replicas", "scale_ups",
+                "scale_downs"):
+        out.add(r + key, "gauge", st.get(key))
+    for rep, d in sorted((st.get("per_replica") or {}).items()):
+        lbl = {"replica": str(rep)}
+        out.add(r + "outstanding_tokens", "gauge",
+                d.get("outstanding_tokens"), lbl)
+        out.add(r + "replica_inflight", "gauge", d.get("inflight"),
+                lbl)
+    for tenant, d in sorted((st.get("tenants") or {}).items()):
+        lbl = {"tenant": str(tenant)}
+        out.add(r + "tenant_served_tokens", "gauge",
+                d.get("served_tokens"), lbl)
+        out.add(r + "tenant_share", "gauge", d.get("share"), lbl)
+        out.add(r + "tenant_queued", "gauge", d.get("queued"), lbl)
+    for key in ("ttft_ms", "tpot_ms", "e2e_ms"):
+        d = st.get(key)
+        if not d:
+            continue
+        for q in ("p50", "p99"):
+            out.add(r + key, "gauge", d.get(q), {"q": q})
+        out.add(r + key + "_count", "gauge", d.get("count"))
+    return out.lines
+
+
 def prometheus_text(engines=None, run_dir=None, registry=None,
-                    now=None):
-    """The full exposition: registry + SLO gauges, newline-terminated
-    Prometheus text format."""
-    return "\n".join(registry_lines(registry) +
-                     slo_lines(engines, run_dir, now=now)) + "\n"
+                    now=None, router=None, sources=None):
+    """The full exposition: registry + SLO gauges (+ router gauges and
+    scraped-and-merged remote ``sources``, for a fleet front-end),
+    newline-terminated Prometheus text format."""
+    lines = registry_lines(registry) + slo_lines(engines, run_dir,
+                                                 now=now)
+    if router is not None:
+        lines += router_lines(router)
+    if sources:
+        texts = ["\n".join(lines) + "\n"]
+        for target in sources:
+            try:
+                texts.append(scrape(target))
+            except Exception:
+                continue  # a restarting replica misses one scrape tick
+        return merge_expositions(texts)
+    return "\n".join(lines) + "\n"
 
 
-def write_textfile(path, engines=None, run_dir=None, registry=None):
+def scrape(target, timeout=5.0):
+    """Fetch one exposition: an ``http(s)://`` URL (a per-replica
+    :class:`MetricsExporter`) or a textfile path — the two transports a
+    multi-process serve fleet exports over."""
+    t = str(target)
+    if t.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(t, timeout=timeout) as resp:
+            return resp.read().decode("utf-8")
+    with open(t, encoding="utf-8") as f:
+        return f.read()
+
+
+def merge_expositions(texts):
+    """Fuse N Prometheus expositions into one: ``# TYPE`` declared once
+    per family (first seen wins), and samples with IDENTICAL keys
+    (name + labels) SUMMED — correct for counters and histogram
+    ``_bucket``/``_sum``/``_count`` series, and for additive gauges
+    (queue depths, running counts); non-additive gauges must carry a
+    distinguishing label, which the per-replica SLO gauges
+    (``replica="N"``) and router gauges do. This is the router-side
+    merge that extends the PR-13 signal plane to OUT-of-process
+    replicas (``live_engines()`` only ever saw this process's)."""
+    types = {}        # family -> type
+    order = []        # sample keys, first-seen order
+    values = {}       # key -> summed float (or raw string passthrough)
+    raw = {}          # key -> original value string (single source)
+    counts = {}
+    for text in texts:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("# TYPE "):
+                parts = line.split()
+                if len(parts) >= 4:
+                    types.setdefault(parts[2], parts[3])
+                continue
+            if line.startswith("#"):
+                continue
+            key, _, val = line.rpartition(" ")
+            if not key:
+                continue
+            if key not in values:
+                order.append(key)
+                values[key] = 0.0
+                counts[key] = 0
+            try:
+                values[key] += float(val)
+            except ValueError:
+                pass
+            raw[key] = val
+            counts[key] += 1
+    out = _Lines()
+    for key in order:
+        family = key.split("{", 1)[0]
+        if family not in types:
+            # histogram samples carry suffixes; their TYPE is declared
+            # on the base family (an exact-name match — e.g. the SLO
+            # ``*_count`` gauges — always wins over the strip)
+            for suffix in ("_bucket", "_sum", "_count"):
+                if family.endswith(suffix) and \
+                        family[:-len(suffix)] in types:
+                    family = family[:-len(suffix)]
+                    break
+        if family in types and family not in out._declared:
+            out._declared.add(family)
+            out.raw(f"# TYPE {family} {types[family]}")
+        if counts[key] == 1:
+            # single source: pass the value through VERBATIM so the
+            # merge is bitwise-lossless (the common per-replica case)
+            out.raw(f"{key} {raw[key]}")
+        else:
+            out.raw(f"{key} {_fmt(values[key])}")
+    return "\n".join(out.lines) + "\n"
+
+
+def write_textfile(path, engines=None, run_dir=None, registry=None,
+                   router=None, sources=None):
     """Atomic textfile export (node_exporter textfile-collector
     convention): write to a tmp sibling, fsync-free rename — a scraper
     reading mid-write sees the previous complete snapshot, never a torn
     one. Returns ``path``."""
     body = prometheus_text(engines=engines, run_dir=run_dir,
-                           registry=registry)
+                           registry=registry, router=router,
+                           sources=sources)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -215,12 +349,16 @@ class MetricsExporter:
     :meth:`write_textfile` snapshots."""
 
     def __init__(self, engines=None, run_dir=None, host="127.0.0.1",
-                 port=0, registry=None):
+                 port=0, registry=None, router=None, sources=None):
         self.engines = None if engines is None else list(engines)
         self.run_dir = run_dir
         self.host = str(host)
         self.port = int(port)
         self.registry = registry
+        # fleet front-end mode: a serving.fleet.Router's gauges, plus
+        # remote per-replica exporters scraped-and-merged per render
+        self.router = router
+        self.sources = None if sources is None else list(sources)
         self._httpd = None
         self._thread = None
 
@@ -234,12 +372,16 @@ class MetricsExporter:
     def render(self):
         return prometheus_text(engines=self.engines,
                                run_dir=self.run_dir,
-                               registry=self.registry)
+                               registry=self.registry,
+                               router=self.router,
+                               sources=self.sources)
 
     def write_textfile(self, path):
         return write_textfile(path, engines=self.engines,
                               run_dir=self.run_dir,
-                              registry=self.registry)
+                              registry=self.registry,
+                              router=self.router,
+                              sources=self.sources)
 
     @property
     def url(self):

@@ -42,18 +42,18 @@ import os
 import re
 import time
 
-from .journal import (JOURNAL_FILE, SUPERVISOR_DIR,  # noqa: F401
-                      TRACE_FILE, rank_subdir)
+from .journal import (JOURNAL_FILE, ROUTER_DIR,  # noqa: F401
+                      SUPERVISOR_DIR, TRACE_FILE, rank_subdir)
 from .trace import DEVICE_PID_BASE, RANK_PID_STRIDE
 
 __all__ = [
-    "SUPERVISOR_DIR", "SUPERVISOR_PID", "rank_dirs", "supervisor_dirs",
-    "journal_files",
+    "SUPERVISOR_DIR", "ROUTER_DIR", "SUPERVISOR_PID", "rank_dirs",
+    "supervisor_dirs", "router_dir", "journal_files",
     "load_journal", "load_fleet", "align_steps", "step_skew",
     "StragglerDetector", "detect_stragglers", "stall_attribution",
     "request_summary", "merged_request_summary", "elastic_summary",
-    "per_rank_summary", "aggregate", "heartbeat_ages",
-    "merge_chrome_traces", "rank_subdir",
+    "router_summary", "per_rank_summary", "aggregate",
+    "heartbeat_ages", "merge_chrome_traces", "rank_subdir",
 ]
 
 # the supervisor's merged-trace lane: above any plausible rank, below
@@ -217,11 +217,23 @@ def supervisor_dirs(run_dir):
     return out
 
 
+def router_dir(run_dir):
+    """The serve-fleet router's journal dir under ``run_dir``
+    (``router/``, written by ``serving.fleet.Router``'s host process),
+    or None."""
+    if not run_dir:
+        return None
+    p = os.path.join(str(run_dir), ROUTER_DIR)
+    if os.path.isfile(os.path.join(p, JOURNAL_FILE)):
+        return p
+    return None
+
+
 def load_fleet(run_dir):
-    """Load every rank journal (+ every supervisor's, when present)
-    under ``run_dir`` into ``{run_dir, ranks: {rank: run},
-    supervisors: {rank_base: run}, supervisor}``; ``supervisor`` stays
-    the base-0 record for single-node callers."""
+    """Load every rank journal (+ every supervisor's and the serve
+    router's, when present) under ``run_dir`` into ``{run_dir, ranks:
+    {rank: run}, supervisors: {rank_base: run}, supervisor, router}``;
+    ``supervisor`` stays the base-0 record for single-node callers."""
     ranks = rank_dirs(run_dir)
     if not ranks:
         raise FileNotFoundError(
@@ -230,10 +242,13 @@ def load_fleet(run_dir):
     fleet = {"run_dir": str(run_dir),
              "ranks": {r: load_journal(p)
                        for r, p in sorted(ranks.items())},
-             "supervisors": {}, "supervisor": None}
+             "supervisors": {}, "supervisor": None, "router": None}
     for base, p in sorted(supervisor_dirs(run_dir).items()):
         fleet["supervisors"][base] = load_journal(p)
     fleet["supervisor"] = fleet["supervisors"].get(0)
+    rd = router_dir(run_dir)
+    if rd:
+        fleet["router"] = load_journal(rd)
     return fleet
 
 
@@ -492,6 +507,43 @@ def elastic_summary(run):
     return out
 
 
+def router_summary(run):
+    """Serve-router columns over a run's ``router.*`` events (written
+    by ``serving.fleet.Router``): the LAST ``router.summary`` truth
+    (dispatched/requeued/rejected/completed, per-tenant token shares,
+    aggregate p99 TTFT) plus reject/requeue/scale event counts. None
+    when the run never routed. (Canonical home of the line
+    ``tools/run_report.py`` / ``tools/fleet_report.py`` render.)"""
+    if not run:
+        return None
+    events = [e for e in run.get("events") or []
+              if str(e.get("kind", "")).startswith("router.")]
+    if not events:
+        return None
+    summary = None
+    for e in events:
+        if e.get("kind") == "router.summary":
+            summary = e   # last wins: the final truth
+    out = {
+        "dispatched": None, "requeued": None, "rejected": None,
+        "completed": None, "replicas": None, "scale_ups": None,
+        "scale_downs": None, "tenants": {}, "ttft_p99_ms": None,
+        "requeue_events": sum(1 for e in events
+                              if e.get("kind") == "router.requeue"),
+        "reject_events": sum(1 for e in events
+                             if e.get("kind") == "router.reject"),
+        "scale_events": sum(1 for e in events
+                            if e.get("kind") == "router.scale"),
+    }
+    if summary is not None:
+        for k in ("dispatched", "requeued", "rejected", "completed",
+                  "replicas", "scale_ups", "scale_downs",
+                  "ttft_p99_ms"):
+            out[k] = summary.get(k)
+        out["tenants"] = summary.get("tenants") or {}
+    return out
+
+
 def per_rank_summary(run):
     """One rank's row in the fleet table (plain data)."""
     steps = run["steps"]
@@ -603,6 +655,9 @@ def aggregate(run_dir, straggler_factor=1.5, straggler_patience=3):
         "supervisor": elastic_summary(
             {"events": [e for sup in _supervisors(fleet).values()
                         for e in sup.get("events") or []]}),
+        # the serve router's own journal (serving.fleet drill/serve):
+        # dispatch/requeue/scale truth next to the per-rank rollup
+        "router": router_summary(fleet.get("router")),
     }
     if not isinstance(run_dir, dict):
         out["heartbeat_age_s"] = heartbeat_ages(run_dir)

@@ -54,7 +54,8 @@ from .mfu import MFUAccounting, peak_flops
 
 __all__ = ["RunJournal", "ACTIVE", "start_run", "end_run", "active",
            "JOURNAL_FILE", "POSTMORTEM_FILE", "TRACE_FILE",
-           "RANK_ENV", "SUPERVISOR_DIR", "rank_subdir", "env_rank"]
+           "RANK_ENV", "SUPERVISOR_DIR", "ROUTER_DIR", "rank_subdir",
+           "env_rank"]
 
 JOURNAL_FILE = "journal.jsonl"
 POSTMORTEM_FILE = "postmortem.json"
@@ -66,6 +67,9 @@ RANK_ENV = "PADDLE_TPU_RANK"
 # ONE constant shared by the writer (resilience.elastic) and the reader
 # (obs.fleet); a rename on either side would silently orphan the record
 SUPERVISOR_DIR = "supervisor"
+# likewise for the serve-fleet router's own journal (writer:
+# serving.fleet.Router / drill; reader: obs.fleet.router_summary)
+ROUTER_DIR = "router"
 
 # The active journal every hook checks (mirrors resilience.inject.ACTIVE:
 # None => hooks are a single None check and nothing else).

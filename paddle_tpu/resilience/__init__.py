@@ -22,8 +22,9 @@ from .policy import (  # noqa: F401
 from .guard import GuardedExecutor, GuardedStep, GuardStats  # noqa: F401
 from .elastic import (  # noqa: F401
     PREEMPTED_EXIT_CODE, ElasticBudgetError, GangSupervisor,
-    GracefulShutdown, Heartbeat, ProgramStateAdapter, fire_step_chaos,
-    graceful_shutdown, newest_intact_step, normalize_exit_code,
+    GracefulShutdown, Heartbeat, ProgramStateAdapter, ReplicaSupervisor,
+    fire_step_chaos, graceful_shutdown, newest_intact_step,
+    normalize_exit_code,
 )
 
 __all__ = [
@@ -34,6 +35,7 @@ __all__ = [
     "GuardedStep", "GuardedExecutor", "GuardStats",
     "PREEMPTED_EXIT_CODE", "ElasticBudgetError", "GangSupervisor",
     "GracefulShutdown", "Heartbeat", "ProgramStateAdapter",
+    "ReplicaSupervisor",
     "fire_step_chaos", "graceful_shutdown", "newest_intact_step",
     "normalize_exit_code",
 ]

@@ -52,6 +52,7 @@ __all__ = [
     "PREEMPTED_EXIT_CODE", "HEARTBEAT_ENV", "ATTEMPT_ENV",
     "ElasticBudgetError", "Heartbeat", "GracefulShutdown",
     "graceful_shutdown", "ProgramStateAdapter", "GangSupervisor",
+    "ReplicaSupervisor",
     "fire_step_chaos", "newest_intact_step", "normalize_exit_code",
 ]
 
@@ -274,6 +275,71 @@ class _Worker:
         self.spawned_at = time.monotonic()
         self.done = False
         self.exit_code = None
+
+
+class ReplicaSupervisor:
+    """The :class:`GangSupervisor` relaunch discipline for INDEPENDENT
+    serve replicas (``serving.fleet.ReplicaPool``): per-replica restart
+    budget, the same seeded capped-exponential + post-cap-jitter
+    backoff schedule (one formula, owned by ``RecoveryPolicy``), and
+    ``elastic.replica_restart`` journal events. The crucial difference
+    from a training gang: replicas share no collective, so a failed
+    replica NEVER tears down its peers — the pool drains/requeues the
+    casualty's requests and relaunches it alone while the survivors
+    keep serving. Preemption-style exits (``PREEMPTED_EXIT_CODE``) stay
+    budget-free, mirroring the gang rules."""
+
+    def __init__(self, max_restarts=3, *, backoff_s=0.5,
+                 backoff_factor=2.0, max_backoff_s=30.0, jitter=0.25,
+                 seed=0, sleep=None):
+        self.max_restarts = int(max_restarts)
+        self._policy = _RecoveryPolicy(
+            backoff=float(backoff_s), backoff_factor=float(backoff_factor),
+            max_backoff=float(max_backoff_s), jitter=float(jitter),
+            jitter_seed=int(seed))
+        self._sleep = sleep if sleep is not None else time.sleep
+        # per-replica budgets: one flapping replica must not spend the
+        # healthy ones' relaunches
+        self.restarts = {}     # replica_id -> budget-consuming restarts
+        self.preemptions = {}  # replica_id -> budget-free relaunches
+        self.history = []      # [{replica, kind, code, restarts}]
+
+    def note_failure(self, replica_id, kind="crash", code=None):
+        """Account one replica failure and SLEEP the backoff before the
+        relaunch the caller is about to do. ``kind``: ``crash``/``hang``
+        consume that replica's restart budget, ``preempt`` is free.
+        Raises :class:`ElasticBudgetError` (with the failure history)
+        when the budget is spent. Returns the backoff slept (s)."""
+        rid = int(replica_id)
+        free = kind == "preempt"
+        if free:
+            self.preemptions[rid] = self.preemptions.get(rid, 0) + 1
+            n = self.preemptions[rid]
+        else:
+            self.restarts[rid] = self.restarts.get(rid, 0) + 1
+            n = self.restarts[rid]
+        self.history.append({"replica": rid, "kind": kind, "code": code,
+                             "restarts": self.restarts.get(rid, 0)})
+        if not free and n > self.max_restarts:
+            _journal_event("elastic.replica_budget_exhausted",
+                           replica=rid, restarts=n - 1, last_kind=kind,
+                           last_code=code)
+            raise ElasticBudgetError(
+                f"replica {rid} failed {n} times, restart budget is "
+                f"{self.max_restarts}: last failure {kind} "
+                f"(exit {code})", self.history)
+        delay = 0.0 if free else self._policy.backoff_for(n - 1)
+        if not free:
+            _M_RESTARTS.inc()
+        else:
+            _M_PREEMPTIONS.inc()
+        _journal_event("elastic.replica_restart", replica=rid,
+                       failure=kind, code=code,
+                       restarts_used=self.restarts.get(rid, 0),
+                       backoff_s=round(delay, 4))
+        if delay:
+            self._sleep(delay)
+        return delay
 
 
 class GangSupervisor:

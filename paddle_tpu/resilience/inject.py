@@ -445,6 +445,24 @@ class PreemptSignalInjector(_WorkerFaultInjector):
         return value
 
 
+@register_injector("replica_kill")
+class ReplicaKillInjector(_WorkerFaultInjector):
+    """Hard-kill a SERVE replica process mid-flight via ``os._exit`` —
+    the machine-loss fault for the serving fleet. Fired from
+    ``ServeEngine.step()``'s boundary hook with the engine's serve-step
+    count and replica id, so ``at=N`` means "die inside serve step N"
+    (typically mid-decode) and ``rank=R`` targets one replica of a
+    ``serving.fleet.ReplicaPool``. The router's drill asserts the
+    stranded requests requeue in arrival order and finish
+    oracle-identical on the survivors while the relaunched replica
+    hydrates AOT-warm. cfg: ``code`` (exit code, default 1)."""
+
+    def fire(self, value=None, step=None, rank=None, **ctx):
+        if self._worker_applies(step, rank):
+            os._exit(int(self.cfg.get("code", 1)))
+        return value
+
+
 @register_injector("loader_worker")
 class LoaderWorkerInjector(Injector):
     """Kill a DataLoader prefetch worker thread (the exception escapes

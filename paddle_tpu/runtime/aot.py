@@ -64,7 +64,7 @@ __all__ = [
     "AOTCache", "configure", "configured", "active_cache",
     "resolve_cache", "fingerprint", "fingerprint_digest",
     "load_or_compile", "cache_stats", "warm_inference_model",
-    "ENV_DIR", "FORMAT_VERSION",
+    "shared_cache_env", "ENV_DIR", "FORMAT_VERSION",
 ]
 
 ENV_DIR = "PADDLE_TPU_AOT_CACHE"
@@ -478,6 +478,19 @@ def cache_stats():
     """Stats of the active process-wide cache, or None."""
     c = active_cache()
     return c.stats() if c is not None else None
+
+
+def shared_cache_env(directory):
+    """The env block that hands a SHARED executable cache to a fleet of
+    worker processes (``serving.fleet.ReplicaPool``): creates the
+    directory and returns ``{ENV_DIR: abspath}``. Concurrent workers
+    compiling the same digest race only on the atomic tmp+rename
+    publish (last writer wins, both envelopes identical), so the first
+    incarnation of every replica can warm the cache in parallel and
+    every relaunch/scale-up after that hydrates instead of compiling."""
+    d = os.path.abspath(str(directory))
+    os.makedirs(d, exist_ok=True)
+    return {ENV_DIR: d}
 
 
 # -- the one compile-site flow ------------------------------------------------

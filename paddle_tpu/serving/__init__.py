@@ -22,17 +22,24 @@ compiled steps —
 
 ``tools/serve_bench.py`` drives a synthetic Poisson trace through the
 engine and reports p50/p99 TTFT/TPOT and tokens/s.
+
+The multi-replica layer lives in ``serving.fleet``: a load-aware
+``Router`` over a ``ReplicaPool`` of engines (in-process or worker
+processes), per-tenant fairness + rate limits, SLO-driven
+``Autoscaler``, and elastic replica relaunch — see that package's
+docstring.
 """
 from .kv_cache import (CachePressureError, PageAllocationError,
                        PagedKVCache, write_tokens)
 from .scheduler import (Batch, ManualClock, Request, Scheduler,
                         QUEUED, RUNNING, PREEMPTED, FINISHED, CANCELLED)
 from .engine import ServeEngine, TinyLM
+from . import fleet  # noqa: F401  (serving.fleet.Router et al.)
 
 __all__ = [
     "PagedKVCache", "PageAllocationError", "CachePressureError",
     "write_tokens",
     "Scheduler", "Request", "Batch", "ManualClock",
     "QUEUED", "RUNNING", "PREEMPTED", "FINISHED", "CANCELLED",
-    "ServeEngine", "TinyLM",
+    "ServeEngine", "TinyLM", "fleet",
 ]

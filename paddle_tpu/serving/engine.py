@@ -48,6 +48,7 @@ import numpy as np
 from ..obs import journal as _journal
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
+from ..resilience import inject as _inject
 from ..resilience.policy import RecoveryPolicy, retry_call
 from .kv_cache import (CachePressureError, PagedKVCache,
                        PageAllocationError, write_tokens)
@@ -197,7 +198,7 @@ class ServeEngine:
 
     def __init__(self, model, cache, scheduler=None, policy=None,
                  sample_fn=None, interpret=None, clock=None,
-                 aot_cache_dir=None):
+                 aot_cache_dir=None, replica_id=None):
         self.model = model
         self.cache = cache
         if cache.num_heads != model.num_heads or \
@@ -249,8 +250,12 @@ class ServeEngine:
         # step boundary, or the freed rid KeyErrors the batch build
         self._step_lock = threading.RLock()
         # SLO-export identity: stable per process, rides the exporter's
-        # replica="N" label so multi-replica scrapes stay attributable
-        self.replica_id = next(_REPLICA_IDS)
+        # replica="N" label so multi-replica scrapes stay attributable.
+        # A fleet launcher passes the FLEET-assigned id instead — the
+        # per-process counter restarts at 0 in every worker process, so
+        # two replicas' scrapes would otherwise collide on replica="0"
+        self.replica_id = next(_REPLICA_IDS) if replica_id is None \
+            else int(replica_id)
         with _ENGINES_LOCK:
             _ENGINES.append(weakref.ref(self))
 
@@ -391,6 +396,42 @@ class ServeEngine:
                               aot_info=aot_info)
         return entry
 
+    def warm(self, max_batch=8):
+        """Compile (or AOT-hydrate) EVERY bucketed step this engine can
+        reach up front: all prefill context-length buckets (the
+        ``_len_bucket`` power-of-two ladder from ``page_size`` to
+        ``max_seq_len``) and every decode (batch-bucket, table-width)
+        pair up to ``max_batch`` lanes. With an AOT cache configured
+        this is the replica scale-up story: the FIRST incarnation pays
+        XLA once and publishes, every later replica (or relaunch)
+        hydrates the whole set from disk before its first request — the
+        fleet drill asserts a relaunched replica journals zero
+        ``via=="xla"`` compiles. Returns the number of entries warmed.
+        (Without a cache the jitted steps still compile lazily on first
+        dispatch — warming would build jit wrappers, not executables.)"""
+        n = 0
+        blen = self.cache.page_size
+        while True:
+            self._get_prefill_fn(_len_bucket(blen, self.cache.page_size))
+            n += 1
+            if blen >= self.cache.max_seq_len:
+                break
+            blen *= 2
+        # reachable table widths are _len_bucket(pages, 1) clamped to
+        # the pool-wide maximum — enumerate exactly that set
+        widths, w = [], 1
+        while w < self.cache.table_width:
+            widths.append(w)
+            w *= 2
+        widths.append(self.cache.table_width)
+        for b in _DECODE_BUCKETS:
+            if b > max(int(max_batch), 1):
+                break
+            for w in widths:
+                self._get_decode_fn(b, width=w)
+                n += 1
+        return n
+
     def decode_entry(self, bucket=1):
         """The compiled decode step as a perf-gate entry (``fn`` +
         ``arg_structs``): ``tools/perf_gate.check_entry`` lowers it and
@@ -403,6 +444,13 @@ class ServeEngine:
         the running set, retire finished requests. Returns the Batch
         served (falsy when idle)."""
         with self._step_lock:   # cancel() waits for the step boundary
+            if _inject.ACTIVE and "replica_kill" in _inject.ACTIVE:
+                # serve-loop chaos boundary (the elastic.fire_step_chaos
+                # twin): lets the fleet drill kill THIS replica mid-step,
+                # gated on serve-step count + replica id. Inactive cost:
+                # one empty-dict truthiness test
+                _inject.fire("replica_kill", step=self._steps + 1,
+                             rank=self.replica_id)
             t0 = self.clock()
             batch = self.scheduler.schedule()
             if not batch:

@@ -1,0 +1,38 @@
+"""paddle_tpu.serving.fleet: multi-replica serve router, SLO-aware
+autoscaling, elastic replica supervision.
+
+The millions-of-users topology (ROADMAP item 5) composed from five
+existing subsystems: N continuous-batching ``ServeEngine`` replicas
+(PR 7) behind a load-aware :class:`~.router.Router` with per-tenant
+fairness + rate limits, replica processes heartbeat-watched and
+relaunched in the PR-8 gang style (``resilience.ReplicaSupervisor``),
+hydrating from a SHARED AOT executable cache (PR 12) so scale-up pays
+deserialize instead of XLA, journaling per-rank and exporting live SLO
+gauges through the PR-13 signal plane, and an :class:`~.autoscale
+.Autoscaler` consuming that scrape to drive scale-up / drain-based
+scale-down.
+
+- ``router.Router`` — least-outstanding-tokens dispatch, tenant
+  fairness/rate limits, arrival-order requeue on replica death;
+  deterministic under an injectable clock.
+- ``pool.ReplicaPool`` / ``ReplicaSpec`` — in-process or worker-process
+  replicas with heartbeats, per-rank journals, per-replica ``/metrics``.
+- ``autoscale.Autoscaler`` — hysteresis + cooldown over queue-depth and
+  TTFT/TPOT p99 signals in the Prometheus scrape format.
+- ``worker`` — the replica process entry
+  (``python -m paddle_tpu.serving.fleet.worker``).
+- ``drill`` — the kill-a-replica-mid-decode acceptance drill
+  (``tools/chaos_run.py replica_kill``).
+
+``tools/serve_bench.py --replicas N`` drives a Poisson trace through
+an in-process fleet and gates aggregate p50/p99 TTFT/TPOT.
+"""
+from .autoscale import Autoscaler
+from .pool import LocalReplica, ProcessReplica, ReplicaPool, ReplicaSpec
+from .router import FleetRequest, Router, TenantPolicy, TokenBucket
+
+__all__ = [
+    "Router", "FleetRequest", "TenantPolicy", "TokenBucket",
+    "ReplicaPool", "ReplicaSpec", "LocalReplica", "ProcessReplica",
+    "Autoscaler",
+]

@@ -1,0 +1,243 @@
+"""Elastic-serving chaos drill: kill a replica mid-decode, lose nothing.
+
+The fleet's acceptance drill (the serving twin of
+``tools/elastic_run.py``'s gang drill): N=2 ``worker`` processes behind
+a :class:`~.router.Router`, sharing one AOT executable cache and one
+fleet journal root. The ``replica_kill`` injector hard-kills replica 1
+inside serve step ``KILL_STEP`` (``os._exit`` — no flush, no goodbye:
+machine loss). The drill then proves, end to end:
+
+1. **No request is lost.** Every submitted request reaches FINISHED —
+   the victims requeue through the router and finish elsewhere (or on
+   the relaunched replica).
+2. **Token-for-token oracle identity.** Every request's output equals
+   the single-engine dense oracle (``TinyLM.reference_generate``) —
+   re-dispatch re-prefills the original prompt and greedy decode is
+   deterministic, so a kill is invisible in the tokens.
+3. **Requeue keeps arrival order.** The stranded requests re-dispatch
+   in their ORIGINAL arrival order (the router-level mirror of the
+   scheduler's preemption rule).
+4. **Relaunch is AOT-warm.** The relaunched incarnation's journal
+   segment records ZERO ``via=="xla"`` compile events and at least one
+   ``via=="aot_disk"`` hydration — scale-up/recovery pays deserialize,
+   never XLA (PR 12's promise, under fire).
+
+The run is cached once per process (``drill_result``) and shared by
+``tools/chaos_run.py``'s ``replica_kill`` scenario and
+``tests/test_serve_fleet.py`` — tier-1 pays for ONE drill.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+__all__ = ["run_drill", "drill_result", "KILL_STEP"]
+
+KILL_STEP = 4      # serve step the victim dies in (mid-decode)
+VICTIM = 1
+N_REQUESTS = 6     # split ~3/3; max_new=5 means nothing finishes
+MAX_NEW = 5        # before the step-4 kill — every strand is mid-decode
+
+_RESULT = None
+
+
+def _requests(vocab, n=N_REQUESTS, seed=7):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        plen = int(rng.randint(4, 7))
+        out.append(([int(x) for x in rng.randint(0, vocab, plen)],
+                    MAX_NEW))
+    return out
+
+
+def _relaunch_compiles(rank_dir):
+    """Compile-event provenance of the LAST incarnation in a rank
+    journal (relaunches append to the same file; segments split on
+    ``run_start``)."""
+    path = os.path.join(rank_dir, "journal.jsonl")
+    segments = [[]]
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail from the os._exit kill
+            if rec.get("t") == "run_start":
+                segments.append([])
+            segments[-1].append(rec)
+    last = segments[-1]
+    via = {"xla": 0, "aot_disk": 0, "none": 0}
+    for rec in last:
+        if rec.get("t") == "event" and rec.get("kind") == "compile":
+            via[rec.get("via") or "none"] = \
+                via.get(rec.get("via") or "none", 0) + 1
+    # one segment per run_start (segments[0] is the pre-header void)
+    return via, len(segments) - 1
+
+
+def run_drill(root=None, keep=False):
+    """Run the 2-replica kill drill; returns the result dict (with a
+    ``failures`` list — empty on success)."""
+    from ..engine import TinyLM
+    from ...obs import journal as _journal
+    from .pool import ReplicaPool, ReplicaSpec
+    from .router import Router
+
+    failures = []
+    own_root = root is None
+    root = root or tempfile.mkdtemp(prefix="pt_fleet_drill_")
+    run_dir = os.path.join(root, "run")
+    spec = ReplicaSpec(
+        vocab_size=32, num_heads=2, head_dim=8, seed=0,
+        pages=16, page_size=4, max_seq_len=16, token_budget=64,
+        # warm bound 4: the requeue routes the ≤3 stranded requests to
+        # the EMPTY relaunched replica, so no decode batch exceeds 4
+        # lanes anywhere — warming buckets past that would only slow
+        # the one cold (compiling) incarnation
+        max_batch=4, warm=True,
+        aot_cache_dir=os.path.join(root, "aot"),
+        run_dir=run_dir, metrics_port=0,
+        env={"JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+             # quiet the journal's background analysis compiles: CPU
+             # contention inside workers racing the drill's wall clock,
+             # and an analysis compile must never muddy the zero-xla
+             # assertion's compile-event stream
+             "PADDLE_TPU_JOURNAL_FLOPS": "0",
+             "PADDLE_TPU_TRACE": "",
+             "PADDLE_TPU_CHAOS": ""},
+        env_for_replica=lambda rid, attempt: (
+            {"PADDLE_TPU_CHAOS":
+             f"replica_kill:at={KILL_STEP},rank={VICTIM}"}
+            if rid == VICTIM and attempt == 0 else {}),
+        hang_timeout_s=120.0, startup_timeout_s=300.0)
+
+    model = TinyLM(vocab_size=32, num_heads=2, head_dim=8, seed=0)
+    trace = _requests(spec.vocab_size)
+    oracle = [model.reference_generate(p, m) for p, m in trace]
+
+    from ...resilience.elastic import ReplicaSupervisor
+
+    prev_active = _journal.ACTIVE
+    router_journal = _journal.RunJournal(
+        os.path.join(run_dir, _journal.ROUTER_DIR), rank=None,
+        flush_every=1, compute_flops=False)
+    router_journal.start()
+    _journal.ACTIVE = router_journal
+    pool = None
+    router = None
+    try:
+        pool = ReplicaPool(
+            spec, replicas=2, mode="process",
+            supervisor=ReplicaSupervisor(max_restarts=2,
+                                         backoff_s=0.05, jitter=0.0))
+        router = Router(pool)
+        t0 = time.time()
+        reqs = [router.submit(p, max_new_tokens=m,
+                              arrival_t=t0 + i * 1e-3)
+                for i, (p, m) in enumerate(trace)]
+        router.run_until_drained(timeout_s=300.0, sleep_s=0.02)
+        stats = router.stats()
+        dispatch_trace = list(router.trace)
+        # graceful stop BEFORE the journal assertions: the live
+        # workers' buffered tails flush on their way out
+        router.close()
+        router = None
+
+        # 1. nothing lost
+        for r in reqs:
+            if r.state != "FINISHED":
+                failures.append(f"{r.rid} ended {r.state}, not FINISHED")
+        # 2. oracle identity
+        for r, ref in zip(reqs, oracle):
+            if r.tokens != ref:
+                failures.append(
+                    f"{r.rid} tokens {r.tokens} != oracle {ref} "
+                    f"(requeues={r.requeues})")
+        # the kill actually stranded someone (else the drill is vacuous)
+        requeued = [r for r in reqs if r.requeues]
+        if stats["requeued"] < 1 or not requeued:
+            failures.append(
+                f"kill at step {KILL_STEP} stranded no request "
+                f"(requeued={stats['requeued']}) — drill vacuous")
+        # 3. requeued re-dispatches follow original arrival order
+        requeued_rids = {r.rid for r in requeued}
+        redis = [e["rid"] for e in dispatch_trace
+                 if e["rid"] in requeued_rids][len(requeued_rids):]
+        arrival_order = [r.rid for r in
+                         sorted(requeued, key=lambda r: r.arrival_t)]
+        if redis != arrival_order:
+            failures.append(
+                f"requeued dispatch order {redis} != arrival order "
+                f"{arrival_order}")
+        # 4. the relaunched incarnation is AOT-warm: zero xla compiles
+        rank_dir = os.path.join(run_dir,
+                                _journal.rank_subdir(VICTIM))
+        via, incarnations = _relaunch_compiles(rank_dir)
+        if incarnations < 2:
+            failures.append(
+                f"victim journal shows {incarnations} "
+                "incarnation(s) — was it relaunched at all?")
+        if via["xla"] != 0:
+            failures.append(
+                f"relaunched replica journaled {via['xla']} "
+                f"via=='xla' compile(s) — scale-up paid XLA: {via}")
+        if via["aot_disk"] < 2:
+            failures.append(
+                f"relaunched replica hydrated only "
+                f"{via['aot_disk']} entries from the shared AOT "
+                "cache (warm() covers prefill+decode buckets)")
+        result = {
+            "failures": failures, "run_dir": run_dir, "root": root,
+            "stats": stats, "trace": dispatch_trace,
+            "requeued_rids": sorted(requeued_rids),
+            "relaunch_via": via, "incarnations": incarnations,
+            "oracle": oracle,
+            "requests": [{"rid": r.rid, "state": r.state,
+                          "tokens": r.tokens, "requeues": r.requeues,
+                          "arrival_t": r.arrival_t,
+                          "admit_t": r.admit_t} for r in reqs],
+        }
+    except Exception as e:  # a harness crash is a drill failure too
+        failures.append(f"drill harness raised {type(e).__name__}: {e}")
+        result = {"failures": failures, "run_dir": run_dir,
+                  "root": root, "stats": None, "trace": [],
+                  "requeued_rids": [], "relaunch_via": None,
+                  "incarnations": 0, "oracle": oracle, "requests": []}
+    finally:
+        try:
+            if router is not None:
+                router.close()
+            elif pool is not None:
+                pool.shutdown()
+        except Exception:
+            pass
+        try:
+            router_journal.close()
+        except Exception:
+            pass
+        if _journal.ACTIVE is None and prev_active is not None \
+                and not prev_active.closed:
+            _journal.ACTIVE = prev_active
+    if own_root and not keep and not failures:
+        import atexit
+        import shutil
+
+        # keep a FAILED drill's artifacts for the postmortem; clean
+        # successful ones at exit (fleet_report's self-test still reads
+        # the journals until then)
+        atexit.register(shutil.rmtree, root, ignore_errors=True)
+    return result
+
+
+def drill_result(refresh=False):
+    """The process-cached drill run — chaos_run, fleet_report and the
+    pytest suite all read ONE execution."""
+    global _RESULT
+    if _RESULT is None or refresh:
+        _RESULT = run_drill()
+    return _RESULT

@@ -1,0 +1,219 @@
+"""Serve-replica worker process: ``python -m paddle_tpu.serving.fleet.worker``.
+
+One replica of a :class:`~.pool.ReplicaPool` in ``mode="process"``: a
+``ServeEngine`` behind a newline-JSON stdin/stdout protocol, composed
+from the PR stack the fleet exists to tie together —
+
+- **AOT-warm start** (PR 12): with ``PADDLE_TPU_AOT_CACHE`` pointing
+  at the pool's shared cache, ``--warm`` compiles-or-hydrates every
+  prefill/decode bucket BEFORE the ``ready`` line, so a relaunched or
+  scaled-up replica answers its first request with zero XLA compiles
+  (the drill reads the journal to prove it).
+- **Per-rank journal** (PR 13): ``PADDLE_TPU_RUN_DIR`` auto-starts the
+  flight recorder in this replica's ``rank_NN`` subdir; request
+  records + compile events land there for ``tools/fleet_report.py``.
+- **Heartbeat** (PR 8): beats from the SERVE LOOP via
+  ``PADDLE_TPU_HEARTBEAT_FILE`` — a wedged engine stops the beacon and
+  the pool's watchdog SIGKILLs + relaunches.
+- **Live SLO export** (PR 13): ``--metrics-port`` serves this
+  replica's ``/metrics``; the router scrapes-and-merges every
+  replica's endpoint into the fleet exposition the autoscaler reads.
+- **Chaos** (``replica_kill`` injector): fired from the engine's step
+  boundary, so an inherited ``PADDLE_TPU_CHAOS`` spec kills this
+  replica mid-decode deterministically.
+
+Protocol (one JSON object per line):
+
+parent -> worker
+    ``{"op": "submit", "rid", "prompt", "max_new_tokens", "eos_id",
+    "arrival_t"}`` | ``{"op": "cancel", "rid"}`` | ``{"op": "drain"}``
+    | ``{"op": "stats"}`` | ``{"op": "stop"}``
+worker -> parent
+    ``{"t": "ready", "replica", "pid", "metrics_port", "compiles",
+    "warmed"}`` | ``{"t": "done", "rid", "state", "tokens", ...}`` |
+    ``{"t": "rejected", "rid", "reason"}`` | ``{"t": "drained"}`` |
+    ``{"t": "stats", ...}`` | ``{"t": "bye"}``
+
+Timestamps use the WALL clock (``time.time``): the router lives in
+another process, and monotonic clocks don't compare across processes.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import sys
+import threading
+import time
+
+__all__ = ["main"]
+
+
+def _emit(obj):
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def _reader(q):
+    for line in sys.stdin:
+        q.put(line)
+    q.put(None)   # EOF: the parent is gone — shut down
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--replica-id", type=int, required=True)
+    ap.add_argument("--vocab-size", type=int, default=32)
+    ap.add_argument("--num-heads", type=int, default=2)
+    ap.add_argument("--head-dim", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--pages", type=int, default=64)
+    ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--max-seq-len", type=int, default=None)
+    ap.add_argument("--token-budget", type=int, default=256)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--metrics-port", type=int, default=-1,
+                    help="-1 disables the exporter, 0 = ephemeral")
+    ap.add_argument("--warm", action="store_true",
+                    help="compile/hydrate every bucket before ready")
+    args = ap.parse_args(argv)
+
+    from ...obs import journal as _journal
+    from ...obs.export import MetricsExporter
+    from ...resilience.elastic import Heartbeat
+    from ..engine import ServeEngine, TinyLM
+    from ..kv_cache import PagedKVCache
+    from ..scheduler import CANCELLED, Scheduler
+
+    if _journal.ACTIVE is not None:
+        # per-record flush (the elastic_run drill workers' discipline):
+        # a replica_kill is os._exit — no atexit, no flush — so a
+        # buffered journal would lose the kill incarnation's compile
+        # and request records; and the drill reads the RELAUNCHED
+        # incarnation's records while this worker is still serving
+        _journal.ACTIVE.flush_every = 1
+
+    hb = Heartbeat.from_env()
+    hb.beat(0)
+
+    model = TinyLM(vocab_size=args.vocab_size,
+                   num_heads=args.num_heads, head_dim=args.head_dim,
+                   seed=args.seed)
+    cache = PagedKVCache(args.pages, args.page_size, args.num_heads,
+                         args.head_dim, max_seq_len=args.max_seq_len)
+    eng = ServeEngine(
+        model, cache,
+        scheduler=Scheduler(cache, token_budget=args.token_budget,
+                            clock=time.time),
+        replica_id=args.replica_id)
+    warmed = eng.warm(max_batch=args.max_batch) if args.warm else 0
+    hb.beat(0)
+
+    exporter = None
+    port = None
+    if args.metrics_port >= 0:
+        exporter = MetricsExporter(engines=[eng],
+                                   port=args.metrics_port)
+        port = exporter.start()
+
+    _emit({"t": "ready", "replica": args.replica_id,
+           "pid": os.getpid(), "metrics_port": port,
+           "warmed": warmed, "compiles": eng._compiles})
+
+    cmds = queue.Queue()
+    threading.Thread(target=_reader, args=(cmds,), daemon=True).start()
+
+    reqs = {}          # rid -> engine Request
+    done_mark = 0
+    draining = False
+    drained_said = False
+    stop = False
+    while not stop:
+        # drain every pending command first: submits must join the
+        # NEXT engine step, not wait a full idle tick
+        try:
+            block = eng.scheduler.idle  # nothing to decode: wait
+            line = cmds.get(block=block, timeout=0.05 if block
+                            else None)
+        except queue.Empty:
+            line = False
+        while line is not False:
+            if line is None:
+                stop = True
+                break
+            line = line.strip()
+            if line:
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    msg = {}
+                op = msg.get("op")
+                if op == "submit":
+                    rid = msg.get("rid")
+                    if draining:
+                        _emit({"t": "rejected", "rid": rid,
+                               "reason": "draining"})
+                    else:
+                        try:
+                            reqs[rid] = eng.submit(
+                                msg["prompt"],
+                                max_new_tokens=msg.get(
+                                    "max_new_tokens", 16),
+                                rid=rid, eos_id=msg.get("eos_id"),
+                                arrival_t=msg.get("arrival_t"))
+                        except ValueError as e:
+                            _emit({"t": "rejected", "rid": rid,
+                                   "reason": str(e)})
+                elif op == "cancel":
+                    r = reqs.get(msg.get("rid"))
+                    if r is not None:
+                        eng.cancel(r)
+                        if r.state == CANCELLED:
+                            _emit(_done_record(r))
+                            reqs.pop(r.rid, None)
+                elif op == "drain":
+                    draining = True
+                elif op == "stats":
+                    _emit({"t": "stats", **eng.stats()})
+                elif op == "stop":
+                    stop = True
+                    break
+            try:
+                line = cmds.get_nowait()
+            except queue.Empty:
+                break
+        if stop:
+            break
+        if not eng.scheduler.idle:
+            eng.step()   # fires replica_kill chaos at its boundary
+        hb.beat(eng._steps)
+        # report completions in finish order
+        fin = eng.finished
+        while done_mark < len(fin):
+            r = fin[done_mark]
+            done_mark += 1
+            _emit(_done_record(r))
+            reqs.pop(r.rid, None)
+        if draining and not drained_said and eng.scheduler.idle \
+                and not reqs:
+            drained_said = True
+            _emit({"t": "drained", "replica": args.replica_id})
+    if exporter is not None:
+        exporter.stop()
+    _emit({"t": "bye", "replica": args.replica_id,
+           "steps": eng._steps})
+    return 0
+
+
+def _done_record(r):
+    return {"t": "done", "rid": r.rid, "state": r.state,
+            "tokens": list(r.generated), "arrival_t": r.arrival_t,
+            "admit_t": r.admit_t, "first_token_t": r.first_token_t,
+            "finish_t": r.finish_t, "preemptions": r.preemptions,
+            "prompt_tokens": len(r.prompt)}
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -205,7 +205,18 @@ def test_async_save_never_blocks_the_step_loop(tmp_path):
         # issued NOW must neither sweep the live writer's tmp dir nor
         # see a half-written checkpoint
         assert load_checkpoint(d, model=_linear(1)) is None
+        # the writer thread creates the tmp dir on its own schedule —
+        # give it its (stalled, unpublished) moment rather than racing
+        # its first makedirs; the 0.6s stall guarantees it is still
+        # unpublished when the tmp dir appears
+        deadline = time.perf_counter() + 5.0
+        while time.perf_counter() < deadline:
+            if os.path.isdir(d) and any(
+                    f.startswith(".tmp_ckpt_1") for f in os.listdir(d)):
+                break
+            time.sleep(0.005)
         assert any(f.startswith(".tmp_ckpt_1") for f in os.listdir(d))
+        assert not os.path.exists(os.path.join(d, "ckpt_1"))
         h.result(timeout=30.0)
     assert step_path_s < 0.3, \
         f"async save held the step path {step_path_s:.3f}s of a 0.6s write"

@@ -293,8 +293,12 @@ class TestInactiveHooksDoNothing:
         monkeypatch.setattr(obs_fleet, "load_fleet", boom)
         monkeypatch.setattr(obs_fleet, "aggregate", boom)
         monkeypatch.setattr(obs_fleet, "merge_chrome_traces", boom)
+        monkeypatch.setattr(obs_fleet, "router_summary", boom)
         monkeypatch.setattr(obs_export, "prometheus_text", boom)
         monkeypatch.setattr(obs_export, "write_textfile", boom)
+        monkeypatch.setattr(obs_export, "router_lines", boom)
+        monkeypatch.setattr(obs_export, "scrape", boom)
+        monkeypatch.setattr(obs_export, "merge_expositions", boom)
         monkeypatch.setattr(obs_export.MetricsExporter, "render", boom)
 
         pt.enable_static()
@@ -332,6 +336,41 @@ class TestInactiveHooksDoNothing:
         eng.run(max_steps=20)
         assert req.state == "FINISHED" and len(req.generated) == 2
         eng.cancel(eng.submit([1], max_new_tokens=1))
+
+        # serve-fleet hooks (router dispatch/requeue/scale, replica
+        # pool spawn/death/retire): a full routed lifecycle — submit,
+        # dispatch, a killed replica's requeue + relaunch, drain-down,
+        # rejection — must perform zero journal/export work when
+        # inactive (every router.* / fleet.* event is ACTIVE-guarded;
+        # the exporters are pull-only)
+        from paddle_tpu.serving import ManualClock
+        from paddle_tpu.serving.fleet import (ReplicaPool, ReplicaSpec,
+                                              Router)
+        from paddle_tpu.resilience import ReplicaSupervisor
+
+        fclock = ManualClock()
+        fpool = ReplicaPool(
+            ReplicaSpec(vocab_size=32, pages=32, page_size=4,
+                        max_seq_len=16, token_budget=64),
+            replicas=2, mode="local", clock=fclock,
+            supervisor=ReplicaSupervisor(sleep=lambda s: None))
+        frouter = Router(fpool, clock=fclock)
+        fr = frouter.submit([1, 2, 3], max_new_tokens=2)
+        with pytest.raises(ValueError):
+            frouter.submit([1] * 30, max_new_tokens=30)  # reject path
+        frouter.dispatch()
+        fpool.replicas[fr.replica_id].kill()
+        frouter.check_replicas()           # requeue + relaunch
+        for _ in range(30):
+            frouter.step()
+            fclock.advance(0.01)
+            if not frouter.inflight and not frouter.queue_depth:
+                break
+        assert fr.state == "FINISHED" and fr.requeues == 1
+        drainee = fpool.active()[-1]
+        drainee.drain()
+        frouter.poll()                     # retire path
+        frouter.close()
 
         import tempfile
 

@@ -1,0 +1,463 @@
+"""serving.fleet: router dispatch traces, tenant fairness/rate limits,
+replica failure/requeue, autoscaler hysteresis, the multi-process kill
+drill.
+
+Covers the PR's acceptance contract:
+- ManualClock dispatch traces are EXACT: least-outstanding-tokens with
+  lowest-id tie-break, weighted-deficit tenant fairness, token-bucket
+  rate limits that hold one tenant without blocking another;
+- router rejection mirrors single-engine ``ServeEngine.submit``
+  semantics (oversize / budget-unschedulable / vocab range);
+- a killed replica's in-flight requests requeue preserving their
+  original ``arrival_t`` AND first-dispatch ``admit_t``, re-dispatch
+  in arrival order, and still finish token-for-token equal to the
+  dense oracle;
+- drain (scale-down) semantics vs kill: a draining replica finishes
+  its in-flight work where it is (no requeue) and accepts nothing new;
+- ReplicaSupervisor budgets: crash/hang consume per-replica restarts,
+  preemptions don't, exhaustion raises with the failure history;
+- Autoscaler hysteresis on synthetic SLO series: breach patience,
+  low patience, cooldown, min/max clamps — exact decision sequences;
+- the multi-process drill (shared with ``tools/chaos_run.py
+  replica_kill``): 2 worker replicas, one os._exit'd mid-decode, all
+  requests finish oracle-identical and the relaunched replica journals
+  ZERO ``via=="xla"`` compiles (AOT-warm from the shared cache).
+"""
+import atexit
+import shutil
+import tempfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import FINISHED, ManualClock, TinyLM
+from paddle_tpu.serving.fleet import (Autoscaler, ReplicaPool,
+                                      ReplicaSpec, Router, TenantPolicy,
+                                      TokenBucket)
+
+
+# one executable cache for every in-process fleet in this module: the
+# tests share one TinyLM/pool geometry, so the first replica to build a
+# bucket publishes it and every later engine HYDRATES — the suite pays
+# each distinct compile once instead of once per replica per test
+# (dogfooding the exact scale-up mechanism the drill proves)
+_AOT_DIR = tempfile.mkdtemp(prefix="pt_serve_fleet_aot_")
+atexit.register(shutil.rmtree, _AOT_DIR, ignore_errors=True)
+
+
+def _local_fleet(n=2, clock=None, tenants=None, **spec_kw):
+    clock = clock or ManualClock()
+    kw = dict(vocab_size=32, pages=64, page_size=4, max_seq_len=32,
+              token_budget=128, aot_cache_dir=_AOT_DIR, warm=False)
+    kw.update(spec_kw)
+    from paddle_tpu.resilience import ReplicaSupervisor
+
+    pool = ReplicaPool(ReplicaSpec(**kw), replicas=n, mode="local",
+                       clock=clock,
+                       supervisor=ReplicaSupervisor(sleep=lambda s: None))
+    return Router(pool, clock=clock, tenants=tenants), pool, clock
+
+
+def _drive(router, clock, max_iters=500, dt=0.01):
+    for _ in range(max_iters):
+        router.step()
+        clock.advance(dt)
+        if not router.inflight and not router.queue_depth:
+            return
+    raise AssertionError("fleet did not drain")
+
+
+class TestDispatchTraces:
+    def test_least_outstanding_with_deterministic_tie_break(self):
+        router, pool, clock = _local_fleet()
+        # costs 8, 4, 2: rep0 (tie -> lowest id), rep1 (0<8), rep1 (4<8)
+        for plen, new in ((4, 4), (2, 2), (1, 1)):
+            router.submit([1] * plen, max_new_tokens=new)
+        pairs = router.dispatch()
+        assert [p[1] for p in pairs] == [0, 1, 1]
+        # repeatably deterministic: identical fresh fleet -> same trace
+        router2, _, _ = _local_fleet()
+        for plen, new in ((4, 4), (2, 2), (1, 1)):
+            router2.submit([1] * plen, max_new_tokens=new)
+        assert [p[1] for p in router2.dispatch()] == \
+            [p[1] for p in pairs]
+        router.close()
+        router2.close()
+
+    def test_tenant_fairness_interleaves_by_served_deficit(self):
+        router, pool, clock = _local_fleet(tenants={
+            "a": TenantPolicy(weight=1.0), "b": TenantPolicy(weight=1.0)})
+        for i in range(4):
+            router.submit([1, 2], max_new_tokens=2, tenant="a",
+                          rid=f"a{i}")
+        for i in range(2):
+            router.submit([3, 4], max_new_tokens=2, tenant="b",
+                          rid=f"b{i}")
+        order = [rid for rid, _ in router.dispatch()]
+        # equal deficits alternate (alphabetical tie-break), strict
+        # arrival order inside each tenant
+        assert order == ["a0", "b0", "a1", "b1", "a2", "a3"]
+        router.close()
+
+    def test_weighted_tenant_gets_proportional_share(self):
+        router, pool, clock = _local_fleet(tenants={
+            "big": TenantPolicy(weight=2.0),
+            "small": TenantPolicy(weight=1.0)})
+        for i in range(6):
+            router.submit([1, 2], max_new_tokens=2, tenant="big",
+                          rid=f"g{i}")
+            router.submit([3, 4], max_new_tokens=2, tenant="small",
+                          rid=f"s{i}")
+        order = [rid for rid, _ in router.dispatch()]
+        # weight 2 drains twice as fast: per 4-token request, big's
+        # deficit grows half as quickly -> g,g pattern per s
+        assert order[:6] == ["g0", "s0", "g1", "g2", "s1", "g3"]
+        router.close()
+
+    def test_rate_limit_holds_one_tenant_without_blocking_others(self):
+        router, pool, clock = _local_fleet(tenants={
+            "lim": TenantPolicy(rate=1.0, burst=4.0)})
+        router.submit([5, 6], max_new_tokens=2, tenant="lim", rid="l0")
+        router.submit([5, 6], max_new_tokens=2, tenant="lim", rid="l1")
+        router.submit([7, 8], max_new_tokens=2, tenant="free",
+                      rid="f0")
+        # zero deficits tie alphabetically (free < lim); l0's burst
+        # covers it, l1 exhausts the bucket and must wait
+        assert [r for r, _ in router.dispatch()] == ["f0", "l0"]
+        assert router.queue_depth == 1          # l1 waits on the bucket
+        clock.advance(3.9)
+        assert router.dispatch() == []          # 3.9 tokens < cost 4
+        clock.advance(0.2)
+        assert [r for r, _ in router.dispatch()] == ["l1"]
+        router.close()
+
+    def test_token_bucket_refill_math(self):
+        b = TokenBucket(rate=2.0, burst=10.0, now=0.0)
+        assert b.take(10, 0.0) and not b.peek(1, 0.0)
+        assert not b.take(5, 2.0)    # refilled 4 < 5
+        assert b.take(5, 2.5)        # refilled 5
+        assert b.peek(10, 100.0) and b.level == 10.0  # capped at burst
+
+
+class TestRejection:
+    def test_rejection_matches_engine_submit_semantics(self):
+        router, pool, clock = _local_fleet(pages=16, max_seq_len=16,
+                                           token_budget=32)
+        eng = pool.replicas[0].engine
+        for prompt, new in (([1] * 12, 8),    # > max_seq_len
+                            ([1] * 4, 40),    # > max_seq_len
+                            ([1, 2], 0),      # max_new < 1
+                            ([], 4),          # empty prompt
+                            ([99], 4)):       # vocab range
+            with pytest.raises(ValueError):
+                router.submit(prompt, max_new_tokens=new)
+            with pytest.raises(ValueError):
+                eng.submit(prompt, max_new_tokens=new)
+        assert router.stats()["rejected"] == 5
+        router.close()
+
+    def test_duplicate_live_rid_rejected(self):
+        router, pool, clock = _local_fleet()
+        router.submit([1, 2], max_new_tokens=2, rid="x")
+        with pytest.raises(ValueError, match="already queued"):
+            router.submit([3, 4], max_new_tokens=2, rid="x")  # queued
+        router.dispatch()
+        with pytest.raises(ValueError, match="already queued"):
+            router.submit([3, 4], max_new_tokens=2, rid="x")  # in flight
+        _drive(router, clock)
+        # a TERMINAL rid may be reused (retry-with-same-id pattern)
+        router.submit([3, 4], max_new_tokens=2, rid="x")
+        router.close()
+
+    def test_cost_above_tenant_burst_rejected_at_door(self):
+        # a request costlier than its tenant's bucket capacity would
+        # head-block that tenant FOREVER (the bucket caps at burst)
+        router, pool, clock = _local_fleet(tenants={
+            "lim": TenantPolicy(rate=5.0, burst=10.0)})
+        with pytest.raises(ValueError, match="burst"):
+            router.submit([1] * 5, max_new_tokens=6, tenant="lim")
+        # the same request sails through for an unlimited tenant
+        router.submit([1] * 5, max_new_tokens=6, tenant="free")
+        assert router.stats()["rejected"] == 1
+        router.close()
+
+    def test_budget_unschedulable_rejected_at_door(self):
+        # cap the scheduler budget BELOW the pool capacity: a request
+        # that fits the pool but can never be admitted must be refused
+        router, pool, clock = _local_fleet(pages=64, max_seq_len=32,
+                                           token_budget=16)
+        with pytest.raises(ValueError, match="token_budget"):
+            router.submit([1] * 10, max_new_tokens=10)
+        router.close()
+
+
+class TestFailureAndRequeue:
+    def test_kill_requeues_preserving_arrival_and_admit_t(self):
+        router, pool, clock = _local_fleet()
+        clock.advance(1.0)
+        reqs = [router.submit([1, 2, 3], max_new_tokens=4,
+                              arrival_t=1.0 + i * 0.1, rid=f"r{i}")
+                for i in range(4)]
+        clock.advance(1.0)
+        router.dispatch()
+        admits = {r.rid: r.admit_t for r in reqs}
+        assert all(t == 2.0 for t in admits.values())
+        victims = [r for r in reqs if r.replica_id == 1]
+        assert victims
+        # late arrival queued BEHIND the victims' original positions
+        late = router.submit([4, 5], max_new_tokens=2, rid="late",
+                             arrival_t=9.0)
+        pool.replicas[1].kill()
+        swept = router.check_replicas()
+        assert [(rid, reason) for rid, reason, _ in swept] == \
+            [(1, "exit")]
+        assert router.stats()["requeued"] == len(victims)
+        for v in victims:
+            assert v.state == "QUEUED" and v.requeues == 1
+            assert v.admit_t == admits[v.rid]   # admit_t preserved
+        clock.advance(1.0)
+        order = [rid for rid, _ in router.dispatch()]
+        # requeued victims re-dispatch in original arrival order,
+        # strictly before the later arrival
+        assert order == [v.rid for v in
+                         sorted(victims, key=lambda r: r.arrival_t)] \
+            + ["late"]
+        _drive(router, clock)
+        model = TinyLM(vocab_size=32, seed=0)
+        for r in reqs + [late]:
+            assert r.state == FINISHED
+            assert r.tokens == model.reference_generate(
+                r.prompt, r.max_new_tokens)
+        router.close()
+
+    def test_relaunch_consumes_supervisor_budget(self):
+        from paddle_tpu.resilience import (ElasticBudgetError,
+                                           ReplicaSupervisor)
+
+        sup = ReplicaSupervisor(max_restarts=2, backoff_s=0.0,
+                                sleep=lambda s: None)
+        clock = ManualClock()
+        pool = ReplicaPool(
+            ReplicaSpec(vocab_size=32, pages=16, page_size=4,
+                        max_seq_len=16, token_budget=64),
+            replicas=2, mode="local", clock=clock, supervisor=sup)
+        router = Router(pool, clock=clock)
+        for _ in range(2):
+            pool.replicas[1].kill()
+            router.check_replicas()
+        assert sup.restarts == {1: 2}
+        assert len(pool.active()) == 2   # relaunched both times
+        pool.replicas[1].kill()
+        with pytest.raises(ElasticBudgetError) as ei:
+            router.check_replicas()
+        assert len(ei.value.history) == 3
+        # preemptions never consume the budget
+        sup2 = ReplicaSupervisor(max_restarts=1, sleep=lambda s: None)
+        for _ in range(5):
+            sup2.note_failure(0, kind="preempt")
+        assert sup2.preemptions == {0: 5} and sup2.restarts == {}
+        router.close()
+
+    def test_drain_finishes_in_place_kill_requeues(self):
+        router, pool, clock = _local_fleet()
+        a = router.submit([1, 2, 3], max_new_tokens=4, rid="a")
+        b = router.submit([4, 5, 6], max_new_tokens=4, rid="b")
+        router.dispatch()
+        assert (a.replica_id, b.replica_id) == (0, 1)
+        draining = pool.replicas[1]
+        draining.drain()
+        # no new dispatches to a draining replica...
+        c = router.submit([7, 8], max_new_tokens=2, rid="c")
+        router.dispatch()
+        assert c.replica_id == 0
+        # ...but its in-flight request finishes where it is: no requeue
+        _drive(router, clock)
+        assert b.state == FINISHED and b.requeues == 0
+        assert b.replica_id == 1
+        # drained empty -> retired by poll()
+        assert draining.state == "RETIRED"
+        assert [r.replica_id for r in pool.active()] == [0]
+        assert router.stats()["requeued"] == 0
+        router.close()
+
+
+class TestAutoscaler:
+    def test_hysteresis_cooldown_and_bounds(self):
+        clock = ManualClock()
+        asc = Autoscaler(min_replicas=1, max_replicas=3,
+                         queue_high=8.0, queue_low=1.0,
+                         ttft_p99_slo_ms=100.0, breach_patience=2,
+                         low_patience=3, cooldown_s=10.0, clock=clock)
+        hot = {"queue_depth": 20.0, "ttft_p99_ms": 50.0}
+        idle = {"queue_depth": 0.0, "ttft_p99_ms": 50.0}
+        # one breach is noise; the second (patience 2) scales up
+        assert asc.observe(hot, replicas=1) is None
+        assert asc.observe(hot, replicas=1) == "up"
+        # cooldown swallows further breaches...
+        clock.advance(5.0)
+        assert asc.observe(hot, replicas=2) is None
+        assert asc.observe(hot, replicas=2) is None
+        # ...until it expires (patience already re-accumulated)
+        clock.advance(6.0)
+        assert asc.observe(hot, replicas=2) == "up"
+        # at max_replicas, breaches can't scale further
+        clock.advance(11.0)
+        assert asc.observe(hot, replicas=3) is None
+        assert asc.observe(hot, replicas=3) is None
+        # idle takes low_patience consecutive quiet ticks
+        assert asc.observe(idle, replicas=3) is None
+        assert asc.observe(idle, replicas=3) is None
+        assert asc.observe(idle, replicas=3) == "down"
+        # a breach mid-quiet resets the low counter
+        clock.advance(11.0)
+        assert asc.observe(idle, replicas=2) is None
+        assert asc.observe(hot, replicas=2) is None   # resets lows
+        assert asc.observe(idle, replicas=2) is None
+        assert asc.observe(idle, replicas=2) is None
+        assert asc.observe(idle, replicas=2) == "down"
+        # never below min_replicas
+        clock.advance(11.0)
+        for _ in range(6):
+            assert asc.observe(idle, replicas=1) is None
+
+    def test_ttft_slo_breach_scales_up(self):
+        clock = ManualClock()
+        asc = Autoscaler(max_replicas=2, queue_high=100.0,
+                         ttft_p99_slo_ms=200.0, breach_patience=1,
+                         cooldown_s=0.0, clock=clock)
+        assert asc.observe({"queue_depth": 0.0, "ttft_p99_ms": 350.0},
+                           replicas=1) == "up"
+        assert asc.decisions[-1][2].startswith("ttft_p99")
+
+    def test_signals_from_scrape_round_trip(self):
+        router, pool, clock = _local_fleet()
+        router.submit([1, 2, 3], max_new_tokens=4)
+        router.dispatch()
+        sig = Autoscaler.signals_from_scrape(router.exposition())
+        assert sig["queue_depth"] == 0.0
+        assert sig["replicas"] == 2
+        router.close()
+
+    def test_autoscale_tick_scales_up_then_drains_down(self):
+        clock = ManualClock()
+        asc = Autoscaler(min_replicas=1, max_replicas=3,
+                         queue_high=2.0, queue_low=0.0,
+                         breach_patience=1, low_patience=1,
+                         cooldown_s=0.0, clock=clock)
+        router, pool, clock = _local_fleet(n=1, clock=clock)
+        for i in range(6):   # deep queue, nothing dispatched yet
+            router.submit([1, 2], max_new_tokens=2, rid=f"q{i}")
+        router.autoscaler = asc
+        assert router.autoscale_tick() == "up"
+        assert len(pool.active()) == 2
+        assert router.scale_ups == 1
+        router.autoscaler = None   # drive without mid-run decisions
+        _drive(router, clock)
+        router.autoscaler = asc
+        # idle fleet: next tick drains ONE replica (never the last)
+        decision = router.autoscale_tick()
+        assert decision == "down" and router.scale_downs == 1
+        draining = [r for r in pool.replicas if r.draining]
+        assert len(draining) == 1
+        router.poll()    # empty drain retires immediately
+        assert len(pool.active()) == 1
+        assert router.autoscale_tick() != "down"   # last replica holds
+        router.close()
+
+
+class TestFleetObservability:
+    def test_router_gauges_scrape_bitwise(self):
+        from paddle_tpu.obs import export as obs_export
+
+        router, pool, clock = _local_fleet()
+        for i in range(3):
+            router.submit([1, 2, 3], max_new_tokens=3)
+        router.dispatch()
+        _drive(router, clock)
+        st = router.stats()
+        vals = obs_export.parse_prometheus_text(
+            "\n".join(obs_export.router_lines(router)) + "\n")
+        pre = "paddle_tpu_fleet_router_"
+        for key in ("queue_depth", "inflight", "dispatched", "requeued",
+                    "rejected", "completed", "replicas"):
+            assert vals[pre + key] == float(st[key])
+        for key in ("ttft_ms", "e2e_ms"):
+            for q in ("p50", "p99"):
+                assert vals[pre + key + '{q="' + q + '"}'] == \
+                    st[key][q]
+        for rep_id, d in st["per_replica"].items():
+            assert vals[pre + 'outstanding_tokens{replica="'
+                        + str(rep_id) + '"}'] == \
+                float(d["outstanding_tokens"])
+        router.close()
+
+    def test_merge_expositions_sums_identical_series(self):
+        from paddle_tpu.obs.export import (merge_expositions,
+                                           parse_prometheus_text)
+
+        a = ("# TYPE paddle_tpu_serving_tokens_generated counter\n"
+             "paddle_tpu_serving_tokens_generated 10\n"
+             "# TYPE paddle_tpu_serving_slo_running gauge\n"
+             'paddle_tpu_serving_slo_running{replica="0"} 2\n')
+        b = ("# TYPE paddle_tpu_serving_tokens_generated counter\n"
+             "paddle_tpu_serving_tokens_generated 32\n"
+             "# TYPE paddle_tpu_serving_slo_running gauge\n"
+             'paddle_tpu_serving_slo_running{replica="1"} 1\n')
+        merged = merge_expositions([a, b])
+        vals = parse_prometheus_text(merged)
+        # identical keys sum (process-wide counters across workers)...
+        assert vals["paddle_tpu_serving_tokens_generated"] == 42.0
+        # ...labelled per-replica series pass through verbatim
+        assert vals['paddle_tpu_serving_slo_running{replica="0"}'] == 2.0
+        assert vals['paddle_tpu_serving_slo_running{replica="1"}'] == 1.0
+        assert merged.count(
+            "# TYPE paddle_tpu_serving_tokens_generated counter") == 1
+
+    def test_local_fleet_oracle_identity_across_replicas(self):
+        router, pool, clock = _local_fleet(n=3)
+        rng = np.random.RandomState(11)
+        prompts = [list(map(int, rng.randint(0, 32, rng.randint(3, 8))))
+                   for _ in range(9)]
+        reqs = [router.submit(p, max_new_tokens=5) for p in prompts]
+        router.dispatch()
+        _drive(router, clock)
+        assert {r.replica_id for r in reqs} == {0, 1, 2}
+        model = TinyLM(vocab_size=32, seed=0)
+        for r, p in zip(reqs, prompts):
+            assert r.tokens == model.reference_generate(p, 5)
+        router.close()
+
+
+class TestMultiProcessDrill:
+    def test_replica_kill_drill_end_to_end(self):
+        """The acceptance drill (cached per process, shared with
+        tools/chaos_run.py): 2 worker replicas, one killed mid-decode,
+        everything finishes oracle-identical, relaunch is AOT-warm."""
+        from paddle_tpu.serving.fleet import drill
+
+        res = drill.drill_result()
+        assert not res["failures"], res["failures"]
+        assert res["stats"]["requeued"] >= 1
+        assert all(r["state"] == FINISHED for r in res["requests"])
+        for r, ref in zip(res["requests"], res["oracle"]):
+            assert r["tokens"] == ref
+        assert res["relaunch_via"]["xla"] == 0
+        assert res["relaunch_via"]["aot_disk"] >= 2
+        assert res["incarnations"] >= 2
+
+    def test_drill_fleet_report_aggregates(self):
+        """The drill's run dir is a real fleet run dir: per-rank
+        request records merge and the router journal renders."""
+        from paddle_tpu.obs import fleet as obs_fleet
+        from paddle_tpu.serving.fleet import drill
+
+        res = drill.drill_result()
+        assert not res["failures"], res["failures"]
+        agg = obs_fleet.aggregate(res["run_dir"])
+        assert agg["nranks"] == 2
+        req = agg["requests"]
+        assert req and req["finished"] >= len(res["requests"])
+        rt = agg["router"]
+        assert rt and rt["dispatched"] == res["stats"]["dispatched"]
+        assert rt["requeued"] == res["stats"]["requeued"]
+        assert rt["requeue_events"] >= 1

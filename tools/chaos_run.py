@@ -436,6 +436,30 @@ def run_preempt_signal():
     return "graceful checkpoint-and-exit 75; relaunch was budget-free"
 
 
+@scenario("replica_kill")
+def run_replica_kill():
+    """A SERVE replica hard-dies (``os._exit``) mid-decode behind the
+    ``serving.fleet`` router: its in-flight requests requeue in
+    original arrival order and finish token-for-token identical to the
+    single-engine oracle, and the relaunched replica hydrates every
+    bucket from the shared AOT cache — zero ``via=="xla"`` compiles in
+    its journal segment. (One cached 2-replica drill per process,
+    shared with tests/test_serve_fleet.py.)"""
+    from paddle_tpu.serving.fleet import drill
+
+    res = drill.drill_result()
+    assert not res["failures"], res["failures"]
+    st = res["stats"]
+    assert st["requeued"] >= 1 and st["completed"] == len(
+        res["requests"]), st
+    assert res["relaunch_via"]["xla"] == 0, res["relaunch_via"]
+    return (f"replica kill mid-decode: {st['requeued']} requests "
+            f"requeued in arrival order, all {st['completed']} "
+            f"finished oracle-identical; relaunch hydrated "
+            f"{res['relaunch_via']['aot_disk']} entries, 0 XLA "
+            "compiles")
+
+
 def self_test():
     from paddle_tpu.resilience import INJECTORS
 

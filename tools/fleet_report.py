@@ -38,6 +38,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 THIS_DIR = os.path.dirname(os.path.abspath(__file__))
 
 DEFAULT_SKEW_THRESHOLD = 0.25  # max cross-rank skew may grow 25%
+DEFAULT_TTFT_THRESHOLD = 0.25  # merged p99 TTFT may grow 25%
 
 
 def _load_sibling(name):
@@ -113,6 +114,12 @@ def render_fleet(agg, as_json=False):
             if req.get(f"{key}_p50") is not None:
                 lines.append(f"{key:<12} p50={req[f'{key}_p50']:.3f} "
                              f"p99={req[f'{key}_p99']:.3f}")
+    rt = agg.get("router")
+    if rt:
+        # ONE router-line format: run_report owns it (both tools render
+        # the same obs.fleet.router_summary dict — a second
+        # hand-maintained copy here had already drifted)
+        lines.append(_load_sibling("run_report").render_router_line(rt))
     sup = agg.get("supervisor")
     if sup:
         line = (f"supervisor   restarts={sup['restarts']} "
@@ -139,11 +146,14 @@ def render_fleet(agg, as_json=False):
 # -- diff (the skew-regression gate) -----------------------------------------
 
 
-def diff_fleets(base, new, skew_threshold=DEFAULT_SKEW_THRESHOLD):
+def diff_fleets(base, new, skew_threshold=DEFAULT_SKEW_THRESHOLD,
+                ttft_threshold=DEFAULT_TTFT_THRESHOLD):
     """Compare two fleet aggregates; regression flips when NEW's
     cross-rank skew (or straggler count) is worse than BASE beyond the
     threshold. A perfectly balanced base (skew 1.0) regressing to ANY
-    persistent straggler is flagged regardless of ratio."""
+    persistent straggler is flagged regardless of ratio. Serve fleets:
+    the MERGED (cross-replica pooled) p99 TTFT gates the same way —
+    the aggregate serving-SLO axis a per-rank skew number can't see."""
     bs, ns = base["skew"]["max"], new["skew"]["max"]
     b_slow = sum(1 for s in base.get("stragglers") or []
                  if s["kind"] == "slow")
@@ -164,8 +174,17 @@ def diff_fleets(base, new, skew_threshold=DEFAULT_SKEW_THRESHOLD):
         "base_hangs": b_hang, "new_hangs": n_hang,
         "hang_regression": n_hang > b_hang,
     }
+    bt = (base.get("requests") or {}).get("ttft_ms_p99")
+    nt = (new.get("requests") or {}).get("ttft_ms_p99")
+    out["base_ttft_p99_ms"] = bt
+    out["new_ttft_p99_ms"] = nt
+    out["ttft_ratio"] = (nt / bt) if bt and nt else None
+    out["ttft_regression"] = bool(
+        bt is not None and nt is not None and
+        nt > bt * (1.0 + ttft_threshold))
     out["regression"] = out["skew_regression"] or \
-        out["straggler_regression"] or out["hang_regression"]
+        out["straggler_regression"] or out["hang_regression"] or \
+        out["ttft_regression"]
     return out
 
 
@@ -280,8 +299,52 @@ def _selftest_fixtures(failures):
         if "straggler    rank 1 SLOW 2x" not in render_fleet(agg):
             failures.append("render lost the straggler line:\n"
                             + render_fleet(agg))
+
+        # serve-fleet axes: a run whose merged p99 TTFT doubled
+        # (rank 0: 200..1000 ms, rank 1: 1200..2000 ms -> pooled p99 =
+        # 2000 ms exactly, 2x the skewed fixture's 1000 ms) must trip
+        # the TTFT gate — and ONLY it (same step times as balanced)
+        slower = os.path.join(d, "slower")
+        _write_rank(slower, 0, 10.0,
+                    requests=[200.0, 400.0, 600.0, 800.0, 1000.0])
+        _write_rank(slower, 1, 10.0,
+                    requests=[1200.0, 1400.0, 1600.0, 1800.0, 2000.0])
+        slow_agg = F.aggregate(slower)
+        trep = diff_fleets(agg, slow_agg)
+        if not trep["ttft_regression"] or \
+                abs((trep["ttft_ratio"] or 0) - 2.0) > 1e-9:
+            failures.append(
+                f"diff missed the 2x merged-p99-TTFT regression: "
+                f"{trep}")
+        if trep["skew_regression"] or trep["straggler_regression"]:
+            failures.append(
+                f"TTFT fixture false-positived a skew/straggler "
+                f"regression: {trep}")
+
+        # a router journal under <run>/router joins the aggregate and
+        # renders the dispatch/requeue line
+        from paddle_tpu.obs import journal as J
+
+        rj = J.RunJournal(os.path.join(skewed, J.ROUTER_DIR),
+                          rank=None, flush_every=1,
+                          compute_flops=False)
+        rj.start()
+        rj.event("router.summary", dispatched=12, requeued=2,
+                 rejected=1, completed=10, replicas=2, scale_ups=0,
+                 scale_downs=0, tenants={"default": 1.0},
+                 ttft_p99_ms=1000.0)
+        rj.close()
+        ragg = F.aggregate(skewed)
+        rt = ragg.get("router")
+        if not rt or rt["dispatched"] != 12 or rt["requeued"] != 2:
+            failures.append(f"aggregate lost the router journal: {rt}")
+        elif "router       dispatched=12 requeued=2" not in \
+                render_fleet(ragg):
+            failures.append("render lost the router line:\n"
+                            + render_fleet(ragg))
     print("  fixtures       ok — exact 20/15 skew, rank-1-at-2.0x "
-          "attribution, merged p50=500/p99=1000, re-arm, diff gate"
+          "attribution, merged p50=500/p99=1000, re-arm, diff gate, "
+          "2x-TTFT gate, router line"
           if not failures else
           f"  fixtures       FAILED ({len(failures)})")
     return failures
@@ -358,9 +421,10 @@ def self_test():
         print(f"self-test FAILED: {len(failures)} check(s)")
         return 1
     print("self-test passed: canned 2-rank fixtures reproduce exact "
-          "skew/straggler/percentile numbers, and a real 2-worker "
-          "hang drill's journals identify the hung rank and fuse into "
-          "a merged per-rank Perfetto trace")
+          "skew/straggler/percentile numbers (incl. the 2x merged-p99-"
+          "TTFT serve gate and the router summary line), and a real "
+          "2-worker hang drill's journals identify the hung rank and "
+          "fuse into a merged per-rank Perfetto trace")
     return 0
 
 
@@ -378,6 +442,10 @@ def main(argv=None):
                     default=DEFAULT_SKEW_THRESHOLD,
                     help="allowed relative cross-rank skew growth "
                          "(--diff)")
+    ap.add_argument("--ttft-threshold", type=float,
+                    default=DEFAULT_TTFT_THRESHOLD,
+                    help="allowed relative merged-p99-TTFT growth "
+                         "(--diff, serve fleets)")
     ap.add_argument("--self-test", action="store_true")
     args = ap.parse_args(argv)
     from paddle_tpu.obs import fleet as F
@@ -389,7 +457,8 @@ def main(argv=None):
             ap.error("--diff needs exactly two fleet run dirs")
         rep = diff_fleets(F.aggregate(args.paths[0]),
                           F.aggregate(args.paths[1]),
-                          skew_threshold=args.skew_threshold)
+                          skew_threshold=args.skew_threshold,
+                          ttft_threshold=args.ttft_threshold)
         print(render_diff(rep, as_json=args.json))
         return 1 if rep["regression"] else 0
     if len(args.paths) != 1:

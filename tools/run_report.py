@@ -119,6 +119,36 @@ def elastic_summary(run):
     return _fleet.elastic_summary(run)
 
 
+def router_summary(run):
+    """Serve-fleet router columns over the run's ``router.*`` events
+    (written by ``serving.fleet.Router``; canonical implementation in
+    ``obs.fleet``): dispatched/requeued/rejected counts, per-tenant
+    token shares, scale events, aggregate p99 TTFT. None when the run
+    never routed."""
+    from paddle_tpu.obs import fleet as _fleet
+
+    return _fleet.router_summary(run)
+
+
+def render_router_line(rsum):
+    """One render line for a run that routed a serve fleet."""
+    line = (f"router       dispatched={rsum['dispatched']} "
+            f"requeued={rsum['requeued']} rejected={rsum['rejected']} "
+            f"completed={rsum['completed']}")
+    if rsum.get("replicas") is not None:
+        line += f" replicas={rsum['replicas']}"
+    if rsum.get("scale_events"):
+        line += (f" scale_events={rsum['scale_events']} "
+                 f"(+{rsum.get('scale_ups') or 0}/"
+                 f"-{rsum.get('scale_downs') or 0})")
+    if rsum.get("tenants"):
+        line += " tenants " + " ".join(
+            f"{t}:{s:.2f}" for t, s in sorted(rsum["tenants"].items()))
+    if rsum.get("ttft_p99_ms") is not None:
+        line += f" ttft_p99={rsum['ttft_p99_ms']:.1f}ms"
+    return line
+
+
 def fleet_summary(path):
     """The cross-rank rollup when ``path`` holds per-rank journal
     subdirs (``rank_NN/``, written by GangSupervisor / ``dist.launch``
@@ -352,6 +382,9 @@ def render_run(run, as_json=False):
         if asum["compile_ms_avoided"]:
             line += f", compile avoided {asum['compile_ms_avoided']:.1f}ms"
         lines.append(line)
+    rtsum = router_summary(run)
+    if rtsum:
+        lines.append(render_router_line(rtsum))
     esum = elastic_summary(run)
     if esum:
         line = (f"elastic      restarts={esum['restarts']} "
@@ -735,6 +768,40 @@ def self_test():
                         f"tpot_ms derivation off: min={min(tpots)} "
                         f"(want 250: req 9 = (2.0-1.0)/4 s) "
                         f"max={max(tpots)} (want 475)")
+
+        # serve-router events round-trip into the router line (the
+        # hand-computed 2-replica fixture: 9 dispatched = 8 arrivals +
+        # 1 requeued re-dispatch, tenant shares 0.75/0.25)
+        with tempfile.TemporaryDirectory() as d:
+            j = J.RunJournal(d, compute_flops=False)
+            j.start()
+            j.event("router.reject", rid="r9", tenant="a",
+                    reason="oversize")
+            j.event("router.requeue", replica=1, reason="exit",
+                    rids=["r3"])
+            j.event("router.scale", direction="up", replica=2,
+                    replicas=3)
+            j.event("router.summary", dispatched=9, requeued=1,
+                    rejected=1, completed=8, replicas=3, scale_ups=1,
+                    scale_downs=0, tenants={"a": 0.75, "b": 0.25},
+                    ttft_p99_ms=123.5)
+            j.close()
+            rsum = router_summary(load_run(d))
+            if rsum is None:
+                failures.append("router events did not round-trip")
+            elif rsum["dispatched"] != 9 or rsum["requeued"] != 1 or \
+                    rsum["requeue_events"] != 1 or \
+                    rsum["scale_events"] != 1 or \
+                    rsum["reject_events"] != 1 or \
+                    rsum["tenants"] != {"a": 0.75, "b": 0.25}:
+                failures.append(f"router_summary columns wrong: {rsum}")
+            else:
+                line = render_router_line(rsum)
+                for want in ("dispatched=9", "requeued=1", "a:0.75",
+                             "ttft_p99=123.5ms"):
+                    if want not in line:
+                        failures.append(
+                            f"router render line lost {want!r}: {line}")
     finally:
         mfu.set_peak_flops(None)
 
@@ -750,7 +817,9 @@ def self_test():
           "AOT warm-start "
           "regressions (and only them), serving request records "
           "round-trip with hand-computed TTFT/TPOT percentile columns, "
-          "and rank-subdir run dirs render the fleet rollup line")
+          "rank-subdir run dirs render the fleet rollup line, and "
+          "serve-router events render the dispatched/requeued/tenant-"
+          "share line")
     return 0
 
 

@@ -145,6 +145,104 @@ def _report(eng, wall_s, n_requests):
     }
 
 
+# -- fleet mode (--replicas N) ------------------------------------------------
+
+
+def run_bench_fleet(n_requests=32, rate=50.0, replicas=2, pages=128,
+                    page_size=8, seed=0, token_budget=512, heads=2,
+                    head_dim=8, vocab=32, keep_router=False,
+                    trace_kw=None, aot_cache_dir=None):
+    """The same open-loop Poisson trace through a ``serving.fleet``
+    Router over N in-process replicas: aggregate p50/p99 TTFT/TPOT
+    across the whole fleet, a per-replica breakdown, and
+    ``router_overhead_ms`` — wall time spent inside the router's
+    dispatch/poll/health decisions (NOT engine compute), the dispatch-
+    layer tax the single-engine bench can't see."""
+    from paddle_tpu.serving.fleet import ReplicaPool, ReplicaSpec, Router
+
+    trace = make_trace(n_requests, rate, seed=seed, vocab=vocab,
+                       **(trace_kw or {}))
+    # an executable cache dir makes replicas 2..N hydrate the buckets
+    # replica 1 compiled (warm=False: lazily, only buckets the trace
+    # actually reaches)
+    spec = ReplicaSpec(vocab_size=vocab, num_heads=heads,
+                       head_dim=head_dim, seed=seed, pages=pages,
+                       page_size=page_size, token_budget=token_budget,
+                       aot_cache_dir=aot_cache_dir, warm=False)
+    pool = ReplicaPool(spec, replicas=replicas, mode="local")
+    router = Router(pool)
+    t_start = time.monotonic()
+    pending = list(trace)
+    rejected = 0
+    router_s = 0.0
+    while True:
+        now = time.monotonic() - t_start
+        while pending and pending[0]["arrival"] <= now:
+            r = pending.pop(0)
+            try:
+                router.submit(r["prompt"],
+                              max_new_tokens=r["max_new_tokens"],
+                              arrival_t=t_start + r["arrival"])
+            except ValueError:
+                rejected += 1
+        if not router.inflight and not router.queue_depth:
+            if not pending:
+                break
+            time.sleep(max(0.0, pending[0]["arrival"] - now))
+            continue
+        t0 = time.perf_counter()
+        router.check_replicas()
+        router.dispatch()
+        router_s += time.perf_counter() - t0
+        pumped = pool.pump()
+        t0 = time.perf_counter()
+        router.poll()
+        router_s += time.perf_counter() - t0
+        if not pumped and not router.inflight and not pending:
+            break  # gridlock: nothing dispatchable, nothing arriving
+    wall = time.monotonic() - t_start
+    rep = _fleet_report(router, wall, n_requests)
+    rep["rejected"] = rejected
+    rep["stuck"] = router.queue_depth
+    rep["router_overhead_ms"] = router_s * 1e3
+    if keep_router:
+        return rep, router
+    router.close()
+    return rep
+
+
+def _fleet_report(router, wall_s, n_requests):
+    fin = [r for r in router.completed if r.state == "FINISHED"]
+    ttft = [(r.first_token_t - r.arrival_t) * 1e3 for r in fin
+            if r.first_token_t is not None]
+    tpot = [(r.finish_t - r.first_token_t) * 1e3 / (len(r.tokens) - 1)
+            for r in fin if len(r.tokens) > 1
+            and r.first_token_t is not None]
+    e2e = [(r.finish_t - r.arrival_t) * 1e3 for r in fin
+           if r.finish_t is not None]
+    tokens = sum(len(r.tokens) for r in fin)
+    st = router.stats()
+    per_replica = {}
+    for r in fin:
+        d = per_replica.setdefault(r.replica_id, {
+            "finished": 0, "tokens": 0, "preemptions": 0,
+            "requeues": 0})
+        d["finished"] += 1
+        d["tokens"] += len(r.tokens)
+        d["preemptions"] += r.preemptions
+        d["requeues"] += r.requeues
+    return {
+        "requests": n_requests, "finished": len(fin),
+        "replicas": st["replicas"], "tokens": tokens, "wall_s": wall_s,
+        "tokens_per_sec": tokens / wall_s if wall_s else None,
+        "ttft_p50_ms": _pctl(ttft, 50), "ttft_p99_ms": _pctl(ttft, 99),
+        "tpot_p50_ms": _pctl(tpot, 50), "tpot_p99_ms": _pctl(tpot, 99),
+        "e2e_p50_ms": _pctl(e2e, 50), "e2e_p99_ms": _pctl(e2e, 99),
+        "dispatched": st["dispatched"], "requeued": st["requeued"],
+        "per_replica": per_replica,
+    }
+
+
 # -- self-test ----------------------------------------------------------------
 
 
@@ -316,12 +414,167 @@ def _test_engine_vs_oracle(failures):
            "should exist) — the assertion went vacuous")
 
 
+def _test_router_trace(failures):
+    """Hand-checked fleet dispatch on a ManualClock: least-outstanding-
+    tokens with lowest-id tie-break, weighted-deficit tenant fairness,
+    and a token-bucket rate limit that holds ONE tenant back without
+    blocking the other."""
+    from paddle_tpu.serving import ManualClock
+    from paddle_tpu.serving.fleet import (ReplicaPool, ReplicaSpec,
+                                          Router, TenantPolicy)
+
+    clock = ManualClock()
+    spec = ReplicaSpec(vocab_size=32, pages=64, page_size=4,
+                       max_seq_len=32, token_budget=128)
+    pool = ReplicaPool(spec, replicas=2, mode="local", clock=clock)
+    router = Router(pool, clock=clock, tenants={
+        "a": TenantPolicy(weight=1.0),
+        "b": TenantPolicy(weight=1.0),
+        "lim": TenantPolicy(weight=1.0, rate=1.0, burst=4.0),
+    })
+    # least-loaded + tie-break: costs 8, 4, 2 -> rep0 (tie: lowest id),
+    # rep1 (0 < 8), rep1 again (4 < 8)
+    for plen, new in ((4, 4), (2, 2), (1, 1)):
+        router.submit([1] * plen, max_new_tokens=new, tenant="a")
+    pairs = router.dispatch()
+    _check(failures, [p[1] for p in pairs] == [0, 1, 1],
+           f"least-outstanding trace {pairs} != replicas [0, 1, 1]")
+    # fairness: a floods 4 x cost-4, b queues 2 x cost-4 — deficit
+    # round-robin must interleave a/b, not serve a's flood first
+    clock.advance(1.0)
+    a = [router.submit([1, 2], max_new_tokens=2, tenant="a",
+                       rid=f"a{i}") for i in range(4)]
+    b = [router.submit([3, 4], max_new_tokens=2, tenant="b",
+                       rid=f"b{i}") for i in range(2)]
+    order = [rid for rid, _ in router.dispatch()]
+    _check(failures, order == ["b0", "b1", "a0", "a1", "a2", "a3"],
+           f"fairness order {order}: b (behind on served tokens) must "
+           "catch up before a's flood continues")
+    # rate limit: burst 4 admits one cost-4 request; the next waits for
+    # the bucket (1 token/s), while an unlimited tenant sails past
+    clock.advance(1.0)
+    router.submit([5, 6], max_new_tokens=2, tenant="lim", rid="l0")
+    router.submit([5, 6], max_new_tokens=2, tenant="lim", rid="l1")
+    router.submit([7, 8], max_new_tokens=2, tenant="a", rid="a4")
+    order = [rid for rid, _ in router.dispatch()]
+    _check(failures, order == ["l0", "a4"],
+           f"rate-limit trace {order} != ['l0', 'a4'] (l1 must wait "
+           "for the bucket, a4 must not be blocked by it)")
+    _check(failures, router.queue_depth == 1,
+           f"l1 should still be queued, depth={router.queue_depth}")
+    clock.advance(4.0)   # bucket refills 4 tokens
+    order = [rid for rid, _ in router.dispatch()]
+    _check(failures, order == ["l1"],
+           f"after refill {order} != ['l1']")
+    # rejection mirrors ServeEngine.submit: oversize at the door
+    try:
+        router.submit(list(range(20)), max_new_tokens=20)
+        _check(failures, False, "oversize request not rejected")
+    except ValueError:
+        pass
+    _check(failures, router.stats()["rejected"] == 1,
+           "rejection not counted in router stats")
+    router.close()
+
+
+def _test_fleet_bench_gates(failures):
+    """A real 2-replica fleet run on CPU: aggregate-percentile gates,
+    per-replica breakdown consistency, oracle-identical tokens, and a
+    LIVE HTTP scrape of the router metrics endpoint matching
+    ``router.stats()`` BITWISE."""
+    import urllib.request
+
+    from paddle_tpu.obs.export import (MetricsExporter,
+                                       parse_prometheus_text)
+    from paddle_tpu.serving import TinyLM
+
+    # short prompts + bounded outputs keep the tier-1 leg to the two
+    # smallest prefill buckets per replica (compile cost, not coverage,
+    # is what the long tail would add here)
+    import shutil
+    import tempfile
+
+    _TRACE_KW = dict(short_frac=1.0, out_len=(4, 10))
+    aot_dir = tempfile.mkdtemp(prefix="pt_serve_bench_aot_")
+    rep, router = run_bench_fleet(n_requests=12, rate=200.0,
+                                  replicas=2, pages=64, page_size=8,
+                                  token_budget=256, keep_router=True,
+                                  trace_kw=_TRACE_KW,
+                                  aot_cache_dir=aot_dir)
+    try:
+        _check(failures, rep["replicas"] == 2,
+               f"fleet bench ran {rep['replicas']} replicas, want 2")
+        _check(failures,
+               rep["finished"] + rep["rejected"] == rep["requests"],
+               f"requests lost: {rep['finished']} finished + "
+               f"{rep['rejected']} rejected != {rep['requests']}")
+        for q in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                  "tpot_p99_ms"):
+            _check(failures, rep[q] is not None and rep[q] > 0.0,
+                   f"aggregate gate {q} missing/non-positive: {rep[q]}")
+        _check(failures, rep["ttft_p99_ms"] >= rep["ttft_p50_ms"],
+               f"p99 {rep['ttft_p99_ms']} < p50 {rep['ttft_p50_ms']}")
+        per = rep["per_replica"]
+        _check(failures,
+               sum(d["finished"] for d in per.values())
+               == rep["finished"] and len(per) == 2,
+               f"per-replica breakdown {per} does not partition "
+               f"{rep['finished']} finished requests over 2 replicas")
+        # oracle identity across the whole fleet (the trace is sized
+        # to reject nothing; a reject would misalign the zip)
+        _check(failures, rep["rejected"] == 0 and rep["finished"] == 12,
+               f"fleet run should finish all 12: {rep['finished']} "
+               f"finished, {rep['rejected']} rejected")
+        model = TinyLM(vocab_size=32, num_heads=2, head_dim=8, seed=0)
+        trace = make_trace(12, 200.0, seed=0, vocab=32, **_TRACE_KW)
+        by_arrival = sorted(router.completed,
+                            key=lambda r: r.arrival_t)
+        if len(by_arrival) == len(trace):
+            for r, t in zip(by_arrival, trace):
+                ref = model.reference_generate(t["prompt"],
+                                               t["max_new_tokens"])
+                _check(failures, r.tokens == ref,
+                       f"{r.rid} (replica {r.replica_id}) tokens != "
+                       "single-engine oracle")
+        # scrapeable router endpoint, gauges == stats bitwise
+        st = router.stats()
+        exp = MetricsExporter(engines=[], router=router)
+        port = exp.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=10) as resp:
+                body = resp.read().decode("utf-8")
+        finally:
+            exp.stop()
+        vals = parse_prometheus_text(body)
+        pre = "paddle_tpu_fleet_router_"
+        for key in ("dispatched", "completed", "requeued", "rejected",
+                    "queue_depth", "replicas"):
+            _check(failures, vals.get(pre + key) == float(st[key]),
+                   f"scraped {key}={vals.get(pre + key)} != router "
+                   f"truth {st[key]} (bitwise gate)")
+        for key in ("ttft_ms", "tpot_ms", "e2e_ms"):
+            if st.get(key):
+                for q in ("p50", "p99"):
+                    skey = pre + key + '{q="' + q + '"}'
+                    _check(
+                        failures, vals.get(skey) == st[key][q],
+                        f"scraped {key} {q} != stats bitwise: "
+                        f"{vals.get(skey)} vs {st[key][q]}")
+    finally:
+        router.close()
+        shutil.rmtree(aot_dir, ignore_errors=True)
+
+
 def self_test():
     _ensure_cpu()
     failures = []
     _test_paged_vs_dense(failures)
     _test_scheduler_trace(failures)
     _test_engine_vs_oracle(failures)
+    _test_router_trace(failures)
+    _test_fleet_bench_gates(failures)
     for line in failures:
         print(f"  FAILED — {line}")
     if failures:
@@ -330,9 +583,13 @@ def self_test():
     print("self-test passed: paged decode matches the dense reference "
           "on ragged page-crossing batches, the hand-checked scheduler "
           "trace holds exactly (budget admission, oldest-protected "
-          "preemption, arrival-order requeue, zero-leak teardown), and "
+          "preemption, arrival-order requeue, zero-leak teardown), "
           "the pressured engine reproduces the dense oracle's tokens "
-          "with manual-clock-exact TTFT")
+          "with manual-clock-exact TTFT, the fleet router's dispatch "
+          "trace is hand-exact (least-outstanding tie-break, tenant "
+          "fairness, rate limits), and a live 2-replica run passes the "
+          "aggregate-percentile gates with the scraped router gauges "
+          "bitwise-equal to router truth")
     return 0
 
 
@@ -345,6 +602,9 @@ def main(argv=None):
     ap.add_argument("--page-size", type=int, default=8)
     ap.add_argument("--token-budget", type=int, default=512)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="N>1 routes the trace through a "
+                         "serving.fleet Router over N replicas")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--self-test", action="store_true",
                     help="deterministic kernel/scheduler/engine checks")
@@ -352,16 +612,26 @@ def main(argv=None):
     if args.self_test:
         return self_test()
     _ensure_cpu()
-    rep = run_bench(n_requests=args.requests, rate=args.rate,
-                    pages=args.pages, page_size=args.page_size,
-                    seed=args.seed, token_budget=args.token_budget)
+    if args.replicas > 1:
+        rep = run_bench_fleet(n_requests=args.requests, rate=args.rate,
+                              replicas=args.replicas, pages=args.pages,
+                              page_size=args.page_size, seed=args.seed,
+                              token_budget=args.token_budget)
+    else:
+        rep = run_bench(n_requests=args.requests, rate=args.rate,
+                        pages=args.pages, page_size=args.page_size,
+                        seed=args.seed, token_budget=args.token_budget)
     if args.json:
         print(json.dumps(rep, sort_keys=True))
     else:
         for k in sorted(rep):
             v = rep[k]
-            print(f"{k:<20} {v:.4g}" if isinstance(v, float)
-                  else f"{k:<20} {v}")
+            if isinstance(v, dict):
+                print(f"{k:<20} {json.dumps(v, sort_keys=True)}")
+            elif isinstance(v, float):
+                print(f"{k:<20} {v:.4g}")
+            else:
+                print(f"{k:<20} {v}")
     return 0
 
 
