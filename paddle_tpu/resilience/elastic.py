@@ -304,12 +304,18 @@ class ReplicaSupervisor:
         self.preemptions = {}  # replica_id -> budget-free relaunches
         self.history = []      # [{replica, kind, code, restarts}]
 
-    def note_failure(self, replica_id, kind="crash", code=None):
+    def note_failure(self, replica_id, kind="crash", code=None,
+                     defer=False):
         """Account one replica failure and SLEEP the backoff before the
         relaunch the caller is about to do. ``kind``: ``crash``/``hang``
         consume that replica's restart budget, ``preempt`` is free.
         Raises :class:`ElasticBudgetError` (with the failure history)
-        when the budget is spent. Returns the backoff slept (s)."""
+        when the budget is spent. Returns the backoff slept (s).
+        ``defer=True`` skips the sleep and just returns the delay — for
+        callers that schedule the relaunch themselves instead of
+        blocking (the fleet pool's health sweep runs on the router's
+        dispatch thread; sleeping there would stall the healthy
+        replicas)."""
         rid = int(replica_id)
         free = kind == "preempt"
         if free:
@@ -337,7 +343,7 @@ class ReplicaSupervisor:
                        failure=kind, code=code,
                        restarts_used=self.restarts.get(rid, 0),
                        backoff_s=round(delay, 4))
-        if delay:
+        if delay and not defer:
             self._sleep(delay)
         return delay
 

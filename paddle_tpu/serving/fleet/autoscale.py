@@ -135,14 +135,23 @@ class Autoscaler:
                 return False
         return True
 
-    def observe(self, signals, replicas=None, now=None):
+    def observe(self, signals, replicas=None, now=None,
+                max_replicas=None):
         """One observation -> ``"up"`` / ``"down"`` / ``None``.
         ``signals`` is a :meth:`signals_from_scrape` dict (or any dict
         with ``queue_depth`` / ``*_p99_ms``); ``replicas`` overrides
-        the scrape-visible replica count with pool truth."""
+        the scrape-visible replica count with pool truth.
+        ``max_replicas`` tightens the up-bound for THIS observation
+        (the router passes the pool's remaining capacity, which counts
+        STARTING/DRAINING replicas and backoff-pending relaunches the
+        active count misses) — clamping inside the decision keeps a
+        can't-scale observation from committing an "up": no cooldown
+        burned, no breach streak reset, no phantom decisions entry."""
         now = self.clock() if now is None else now
         n = int(replicas if replicas is not None
                 else signals.get("replicas", self.min_replicas))
+        cap = self.max_replicas if max_replicas is None \
+            else min(self.max_replicas, int(max_replicas))
         breach = self._breached(signals)
         if breach:
             self._breaches += 1
@@ -157,7 +166,7 @@ class Autoscaler:
                 now - self._last_decision_t < self.cooldown_s:
             return None
         if breach and self._breaches >= self.breach_patience and \
-                n < self.max_replicas:
+                n < cap:
             self._breaches = 0
             self._lows = 0
             self._last_decision_t = now

@@ -141,6 +141,15 @@ def run_drill(root=None, keep=False):
                               arrival_t=t0 + i * 1e-3)
                 for i, (p, m) in enumerate(trace)]
         router.run_until_drained(timeout_s=300.0, sleep_s=0.02)
+        # the victim's relaunch is deferred behind its supervisor
+        # backoff (never slept on the router thread): the survivor can
+        # finish every stranded request before the spawn fires, so
+        # drive the health sweep until it does — the journal
+        # assertions below read the relaunched incarnation's warm
+        spawn_deadline = time.time() + 60.0
+        while pool._pending and time.time() < spawn_deadline:
+            router.check_replicas()
+            time.sleep(0.01)
         stats = router.stats()
         dispatch_trace = list(router.trace)
         # graceful stop BEFORE the journal assertions: the live

@@ -295,6 +295,13 @@ class ProcessReplica(_BaseReplica):
         except Exception:
             pass
 
+    def _mark_ready(self, ev):
+        port = ev.get("metrics_port")
+        if port:
+            self.metrics_url = f"http://127.0.0.1:{port}/metrics"
+        if self.state == STARTING:
+            self.state = READY
+
     def scan_ready(self):
         """Non-blocking readiness check: consume the worker's buffered
         ``ready`` event if it arrived, promoting STARTING -> READY.
@@ -305,11 +312,7 @@ class ProcessReplica(_BaseReplica):
             for ev in list(self._events):
                 if ev.get("t") == "ready":
                     self._events.remove(ev)
-                    port = ev.get("metrics_port")
-                    if port:
-                        self.metrics_url = \
-                            f"http://127.0.0.1:{port}/metrics"
-                    self.state = READY
+                    self._mark_ready(ev)
                     return ev
         return None
 
@@ -359,7 +362,14 @@ class ProcessReplica(_BaseReplica):
             evs, self._events = list(self._events), deque()
         for ev in evs:
             t = ev.get("t")
-            if t == "done":
+            if t == "ready":
+                # a background relaunch's ready line can land between
+                # the health sweep's scan_ready and this poll; it must
+                # promote here too, not vanish with the batch — dropped,
+                # the replica would sit STARTING forever (fresh
+                # heartbeat, so never flagged unhealthy either)
+                self._mark_ready(ev)
+            elif t == "done":
                 if ev.get("rid") in self._ledger:
                     self._ledger.pop(ev["rid"], None)
                     out.append(ev)
@@ -367,6 +377,8 @@ class ProcessReplica(_BaseReplica):
                 self._ledger.pop(ev.get("rid"), None)
             elif t == "drained":
                 self._drained = True
+            # anything else ("stats"/"bye") has no parent-side reader:
+            # poll() is the stream's terminal consumer and drops it
         return out
 
     def health(self, now=None):
@@ -379,6 +391,17 @@ class ProcessReplica(_BaseReplica):
             if self._drained and rc == 0 and not self._ledger:
                 return None
             return "exit"
+        if self.state == STARTING:
+            # the worker beats once at boot and then warms WITHOUT
+            # beating (serve-loop beats start post-ready), so heartbeat
+            # age says nothing here: the whole STARTING window gets the
+            # startup grace, not the steady-state hang timeout — else a
+            # slow warm is SIGKILLed mid-hydration and relaunched in a
+            # loop until the supervisor budget burns out
+            if time.monotonic() - self.spawned_at > \
+                    self.spec.startup_timeout_s:
+                return "hung"
+            return None
         try:
             age = time.time() - os.path.getmtime(self.hb_path)
         except OSError:
@@ -438,6 +461,9 @@ class ReplicaPool:
         self.max_replicas = max_replicas
         self.replicas = []        # live (READY/DRAINING/STARTING)
         self.retired = []
+        # replica_id -> {"attempt", "not_before"}: relaunches waiting
+        # out their supervisor backoff (spawned by the health sweep)
+        self._pending = {}
         self._next_id = 0
         self._hb_dir = None
         if mode == "process":
@@ -494,12 +520,31 @@ class ReplicaPool:
                        pid=getattr(rep, "pid", None))
         return rep
 
-    def scale_up(self):
-        if self.max_replicas is not None and \
-                len(self.active()) >= self.max_replicas:
+    def headroom(self):
+        """Remaining replica slots under ``max_replicas`` (None =
+        unbounded). Live replicas in any non-terminal state — STARTING
+        and DRAINING still hold host capacity — AND backoff-pending
+        relaunches count: a pending relaunch WILL respawn
+        unconditionally, so ignoring it would let a scale-up overshoot
+        the cap."""
+        if self.max_replicas is None:
+            return None
+        live = sum(1 for r in self.replicas
+                   if r.state not in (DEAD, RETIRED))
+        return max(0, self.max_replicas - live - len(self._pending))
+
+    def at_capacity(self):
+        return self.headroom() == 0
+
+    def scale_up(self, wait=True):
+        """Launch one more replica. ``wait=False`` returns a STARTING
+        process replica that warms in the background (the health
+        sweep promotes it) — the autoscaler's mode, so an "up" never
+        stalls the dispatch loop for a whole boot+warm."""
+        if self.at_capacity():
             raise RuntimeError(
                 f"pool already at max_replicas={self.max_replicas}")
-        rep = self._spawn(self._next_id, attempt=0)
+        rep = self._spawn(self._next_id, attempt=0, wait=wait)
         self._next_id += 1
         self.replicas.append(rep)
         return rep
@@ -508,27 +553,51 @@ class ReplicaPool:
         """Replace a DEAD replica (supervisor budget + backoff first —
         raises ``ElasticBudgetError`` when a replica flaps past its
         budget). The new incarnation keeps the replica id, so journals
-        and SLO labels read as one replica's history. Process-mode
-        relaunches return a STARTING replica that warms in the
-        BACKGROUND — the router keeps dispatching to the survivors and
-        the health sweep promotes it to READY when its ``ready`` line
-        lands (a relaunch blocking the dispatch loop for a whole warm
-        would stall the healthy fleet, exactly what replica isolation
-        exists to prevent)."""
+        and SLO labels read as one replica's history. Nothing here
+        blocks the router thread: the backoff is NOT slept (the pool
+        records a not-before time on its clock and a later health
+        sweep does the spawn), and process-mode spawns return a
+        STARTING replica that warms in the BACKGROUND, promoted to
+        READY when its ``ready`` line lands — a relaunch blocking the
+        dispatch loop for a backoff or a warm would stall the healthy
+        fleet, exactly what replica isolation exists to prevent.
+        Returns the fresh replica, or None when the spawn is deferred
+        behind its backoff."""
         kind = "hang" if rep.last_failure == "hung" else "crash"
-        self.supervisor.note_failure(rep.replica_id, kind=kind)
+        delay = self.supervisor.note_failure(rep.replica_id, kind=kind,
+                                             defer=True)
+        if delay > 0:
+            self.replicas = [r for r in self.replicas if r is not rep]
+            self._pending[rep.replica_id] = {
+                "attempt": rep.attempt + 1,
+                "not_before": self.default_clock() + delay}
+            return None
         fresh = self._spawn(rep.replica_id, attempt=rep.attempt + 1,
                             wait=False)
         self.replicas = [fresh if r is rep else r
                          for r in self.replicas]
         return fresh
 
+    def _spawn_pending(self, now):
+        """Launch every backoff-deferred relaunch whose not-before time
+        has passed (pool clock)."""
+        for rid in sorted(self._pending):
+            p = self._pending[rid]
+            if now >= p["not_before"]:
+                del self._pending[rid]
+                fresh = self._spawn(rid, attempt=p["attempt"],
+                                    wait=False)
+                self.replicas.append(fresh)
+
     # -- health --------------------------------------------------------------
     def check_health(self, now=None):
         """Sweep for newly failed replicas: reap exits, SIGKILL stale-
         heartbeat hangs. Marks them DEAD and returns
         ``[(replica, reason)]`` — the router requeues their in-flight
-        requests before asking for a relaunch."""
+        requests before asking for a relaunch. Also launches relaunches
+        whose supervisor backoff just expired."""
+        self._spawn_pending(self.default_clock() if now is None
+                            else now)
         out = []
         for rep in list(self.replicas):
             if rep.state == STARTING and \
@@ -585,6 +654,7 @@ class ReplicaPool:
         _journal_event("fleet.replica_retired", replica=rep.replica_id)
 
     def shutdown(self):
+        self._pending.clear()
         for rep in list(self.replicas):
             if isinstance(rep, ProcessReplica):
                 rep.stop()

@@ -193,7 +193,8 @@ class Router:
         self.autoscale_interval_s = float(autoscale_interval_s)
         self._next_autoscale_t = None
         self._queues = {}      # tenant -> [FleetRequest] arrival order
-        self._buckets = {}     # tenant -> TokenBucket | None
+        self._buckets = {}     # tenant -> ((rate, burst), TokenBucket|None)
+        self._default_policy = TenantPolicy()
         self._served = {}      # tenant -> tokens dispatched
         self._inflight = {}    # rid -> FleetRequest
         self.completed = []    # FINISHED/CANCELLED FleetRequests
@@ -207,7 +208,7 @@ class Router:
 
     # -- intake --------------------------------------------------------------
     def _policy(self, tenant):
-        return self.tenants.get(tenant) or TenantPolicy()
+        return self.tenants.get(tenant) or self._default_policy
 
     def submit(self, prompt, max_new_tokens=16, rid=None, eos_id=None,
                tenant="default", arrival_t=None):
@@ -286,11 +287,44 @@ class Router:
             if not q:
                 continue
             pol = self._policy(tenant)
-            if tenant not in self._buckets:
-                self._buckets[tenant] = pol.bucket(now)
-            bucket = self._buckets[tenant]
-            if bucket is not None and not bucket.peek(q[0].cost, now):
-                continue
+            key = (pol.rate, pol.burst)
+            cached = self._buckets.get(tenant)
+            if cached is None or cached[0] != key:
+                # rebuild on rate/burst CHANGE, not just first sight:
+                # changing a live tenant's limits (new rate/burst,
+                # unlimited <-> rated) must take effect, not serve a
+                # stale bucket forever. Compared against a VALUE
+                # snapshot taken at cache time — catching in-place
+                # policy mutation as well as entry replacement — while
+                # a config reloader rebuilding equal policies each
+                # interval keeps the bucket level (no wiping the
+                # tenant's accumulated rate debt)
+                cached = (key, pol.bucket(now))
+                self._buckets[tenant] = cached
+            bucket = cached[1]
+            if bucket is not None:
+                # a queued request costlier than the bucket's capacity
+                # can NEVER dispatch (the bucket caps at burst). The
+                # submit-time burst guard only saw the policy of its
+                # moment — a live rate-limit change (or a requeue into
+                # a since-tightened tenant) can strand a head that
+                # would gridlock the tenant forever: evict as rejected
+                while q and q[0].cost > bucket.burst:
+                    head = q.pop(0)
+                    head.state = REJECTED
+                    self.rejected += 1
+                    _M_REJECTED.inc()
+                    if _journal.ACTIVE is not None:
+                        _journal.ACTIVE.event(
+                            "router.reject", rid=head.rid,
+                            tenant=tenant,
+                            reason=f"cost {head.cost} > tenant burst "
+                                   f"{bucket.burst:g} (policy changed "
+                                   "after queue)")
+                if not q:
+                    continue
+                if not bucket.peek(q[0].cost, now):
+                    continue
             deficit = self._served.get(tenant, 0.0) / pol.weight
             out.append((deficit, tenant))
         return sorted(out)
@@ -327,7 +361,8 @@ class Router:
                     # (within a tenant, arrival order stays strict)
                     continue
                 self._queues[tenant].pop(0)
-                bucket = self._buckets.get(tenant)
+                cached = self._buckets.get(tenant)
+                bucket = cached[1] if cached else None
                 if bucket is not None:
                     bucket.take(head.cost, now)
                 self._served[tenant] = \
@@ -446,10 +481,19 @@ class Router:
         now = self.clock() if now is None else now
         signals = Autoscaler.signals_from_scrape(self.exposition())
         signals.setdefault("queue_depth", float(self.queue_depth))
+        n = len(self.pool.active())
+        # the pool's own max_replicas can sit BELOW the autoscaler's,
+        # and its capacity counts STARTING/DRAINING replicas and
+        # backoff-pending relaunches that n (accepting only) misses:
+        # clamp INSIDE observe so a can't-scale tick is a clean hold —
+        # no cooldown burned, no breach streak reset — instead of a
+        # crash of the serve loop or a committed phantom "up"
+        headroom = self.pool.headroom()
         decision = self.autoscaler.observe(
-            signals, replicas=len(self.pool.active()), now=now)
+            signals, replicas=n, now=now,
+            max_replicas=None if headroom is None else n + headroom)
         if decision == "up":
-            rep = self.pool.scale_up()
+            rep = self.pool.scale_up(wait=False)
             self.scale_ups += 1
             _M_SCALE_UP.inc()
             _M_REPLICAS.set(len(self.pool.active()))

@@ -428,6 +428,149 @@ class TestFleetObservability:
         router.close()
 
 
+class TestReplicaLifecycleRegressions:
+    """Pins the failure-path fixes: a buffered ``ready`` must survive
+    ``poll()``, a warming replica gets the startup grace (not the
+    steady-state hang timeout), and the supervisor backoff never sleeps
+    the router thread — the spawn defers to a later health sweep."""
+
+    def _bare_process_replica(self, **spec_kw):
+        """ProcessReplica's protocol surface without a live subprocess
+        (white-box: these paths are what the multi-process drill only
+        exercises when the race actually fires)."""
+        import threading
+        from collections import deque
+
+        from paddle_tpu.serving.fleet.pool import ProcessReplica
+
+        rep = ProcessReplica.__new__(ProcessReplica)
+        rep.replica_id, rep.attempt = 1, 1
+        rep.state = "STARTING"
+        rep.last_failure = None
+        rep._ledger = {}
+        rep._events = deque()
+        rep._lock = threading.Lock()
+        rep._drained = False
+        rep.metrics_url = None
+        rep.spec = ReplicaSpec(**spec_kw)
+        return rep
+
+    def test_poll_promotes_buffered_ready(self):
+        # a background relaunch's ready line landing between the health
+        # sweep and poll() must promote STARTING -> READY, not vanish
+        # with the drained batch (stuck-STARTING = silent capacity loss)
+        rep = self._bare_process_replica()
+        rep._events.append({"t": "ready", "metrics_port": 4242})
+        rep._events.append({"t": "stats", "steps": 7})
+        assert rep.poll() == []
+        assert rep.state == "READY"
+        assert rep.metrics_url == "http://127.0.0.1:4242/metrics"
+
+    def test_starting_replica_gets_full_startup_grace(self, tmp_path):
+        import os
+        import time as _time
+
+        rep = self._bare_process_replica(hang_timeout_s=0.01,
+                                         startup_timeout_s=3600.0)
+        hb = tmp_path / "hb.json"
+        hb.write_text("{}")
+        old = _time.time() - 120.0
+        os.utime(hb, (old, old))
+        rep.hb_path = str(hb)
+        rep.spawned_at = _time.monotonic() - 1.0
+
+        class _Alive:
+            def poll(self):
+                return None
+
+        rep.proc = _Alive()
+        # the worker beats once at boot then warms WITHOUT beating: a
+        # stale beat while STARTING is a warm in progress, not a hang
+        assert rep.health() is None
+        rep.state = "READY"   # post-ready, the same staleness IS a hang
+        assert rep.health() == "hung"
+
+    def test_relaunch_backoff_defers_spawn_without_blocking(self):
+        from paddle_tpu.resilience import ReplicaSupervisor
+
+        clock = ManualClock()
+        slept = []
+        pool = ReplicaPool(
+            ReplicaSpec(vocab_size=32, pages=16, page_size=4,
+                        max_seq_len=16, token_budget=64),
+            replicas=2, mode="local", clock=clock, max_replicas=2,
+            supervisor=ReplicaSupervisor(backoff_s=5.0, jitter=0.0,
+                                         sleep=slept.append))
+        router = Router(pool, clock=clock)
+        pool.replicas[1].kill()
+        router.check_replicas()
+        assert slept == []   # the router thread never sleeps a backoff
+        assert [r.replica_id for r in pool.active()] == [0]
+        # the parked relaunch still counts toward the replica cap: a
+        # scale-up during the backoff must not overshoot max_replicas
+        assert pool.at_capacity()
+        with pytest.raises(RuntimeError, match="max_replicas"):
+            pool.scale_up()
+        clock.advance(4.9)
+        router.check_replicas()   # still inside the backoff window
+        assert [r.replica_id for r in pool.active()] == [0]
+        clock.advance(0.2)
+        router.check_replicas()   # not-before passed -> health sweep spawns
+        assert sorted(r.replica_id for r in pool.active()) == [0, 1]
+        fresh = [r for r in pool.active() if r.replica_id == 1][0]
+        assert fresh.attempt == 1
+        router.close()
+
+    def test_autoscale_up_at_pool_capacity_holds(self):
+        from paddle_tpu.resilience import ReplicaSupervisor
+
+        clock = ManualClock()
+        asc = Autoscaler(min_replicas=1, max_replicas=5,
+                         queue_high=1.0, breach_patience=1,
+                         cooldown_s=0.0, clock=clock)
+        pool = ReplicaPool(
+            ReplicaSpec(vocab_size=32, pages=16, page_size=4,
+                        max_seq_len=16, token_budget=64),
+            replicas=1, mode="local", clock=clock, max_replicas=1,
+            supervisor=ReplicaSupervisor(sleep=lambda s: None))
+        router = Router(pool, clock=clock, autoscaler=asc)
+        for i in range(4):
+            router.submit([1, 2], max_new_tokens=2, rid=f"q{i}")
+        # the pool's cap can sit below the autoscaler's: "up" holds
+        assert router.autoscale_tick() is None
+        assert len(pool.active()) == 1 and router.scale_ups == 0
+        router.close()
+
+    def test_live_tenant_policy_update_takes_effect(self):
+        router, pool, clock = _local_fleet()
+        router.submit([1, 2], max_new_tokens=2, tenant="t", rid="t0")
+        assert [r for r, _ in router.dispatch()] == ["t0"]  # unlimited
+        # queued while unlimited (sails past the submit-time guard)...
+        big = router.submit([1] * 4, max_new_tokens=4, tenant="t",
+                            rid="big")            # cost 8
+        # ...then rate-limit the LIVE tenant (unlimited -> rated): the
+        # stale first-sight bucket must not keep serving
+        router.tenants["t"] = TenantPolicy(rate=1.0, burst=4.0)
+        router.submit([1, 2], max_new_tokens=2, tenant="t", rid="t1")
+        router.submit([1, 2], max_new_tokens=2, tenant="t", rid="t2")
+        # big (cost 8 > NEW burst 4) could never dispatch: evicted as
+        # REJECTED, not left to gridlock the tenant queue forever
+        assert [r for r, _ in router.dispatch()] == ["t1"]
+        assert big.state == "REJECTED"
+        assert router.stats()["rejected"] == 1
+        assert router.queue_depth == 1    # t2 waits on the NEW bucket
+        clock.advance(4.1)
+        assert [r for r, _ in router.dispatch()] == ["t2"]
+        # IN-PLACE mutation of the live policy object must apply too
+        # (the cache compares a value snapshot, not the instance)
+        router.tenants["t"].rate = 8.0
+        router.tenants["t"].burst = 8.0
+        router.submit([1, 2], max_new_tokens=2, tenant="t", rid="t4")
+        router.submit([1, 2], max_new_tokens=2, tenant="t", rid="t5")
+        assert [r for r, _ in router.dispatch()] == ["t4", "t5"]
+        router.close()
+
+
 class TestMultiProcessDrill:
     def test_replica_kill_drill_end_to_end(self):
         """The acceptance drill (cached per process, shared with
