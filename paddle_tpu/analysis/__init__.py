@@ -21,6 +21,10 @@ Layers:
 - ``passes``       — identity forwarding, dead-op elimination, CSE
 - ``lint``         — API-smell warnings (unused feeds, stale fetches,
                      unconsumed constants)
+- ``concurrency``  — host-runtime concurrency lint over the package's own
+                     Python source (PTC001 lock-order inversions, PTC002
+                     blocking-under-lock, PTC003 unguarded cross-thread
+                     writes); the static half of ``obs.lockdep``
 
 ``run_compile_passes`` is the Executor's single entry point: verify
 always, optimize behind ``optimize_level``.
@@ -35,6 +39,7 @@ from .verifier import VerifierPass, verify_program
 from .passes import (CSEPass, DeadOpEliminationPass, ForwardIdentityPass,
                      default_optimize_passes)
 from .lint import LintPass, lint_program
+from . import concurrency
 from . import dataflow
 from . import memory
 from .memory import (MemoryEstimate, estimate_entry, memory_report,
@@ -46,6 +51,7 @@ __all__ = [
     "normalize_fetch", "VerifierPass", "verify_program",
     "ForwardIdentityPass", "DeadOpEliminationPass", "CSEPass",
     "default_optimize_passes", "LintPass", "lint_program",
+    "concurrency",
     "run_compile_passes", "dataflow", "memory", "MemoryEstimate",
     "estimate_entry", "memory_report", "remat_candidates",
 ]

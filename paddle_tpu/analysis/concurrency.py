@@ -1,0 +1,556 @@
+"""Static concurrency lint: lock order, blocking-under-lock, shared state.
+
+The Program-IR side of ``paddle_tpu.analysis`` checks graphs; this
+module checks the *host runtime's own Python source* — the threaded
+side (router/pool reader threads, serve engine step lock, DataLoader
+workers, async checkpoint writer) where the PR-14/15 review cycle
+burned a full round on exactly three bug shapes. It is an AST lint
+(no imports, no execution: linting a file can never deadlock), the
+static half of the ``obs.lockdep`` runtime validator:
+
+==========  =========  =====================================================
+code        severity   meaning
+==========  =========  =====================================================
+PTC001      error      inconsistent lock-acquisition order: two lock
+                       classes are taken A-then-B on one path and
+                       B-then-A on another — the deadlock precondition
+PTC002      error      blocking call under a held lock (``time.sleep``,
+                       ``Thread.join``, ``Popen.wait``/``communicate``,
+                       ``urlopen``/HTTP scrape, untimed ``queue.get``):
+                       the PR-15 router-stall class
+PTC003      warning    attribute written both from a spawned-thread
+                       target and from a public method with no shared
+                       lock in scope on at least one side
+==========  =========  =====================================================
+
+The model: per module, every lock *token* is either ``self.<attr>``
+where ``<attr>`` was assigned a ``threading.Lock()``/``RLock()``/
+``Condition()`` (or an ``obs.lockdep`` factory) anywhere in the class
+— giving the token ``ClassName.<attr>`` — or a module-level name bound
+the same way. Each function is walked in statement order with the held
+set live (``with`` blocks scope it exactly; bare ``acquire()`` holds
+until a matching ``release()`` or function end), recording acquisition
+pairs, blocking calls under a non-empty held set, and (one level deep)
+locks acquired by ``self.method()`` calls made while holding.
+
+Deliberate non-goals that bound the false-positive rate: same-token
+nesting is not an ordering edge, ``cond.wait()`` on the HELD lock
+token is legal (it releases while waiting — that is what Conditions
+are for), ``"sep".join(x)`` / ``dict.get(k)`` are not ``Thread.join``
+/ ``queue.get`` (arity + receiver heuristics below), and a finding on
+a line whose comment carries ``lockdep: waive`` or ``noqa: PTC00x``
+is reported but ``waived`` — the CLI gate counts only unwaived
+PTC001/PTC002.
+
+Lock-ordering contract this lint (and the runtime validator) enforce
+in-tree: **router → pool → replica** on the fleet control plane and
+**engine.step → scheduler → cache** inside a replica; the journal and
+metrics locks are leaves (nothing may be acquired under them).
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+__all__ = ["Finding", "lint_source", "lint_file", "lint_tree",
+           "gate_findings", "BLOCKING_NAMES"]
+
+# direct-call names that block (module function style: time.sleep,
+# urllib.request.urlopen, subprocess.check_output / run)
+BLOCKING_NAMES = ("sleep", "urlopen", "check_output", "check_call")
+# attribute-call names that block, with disambiguation handled in
+# _blocking_reason: wait/communicate (Popen/Event), join (Thread — not
+# str.join), get (queue — not dict.get)
+_BLOCKING_ATTRS = ("sleep", "urlopen", "check_output", "check_call",
+                   "wait", "communicate", "join", "get")
+
+_WAIVE_MARKERS = ("lockdep: waive", "lockdep:waive")
+
+_LOCK_FACTORY_SUFFIXES = ("Lock", "RLock", "Condition", "Semaphore",
+                          "BoundedSemaphore")
+
+
+class Finding:
+    """One lint finding with source provenance."""
+
+    __slots__ = ("code", "severity", "message", "file", "line", "cls",
+                 "func", "locks", "waived")
+
+    def __init__(self, code, severity, message, file, line, cls=None,
+                 func=None, locks=(), waived=False):
+        self.code = code
+        self.severity = severity
+        self.message = message
+        self.file = file
+        self.line = line
+        self.cls = cls
+        self.func = func
+        self.locks = tuple(locks)
+        self.waived = bool(waived)
+
+    def as_dict(self):
+        return {"code": self.code, "severity": self.severity,
+                "message": self.message, "file": self.file,
+                "line": self.line, "class": self.cls, "func": self.func,
+                "locks": list(self.locks), "waived": self.waived}
+
+    def __repr__(self):
+        w = " (waived)" if self.waived else ""
+        where = f"{self.file}:{self.line}"
+        ctx = ".".join(x for x in (self.cls, self.func) if x)
+        return f"[{self.code}]{w} {where} {ctx}: {self.message}"
+
+
+def _is_lock_factory(call):
+    """Does this Call construct a lock? ``threading.Lock()``,
+    ``Lock()``, ``lockdep.lock("x")`` / ``.rlock("x")`` all count."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in ("lock", "rlock") and isinstance(fn.value, ast.Name) \
+                and "lockdep" in fn.value.id:
+            return True
+        return fn.attr.endswith(_LOCK_FACTORY_SUFFIXES)
+    if isinstance(fn, ast.Name):
+        if fn.id in ("lock", "rlock"):
+            return False  # bare helpers: too ambiguous without import info
+        return fn.id.endswith(_LOCK_FACTORY_SUFFIXES)
+    return False
+
+
+def _dotted(node):
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _ClassModel:
+    def __init__(self, name):
+        self.name = name
+        self.lock_attrs = set()      # attr names holding locks
+        self.thread_targets = set()  # method names used as Thread targets
+        self.methods = {}            # name -> _FuncModel
+
+
+class _FuncModel:
+    def __init__(self, name, node, cls=None):
+        self.name = name
+        self.node = node
+        self.cls = cls
+        self.pairs = []        # (held_token, acquired_token, line)
+        self.first_locks = []  # (token, line) acquired with nothing held
+        self.blocking = []     # (line, what, held_tokens)
+        self.calls_holding = []  # (method_name, held_tokens, line)
+        self.writes = []       # (attr, line, held_tokens)
+
+
+def _collect_locks(tree):
+    """First pass: module-level lock names + per-class lock attrs +
+    thread-target methods."""
+    module_locks = set()
+    classes = {}
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = getattr(node, "value", None)
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if isinstance(value, ast.Call) and _is_lock_factory(value):
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        module_locks.add(t.id)
+        elif isinstance(node, ast.ClassDef):
+            cm = classes.setdefault(node.name, _ClassModel(node.name))
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and \
+                        isinstance(sub.value, ast.Call) and \
+                        _is_lock_factory(sub.value):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            cm.lock_attrs.add(t.attr)
+                if isinstance(sub, ast.Call):
+                    fd = _dotted(sub.func) or ""
+                    if fd.endswith("Thread"):
+                        for kw in sub.keywords:
+                            if kw.arg == "target" and \
+                                    isinstance(kw.value, ast.Attribute) \
+                                    and isinstance(kw.value.value,
+                                                   ast.Name) \
+                                    and kw.value.value.id == "self":
+                                cm.thread_targets.add(kw.value.attr)
+    return module_locks, classes
+
+
+def _lock_token(node, cls_model, module_locks):
+    """Resolve an expression to a lock token, or None. ``self._lock``
+    -> ``Cls._lock``; a module-level lock name -> that name."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self" \
+            and cls_model is not None and node.attr in cls_model.lock_attrs:
+        return f"{cls_model.name}.{node.attr}"
+    if isinstance(node, ast.Name) and node.id in module_locks:
+        return node.id
+    return None
+
+
+def _blocking_reason(call, held, cls_model, module_locks):
+    """Name the blocking operation in ``call``, or None if benign."""
+    fn = call.func
+    has_timeout = any(kw.arg in ("timeout", "timeout_s") and not (
+        isinstance(kw.value, ast.Constant) and kw.value.value is None)
+        for kw in call.keywords)
+    nonblocking = any(
+        kw.arg == "block" and isinstance(kw.value, ast.Constant)
+        and kw.value.value is False for kw in call.keywords)
+    if isinstance(fn, ast.Name):
+        if fn.id in BLOCKING_NAMES:
+            return fn.id
+        return None
+    if not isinstance(fn, ast.Attribute):
+        return None
+    name = fn.attr
+    if name not in _BLOCKING_ATTRS:
+        return None
+    recv = fn.value
+    recv_dotted = _dotted(recv) or ""
+    if name == "sleep":
+        return "time.sleep" if recv_dotted in ("time", "_time") \
+            else f"{recv_dotted}.sleep"
+    if name in ("urlopen", "check_output", "check_call"):
+        return f"{recv_dotted}.{name}"
+    if name == "communicate":
+        return f"{recv_dotted}.communicate"
+    if name == "wait":
+        # cond.wait() on the HELD lock is the condition-variable
+        # pattern (it releases while waiting) — only flag waits on
+        # something NOT currently held, and only untimed ones
+        tok = _lock_token(recv, cls_model, module_locks)
+        if tok is not None and tok in held:
+            return None
+        if has_timeout or (call.args and not (
+                isinstance(call.args[0], ast.Constant)
+                and call.args[0].value is None)):
+            return None  # bounded wait: a stall, not a deadlock arm
+        if isinstance(recv, ast.Constant):
+            return None
+        return f"{recv_dotted or '<expr>'}.wait"
+    if name == "join":
+        # str.join takes exactly one positional (the iterable);
+        # Thread/Process.join takes zero positionals (+ optional
+        # timeout kwarg). ''.join(...) and os.path.join(...) are the
+        # common benign shapes — require zero positionals.
+        if call.args:
+            return None
+        if isinstance(recv, ast.Constant):
+            return None
+        if has_timeout:
+            return None
+        return f"{recv_dotted or '<expr>'}.join"
+    if name == "get":
+        # dict.get(k[, d]) carries positionals; queue.get() blocks with
+        # none. Require a queue-ish receiver name to keep arbitrary
+        # zero-arg .get() wrappers out.
+        if call.args or nonblocking or has_timeout:
+            return None
+        leaf = recv_dotted.rsplit(".", 1)[-1].lower()
+        if leaf in ("q", "queue") or leaf.endswith(("_q", "_queue",
+                                                    "queue")):
+            return f"{recv_dotted}.get (untimed)"
+        return None
+    return None
+
+
+class _FuncWalker:
+    """Walks one function's statements in order, tracking the held-lock
+    list (a stack of tokens)."""
+
+    def __init__(self, model, cls_model, module_locks):
+        self.m = model
+        self.cls = cls_model
+        self.module_locks = module_locks
+        self.held = []
+
+    def walk(self):
+        self._body(self.m.node.body)
+
+    # -- statement dispatch --------------------------------------------------
+    def _body(self, stmts):
+        for st in stmts:
+            self._stmt(st)
+
+    def _stmt(self, st):
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in st.items:
+                self._expr(item.context_expr)
+                tok = _lock_token(item.context_expr, self.cls,
+                                  self.module_locks)
+                if tok is not None:
+                    self._acquire(tok, item.context_expr.lineno)
+                    self.held.append(tok)
+                    pushed += 1
+            self._body(st.body)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # nested defs run later, under their own discipline
+        if isinstance(st, (ast.If, ast.For, ast.AsyncFor, ast.While)):
+            for f in ast.iter_fields(st):
+                pass
+            self._expr(getattr(st, "test", None) or
+                       getattr(st, "iter", None))
+            self._body(st.body)
+            self._body(st.orelse)
+            return
+        if isinstance(st, ast.Try):
+            self._body(st.body)
+            for h in st.handlers:
+                self._body(h.body)
+            self._body(st.orelse)
+            self._body(st.finalbody)
+            return
+        if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = st.targets if isinstance(st, ast.Assign) \
+                else [st.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    self.m.writes.append((t.attr, st.lineno,
+                                          tuple(self.held)))
+            self._expr(getattr(st, "value", None))
+            return
+        # generic statement: scan contained expressions
+        for child in ast.iter_child_nodes(st):
+            self._expr(child)
+
+    # -- expression scan (calls + acquire/release) ---------------------------
+    def _expr(self, node):
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in ("acquire", "release"):
+                tok = _lock_token(fn.value, self.cls, self.module_locks)
+                if tok is not None:
+                    if fn.attr == "acquire":
+                        self._acquire(tok, sub.lineno)
+                        self.held.append(tok)
+                    elif tok in self.held:
+                        self.held.remove(tok)
+                    continue
+            what = _blocking_reason(sub, self.held, self.cls,
+                                    self.module_locks)
+            if what is not None and self.held:
+                self.m.blocking.append((sub.lineno, what,
+                                        tuple(self.held)))
+            if isinstance(fn, ast.Attribute) and \
+                    isinstance(fn.value, ast.Name) and \
+                    fn.value.id == "self" and self.held:
+                self.m.calls_holding.append((fn.attr, tuple(self.held),
+                                             sub.lineno))
+
+    def _acquire(self, tok, line):
+        if not self.held:
+            self.m.first_locks.append((tok, line))
+        for h in self.held:
+            if h != tok:
+                self.m.pairs.append((h, tok, line))
+
+
+def _analyze_module(tree, filename):
+    module_locks, classes = _collect_locks(tree)
+    funcs = []
+
+    def visit_func(node, cls_model):
+        fm = _FuncModel(node.name, node, cls=cls_model.name
+                        if cls_model else None)
+        if cls_model is not None:
+            cls_model.methods[node.name] = fm
+        _FuncWalker(fm, cls_model, module_locks).walk()
+        funcs.append(fm)
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            visit_func(node, None)
+        elif isinstance(node, ast.ClassDef):
+            cm = classes[node.name]
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    visit_func(sub, cm)
+    return module_locks, classes, funcs
+
+
+def _expand_call_pairs(classes, funcs):
+    """One-level interprocedural expansion: ``self.m()`` made while
+    holding L contributes (L, first-lock-of-m) ordering pairs."""
+    out = []
+    for fm in funcs:
+        if fm.cls is None:
+            continue
+        cm = classes.get(fm.cls)
+        if cm is None:
+            continue
+        for name, held, line in fm.calls_holding:
+            callee = cm.methods.get(name)
+            if callee is None:
+                continue
+            for tok, _ in callee.first_locks:
+                for h in held:
+                    if h != tok:
+                        out.append((h, tok, line, fm, callee))
+    return out
+
+
+def lint_source(src, filename="<string>"):
+    """Lint one module's source text; returns a list of Findings."""
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as e:
+        return [Finding("PTC000", "warning",
+                        f"unparseable: {e}", filename,
+                        getattr(e, "lineno", 0) or 0)]
+    lines = src.splitlines()
+
+    def waived(line_no, code):
+        idx = line_no - 1
+        if not 0 <= idx < len(lines):
+            return False
+        text = lines[idx]
+        if "#" not in text:
+            return False
+        comment = text[text.index("#"):].lower()
+        if any(m in comment for m in _WAIVE_MARKERS):
+            return True
+        return "noqa" in comment and code.lower() in comment
+
+    module_locks, classes, funcs = _analyze_module(tree, filename)
+    findings = []
+
+    # PTC002: blocking call under a held lock
+    for fm in funcs:
+        for line, what, held in fm.blocking:
+            findings.append(Finding(
+                "PTC002", "error",
+                f"blocking call {what} while holding "
+                f"{', '.join(held)} — move it outside the critical "
+                "section (or bound it with a timeout)",
+                filename, line, cls=fm.cls, func=fm.name, locks=held,
+                waived=waived(line, "PTC002")))
+
+    # PTC001: inconsistent acquisition order across the module
+    pair_sites = {}   # (a, b) -> (line, func-label)
+    for fm in funcs:
+        label = ".".join(x for x in (fm.cls, fm.name) if x)
+        for a, b, line in fm.pairs:
+            pair_sites.setdefault((a, b), (line, label))
+    for a, b, line, fm, callee in _expand_call_pairs(classes, funcs):
+        label = (".".join(x for x in (fm.cls, fm.name) if x)
+                 + f" -> {callee.name}()")
+        pair_sites.setdefault((a, b), (line, label))
+    reported = set()
+    for (a, b), (line, label) in sorted(pair_sites.items(),
+                                        key=lambda kv: kv[1][0]):
+        if (b, a) not in pair_sites or frozenset((a, b)) in reported:
+            continue
+        reported.add(frozenset((a, b)))
+        rline, rlabel = pair_sites[(b, a)]
+        findings.append(Finding(
+            "PTC001", "error",
+            f"inconsistent lock order: {a} -> {b} here but "
+            f"{b} -> {a} at line {rline} ({rlabel}) — pick one order "
+            "and document it",
+            filename, line, func=label, locks=(a, b),
+            waived=waived(line, "PTC001") or waived(rline, "PTC001")))
+
+    # PTC003: attr written from a thread target AND a public method,
+    # with an unguarded write on at least one side
+    for cm in classes.values():
+        if not cm.thread_targets:
+            continue
+        entry = set(cm.thread_targets)
+        # one level of self-call closure from the thread entries
+        for name in list(entry):
+            fm = cm.methods.get(name)
+            if fm is not None:
+                entry.update(n for n, _, _ in fm.calls_holding
+                             if n in cm.methods)
+                for sub in ast.walk(fm.node):
+                    if isinstance(sub, ast.Call) and \
+                            isinstance(sub.func, ast.Attribute) and \
+                            isinstance(sub.func.value, ast.Name) and \
+                            sub.func.value.id == "self" and \
+                            sub.func.attr in cm.methods:
+                        entry.add(sub.func.attr)
+        thread_writes = {}   # attr -> (line, guarded?)
+        public_writes = {}
+        for name, fm in cm.methods.items():
+            for attr, line, held in fm.writes:
+                if attr in cm.lock_attrs:
+                    continue
+                rec = (line, bool(held))
+                if name in entry:
+                    thread_writes.setdefault(attr, rec)
+                elif not name.startswith("_"):
+                    public_writes.setdefault(attr, rec)
+        for attr in sorted(set(thread_writes) & set(public_writes)):
+            tl, tg = thread_writes[attr]
+            pl, pg = public_writes[attr]
+            if tg and pg:
+                continue  # both sides wrote under SOME lock
+            findings.append(Finding(
+                "PTC003", "warning",
+                f"self.{attr} written from thread target (line {tl}"
+                f"{'' if tg else ', unguarded'}) and public method "
+                f"(line {pl}{'' if pg else ', unguarded'}) without a "
+                "shared lock in scope — guard both sides or make the "
+                "handoff explicit",
+                filename, min(tl, pl), cls=cm.name, locks=(),
+                waived=waived(tl, "PTC003") or waived(pl, "PTC003")))
+
+    findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings
+
+
+def lint_file(path):
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, filename=path)
+
+
+def lint_tree(root, skip=("fluid",)):
+    """Lint every ``*.py`` under ``root`` (recursively); ``skip`` names
+    top-level subpackages excluded from the sweep (the fluid compat
+    layer is single-threaded API surface, not host-runtime code)."""
+    findings = []
+    root = os.path.abspath(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel = os.path.relpath(dirpath, root)
+        top = rel.split(os.sep)[0]
+        if top in skip:
+            dirnames[:] = []
+            continue
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d not in ("__pycache__",)]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                findings.extend(lint_file(os.path.join(dirpath, fn)))
+    return findings
+
+
+def gate_findings(findings, codes=("PTC001", "PTC002")):
+    """The CI gate's view: unwaived findings whose code is in
+    ``codes`` (PTC003 is advisory — it warns, it does not fail)."""
+    return [f for f in findings if f.code in codes and not f.waived]

@@ -33,15 +33,42 @@ PTL102      warning    fetch of a stale Variable handle (other Program / _stale)
 PTL103      warning    captured constant never consumed
 PTL104      warning    remat candidate: a long-lived, cheap-to-recompute
                        activation holds up the peak-HBM high-water mark
+PTC001      error      inconsistent lock-acquisition order across methods
+                       (A->B on one path, B->A on another: deadlock
+                       precondition) — ``analysis/concurrency.py``
+PTC002      error      blocking call (sleep / Thread.join / Popen.wait /
+                       urlopen / untimed queue.get) under a held lock
+PTC003      warning    attribute written from both a spawned-thread target
+                       and a public method without a shared lock in scope
+PTC004      error      runtime lock-order cycle witnessed by the lockdep
+                       validator (``obs/lockdep.py``, both stacks attached)
 ==========  =========  =====================================================
 """
 from __future__ import annotations
 
 __all__ = ["Diagnostic", "DiagnosticReport", "ProgramVerificationError",
-           "ERROR", "WARNING"]
+           "ERROR", "WARNING", "CONCURRENCY_CODES"]
 
 ERROR = "error"
 WARNING = "warning"
+
+# PTC00x remediation hints, keyed by code — the concurrency lint
+# (static: PTC001-003) and the lockdep runtime (PTC004) print these
+# next to findings; tools/lint_concurrency.py renders them in reports.
+CONCURRENCY_CODES = {
+    "PTC001": (ERROR, "pick ONE acquisition order for the two locks, "
+               "document it in the module docstring, and restructure the "
+               "minority path (or split the critical section)"),
+    "PTC002": (ERROR, "move the blocking call outside the critical "
+               "section — snapshot state under the lock, block after "
+               "release — or bound it with a timeout"),
+    "PTC003": (WARNING, "guard both the thread-target write and the "
+               "public-method write with one shared lock, or hand the "
+               "value across via a queue/Event instead of an attribute"),
+    "PTC004": (ERROR, "a runtime acquisition closed an order cycle: fix "
+               "the minority ordering shown in the witness stacks, then "
+               "re-run the drill under PADDLE_TPU_LOCKDEP=1"),
+}
 
 
 class Diagnostic:

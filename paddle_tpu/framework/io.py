@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..obs import journal as _journal
+from ..obs import lockdep as _lockdep
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..resilience import inject as _chaos
@@ -336,7 +337,9 @@ class AsyncCheckpoint:
         return self.path
 
 
-_ASYNC_LOCK = threading.Lock()
+# held only around _ASYNC_PENDING handoff — wait_checkpoints() blocks
+# on handle.result() strictly AFTER releasing (lockdep-checked)
+_ASYNC_LOCK = _lockdep.lock("checkpoint.async_barrier")
 _ASYNC_PENDING = None  # at most ONE async save is ever in flight
 
 

@@ -18,6 +18,7 @@ import time
 import numpy as np
 
 from ..obs import journal as _journal
+from ..obs import lockdep as _lockdep
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..resilience import inject as _chaos
@@ -87,12 +88,14 @@ class _Prefetcher:
         self._stash = {}
         self._cursor = 0
         self._retry: list = []  # indices abandoned by crashed workers
-        self._cursor_lock = threading.Lock()
+        # lock order in this class: active -> cursor (_crashed nests
+        # them that way; lockdep-checked under PADDLE_TPU_LOCKDEP)
+        self._cursor_lock = _lockdep.lock("dataloader.cursor")
         self._restarts_left = int(max_restarts)
         self.restarts = 0  # observability: how many crashes were absorbed
         self._threads = []
         self._active = num_workers
-        self._active_lock = threading.Lock()
+        self._active_lock = _lockdep.lock("dataloader.active")
         for _ in range(num_workers):
             # start each thread as it is created: a crashed worker may
             # append its replacement to _threads concurrently, and a

@@ -33,6 +33,12 @@ plus the production metrics layer the reference keeps in VLOG counters:
   straggler/hang attribution, merged request percentiles, merged
   Chrome traces with pid=rank lanes (``tools/fleet_report.py`` is the
   CLI).
+- ``lockdep``  — opt-in runtime lock-order validation (env
+  ``PADDLE_TPU_LOCKDEP``): instrumented ``lock(name)``/``rlock(name)``
+  factories feed a process-wide acquisition-order graph; the first
+  cycle raises/journals a PTC004 with both witness stacks, and
+  ``lockdep.held_ms.<name>`` histograms land in the registry. The
+  runtime half of ``analysis.concurrency``'s static lint.
 - ``export``   — live SLO signal plane: the registry + per-replica
   serving SLOs + per-rank heartbeat ages as Prometheus text over a
   localhost HTTP endpoint (``MetricsExporter``) or an atomic
@@ -70,6 +76,7 @@ from __future__ import annotations
 
 import os as _os
 
+from . import lockdep  # noqa: F401  (first: others build locks through it)
 from . import metrics, trace, report, anomaly, mfu, journal, spmd  # noqa: F401,E501
 from . import fleet, export  # noqa: F401
 from .metrics import (counter, gauge, histogram, snapshot, reset,  # noqa: F401
@@ -82,7 +89,7 @@ from .export import MetricsExporter  # noqa: F401
 
 __all__ = [
     "metrics", "trace", "report", "anomaly", "mfu", "journal", "spmd",
-    "fleet", "export",
+    "fleet", "export", "lockdep",
     "counter", "gauge", "histogram", "snapshot", "reset",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "span", "enable_tracing", "disable_tracing", "tracing_enabled",

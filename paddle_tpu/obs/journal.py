@@ -47,6 +47,7 @@ import threading
 import time
 from collections import deque
 
+from . import lockdep as _lockdep
 from . import metrics as _metrics
 from . import trace as _trace
 from .anomaly import AnomalyEngine, default_detectors
@@ -220,7 +221,10 @@ class RunJournal:
                 "PADDLE_TPU_JOURNAL_FLOPS", "").lower() not in \
                 ("0", "false", "off")
         self.compute_flops = bool(compute_flops)
-        self._lock = threading.RLock()
+        # leaf lock: record/event paths are called from under the
+        # scheduler/engine/prefetcher locks, so nothing may be
+        # acquired while THIS is held (lockdep enforces it)
+        self._lock = _lockdep.rlock("obs.journal")
         self._buf = []
         self._file = None
         self._bytes = 0

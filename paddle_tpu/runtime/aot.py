@@ -60,6 +60,8 @@ import struct
 import threading
 import time
 
+from ..obs import lockdep as _lockdep
+
 __all__ = [
     "AOTCache", "configure", "configured", "active_cache",
     "resolve_cache", "fingerprint", "fingerprint_digest",
@@ -82,7 +84,7 @@ _DISABLED = object()      # configure-level mask over the env fallback
 _ACTIVE = [None]          # configure()'d cache, None (defer to env),
                           # or _DISABLED (force-off, env masked too)
 _BY_DIR = {}              # dir -> AOTCache (per-instance caches share)
-_LOCK = threading.Lock()
+_LOCK = _lockdep.lock("aot.registry")
 
 
 def fingerprint():
@@ -203,7 +205,7 @@ class AOTCache:
         self.misses = 0
         self.stores = 0
         self.rejects = 0   # present-but-refused entries (stale/torn)
-        self._lock = threading.Lock()
+        self._lock = _lockdep.lock("aot.cache")
 
     # -- keys -----------------------------------------------------------------
     def key_for(self, lowered, kind, extra=""):

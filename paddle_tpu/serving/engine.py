@@ -46,6 +46,7 @@ import weakref
 import numpy as np
 
 from ..obs import journal as _journal
+from ..obs import lockdep as _lockdep
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..resilience import inject as _inject
@@ -61,7 +62,7 @@ __all__ = ["ServeEngine", "TinyLM", "live_engines"]
 # no explicit engine list) discovers every live replica in the process
 # without any wiring. Weak by design — the registry must never keep a
 # replaced replica (and its donated KV pools) alive.
-_ENGINES_LOCK = threading.Lock()
+_ENGINES_LOCK = _lockdep.lock("serving.engines")
 _ENGINES: list = []
 _REPLICA_IDS = itertools.count()
 
@@ -248,7 +249,9 @@ class ServeEngine:
         # serializes step() against cancel(): a cancel landing while
         # its request is inside the current batch must wait for the
         # step boundary, or the freed rid KeyErrors the batch build
-        self._step_lock = threading.RLock()
+        # Lock order inside a replica: engine.step -> scheduler ->
+        # cache (lockdep-checked under PADDLE_TPU_LOCKDEP)
+        self._step_lock = _lockdep.rlock("serving.engine.step")
         # SLO-export identity: stable per process, rides the exporter's
         # replica="N" label so multi-replica scrapes stay attributable.
         # A fleet launcher passes the FLEET-assigned id instead — the

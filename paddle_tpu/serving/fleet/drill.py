@@ -80,6 +80,27 @@ def _relaunch_compiles(rank_dir):
     return via, len(segments) - 1
 
 
+def _scan_lockdep_cycles(run_dir):
+    """Every ``lockdep.cycle`` event journaled under ``run_dir`` (any
+    rank / the router journal) — the worker-side PTC004 witness."""
+    cycles = []
+    for dirpath, _dirnames, filenames in os.walk(run_dir):
+        for fn in filenames:
+            if not fn.endswith(".jsonl"):
+                continue
+            with open(os.path.join(dirpath, fn),
+                      encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail from the kill
+                    if rec.get("t") == "event" and \
+                            rec.get("kind") == "lockdep.cycle":
+                        cycles.append(rec.get("cycle"))
+    return cycles
+
+
 def run_drill(root=None, keep=False):
     """Run the 2-replica kill drill; returns the result dict (with a
     ``failures`` list — empty on success)."""
@@ -87,6 +108,8 @@ def run_drill(root=None, keep=False):
     from ...obs import journal as _journal
     from .pool import ReplicaPool, ReplicaSpec
     from .router import Router
+
+    from ...obs import lockdep as _lockdep
 
     failures = []
     own_root = root is None
@@ -109,7 +132,12 @@ def run_drill(root=None, keep=False):
              # assertion's compile-event stream
              "PADDLE_TPU_JOURNAL_FLOPS": "0",
              "PADDLE_TPU_TRACE": "",
-             "PADDLE_TPU_CHAOS": ""},
+             "PADDLE_TPU_CHAOS": "",
+             # every worker runs the lockdep runtime in raise mode: a
+             # lock-order cycle anywhere in the serve loop crashes the
+             # replica, strands its requests, and fails the drill —
+             # the acceptance gate for the PTC004 class
+             "PADDLE_TPU_LOCKDEP": "1"},
         env_for_replica=lambda rid, attempt: (
             {"PADDLE_TPU_CHAOS":
              f"replica_kill:at={KILL_STEP},rank={VICTIM}"}
@@ -121,6 +149,15 @@ def run_drill(root=None, keep=False):
     oracle = [model.reference_generate(p, m) for p, m in trace]
 
     from ...resilience.elastic import ReplicaSupervisor
+
+    # lockdep on the PARENT side too (scoped): the router journal's and
+    # each ProcessReplica's locks are constructed below, so they come
+    # out instrumented; the router thread's consume path and the reader
+    # threads' produce path both feed the order graph. Raise mode — a
+    # cycle aborts the drill into `failures`.
+    prev_lockdep = _lockdep.mode()
+    _lockdep.enable(_lockdep.MODE_RAISE)
+    lockdep_before = len(_lockdep.violations())
 
     prev_active = _journal.ACTIVE
     router_journal = _journal.RunJournal(
@@ -200,6 +237,21 @@ def run_drill(root=None, keep=False):
                 f"relaunched replica hydrated only "
                 f"{via['aot_disk']} entries from the shared AOT "
                 "cache (warm() covers prefill+decode buckets)")
+        # 5. zero lock-order cycles, parent AND workers: parent-side
+        # from the live graph, worker-side from journaled
+        # lockdep.cycle events (a worker in raise mode also crashes,
+        # which assertions 1-2 already catch — this names the cause)
+        parent_cycles = _lockdep.violations()[lockdep_before:]
+        worker_cycles = _scan_lockdep_cycles(run_dir)
+        if parent_cycles:
+            failures.append(
+                f"lockdep: {len(parent_cycles)} PTC004 cycle(s) on "
+                f"the router side: "
+                f"{[v['cycle'] for v in parent_cycles]}")
+        if worker_cycles:
+            failures.append(
+                f"lockdep: {len(worker_cycles)} PTC004 cycle(s) "
+                f"journaled by workers: {worker_cycles}")
         result = {
             "failures": failures, "run_dir": run_dir, "root": root,
             "stats": stats, "trace": dispatch_trace,
@@ -210,14 +262,25 @@ def run_drill(root=None, keep=False):
                           "tokens": r.tokens, "requeues": r.requeues,
                           "arrival_t": r.arrival_t,
                           "admit_t": r.admit_t} for r in reqs],
+            "lockdep": {"mode": "raise",
+                        "parent_cycles": parent_cycles,
+                        "worker_cycles": worker_cycles},
         }
     except Exception as e:  # a harness crash is a drill failure too
         failures.append(f"drill harness raised {type(e).__name__}: {e}")
         result = {"failures": failures, "run_dir": run_dir,
                   "root": root, "stats": None, "trace": [],
                   "requeued_rids": [], "relaunch_via": None,
-                  "incarnations": 0, "oracle": oracle, "requests": []}
+                  "incarnations": 0, "oracle": oracle, "requests": [],
+                  "lockdep": {"mode": "raise",
+                              "parent_cycles":
+                              _lockdep.violations()[lockdep_before:],
+                              "worker_cycles": []}}
     finally:
+        if prev_lockdep is not None:
+            _lockdep.enable(prev_lockdep)
+        else:
+            _lockdep.disable()
         try:
             if router is not None:
                 router.close()

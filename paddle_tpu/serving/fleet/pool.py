@@ -38,6 +38,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from ...obs import journal as _journal
+from ...obs import lockdep as _lockdep
 from ...resilience.elastic import HEARTBEAT_ENV, ATTEMPT_ENV, \
     ReplicaSupervisor
 
@@ -264,7 +265,12 @@ class ProcessReplica(_BaseReplica):
         self.pid = None
         self.spawned_at = time.monotonic()
         self._events = deque()
-        self._lock = threading.Lock()
+        # guards _events between the reader thread (producer) and the
+        # router thread (consumer). Leaf of the fleet control-plane
+        # order router -> pool -> replica: the reader thread holds it
+        # only around deque ops, never while journaling or touching
+        # the pool.
+        self._lock = _lockdep.lock("fleet.replica_events")
         self._drained = False
         try:  # a stale beacon from the previous incarnation must not
             os.remove(hb_path)  # read as liveness

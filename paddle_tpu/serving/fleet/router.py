@@ -41,6 +41,15 @@ lands in ``fleet.router.*`` metrics, ``obs.export.router_lines``
 gauges (scraped == ``stats()`` bitwise), and — when a run journal is
 active — ``router.*`` events that ``tools/run_report.py`` /
 ``tools/fleet_report.py`` summarize.
+
+**Concurrency contract** (checked by ``analysis.concurrency`` +
+``obs.lockdep``): the Router itself is single-threaded — one thread
+owns ``dispatch()``/``pump()``/``poll()``; it holds NO lock of its
+own. The fleet lock order is **router → pool → replica**: the only
+lock on this control plane is each ``ProcessReplica``'s events lock
+(class ``fleet.replica_events``), a leaf taken briefly by the router
+thread (consume) and the replica's reader thread (produce). Never
+journal, scrape, sleep, or call back into the pool while holding it.
 """
 from __future__ import annotations
 

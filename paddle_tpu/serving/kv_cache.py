@@ -24,10 +24,9 @@ is a testable invariant, not a hope.
 """
 from __future__ import annotations
 
-import threading
-
 import numpy as np
 
+from ..obs import lockdep as _lockdep
 from ..obs import metrics as _metrics
 from ..resilience.policy import TransientError
 
@@ -94,7 +93,9 @@ class PagedKVCache:
         self._free = sorted(range(1, self.num_pages))
         self._tables = {}    # seq_id -> [page ids, in order]
         self._lengths = {}   # seq_id -> tokens stored
-        self._lock = threading.Lock()
+        # leaf of the serving order (engine.step -> scheduler -> cache):
+        # nothing may be acquired while this is held
+        self._lock = _lockdep.lock("serving.kv_cache")
         self._k = None
         self._v = None
         self._update_gauges()

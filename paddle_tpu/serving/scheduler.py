@@ -28,10 +28,10 @@ Rules (each one is pinned exactly by tests/test_serving.py):
 from __future__ import annotations
 
 import itertools
-import threading
 import time
 from dataclasses import dataclass, field
 
+from ..obs import lockdep as _lockdep
 from ..obs import metrics as _metrics
 from .kv_cache import CachePressureError, PageAllocationError
 
@@ -137,8 +137,12 @@ class Scheduler:
         # may arrive from other threads while the engine thread is
         # inside schedule() — an unlocked head pop racing a remove()
         # would silently discard (and permanently lose) a request.
-        # Lock order is scheduler -> cache, everywhere
-        self._lock = threading.RLock()
+        # Lock order is scheduler -> cache, everywhere (the journal's
+        # lock nests under the scheduler's too — record_request fires
+        # inside schedule(); journal is a leaf, it never calls back).
+        # lockdep-instrumented under PADDLE_TPU_LOCKDEP, plain RLock
+        # otherwise.
+        self._lock = _lockdep.rlock("serving.scheduler")
 
     # -- intake --------------------------------------------------------------
     def submit(self, request):

@@ -1,0 +1,454 @@
+"""Concurrency analysis: the PTC lint, the lockdep runtime, and the
+zero-overhead-off contract.
+
+Covers the PR's acceptance criteria:
+- static lint fixtures: AB/BA inversion (PTC001, both lock names),
+  blocking-under-lock (PTC002 — sleep / untimed queue.get /
+  Thread.join, including the acquire()/release() form), unguarded
+  cross-thread writes (PTC003), the false-positive guards (str.join,
+  timed join/get, Condition.wait on the held lock), waiver comments,
+  one-level interprocedural ordering;
+- the real paddle_tpu/ tree carries zero unwaived PTC001/PTC002;
+- a synthetic two-thread AB/BA harness deterministically produces ONE
+  PTC004 with BOTH witness stacks (event-sequenced — no sleeps, no
+  timing luck: lockdep flags the cycle at edge-insertion time, before
+  anything blocks);
+- ``lockdep.held_ms.<name>`` histograms land in the metrics registry;
+- ``PADDLE_TPU_LOCKDEP`` off ⇒ zero overhead: the PR-4 poison pattern
+  — every lockdep hook set to raise — over the scheduler / KV-cache /
+  journal / checkpoint-barrier hot paths;
+- lockdep-clean assertions piggyback on the cached serve-fleet and
+  elastic gang drills (no new drills: tier-1 runs on a 1-core box) —
+  they live next to the other drill consumers in test_serve_fleet.py
+  and test_tooling.py so the drills keep their natural late slot in
+  the timeout-bounded tier-1 run.
+"""
+import os
+import threading
+
+import pytest
+
+from paddle_tpu.analysis import concurrency as C
+from paddle_tpu.obs import lockdep
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def clean_lockdep():
+    """Scoped lockdep: enabled (raise) inside the test, prior mode and
+    graph restored after — the suite's other tests must never see a
+    leftover edge."""
+    prev = lockdep.mode()
+    lockdep.enable(lockdep.MODE_RAISE)
+    lockdep.reset()
+    yield lockdep
+    if prev is not None:
+        lockdep.enable(prev)
+    else:
+        lockdep.disable()
+    lockdep.reset()
+
+
+# -- static lint -------------------------------------------------------------
+
+
+class TestStaticLint:
+    def test_abba_inversion_flagged_with_both_locks(self):
+        src = '''
+import threading
+
+class S:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def f(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def g(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+        fs = C.lint_source(src, "x.py")
+        inv = [f for f in fs if f.code == "PTC001"]
+        assert len(inv) == 1, fs
+        assert set(inv[0].locks) == {"S._a", "S._b"}
+        assert inv[0].severity == "error"
+        # the message points at BOTH sites
+        assert "S._a" in inv[0].message and "S._b" in inv[0].message
+
+    def test_blocking_under_lock_all_shapes(self):
+        """sleep under with-lock, untimed queue.get, Thread.join via
+        the explicit acquire()/release() form."""
+        src = '''
+import threading
+import time
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.q = None
+        self.worker = None
+
+    def a(self):
+        with self._lock:
+            time.sleep(0.5)
+
+    def b(self):
+        with self._lock:
+            return self.q.get()
+
+    def c(self):
+        self._lock.acquire()
+        self.worker.join()
+        self._lock.release()
+'''
+        fs = C.lint_source(src, "x.py")
+        assert [f.code for f in fs] == ["PTC002"] * 3, fs
+        assert all("S._lock" in f.locks for f in fs)
+
+    def test_false_positive_guards(self):
+        """str.join, os.path.join, timed join/get/wait, nonblocking
+        get, and Condition.wait on the HELD lock are all benign."""
+        src = '''
+import os
+import threading
+import time
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self.q = None
+
+    def a(self, parts, t):
+        with self._lock:
+            x = ", ".join(parts)
+            y = os.path.join("a", "b")
+            t.join(timeout=5.0)
+            z = self.q.get(timeout=1.0)
+            w = self.q.get(block=False)
+            return x, y, z, w
+
+    def b(self):
+        with self._cv:
+            self._cv.wait(0.1)
+            self._cv.wait()
+'''
+        fs = C.lint_source(src, "x.py")
+        assert not fs, fs
+
+    def test_release_ends_the_critical_section(self):
+        src = '''
+import threading
+import time
+
+_L = threading.Lock()
+
+def f():
+    _L.acquire()
+    _L.release()
+    time.sleep(0.5)
+'''
+        assert not C.lint_source(src, "x.py")
+
+    def test_unguarded_cross_thread_write(self):
+        src = '''
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.beat = None
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        self.beat = 1.0
+
+    def touch(self):
+        self.beat = 2.0
+'''
+        fs = C.lint_source(src, "x.py")
+        assert [f.code for f in fs] == ["PTC003"], fs
+        assert fs[0].severity == "warning"
+        # advisory: PTC003 never gates the CLI exit code
+        assert not C.gate_findings(fs)
+
+    def test_guarded_both_sides_is_silent(self):
+        src = '''
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.beat = None
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self.beat = 1.0
+
+    def touch(self):
+        with self._lock:
+            self.beat = 2.0
+'''
+        assert not C.lint_source(src, "x.py")
+
+    def test_waiver_comment_downgrades(self):
+        src = '''
+import threading
+import time
+
+_L = threading.Lock()
+
+def f():
+    with _L:
+        time.sleep(0.1)  # lockdep: waive — fixture sleep
+
+def g():
+    with _L:
+        time.sleep(0.1)  # noqa: PTC002
+'''
+        fs = C.lint_source(src, "x.py")
+        assert len(fs) == 2 and all(f.waived for f in fs), fs
+        assert not C.gate_findings(fs)
+
+    def test_one_level_interprocedural_order(self):
+        """g() takes B then calls self.f() whose FIRST lock is A; h()
+        takes A then B directly — inversion across the call edge."""
+        src = '''
+import threading
+
+class S:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def f(self):
+        with self._a:
+            pass
+
+    def g(self):
+        with self._b:
+            self.f()
+
+    def h(self):
+        with self._a:
+            with self._b:
+                pass
+'''
+        fs = C.lint_source(src, "x.py")
+        assert any(f.code == "PTC001" and
+                   set(f.locks) == {"S._a", "S._b"} for f in fs), fs
+
+    def test_paddle_tpu_tree_is_clean(self):
+        """The in-tree acceptance gate: zero unwaived PTC001/PTC002
+        over the real source tree (true positives found during this
+        PR were fixed, and future ones fail here with file:line)."""
+        findings = C.lint_tree(os.path.join(ROOT, "paddle_tpu"))
+        gating = C.gate_findings(findings)
+        assert not gating, "\n".join(repr(f) for f in gating)
+
+
+# -- lockdep runtime ---------------------------------------------------------
+
+
+class TestLockdepRuntime:
+    def test_off_by_default_returns_plain_primitives(self):
+        assert lockdep.mode() is None
+        assert type(lockdep.lock("x")) is type(threading.Lock())
+        assert type(lockdep.rlock("x")) is type(threading.RLock())
+
+    def test_two_thread_abba_cycle_deterministic(self, clean_lockdep):
+        """The synthetic AB/BA harness: t1 records A->B and signals;
+        t2 then attempts B->A. Lockdep flags the edge B->A at
+        insertion time — BEFORE t2 blocks on A — so the test is
+        deterministic with no sleeps and cannot deadlock."""
+        A = lockdep.lock("t.A")
+        B = lockdep.lock("t.B")
+        t1_done = threading.Event()
+        caught = {}
+
+        def t1():
+            with A:
+                with B:
+                    pass
+            t1_done.set()
+
+        def t2():
+            t1_done.wait(30)
+            try:
+                with B:
+                    with A:
+                        pass
+            except lockdep.LockCycleError as e:
+                caught["e"] = e
+
+        th1 = threading.Thread(target=t1)
+        th2 = threading.Thread(target=t2)
+        th1.start()
+        th2.start()
+        th1.join(30)
+        th2.join(30)
+
+        e = caught.get("e")
+        assert e is not None, "PTC004 not raised"
+        assert e.code == "PTC004"
+        assert set(e.cycle) == {"t.A", "t.B"}
+        # BOTH witness stacks: the closing acquisition and the first
+        # recorded reverse-order acquisition
+        assert e.new_stack and any("t2" in fr for fr in e.new_stack)
+        assert e.prev_stack and any("t1" in fr for fr in e.prev_stack)
+        viols = lockdep.violations()
+        assert len(viols) == 1
+        assert viols[0]["new_edge"] == ("t.B", "t.A")
+        assert viols[0]["prev_thread"] != viols[0]["new_thread"]
+
+    def test_warn_mode_records_without_raising(self):
+        prev = lockdep.mode()
+        lockdep.enable(lockdep.MODE_WARN)
+        lockdep.reset()
+        try:
+            A = lockdep.lock("w.A")
+            B = lockdep.lock("w.B")
+            done = threading.Event()
+
+            def t1():
+                with A:
+                    with B:
+                        pass
+                done.set()
+
+            th = threading.Thread(target=t1)
+            th.start()
+            th.join(30)
+            assert done.wait(1)
+            with pytest.warns(RuntimeWarning, match="PTC004"):
+                with B:
+                    with A:
+                        pass
+            assert len(lockdep.violations()) == 1
+        finally:
+            if prev is not None:
+                lockdep.enable(prev)
+            else:
+                lockdep.disable()
+            lockdep.reset()
+
+    def test_held_time_histograms_in_registry(self, clean_lockdep):
+        from paddle_tpu.obs import metrics
+
+        L = lockdep.lock("hist.demo")
+        with L:
+            pass
+        snap = metrics.snapshot()
+        assert "lockdep.held_ms.hist.demo" in snap
+        hist = snap["lockdep.held_ms.hist.demo"]
+        assert hist["count"] == 1
+
+    def test_rlock_reentrancy_is_not_an_edge(self, clean_lockdep):
+        R = lockdep.rlock("re.R")
+        with R:
+            with R:
+                pass
+        assert not lockdep.violations()
+        assert "re.R" not in lockdep.order_graph().get("re.R", [])
+
+    def test_consistent_order_stays_silent(self, clean_lockdep):
+        A = lockdep.lock("ok.A")
+        B = lockdep.lock("ok.B")
+        for _ in range(3):
+            with A:
+                with B:
+                    pass
+        assert not lockdep.violations()
+        assert lockdep.order_graph() == {"ok.A": ["ok.B"]}
+
+    def test_env_install(self, monkeypatch):
+        prev = lockdep.mode()
+        try:
+            monkeypatch.setenv("PADDLE_TPU_LOCKDEP", "warn")
+            lockdep.disable()
+            lockdep.install_from_env()
+            assert lockdep.mode() == lockdep.MODE_WARN
+            monkeypatch.setenv("PADDLE_TPU_LOCKDEP", "0")
+            lockdep.disable()
+            lockdep.install_from_env()
+            assert lockdep.mode() is None
+        finally:
+            if prev is not None:
+                lockdep.enable(prev)
+            else:
+                lockdep.disable()
+
+    def test_wired_subsystems_use_instrumented_locks(self,
+                                                     clean_lockdep):
+        """With lockdep on, the wired constructors come out
+        instrumented and exercising them builds the documented order
+        (scheduler -> cache, scheduler -> journal as leaves) with
+        zero violations."""
+        from paddle_tpu.serving.kv_cache import PagedKVCache
+        from paddle_tpu.serving.scheduler import Request, Scheduler
+
+        cache = PagedKVCache(num_pages=8, page_size=4, num_heads=1,
+                             head_dim=4, max_seq_len=16)
+        sched = Scheduler(cache, token_budget=16)
+        assert type(sched._lock).__name__ == "_DebugLock"
+        assert type(cache._lock).__name__ == "_DebugLock"
+        sched.submit(Request(prompt=[1, 2, 3], max_new_tokens=2))
+        batch = sched.schedule()
+        assert batch.prefills
+        assert not lockdep.violations()
+        graph = lockdep.order_graph()
+        assert "serving.kv_cache" in \
+            graph.get("serving.scheduler", [])
+
+
+# -- zero-overhead-off contract (the PR-4 poison pattern) --------------------
+
+
+class TestLockdepOffZeroOverhead:
+    def test_hot_paths_never_touch_lockdep_when_off(self, tmp_path,
+                                                    monkeypatch):
+        """With PADDLE_TPU_LOCKDEP unset, the factories hand back
+        plain threading primitives at construction and the steady
+        state pays NOTHING: every lockdep hook is poisoned to raise,
+        then the scheduler/cache/journal/checkpoint-barrier paths run
+        clean."""
+        assert lockdep.mode() is None
+
+        def boom(*a, **k):
+            raise AssertionError("lockdep work performed while off")
+
+        monkeypatch.setattr(lockdep._DebugLock, "__init__", boom)
+        monkeypatch.setattr(lockdep._DebugLock, "acquire", boom)
+        monkeypatch.setattr(lockdep, "_note_edges", boom)
+        monkeypatch.setattr(lockdep, "_emit_violation", boom)
+        monkeypatch.setattr(lockdep, "_stack", boom)
+
+        from paddle_tpu.framework.io import wait_checkpoints
+        from paddle_tpu.obs.journal import RunJournal
+        from paddle_tpu.serving.kv_cache import PagedKVCache
+        from paddle_tpu.serving.scheduler import Request, Scheduler
+
+        cache = PagedKVCache(num_pages=8, page_size=4, num_heads=1,
+                             head_dim=4, max_seq_len=16)
+        sched = Scheduler(cache, token_budget=16)
+        r = sched.submit(Request(prompt=[1, 2, 3], max_new_tokens=2))
+        assert sched.schedule().prefills == [r]
+        sid = r.rid
+        assert cache.length(sid) >= 0
+
+        j = RunJournal(str(tmp_path / "run"), flush_every=1,
+                       compute_flops=False).start()
+        j.record_step(loss=0.5, step_ms=1.0)
+        j.event("poison.check")
+        j.close()
+
+        assert wait_checkpoints() is None  # takes the async barrier
+
+

@@ -604,3 +604,16 @@ class TestMultiProcessDrill:
         assert rt and rt["dispatched"] == res["stats"]["dispatched"]
         assert rt["requeued"] == res["stats"]["requeued"]
         assert rt["requeue_events"] >= 1
+
+    def test_drill_ran_lockdep_enabled_and_clean(self):
+        """The cached kill drill runs every worker under
+        PADDLE_TPU_LOCKDEP=1 and the parent router side under a scoped
+        enable (raise mode): zero PTC004 anywhere."""
+        from paddle_tpu.serving.fleet import drill
+
+        res = drill.drill_result()
+        assert not res["failures"], res["failures"]
+        ld = res["lockdep"]
+        assert ld["mode"] == "raise"
+        assert ld["parent_cycles"] == []
+        assert ld["worker_cycles"] == []

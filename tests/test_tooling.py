@@ -133,6 +133,33 @@ def test_elastic_run_self_test_passes():
     assert mod.main(["--self-test"]) == 0
 
 
+def test_elastic_drill_ran_lockdep_enabled_and_clean():
+    """The cached elastic gang drill exports PADDLE_TPU_LOCKDEP=1 to
+    every worker (raise mode — a cycle crashes the worker and the
+    bitwise-identity gate already fails); belt and braces, the per-rank
+    journals must carry zero lockdep.cycle events."""
+    import json
+
+    mod = _load_tool("elastic_run")
+    res = mod.drill_result()
+    assert not res["failures"], res["failures"]
+    cycles = []
+    for dirpath, _dn, filenames in os.walk(res["journal_dir"]):
+        for fn in filenames:
+            if not fn.endswith(".jsonl"):
+                continue
+            with open(os.path.join(dirpath, fn), encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("t") == "event" and \
+                            rec.get("kind") == "lockdep.cycle":
+                        cycles.append(rec)
+    assert not cycles, cycles
+
+
 def test_fleet_report_self_test_passes():
     """tools/fleet_report.py --self-test: the ISSUE-13 acceptance core
     — canned 2-rank journal fixtures must reproduce EXACT cross-rank
@@ -175,6 +202,18 @@ def test_aot_cache_self_test_passes():
     process so it rides the tier-1 command path like the other
     self-tests."""
     mod = _load_tool("aot_cache")
+    assert mod.main(["--self-test"]) == 0
+
+
+def test_lint_concurrency_self_test_passes():
+    """tools/lint_concurrency.py --self-test: the hand-built AB/BA
+    deadlock, blocking-under-lock, and unguarded-write fixtures must
+    each be caught (clean fixture silent, waiver comments honored),
+    AND the real paddle_tpu/ tree must carry zero unwaived
+    PTC001/PTC002 findings — tier-1 is the gate that keeps future
+    serving/fleet PRs lock-discipline-clean. In-process so it rides
+    the tier-1 command path like the other self-tests."""
+    mod = _load_tool("lint_concurrency")
     assert mod.main(["--self-test"]) == 0
 
 

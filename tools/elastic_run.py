@@ -199,6 +199,11 @@ _WORKER_ENV = {
     "JAX_PLATFORMS": "cpu",
     "PADDLE_TPU_CHAOS": "",
     "PADDLE_TPU_JOURNAL_FLOPS": "0",
+    # lockdep in raise mode: a lock-order cycle in any gang worker
+    # (journal, prefetcher, async checkpoint barrier — the paths this
+    # drill hammers) crashes that worker and fails the drill's
+    # trajectory-identity gate with a PTC004 in its journal
+    "PADDLE_TPU_LOCKDEP": "1",
 }
 
 

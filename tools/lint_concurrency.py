@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""lint_concurrency: host-runtime lock-discipline gate.
+
+CLI front door for ``paddle_tpu.analysis.concurrency`` — the static
+half of the ``obs.lockdep`` runtime validator. Walks a Python source
+tree, builds each module's lock-acquisition model, and reports:
+
+- **PTC001** inconsistent lock-acquisition order (A->B on one path,
+  B->A on another — the deadlock precondition)
+- **PTC002** blocking calls under a held lock (``time.sleep``,
+  ``Thread.join``, ``Popen.wait``/``communicate``, ``urlopen``,
+  untimed ``queue.get`` — the PR-15 router-stall class)
+- **PTC003** attributes written from both a spawned-thread target and
+  a public method without a shared lock in scope (advisory)
+
+Usage:
+    python tools/lint_concurrency.py                  # lint paddle_tpu/
+    python tools/lint_concurrency.py --path some/dir  # or one file
+    python tools/lint_concurrency.py --json           # machine-readable
+    python tools/lint_concurrency.py --self-test      # check the checker
+
+Exit code: nonzero iff any UNWAIVED PTC001/PTC002 finding exists
+(PTC003 prints but does not gate; a finding is waived by a
+``# lockdep: waive`` or ``# noqa: PTC00x`` comment on its line).
+
+``--self-test`` first runs hand-built fixtures through the lint — an
+AB/BA deadlock pair, a blocking-under-lock body, an unguarded
+cross-thread write, each of which MUST be caught, and a clean fixture
+that MUST stay silent — then lints the real ``paddle_tpu`` tree with
+the production gate. Wired into tier-1 via ``tests/test_tooling.py``,
+so a future serving/fleet PR that regresses lock discipline fails CI
+here, with the offending file:line in the output.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+DEFAULT_PATH = os.path.join(ROOT, "paddle_tpu")
+
+
+def _hint(code):
+    from paddle_tpu.analysis.diagnostics import CONCURRENCY_CODES
+
+    sev_hint = CONCURRENCY_CODES.get(code)
+    return sev_hint[1] if sev_hint else ""
+
+
+def _print_findings(findings, show_hints=True):
+    for f in findings:
+        print(f"  {f!r}")
+        if show_hints and not f.waived:
+            hint = _hint(f.code)
+            if hint:
+                print(f"      hint: {hint}")
+
+
+def lint_path(path, as_json=False):
+    from paddle_tpu.analysis import concurrency as C
+
+    if os.path.isdir(path):
+        findings = C.lint_tree(path)
+    else:
+        findings = C.lint_file(path)
+    gating = C.gate_findings(findings)
+    if as_json:
+        print(json.dumps({
+            "path": path,
+            "findings": [f.as_dict() for f in findings],
+            "gating": len(gating),
+        }, indent=2))
+    else:
+        print(f"lint_concurrency: {path}")
+        if findings:
+            _print_findings(findings)
+        waived = sum(1 for f in findings if f.waived)
+        print(f"  {len(findings)} finding(s), {waived} waived, "
+              f"{len(gating)} gating (unwaived PTC001/PTC002)")
+    return 1 if gating else 0
+
+
+# -- self-test fixtures ------------------------------------------------------
+
+_FIXTURE_ABBA = '''
+import threading
+
+class Pool:
+    def __init__(self):
+        self._slots = threading.Lock()
+        self._stats = threading.Lock()
+
+    def grab(self):
+        with self._slots:
+            with self._stats:
+                pass
+
+    def report(self):
+        with self._stats:
+            with self._slots:
+                pass
+'''
+
+_FIXTURE_BLOCKING = '''
+import threading
+import time
+
+class Sup:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.q = None
+        self.worker = None
+
+    def backoff(self):
+        with self._lock:
+            time.sleep(0.5)
+
+    def drain(self):
+        with self._lock:
+            item = self.q.get()
+        return item
+
+    def reap(self):
+        self._lock.acquire()
+        self.worker.join()
+        self._lock.release()
+'''
+
+_FIXTURE_UNGUARDED = '''
+import threading
+
+class Beacon:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.last_seen = None
+        self._t = threading.Thread(target=self._beat, daemon=True)
+
+    def _beat(self):
+        self.last_seen = 1.0
+
+    def touch(self):
+        self.last_seen = 2.0
+'''
+
+_FIXTURE_CLEAN = '''
+import threading
+import time
+
+class Clean:
+    """Consistent order, blocking outside critical sections, guarded
+    shared writes, condition-wait on the held lock, str.join."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._cv = threading.Condition()
+        self.count = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._a:
+            with self._b:
+                self.count += 1
+
+    def bump(self):
+        with self._a:
+            self.count += 1
+        time.sleep(0.0)
+
+    def wait_turn(self):
+        with self._cv:
+            self._cv.wait(1.0)
+
+    def label(self, parts):
+        with self._a:
+            return ", ".join(parts)
+
+    def reap(self, t):
+        with self._a:
+            pass
+        t.join(timeout=5.0)
+'''
+
+
+def self_test():
+    from paddle_tpu.analysis import concurrency as C
+
+    failures = []
+
+    def check(label, ok, detail=""):
+        status = "ok" if ok else "FAIL"
+        print(f"  [{status}] {label}" + (f" — {detail}" if detail
+                                         and not ok else ""))
+        if not ok:
+            failures.append(label)
+
+    print("lint_concurrency --self-test")
+
+    fs = C.lint_source(_FIXTURE_ABBA, "fixture_abba.py")
+    check("AB/BA inversion caught (PTC001)",
+          any(f.code == "PTC001" for f in fs), repr(fs))
+    check("AB/BA names both locks",
+          any(set(f.locks) == {"Pool._slots", "Pool._stats"}
+              for f in fs if f.code == "PTC001"), repr(fs))
+
+    fs = C.lint_source(_FIXTURE_BLOCKING, "fixture_blocking.py")
+    codes = [f.code for f in fs]
+    check("sleep/untimed-get/join under lock all caught (PTC002 x3)",
+          codes.count("PTC002") == 3, repr(fs))
+
+    fs = C.lint_source(_FIXTURE_UNGUARDED, "fixture_unguarded.py")
+    check("unguarded cross-thread write caught (PTC003)",
+          any(f.code == "PTC003" for f in fs), repr(fs))
+    check("PTC003 does not gate the exit code",
+          not C.gate_findings(fs), repr(fs))
+
+    fs = C.lint_source(_FIXTURE_CLEAN, "fixture_clean.py")
+    check("clean fixture stays silent", not fs, repr(fs))
+
+    waived_src = _FIXTURE_BLOCKING.replace(
+        "time.sleep(0.5)", "time.sleep(0.5)  # lockdep: waive")
+    fs = C.lint_source(waived_src, "fixture_waived.py")
+    w = [f for f in fs if f.waived]
+    check("waiver comment downgrades the finding",
+          len(w) == 1 and len(C.gate_findings(fs)) == 2, repr(fs))
+
+    # the production gate: the real tree must be clean
+    tree = C.lint_tree(DEFAULT_PATH)
+    gating = C.gate_findings(tree)
+    check(f"paddle_tpu/ tree clean ({len(tree)} finding(s), "
+          f"{len(gating)} gating)", not gating)
+    if gating:
+        _print_findings(gating)
+
+    if failures:
+        print(f"self-test FAILED: {len(failures)} check(s): {failures}")
+        return 1
+    print("self-test passed")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--path", default=DEFAULT_PATH,
+                    help="file or directory to lint (default: paddle_tpu/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the fixture checks + the full-tree gate")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    return lint_path(args.path, as_json=args.as_json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
