@@ -27,6 +27,12 @@ plus the production metrics layer the reference keeps in VLOG counters:
   to mesh axes), comm roofline vs ``PADDLE_TPU_ICI_BW``/chip table,
   ShardingReport per Executor cache entry, per-device memory gauges +
   Chrome-trace device lanes (``tools/shard_report.py`` is the CLI).
+- ``reqtrace`` — request-scoped distributed tracing: assemble the
+  ``req.*`` journal events (router + replicas) into per-request
+  timelines, exact tail-latency phase attribution (rate-limit wait /
+  router queue / scheduler queue / prefill / preemption loss summing
+  to e2e), and Perfetto request lanes with flow arrows across
+  requeues (``tools/request_report.py`` is the CLI).
 - ``fleet``    — cross-rank aggregation over per-rank journals
   (``<run_dir>/rank_NN/``, written when gang launchers hand workers
   ``PADDLE_TPU_RANK``): step alignment, cross-rank skew,
@@ -78,7 +84,7 @@ import os as _os
 
 from . import lockdep  # noqa: F401  (first: others build locks through it)
 from . import metrics, trace, report, anomaly, mfu, journal, spmd  # noqa: F401,E501
-from . import fleet, export  # noqa: F401
+from . import fleet, export, reqtrace  # noqa: F401
 from .metrics import (counter, gauge, histogram, snapshot, reset,  # noqa: F401
                       Counter, Gauge, Histogram, Registry, REGISTRY)
 from .trace import (span, enable_tracing, disable_tracing,  # noqa: F401
@@ -89,7 +95,7 @@ from .export import MetricsExporter  # noqa: F401
 
 __all__ = [
     "metrics", "trace", "report", "anomaly", "mfu", "journal", "spmd",
-    "fleet", "export", "lockdep",
+    "fleet", "export", "reqtrace", "lockdep",
     "counter", "gauge", "histogram", "snapshot", "reset",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "span", "enable_tracing", "disable_tracing", "tracing_enabled",

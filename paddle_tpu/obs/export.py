@@ -161,6 +161,18 @@ def slo_lines(engines=None, run_dir=None, now=None):
                 out.add(s + key, "gauge", d.get(q),
                         {"replica": rep, "q": q})
             out.add(s + key + "_count", "gauge", d.get("count"), lbl)
+        # reqtrace phase attribution: where this replica's request
+        # time went, as shares of total (queue + prefill + preempt +
+        # decode over its finished requests) — the signal that says
+        # whether a p99 breach is queueing or compute
+        ph = st.get("phase_ms") or {}
+        total = sum(v for v in ph.values()
+                    if isinstance(v, (int, float)))
+        if ph and total > 0:
+            for phase in sorted(ph):
+                out.add(s + "phase_share", "gauge",
+                        float(ph[phase]) / total,
+                        {"replica": rep, "phase": phase})
     if run_dir:
         from . import fleet as _fleet
 

@@ -427,9 +427,13 @@ def _stalls_for_supervisor(sup, ranks):
 
 def request_summary(run):
     """Serving columns over one run's ``request`` records: counts by
-    state, total preemptions, and exact p50/p99 TTFT/TPOT/e2e (ms).
-    None when the run served nothing. (Canonical home of the summary
-    ``tools/run_report.py`` renders.)"""
+    state, total preemptions, exact p50/p99 TTFT/TPOT/e2e/queue (ms),
+    and ``queue_share`` — the fraction of total TTFT spent in the
+    arrival->admit queue (the reqtrace regression gate's signal: a
+    p99 TTFT breach whose attribution shifted into queue wait moves
+    this, a prefill regression doesn't). None when the run served
+    nothing. (Canonical home of the summary ``tools/run_report.py``
+    renders.)"""
     reqs = run.get("requests") or []
     if not reqs:
         return None
@@ -442,11 +446,16 @@ def request_summary(run):
                               for r in reqs),
            "output_tokens": sum(int(r.get("output_tokens") or 0)
                                 for r in reqs)}
-    for key in ("ttft_ms", "tpot_ms", "e2e_ms"):
+    for key in ("ttft_ms", "tpot_ms", "e2e_ms", "queue_ms"):
         vals = [r[key] for r in reqs if _num(r.get(key))]
         if vals:
             out[f"{key}_p50"] = _pctl(vals, 50)
             out[f"{key}_p99"] = _pctl(vals, 99)
+    both = [(r["queue_ms"], r["ttft_ms"]) for r in reqs
+            if _num(r.get("queue_ms")) and _num(r.get("ttft_ms"))]
+    ttft_total = sum(t for _, t in both)
+    if both and ttft_total > 0:
+        out["queue_share"] = sum(q for q, _ in both) / ttft_total
     return out
 
 
@@ -683,14 +692,19 @@ def _remap_pid(pid, lane, device_pids):
     return lane
 
 
-def merge_chrome_traces(run_dir, out_path, include_supervisor=True):
+def merge_chrome_traces(run_dir, out_path, include_supervisor=True,
+                        include_requests=True):
     """Fuse the per-rank Chrome traces under ``run_dir`` (exported next
     to each rank journal on close/postmortem when ``PADDLE_TPU_TRACE``
     is on) into ONE Perfetto file: rank r's spans on pid=r, its device
     counter lanes inside ``DEVICE_PID_BASE + r*RANK_PID_STRIDE``, the
     supervisor's spans on ``SUPERVISOR_PID`` — every rank a distinct
-    lane, no pid collisions by construction. Returns
-    ``{sources, events, path}``."""
+    lane, no pid collisions by construction. ``include_requests``
+    additionally renders ``obs.reqtrace`` request lanes from the
+    JOURNALS (slices on pid=replica with flow arrows across requeues)
+    — journal-derived, so they appear even when the workers ran with
+    span tracing off and contributed zero trace files. Returns
+    ``{sources, events, request_slices, path}``."""
     sources = [(int(rank), None, os.path.join(p, TRACE_FILE))
                for rank, p in sorted(rank_dirs(run_dir).items())]
     if include_supervisor:
@@ -732,6 +746,28 @@ def merge_chrome_traces(run_dir, out_path, include_supervisor=True):
         events.append({"ph": "M", "pid": lane, "name":
                        "process_sort_index",
                        "args": {"sort_index": lane}})
+    labeled = {e["pid"] for e in events
+               if e.get("ph") == "M" and e.get("name") == "process_name"}
+    request_slices = 0
+    if include_requests:
+        from . import reqtrace as _reqtrace
+
+        try:
+            tls = _reqtrace.assemble_run(run_dir)
+        except (FileNotFoundError, OSError):
+            tls = {}
+        req_events = _reqtrace.request_lane_events(tls)
+        request_slices = sum(1 for e in req_events if e["ph"] == "X")
+        events += req_events
+        for pid in sorted({e["pid"] for e in req_events}):
+            if pid in labeled:
+                continue  # the rank's own trace already named the lane
+            events.append({
+                "ph": "M", "pid": pid, "name": "process_name",
+                "args": {"name": f"replica {pid}"}})
+            events.append({
+                "ph": "M", "pid": pid, "name": "process_sort_index",
+                "args": {"sort_index": pid}})
     d = os.path.dirname(out_path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -739,4 +775,4 @@ def merge_chrome_traces(run_dir, out_path, include_supervisor=True):
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f,
                   default=str)
     return {"sources": n_sources, "events": len(events),
-            "path": out_path}
+            "request_slices": request_slices, "path": out_path}

@@ -503,9 +503,14 @@ class RunJournal:
         """Append one per-request serving record (the decode analog of
         a training step record): the request's lifecycle timestamps in
         the SERVING clock (the engine's injectable clock, so tests are
-        exact), derived TTFT/TPOT/e2e latencies in ms, and the KV-page
-        + preemption footprint. ``tools/run_report.py`` summarizes
-        these into p50/p99 columns."""
+        exact), derived TTFT/TPOT/e2e/queue latencies in ms, and the
+        KV-page + preemption footprint. Per-phase ms fields ride
+        ``extra`` (the engine passes ``prefill_ms``/``preempt_ms``/
+        ``decode_ms`` from its preempt/resume stamps; with the derived
+        ``queue_ms`` they telescope exactly to ``e2e_ms`` — the
+        ``obs.reqtrace`` attribution invariant).
+        ``tools/run_report.py`` summarizes these into p50/p99
+        columns."""
         rec = {"t": "request", "rid": rid, "ts": time.time()}
         if state is not None:
             rec["state"] = state
@@ -522,6 +527,8 @@ class RunJournal:
             rec["pages_peak"] = int(pages_peak)
         if preemptions:
             rec["preemptions"] = int(preemptions)
+        if arrival_t is not None and admit_t is not None:
+            rec["queue_ms"] = (admit_t - arrival_t) * 1e3
         if arrival_t is not None and first_token_t is not None:
             rec["ttft_ms"] = (first_token_t - arrival_t) * 1e3
         if arrival_t is not None and finish_t is not None:

@@ -30,6 +30,7 @@ __all__ = [
     "span", "enable_tracing", "disable_tracing", "tracing_enabled",
     "clear_trace", "trace_events", "export_chrome_trace",
     "device_counter", "set_rank", "current_rank",
+    "flow_start", "flow_finish",
     "DEFAULT_CAPACITY", "DEVICE_PID_BASE", "RANK_PID_STRIDE",
 ]
 
@@ -145,6 +146,27 @@ def device_counter(device_id, name, value, label=None):
     _device_samples.append((int(device_id), name,
                             (time.perf_counter() - _EPOCH) * 1e6,
                             float(value)))
+
+
+def flow_start(name, flow_id, pid, tid, ts_us, **args):
+    """One Chrome-trace flow-start event ("s"): the tail of an arrow
+    Perfetto draws between two slices — possibly on different pid
+    lanes. Pair with :func:`flow_finish` under the same ``flow_id``
+    (``obs.reqtrace`` uses these to draw a requeued request crossing
+    from the victim replica's lane to the re-dispatched one's)."""
+    return {"ph": "s", "cat": "req", "name": str(name),
+            "id": int(flow_id), "pid": pid, "tid": tid,
+            "ts": float(ts_us), "args": dict(args)}
+
+
+def flow_finish(name, flow_id, pid, tid, ts_us, **args):
+    """The matching flow-finish ("f") for :func:`flow_start`.
+    ``bp="e"`` binds the arrowhead to the ENCLOSING slice at this
+    timestamp rather than the next slice to start — the binding that
+    keeps the arrow on the re-dispatch segment itself."""
+    return {"ph": "f", "bp": "e", "cat": "req", "name": str(name),
+            "id": int(flow_id), "pid": pid, "tid": tid,
+            "ts": float(ts_us), "args": dict(args)}
 
 
 def trace_events():

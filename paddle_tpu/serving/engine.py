@@ -55,7 +55,8 @@ from .kv_cache import (CachePressureError, PagedKVCache,
                        PageAllocationError, write_tokens)
 from .scheduler import CANCELLED, FINISHED, RUNNING, Request, Scheduler
 
-__all__ = ["ServeEngine", "TinyLM", "live_engines"]
+__all__ = ["ServeEngine", "TinyLM", "live_engines", "request_phases",
+           "preempt_loss_ms"]
 
 # process-wide replica registry: every ServeEngine registers a weakref
 # at construction, so the SLO exporter (obs.export.MetricsExporter with
@@ -264,9 +265,10 @@ class ServeEngine:
 
     # -- intake --------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, rid=None, eos_id=None,
-               arrival_t=None):
+               arrival_t=None, trace=None):
         req = Request(prompt=list(prompt), max_new_tokens=max_new_tokens,
-                      rid=rid, eos_id=eos_id, arrival_t=arrival_t)
+                      rid=rid, eos_id=eos_id, arrival_t=arrival_t,
+                      trace=trace)
         if any(not 0 <= t < self.model.vocab_size for t in req.prompt):
             raise ValueError("prompt token out of vocab range")
         # the deepest context this request can reach is
@@ -458,6 +460,14 @@ class ServeEngine:
             batch = self.scheduler.schedule()
             if not batch:
                 return batch
+            if _journal.ACTIVE is not None and batch.decodes:
+                # reqtrace decode-step mark: which requests decoded at
+                # which engine clock — the per-step resolution the
+                # assembled timelines anchor decode progress on
+                _journal.ACTIVE.event(
+                    "req.decode_mark", at=t0, step=self._steps + 1,
+                    replica=self.replica_id,
+                    rids=[r.rid for r in batch.decodes])
             with _trace.span("serving.step",
                              prefills=len(batch.prefills),
                              decodes=len(batch.decodes)):
@@ -634,6 +644,9 @@ class ServeEngine:
 
     def _journal_request(self, req):
         if _journal.ACTIVE is not None:
+            extra = request_phases(req)
+            if req.trace is not None:
+                extra["trace"] = req.trace
             _journal.ACTIVE.record_request(
                 rid=req.rid, state=req.state,
                 arrival_t=req.arrival_t, admit_t=req.admit_t,
@@ -641,7 +654,8 @@ class ServeEngine:
                 prompt_tokens=len(req.prompt),
                 output_tokens=len(req.generated),
                 pages_peak=req.pages_peak,
-                preemptions=req.preemptions)
+                preemptions=req.preemptions, replica=self.replica_id,
+                **extra)
 
     def stats(self):
         """Engine + pool + latency snapshot (plain data). Latency
@@ -676,7 +690,54 @@ class ServeEngine:
                 snap[name] = {"count": len(xs),
                               "p50": exact_percentile(xs, 50),
                               "p99": exact_percentile(xs, 99)}
+        # phase attribution sums over finished requests (the numerators
+        # of the per-replica phase-share gauges obs.export publishes):
+        # queue (arrival->admit) + prefill + preempt + decode == e2e
+        phases = {"queue": 0.0, "prefill": 0.0, "preempt": 0.0,
+                  "decode": 0.0}
+        for r in fin:
+            if r.admit_t is not None and r.arrival_t is not None:
+                phases["queue"] += (r.admit_t - r.arrival_t) * 1e3
+            p = request_phases(r)
+            phases["prefill"] += p.get("prefill_ms", 0.0)
+            phases["preempt"] += p.get("preempt_ms", 0.0)
+            phases["decode"] += p.get("decode_ms", 0.0)
+        snap["phase_ms"] = phases
         return snap
+
+
+def preempt_loss_ms(req):
+    """Total wall time ``req`` spent preempted, in ms: every
+    ``preempt_ts[i]`` pairs with ``resume_ts[i]`` (the scheduler stamps
+    both), and a final unpaired preempt — the request was torn down
+    while still PREEMPTED — pairs with ``finish_t``."""
+    loss = 0.0
+    for i, p in enumerate(req.preempt_ts):
+        end = req.resume_ts[i] if i < len(req.resume_ts) else req.finish_t
+        if end is not None:
+            loss += (end - p) * 1e3
+    return loss
+
+
+def request_phases(req):
+    """Engine-side phase decomposition of one terminal request (ms):
+    ``prefill_ms`` (admit -> first token), ``preempt_ms`` (total time
+    parked by preemption), ``decode_ms`` (first token -> finish, minus
+    preemption loss). Together with the ``queue_ms`` the journal
+    derives (arrival -> admit) the four telescope exactly to e2e —
+    the attribution invariant ``obs.reqtrace`` builds on. Fields are
+    emitted only when their stamps exist (a rejected request has no
+    admission, a cancelled one may have no first token)."""
+    out = {}
+    if req.admit_t is not None and req.first_token_t is not None:
+        out["prefill_ms"] = (req.first_token_t - req.admit_t) * 1e3
+    if req.finish_t is not None:
+        if req.preempt_ts:
+            out["preempt_ms"] = preempt_loss_ms(req)
+        if req.first_token_t is not None:
+            out["decode_ms"] = (req.finish_t - req.first_token_t) * 1e3 \
+                - out.get("preempt_ms", 0.0)
+    return out
 
 
 class _DecodeEntry:

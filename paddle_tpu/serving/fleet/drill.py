@@ -252,6 +252,51 @@ def run_drill(root=None, keep=False):
             failures.append(
                 f"lockdep: {len(worker_cycles)} PTC004 cycle(s) "
                 f"journaled by workers: {worker_cycles}")
+        # 6. request timelines (obs.reqtrace): every requeued request's
+        # assembled timeline spans BOTH replica incarnations — the
+        # victim's dispatch segment AND the re-dispatched one's — and
+        # the merged Perfetto export carries the cross-pid flow arrow.
+        # Workers run with span tracing OFF, so the request lanes are
+        # journal-derived by construction (zero trace-file sources).
+        from ...obs import fleet as obs_fleet
+        from ...obs import reqtrace as _reqtrace
+
+        timelines = _reqtrace.assemble_run(run_dir)
+        attribution = {a["rid"]: a
+                       for a in _reqtrace.attribute_run(timelines)}
+        for rid in sorted(requeued_rids):
+            segs = (timelines.get(rid) or {}).get("segments") or []
+            seg_reps = {s["replica"] for s in segs}
+            if len(segs) < 2 or len(seg_reps) < 2:
+                failures.append(
+                    f"reqtrace: {rid} requeued but its timeline has "
+                    f"{len(segs)} segment(s) on replicas "
+                    f"{sorted(seg_reps)} — expected the victim's AND "
+                    "the re-dispatched replica's")
+            att = attribution.get(rid)
+            if att is None or not att["requeue_ms"] > 0:
+                failures.append(
+                    f"reqtrace: {rid} requeued but its attribution "
+                    f"shows no requeue loss: {att}")
+        merged = obs_fleet.merge_chrome_traces(
+            run_dir, os.path.join(root, "merged_trace.json"))
+        with open(merged["path"], encoding="utf-8") as f:
+            merged_events = json.load(f).get("traceEvents") or []
+        flow_pairs = {}
+        for ev in merged_events:
+            if ev.get("ph") in ("s", "f"):
+                flow_pairs.setdefault(ev.get("id"), {})[ev["ph"]] = ev
+        cross_flows = [fl for fl in flow_pairs.values()
+                       if "s" in fl and "f" in fl
+                       and fl["s"].get("pid") != fl["f"].get("pid")]
+        cross_flow_rids = sorted(
+            {(fl["s"].get("args") or {}).get("rid")
+             for fl in cross_flows})
+        if requeued_rids and not cross_flows:
+            failures.append(
+                "reqtrace: merged trace carries no cross-pid flow "
+                "event — a requeued request should visibly cross "
+                "from the victim's lane to the re-dispatched one's")
         result = {
             "failures": failures, "run_dir": run_dir, "root": root,
             "stats": stats, "trace": dispatch_trace,
@@ -265,6 +310,11 @@ def run_drill(root=None, keep=False):
             "lockdep": {"mode": "raise",
                         "parent_cycles": parent_cycles,
                         "worker_cycles": worker_cycles},
+            "request_timelines": {rid: tl["segments"]
+                                  for rid, tl in timelines.items()},
+            "request_attribution": attribution,
+            "merged_trace": merged,
+            "cross_flow_rids": cross_flow_rids,
         }
     except Exception as e:  # a harness crash is a drill failure too
         failures.append(f"drill harness raised {type(e).__name__}: {e}")
@@ -275,7 +325,9 @@ def run_drill(root=None, keep=False):
                   "lockdep": {"mode": "raise",
                               "parent_cycles":
                               _lockdep.violations()[lockdep_before:],
-                              "worker_cycles": []}}
+                              "worker_cycles": []},
+                  "request_timelines": {}, "request_attribution": {},
+                  "merged_trace": None, "cross_flow_rids": []}
     finally:
         if prev_lockdep is not None:
             _lockdep.enable(prev_lockdep)

@@ -200,7 +200,8 @@ class LocalReplica(_BaseReplica):
             self.engine.submit(req.prompt,
                                max_new_tokens=req.max_new_tokens,
                                rid=req.rid, eos_id=req.eos_id,
-                               arrival_t=req.arrival_t)
+                               arrival_t=req.arrival_t,
+                               trace=req.trace_id)
         except ValueError:
             # the router pre-validates with the same rules, so this is
             # a spec drift bug — surface it, don't strand the request
@@ -356,7 +357,8 @@ class ProcessReplica(_BaseReplica):
                     "prompt": req.prompt,
                     "max_new_tokens": req.max_new_tokens,
                     "eos_id": req.eos_id,
-                    "arrival_t": req.arrival_t})
+                    "arrival_t": req.arrival_t,
+                    "trace": req.trace_id})
 
     def drain(self):
         super().drain()

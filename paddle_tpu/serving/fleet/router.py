@@ -81,6 +81,7 @@ _M_SCALE_UP = _metrics.counter("serving.router.scale_ups")
 _M_SCALE_DOWN = _metrics.counter("serving.router.scale_downs")
 
 _frid_counter = itertools.count()
+_trace_counter = itertools.count()
 
 
 class FleetRequest:
@@ -90,7 +91,8 @@ class FleetRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id", "tenant",
                  "state", "arrival_t", "admit_t", "first_token_t",
                  "finish_t", "replica_id", "tokens", "requeues",
-                 "preemptions", "dispatches")
+                 "preemptions", "dispatches", "trace_id", "requeue_ts",
+                 "rate_hold_t", "rate_wait")
 
     def __init__(self, prompt, max_new_tokens=16, rid=None, eos_id=None,
                  tenant="default", arrival_t=None):
@@ -109,6 +111,16 @@ class FleetRequest:
         self.requeues = 0
         self.preemptions = 0         # in-replica preemptions, reported back
         self.dispatches = []         # [(t, replica_id)] — the trace
+        # request-scoped tracing (obs.reqtrace): one trace id per
+        # routed request, minted here and propagated through dispatch
+        # into the replica's engine Request (both pool modes)
+        self.trace_id = f"tr-{next(_trace_counter):06d}-{self.rid}"
+        self.requeue_ts = []         # [t] — when a dead replica stranded it
+        # tenant-bucket wait accounting: rate_hold_t is the open
+        # hold's start (the head was rate-blocked at that clock),
+        # rate_wait accumulates closed holds in seconds
+        self.rate_hold_t = None
+        self.rate_wait = 0.0
 
     @property
     def cost(self):
@@ -276,6 +288,13 @@ class Router:
                                       tenant=req.tenant, reason=str(e))
             raise
         self._enqueue(req)
+        if _journal.ACTIVE is not None:
+            # reqtrace lifecycle edge: the routed request exists — the
+            # anchor every later req.* event joins on (by rid)
+            _journal.ACTIVE.event(
+                "req.submit", rid=req.rid, at=req.arrival_t,
+                tenant=req.tenant, trace=req.trace_id, cost=req.cost,
+                prompt_tokens=len(req.prompt))
         return req
 
     def _enqueue(self, req):
@@ -332,8 +351,24 @@ class Router:
                                    "after queue)")
                 if not q:
                     continue
-                if not bucket.peek(q[0].cost, now):
+                head = q[0]
+                if not bucket.peek(head.cost, now):
+                    # tenant-bucket wait starts (once per queueing
+                    # episode): the head is dispatchable but its
+                    # tenant's rate bucket cannot yet afford it
+                    if head.rate_hold_t is None:
+                        head.rate_hold_t = now
+                        if _journal.ACTIVE is not None:
+                            _journal.ACTIVE.event(
+                                "req.rate_hold", rid=head.rid, at=now,
+                                tenant=tenant)
                     continue
+                if head.rate_hold_t is not None:
+                    # the bucket refilled: the hold closes HERE — time
+                    # past this point (e.g. waiting for a replica slot)
+                    # is router-queue wait, not rate-limit wait
+                    head.rate_wait += now - head.rate_hold_t
+                    head.rate_hold_t = None
             deficit = self._served.get(tenant, 0.0) / pol.weight
             out.append((deficit, tenant))
         return sorted(out)
@@ -390,6 +425,9 @@ class Router:
         req.replica_id = rep.replica_id
         if req.admit_t is None:   # a requeue keeps the ORIGINAL admit
             req.admit_t = now
+        if req.rate_hold_t is not None:   # belt-and-braces close
+            req.rate_wait += now - req.rate_hold_t
+            req.rate_hold_t = None
         req.dispatches.append((now, rep.replica_id))
         self._inflight[req.rid] = req
         self.dispatched += 1
@@ -397,6 +435,14 @@ class Router:
         self.trace.append({"t": now, "rid": req.rid,
                            "replica": rep.replica_id,
                            "tenant": req.tenant})
+        if _journal.ACTIVE is not None:
+            # reqtrace lifecycle edge: dispatch segment N starts on
+            # this replica's lane; rate_wait_ms is CUMULATIVE across
+            # the request's queueing episodes (assembly reads the last)
+            _journal.ACTIVE.event(
+                "req.dispatch", rid=req.rid, at=now,
+                replica=rep.replica_id, seq=len(req.dispatches),
+                rate_wait_ms=req.rate_wait * 1e3, trace=req.trace_id)
         rep.submit(req)
 
     # -- completion + failure ------------------------------------------------
@@ -442,9 +488,17 @@ class Router:
                         if r.rid in self._inflight]
             for req in sorted(stranded, key=lambda r: r.arrival_t):
                 req.requeues += 1
+                req.requeue_ts.append(now)
                 self.requeued += 1
                 _M_REQUEUED.inc()
                 self._enqueue(req)
+                if _journal.ACTIVE is not None:
+                    # reqtrace lifecycle edge: the dispatch segment on
+                    # the dead replica ends here (per-rid twin of the
+                    # aggregate router.requeue event below)
+                    _journal.ACTIVE.event(
+                        "req.requeue", rid=req.rid, at=now,
+                        replica=rep.replica_id, reason=reason)
             if _journal.ACTIVE is not None:
                 _journal.ACTIVE.event(
                     "router.requeue", replica=rep.replica_id,

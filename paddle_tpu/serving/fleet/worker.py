@@ -26,7 +26,8 @@ Protocol (one JSON object per line):
 
 parent -> worker
     ``{"op": "submit", "rid", "prompt", "max_new_tokens", "eos_id",
-    "arrival_t"}`` | ``{"op": "cancel", "rid"}`` | ``{"op": "drain"}``
+    "arrival_t", "trace"}`` | ``{"op": "cancel", "rid"}`` |
+    ``{"op": "drain"}``
     | ``{"op": "stats"}`` | ``{"op": "stop"}``
 worker -> parent
     ``{"t": "ready", "replica", "pid", "metrics_port", "compiles",
@@ -162,7 +163,8 @@ def main(argv=None):
                                 max_new_tokens=msg.get(
                                     "max_new_tokens", 16),
                                 rid=rid, eos_id=msg.get("eos_id"),
-                                arrival_t=msg.get("arrival_t"))
+                                arrival_t=msg.get("arrival_t"),
+                                trace=msg.get("trace"))
                         except ValueError as e:
                             _emit({"t": "rejected", "rid": rid,
                                    "reason": str(e)})

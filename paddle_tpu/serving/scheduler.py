@@ -82,6 +82,14 @@ class Request:
     generated: list = field(default_factory=list)
     preemptions: int = 0
     pages_peak: int = 0
+    # request-scoped tracing (obs.reqtrace): the trace id minted at
+    # Router.submit rides dispatch into this replica's Request; the
+    # preempt/resume stamp pairs are what preemption-loss attribution
+    # is computed from (every preempt_ts[i] pairs with resume_ts[i],
+    # a final unpaired preempt pairs with finish_t)
+    trace: str = None
+    preempt_ts: list = field(default_factory=list)
+    resume_ts: list = field(default_factory=list)
 
     def __post_init__(self):
         self.prompt = [int(t) for t in self.prompt]
@@ -227,14 +235,27 @@ class Scheduler:
                 self._queue.pop(0)
                 self.cache.alloc(nxt.rid, cost)
                 nxt.state = RUNNING
-                if nxt.admit_t is None:   # a preemption resume keeps the
+                resumed = nxt.admit_t is not None
+                if not resumed:           # a preemption resume keeps the
                     nxt.admit_t = self.clock()  # original admission time
+                else:
+                    # close the open preempt interval: preemption-loss
+                    # attribution pairs resume_ts[i] with preempt_ts[i]
+                    nxt.resume_ts.append(self.clock())
                 nxt.pages_peak = max(nxt.pages_peak,
                                      len(self.cache.page_table(nxt.rid)))
                 self._running.append(nxt)
                 batch.prefills.append(nxt)
                 budget -= cost
                 _M_ADMITTED.inc()
+                from ..obs import journal as _journal
+
+                if _journal.ACTIVE is not None:
+                    # reqtrace lifecycle edge: scheduler admission (the
+                    # journal lock nests under the scheduler's, leaf)
+                    _journal.ACTIVE.event(
+                        "req.admit", rid=nxt.rid, at=nxt.resume_ts[-1]
+                        if resumed else nxt.admit_t, resumed=resumed)
             _M_QUEUE.set(len(self._queue))
             _M_RUNNING.set(len(self._running))
             return batch
@@ -285,10 +306,19 @@ class Scheduler:
         self._running.remove(victim)
         victim.state = PREEMPTED
         victim.preemptions += 1
+        victim.preempt_ts.append(self.clock())
         self.preemptions += 1
         _M_PREEMPTED.inc()
         self._enqueue(victim)
         _M_RUNNING.set(len(self._running))
+        from ..obs import journal as _journal
+
+        if _journal.ACTIVE is not None:
+            # reqtrace lifecycle edge: preemption start (the matching
+            # resume is the req.admit event with resumed=True)
+            _journal.ACTIVE.event("req.preempt", rid=victim.rid,
+                                  at=victim.preempt_ts[-1],
+                                  preemptions=victim.preemptions)
 
     # -- teardown ------------------------------------------------------------
     def finish(self, request, state=FINISHED):

@@ -300,6 +300,15 @@ class TestInactiveHooksDoNothing:
         monkeypatch.setattr(obs_export, "scrape", boom)
         monkeypatch.setattr(obs_export, "merge_expositions", boom)
         monkeypatch.setattr(obs_export.MetricsExporter, "render", boom)
+        # the reqtrace reader (timeline assembly / attribution / lane
+        # export) is pull-only too: the serve path writes req.* events
+        # through the same ACTIVE gate and must never read them back
+        from paddle_tpu.obs import reqtrace as obs_reqtrace
+
+        for name in ("assemble", "assemble_run", "attribute",
+                     "attribute_run", "tail_report",
+                     "request_lane_events", "write_request_trace"):
+            monkeypatch.setattr(obs_reqtrace, name, boom)
 
         pt.enable_static()
         try:
@@ -371,6 +380,22 @@ class TestInactiveHooksDoNothing:
         drainee.drain()
         frouter.poll()                     # retire path
         frouter.close()
+
+        # reqtrace write hooks (PR 18): a pressured engine run forcing
+        # preemption, resume, and decode-step marks (the req.preempt /
+        # req.admit(resumed) / req.decode_mark emit sites) must also
+        # collapse to the single None check when inactive
+        from paddle_tpu.serving import Scheduler
+
+        pcache = PagedKVCache(8, 2, 2, 8, max_seq_len=8)
+        peng = ServeEngine(TinyLM(num_heads=2, head_dim=8), pcache,
+                           scheduler=Scheduler(pcache,
+                                               token_budget=64))
+        preqs = [peng.submit([1, 2], max_new_tokens=6)
+                 for _ in range(4)]
+        peng.run(max_steps=200)
+        assert all(r.state == "FINISHED" for r in preqs)
+        assert peng.scheduler.preemptions >= 1
 
         import tempfile
 

@@ -605,6 +605,35 @@ class TestMultiProcessDrill:
         assert rt["requeued"] == res["stats"]["requeued"]
         assert rt["requeue_events"] >= 1
 
+    def test_drill_requeued_timelines_span_both_replicas(self):
+        """Satellite of the reqtrace tentpole, on the CACHED drill: a
+        requeued request's assembled timeline carries BOTH dispatch
+        segments (victim + re-dispatched replica), its attribution
+        shows the requeue loss, and the merged Perfetto export draws
+        the cross-pid flow arrow — from journals alone (the workers
+        run with span tracing off, so there are no trace files)."""
+        from paddle_tpu.obs import reqtrace
+        from paddle_tpu.serving.fleet import drill
+
+        res = drill.drill_result()
+        assert not res["failures"], res["failures"]
+        assert res["requeued_rids"]
+        for rid in res["requeued_rids"]:
+            segs = res["request_timelines"][rid]
+            assert len(segs) >= 2
+            assert len({s["replica"] for s in segs}) >= 2
+            att = res["request_attribution"][rid]
+            assert att["requeue_ms"] > 0
+            assert att["dispatches"] >= 2
+            # the telescoped phases land on e2e (wall clock here, so
+            # close — the nanosecond-exact gate is the ManualClock
+            # fixture in tools/request_report.py --self-test)
+            assert abs(reqtrace.attribution_sum(att) -
+                       att["e2e_ms"]) < 1e-6
+        # the crossing is visible in the merged trace
+        assert res["merged_trace"]["request_slices"] >= 2
+        assert set(res["cross_flow_rids"]) & set(res["requeued_rids"])
+
     def test_drill_ran_lockdep_enabled_and_clean(self):
         """The cached kill drill runs every worker under
         PADDLE_TPU_LOCKDEP=1 and the parent router side under a scoped

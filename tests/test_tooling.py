@@ -116,6 +116,20 @@ def test_serve_bench_self_test_passes():
     assert mod.main(["--self-test"]) == 0
 
 
+def test_request_report_self_test_passes():
+    """tools/request_report.py --self-test: the ISSUE-18 acceptance
+    core — a real pressured-engine run's journal-derived phase
+    attribution must sum BITWISE to each request's e2e on the manual
+    clock (preemption loss matching the engine's own stamp pairs), and
+    the hand-written routed fixture (rate hold + requeue + preemption)
+    must reproduce every hand-computed phase to the nanosecond, carry
+    both dispatch segments, and export request lanes with the
+    cross-replica flow arrow. In-process so it rides the tier-1
+    command path like the other self-tests."""
+    mod = _load_tool("request_report")
+    assert mod.main(["--self-test"]) == 0
+
+
 def test_elastic_run_self_test_passes():
     """tools/elastic_run.py --self-test: the ISSUE-8 acceptance drill —
     a real 2-worker CPU gang under GangSupervisor survives, in ONE run,

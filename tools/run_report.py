@@ -37,6 +37,8 @@ DEFAULT_COMM_THRESHOLD = 0.10        # all-reduce bytes/step may grow 10%
 DEFAULT_PLAN_MISMATCH_THRESHOLD = 0.10  # planner predicted-vs-measured
 DEFAULT_MEMORY_DRIFT_THRESHOLD = 0.15   # static peak-HBM prediction vs
 #                                         the executable's memory_analysis()
+DEFAULT_QUEUE_SHARE_THRESHOLD = 0.10    # serving queue share of TTFT may
+#                                         grow 10 points (absolute)
 
 
 # -- loading -----------------------------------------------------------------
@@ -346,7 +348,8 @@ def render_run(run, as_json=False):
             f"cancelled, {rsum['preemptions']} preemptions, "
             f"{rsum['output_tokens']} tokens)")
         for key, label in (("ttft_ms", "ttft_ms"), ("tpot_ms", "tpot_ms"),
-                           ("e2e_ms", "e2e_ms")):
+                           ("e2e_ms", "e2e_ms"),
+                           ("queue_ms", "queue_ms")):
             if rsum.get(f"{key}_p50") is not None:
                 lines.append(
                     f"{label:<12} p50={rsum[f'{key}_p50']:.3f} "
@@ -417,7 +420,8 @@ def render_run(run, as_json=False):
 def diff_runs(base, new,
               step_time_threshold=DEFAULT_STEP_TIME_THRESHOLD,
               loss_threshold=DEFAULT_LOSS_THRESHOLD,
-              comm_threshold=DEFAULT_COMM_THRESHOLD):
+              comm_threshold=DEFAULT_COMM_THRESHOLD,
+              queue_share_threshold=DEFAULT_QUEUE_SHARE_THRESHOLD):
     """Compare two loaded runs; regression flags flip when NEW is worse
     than BASE beyond the thresholds. Returns a plain-data report."""
     bt, nt = _mean(_step_times(base)), _mean(_step_times(new))
@@ -498,6 +502,19 @@ def diff_runs(base, new,
         (ba["hydrated"] if ba else 0)
     out["aot_regression"] = bool(
         ba and ba["hydrated"] and new_compiled > ba["compiled"])
+    # serving queue-share fold (reqtrace attribution signal): the
+    # fraction of fleet TTFT spent in the arrival->admit queue growing
+    # by more than the threshold (ABSOLUTE points) means latency
+    # shifted into queueing — an admission/dispatch regression even
+    # when the p99 TTFT column alone can't say WHERE the time went
+    brs, nrs = request_summary(base), request_summary(new)
+    bqs = (brs or {}).get("queue_share")
+    nqs = (nrs or {}).get("queue_share")
+    out["base_queue_share"] = bqs
+    out["new_queue_share"] = nqs
+    out["queue_share_regression"] = bool(
+        nqs is not None and
+        nqs > (bqs or 0.0) + queue_share_threshold)
     if bl is not None and nl is not None:
         margin = loss_threshold * max(abs(bl), 1e-12)
         out["loss_delta"] = nl - bl
@@ -505,7 +522,8 @@ def diff_runs(base, new,
     out["regression"] = out["step_time_regression"] or \
         out["loss_regression"] or out["comm_regression"] or \
         out["gate_regression"] or out["plan_regression"] or \
-        out["memory_regression"] or out["aot_regression"]
+        out["memory_regression"] or out["aot_regression"] or \
+        out["queue_share_regression"]
     return out
 
 
@@ -530,6 +548,8 @@ def render_diff(rep, as_json=False):
               "memory_regression",
               "base_aot_hydrated", "new_aot_hydrated",
               "aot_regression",
+              "base_queue_share", "new_queue_share",
+              "queue_share_regression",
               "base_anomalies", "new_anomalies", "regression"):
         if rep.get(k) is not None:
             lines.append(f"{k:<22} {fmt(rep[k])}")
@@ -768,6 +788,56 @@ def self_test():
                         f"tpot_ms derivation off: min={min(tpots)} "
                         f"(want 250: req 9 = (2.0-1.0)/4 s) "
                         f"max={max(tpots)} (want 475)")
+                # queue_ms = (admit - arrival) = 10 ms on EVERY record,
+                # so both percentiles are exactly 10.0; queue_share =
+                # sum(queue)/sum(ttft) = 100/5500 = 1/55
+                if rs.get("queue_ms_p50") != 10.0 or \
+                        rs.get("queue_ms_p99") != 10.0:
+                    failures.append(
+                        f"queue_ms percentiles off hand-computed 10.0: "
+                        f"p50={rs.get('queue_ms_p50')} "
+                        f"p99={rs.get('queue_ms_p99')}")
+                if abs((rs.get("queue_share") or 0) - 100.0 / 5500.0) \
+                        > 1e-12:
+                    failures.append(
+                        f"queue_share {rs.get('queue_share')} != "
+                        "hand-computed 100/5500")
+                if "queue_ms" not in render_run(load_run(d)):
+                    failures.append("render_run lost the queue_ms line")
+
+        # the queue-share regression gate: BASE serves with 10% of TTFT
+        # queued, NEW with 80% (same p99 TTFT class — only the
+        # attribution shifted into queueing); the diff must flag it,
+        # and NEW-vs-NEW must stay clean
+        with tempfile.TemporaryDirectory() as d:
+            qa, qb = os.path.join(d, "qa"), os.path.join(d, "qb")
+            for path, admit in ((qa, 0.01), (qb, 0.08)):
+                j = J.RunJournal(path, compute_flops=False)
+                j.start()
+                for i in range(8):
+                    j.record_request(
+                        rid=f"q{i}", state="FINISHED", arrival_t=0.0,
+                        admit_t=admit, first_token_t=0.1, finish_t=0.2,
+                        prompt_tokens=4, output_tokens=4)
+                j.close()
+            qrep = diff_runs(load_run(qa), load_run(qb))
+            if not qrep["queue_share_regression"]:
+                failures.append(
+                    "diff missed the queue-share shift (base 10% -> "
+                    f"new 80% of TTFT queued): {qrep}")
+            if abs((qrep["base_queue_share"] or 0) - 0.1) > 1e-9 or \
+                    abs((qrep["new_queue_share"] or 0) - 0.8) > 1e-9:
+                failures.append(
+                    f"queue shares off hand-computed 0.1/0.8: "
+                    f"{qrep['base_queue_share']}/"
+                    f"{qrep['new_queue_share']}")
+            if not qrep["regression"]:
+                failures.append("queue-share regression did not fold "
+                                "into the top-level regression flag")
+            qself = diff_runs(load_run(qb), load_run(qb))
+            if qself["regression"]:
+                failures.append(
+                    f"NEW-vs-NEW queue diff false-positived: {qself}")
 
         # serve-router events round-trip into the router line (the
         # hand-computed 2-replica fixture: 9 dispatched = 8 arrivals +
@@ -816,7 +886,9 @@ def self_test():
           "perf-gate (lost donation), plan-mismatch, memory-drift AND "
           "AOT warm-start "
           "regressions (and only them), serving request records "
-          "round-trip with hand-computed TTFT/TPOT percentile columns, "
+          "round-trip with hand-computed TTFT/TPOT/queue percentile "
+          "columns and the diff flagged the injected queue-share "
+          "shift, "
           "rank-subdir run dirs render the fleet rollup line, and "
           "serve-router events render the dispatched/requeued/tenant-"
           "share line")
@@ -839,6 +911,10 @@ def main(argv=None):
     ap.add_argument("--comm-threshold", type=float,
                     default=DEFAULT_COMM_THRESHOLD,
                     help="allowed relative all-reduce-bytes/step growth")
+    ap.add_argument("--queue-share-threshold", type=float,
+                    default=DEFAULT_QUEUE_SHARE_THRESHOLD,
+                    help="allowed absolute growth in the serving "
+                         "queue share of TTFT")
     ap.add_argument("--self-test", action="store_true",
                     help="synthetic 2-run pair: diff must flag the "
                          "injected regression, detectors must fire")
@@ -851,7 +927,8 @@ def main(argv=None):
         rep = diff_runs(load_run(args.paths[0]), load_run(args.paths[1]),
                         step_time_threshold=args.step_time_threshold,
                         loss_threshold=args.loss_threshold,
-                        comm_threshold=args.comm_threshold)
+                        comm_threshold=args.comm_threshold,
+                        queue_share_threshold=args.queue_share_threshold)
         print(render_diff(rep, as_json=args.json))
         return 1 if rep["regression"] else 0
     if len(args.paths) != 1:

@@ -13,6 +13,7 @@ Usage:
     python tools/serve_bench.py                      # default trace
     python tools/serve_bench.py --requests 64 --rate 100 --json
     python tools/serve_bench.py --pages 32 --page-size 8   # pressure
+    python tools/serve_bench.py --request-report 5         # tail blame
     python tools/serve_bench.py --self-test
 
 --self-test (wired into tier-1 via tests/test_tooling.py, like the
@@ -120,6 +121,44 @@ def run_bench(n_requests=32, rate=50.0, pages=128, page_size=8,
     rep["rejected"] = rejected
     rep["stuck"] = eng.scheduler.queue_depth
     return rep
+
+
+def request_report(run_dir, k):
+    """Tail-latency attribution for a journaled bench run: the K
+    worst-TTFT requests with their exact phase decompositions (see
+    ``paddle_tpu.obs.reqtrace``), plus the fleet-wide phase shares.
+    Returns the ``tail_report`` dict (None when nothing is
+    attributable — e.g. the run finished no requests)."""
+    from paddle_tpu.obs import reqtrace
+
+    try:
+        tls = reqtrace.assemble_run(run_dir)
+    except (FileNotFoundError, OSError):
+        return None
+    return reqtrace.tail_report(tls, key="ttft_ms", k=k)
+
+
+def _print_request_report(rep):
+    from paddle_tpu.obs.reqtrace import PHASES
+
+    if rep is None:
+        print("request report: no attributable requests")
+        return
+    # column labels for PHASES, in canonical order
+    short = ("rate", "router", "requeue", "sched", "prefill",
+             "preempt", "decode")
+    print(f"worst {len(rep['worst'])} of {rep['requests']} requests "
+          "by TTFT (phase ms):")
+    print("  " + "rid".ljust(10) + "".join(
+        c.rjust(12) for c in ("ttft", "e2e") + tuple(short)))
+    for w in rep["worst"]:
+        row = [w["ttft_ms"], w["e2e_ms"]] + [w[p] for p in PHASES]
+        print("  " + str(w["rid"]).ljust(10)
+              + "".join(f"{v:12.3f}" for v in row))
+    share = rep["phase_share"]
+    print("  phase share: " + "  ".join(
+        f"{s}={share[p]:.1%}" for s, p in zip(short, PHASES)
+        if share[p] > 0))
 
 
 def _report(eng, wall_s, n_requests):
@@ -606,22 +645,49 @@ def main(argv=None):
                     help="N>1 routes the trace through a "
                          "serving.fleet Router over N replicas")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--request-report", type=int, default=0,
+                    metavar="K",
+                    help="journal the run and print the K worst-TTFT "
+                         "requests with exact phase attribution "
+                         "(rate-limit/router-queue/requeue/sched-"
+                         "queue/prefill/preempt/decode)")
     ap.add_argument("--self-test", action="store_true",
                     help="deterministic kernel/scheduler/engine checks")
     args = ap.parse_args(argv)
     if args.self_test:
         return self_test()
     _ensure_cpu()
-    if args.replicas > 1:
-        rep = run_bench_fleet(n_requests=args.requests, rate=args.rate,
-                              replicas=args.replicas, pages=args.pages,
-                              page_size=args.page_size, seed=args.seed,
-                              token_budget=args.token_budget)
-    else:
-        rep = run_bench(n_requests=args.requests, rate=args.rate,
-                        pages=args.pages, page_size=args.page_size,
-                        seed=args.seed, token_budget=args.token_budget)
+    run_dir = None
+    if args.request_report > 0:
+        import shutil
+        import tempfile
+
+        from paddle_tpu.obs import journal
+
+        run_dir = tempfile.mkdtemp(prefix="pt_serve_bench_req_")
+        journal.start_run(run_dir)
+    try:
+        if args.replicas > 1:
+            rep = run_bench_fleet(
+                n_requests=args.requests, rate=args.rate,
+                replicas=args.replicas, pages=args.pages,
+                page_size=args.page_size, seed=args.seed,
+                token_budget=args.token_budget)
+        else:
+            rep = run_bench(n_requests=args.requests, rate=args.rate,
+                            pages=args.pages,
+                            page_size=args.page_size, seed=args.seed,
+                            token_budget=args.token_budget)
+    finally:
+        if run_dir is not None:
+            journal.end_run()
+    req_rep = None
+    if run_dir is not None:
+        req_rep = request_report(run_dir, args.request_report)
+        shutil.rmtree(run_dir, ignore_errors=True)
     if args.json:
+        if req_rep is not None:
+            rep["request_report"] = req_rep
         print(json.dumps(rep, sort_keys=True))
     else:
         for k in sorted(rep):
@@ -632,6 +698,8 @@ def main(argv=None):
                 print(f"{k:<20} {v:.4g}")
             else:
                 print(f"{k:<20} {v}")
+        if args.request_report > 0:
+            _print_request_report(req_rep)
     return 0
 
 
