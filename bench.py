@@ -293,6 +293,14 @@ def bench_int8_predictor(B=256):
                 "max_prob_diff": float(np.abs(o32 - o8).max())}
 
 
+# ceilings for the serve leg's exit-time SLO evaluation: generous
+# enough for the dispatch-bound TinyLM on the CPU smoke path, tight
+# enough that a pathological scheduler/latency regression lands as a
+# nonempty serve_slo_violations list in the one-line JSON
+SERVE_SLO_SPEC = {"ttft_p99_ms": 30000.0, "tpot_p99_ms": 5000.0,
+                  "availability": 0.9, "goodput_tps": 0.01}
+
+
 def bench_serve(requests=48, rate=100.0, pages=256, page_size=16):
     """Continuous-batching serving (paddle_tpu.serving): a Poisson
     trace of mixed-length prompts through ServeEngine's paged-KV
@@ -309,8 +317,29 @@ def bench_serve(requests=48, rate=100.0, pages=256, page_size=16):
                      "tools", "serve_bench.py"))
     sb = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(sb)
-    rep = sb.run_bench(n_requests=requests, rate=rate, pages=pages,
-                       page_size=page_size)
+    # journal the leg so the default serving SLO spec (generous enough
+    # to hold on the CPU smoke path, but a real ceiling: a pathological
+    # scheduler regression trips it) evaluates post-hoc over the real
+    # per-request records — the same obs.slo.evaluate_run math
+    # ``serve_bench --slo`` gates on
+    slo_dir = None
+    try:
+        import shutil as _sh
+        import tempfile as _tf
+
+        from paddle_tpu.obs import journal as _jl
+
+        slo_dir = _tf.mkdtemp(prefix="pt_serve_slo_")
+        _jl.start_run(slo_dir)
+    except Exception as e:
+        _log(f"serve slo journal failed: {type(e).__name__}: {e}")
+        slo_dir = None
+    try:
+        rep = sb.run_bench(n_requests=requests, rate=rate, pages=pages,
+                           page_size=page_size)
+    finally:
+        if slo_dir is not None:
+            _jl.end_run()
     out = {
         "tokens_per_sec": rep["tokens_per_sec"],
         "ttft_p50_ms": rep["ttft_p50_ms"],
@@ -321,6 +350,16 @@ def bench_serve(requests=48, rate=100.0, pages=256, page_size=16):
         "preemptions": rep["preemptions"],
         "kv_fragmentation": rep["kv_fragmentation"],
     }
+    if slo_dir is not None:
+        try:
+            from paddle_tpu.obs.slo import evaluate_run
+
+            slo_rep = evaluate_run(
+                slo_dir, SERVE_SLO_SPEC, duration_s=rep["wall_s"])
+            out["slo_violations"] = slo_rep["violations"]
+        except Exception as e:
+            _log(f"serve slo eval failed: {type(e).__name__}: {e}")
+        _sh.rmtree(slo_dir, ignore_errors=True)
     # replica cold-start vs warm-start: time-to-first-request of a
     # fresh ServeEngine against a fresh AOT executable cache (compiles
     # prefill + decode buckets) vs the same cache warm (hydrates) —
@@ -919,6 +958,11 @@ def _score(results, headline, extras):
             extras["serve_warm_start_ms"] = round(sv["warm_start_ms"], 1)
             extras["aot_hits"] = extras.get("aot_hits", 0) + \
                 sv["aot_hits"]
+        if "slo_violations" in sv:
+            # SLO verdict on EVERY round (cpu_fallback_smoke included):
+            # the serve leg's journal evaluated against SERVE_SLO_SPEC
+            extras["serve_slo_violations"] = sv["slo_violations"]
+            extras["serve_slo_ok"] = not sv["slo_violations"]
         if "replicas" in sv:
             # 2-replica router evidence on EVERY round
             # (cpu_fallback_smoke included): dispatch-layer overhead

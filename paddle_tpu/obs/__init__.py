@@ -47,8 +47,19 @@ plus the production metrics layer the reference keeps in VLOG counters:
   runtime half of ``analysis.concurrency``'s static lint.
 - ``export``   — live SLO signal plane: the registry + per-replica
   serving SLOs + per-rank heartbeat ages as Prometheus text over a
-  localhost HTTP endpoint (``MetricsExporter``) or an atomic
-  textfile.
+  localhost HTTP endpoint (``MetricsExporter``, which also serves the
+  ``/statusz`` fleet status page) or an atomic textfile.
+- ``timeseries`` — fixed-interval rolling windows over registry
+  snapshots or scraped expositions (``SeriesStore``): windowed counter
+  rates, gauge trends, and histogram percentiles / threshold
+  fractions over the last 1m/5m/30m/3h, exact under a ManualClock.
+- ``slo``      — declarative serving SLOs on top of ``timeseries``:
+  per-objective error budgets, Google-SRE multi-window multi-burn-rate
+  alerting (fast page 14.4x over 5m+30m, slow warn 6x over 30m+3h),
+  latched ``slo.fire``/``slo.clear`` journal events with worst-replica
+  attribution, and post-hoc ``evaluate_run`` for finished run dirs
+  (``tools/slo_report.py`` is the CLI; ``serve_bench --slo`` the
+  exit gate).
 
 Instrumented sites (all zero-overhead when idle — one flag/None check,
 no host sync, mirroring the ``resilience.inject`` ``if ACTIVE`` hooks):
@@ -85,6 +96,7 @@ import os as _os
 from . import lockdep  # noqa: F401  (first: others build locks through it)
 from . import metrics, trace, report, anomaly, mfu, journal, spmd  # noqa: F401,E501
 from . import fleet, export, reqtrace  # noqa: F401
+from . import timeseries, slo  # noqa: F401  (after metrics/export)
 from .metrics import (counter, gauge, histogram, snapshot, reset,  # noqa: F401
                       Counter, Gauge, Histogram, Registry, REGISTRY)
 from .trace import (span, enable_tracing, disable_tracing,  # noqa: F401
@@ -95,7 +107,7 @@ from .export import MetricsExporter  # noqa: F401
 
 __all__ = [
     "metrics", "trace", "report", "anomaly", "mfu", "journal", "spmd",
-    "fleet", "export", "reqtrace", "lockdep",
+    "fleet", "export", "reqtrace", "lockdep", "timeseries", "slo",
     "counter", "gauge", "histogram", "snapshot", "reset",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "span", "enable_tracing", "disable_tracing", "tracing_enabled",

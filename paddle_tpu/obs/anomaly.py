@@ -30,8 +30,9 @@ from . import metrics as _metrics
 
 __all__ = [
     "Detector", "LossSpike", "LossPlateau", "NonfiniteStreak",
-    "ThroughputDrop", "DataloaderStarvation", "AnomalyEngine",
-    "default_detectors", "DETECTORS",
+    "ThroughputDrop", "DataloaderStarvation", "TtftSpike",
+    "AnomalyEngine", "default_detectors", "serving_detectors",
+    "DETECTORS", "SERVING_DETECTORS",
 ]
 
 
@@ -220,9 +221,75 @@ class DataloaderStarvation(Detector):
         return None
 
 
+class TtftSpike(Detector):
+    """The serve path's LossSpike: windowed TTFT p99 (ms, from
+    ``obs.timeseries`` via the SLO evaluator's tick record) jumps
+    above ``median + factor * max(MAD, floor)`` over the last
+    ``window`` observations. Same once-per-excursion re-arm as the
+    training detectors — a sustained latency excursion fires once,
+    recovery re-arms it."""
+
+    name = "ttft_spike"
+
+    def __init__(self, window=32, factor=6.0, min_steps=5,
+                 floor_ms=0.5):
+        self.window = int(window)
+        self.factor = float(factor)
+        self.min_steps = int(min_steps)
+        self.floor_ms = float(floor_ms)
+        self._values = deque(maxlen=self.window)
+        self._armed = True
+
+    def update(self, rec):
+        v = rec.get("ttft_ms")
+        if not _finite(v) or v < 0:
+            return None
+        fired = None
+        if len(self._values) >= self.min_steps:
+            med = _median(self._values)
+            mad = _median([abs(x - med) for x in self._values])
+            threshold = med + self.factor * max(mad, self.floor_ms)
+            if v > threshold:
+                if self._armed:  # once per excursion, not per tick
+                    self._armed = False
+                    fired = {"ttft_ms": v, "median_ms": med,
+                             "threshold_ms": threshold}
+            else:
+                self._armed = True
+        self._values.append(v)
+        return fired
+
+
 DETECTORS = {cls.name: cls for cls in
              (LossSpike, LossPlateau, NonfiniteStreak, ThroughputDrop,
-              DataloaderStarvation)}
+              DataloaderStarvation, TtftSpike)}
+
+# the serve-path subset: ttft_spike reads the windowed TTFT p99,
+# throughput_drop reads the per-token latency implied by the windowed
+# token rate (both fed by obs.slo.SLOEvaluator's tick record) — the
+# AnomalyEngine blind spot ISSUE 19 closes: detectors used to see only
+# training step records
+SERVING_DETECTORS = ("ttft_spike", "throughput_drop")
+
+
+def serving_detectors(env=None):
+    """The serving detector set (``ttft_spike`` + ``throughput_drop``)
+    with thresholds overridden by the same ``PADDLE_TPU_ANOMALY`` spec
+    grammar ``default_detectors`` honors (non-serving names in the
+    spec are ignored here, not errors — one env var configures both
+    engines); ``"off"`` returns no detectors."""
+    from ..utils.envspec import parse_spec
+
+    spec = env if env is not None \
+        else os.environ.get("PADDLE_TPU_ANOMALY", "")
+    if spec.strip().lower() in ("off", "0", "false", "none"):
+        return []
+    overrides = {}
+    for name, cfg in parse_spec(spec):
+        if name in SERVING_DETECTORS:
+            overrides[name] = cfg
+    return [DETECTORS[name](**overrides.get(name, {}))
+            for name in SERVING_DETECTORS]
 
 
 def default_detectors(env=None):

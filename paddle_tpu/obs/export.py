@@ -45,6 +45,7 @@ from .metrics import Counter, Gauge, Histogram
 
 __all__ = [
     "prometheus_text", "registry_lines", "slo_lines", "router_lines",
+    "slo_engine_lines", "statusz_data", "render_statusz_html",
     "write_textfile", "parse_prometheus_text", "scrape",
     "merge_expositions", "MetricsExporter", "PREFIX",
 ]
@@ -218,15 +219,50 @@ def router_lines(router):
     return out.lines
 
 
+def slo_engine_lines(evaluator):
+    """The live SLO engine's truth (``obs.slo.SLOEvaluator``) as
+    gauges: per-objective ``slo_burn_rate{objective=,window=}``,
+    ``slo_budget_remaining{objective=}`` and
+    ``slo_alert_active{objective=,severity=}``. Values are emitted in
+    ``repr`` round-trip form like everything else here, so a scraped
+    burn rate parses back BITWISE equal to the evaluator's float — the
+    ISSUE-19 acceptance gate an alertmanager rule rests on."""
+    out = _Lines()
+    s = PREFIX + "slo_"
+    for spec in evaluator.specs:
+        obj = spec.name
+        for label in evaluator.windows:
+            v = evaluator.burn.get((obj, label))
+            if v is None:
+                continue
+            out.add(s + "burn_rate", "gauge", v,
+                    {"objective": obj, "window": label})
+        rem = evaluator.budget_left.get(obj)
+        if rem is not None:
+            out.add(s + "budget_remaining", "gauge", rem,
+                    {"objective": obj})
+        out.add(s + "target", "gauge", spec.target,
+                {"objective": obj})
+    for st in evaluator._alerts.values():
+        out.add(s + "alert_active", "gauge",
+                1.0 if st["active"] else 0.0,
+                {"objective": st["objective"],
+                 "severity": st["severity"]})
+    return out.lines
+
+
 def prometheus_text(engines=None, run_dir=None, registry=None,
-                    now=None, router=None, sources=None):
-    """The full exposition: registry + SLO gauges (+ router gauges and
-    scraped-and-merged remote ``sources``, for a fleet front-end),
-    newline-terminated Prometheus text format."""
+                    now=None, router=None, sources=None, slo=None):
+    """The full exposition: registry + SLO gauges (+ router gauges,
+    the live SLO engine's burn/budget gauges, and scraped-and-merged
+    remote ``sources``, for a fleet front-end), newline-terminated
+    Prometheus text format."""
     lines = registry_lines(registry) + slo_lines(engines, run_dir,
                                                  now=now)
     if router is not None:
         lines += router_lines(router)
+    if slo is not None:
+        lines += slo_engine_lines(slo)
     if sources:
         texts = ["\n".join(lines) + "\n"]
         for target in sources:
@@ -316,15 +352,154 @@ def merge_expositions(texts):
     return "\n".join(out.lines) + "\n"
 
 
+def statusz_data(router=None, slo=None, engines=None, now=None):
+    """The live fleet pane as plain data (the ``/statusz?format=json``
+    body): fleet topology (replica id / state / incarnation from the
+    pool), per-replica SLO table (the evaluator's cached last scrape,
+    falling back to local engine stats — NO new HTTP calls on render),
+    burn/budget/active alerts, and the router's recent scale/requeue
+    events. Pull-only: rendered per GET, nothing on the serve path."""
+    data = {"now": now, "fleet": [], "router": None, "slo": None,
+            "events": [], "replica_slo": {}}
+    pool = getattr(router, "pool", None)
+    if pool is not None:
+        data["fleet"] = pool.topology()
+    if router is not None:
+        st = router.stats()
+        data["router"] = {k: st.get(k) for k in
+                          ("queue_depth", "inflight", "dispatched",
+                           "requeued", "rejected", "completed",
+                           "replicas", "scale_ups", "scale_downs")}
+        for key in ("ttft_ms", "tpot_ms", "e2e_ms"):
+            if st.get(key):
+                data["router"][key] = st[key]
+        data["events"] = [dict(e) for e in
+                          getattr(router, "recent_events", ())]
+    if slo is not None:
+        s = slo.status()
+        data["slo"] = s
+        data["replica_slo"] = s.get("replica_slo") or {}
+    if not data["replica_slo"] and engines:
+        for i, eng in enumerate(engines):
+            try:
+                st = eng.stats()
+            except Exception:
+                continue
+            rep = str(getattr(eng, "replica_id", i))
+            row = {}
+            for key in ("ttft_ms", "tpot_ms"):
+                d = st.get(key) or {}
+                for q in ("p50", "p99"):
+                    if d.get(q) is not None:
+                        row[f"{key[:-3]}_{q}_ms"] = d[q]
+            if row:
+                data["replica_slo"][rep] = row
+    return data
+
+
+def _esc(v):
+    return (str(v).replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
+
+
+def _td(v):
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return "-" if v is None else _esc(v)
+
+
+def _html_table(headers, rows):
+    h = "".join(f"<th>{_esc(c)}</th>" for c in headers)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{_td(c)}</td>" for c in row) + "</tr>"
+        for row in rows)
+    return f"<table><tr>{h}</tr>{body}</table>"
+
+
+def render_statusz_html(data):
+    """``/statusz`` as a dependency-free single HTML page: fleet
+    topology, per-replica SLO table, per-objective burn/budget, active
+    alerts, recent router events."""
+    parts = ["<!DOCTYPE html><html><head><title>statusz</title>",
+             "<style>body{font-family:monospace;margin:1em}",
+             "table{border-collapse:collapse;margin:0.5em 0}",
+             "td,th{border:1px solid #999;padding:2px 8px;",
+             "text-align:right}th{background:#eee}",
+             ".firing{color:#b00;font-weight:bold}</style>",
+             "</head><body><h1>paddle_tpu fleet statusz</h1>"]
+    slo = data.get("slo") or {}
+    active = slo.get("active_alerts") or []
+    if active:
+        parts.append('<p class="firing">FIRING: ' + ", ".join(
+            f'{_esc(a["objective"])} [{_esc(a["severity"])}]'
+            for a in active) + "</p>")
+    else:
+        parts.append("<p>no active SLO alerts</p>")
+    if data.get("fleet"):
+        parts.append("<h2>fleet topology</h2>")
+        parts.append(_html_table(
+            ["replica", "state", "incarnation", "outstanding_tokens",
+             "inflight"],
+            [[r.get("replica"), r.get("state"), r.get("incarnation"),
+              r.get("outstanding_tokens"), r.get("inflight")]
+             for r in data["fleet"]]))
+    if slo.get("objectives"):
+        parts.append("<h2>SLO burn &amp; budget</h2>")
+        windows = sorted(
+            {w for o in slo["objectives"] for w in (o.get("burn")
+                                                    or {})})
+        parts.append(_html_table(
+            ["objective", "target"] + [f"burn {w}" for w in windows]
+            + ["budget remaining"],
+            [[o.get("name"), o.get("target")]
+             + [(o.get("burn") or {}).get(w) for w in windows]
+             + [o.get("budget_remaining")]
+             for o in slo["objectives"]]))
+    if data.get("replica_slo"):
+        keys = sorted({k for v in data["replica_slo"].values()
+                       for k in v})
+        parts.append("<h2>per-replica SLO</h2>")
+        parts.append(_html_table(
+            ["replica"] + keys,
+            [[rep] + [vals.get(k) for k in keys]
+             for rep, vals in sorted(data["replica_slo"].items())]))
+    if data.get("router"):
+        r = data["router"]
+        parts.append("<h2>router</h2>")
+        parts.append(_html_table(
+            sorted(k for k in r if not isinstance(r[k], dict)),
+            [[r[k] for k in sorted(r) if not isinstance(r[k], dict)]]))
+    if data.get("events"):
+        parts.append("<h2>recent router events</h2>")
+        parts.append(_html_table(
+            ["t", "kind", "detail"],
+            [[e.get("t"), e.get("kind"),
+              "; ".join(f"{k}={v}" for k, v in sorted(e.items())
+                        if k not in ("t", "kind"))]
+             for e in data["events"]]))
+    log = slo.get("alert_log") or []
+    if log:
+        parts.append("<h2>alert history</h2>")
+        parts.append(_html_table(
+            ["at", "kind", "objective", "severity", "burn_short",
+             "burn_long", "worst_replica"],
+            [[e.get("at"), e.get("kind"), e.get("objective"),
+              e.get("severity"), e.get("burn_short"),
+              e.get("burn_long"), e.get("worst_replica")]
+             for e in log]))
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
 def write_textfile(path, engines=None, run_dir=None, registry=None,
-                   router=None, sources=None):
+                   router=None, sources=None, slo=None):
     """Atomic textfile export (node_exporter textfile-collector
     convention): write to a tmp sibling, fsync-free rename — a scraper
     reading mid-write sees the previous complete snapshot, never a torn
     one. Returns ``path``."""
     body = prometheus_text(engines=engines, run_dir=run_dir,
                            registry=registry, router=router,
-                           sources=sources)
+                           sources=sources, slo=slo)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -361,16 +536,19 @@ class MetricsExporter:
     :meth:`write_textfile` snapshots."""
 
     def __init__(self, engines=None, run_dir=None, host="127.0.0.1",
-                 port=0, registry=None, router=None, sources=None):
+                 port=0, registry=None, router=None, sources=None,
+                 slo=None):
         self.engines = None if engines is None else list(engines)
         self.run_dir = run_dir
         self.host = str(host)
         self.port = int(port)
         self.registry = registry
         # fleet front-end mode: a serving.fleet.Router's gauges, plus
-        # remote per-replica exporters scraped-and-merged per render
+        # remote per-replica exporters scraped-and-merged per render,
+        # plus the live SLO engine's burn/budget gauges + /statusz
         self.router = router
         self.sources = None if sources is None else list(sources)
+        self.slo = slo
         self._httpd = None
         self._thread = None
 
@@ -386,14 +564,28 @@ class MetricsExporter:
                                run_dir=self.run_dir,
                                registry=self.registry,
                                router=self.router,
-                               sources=self.sources)
+                               sources=self.sources,
+                               slo=self.slo)
+
+    def render_statusz(self, fmt="html"):
+        """The /statusz body: live fleet topology + SLO pane (the
+        pane ``tools/fleet_report.py`` only reconstructs post-mortem).
+        ``fmt="json"`` returns the machine-readable form."""
+        import json as _json
+
+        data = statusz_data(router=self.router, slo=self.slo,
+                            engines=self.engines)
+        if fmt == "json":
+            return _json.dumps(data, default=str, indent=1)
+        return render_statusz_html(data)
 
     def write_textfile(self, path):
         return write_textfile(path, engines=self.engines,
                               run_dir=self.run_dir,
                               registry=self.registry,
                               router=self.router,
-                              sources=self.sources)
+                              sources=self.sources,
+                              slo=self.slo)
 
     @property
     def url(self):
@@ -410,18 +602,32 @@ class MetricsExporter:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib handler contract)
-                if self.path.split("?")[0] not in ("/metrics", "/"):
+                path, _, query = self.path.partition("?")
+                if path == "/statusz":
+                    fmt = "json" if "format=json" in query else "html"
+                    ctype = ("application/json; charset=utf-8"
+                             if fmt == "json"
+                             else "text/html; charset=utf-8")
+                    try:
+                        body = exporter.render_statusz(fmt) \
+                            .encode("utf-8")
+                    except Exception as e:
+                        self.send_error(500,
+                                        f"{type(e).__name__}: {e}")
+                        return
+                elif path in ("/metrics", "/"):
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    try:
+                        body = exporter.render().encode("utf-8")
+                    except Exception as e:  # surface, don't kill
+                        self.send_error(500,
+                                        f"{type(e).__name__}: {e}")
+                        return
+                else:
                     self.send_error(404)
                     return
-                try:
-                    body = exporter.render().encode("utf-8")
-                except Exception as e:  # surface, don't kill the server
-                    self.send_error(500, f"{type(e).__name__}: {e}")
-                    return
                 self.send_response(200)
-                self.send_header(
-                    "Content-Type",
-                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

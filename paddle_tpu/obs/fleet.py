@@ -52,7 +52,7 @@ __all__ = [
     "load_journal", "load_fleet", "align_steps", "step_skew",
     "StragglerDetector", "detect_stragglers", "stall_attribution",
     "request_summary", "merged_request_summary", "elastic_summary",
-    "router_summary", "per_rank_summary", "aggregate",
+    "router_summary", "slo_summary", "per_rank_summary", "aggregate",
     "heartbeat_ages", "merge_chrome_traces", "rank_subdir",
 ]
 
@@ -550,6 +550,68 @@ def router_summary(run):
                   "ttft_p99_ms"):
             out[k] = summary.get(k)
         out["tenants"] = summary.get("tenants") or {}
+    return out
+
+
+def slo_summary(run):
+    """SLO columns over a run's ``slo.*`` events (written by
+    ``obs.slo.SLOEvaluator``): the chronological fire/clear timeline,
+    per-alert (``objective/severity``) fire/clear counts, which alerts
+    are still latched at end-of-run, and the LAST ``slo.summary`` truth
+    (budget remaining, burn). None when the run was never evaluated.
+    (Canonical home of the timeline ``tools/slo_report.py`` renders.)
+    """
+    if not run:
+        return None
+    events = [e for e in run.get("events") or []
+              if str(e.get("kind", "")).startswith("slo.")]
+    if not events:
+        return None
+    timeline = []
+    per = {}
+    for e in events:
+        kind = e.get("kind")
+        if kind not in ("slo.fire", "slo.clear"):
+            continue
+        obj = e.get("objective")
+        # keyed per (objective, severity): the page clearing must not
+        # mask a warn that is still latched on the same objective
+        alert = f"{obj}/{e.get('severity')}"
+        row = per.setdefault(alert, {"fires": 0, "clears": 0,
+                                     "active": False})
+        if kind == "slo.fire":
+            row["fires"] += 1
+            row["active"] = True
+        else:
+            row["clears"] += 1
+            row["active"] = False
+        timeline.append({
+            "at": e.get("at"), "kind": kind, "objective": obj,
+            "severity": e.get("severity"),
+            "burn_short": e.get("burn_short"),
+            "burn_long": e.get("burn_long"),
+            "windows": f"{e.get('window_short')}+"
+                       f"{e.get('window_long')}",
+            "threshold": e.get("threshold"),
+            "worst_replica": e.get("worst_replica"),
+            "budget_remaining": e.get("budget_remaining"),
+        })
+    timeline.sort(key=lambda r: (r["at"] is None, r["at"]))
+    summary = None
+    for e in events:
+        if e.get("kind") == "slo.summary":
+            summary = e   # last wins: the final truth
+    out = {
+        "fires": sum(r["fires"] for r in per.values()),
+        "clears": sum(r["clears"] for r in per.values()),
+        "active_at_end": sorted(a for a, r in per.items()
+                                if r["active"]),
+        "alerts": per,
+        "timeline": timeline,
+        "summary": None if summary is None
+        else summary.get("objectives"),
+        "ticks": None if summary is None else summary.get("ticks"),
+    }
     return out
 
 

@@ -31,7 +31,7 @@ from __future__ import annotations
 import re
 import time
 
-__all__ = ["Autoscaler"]
+__all__ = ["Autoscaler", "per_replica_slo_from_scrape"]
 
 # exposition keys (obs.export naming): one place, shared with the
 # signal parser's regexes below
@@ -44,6 +44,28 @@ _RUNNING_RE = re.compile(
     r"^paddle_tpu_serving_slo_running\{[^}]*\breplica=\"([^\"]*)\"")
 _ENGINE_QUEUE_RE = re.compile(
     r"^paddle_tpu_serving_slo_queue_depth\{")
+
+
+def per_replica_slo_from_scrape(text):
+    """Per-replica SLO latencies from the same exposition
+    :meth:`Autoscaler.signals_from_scrape` reads, UNpooled:
+    ``{replica: {"ttft_p99_ms": v, "tpot_p50_ms": v, ...}}``. The
+    attribution complement of the autoscaler's worst-of signal — the
+    SLO evaluator's worst-offender lookup and the /statusz per-replica
+    table both read this."""
+    from ...obs.export import parse_prometheus_text
+
+    vals = text if isinstance(text, dict) \
+        else parse_prometheus_text(text)
+    out = {}
+    for key, v in vals.items():
+        m = _SLO_RE.match(key)
+        if not m:
+            continue
+        rep = m.group("rep")
+        out.setdefault(rep, {})[
+            f"{m.group(1)}_{m.group('q')}_ms"] = v
+    return out
 
 
 class Autoscaler:

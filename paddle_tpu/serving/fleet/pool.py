@@ -630,6 +630,26 @@ class ReplicaPool:
     def active(self):
         return [r for r in self.replicas if r.accepting]
 
+    def topology(self):
+        """The fleet's live shape as plain data: one row per replica
+        (current AND retired) with id, state, incarnation (the
+        supervisor attempt — a relaunch bumps it), load, and the last
+        failure reason. The /statusz fleet table (``obs.export``)
+        renders exactly this."""
+        rows = []
+        for rep in list(self.replicas) + list(self.retired):
+            rows.append({
+                "replica": rep.replica_id, "state": rep.state,
+                "incarnation": rep.attempt,
+                "outstanding_tokens": rep.outstanding_tokens,
+                "inflight": rep.inflight_count,
+                "mode": ("process" if isinstance(rep, ProcessReplica)
+                         else "local"),
+                "last_failure": getattr(rep, "last_failure", None),
+            })
+        rows.sort(key=lambda r: (r["replica"], r["incarnation"]))
+        return rows
+
     def local_engines(self):
         return [r.engine for r in self.replicas
                 if isinstance(r, LocalReplica)

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import itertools
 import time
+from collections import deque
 
 from ...obs import journal as _journal
 from ...obs import metrics as _metrics
@@ -198,7 +199,7 @@ class Router:
 
     def __init__(self, pool, clock=None, tenants=None,
                  max_outstanding_per_replica=None, autoscaler=None,
-                 autoscale_interval_s=1.0):
+                 autoscale_interval_s=1.0, slo=None):
         self.pool = pool
         self.clock = clock if clock is not None \
             else getattr(pool, "default_clock", time.monotonic)
@@ -213,6 +214,15 @@ class Router:
         # hundreds of times per cooldown window for guaranteed no-ops
         self.autoscale_interval_s = float(autoscale_interval_s)
         self._next_autoscale_t = None
+        # live SLO engine (obs.slo.SLOEvaluator): fed on the SAME
+        # throttled tick from the SAME exposition the autoscaler
+        # consumes — attaching SLO evaluation adds ZERO scrapes. With
+        # slo=None the serve loop never touches obs.slo/obs.timeseries
+        # (the zero-overhead poison test pins it).
+        self.slo = slo
+        # bounded plain-data trail of scale/requeue decisions for the
+        # live /statusz pane (the journal stays the durable record)
+        self.recent_events = deque(maxlen=64)
         self._queues = {}      # tenant -> [FleetRequest] arrival order
         self._buckets = {}     # tenant -> ((rate, burst), TokenBucket|None)
         self._default_policy = TenantPolicy()
@@ -503,6 +513,10 @@ class Router:
                 _journal.ACTIVE.event(
                     "router.requeue", replica=rep.replica_id,
                     reason=reason, rids=[r.rid for r in stranded])
+            self.recent_events.append(
+                {"t": now, "kind": "requeue",
+                 "replica": rep.replica_id, "reason": reason,
+                 "requeued": len(stranded)})
             if rep.draining:
                 self.pool.retire(rep)
             else:
@@ -533,16 +547,21 @@ class Router:
                 continue  # a mid-restart replica just misses one tick
         return _export.merge_expositions(texts)
 
-    def autoscale_tick(self, now=None):
+    def autoscale_tick(self, now=None, exposition=None):
         """One autoscaler observation over the live scrape: ``"up"``
         launches a warm replica, ``"down"`` DRAINS the least-loaded one
-        (never kills mid-decode; ``poll`` retires it once empty)."""
+        (never kills mid-decode; ``poll`` retires it once empty).
+        ``exposition`` lets ``step()`` hand in the text it already
+        built for this tick (shared with the SLO evaluator) instead of
+        paying a second scrape sweep."""
         if self.autoscaler is None:
             return None
         from .autoscale import Autoscaler
 
         now = self.clock() if now is None else now
-        signals = Autoscaler.signals_from_scrape(self.exposition())
+        if exposition is None:
+            exposition = self.exposition()
+        signals = Autoscaler.signals_from_scrape(exposition)
         signals.setdefault("queue_depth", float(self.queue_depth))
         n = len(self.pool.active())
         # the pool's own max_replicas can sit BELOW the autoscaler's,
@@ -564,6 +583,9 @@ class Router:
                 _journal.ACTIVE.event("router.scale", direction="up",
                                       replica=rep.replica_id,
                                       replicas=len(self.pool.active()))
+            self.recent_events.append(
+                {"t": now, "kind": "scale_up",
+                 "replica": rep.replica_id})
         elif decision == "down":
             active = self.pool.active()
             if len(active) > 1:
@@ -577,6 +599,9 @@ class Router:
                         "router.scale", direction="down",
                         replica=rep.replica_id,
                         replicas=len(self.pool.active()))
+                self.recent_events.append(
+                    {"t": now, "kind": "scale_down",
+                     "replica": rep.replica_id})
             else:
                 decision = None  # never drain the last replica
         return decision
@@ -591,11 +616,18 @@ class Router:
         self.dispatch(now)
         self.pool.pump()
         done = self.poll(now)
-        if self.autoscaler is not None and (
-                self._next_autoscale_t is None
-                or now >= self._next_autoscale_t):
+        if (self.autoscaler is not None or self.slo is not None) \
+                and (self._next_autoscale_t is None
+                     or now >= self._next_autoscale_t):
             self._next_autoscale_t = now + self.autoscale_interval_s
-            self.autoscale_tick(now)
+            # ONE exposition per throttled tick, shared by the
+            # autoscaler and the SLO evaluator: attaching SLO
+            # monitoring must not change the scrape budget
+            text = self.exposition()
+            if self.autoscaler is not None:
+                self.autoscale_tick(now, exposition=text)
+            if self.slo is not None:
+                self.slo.observe(text=text, now=now)
         return done
 
     def run_until_drained(self, timeout_s=120.0, sleep_s=0.0):
@@ -699,4 +731,6 @@ class Router:
         """Journal the summary and shut the pool down (drain-free stop:
         callers wanting a graceful end drain first)."""
         self.journal_summary()
+        if self.slo is not None:
+            self.slo.journal_summary()
         self.pool.shutdown()

@@ -478,3 +478,111 @@ class TestExporter:
         assert "paddle_tpu_unit_test_ms_sum 55.5" in text
         assert "# TYPE paddle_tpu_unit_hits counter" in text
         assert "paddle_tpu_unit_hits 7.0" in text
+
+
+class TestExpositionRoundTrip:
+    """ISSUE 19: the fleet signal plane's scrape/merge algebra. The
+    new ``slo_*`` gauge families ride the same merged exposition the
+    autoscaler and the SLO evaluator read — a merge that wrongly
+    summed their labeled series (or broke histogram ``_bucket``
+    cumulativity) would silently corrupt burn rates fleet-wide."""
+
+    def _evaluator_with_signal(self):
+        from paddle_tpu.obs import slo as obs_slo
+        from paddle_tpu.serving import ManualClock
+
+        clock = ManualClock()
+        ev = obs_slo.SLOEvaluator(
+            {"availability": 0.99}, clock=clock, interval_s=60.0,
+            include_registry=False)
+        rej, disp = 0.0, 0.0
+        for i in range(49):   # 40 clean ticks, then 9 at 50% rejects
+            bad = 50 if i >= 40 else 0
+            rej += bad
+            disp += 100 - bad
+            clock.advance(60.0)
+            ev.observe(
+                text={"serving.router.rejected": ("counter", rej),
+                      "serving.router.dispatched": ("counter", disp)},
+                now=clock())
+        return ev
+
+    def test_merge_passes_labeled_slo_gauges_verbatim(self):
+        """A fleet front-end merges its own exposition (registry +
+        live SLO engine) with a remote replica's: every ``slo_*``
+        burn/budget/alert gauge and every per-replica latency gauge is
+        a single-source labeled series, so it must survive the merge
+        VERBATIM (bitwise equal to the evaluator's float) — while
+        identical unlabeled counter keys across sources sum."""
+        ev = self._evaluator_with_signal()
+        rega = obs.metrics.Registry()
+        rega.counter("unit.hits").inc(3)
+        regb = obs.metrics.Registry()
+        regb.counter("unit.hits").inc(4)
+        local = obs_export.prometheus_text(engines=[], registry=rega,
+                                           slo=ev)
+        eng = _manual_clock_engine()
+        remote = obs_export.prometheus_text(engines=[eng],
+                                            registry=regb)
+        vals = obs_export.parse_prometheus_text(
+            obs_export.merge_expositions([local, remote]))
+
+        for w in ("1m", "5m", "30m", "3h"):
+            key = (f'paddle_tpu_slo_burn_rate{{objective='
+                   f'"availability",window="{w}"}}')
+            assert vals[key] == ev.burn[("availability", w)]
+        assert vals['paddle_tpu_slo_budget_remaining'
+                    '{objective="availability"}'] == \
+            ev.budget_left["availability"]
+        # 9 bad ticks at 50%: both ladder rungs are latched
+        for sev in ("page", "warn"):
+            assert vals[f'paddle_tpu_slo_alert_active{{objective='
+                        f'"availability",severity="{sev}"}}'] == 1.0
+        st = eng.stats()
+        assert vals[f'paddle_tpu_serving_slo_ttft_ms'
+                    f'{{replica="{eng.replica_id}",q="p99"}}'] == \
+            st["ttft_ms"]["p99"]
+        assert vals["paddle_tpu_unit_hits"] == 7.0  # 3 + 4, summed
+
+    def test_merged_histograms_keep_the_cumulative_invariant(self):
+        """Merging two replicas' expositions of the same histogram
+        family must yield a series that is still a valid Prometheus
+        histogram: per-``le`` values summed, non-decreasing in bound
+        order, ``_bucket{le="+Inf"} == _count``, ``# TYPE`` declared
+        once — and ``timeseries.exposition_snapshot`` must reconstruct
+        the pooled bucket layout from the merged text."""
+        from paddle_tpu.obs import timeseries as obs_ts
+
+        rega = obs.metrics.Registry()
+        for v in (0.5, 5.0, 50.0, 500.0):
+            rega.histogram("unit.lat_ms",
+                           buckets=(1.0, 10.0, 100.0)).observe(v)
+        regb = obs.metrics.Registry()
+        for v in (0.7, 7.0, 7.0):
+            regb.histogram("unit.lat_ms",
+                           buckets=(1.0, 10.0, 100.0)).observe(v)
+        texts = ["\n".join(obs_export.registry_lines(r)) + "\n"
+                 for r in (rega, regb)]
+        merged = obs_export.merge_expositions(texts)
+        vals = obs_export.parse_prometheus_text(merged)
+
+        n = "paddle_tpu_unit_lat_ms"
+        series = [vals[f'{n}_bucket{{le="1.0"}}'],
+                  vals[f'{n}_bucket{{le="10.0"}}'],
+                  vals[f'{n}_bucket{{le="100.0"}}'],
+                  vals[f'{n}_bucket{{le="+Inf"}}']]
+        assert series == [2.0, 5.0, 6.0, 7.0]
+        assert all(a <= b for a, b in zip(series, series[1:]))
+        assert series[-1] == vals[n + "_count"] == 7.0
+        va, vb = (obs_export.parse_prometheus_text(t) for t in texts)
+        assert vals[n + "_sum"] == va[n + "_sum"] + vb[n + "_sum"]
+        assert merged.count(f"# TYPE {n} histogram") == 1
+
+        kind, (bounds, cum, count, total) = \
+            obs_ts.exposition_snapshot(merged)[n]
+        assert kind == "histogram"
+        assert bounds == (1.0, 10.0, 100.0)
+        # 3 finite bounds + the overflow slot (derived from _count,
+        # never from the parsed +Inf line)
+        assert cum == (2, 5, 6, 7)
+        assert count == 7 and total == vals[n + "_sum"]

@@ -309,6 +309,26 @@ class TestInactiveHooksDoNothing:
                      "attribute_run", "tail_report",
                      "request_lane_events", "write_request_trace"):
             monkeypatch.setattr(obs_reqtrace, name, boom)
+        # the SLO engine (PR 19) is strictly opt-in: with no evaluator
+        # installed on the router and no statusz consumer, nothing on a
+        # step/serve path may window a snapshot, evaluate a burn rate,
+        # or render the status plane
+        from paddle_tpu.obs import slo as obs_slo
+        from paddle_tpu.obs import timeseries as obs_timeseries
+
+        monkeypatch.setattr(obs_timeseries.SeriesStore, "observe", boom)
+        monkeypatch.setattr(obs_timeseries.SeriesStore, "sample", boom)
+        monkeypatch.setattr(obs_timeseries, "registry_snapshot", boom)
+        monkeypatch.setattr(obs_timeseries, "exposition_snapshot", boom)
+        monkeypatch.setattr(obs_slo.SLOEvaluator, "observe", boom)
+        monkeypatch.setattr(obs_slo, "evaluate_run", boom)
+        monkeypatch.setattr(obs_slo, "load_any", boom)
+        monkeypatch.setattr(obs_export, "statusz_data", boom)
+        monkeypatch.setattr(obs_export, "render_statusz_html", boom)
+        monkeypatch.setattr(obs_export, "slo_engine_lines", boom)
+        monkeypatch.setattr(obs_export.MetricsExporter,
+                            "render_statusz", boom)
+        monkeypatch.setattr(obs_fleet, "slo_summary", boom)
 
         pt.enable_static()
         try:
@@ -523,6 +543,32 @@ class TestDetectors:
         assert det.update({"step_ms": 50.0}) is None  # same slowdown
         assert det.update({"step_ms": 10.0}) is None  # recovery re-arms
         assert det.update({"step_ms": 55.0})
+
+    def test_ttft_spike_and_rearm(self):
+        det = anomaly.TtftSpike(window=8, factor=6.0, min_steps=4,
+                                floor_ms=0.5)
+        for i in range(6):
+            assert det.update({"ttft_ms": 10.0 + 0.1 * i}) is None
+        assert det.update({"ttft_ms": 200.0})
+        # a sustained latency excursion fires ONCE; recovery re-arms
+        assert det.update({"ttft_ms": 250.0}) is None
+        assert det.update({"ttft_ms": 10.0}) is None
+        assert det.update({"ttft_ms": 200.0})
+        # records without a TTFT field (training steps) are ignored
+        assert det.update({"loss": 1.0, "step_ms": 5.0}) is None
+
+    def test_serving_detectors_env_spec(self):
+        dets = anomaly.serving_detectors("")
+        assert sorted(d.name for d in dets) == \
+            sorted(anomaly.SERVING_DETECTORS)
+        tuned = anomaly.serving_detectors(
+            "ttft_spike:factor=3;loss_spike:factor=99")
+        spike = [d for d in tuned
+                 if isinstance(d, anomaly.TtftSpike)][0]
+        # non-serving names in the shared env spec are ignored here
+        assert spike.factor == 3.0
+        assert not any(isinstance(d, anomaly.LossSpike) for d in tuned)
+        assert anomaly.serving_detectors("off") == []
 
     def test_starvation_ratio_and_rearm(self):
         det = anomaly.DataloaderStarvation(ratio=0.5, min_wait_ms=1.0,

@@ -1,0 +1,539 @@
+"""SLO engine (ISSUE 19): windowed time-series over the metrics plane
+(obs.timeseries), error-budget burn-rate alerting (obs.slo), and the
+live fleet statusz plane (obs.export /statusz).
+
+Covers the PR's acceptance contract:
+- window math is EXACT under a ManualClock: counter deltas/rates,
+  gauge trends, windowed histogram percentiles and threshold
+  fractions (exact at bucket bounds), identical over in-process
+  registry snapshots and scraped exposition text;
+- the Google-SRE multi-window burn-rate ladder fires the fast page at
+  the hand-computed instant, clears on recovery, never double-fires
+  while latched — and both the journaled ``slo.fire`` burn values and
+  the scraped ``slo_burn_rate`` gauge are BITWISE the evaluator's
+  floats;
+- the live fleet drill: a 2-replica routed fleet with one degraded
+  replica pages with that replica attributed as worst offender on
+  /statusz and in the ``slo.fire`` event, the evaluator rides the
+  router's EXISTING throttled autoscale exposition (scrape budget
+  unchanged), and ``tools/slo_report.py`` reconstructs the same
+  alert timeline from the journals post-hoc.
+"""
+import json
+import os
+import urllib.request
+
+import pytest
+
+from paddle_tpu import obs
+from paddle_tpu.obs import export as obs_export
+from paddle_tpu.obs import fleet as obs_fleet
+from paddle_tpu.obs import journal
+from paddle_tpu.obs import slo as obs_slo
+from paddle_tpu.obs import timeseries as obs_ts
+from paddle_tpu.serving import ManualClock
+
+
+@pytest.fixture(autouse=True)
+def _no_global_journal():
+    yield
+    if journal.ACTIVE is not None:
+        journal.ACTIVE.close()
+    journal.ACTIVE = None
+
+
+def _hist_payload(buckets, flat_counts, total=None):
+    """Snapshot-shaped histogram payload from per-bucket (plus
+    overflow) counts."""
+    cum, c = [], 0
+    for n in flat_counts:
+        c += n
+        cum.append(c)
+    return ("histogram", (tuple(buckets), tuple(cum), c,
+                          float(total if total is not None else 0.0)))
+
+
+# -- the windowing layer ------------------------------------------------------
+
+
+class TestSeriesStore:
+    def test_counter_delta_and_rate_are_exact(self):
+        clock = ManualClock()
+        store = obs_ts.SeriesStore(interval_s=1.0, clock=clock)
+        for i in range(11):
+            store.observe({"req": ("counter", float(5 * i))},
+                          now=float(i))
+        assert store.counter_delta("req", 4.0, now=10.0) == 20.0
+        assert store.counter_rate("req", 4.0, now=10.0) == 5.0
+        # a window predating history falls back to the oldest sample
+        # (partial windows read what exists, the budget-accounting rule)
+        assert store.counter_delta("req", 1e9, now=10.0) == 50.0
+
+    def test_counter_reset_clamps_to_zero(self):
+        store = obs_ts.SeriesStore(clock=ManualClock())
+        store.observe({"req": ("counter", 100.0)}, now=0.0)
+        store.observe({"req": ("counter", 3.0)}, now=1.0)  # restart
+        assert store.counter_delta("req", 10.0, now=1.0) == 0.0
+
+    def test_gauge_last_and_trend(self):
+        store = obs_ts.SeriesStore(clock=ManualClock())
+        store.observe({"depth": ("gauge", 2.0)}, now=0.0)
+        store.observe({"depth": ("gauge", 9.0)}, now=5.0)
+        assert store.gauge_last("depth") == 9.0
+        assert store.gauge_delta("depth", 5.0, now=5.0) == 7.0
+
+    def test_sample_enforces_cadence_observe_does_not(self):
+        clock = ManualClock()
+        store = obs_ts.SeriesStore(interval_s=10.0, clock=clock)
+        calls = []
+
+        def snap():
+            calls.append(1)
+            return {"g": ("gauge", 1.0)}
+
+        assert store.sample(snap, now=0.0) == 0.0
+        assert store.sample(snap, now=3.0) is None  # not due: no call
+        assert len(calls) == 1
+        assert store.sample(snap, now=10.0) == 10.0
+
+    def test_windowed_histogram_percentile_and_fraction(self):
+        store = obs_ts.SeriesStore(clock=ManualClock())
+        buckets = (10.0, 20.0, 40.0)
+        store.observe({"lat": _hist_payload(buckets, (0, 0, 0, 0))},
+                      now=0.0)
+        # inside the window: 6 obs <=10, 2 in (10,20], 2 in (20,40]
+        store.observe({"lat": _hist_payload(buckets, (6, 2, 2, 0))},
+                      now=60.0)
+        b, counts, count, _ = store.hist_window("lat", 60.0, now=60.0)
+        assert b == buckets and counts == (6, 2, 2, 0) and count == 10
+        assert store.percentile("lat", 50, 60.0, now=60.0) == \
+            pytest.approx(8.333333333333334)
+        # threshold AT a bucket bound is exact: 2 of 10 strictly above
+        assert store.fraction_above("lat", 20.0, 60.0, now=60.0) == \
+            (2.0, 10.0)
+        # between bounds it is conservative: the straddling (10,20]
+        # bucket counts as above
+        assert store.fraction_above("lat", 15.0, 60.0, now=60.0) == \
+            (4.0, 10.0)
+
+    def test_hist_window_is_a_true_delta(self):
+        store = obs_ts.SeriesStore(clock=ManualClock())
+        buckets = (10.0, 20.0)
+        store.observe({"lat": _hist_payload(buckets, (5, 1, 0))},
+                      now=0.0)
+        store.observe({"lat": _hist_payload(buckets, (5, 4, 2))},
+                      now=30.0)
+        _, counts, count, _ = store.hist_window("lat", 30.0, now=30.0)
+        assert counts == (0, 3, 2) and count == 5
+
+    def test_exposition_snapshot_matches_registry_snapshot(self):
+        """The multi-process path and the in-process path must produce
+        the SAME windowed numbers: snapshotting a registry directly and
+        snapshotting its rendered exposition text are interchangeable
+        SeriesStore feeds (histogram bucket layout included — the +Inf
+        bucket folds into the overflow slot, never into the bounds)."""
+        reg = obs.metrics.Registry()
+        h = reg.histogram("unit.lat_ms", buckets=(1.0, 10.0))
+        for v in (0.5, 5.0, 50.0, 7.0):
+            h.observe(v)
+        reg.counter("unit.hits").inc(7)
+        reg.gauge("unit.depth").set(3.0)
+
+        direct = obs_ts.registry_snapshot(reg)
+        text = "\n".join(obs_export.registry_lines(reg)) + "\n"
+        scraped = obs_ts.exposition_snapshot(text)
+
+        assert scraped["paddle_tpu_unit_hits"] == ("counter", 7.0)
+        assert scraped["paddle_tpu_unit_depth"] == ("gauge", 3.0)
+        kind, (b, cum, count, total) = \
+            scraped["paddle_tpu_unit_lat_ms"]
+        dkind, (db, dcum, dcount, dtotal) = direct["unit.lat_ms"]
+        assert kind == dkind == "histogram"
+        assert b == db == (1.0, 10.0)
+        assert cum == dcum == (1, 3, 4)
+        assert count == dcount == 4 and total == dtotal == 62.5
+
+    def test_retention_is_bounded_by_horizon(self):
+        store = obs_ts.SeriesStore(interval_s=1.0, horizon_s=10.0,
+                                   clock=ManualClock())
+        for i in range(100):
+            store.observe({"g": ("gauge", float(i))}, now=float(i))
+        ring = store._rings["g"]
+        assert len(ring.samples) <= 12
+
+
+# -- burn-rate alerting -------------------------------------------------------
+
+
+def _availability_fixture(clock, ev):
+    """40 clean warmup ticks, then bad ticks at 50% rejects: 60 s
+    ticks, 100 requests/tick (the tools/slo_report.py fixture)."""
+    state = {"rej": 0.0, "disp": 0.0}
+
+    def tick(n_rej, n_disp):
+        state["rej"] += n_rej
+        state["disp"] += n_disp
+        clock.advance(60.0)
+        return ev.observe(
+            text={"serving.router.rejected":
+                  ("counter", state["rej"]),
+                  "serving.router.dispatched":
+                  ("counter", state["disp"])},
+            now=clock())
+
+    for _ in range(40):
+        tick(0, 100)
+    return tick
+
+
+class TestBurnRateAlerting:
+    def test_page_fires_at_hand_computed_instant_and_is_bitwise(
+            self, tmp_path):
+        """The acceptance core: under ManualClock the 14.4x page fires
+        at the 9th bad tick with burn values BITWISE equal to the
+        hand-computed fractions, the journaled slo.fire carries the
+        same floats, it never refires while latched, and it clears at
+        the 4th clean tick."""
+        run_dir = str(tmp_path / "run")
+        j = journal.start_run(run_dir)
+        clock = ManualClock()
+        ev = obs_slo.SLOEvaluator({"availability": 0.99}, clock=clock,
+                                  interval_s=60.0,
+                                  include_registry=False)
+        tick = _availability_fixture(clock, ev)
+        budget = 1.0 - 0.99
+
+        page_fires = []
+        for k in range(1, 13):
+            for t in tick(50, 50):
+                if t["kind"] == "slo.fire" and t["severity"] == "page":
+                    page_fires.append((k, t))
+        assert [k for k, _ in page_fires] == [9]
+        fire = page_fires[0][1]
+        # 5m window: 5 all-bad ticks -> frac 250/500; 30m window: 9 of
+        # 30 ticks bad -> frac 450/3000. Bitwise, not approx.
+        assert fire["burn_short"] == (250.0 / 500.0) / budget
+        assert fire["burn_long"] == (450.0 / 3000.0) / budget
+        assert ev._alerts[("availability", "page")]["fires"] == 1
+
+        page_clears = []
+        for m in range(1, 8):
+            for t in tick(0, 100):
+                if t["kind"] == "slo.clear" and \
+                        t["severity"] == "page":
+                    page_clears.append(m)
+        assert page_clears == [4]
+
+        # the scraped gauges parse back to EXACTLY the evaluator floats
+        vals = obs_export.parse_prometheus_text(
+            obs_export.prometheus_text(slo=ev))
+        for label in ("1m", "5m", "30m", "3h"):
+            key = (f'paddle_tpu_slo_burn_rate{{objective='
+                   f'"availability",window="{label}"}}')
+            assert vals[key] == ev.burn[("availability", label)]
+        assert vals['paddle_tpu_slo_budget_remaining'
+                    '{objective="availability"}'] == \
+            ev.budget_left["availability"]
+
+        # the journal carries the identical floats
+        ev.journal_summary()
+        j.close()
+        journal.ACTIVE = None
+        run = obs_fleet.load_journal(run_dir)
+        fires = [e for e in run["events"]
+                 if e.get("kind") == "slo.fire"
+                 and e.get("severity") == "page"]
+        assert len(fires) == 1
+        assert fires[0]["burn_short"] == fire["burn_short"]
+        assert fires[0]["burn_long"] == fire["burn_long"]
+
+    def test_no_signal_means_no_alert(self):
+        ev = obs_slo.SLOEvaluator({"availability": 0.99},
+                                  clock=ManualClock(),
+                                  include_registry=False)
+        assert ev.observe(text={}, now=1.0) == []
+        assert ev.burn[("availability", "5m")] is None
+        assert ev.active_alerts() == []
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            obs_slo.SLOSpec("bad", "latency", target=1.0,
+                            threshold_ms=1.0)  # zero budget
+        with pytest.raises(ValueError):
+            obs_slo.SLOSpec("bad", "nonsense")
+        with pytest.raises(ValueError):
+            obs_slo.SLOSpec("bad", "latency")  # no threshold
+        with pytest.raises(KeyError):
+            obs_slo.specs_from_dict({"nope": 1})
+        with pytest.raises(ValueError):
+            obs_slo.AlertPolicy("page", "30m", "5m", 2.0)  # inverted
+
+    def test_evaluate_run_post_hoc(self, tmp_path):
+        """The post-hoc twin: exact pooled percentiles + availability
+        from reject events + goodput from the serving-clock span."""
+        run_dir = str(tmp_path / "run")
+        j = journal.RunJournal(run_dir, flush_every=1,
+                               compute_flops=False).start()
+        for i, ttft_s in enumerate((0.1, 0.2, 0.4, 0.8)):
+            j.record_request(rid=f"r{i}", state="FINISHED",
+                             arrival_t=0.0, first_token_t=ttft_s,
+                             finish_t=10.0, prompt_tokens=4,
+                             output_tokens=5)
+        j.event("router.reject", rid="rX", reason="queue_full")
+        j.close()
+
+        rep = obs_slo.evaluate_run(
+            run_dir, {"ttft_p99_ms": 500.0, "availability": 0.9,
+                      "goodput_tps": 1.0})
+        rows = {r["name"]: r for r in rep["objectives"]}
+        assert rows["ttft_p99_ms"]["value"] == 800.0
+        assert rows["ttft_p99_ms"]["ok"] is False
+        assert rows["availability"]["value"] == 1.0 - 1.0 / 5.0
+        assert rows["availability"]["ok"] is False
+        assert rows["goodput_tps"]["value"] == 20.0 / 10.0
+        assert rows["goodput_tps"]["ok"] is True
+        assert rep["violations"] == ["ttft_p99_ms", "availability"]
+
+        # tightening nothing: an empty run dir has no journals at all
+        with pytest.raises(FileNotFoundError):
+            obs_slo.evaluate_run(str(tmp_path / "empty"),
+                                 {"availability": 0.9})
+
+    def test_serving_anomaly_detectors_fire_on_windowed_spike(self):
+        """The evaluator's tick record reaches the serving anomaly
+        detectors: a stable windowed TTFT p99 followed by a spike fires
+        ``ttft_spike`` exactly once per excursion."""
+        from paddle_tpu.obs import anomaly
+
+        clock = ManualClock()
+        eng = anomaly.AnomalyEngine(
+            detectors=anomaly.serving_detectors(""))
+        ev = obs_slo.SLOEvaluator(
+            {"ttft_p99_ms": 250.0}, clock=clock, interval_s=10.0,
+            include_registry=False, anomaly_engine=eng)
+        buckets = (10.0, 1000.0)
+        flat = [0, 0, 0]
+
+        def tick(bucket_idx, n=10):
+            flat[bucket_idx] += n
+            clock.advance(10.0)
+            ev.observe(
+                text={"serving.ttft_ms":
+                      _hist_payload(buckets, tuple(flat))},
+                now=clock())
+
+        for _ in range(8):
+            tick(0)        # stable: p99 inside the <=10ms bucket
+        assert eng.fired == []
+        tick(1)            # excursion: p99 jumps into (10,1000]
+        assert [f["name"] for f in eng.fired] == ["ttft_spike"]
+        tick(1)            # sustained: latched, no refire
+        assert len(eng.fired) == 1
+
+
+# -- statusz ------------------------------------------------------------------
+
+
+class TestStatusz:
+    def _evaluator_with_signal(self):
+        clock = ManualClock()
+        ev = obs_slo.SLOEvaluator({"availability": 0.99}, clock=clock,
+                                  interval_s=60.0,
+                                  include_registry=False)
+        tick = _availability_fixture(clock, ev)
+        for _ in range(10):
+            tick(50, 50)   # page + warn latched
+        return ev
+
+    def test_statusz_data_and_html(self):
+        ev = self._evaluator_with_signal()
+        data = obs_export.statusz_data(slo=ev)
+        assert data["slo"]["active_alerts"]
+        objs = {o["name"]: o for o in data["slo"]["objectives"]}
+        assert objs["availability"]["burn"]["5m"] == \
+            ev.burn[("availability", "5m")]
+        html = obs_export.render_statusz_html(data)
+        assert html.startswith("<!DOCTYPE html>")
+        assert "FIRING: availability" in html
+        assert "SLO burn" in html
+
+    def test_http_statusz_endpoint_html_and_json(self):
+        ev = self._evaluator_with_signal()
+        exp = obs_export.MetricsExporter(engines=[], slo=ev)
+        port = exp.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            with urllib.request.urlopen(base + "/statusz",
+                                        timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith(
+                    "text/html")
+                html = resp.read().decode("utf-8")
+            with urllib.request.urlopen(
+                    base + "/statusz?format=json", timeout=10) as resp:
+                assert resp.headers["Content-Type"].startswith(
+                    "application/json")
+                data = json.loads(resp.read().decode("utf-8"))
+            with urllib.request.urlopen(base + "/nope",
+                                        timeout=10) as resp:
+                pass
+        except urllib.error.HTTPError as e:
+            assert e.code == 404   # the unknown path, not the others
+        finally:
+            exp.stop()
+        assert "FIRING" in html
+        # the JSON body carries the live burn values, not a rendering
+        objs = {o["name"]: o for o in data["slo"]["objectives"]}
+        assert objs["availability"]["burn"]["5m"] == \
+            ev.burn[("availability", "5m")]
+
+
+# -- the fleet drill ----------------------------------------------------------
+
+
+class TestFleetDrill:
+    def test_degraded_replica_pages_with_worst_offender_attribution(
+            self, tmp_path):
+        """ISSUE 19 acceptance drill: 2 local replicas under a routed
+        ManualClock fleet, one degraded (stalled for the first 12
+        router iterations, so its early requests wait seconds for
+        their first token while the healthy replica answers within an
+        iteration); the latency
+        page fires with THAT replica attributed worst offender in the
+        slo.fire event and on /statusz; the SLO evaluator consumed the
+        router's EXISTING throttled exposition (exactly one per tick —
+        the autoscaler's scrape budget unchanged); and slo_report
+        reconstructs the identical alert timeline from the run dir."""
+        from paddle_tpu.serving.fleet import (ReplicaPool, ReplicaSpec,
+                                              Router)
+        from paddle_tpu.serving.fleet.autoscale import Autoscaler
+
+        obs.metrics.reset()
+        run_dir = str(tmp_path / "run")
+        j = journal.start_run(run_dir)
+        clock = ManualClock()
+        pool = ReplicaPool(
+            ReplicaSpec(vocab_size=32, pages=64, page_size=4,
+                        max_seq_len=32, token_budget=128),
+            replicas=2, mode="local", clock=clock)
+        ev = obs_slo.SLOEvaluator(
+            {"ttft_p99_ms": {"threshold_ms": 500.0, "target": 0.999}},
+            clock=clock, interval_s=0.5)
+        asc = Autoscaler(min_replicas=2, max_replicas=2, clock=clock)
+        router = Router(pool, clock=clock, autoscaler=asc, slo=ev,
+                        autoscale_interval_s=0.5)
+
+        victim = pool.replicas[1]
+        victim_id = victim.replica_id
+        healthy_id = pool.replicas[0].replica_id
+        real_pump = victim.pump
+        pumps = {"n": 0}
+
+        def stalled_pump(steps=1):
+            # degraded for the first 12 router iterations: anything
+            # dispatched to the victim early waits multi-second for
+            # its first token (ManualClock-deterministic badness),
+            # then the replica recovers and drains
+            pumps["n"] += 1
+            if pumps["n"] <= 12:
+                return 0
+            return real_pump(steps)
+
+        victim.pump = stalled_pump
+
+        expo = {"n": 0}
+        real_expo = router.exposition
+
+        def counting_expo():
+            expo["n"] += 1
+            return real_expo()
+
+        router.exposition = counting_expo
+
+        steps = 0
+        for i in range(40):
+            if i < 2:
+                # pairs: the second of a pair lands on the victim
+                # while the healthy replica holds the first (least-
+                # outstanding placement), and the light load keeps
+                # every healthy TTFT under the threshold — the ONLY
+                # bad requests are the stalled victim's
+                # max_new_tokens=1: first token == finish, so the
+                # per-replica attribution gauge (finished-request
+                # percentiles) updates in the SAME tick the registry
+                # histogram records the bad TTFT
+                router.submit([1, 2, 3], max_new_tokens=1)
+                router.submit([1, 2, 3], max_new_tokens=1)
+            router.step()
+            steps += 1
+            clock.advance(0.5)
+        for _ in range(200):
+            if not router.inflight and not router.queue_depth:
+                break
+            router.step()
+            steps += 1
+            clock.advance(0.5)
+        assert not router.inflight and not router.queue_depth
+
+        # scrape budget: ONE exposition per throttled tick, shared by
+        # the autoscaler and the SLO evaluator — attaching SLO
+        # monitoring added zero scrapes (every step ticks here because
+        # the clock advances exactly one interval per step)
+        assert expo["n"] == steps
+        assert ev.ticks == steps
+
+        # the page fired, attributing the degraded replica
+        page_fires = [e for e in ev.alert_log
+                      if e["kind"] == "slo.fire"
+                      and e["severity"] == "page"]
+        assert page_fires, "degraded fleet never paged"
+        assert page_fires[0]["worst_replica"] == str(victim_id)
+        assert ev._alerts[("ttft_p99_ms", "page")]["fires"] == 1
+
+        # /statusz (live HTTP): topology + the same worst offender
+        exp = obs_export.MetricsExporter(engines=[], router=router,
+                                         slo=ev)
+        port = exp.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/statusz?format=json",
+                    timeout=10) as resp:
+                data = json.loads(resp.read().decode("utf-8"))
+        finally:
+            exp.stop()
+        assert {r["replica"] for r in data["fleet"]} >= \
+            {victim_id, healthy_id}
+        per = data["replica_slo"]
+        assert per[str(victim_id)]["ttft_p99_ms"] > \
+            per[str(healthy_id)]["ttft_p99_ms"]
+        log = data["slo"]["alert_log"]
+        assert any(e["kind"] == "slo.fire"
+                   and e["severity"] == "page"
+                   and e["worst_replica"] == str(victim_id)
+                   for e in log)
+
+        router.close()   # journals router.summary + slo.summary
+        j.close()
+        journal.ACTIVE = None
+
+        # post-hoc: slo_report reconstructs the same timeline from the
+        # journals alone
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "slo_report_drill", os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "tools", "slo_report.py"))
+        tool = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tool)
+        rep = tool.report(run_dir)
+        assert rep["slo"] is not None
+        got = [(t["at"], t["kind"], t["objective"], t["severity"],
+                t["worst_replica"])
+               for t in rep["slo"]["timeline"]]
+        want = [(t["at"], t["kind"], t["objective"], t["severity"],
+                 t.get("worst_replica"))
+                for t in ev.alert_log]
+        assert got == want
+        assert rep["slo"]["summary"]["ttft_p99_ms"]["fires"] >= 1

@@ -116,6 +116,20 @@ def test_serve_bench_self_test_passes():
     assert mod.main(["--self-test"]) == 0
 
 
+def test_slo_report_self_test_passes():
+    """tools/slo_report.py --self-test: the ISSUE-19 acceptance core —
+    under a ManualClock the 14.4x fast-burn availability fixture must
+    fire the page at the hand-computed 9th bad tick and clear it at the
+    4th clean tick (the warn at bad tick 6 / clean tick 27), latch
+    exactly once while firing, scrape the slo_burn_rate gauge bitwise-
+    equal to the evaluator's float, and reconstruct the evaluator's
+    alert log from the journaled slo.* events alone; A-vs-A must diff
+    clean. In-process so it rides the tier-1 command path like the
+    other self-tests."""
+    mod = _load_tool("slo_report")
+    assert mod.main(["--self-test"]) == 0
+
+
 def test_request_report_self_test_passes():
     """tools/request_report.py --self-test: the ISSUE-18 acceptance
     core — a real pressured-engine run's journal-derived phase

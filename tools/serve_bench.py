@@ -14,6 +14,7 @@ Usage:
     python tools/serve_bench.py --requests 64 --rate 100 --json
     python tools/serve_bench.py --pages 32 --page-size 8   # pressure
     python tools/serve_bench.py --request-report 5         # tail blame
+    python tools/serve_bench.py --slo '{"ttft_p99_ms": 250}'  # SLO gate
     python tools/serve_bench.py --self-test
 
 --self-test (wired into tier-1 via tests/test_tooling.py, like the
@@ -651,14 +652,27 @@ def main(argv=None):
                          "requests with exact phase attribution "
                          "(rate-limit/router-queue/requeue/sched-"
                          "queue/prefill/preempt/decode)")
+    ap.add_argument("--slo", type=str, default=None, metavar="SPEC",
+                    help="evaluate the run against an SLO spec at "
+                         "exit (inline JSON or @path, e.g. "
+                         '\'{"ttft_p99_ms": 250, "availability": '
+                         "0.999}'); exit 1 on violation — works in "
+                         "single-engine and --replicas mode "
+                         "(tools/slo_report.py renders the same math "
+                         "post-hoc)")
     ap.add_argument("--self-test", action="store_true",
                     help="deterministic kernel/scheduler/engine checks")
     args = ap.parse_args(argv)
     if args.self_test:
         return self_test()
     _ensure_cpu()
+    slo_specs = None
+    if args.slo is not None:
+        from paddle_tpu.obs.slo import parse_spec_arg
+
+        slo_specs = parse_spec_arg(args.slo)
     run_dir = None
-    if args.request_report > 0:
+    if args.request_report > 0 or slo_specs is not None:
         import shutil
         import tempfile
 
@@ -682,17 +696,27 @@ def main(argv=None):
         if run_dir is not None:
             journal.end_run()
     req_rep = None
+    slo_rep = None
     if run_dir is not None:
-        req_rep = request_report(run_dir, args.request_report)
+        if args.request_report > 0:
+            req_rep = request_report(run_dir, args.request_report)
+        if slo_specs is not None:
+            from paddle_tpu.obs.slo import evaluate_run
+
+            slo_rep = evaluate_run(run_dir, slo_specs,
+                                   duration_s=rep.get("wall_s"))
+            rep["slo_violations"] = slo_rep["violations"]
         shutil.rmtree(run_dir, ignore_errors=True)
     if args.json:
         if req_rep is not None:
             rep["request_report"] = req_rep
+        if slo_rep is not None:
+            rep["slo"] = slo_rep["objectives"]
         print(json.dumps(rep, sort_keys=True))
     else:
         for k in sorted(rep):
             v = rep[k]
-            if isinstance(v, dict):
+            if isinstance(v, (dict, list)):
                 print(f"{k:<20} {json.dumps(v, sort_keys=True)}")
             elif isinstance(v, float):
                 print(f"{k:<20} {v:.4g}")
@@ -700,6 +724,18 @@ def main(argv=None):
                 print(f"{k:<20} {v}")
         if args.request_report > 0:
             _print_request_report(req_rep)
+        if slo_rep is not None:
+            for row in slo_rep["objectives"]:
+                tgt = row.get("threshold_ms",
+                              row.get("floor", row.get("target")))
+                verdict = {True: "ok", False: "VIOLATED",
+                           None: "no-data"}[row["ok"]]
+                val = "-" if row["value"] is None \
+                    else f"{row['value']:.4g}"
+                print(f"slo {row['name']:<16} value={val} "
+                      f"target={tgt:g} {verdict}")
+    if slo_rep is not None and slo_rep["violations"]:
+        return 1
     return 0
 
 
