@@ -334,9 +334,16 @@ def bench_serve(requests=48, rate=100.0, pages=256, page_size=16):
     except Exception as e:
         _log(f"serve slo journal failed: {type(e).__name__}: {e}")
         slo_dir = None
+    # weighted two-tenant trace on every round: rates proportional to
+    # weights (3:1), so the measured served-token share should track
+    # the weight share and tenant_share_err stays a near-zero fairness
+    # canary — a scheduler/fairness regression shows up as drift here
+    # before it trips any latency gate
+    tenants = sb.parse_tenants(
+        f"a:rate={0.75 * rate:g},weight=3;b:rate={0.25 * rate:g},weight=1")
     try:
         rep = sb.run_bench(n_requests=requests, rate=rate, pages=pages,
-                           page_size=page_size)
+                           page_size=page_size, tenants=tenants)
     finally:
         if slo_dir is not None:
             _jl.end_run()
@@ -349,6 +356,7 @@ def bench_serve(requests=48, rate=100.0, pages=256, page_size=16):
         "requests": rep["requests"], "finished": rep["finished"],
         "preemptions": rep["preemptions"],
         "kv_fragmentation": rep["kv_fragmentation"],
+        "tenant_share_err": rep.get("tenant_share_err"),
     }
     if slo_dir is not None:
         try:
@@ -443,13 +451,14 @@ def bench_serve(requests=48, rate=100.0, pages=256, page_size=16):
     try:
         rep2 = sb.run_bench_fleet(
             n_requests=min(requests, 24), rate=rate, replicas=2,
-            pages=pages, page_size=page_size)
+            pages=pages, page_size=page_size, tenants=tenants)
         out.update({
             "replicas": rep2["replicas"],
             "router_overhead_ms": rep2["router_overhead_ms"],
             "fleet_tokens_per_sec": rep2["tokens_per_sec"],
             "fleet_ttft_p99_ms": rep2["ttft_p99_ms"],
             "fleet_requeued": rep2["requeued"],
+            "fleet_tenant_share_err": rep2.get("tenant_share_err"),
         })
     except Exception as e:
         _log(f"serve fleet leg failed: {type(e).__name__}: {e}")
@@ -947,6 +956,12 @@ def _score(results, headline, extras):
             extras["serve_tpot_p50_ms"] = round(sv["tpot_p50_ms"], 2)
             extras["serve_tpot_p99_ms"] = round(sv["tpot_p99_ms"], 2)
         extras["serve_preemptions"] = sv["preemptions"]
+        if sv.get("tenant_share_err") is not None:
+            # per-tenant fairness canary on EVERY round
+            # (cpu_fallback_smoke included): max |served-token share -
+            # weight share| over the leg's weighted two-tenant trace
+            extras["serve_tenant_share_err"] = round(
+                sv["tenant_share_err"], 4)
         if "export_scrape_ms" in sv:
             # live SLO-exporter evidence on EVERY round
             # (cpu_fallback_smoke included): one real localhost HTTP
@@ -973,6 +988,9 @@ def _score(results, headline, extras):
             if sv.get("fleet_ttft_p99_ms") is not None:
                 extras["serve_fleet_ttft_p99_ms"] = round(
                     sv["fleet_ttft_p99_ms"], 2)
+            if sv.get("fleet_tenant_share_err") is not None:
+                extras["serve_fleet_tenant_share_err"] = round(
+                    sv["fleet_tenant_share_err"], 4)
     return {**headline, **extras}
 
 

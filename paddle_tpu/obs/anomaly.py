@@ -31,8 +31,8 @@ from . import metrics as _metrics
 __all__ = [
     "Detector", "LossSpike", "LossPlateau", "NonfiniteStreak",
     "ThroughputDrop", "DataloaderStarvation", "TtftSpike",
-    "AnomalyEngine", "default_detectors", "serving_detectors",
-    "DETECTORS", "SERVING_DETECTORS",
+    "TenantHog", "AnomalyEngine", "default_detectors",
+    "serving_detectors", "DETECTORS", "SERVING_DETECTORS",
 ]
 
 
@@ -260,16 +260,70 @@ class TtftSpike(Detector):
         return fired
 
 
+class TenantHog(Detector):
+    """One tenant's measured served-token share runs ``margin`` above
+    its configured weight share for ``patience`` consecutive
+    observations — a tenant is eating more of the fleet than its
+    weight entitles it to, persistently (transient overshoot right
+    after a burst is normal for a work-conserving scheduler; the
+    streak filters it). Reads the ``tenant_shares`` field
+    (``{tenant: {share, weight_share}}``) the router folds into its
+    throttled SLO tick record via ``obs.usage.fairness_record``.
+    Same once-per-episode discipline as StragglerDetector: fires when
+    the SAME tenant holds the worst overshoot for ``patience``
+    straight ticks; the overshoot dropping under ``margin`` (or the
+    hog changing) resets the streak and re-arms."""
+
+    name = "tenant_hog"
+
+    def __init__(self, margin=0.2, patience=3, min_served=32):
+        self.margin = float(margin)
+        self.patience = max(1, int(patience))
+        self.min_served = int(min_served)
+        self._tenant = None
+        self._streak = 0
+
+    def update(self, rec):
+        shares = rec.get("tenant_shares")
+        if not isinstance(shares, dict) or len(shares) < 2:
+            return None
+        served = rec.get("tenant_served_total")
+        if _finite(served) and served < self.min_served:
+            return None  # too few tokens for a share to mean anything
+        worst, over = None, 0.0
+        for tenant, d in shares.items():
+            share, wshare = d.get("share"), d.get("weight_share")
+            if not _finite(share) or not _finite(wshare):
+                continue
+            o = share - wshare
+            if worst is None or o > over:
+                worst, over = tenant, o
+        if worst is None or over < self.margin:
+            self._tenant, self._streak = None, 0
+            return None
+        if worst != self._tenant:
+            self._tenant = worst
+            self._streak = 0
+        self._streak += 1
+        if self._streak == self.patience:  # once per episode
+            d = shares[worst]
+            return {"tenant": worst, "share": d.get("share"),
+                    "weight_share": d.get("weight_share"),
+                    "over": over, "streak": self._streak}
+        return None
+
+
 DETECTORS = {cls.name: cls for cls in
              (LossSpike, LossPlateau, NonfiniteStreak, ThroughputDrop,
-              DataloaderStarvation, TtftSpike)}
+              DataloaderStarvation, TtftSpike, TenantHog)}
 
 # the serve-path subset: ttft_spike reads the windowed TTFT p99,
 # throughput_drop reads the per-token latency implied by the windowed
 # token rate (both fed by obs.slo.SLOEvaluator's tick record) — the
 # AnomalyEngine blind spot ISSUE 19 closes: detectors used to see only
-# training step records
-SERVING_DETECTORS = ("ttft_spike", "throughput_drop")
+# training step records. tenant_hog reads the per-tenant share fields
+# the router folds into the same tick (obs.usage.fairness_record).
+SERVING_DETECTORS = ("ttft_spike", "throughput_drop", "tenant_hog")
 
 
 def serving_detectors(env=None):

@@ -45,9 +45,9 @@ from .metrics import Counter, Gauge, Histogram
 
 __all__ = [
     "prometheus_text", "registry_lines", "slo_lines", "router_lines",
-    "slo_engine_lines", "statusz_data", "render_statusz_html",
-    "write_textfile", "parse_prometheus_text", "scrape",
-    "merge_expositions", "MetricsExporter", "PREFIX",
+    "tenant_lines", "slo_engine_lines", "statusz_data",
+    "render_statusz_html", "write_textfile", "parse_prometheus_text",
+    "scrape", "merge_expositions", "MetricsExporter", "PREFIX",
 ]
 
 PREFIX = "paddle_tpu_"
@@ -219,6 +219,57 @@ def router_lines(router):
     return out.lines
 
 
+def tenant_lines(router=None, engines=None):
+    """The per-tenant chargeback plane (``obs.usage``) as labeled
+    ``paddle_tpu_tenant_*{tenant="..."}`` gauges, in ``repr``
+    round-trip form like everything else here — a scraped gauge parses
+    back BITWISE equal to the rollup float. Merge-safe across
+    replicas: router-level families carry only the tenant label and
+    are emitted by exactly one router; engine-level families
+    (``tenant_replica_*``) carry a distinguishing ``replica`` label,
+    so :func:`merge_expositions` passes every series through verbatim
+    (never sums two sources into one key)."""
+    from . import usage as _usage
+
+    out = _Lines()
+    t = PREFIX + "tenant_"
+    if router is not None:
+        tu = _usage.router_tenant_usage(router)
+        for tenant, d in sorted(tu["tenants"].items()):
+            lbl = {"tenant": str(tenant)}
+            for key in ("weight", "weight_share", "served_tokens",
+                        "share", "queued", "requests", "completed",
+                        "cancelled", "rejected", "rate_holds",
+                        "requeued", "preemptions", "prompt_tokens",
+                        "decode_tokens"):
+                out.add(t + key, "gauge", d.get(key, 0), lbl)
+            for key in ("queue_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
+                for q in ("p50", "p99"):
+                    v = d.get(f"{key}_{q}")
+                    if v is not None:
+                        out.add(t + key, "gauge", v,
+                                {"tenant": str(tenant), "q": q})
+    for i, eng in enumerate(engines or ()):
+        try:
+            eu = _usage.engine_tenant_usage(eng)
+        except Exception:
+            continue
+        rep = str(eu.get("replica", i))
+        rlbl = {"replica": rep}
+        out.add(t + "replica_busy_ns", "gauge", eu["busy_ns"], rlbl)
+        out.add(t + "replica_page_open", "gauge", eu["page_open"],
+                rlbl)
+        out.add(t + "replica_page_bytes", "gauge", eu["page_bytes"],
+                rlbl)
+        for tenant, d in sorted(eu["tenants"].items()):
+            lbl = {"tenant": str(tenant), "replica": rep}
+            for key in ("device_ns", "page_ns", "prompt_tokens",
+                        "decode_tokens", "completed", "preemptions"):
+                out.add(t + "replica_" + key, "gauge", d.get(key, 0),
+                        lbl)
+    return out.lines
+
+
 def slo_engine_lines(evaluator):
     """The live SLO engine's truth (``obs.slo.SLOEvaluator``) as
     gauges: per-objective ``slo_burn_rate{objective=,window=}``,
@@ -261,6 +312,12 @@ def prometheus_text(engines=None, run_dir=None, registry=None,
                                                  now=now)
     if router is not None:
         lines += router_lines(router)
+    if router is not None or engines:
+        # the per-tenant chargeback gauges: router-level shares/weights
+        # when fronting a fleet, per-replica device/page integrals when
+        # exporting engines (each worker's own exporter emits these, so
+        # the router's scrape-and-merge carries them fleet-wide)
+        lines += tenant_lines(router=router, engines=engines)
     if slo is not None:
         lines += slo_engine_lines(slo)
     if sources:
@@ -360,7 +417,8 @@ def statusz_data(router=None, slo=None, engines=None, now=None):
     burn/budget/active alerts, and the router's recent scale/requeue
     events. Pull-only: rendered per GET, nothing on the serve path."""
     data = {"now": now, "fleet": [], "router": None, "slo": None,
-            "events": [], "replica_slo": {}}
+            "events": [], "replica_slo": {}, "tenants": {},
+            "fairness": None}
     pool = getattr(router, "pool", None)
     if pool is not None:
         data["fleet"] = pool.topology()
@@ -375,6 +433,12 @@ def statusz_data(router=None, slo=None, engines=None, now=None):
                 data["router"][key] = st[key]
         data["events"] = [dict(e) for e in
                           getattr(router, "recent_events", ())]
+        # the tenant chargeback/fairness pane (obs.usage, pull-only)
+        from . import usage as _usage
+
+        tu = _usage.router_tenant_usage(router)
+        data["tenants"] = tu["tenants"]
+        data["fairness"] = _usage.fairness_audit(tu["tenants"])
     if slo is not None:
         s = slo.status()
         data["slo"] = s
@@ -463,6 +527,26 @@ def render_statusz_html(data):
             ["replica"] + keys,
             [[rep] + [vals.get(k) for k in keys]
              for rep, vals in sorted(data["replica_slo"].items())]))
+    if data.get("tenants"):
+        fair = data.get("fairness") or {}
+        flag = "" if fair.get("ok", True) else \
+            f' <span class="firing">DRIFT {fair.get("max_drift"):.3f}' \
+            f' &gt; {fair.get("threshold"):.3f}' \
+            f' ({_esc(fair.get("worst_tenant"))})</span>'
+        parts.append(f"<h2>tenants</h2>{flag}" if flag
+                     else "<h2>tenants</h2>")
+        parts.append(_html_table(
+            ["tenant", "weight", "weight_share", "share",
+             "served_tokens", "queued", "completed", "rejected",
+             "rate_holds", "requeued", "preemptions", "ttft_p99_ms",
+             "e2e_p99_ms"],
+            [[tname, d.get("weight"), d.get("weight_share"),
+              d.get("share"), d.get("served_tokens"), d.get("queued"),
+              d.get("completed"), d.get("rejected"),
+              d.get("rate_holds"), d.get("requeued"),
+              d.get("preemptions"), d.get("ttft_ms_p99"),
+              d.get("e2e_ms_p99")]
+             for tname, d in sorted(data["tenants"].items())]))
     if data.get("router"):
         r = data["router"]
         parts.append("<h2>router</h2>")

@@ -52,7 +52,8 @@ __all__ = [
     "load_journal", "load_fleet", "align_steps", "step_skew",
     "StragglerDetector", "detect_stragglers", "stall_attribution",
     "request_summary", "merged_request_summary", "elastic_summary",
-    "router_summary", "slo_summary", "per_rank_summary", "aggregate",
+    "router_summary", "slo_summary", "tenant_summary",
+    "merged_tenant_summary", "per_rank_summary", "aggregate",
     "heartbeat_ages", "merge_chrome_traces", "rank_subdir",
 ]
 
@@ -615,6 +616,94 @@ def slo_summary(run):
     return out
 
 
+def tenant_summary(run):
+    """Per-tenant chargeback columns over ONE journal: the run's
+    ``request`` records rolled up via ``obs.usage.rollup_requests``
+    (tokens, device-ns, page-ns, exact latency percentiles), plus the
+    LAST ``tenant.summary`` (router truth) and LAST ``tenant.usage``
+    (engine truth — the final incarnation's device-second telescoping
+    and page-second closure) events carried alongside, with the
+    fairness audit when the run routed. None when the run carries no
+    tenant signal."""
+    if not run:
+        return None
+    from . import usage as _usage
+
+    reqs = run.get("requests") or []
+    router = engine = None
+    for e in run.get("events") or []:
+        k = e.get("kind")
+        if k == "tenant.summary":
+            router = e   # last wins: the final truth
+        elif k == "tenant.usage":
+            engine = e   # last wins: the final incarnation
+    if not reqs and router is None and engine is None:
+        return None
+    out = {
+        "tenants": _usage.rollup_requests(reqs),
+        "router": None if router is None else {
+            "served_total": router.get("served_total"),
+            "tenants": router.get("tenants") or {}},
+        "engine": None if engine is None else {
+            k: engine.get(k)
+            for k in ("replica", "busy_ns", "prefill_ns", "decode_ns",
+                      "page_bytes", "page_open", "seq_allocs",
+                      "seq_frees", "tenants")},
+    }
+    if router is not None:
+        out["fairness"] = _usage.fairness_audit(
+            router.get("tenants") or {})
+    return out
+
+
+def merged_tenant_summary(fleet):
+    """Chargeback rolled up ACROSS the fleet: every rank's (and the
+    supervisors'/router's) request records pooled through ONE
+    ``obs.usage.rollup_requests`` pass (percentiles over the pool —
+    per-replica percentiles don't average), per-replica engine truth
+    from each rank's LAST ``tenant.usage`` event, and the router's
+    final ``tenant.summary`` + fairness audit when the run routed.
+    None when nothing in the fleet carries a tenant signal."""
+    from . import usage as _usage
+
+    reqs = []
+    replicas = {}
+    for rank, run in sorted(fleet["ranks"].items()):
+        reqs += run.get("requests") or []
+        last = None
+        for e in run.get("events") or []:
+            if e.get("kind") == "tenant.usage":
+                last = e   # last wins: the final incarnation
+        if last is not None:
+            replicas[rank] = {
+                "replica": last.get("replica"),
+                "busy_ns": last.get("busy_ns"),
+                "page_open": last.get("page_open"),
+                "tenants": last.get("tenants") or {}}
+    for sup in _supervisors(fleet).values():
+        reqs += sup.get("requests") or []
+    rsum = None
+    router_run = fleet.get("router")
+    if router_run:
+        reqs += router_run.get("requests") or []
+        for e in router_run.get("events") or []:
+            if e.get("kind") == "tenant.summary":
+                rsum = e   # last wins: the final truth
+    if not reqs and not replicas and rsum is None:
+        return None
+    out = {
+        "tenants": _usage.rollup_requests(reqs),
+        "replicas": replicas,
+        "router": None if rsum is None else {
+            "served_total": rsum.get("served_total"),
+            "tenants": rsum.get("tenants") or {}},
+    }
+    if rsum is not None:
+        out["fairness"] = _usage.fairness_audit(
+            rsum.get("tenants") or {})
+    return out
+
+
 def per_rank_summary(run):
     """One rank's row in the fleet table (plain data)."""
     steps = run["steps"]
@@ -729,6 +818,9 @@ def aggregate(run_dir, straggler_factor=1.5, straggler_patience=3):
         # the serve router's own journal (serving.fleet drill/serve):
         # dispatch/requeue/scale truth next to the per-rank rollup
         "router": router_summary(fleet.get("router")),
+        # per-tenant chargeback: pooled request rollup + per-replica
+        # engine truth + the router's fairness audit
+        "tenant_usage": merged_tenant_summary(fleet),
     }
     if not isinstance(run_dir, dict):
         out["heartbeat_age_s"] = heartbeat_ages(run_dir)

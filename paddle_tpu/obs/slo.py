@@ -321,11 +321,14 @@ class SLOEvaluator:
         raise KeyError(f"unknown objective {objective!r}")
 
     # -- the tick ------------------------------------------------------------
-    def observe(self, text=None, registry=None, now=None):
+    def observe(self, text=None, registry=None, now=None, extra=None):
         """One evaluation tick: snapshot the inputs into the store,
         recompute burn/budget, run the alert state machines (journal
         ``slo.fire``/``slo.clear``, tick ``slo.*`` counters), feed the
-        serving anomaly detectors. Returns the alert transitions
+        serving anomaly detectors. ``extra`` (a dict) is folded into
+        the anomaly record verbatim — the router's per-tenant fairness
+        fields (``obs.usage.fairness_record``) ride the same tick the
+        latency detectors read. Returns the alert transitions
         (``slo.fire``/``slo.clear`` dicts) of this tick."""
         now = self.clock() if now is None else float(now)
         snap = {}
@@ -355,7 +358,7 @@ class SLOEvaluator:
             for pol in self.policies:
                 transitions.extend(
                     self._drive_alert(spec, pol, now))
-        self._observe_anomalies(now)
+        self._observe_anomalies(now, extra=extra)
         return transitions
 
     def _drive_alert(self, spec, pol, now):
@@ -444,13 +447,17 @@ class SLOEvaluator:
                 worst, worst_value = rep, v
         return worst, worst_value
 
-    def _observe_anomalies(self, now):
+    def _observe_anomalies(self, now, extra=None):
         """Feed the serving anomaly detectors one windowed record:
-        TTFT p99 over the 1m pane and the per-token latency implied by
-        the 1m token rate (``throughput_drop``'s serving signal)."""
+        TTFT p99 over the 1m pane, the per-token latency implied by
+        the 1m token rate (``throughput_drop``'s serving signal), and
+        any caller-supplied ``extra`` fields (``tenant_hog``'s
+        fairness signal)."""
         if self.anomaly_engine is None:
             return
         rec = {"step": self.ticks}
+        if extra:
+            rec.update(extra)
         for spec in self.specs:
             if spec.kind != "latency":
                 continue

@@ -49,6 +49,7 @@ from ..obs import journal as _journal
 from ..obs import lockdep as _lockdep
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
+from ..obs import usage as _usage
 from ..resilience import inject as _inject
 from ..resilience.policy import RecoveryPolicy, retry_call
 from .kv_cache import (CachePressureError, PagedKVCache,
@@ -260,15 +261,23 @@ class ServeEngine:
         # two replicas' scrapes would otherwise collide on replica="0"
         self.replica_id = next(_REPLICA_IDS) if replica_id is None \
             else int(replica_id)
+        # per-tenant device-second attribution (obs.usage): charged
+        # from step() always-on (plain int/dict arithmetic, the same
+        # cost class as the step_ms histogram observe); read pull-only
+        self.usage = _usage.UsageMeter(replica_id=self.replica_id)
+        # requests that finished mid-step: their journal records are
+        # deferred to the end of step() so the pass's device-second
+        # charge is already in request_ns when the record is written
+        self._finished_this_step = []
         with _ENGINES_LOCK:
             _ENGINES.append(weakref.ref(self))
 
     # -- intake --------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, rid=None, eos_id=None,
-               arrival_t=None, trace=None):
+               arrival_t=None, trace=None, tenant=None):
         req = Request(prompt=list(prompt), max_new_tokens=max_new_tokens,
                       rid=rid, eos_id=eos_id, arrival_t=arrival_t,
-                      trace=trace)
+                      trace=trace, tenant=tenant)
         if any(not 0 <= t < self.model.vocab_size for t in req.prompt):
             raise ValueError("prompt token out of vocab range")
         # the deepest context this request can reach is
@@ -468,15 +477,31 @@ class ServeEngine:
                     "req.decode_mark", at=t0, step=self._steps + 1,
                     replica=self.replica_id,
                     rids=[r.rid for r in batch.decodes])
-            with _trace.span("serving.step",
-                             prefills=len(batch.prefills),
-                             decodes=len(batch.decodes)):
-                for req in batch.prefills:
-                    self._prefill_one(req)
-                if batch.decodes:
-                    self._decode_batch(
-                        [r for r in batch.decodes
-                         if r.state == RUNNING])
+            try:
+                with _trace.span("serving.step",
+                                 prefills=len(batch.prefills),
+                                 decodes=len(batch.decodes)):
+                    for req in batch.prefills:
+                        p0 = self.clock()
+                        self._prefill_one(req)
+                        self.usage.charge_prefill(req,
+                                                  self.clock() - p0)
+                    if batch.decodes:
+                        d0 = self.clock()
+                        survivors = self._decode_batch(
+                            [r for r in batch.decodes
+                             if r.state == RUNNING])
+                        # the span splits across the lanes that
+                        # actually decoded; an all-preempted pass
+                        # charges nobody
+                        self.usage.charge_decode(survivors,
+                                                 self.clock() - d0)
+            finally:
+                # journal finishes only now: the pass's charge is in
+                # request_ns, so the record's device_ns is final
+                for req in self._finished_this_step:
+                    self._journal_request(req)
+                del self._finished_this_step[:]
             self._steps += 1
             step_ms = (self.clock() - t0) * 1e3
             _M_STEP.observe(step_ms)
@@ -554,7 +579,7 @@ class ServeEngine:
         # (it was the youngest running) — it no longer holds pages
         survivors = [r for r in survivors if r.state == RUNNING]
         if not survivors:
-            return
+            return survivors
         n = len(survivors)
         bucket = _bucket(n, _DECODE_BUCKETS)
         rids = [r.rid for r in survivors]
@@ -583,6 +608,7 @@ class ServeEngine:
             for i, r in enumerate(survivors):
                 self._emit_token(r, logits[i],
                                  first=r.first_token_t is None)
+        return survivors
 
     # -- token plumbing ------------------------------------------------------
     def _choose(self, logits_row):
@@ -616,7 +642,8 @@ class ServeEngine:
         with _trace.span("serving.request.finish", rid=req.rid,
                          tokens=len(req.generated)):
             pass
-        self._journal_request(req)
+        # deferred: step() journals after the pass's usage charge lands
+        self._finished_this_step.append(req)
 
     def _maybe_aot(self, fn, structs, kind):
         """Hydrate one jitted bucket step from the AOT executable cache
@@ -647,6 +674,12 @@ class ServeEngine:
             extra = request_phases(req)
             if req.trace is not None:
                 extra["trace"] = req.trace
+            # chargeback extras: resolved tenant + the int-ns device /
+            # page integrals, so obs.usage.rollup_requests rebuilds the
+            # per-tenant table from journals alone, exact to the ns
+            extra["tenant"] = req.tenant or _usage.DEFAULT_TENANT
+            extra["device_ns"] = self.usage.request_ns.get(req.rid, 0)
+            extra["page_ns"] = self.cache.closed_page_ns(req.rid)
             _journal.ACTIVE.record_request(
                 rid=req.rid, state=req.state,
                 arrival_t=req.arrival_t, admit_t=req.admit_t,
@@ -673,6 +706,7 @@ class ServeEngine:
             "running": len(self.scheduler.running),
             "preemptions": self.scheduler.preemptions,
             "kv": self.cache.stats(),
+            "usage": self.usage.snapshot(),
         }
         fin = list(self.finished)
         lat = {

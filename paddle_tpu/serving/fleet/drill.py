@@ -172,10 +172,18 @@ def run_drill(root=None, keep=False):
             spec, replicas=2, mode="process",
             supervisor=ReplicaSupervisor(max_restarts=2,
                                          backoff_s=0.05, jitter=0.0))
-        router = Router(pool)
+        from .router import TenantPolicy
+
+        # every submission rides tenant "drill": the chargeback plane
+        # (obs.usage) gets exercised under replica loss, and the gauge
+        # check below proves the scraped tenant_* series equal router
+        # truth bitwise on this live 2-replica run
+        router = Router(pool,
+                        tenants={"drill": TenantPolicy(weight=1.0)})
         t0 = time.time()
         reqs = [router.submit(p, max_new_tokens=m,
-                              arrival_t=t0 + i * 1e-3)
+                              arrival_t=t0 + i * 1e-3,
+                              tenant="drill")
                 for i, (p, m) in enumerate(trace)]
         router.run_until_drained(timeout_s=300.0, sleep_s=0.02)
         # the victim's relaunch is deferred behind its supervisor
@@ -189,6 +197,31 @@ def run_drill(root=None, keep=False):
             time.sleep(0.01)
         stats = router.stats()
         dispatch_trace = list(router.trace)
+        # tenant chargeback gauges, live: scrape the router's
+        # tenant_* exposition and parse it back — every series must
+        # equal the obs.usage rollup BITWISE (repr round-trip), on a
+        # fleet that just survived a replica kill with requeues
+        from ...obs import export as _export
+        from ...obs import usage as _usage
+
+        tenant_usage = _usage.router_tenant_usage(router)
+        scraped = _export.parse_prometheus_text(
+            "\n".join(_export.tenant_lines(router=router)))
+        for tenant, d in tenant_usage["tenants"].items():
+            for key in ("weight_share", "served_tokens", "share",
+                        "requests", "completed", "requeued",
+                        "preemptions", "prompt_tokens",
+                        "decode_tokens"):
+                skey = (f'paddle_tpu_tenant_{key}'
+                        f'{{tenant="{tenant}"}}')
+                if scraped.get(skey) != float(d.get(key, 0)):
+                    failures.append(
+                        f"tenant gauge {skey}={scraped.get(skey)} != "
+                        f"router truth {d.get(key, 0)} (bitwise gate)")
+        if not tenant_usage["served_total"]:
+            failures.append(
+                "tenant metering saw zero served tokens — the drill's "
+                "tenant='drill' stamps went missing")
         # graceful stop BEFORE the journal assertions: the live
         # workers' buffered tails flush on their way out
         router.close()
@@ -315,6 +348,7 @@ def run_drill(root=None, keep=False):
             "request_attribution": attribution,
             "merged_trace": merged,
             "cross_flow_rids": cross_flow_rids,
+            "tenant_usage": tenant_usage,
         }
     except Exception as e:  # a harness crash is a drill failure too
         failures.append(f"drill harness raised {type(e).__name__}: {e}")
@@ -327,7 +361,8 @@ def run_drill(root=None, keep=False):
                               _lockdep.violations()[lockdep_before:],
                               "worker_cycles": []},
                   "request_timelines": {}, "request_attribution": {},
-                  "merged_trace": None, "cross_flow_rids": []}
+                  "merged_trace": None, "cross_flow_rids": [],
+                  "tenant_usage": None}
     finally:
         if prev_lockdep is not None:
             _lockdep.enable(prev_lockdep)

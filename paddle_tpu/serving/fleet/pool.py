@@ -201,7 +201,7 @@ class LocalReplica(_BaseReplica):
                                max_new_tokens=req.max_new_tokens,
                                rid=req.rid, eos_id=req.eos_id,
                                arrival_t=req.arrival_t,
-                               trace=req.trace_id)
+                               trace=req.trace_id, tenant=req.tenant)
         except ValueError:
             # the router pre-validates with the same rules, so this is
             # a spec drift bug — surface it, don't strand the request
@@ -244,6 +244,19 @@ class LocalReplica(_BaseReplica):
         but — like a real dead machine — the pool only notices at the
         next health sweep, which requeues the stranded ledger."""
         self._crashed = True
+
+    def close(self):
+        # mirror the process-mode worker's before-bye emission so a
+        # local-mode run dir bills the same way: final per-tenant
+        # engine truth into the shared journal. A killed local
+        # replica skips it — machine loss loses its meter, as billed.
+        if not self._crashed and self.state not in (DEAD,) \
+                and _journal.ACTIVE is not None:
+            from ...obs import usage as _usage
+
+            _journal_event("tenant.usage",
+                           **_usage.engine_tenant_usage(self.engine))
+        super().close()
 
     def health(self, now=None):
         return "exit" if self._crashed else None
@@ -358,7 +371,8 @@ class ProcessReplica(_BaseReplica):
                     "max_new_tokens": req.max_new_tokens,
                     "eos_id": req.eos_id,
                     "arrival_t": req.arrival_t,
-                    "trace": req.trace_id})
+                    "trace": req.trace_id,
+                    "tenant": req.tenant})
 
     def drain(self):
         super().drain()

@@ -227,6 +227,11 @@ class Router:
         self._buckets = {}     # tenant -> ((rate, burst), TokenBucket|None)
         self._default_policy = TenantPolicy()
         self._served = {}      # tenant -> tokens dispatched
+        # per-tenant outcome counters (obs.usage.router_tenant_usage
+        # reads these pull-only for the chargeback/fairness rollup)
+        self._rejected_by_tenant = {}    # tenant -> rejects
+        self._rate_holds_by_tenant = {}  # tenant -> hold episodes
+        self._requeued_by_tenant = {}    # tenant -> requeues
         self._inflight = {}    # rid -> FleetRequest
         self.completed = []    # FINISHED/CANCELLED FleetRequests
         self.trace = []        # [{"t", "rid", "replica", "tenant"}]
@@ -292,6 +297,8 @@ class Router:
         except ValueError as e:
             req.state = REJECTED
             self.rejected += 1
+            self._rejected_by_tenant[req.tenant] = \
+                self._rejected_by_tenant.get(req.tenant, 0) + 1
             _M_REJECTED.inc()
             if _journal.ACTIVE is not None:
                 _journal.ACTIVE.event("router.reject", rid=req.rid,
@@ -351,6 +358,8 @@ class Router:
                     head = q.pop(0)
                     head.state = REJECTED
                     self.rejected += 1
+                    self._rejected_by_tenant[tenant] = \
+                        self._rejected_by_tenant.get(tenant, 0) + 1
                     _M_REJECTED.inc()
                     if _journal.ACTIVE is not None:
                         _journal.ACTIVE.event(
@@ -368,6 +377,8 @@ class Router:
                     # tenant's rate bucket cannot yet afford it
                     if head.rate_hold_t is None:
                         head.rate_hold_t = now
+                        self._rate_holds_by_tenant[tenant] = \
+                            self._rate_holds_by_tenant.get(tenant, 0) + 1
                         if _journal.ACTIVE is not None:
                             _journal.ACTIVE.event(
                                 "req.rate_hold", rid=head.rid, at=now,
@@ -500,6 +511,8 @@ class Router:
                 req.requeues += 1
                 req.requeue_ts.append(now)
                 self.requeued += 1
+                self._requeued_by_tenant[req.tenant] = \
+                    self._requeued_by_tenant.get(req.tenant, 0) + 1
                 _M_REQUEUED.inc()
                 self._enqueue(req)
                 if _journal.ACTIVE is not None:
@@ -537,6 +550,8 @@ class Router:
 
         texts = ["\n".join(_export.router_lines(self)) + "\n"]
         engines = self.pool.local_engines()
+        texts.append("\n".join(
+            _export.tenant_lines(router=self, engines=engines)) + "\n")
         if engines:
             texts.append(
                 "\n".join(_export.slo_lines(engines=engines)) + "\n")
@@ -627,7 +642,12 @@ class Router:
             if self.autoscaler is not None:
                 self.autoscale_tick(now, exposition=text)
             if self.slo is not None:
-                self.slo.observe(text=text, now=now)
+                # fairness rides the same throttled tick: tenant_hog
+                # sees measured-vs-weight shares next to the latency
+                # signals, at zero extra scrape cost
+                from ...obs import usage as _usage
+                self.slo.observe(text=text, now=now,
+                                 extra=_usage.fairness_record(self))
         return done
 
     def run_until_drained(self, timeout_s=120.0, sleep_s=0.0):
@@ -687,6 +707,7 @@ class Router:
                 t: {"served_tokens": served,
                     "share": (served / served_total) if served_total
                     else 0.0,
+                    "weight": self._policy(t).weight,
                     "queued": len(self._queues.get(t) or [])}
                 for t, served in sorted(self._served.items())
             },
@@ -726,6 +747,16 @@ class Router:
             tenants={t: round(v["share"], 6)
                      for t, v in st["tenants"].items()},
             ttft_p99_ms=(st.get("ttft_ms") or {}).get("p99"))
+        # full per-tenant rollup (weights, shares, outcomes, latency
+        # percentiles) for the chargeback/fairness readers — a second
+        # event so router.summary's shape (and every report pinned to
+        # it) stays byte-compatible
+        from ...obs import usage as _usage
+
+        tu = _usage.router_tenant_usage(self)
+        _journal.ACTIVE.event("tenant.summary",
+                              served_total=tu["served_total"],
+                              tenants=tu["tenants"])
 
     def close(self):
         """Journal the summary and shut the pool down (drain-free stop:

@@ -26,7 +26,7 @@ Protocol (one JSON object per line):
 
 parent -> worker
     ``{"op": "submit", "rid", "prompt", "max_new_tokens", "eos_id",
-    "arrival_t", "trace"}`` | ``{"op": "cancel", "rid"}`` |
+    "arrival_t", "trace", "tenant"}`` | ``{"op": "cancel", "rid"}`` |
     ``{"op": "drain"}``
     | ``{"op": "stats"}`` | ``{"op": "stop"}``
 worker -> parent
@@ -164,7 +164,8 @@ def main(argv=None):
                                     "max_new_tokens", 16),
                                 rid=rid, eos_id=msg.get("eos_id"),
                                 arrival_t=msg.get("arrival_t"),
-                                trace=msg.get("trace"))
+                                trace=msg.get("trace"),
+                                tenant=msg.get("tenant"))
                         except ValueError as e:
                             _emit({"t": "rejected", "rid": rid,
                                    "reason": str(e)})
@@ -204,6 +205,18 @@ def main(argv=None):
             _emit({"t": "drained", "replica": args.replica_id})
     if exporter is not None:
         exporter.stop()
+    if _journal.ACTIVE is not None:
+        # final per-tenant usage truth for this incarnation: the
+        # device-ns telescoping and page-second closure land in the
+        # rank journal, so the fleet rollup (obs.fleet.tenant_summary)
+        # and the drill can assert them post-mortem. A chaos-killed
+        # incarnation (os._exit) never reaches here — its stranded
+        # requests' usage re-accrues on whichever replica re-serves
+        # them.
+        from ...obs import usage as _usage
+
+        _journal.ACTIVE.event("tenant.usage",
+                              **_usage.engine_tenant_usage(eng))
     _emit({"t": "bye", "replica": args.replica_id,
            "steps": eng._steps})
     return 0

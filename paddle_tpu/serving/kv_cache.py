@@ -24,6 +24,8 @@ is a testable invariant, not a hope.
 """
 from __future__ import annotations
 
+import time as _time
+
 import numpy as np
 
 from ..obs import lockdep as _lockdep
@@ -93,6 +95,18 @@ class PagedKVCache:
         self._free = sorted(range(1, self.num_pages))
         self._tables = {}    # seq_id -> [page ids, in order]
         self._lengths = {}   # seq_id -> tokens stored
+        # page-second attribution (obs.usage): integrate pages-held x
+        # time per sequence, in INTEGER nanoseconds so per-tenant sums
+        # are exact (float accumulation is not associative). The clock
+        # is injectable (Scheduler aligns it with its own) so the
+        # integrals are ManualClock-exact in tests; stamps live and die
+        # with the page table, so closure (no open stamp without a
+        # table) is part of verify().
+        self.clock = None             # None -> time.monotonic
+        self._page_open = {}          # seq_id -> [last_ns, pages, acc_ns]
+        self._page_ns = {}            # seq_id -> closed integral (int ns)
+        self._seq_allocs = 0          # alloc() calls granted a table
+        self._seq_frees = 0           # free() calls that released one
         # leaf of the serving order (engine.step -> scheduler -> cache):
         # nothing may be acquired while this is held
         self._lock = _lockdep.lock("serving.kv_cache")
@@ -153,6 +167,8 @@ class PagedKVCache:
             pages = [self._free.pop(0) for _ in range(need)]
             self._tables[seq_id] = pages
             self._lengths[seq_id] = n_tokens
+            self._page_open[seq_id] = [self._stamp_ns(), need, 0]
+            self._seq_allocs += 1
             _M_ALLOCS.inc(need)
             self._update_gauges_locked()
             return list(pages)
@@ -181,6 +197,13 @@ class PagedKVCache:
             self._tables[seq_id].extend(new)
             self._lengths[seq_id] = cur + n_tokens
             if new:
+                # page count changed: close the integral's interval at
+                # the OLD count and restamp at the new one
+                st = self._page_open[seq_id]
+                now = self._stamp_ns()
+                st[2] += (now - st[0]) * st[1]
+                st[0] = now
+                st[1] += len(new)
                 _M_ALLOCS.inc(len(new))
             self._update_gauges_locked()
             return new
@@ -193,6 +216,15 @@ class PagedKVCache:
         with self._lock:
             pages = self._tables.pop(seq_id, None)
             self._lengths.pop(seq_id, None)
+            if pages is not None:
+                # close the page-second integral; a re-admission after
+                # preemption re-allocs under the same seq_id, so closed
+                # integrals ACCUMULATE across incarnations
+                st = self._page_open.pop(seq_id)
+                st[2] += (self._stamp_ns() - st[0]) * st[1]
+                self._page_ns[seq_id] = \
+                    self._page_ns.get(seq_id, 0) + st[2]
+                self._seq_frees += 1
             if not pages:
                 return 0
             self._free.extend(pages)
@@ -200,6 +232,42 @@ class PagedKVCache:
             _M_FREES.inc(len(pages))
             self._update_gauges_locked()
             return len(pages)
+
+    # -- page-second attribution ---------------------------------------------
+    def _stamp_ns(self):
+        """Now, in integer nanoseconds on the injected clock. Called
+        under the leaf lock; the clock is a plain callable (monotonic
+        or a ManualClock read), never blocking."""
+        clk = self.clock
+        return int(round((clk() if clk is not None else
+                          _time.monotonic()) * 1e9))
+
+    def page_usage(self):
+        """Pull-only snapshot of the page-second integrals: per-seq
+        CLOSED integrals (int ns; accumulated across preempt/re-admit
+        incarnations), currently-OPEN page counts, and the alloc/free
+        closure counters. Nothing here mutates the integrals."""
+        with self._lock:
+            return {
+                "closed_ns": dict(self._page_ns),
+                "open": {sid: st[1]
+                         for sid, st in self._page_open.items()},
+                "seq_allocs": self._seq_allocs,
+                "seq_frees": self._seq_frees,
+            }
+
+    def closed_page_ns(self, seq_id):
+        """Closed page-second integral for one sequence (int ns)."""
+        with self._lock:
+            return self._page_ns.get(seq_id, 0)
+
+    @property
+    def page_bytes(self):
+        """HBM bytes one page pins across BOTH pools and all layers —
+        the page-MB-s chargeback conversion factor."""
+        itemsize = np.dtype(self.dtype).itemsize
+        return (2 * self.num_layers * self.page_size * self.num_heads
+                * self.head_dim * itemsize)
 
     # -- introspection (locked like the mutators: the engine loop is
     # single-threaded, but submit/cancel may come from other threads
@@ -281,6 +349,12 @@ class PagedKVCache:
             total = 1 + len(self._free) + len(owned)
             assert total == self.num_pages, \
                 f"page leak: {self.num_pages - total} unaccounted"
+            # page-second closure: an open stamp exists iff the page
+            # table does (alloc==free discipline for the integrals)
+            assert set(self._page_open) == set(self._tables), \
+                "page-second stamp out of sync with page tables"
+            assert self._seq_allocs - self._seq_frees == \
+                len(self._tables), "page-second alloc/free counter leak"
         return True
 
     def _update_gauges_locked(self):

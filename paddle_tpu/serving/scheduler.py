@@ -72,6 +72,10 @@ class Request:
     max_new_tokens: int = 16
     rid: str = None
     eos_id: int = None
+    # billing identity: every request is owned by exactly one tenant
+    # (None = the router's implicit "default" tenant); obs.usage charges
+    # device-seconds and KV page-seconds to this key
+    tenant: str = None
     state: str = QUEUED
     # lifecycle timestamps (scheduler clock)
     arrival_t: float = None
@@ -138,6 +142,12 @@ class Scheduler:
         self.token_budget = int(token_budget)
         self.max_batch = int(max_batch) if max_batch else None
         self.clock = clock if clock is not None else time.monotonic
+        # page-second attribution (obs.usage) integrates pages x time
+        # from cache stamps; those stamps must tick on the SAME clock
+        # as the request lifecycle or the integrals drift off the
+        # ManualClock-exact timeline tests pin
+        if getattr(cache, "clock", None) is None:
+            cache.clock = self.clock
         self._queue = []      # QUEUED/PREEMPTED, kept in arrival order
         self._running = []    # RUNNING, in admission order
         self.preemptions = 0
@@ -215,6 +225,7 @@ class Scheduler:
                             prompt_tokens=len(nxt.prompt),
                             output_tokens=len(nxt.generated),
                             preemptions=nxt.preemptions,
+                            tenant=nxt.tenant,
                             rejected="context exceeds max_seq_len")
                     continue
                 if cost > budget:

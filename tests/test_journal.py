@@ -329,6 +329,21 @@ class TestInactiveHooksDoNothing:
         monkeypatch.setattr(obs_export.MetricsExporter,
                             "render_statusz", boom)
         monkeypatch.setattr(obs_fleet, "slo_summary", boom)
+        # the tenant chargeback plane (PR 20) is pull-only too: the
+        # meter/cache accumulate plain ints on the hot path, but
+        # nothing on a step/serve path may ever roll up, audit, or
+        # render a tenant view unprompted — every reader is poisoned
+        # while the tenant-tagged lifecycles below run in full
+        from paddle_tpu.obs import usage as obs_usage
+
+        for name in ("engine_tenant_usage", "router_tenant_usage",
+                     "fairness_audit", "fairness_record",
+                     "rollup_requests", "merge_tenant_rollups",
+                     "tenant_slo_slices"):
+            monkeypatch.setattr(obs_usage, name, boom)
+        monkeypatch.setattr(obs_export, "tenant_lines", boom)
+        monkeypatch.setattr(obs_fleet, "tenant_summary", boom)
+        monkeypatch.setattr(obs_fleet, "merged_tenant_summary", boom)
 
         pt.enable_static()
         try:
@@ -361,10 +376,13 @@ class TestInactiveHooksDoNothing:
 
         eng = ServeEngine(TinyLM(num_heads=2, head_dim=8),
                           PagedKVCache(16, 4, 2, 8))
-        req = eng.submit([1, 2, 3], max_new_tokens=2)
+        req = eng.submit([1, 2, 3], max_new_tokens=2, tenant="t0")
         eng.run(max_steps=20)
         assert req.state == "FINISHED" and len(req.generated) == 2
-        eng.cancel(eng.submit([1], max_new_tokens=1))
+        eng.cancel(eng.submit([1], max_new_tokens=1, tenant="t1"))
+        # metering kept charging (always-on ints) while every reader
+        # stayed poisoned — the engine's truth is there to pull later
+        assert eng.usage.busy_ns > 0 and "t0" in eng.usage.device_ns
 
         # serve-fleet hooks (router dispatch/requeue/scale, replica
         # pool spawn/death/retire): a full routed lifecycle — submit,
@@ -384,9 +402,10 @@ class TestInactiveHooksDoNothing:
             replicas=2, mode="local", clock=fclock,
             supervisor=ReplicaSupervisor(sleep=lambda s: None))
         frouter = Router(fpool, clock=fclock)
-        fr = frouter.submit([1, 2, 3], max_new_tokens=2)
+        fr = frouter.submit([1, 2, 3], max_new_tokens=2, tenant="t0")
         with pytest.raises(ValueError):
-            frouter.submit([1] * 30, max_new_tokens=30)  # reject path
+            frouter.submit([1] * 30, max_new_tokens=30,
+                           tenant="t1")  # reject path (tenant-tagged)
         frouter.dispatch()
         fpool.replicas[fr.replica_id].kill()
         frouter.check_replicas()           # requeue + relaunch
@@ -411,11 +430,17 @@ class TestInactiveHooksDoNothing:
         peng = ServeEngine(TinyLM(num_heads=2, head_dim=8), pcache,
                            scheduler=Scheduler(pcache,
                                                token_budget=64))
-        preqs = [peng.submit([1, 2], max_new_tokens=6)
-                 for _ in range(4)]
+        preqs = [peng.submit([1, 2], max_new_tokens=6,
+                             tenant=f"t{i % 2}")
+                 for i in range(4)]
         peng.run(max_steps=200)
         assert all(r.state == "FINISHED" for r in preqs)
         assert peng.scheduler.preemptions >= 1
+        # the page-second integrals closed (alloc==free) and the
+        # preempting run still metered both tenants — always-on
+        # accumulation, pull-only reads
+        assert not pcache.page_usage()["open"]
+        assert set(peng.usage.device_ns) == {"t0", "t1"}
 
         import tempfile
 

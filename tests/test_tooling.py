@@ -116,6 +116,22 @@ def test_serve_bench_self_test_passes():
     assert mod.main(["--self-test"]) == 0
 
 
+def test_usage_report_self_test_passes():
+    """tools/usage_report.py --self-test: the ISSUE-20 acceptance core
+    — the divmod decode split (10 ns over 3 lanes -> 4,3,3 in survivor
+    order) and the busy == sum(per-tenant) == sum(per-request)
+    telescoping invariant hold bitwise; the hand-computed ManualClock
+    page-second integral (2 pages x 2 s + 3 pages x 3 s = 13e9
+    pages-ns) closes with alloc==free; a real TickingClock engine run
+    bills token- and nanosecond-exact through the journal into the
+    chargeback table; and the --diff gates fire on the injected 2x
+    fairness violation and 2x per-tenant p99 regression with A-vs-A
+    clean. In-process so it rides the tier-1 command path like the
+    other self-tests."""
+    mod = _load_tool("usage_report")
+    assert mod.main(["--self-test"]) == 0
+
+
 def test_slo_report_self_test_passes():
     """tools/slo_report.py --self-test: the ISSUE-19 acceptance core —
     under a ManualClock the 14.4x fast-burn availability fixture must

@@ -1,0 +1,261 @@
+"""Per-tenant metering and cost attribution (``obs.usage``): the
+ISSUE-20 exactness contract.
+
+- **Device-seconds telescope bitwise.** Every nanosecond an engine
+  spends computing lands on exactly one tenant and exactly one
+  request — ``busy_ns == sum(per-tenant) == sum(per-request)`` as
+  integer identities, under a deterministic TickingClock, INCLUDING
+  runs with forced preemption + requeue (single-engine) and a killed
+  replica's router-level requeue (local 2-replica fleet).
+- **Page-second integrals close.** ``PagedKVCache`` stamps pages-held
+  x time per sequence in integer nanoseconds; after cancel (and after
+  the multi-process kill drill) every interval is closed —
+  ``alloc == free``, no open stamps, ``verify()`` holds.
+- **The drill's journals bill correctly.** The cached 2-replica kill
+  drill (one execution per process, shared with chaos_run/
+  fleet_report) journals ``tenant.usage`` engine truth per rank and
+  tenant-stamped request records whose rollup carries the drill
+  tenant — and the live scrape-vs-truth bitwise gauge gate already
+  ran inside the drill itself.
+"""
+import atexit
+import shutil
+import tempfile
+
+import pytest
+
+from paddle_tpu.obs.usage import (TickingClock, engine_tenant_usage,
+                                  rollup_requests, router_tenant_usage)
+from paddle_tpu.serving import (ManualClock, PagedKVCache, Scheduler,
+                                ServeEngine, TinyLM)
+
+# share one executable cache across this module's engines (same
+# geometry class as tests/test_serve_fleet.py: pay each distinct
+# compile once, hydrate everywhere else)
+_AOT_DIR = tempfile.mkdtemp(prefix="pt_usage_aot_")
+atexit.register(shutil.rmtree, _AOT_DIR, ignore_errors=True)
+
+
+def _engine(pages=8, page_size=2, max_seq_len=8, token_budget=64,
+            clock=None):
+    cache = PagedKVCache(pages, page_size, 2, 8,
+                         max_seq_len=max_seq_len)
+    eng = ServeEngine(TinyLM(num_heads=2, head_dim=8), cache,
+                      scheduler=Scheduler(cache,
+                                          token_budget=token_budget,
+                                          clock=clock),
+                      aot_cache_dir=_AOT_DIR)
+    return eng, cache
+
+
+class TestDeviceSecondTelescoping:
+    def test_busy_equals_tenant_and_request_sums_bitwise(self):
+        """A preemption-free two-tenant run: the TickingClock makes
+        every prefill/decode span a deterministic integer-ns value and
+        the three ledgers (busy, per-tenant, per-request) must agree
+        as INT equalities, not approximately."""
+        eng, cache = _engine(pages=16, page_size=4, max_seq_len=16,
+                             clock=TickingClock())
+        ra = eng.submit([3, 1, 4], max_new_tokens=4, tenant="a")
+        rb = eng.submit([2, 7], max_new_tokens=3, tenant="b")
+        eng.run()
+        assert ra.state == "FINISHED" and rb.state == "FINISHED"
+        eng.usage.verify()   # the telescoping identity, asserted
+        m = eng.usage
+        assert m.busy_ns > 0
+        assert m.busy_ns == sum(m.device_ns.values())
+        assert m.busy_ns == sum(m.request_ns.values())
+        assert m.busy_ns == m.prefill_ns + m.decode_ns
+        assert set(m.device_ns) == {"a", "b"}
+
+    def test_telescoping_survives_preemption_and_requeue(self):
+        """The acceptance fixture: a pool sized to force preemption +
+        arrival-order requeue mid-decode. Preempted lanes drop out of
+        the decode split (an all-preempted pass charges nobody), yet
+        the integer ledgers still close bitwise and the page-second
+        integrals all end closed."""
+        eng, cache = _engine(clock=TickingClock())
+        reqs = [eng.submit([1, 2], max_new_tokens=6,
+                           tenant=f"t{i % 2}")
+                for i in range(4)]
+        eng.run(max_steps=200)
+        assert all(r.state == "FINISHED" for r in reqs)
+        assert eng.scheduler.preemptions >= 1, \
+            "pool was sized to force preemption; fixture went vacuous"
+        eng.usage.verify()
+        m = eng.usage
+        assert m.busy_ns == sum(m.device_ns.values()) \
+            == sum(m.request_ns.values())
+        assert set(m.device_ns) == {"t0", "t1"}
+        # a preempted request's pages were freed and re-allocated: its
+        # integral accumulates across incarnations and ends closed
+        pu = cache.page_usage()
+        assert not pu["open"]
+        assert pu["seq_allocs"] == pu["seq_frees"]
+        assert cache.verify()
+        eu = engine_tenant_usage(eng)
+        assert eu["busy_ns"] == m.busy_ns
+        assert sum(t["device_ns"] for t in eu["tenants"].values()) \
+            == m.busy_ns
+        assert sum(t["page_ns"] for t in eu["tenants"].values()) > 0
+
+    def test_routed_fleet_kill_requeue_still_telescopes(self):
+        """Router-level loss: a local 2-replica fleet on a shared
+        TickingClock, one replica killed with a request in flight. The
+        victim's metered nanoseconds die with it (exactly as a real
+        machine loss); every SURVIVING engine's ledger must still
+        close bitwise, and the router's per-tenant rollup must count
+        the requeue and serve every token to completion."""
+        from paddle_tpu.resilience import ReplicaSupervisor
+        from paddle_tpu.serving.fleet import (ReplicaPool, ReplicaSpec,
+                                              Router, TenantPolicy)
+
+        clock = TickingClock()
+        pool = ReplicaPool(
+            ReplicaSpec(vocab_size=32, pages=64, page_size=4,
+                        max_seq_len=32, token_budget=128,
+                        aot_cache_dir=_AOT_DIR, warm=False),
+            replicas=2, mode="local", clock=clock,
+            supervisor=ReplicaSupervisor(sleep=lambda s: None))
+        router = Router(pool, clock=clock, tenants={
+            "a": TenantPolicy(weight=3.0),
+            "b": TenantPolicy(weight=1.0)})
+        reqs = [router.submit([1, 2, 3], max_new_tokens=3,
+                              tenant=("a" if i % 2 else "b"),
+                              rid=f"u{i}") for i in range(4)]
+        router.dispatch()
+        victim = reqs[0].replica_id
+        pool.replicas[victim].kill()
+        router.check_replicas()       # requeue + relaunch
+        for _ in range(300):
+            router.step()
+            clock.advance(0.01)
+            if not router.inflight and not router.queue_depth:
+                break
+        assert all(r.state == "FINISHED" for r in reqs)
+        assert any(r.requeues for r in reqs), \
+            "kill stranded nobody — requeue fixture went vacuous"
+        for eng in pool.local_engines():
+            eng.usage.verify()
+            eu = engine_tenant_usage(eng)
+            assert eu["busy_ns"] == sum(
+                t["device_ns"] for t in eu["tenants"].values())
+            assert eu["page_open"] == 0
+            assert eu["seq_allocs"] == eu["seq_frees"]
+        tu = router_tenant_usage(router)
+        assert set(tu["tenants"]) == {"a", "b"}
+        assert tu["served_total"] > 0
+        assert sum(d["requeued"] for d in tu["tenants"].values()) >= 1
+        assert all(d["completed"] == 2 for d in tu["tenants"].values())
+        router.close()
+
+
+class TestPageSecondClosure:
+    def test_cancel_mid_flight_closes_the_integral(self):
+        """A cancelled request's pages free immediately and its
+        page-second integral closes at the cancel stamp — the
+        hand-computable ManualClock twin of the chaos-kill closure the
+        drill facet below asserts."""
+        clock = ManualClock()
+        eng, cache = _engine(pages=16, page_size=4, max_seq_len=16,
+                             clock=clock)
+        keep = eng.submit([5, 6, 7], max_new_tokens=3, tenant="a")
+        doomed = eng.submit([1, 2, 3, 4, 5], max_new_tokens=8,
+                            tenant="b")
+        clock.advance(1.0)
+        eng.step()                    # both prefilled: pages held
+        held = len(cache.page_table(doomed.rid))
+        assert held >= 1
+        clock.advance(2.0)
+        eng.cancel(doomed)
+        # 2 pages x 3 s (alloc at t=1 inside the step... the exact
+        # value depends on the prefill stamp, so assert closure and
+        # positivity, not a constant: the hand-computed-constant gate
+        # lives in tools/usage_report.py --self-test)
+        assert cache.closed_page_ns(doomed.rid) > 0
+        eng.run(max_steps=100)
+        assert keep.state == "FINISHED"
+        pu = cache.page_usage()
+        assert not pu["open"]
+        assert pu["seq_allocs"] == pu["seq_frees"] == 2
+        assert cache.verify()
+
+
+class TestDrillTenantFacet:
+    """Satellites on the CACHED multi-process kill drill (one
+    execution per process — tier-1 pays for one drill total). The
+    drill itself already ran the live gate: scraped ``tenant_*``
+    gauges bitwise-equal to ``router_tenant_usage`` truth."""
+
+    def test_drill_metered_the_drill_tenant(self):
+        from paddle_tpu.serving.fleet import drill
+
+        res = drill.drill_result()
+        assert not res["failures"], res["failures"]
+        tu = res["tenant_usage"]
+        assert tu and set(tu["tenants"]) == {"drill"}
+        d = tu["tenants"]["drill"]
+        assert d["completed"] == len(res["requests"])
+        assert d["requeued"] >= 1          # the kill's strands
+        assert d["share"] == 1.0 and d["weight_share"] == 1.0
+        # single tenant: its served tokens ARE the fleet total
+        assert d["served_tokens"] == tu["served_total"] > 0
+
+    def test_rank_journals_carry_closed_engine_usage(self):
+        """Each rank's final ``tenant.usage`` event (the worker's
+        before-goodbye engine truth; a hard-killed incarnation never
+        writes one — machine loss loses its meter, as billed) must be
+        internally closed: busy == sum(tenant device-ns), zero open
+        page intervals, alloc == free."""
+        from paddle_tpu.obs import fleet as obs_fleet
+        from paddle_tpu.serving.fleet import drill
+
+        res = drill.drill_result()
+        assert not res["failures"], res["failures"]
+        agg = obs_fleet.aggregate(res["run_dir"])
+        tu = agg["tenant_usage"]
+        assert tu is not None
+        assert set(tu["replicas"]), "no rank journaled tenant.usage"
+        for rank, e in tu["replicas"].items():
+            assert e["busy_ns"] == sum(
+                t["device_ns"] for t in e["tenants"].values()), \
+                f"rank {rank} engine ledger leaked nanoseconds: {e}"
+            assert e["page_open"] == 0, \
+                f"rank {rank} left open page intervals: {e}"
+            assert set(e["tenants"]) <= {"drill"}
+        # the pooled request records rebuild the bill per tenant
+        assert set(tu["tenants"]) == {"drill"}
+        row = tu["tenants"]["drill"]
+        assert row["completed"] >= len(res["requests"])
+        assert row["device_ns"] > 0 and row["page_ns"] > 0
+        # router journal carried the tenant.summary -> fleet fairness
+        assert tu["router"] is not None
+        assert set(tu["router"]["tenants"]) == {"drill"}
+
+    def test_usage_report_renders_the_drill_chargeback(self):
+        """tools/usage_report.py over the drill's run dir: the
+        chargeback table bills the drill tenant with nonzero
+        device-ms and closed replica ledgers (TELESCOPED lines)."""
+        import importlib.util
+        import os
+
+        from paddle_tpu.serving.fleet import drill
+
+        res = drill.drill_result()
+        assert not res["failures"], res["failures"]
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        spec = importlib.util.spec_from_file_location(
+            "usage_report", os.path.join(root, "tools",
+                                         "usage_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        u = mod.load_usage(res["run_dir"])
+        assert "drill" in u["tenants"]
+        assert u["tenants"]["drill"]["device_ns"] > 0
+        table = mod.render_usage(u)
+        assert "drill" in table
+        assert "TELESCOPED" in table and "LEAK" not in table
+        # A-vs-A on the real artifact: no self-regression
+        rep = mod.diff_usage(u, u)
+        assert not rep["regression"], rep

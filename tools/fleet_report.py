@@ -39,6 +39,8 @@ THIS_DIR = os.path.dirname(os.path.abspath(__file__))
 
 DEFAULT_SKEW_THRESHOLD = 0.25  # max cross-rank skew may grow 25%
 DEFAULT_TTFT_THRESHOLD = 0.25  # merged p99 TTFT may grow 25%
+DEFAULT_FAIRNESS_DRIFT_THRESHOLD = 0.20  # |served share - weight share|
+#                 (absolute; mirrors obs.usage.DEFAULT_FAIRNESS_DRIFT_THRESHOLD)
 
 
 def _load_sibling(name):
@@ -120,6 +122,11 @@ def render_fleet(agg, as_json=False):
         # the same obs.fleet.router_summary dict — a second
         # hand-maintained copy here had already drifted)
         lines.append(_load_sibling("run_report").render_router_line(rt))
+    tu = agg.get("tenant_usage")
+    if tu and (tu.get("tenants") or tu.get("fairness")):
+        # ONE tenant-table format: run_report owns it (same
+        # single-owner discipline as the router line above)
+        lines += _load_sibling("run_report").render_tenant_table(tu)
     sup = agg.get("supervisor")
     if sup:
         line = (f"supervisor   restarts={sup['restarts']} "
@@ -147,13 +154,19 @@ def render_fleet(agg, as_json=False):
 
 
 def diff_fleets(base, new, skew_threshold=DEFAULT_SKEW_THRESHOLD,
-                ttft_threshold=DEFAULT_TTFT_THRESHOLD):
+                ttft_threshold=DEFAULT_TTFT_THRESHOLD,
+                fairness_drift_threshold=(
+                    DEFAULT_FAIRNESS_DRIFT_THRESHOLD)):
     """Compare two fleet aggregates; regression flips when NEW's
     cross-rank skew (or straggler count) is worse than BASE beyond the
     threshold. A perfectly balanced base (skew 1.0) regressing to ANY
     persistent straggler is flagged regardless of ratio. Serve fleets:
     the MERGED (cross-replica pooled) p99 TTFT gates the same way —
-    the aggregate serving-SLO axis a per-rank skew number can't see."""
+    the aggregate serving-SLO axis a per-rank skew number can't see —
+    and NEW's fairness drift (worst |served share - weight share| from
+    the router's tenant.summary) exceeding the absolute threshold AND
+    base's own drift flags a weighted-scheduling regression (the
+    worse-than-base clause keeps A-vs-A clean by construction)."""
     bs, ns = base["skew"]["max"], new["skew"]["max"]
     b_slow = sum(1 for s in base.get("stragglers") or []
                  if s["kind"] == "slow")
@@ -182,9 +195,22 @@ def diff_fleets(base, new, skew_threshold=DEFAULT_SKEW_THRESHOLD,
     out["ttft_regression"] = bool(
         bt is not None and nt is not None and
         nt > bt * (1.0 + ttft_threshold))
+    bfd = ((base.get("tenant_usage") or {}).get("fairness")
+           or {}).get("max_drift")
+    nfd = ((new.get("tenant_usage") or {}).get("fairness")
+           or {}).get("max_drift")
+    out["base_fairness_drift"] = bfd
+    out["new_fairness_drift"] = nfd
+    out["fairness_drift_regression"] = bool(
+        nfd is not None and nfd > fairness_drift_threshold and
+        (bfd is None or nfd > bfd))
+    if out["fairness_drift_regression"]:
+        out["fairness_worst_tenant"] = \
+            ((new.get("tenant_usage") or {}).get("fairness")
+             or {}).get("worst_tenant")
     out["regression"] = out["skew_regression"] or \
         out["straggler_regression"] or out["hang_regression"] or \
-        out["ttft_regression"]
+        out["ttft_regression"] or out["fairness_drift_regression"]
     return out
 
 
@@ -198,7 +224,8 @@ def render_diff(rep, as_json=False):
 # -- self-test ---------------------------------------------------------------
 
 
-def _write_rank(run_dir, rank, step_ms, n_steps=10, requests=()):
+def _write_rank(run_dir, rank, step_ms, n_steps=10, requests=(),
+                tenant=None):
     """One canned rank journal through the REAL RunJournal API."""
     from paddle_tpu.obs import journal as J
 
@@ -213,7 +240,8 @@ def _write_rank(run_dir, rank, step_ms, n_steps=10, requests=()):
         j.record_request(
             rid=f"r{rank}_{i}", state="FINISHED", arrival_t=0.0,
             admit_t=0.001, first_token_t=ttft_ms / 1e3, finish_t=2.0,
-            prompt_tokens=4, output_tokens=5)
+            prompt_tokens=4, output_tokens=5,
+            **({"tenant": tenant} if tenant else {}))
     j.close()
     return j
 
@@ -342,9 +370,57 @@ def _selftest_fixtures(failures):
                 render_fleet(ragg):
             failures.append("render lost the router line:\n"
                             + render_fleet(ragg))
+
+        # the fairness-drift gate: CLEAN serves weight-0.25 tenant a
+        # exactly at its entitlement, VIOL serves it at DOUBLE (share
+        # 0.5 — the 2x violation, max_drift 0.25 > the 0.2 default);
+        # the diff must flag it — and ONLY it — and A-vs-A stays clean
+        fclean, fviol = os.path.join(d, "fclean"), os.path.join(d,
+                                                                "fviol")
+        for path, share_a in ((fclean, 0.25), (fviol, 0.5)):
+            _write_rank(path, 0, 10.0, requests=[100.0], tenant="a")
+            rj2 = J.RunJournal(os.path.join(path, J.ROUTER_DIR),
+                               rank=None, flush_every=1,
+                               compute_flops=False)
+            rj2.start()
+            rj2.event(
+                "tenant.summary", served_total=100,
+                tenants={
+                    "a": {"share": share_a, "weight_share": 0.25,
+                          "served_tokens": 100 * share_a},
+                    "b": {"share": 1.0 - share_a, "weight_share": 0.75,
+                          "served_tokens": 100 * (1 - share_a)}})
+            rj2.close()
+        aggv = F.aggregate(fviol)
+        frep = diff_fleets(F.aggregate(fclean), aggv)
+        if not frep["fairness_drift_regression"]:
+            failures.append(
+                "diff missed the 2x fairness violation (weight share "
+                f"0.25 served at 0.5): {frep}")
+        if abs((frep["new_fairness_drift"] or 0) - 0.25) > 1e-12:
+            failures.append(
+                f"fairness drift {frep['new_fairness_drift']} != "
+                "hand-computed 0.25")
+        if frep["skew_regression"] or frep["straggler_regression"] or \
+                frep["ttft_regression"]:
+            failures.append(
+                f"fairness fixture false-positived another gate: "
+                f"{frep}")
+        if not frep["regression"]:
+            failures.append("fairness drift did not fold into the "
+                            "top-level fleet regression flag")
+        fself = diff_fleets(aggv, aggv)
+        if fself["regression"]:
+            failures.append(
+                f"A-vs-A fairness diff false-positived: {fself}")
+        rendered = render_fleet(aggv)
+        if "tenant a" not in rendered or "DRIFT" not in rendered:
+            failures.append("render lost the tenant/fairness lines:\n"
+                            + rendered)
     print("  fixtures       ok — exact 20/15 skew, rank-1-at-2.0x "
           "attribution, merged p50=500/p99=1000, re-arm, diff gate, "
-          "2x-TTFT gate, router line"
+          "2x-TTFT gate, router line, 2x-fairness-violation gate "
+          "(A-vs-A clean)"
           if not failures else
           f"  fixtures       FAILED ({len(failures)})")
     return failures
@@ -446,6 +522,11 @@ def main(argv=None):
                     default=DEFAULT_TTFT_THRESHOLD,
                     help="allowed relative merged-p99-TTFT growth "
                          "(--diff, serve fleets)")
+    ap.add_argument("--fairness-drift-threshold", type=float,
+                    default=DEFAULT_FAIRNESS_DRIFT_THRESHOLD,
+                    help="allowed absolute |served share - weight "
+                         "share| fairness drift per tenant (--diff, "
+                         "serve fleets)")
     ap.add_argument("--self-test", action="store_true")
     args = ap.parse_args(argv)
     from paddle_tpu.obs import fleet as F
@@ -458,7 +539,9 @@ def main(argv=None):
         rep = diff_fleets(F.aggregate(args.paths[0]),
                           F.aggregate(args.paths[1]),
                           skew_threshold=args.skew_threshold,
-                          ttft_threshold=args.ttft_threshold)
+                          ttft_threshold=args.ttft_threshold,
+                          fairness_drift_threshold=args
+                          .fairness_drift_threshold)
         print(render_diff(rep, as_json=args.json))
         return 1 if rep["regression"] else 0
     if len(args.paths) != 1:

@@ -39,6 +39,8 @@ DEFAULT_MEMORY_DRIFT_THRESHOLD = 0.15   # static peak-HBM prediction vs
 #                                         the executable's memory_analysis()
 DEFAULT_QUEUE_SHARE_THRESHOLD = 0.10    # serving queue share of TTFT may
 #                                         grow 10 points (absolute)
+DEFAULT_FAIRNESS_DRIFT_THRESHOLD = 0.20  # |served share - weight share|
+#                 (absolute; mirrors obs.usage.DEFAULT_FAIRNESS_DRIFT_THRESHOLD)
 
 
 # -- loading -----------------------------------------------------------------
@@ -149,6 +151,47 @@ def render_router_line(rsum):
     if rsum.get("ttft_p99_ms") is not None:
         line += f" ttft_p99={rsum['ttft_p99_ms']:.1f}ms"
     return line
+
+
+def tenant_summary(run):
+    """Per-tenant chargeback columns over the run's request records and
+    ``tenant.*`` events (canonical implementation:
+    ``obs.fleet.tenant_summary``): tokens, device-ns, page-ns, exact
+    latency percentiles per tenant, plus the router's fairness audit.
+    None when the run carries no tenant signal."""
+    from paddle_tpu.obs import fleet as _fleet
+
+    return _fleet.tenant_summary(run)
+
+
+def render_tenant_table(tsum):
+    """Render lines for a per-tenant chargeback rollup (one line per
+    tenant + the fairness verdict; shared with tools/fleet_report.py
+    and tools/usage_report.py via their ``_load_sibling``)."""
+    lines = []
+    for t, d in sorted((tsum.get("tenants") or {}).items()):
+        line = (f"tenant {t:<10} req={d.get('requests', 0)} "
+                f"done={d.get('completed', 0)} "
+                f"tok={d.get('prompt_tokens', 0)}"
+                f"+{d.get('decode_tokens', 0)} "
+                f"dev_ms={(d.get('device_ns') or 0) / 1e6:.3f} "
+                f"page_s={(d.get('page_ns') or 0) / 1e9:.3f}")
+        if d.get("preemptions"):
+            line += f" preempt={d['preemptions']}"
+        for key, label in (("ttft_ms_p99", "ttft_p99"),
+                           ("e2e_ms_p99", "e2e_p99")):
+            if d.get(key) is not None:
+                line += f" {label}={d[key]:.1f}ms"
+        lines.append(line)
+    fair = tsum.get("fairness")
+    if fair and fair.get("tenants"):
+        line = (f"fairness     max_drift={fair['max_drift']:.3f} "
+                f"threshold={fair['threshold']:.3f}")
+        if fair.get("worst_tenant") is not None:
+            line += f" worst={fair['worst_tenant']}"
+        line += " ok" if fair.get("ok") else " DRIFT"
+        lines.append(line)
+    return lines
 
 
 def fleet_summary(path):
@@ -388,6 +431,9 @@ def render_run(run, as_json=False):
     rtsum = router_summary(run)
     if rtsum:
         lines.append(render_router_line(rtsum))
+    tsum = tenant_summary(run)
+    if tsum and (tsum.get("tenants") or tsum.get("fairness")):
+        lines += render_tenant_table(tsum)
     esum = elastic_summary(run)
     if esum:
         line = (f"elastic      restarts={esum['restarts']} "
@@ -421,7 +467,8 @@ def diff_runs(base, new,
               step_time_threshold=DEFAULT_STEP_TIME_THRESHOLD,
               loss_threshold=DEFAULT_LOSS_THRESHOLD,
               comm_threshold=DEFAULT_COMM_THRESHOLD,
-              queue_share_threshold=DEFAULT_QUEUE_SHARE_THRESHOLD):
+              queue_share_threshold=DEFAULT_QUEUE_SHARE_THRESHOLD,
+              fairness_drift_threshold=DEFAULT_FAIRNESS_DRIFT_THRESHOLD):
     """Compare two loaded runs; regression flags flip when NEW is worse
     than BASE beyond the thresholds. Returns a plain-data report."""
     bt, nt = _mean(_step_times(base)), _mean(_step_times(new))
@@ -515,6 +562,24 @@ def diff_runs(base, new,
     out["queue_share_regression"] = bool(
         nqs is not None and
         nqs > (bqs or 0.0) + queue_share_threshold)
+    # fairness-drift fold (obs.usage fairness audit over the router's
+    # tenant.summary truth): NEW's worst |served-share - weight-share|
+    # exceeding the absolute threshold — and whatever drift BASE ran at
+    # — means the weighted scheduler stopped honoring the configured
+    # shares (a tenant is being starved or hogging), a regression even
+    # when every aggregate latency column is clean. The
+    # worse-than-base clause keeps A-vs-A diffs clean by construction.
+    btn, ntn = tenant_summary(base), tenant_summary(new)
+    bfd = ((btn or {}).get("fairness") or {}).get("max_drift")
+    nfd = ((ntn or {}).get("fairness") or {}).get("max_drift")
+    out["base_fairness_drift"] = bfd
+    out["new_fairness_drift"] = nfd
+    out["fairness_drift_regression"] = bool(
+        nfd is not None and nfd > fairness_drift_threshold and
+        (bfd is None or nfd > bfd))
+    if out["fairness_drift_regression"]:
+        out["fairness_worst_tenant"] = \
+            (ntn.get("fairness") or {}).get("worst_tenant")
     if bl is not None and nl is not None:
         margin = loss_threshold * max(abs(bl), 1e-12)
         out["loss_delta"] = nl - bl
@@ -523,7 +588,8 @@ def diff_runs(base, new,
         out["loss_regression"] or out["comm_regression"] or \
         out["gate_regression"] or out["plan_regression"] or \
         out["memory_regression"] or out["aot_regression"] or \
-        out["queue_share_regression"]
+        out["queue_share_regression"] or \
+        out["fairness_drift_regression"]
     return out
 
 
@@ -550,6 +616,8 @@ def render_diff(rep, as_json=False):
               "aot_regression",
               "base_queue_share", "new_queue_share",
               "queue_share_regression",
+              "base_fairness_drift", "new_fairness_drift",
+              "fairness_drift_regression", "fairness_worst_tenant",
               "base_anomalies", "new_anomalies", "regression"):
         if rep.get(k) is not None:
             lines.append(f"{k:<22} {fmt(rep[k])}")
@@ -872,6 +940,61 @@ def self_test():
                     if want not in line:
                         failures.append(
                             f"router render line lost {want!r}: {line}")
+
+        # the fairness-drift regression gate: BASE serves tenants a/b
+        # exactly at their weight shares, NEW serves weight-0.25 tenant
+        # a at DOUBLE its entitlement (share 0.5 — the 2x violation) so
+        # max_drift = 0.25 > the 0.2 default; the diff must flag it,
+        # with the worst tenant attributed, and A-vs-A must stay clean
+        with tempfile.TemporaryDirectory() as d:
+            fa, fb = os.path.join(d, "fa"), os.path.join(d, "fb")
+            for path, share_a in ((fa, 0.25), (fb, 0.5)):
+                j = J.RunJournal(path, compute_flops=False)
+                j.start()
+                j.record_request(
+                    rid="t0", state="FINISHED", tenant="a",
+                    arrival_t=0.0, admit_t=0.01, first_token_t=0.1,
+                    finish_t=0.2, prompt_tokens=4, output_tokens=4,
+                    device_ns=2_000_000, page_ns=5_000_000)
+                j.event(
+                    "tenant.summary", served_total=100,
+                    tenants={
+                        "a": {"share": share_a, "weight_share": 0.25,
+                              "served_tokens": 100 * share_a},
+                        "b": {"share": 1.0 - share_a,
+                              "weight_share": 0.75,
+                              "served_tokens": 100 * (1 - share_a)}})
+                j.close()
+            frep = diff_runs(load_run(fa), load_run(fb))
+            if not frep["fairness_drift_regression"]:
+                failures.append(
+                    "diff missed the 2x fairness violation (weight "
+                    f"share 0.25 served at 0.5): {frep}")
+            if abs((frep["new_fairness_drift"] or 0) - 0.25) > 1e-12:
+                failures.append(
+                    f"fairness drift {frep['new_fairness_drift']} != "
+                    "hand-computed 0.25")
+            if frep.get("fairness_worst_tenant") not in ("a", "b"):
+                failures.append(
+                    "fairness regression lost the worst tenant: "
+                    f"{frep.get('fairness_worst_tenant')}")
+            if not frep["regression"]:
+                failures.append("fairness drift did not fold into the "
+                                "top-level regression flag")
+            fself = diff_runs(load_run(fb), load_run(fb))
+            if fself["regression"]:
+                failures.append(
+                    f"A-vs-A fairness diff false-positived: {fself}")
+            rendered = render_run(load_run(fb))
+            if "tenant a" not in rendered or "DRIFT" not in rendered:
+                failures.append(
+                    "render_run lost the tenant chargeback/fairness "
+                    f"lines:\n{rendered}")
+            if "dev_ms=2.000" not in rendered or \
+                    "page_s=0.005" not in rendered:
+                failures.append(
+                    "tenant table lost the device/page attribution "
+                    f"columns:\n{rendered}")
     finally:
         mfu.set_peak_flops(None)
 
@@ -889,9 +1012,10 @@ def self_test():
           "round-trip with hand-computed TTFT/TPOT/queue percentile "
           "columns and the diff flagged the injected queue-share "
           "shift, "
-          "rank-subdir run dirs render the fleet rollup line, and "
+          "rank-subdir run dirs render the fleet rollup line, "
           "serve-router events render the dispatched/requeued/tenant-"
-          "share line")
+          "share line, and the diff flagged the injected 2x fairness "
+          "violation (A-vs-A clean)")
     return 0
 
 
@@ -915,6 +1039,10 @@ def main(argv=None):
                     default=DEFAULT_QUEUE_SHARE_THRESHOLD,
                     help="allowed absolute growth in the serving "
                          "queue share of TTFT")
+    ap.add_argument("--fairness-drift-threshold", type=float,
+                    default=DEFAULT_FAIRNESS_DRIFT_THRESHOLD,
+                    help="allowed absolute |served share - weight "
+                         "share| fairness drift per tenant")
     ap.add_argument("--self-test", action="store_true",
                     help="synthetic 2-run pair: diff must flag the "
                          "injected regression, detectors must fire")
@@ -928,7 +1056,9 @@ def main(argv=None):
                         step_time_threshold=args.step_time_threshold,
                         loss_threshold=args.loss_threshold,
                         comm_threshold=args.comm_threshold,
-                        queue_share_threshold=args.queue_share_threshold)
+                        queue_share_threshold=args.queue_share_threshold,
+                        fairness_drift_threshold=args
+                        .fairness_drift_threshold)
         print(render_diff(rep, as_json=args.json))
         return 1 if rep["regression"] else 0
     if len(args.paths) != 1:

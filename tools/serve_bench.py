@@ -52,14 +52,77 @@ def _pctl(xs, q):
     return exact_percentile(xs, q)
 
 
+def parse_tenants(spec):
+    """Parse a ``--tenants`` spec: ``name:rate=R[,weight=W];...`` —
+    per-tenant Poisson arrival rate (req/s, required) and fairness
+    weight (default 1.0). E.g. ``a:rate=30,weight=3;b:rate=10``."""
+    out = {}
+    for part in str(spec).split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, kvs = part.partition(":")
+        name = name.strip()
+        if not name:
+            raise ValueError(f"empty tenant name in {spec!r}")
+        d = {"rate": None, "weight": 1.0}
+        for kv in kvs.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, eq, v = kv.partition("=")
+            if not eq or k.strip() not in d:
+                raise ValueError(
+                    f"bad tenant field {kv!r} (want rate=/weight=)")
+            d[k.strip()] = float(v)
+        if d["rate"] is None or d["rate"] <= 0:
+            raise ValueError(f"tenant {name!r} needs rate= > 0")
+        if d["weight"] <= 0:
+            raise ValueError(f"tenant {name!r} needs weight > 0")
+        out[name] = d
+    if not out:
+        raise ValueError(f"empty --tenants spec {spec!r}")
+    return out
+
+
 def make_trace(n_requests, rate, seed=0, vocab=32, short_frac=0.7,
                short_len=(3, 12), long_len=(24, 48),
-               out_len=(4, 24)):
+               out_len=(4, 24), tenants=None):
     """Synthetic open-loop trace: Poisson arrivals (exponential
     inter-arrival at ``rate`` req/s), 70/30 short/long prompt mix,
-    uniform output lengths — deterministic in ``seed``."""
+    uniform output lengths — deterministic in ``seed``.
+
+    With ``tenants`` (a :func:`parse_tenants` dict) each tenant gets
+    its OWN Poisson stream at its own ``rate`` (the global ``rate`` is
+    ignored), ``n_requests`` split across tenants proportional to rate
+    (largest-remainder, so the total is exact), and every item carries
+    a ``"tenant"`` tag. The merged trace interleaves by arrival time —
+    deterministic in ``seed`` and the tenant names."""
     import numpy as np
 
+    if tenants:
+        names = sorted(tenants)
+        total_rate = sum(tenants[t]["rate"] for t in names)
+        exact = {t: n_requests * tenants[t]["rate"] / total_rate
+                 for t in names}
+        counts = {t: int(exact[t]) for t in names}
+        for t in sorted(names, key=lambda t: (exact[t] - counts[t], t),
+                        reverse=True):
+            if sum(counts.values()) >= n_requests:
+                break
+            counts[t] += 1
+        trace = []
+        for i, t in enumerate(names):
+            sub = make_trace(counts[t], tenants[t]["rate"],
+                             seed=seed + 7919 * (i + 1), vocab=vocab,
+                             short_frac=short_frac,
+                             short_len=short_len, long_len=long_len,
+                             out_len=out_len)
+            for item in sub:
+                item["tenant"] = t
+            trace += sub
+        trace.sort(key=lambda r: r["arrival"])
+        return trace
     rng = np.random.RandomState(seed)
     t = 0.0
     trace = []
@@ -76,17 +139,58 @@ def make_trace(n_requests, rate, seed=0, vocab=32, short_frac=0.7,
     return trace
 
 
+def _tenant_extras(rows, tenants):
+    """Per-tenant latency/share extras from finished-request rows
+    ``(tenant, tokens, ttft_ms, e2e_ms)``: served-token share vs the
+    configured weight share, per-tenant p50/p99, and the headline
+    ``tenant_share_err`` = max |share - weight_share| (0.0 with < 2
+    tenants — nothing to be unfair between)."""
+    wsum = sum(d["weight"] for d in tenants.values())
+    by_t = {t: {"finished": 0, "tokens": 0, "_ttft": [], "_e2e": []}
+            for t in tenants}
+    for tenant, tokens, ttft_ms, e2e_ms in rows:
+        d = by_t.setdefault(tenant, {"finished": 0, "tokens": 0,
+                                     "_ttft": [], "_e2e": []})
+        d["finished"] += 1
+        d["tokens"] += int(tokens)
+        if ttft_ms is not None:
+            d["_ttft"].append(ttft_ms)
+        if e2e_ms is not None:
+            d["_e2e"].append(e2e_ms)
+    total = sum(d["tokens"] for d in by_t.values())
+    out, err = {}, 0.0
+    for t in sorted(by_t):
+        d = by_t[t]
+        share = d["tokens"] / total if total else 0.0
+        wshare = tenants[t]["weight"] / wsum if t in tenants and wsum \
+            else 0.0
+        if len(by_t) >= 2 and total:
+            err = max(err, abs(share - wshare))
+        out[t] = {
+            "finished": d["finished"], "tokens": d["tokens"],
+            "share": share, "weight_share": wshare,
+            "ttft_p50_ms": _pctl(d["_ttft"], 50),
+            "ttft_p99_ms": _pctl(d["_ttft"], 99),
+            "e2e_p50_ms": _pctl(d["_e2e"], 50),
+            "e2e_p99_ms": _pctl(d["_e2e"], 99),
+        }
+    return out, err
+
+
 def run_bench(n_requests=32, rate=50.0, pages=128, page_size=8,
               seed=0, token_budget=512, heads=2, head_dim=8,
-              vocab=32):
+              vocab=32, tenants=None):
     """Drive the trace through a real-clock engine; returns the report
     dict. Open loop: requests are submitted when their arrival time
     passes, whether or not the engine kept up (so TTFT includes queue
-    time under overload, as in a real serving SLO)."""
+    time under overload, as in a real serving SLO). ``tenants`` (a
+    :func:`parse_tenants` dict) tags the trace per tenant and adds the
+    per-tenant share/latency extras to the report."""
     from paddle_tpu.serving import (PagedKVCache, Scheduler, ServeEngine,
                                     TinyLM)
 
-    trace = make_trace(n_requests, rate, seed=seed, vocab=vocab)
+    trace = make_trace(n_requests, rate, seed=seed, vocab=vocab,
+                       tenants=tenants)
     model = TinyLM(vocab_size=vocab, num_heads=heads, head_dim=head_dim,
                    seed=seed)
     cache = PagedKVCache(pages, page_size, heads, head_dim)
@@ -103,7 +207,8 @@ def run_bench(n_requests=32, rate=50.0, pages=128, page_size=8,
             try:
                 eng.submit(r["prompt"],
                            max_new_tokens=r["max_new_tokens"],
-                           arrival_t=t_start + r["arrival"])
+                           arrival_t=t_start + r["arrival"],
+                           tenant=r.get("tenant"))
             except ValueError:
                 # admission control: a request that can NEVER fit the
                 # pool is refused at the door, not served truncated
@@ -118,7 +223,7 @@ def run_bench(n_requests=32, rate=50.0, pages=128, page_size=8,
             # finished instead of busy-spinning forever
             break
     wall = time.monotonic() - t_start
-    rep = _report(eng, wall, n_requests)
+    rep = _report(eng, wall, n_requests, tenants=tenants)
     rep["rejected"] = rejected
     rep["stuck"] = eng.scheduler.queue_depth
     return rep
@@ -162,7 +267,7 @@ def _print_request_report(rep):
         if share[p] > 0))
 
 
-def _report(eng, wall_s, n_requests):
+def _report(eng, wall_s, n_requests, tenants=None):
     fin = eng.finished
     ttft = [(r.first_token_t - r.arrival_t) * 1e3 for r in fin
             if r.first_token_t is not None]
@@ -171,7 +276,7 @@ def _report(eng, wall_s, n_requests):
     e2e = [(r.finish_t - r.arrival_t) * 1e3 for r in fin]
     tokens = sum(len(r.generated) for r in fin)
     st = eng.cache.stats()
-    return {
+    rep = {
         "requests": n_requests, "finished": len(fin),
         "tokens": tokens, "wall_s": wall_s,
         "tokens_per_sec": tokens / wall_s if wall_s else None,
@@ -183,6 +288,16 @@ def _report(eng, wall_s, n_requests):
         "kv_used_pages": st["used_pages"],
         "kv_fragmentation": st["fragmentation"],
     }
+    if tenants:
+        rows = [(r.tenant or "default", len(r.generated),
+                 None if r.first_token_t is None
+                 else (r.first_token_t - r.arrival_t) * 1e3,
+                 None if r.finish_t is None
+                 else (r.finish_t - r.arrival_t) * 1e3)
+                for r in fin]
+        rep["tenants"], rep["tenant_share_err"] = \
+            _tenant_extras(rows, tenants)
+    return rep
 
 
 # -- fleet mode (--replicas N) ------------------------------------------------
@@ -191,17 +306,22 @@ def _report(eng, wall_s, n_requests):
 def run_bench_fleet(n_requests=32, rate=50.0, replicas=2, pages=128,
                     page_size=8, seed=0, token_budget=512, heads=2,
                     head_dim=8, vocab=32, keep_router=False,
-                    trace_kw=None, aot_cache_dir=None):
+                    trace_kw=None, aot_cache_dir=None, tenants=None):
     """The same open-loop Poisson trace through a ``serving.fleet``
     Router over N in-process replicas: aggregate p50/p99 TTFT/TPOT
     across the whole fleet, a per-replica breakdown, and
     ``router_overhead_ms`` — wall time spent inside the router's
     dispatch/poll/health decisions (NOT engine compute), the dispatch-
-    layer tax the single-engine bench can't see."""
-    from paddle_tpu.serving.fleet import ReplicaPool, ReplicaSpec, Router
+    layer tax the single-engine bench can't see. ``tenants`` (a
+    :func:`parse_tenants` dict) additionally configures the router's
+    weighted-deficit fairness (``TenantPolicy(weight=...)``), tags
+    submissions per tenant, and adds the per-tenant share/latency
+    extras to the report."""
+    from paddle_tpu.serving.fleet import (ReplicaPool, ReplicaSpec,
+                                          Router, TenantPolicy)
 
     trace = make_trace(n_requests, rate, seed=seed, vocab=vocab,
-                       **(trace_kw or {}))
+                       tenants=tenants, **(trace_kw or {}))
     # an executable cache dir makes replicas 2..N hydrate the buckets
     # replica 1 compiled (warm=False: lazily, only buckets the trace
     # actually reaches)
@@ -210,7 +330,9 @@ def run_bench_fleet(n_requests=32, rate=50.0, replicas=2, pages=128,
                        page_size=page_size, token_budget=token_budget,
                        aot_cache_dir=aot_cache_dir, warm=False)
     pool = ReplicaPool(spec, replicas=replicas, mode="local")
-    router = Router(pool)
+    router = Router(pool, tenants=None if not tenants else {
+        t: TenantPolicy(weight=d["weight"])
+        for t, d in tenants.items()})
     t_start = time.monotonic()
     pending = list(trace)
     rejected = 0
@@ -222,7 +344,8 @@ def run_bench_fleet(n_requests=32, rate=50.0, replicas=2, pages=128,
             try:
                 router.submit(r["prompt"],
                               max_new_tokens=r["max_new_tokens"],
-                              arrival_t=t_start + r["arrival"])
+                              arrival_t=t_start + r["arrival"],
+                              tenant=r.get("tenant"))
             except ValueError:
                 rejected += 1
         if not router.inflight and not router.queue_depth:
@@ -241,7 +364,7 @@ def run_bench_fleet(n_requests=32, rate=50.0, replicas=2, pages=128,
         if not pumped and not router.inflight and not pending:
             break  # gridlock: nothing dispatchable, nothing arriving
     wall = time.monotonic() - t_start
-    rep = _fleet_report(router, wall, n_requests)
+    rep = _fleet_report(router, wall, n_requests, tenants=tenants)
     rep["rejected"] = rejected
     rep["stuck"] = router.queue_depth
     rep["router_overhead_ms"] = router_s * 1e3
@@ -251,7 +374,7 @@ def run_bench_fleet(n_requests=32, rate=50.0, replicas=2, pages=128,
     return rep
 
 
-def _fleet_report(router, wall_s, n_requests):
+def _fleet_report(router, wall_s, n_requests, tenants=None):
     fin = [r for r in router.completed if r.state == "FINISHED"]
     ttft = [(r.first_token_t - r.arrival_t) * 1e3 for r in fin
             if r.first_token_t is not None]
@@ -271,7 +394,7 @@ def _fleet_report(router, wall_s, n_requests):
         d["tokens"] += len(r.tokens)
         d["preemptions"] += r.preemptions
         d["requeues"] += r.requeues
-    return {
+    rep = {
         "requests": n_requests, "finished": len(fin),
         "replicas": st["replicas"], "tokens": tokens, "wall_s": wall_s,
         "tokens_per_sec": tokens / wall_s if wall_s else None,
@@ -281,6 +404,16 @@ def _fleet_report(router, wall_s, n_requests):
         "dispatched": st["dispatched"], "requeued": st["requeued"],
         "per_replica": per_replica,
     }
+    if tenants:
+        rows = [(r.tenant or "default", len(r.tokens),
+                 None if r.first_token_t is None
+                 else (r.first_token_t - r.arrival_t) * 1e3,
+                 None if r.finish_t is None
+                 else (r.finish_t - r.arrival_t) * 1e3)
+                for r in fin]
+        rep["tenants"], rep["tenant_share_err"] = \
+            _tenant_extras(rows, tenants)
+    return rep
 
 
 # -- self-test ----------------------------------------------------------------
@@ -517,6 +650,57 @@ def _test_router_trace(failures):
     router.close()
 
 
+def _test_tenant_trace(failures):
+    """Deterministic multi-tenant trace + share math: the spec parser,
+    largest-remainder count split (total exact), arrival-sorted merge,
+    and hand-computed ``tenant_share_err`` from ``_tenant_extras``."""
+    tn = parse_tenants("a:rate=30,weight=3;b:rate=10")
+    _check(failures,
+           tn == {"a": {"rate": 30.0, "weight": 3.0},
+                  "b": {"rate": 10.0, "weight": 1.0}},
+           f"parse_tenants mis-parsed: {tn}")
+    for bad in ("", "a:weight=2", "a:rate=0", "a:rate=5,burst=1"):
+        try:
+            parse_tenants(bad)
+            _check(failures, False,
+                   f"parse_tenants accepted bad spec {bad!r}")
+        except ValueError:
+            pass
+    trace = make_trace(8, 999.0, seed=3, tenants=tn)
+    counts = {}
+    for r in trace:
+        counts[r["tenant"]] = counts.get(r["tenant"], 0) + 1
+    _check(failures, counts == {"a": 6, "b": 2},
+           f"rate-proportional split {counts} != {{'a': 6, 'b': 2}} "
+           "(8 requests at 30:10)")
+    _check(failures,
+           all(trace[i]["arrival"] <= trace[i + 1]["arrival"]
+               for i in range(len(trace) - 1)),
+           "merged tenant trace not sorted by arrival")
+    _check(failures, trace == make_trace(8, 999.0, seed=3, tenants=tn),
+           "tenant trace not deterministic in seed")
+    # hand-computed shares: a serves 60 of 100 tokens (share 0.6) vs
+    # weight share 0.75, b 0.4 vs 0.25 -> share_err = 0.15 both ways
+    rows = [("a", 60, 1.0, 2.0), ("b", 40, 3.0, 4.0)]
+    per, err = _tenant_extras(rows, tn)
+    _check(failures, abs(err - 0.15) < 1e-12,
+           f"tenant_share_err {err} != hand-computed 0.15")
+    _check(failures,
+           per["a"]["share"] == 0.6 and per["a"]["weight_share"] == 0.75
+           and per["b"]["share"] == 0.4
+           and per["b"]["weight_share"] == 0.25,
+           f"share math off: {per}")
+    _check(failures,
+           per["a"]["ttft_p99_ms"] == 1.0
+           and per["b"]["e2e_p99_ms"] == 4.0,
+           f"per-tenant percentiles off: {per}")
+    # < 2 tenants: no counterpart to be unfair to
+    _, err1 = _tenant_extras([("a", 60, 1.0, 2.0)],
+                             {"a": {"rate": 1.0, "weight": 1.0}})
+    _check(failures, err1 == 0.0,
+           f"single-tenant share_err {err1} != 0.0")
+
+
 def _test_fleet_bench_gates(failures):
     """A real 2-replica fleet run on CPU: aggregate-percentile gates,
     per-replica breakdown consistency, oracle-identical tokens, and a
@@ -535,12 +719,14 @@ def _test_fleet_bench_gates(failures):
     import tempfile
 
     _TRACE_KW = dict(short_frac=1.0, out_len=(4, 10))
+    _TENANTS = parse_tenants("a:rate=100,weight=1;b:rate=100,weight=1")
     aot_dir = tempfile.mkdtemp(prefix="pt_serve_bench_aot_")
     rep, router = run_bench_fleet(n_requests=12, rate=200.0,
                                   replicas=2, pages=64, page_size=8,
                                   token_budget=256, keep_router=True,
                                   trace_kw=_TRACE_KW,
-                                  aot_cache_dir=aot_dir)
+                                  aot_cache_dir=aot_dir,
+                                  tenants=_TENANTS)
     try:
         _check(failures, rep["replicas"] == 2,
                f"fleet bench ran {rep['replicas']} replicas, want 2")
@@ -566,7 +752,8 @@ def _test_fleet_bench_gates(failures):
                f"fleet run should finish all 12: {rep['finished']} "
                f"finished, {rep['rejected']} rejected")
         model = TinyLM(vocab_size=32, num_heads=2, head_dim=8, seed=0)
-        trace = make_trace(12, 200.0, seed=0, vocab=32, **_TRACE_KW)
+        trace = make_trace(12, 200.0, seed=0, vocab=32,
+                           tenants=_TENANTS, **_TRACE_KW)
         by_arrival = sorted(router.completed,
                             key=lambda r: r.arrival_t)
         if len(by_arrival) == len(trace):
@@ -576,6 +763,25 @@ def _test_fleet_bench_gates(failures):
                 _check(failures, r.tokens == ref,
                        f"{r.rid} (replica {r.replica_id}) tokens != "
                        "single-engine oracle")
+        # per-tenant extras from the live routed run: shares partition
+        # the served tokens and the headline share_err is their
+        # measured-vs-weight gap (weights are equal here, so it is
+        # |share_a - 0.5| twice over)
+        per_t = rep.get("tenants") or {}
+        _check(failures, set(per_t) == {"a", "b"},
+               f"fleet tenant extras missing tenants: {sorted(per_t)}")
+        _check(failures,
+               sum(d["tokens"] for d in per_t.values())
+               == rep["tokens"],
+               f"tenant token shares do not partition the total: "
+               f"{per_t} vs {rep['tokens']}")
+        if per_t:
+            want = abs(per_t["a"]["share"] - 0.5)
+            _check(failures,
+                   abs(rep.get("tenant_share_err", -1.0) - want)
+                   < 1e-12,
+                   f"tenant_share_err {rep.get('tenant_share_err')} "
+                   f"!= |share_a - 0.5| = {want}")
         # scrapeable router endpoint, gauges == stats bitwise
         st = router.stats()
         exp = MetricsExporter(engines=[], router=router)
@@ -614,6 +820,7 @@ def self_test():
     _test_scheduler_trace(failures)
     _test_engine_vs_oracle(failures)
     _test_router_trace(failures)
+    _test_tenant_trace(failures)
     _test_fleet_bench_gates(failures)
     for line in failures:
         print(f"  FAILED — {line}")
@@ -627,9 +834,11 @@ def self_test():
           "the pressured engine reproduces the dense oracle's tokens "
           "with manual-clock-exact TTFT, the fleet router's dispatch "
           "trace is hand-exact (least-outstanding tie-break, tenant "
-          "fairness, rate limits), and a live 2-replica run passes the "
-          "aggregate-percentile gates with the scraped router gauges "
-          "bitwise-equal to router truth")
+          "fairness, rate limits), the multi-tenant trace splits "
+          "rate-proportionally with hand-exact share math, and a live "
+          "2-replica run passes the aggregate-percentile gates with "
+          "per-tenant shares partitioning the served tokens and the "
+          "scraped router gauges bitwise-equal to router truth")
     return 0
 
 
@@ -645,6 +854,13 @@ def main(argv=None):
     ap.add_argument("--replicas", type=int, default=1,
                     help="N>1 routes the trace through a "
                          "serving.fleet Router over N replicas")
+    ap.add_argument("--tenants", type=str, default=None, metavar="SPEC",
+                    help="weighted multi-tenant trace: "
+                         "'name:rate=R[,weight=W];...' (per-tenant "
+                         "Poisson rate in req/s; weight drives the "
+                         "router's fairness in --replicas mode). Adds "
+                         "per-tenant p50/p99 + served-token share and "
+                         "the tenant_share_err extra to the report")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--request-report", type=int, default=0,
                     metavar="K",
@@ -666,6 +882,8 @@ def main(argv=None):
     if args.self_test:
         return self_test()
     _ensure_cpu()
+    tenants = None if args.tenants is None else \
+        parse_tenants(args.tenants)
     slo_specs = None
     if args.slo is not None:
         from paddle_tpu.obs.slo import parse_spec_arg
@@ -686,12 +904,13 @@ def main(argv=None):
                 n_requests=args.requests, rate=args.rate,
                 replicas=args.replicas, pages=args.pages,
                 page_size=args.page_size, seed=args.seed,
-                token_budget=args.token_budget)
+                token_budget=args.token_budget, tenants=tenants)
         else:
             rep = run_bench(n_requests=args.requests, rate=args.rate,
                             pages=args.pages,
                             page_size=args.page_size, seed=args.seed,
-                            token_budget=args.token_budget)
+                            token_budget=args.token_budget,
+                            tenants=tenants)
     finally:
         if run_dir is not None:
             journal.end_run()
